@@ -1,0 +1,31 @@
+"""slate_tpu — a TPU-native distributed dense linear algebra framework.
+
+Brand-new design with the capabilities of SLATE (the reference at
+``/root/reference``: distributed tiled BLAS-3, LU/Cholesky/QR solvers,
+two-stage eigensolvers/SVD, LAPACK/ScaLAPACK compat APIs), re-thought for
+TPU: JAX/pjit SPMD over the ICI mesh, ``jax.lax`` collectives instead of
+MPI tile broadcasts, recursive blocked XLA programs instead of OpenMP task
+DAGs, and Pallas kernels for the hot tile batches.
+
+Public surface mirrors ``include/slate/slate.hh`` (BLAS-named drivers) and
+``include/slate/simplified_api.hh`` (verb-named wrappers in
+:mod:`slate_tpu.api.simplified`).
+"""
+
+from . import config  # noqa: F401
+from .enums import (  # noqa: F401
+    Diag, GridOrder, Layout, MethodCholQR, MethodEig, MethodGels, MethodGemm,
+    MethodHemm, MethodLU, MethodSVD, MethodTrsm, Norm, Op, Option, Side,
+    Target, TileKind, Uplo,
+)
+from .exceptions import SlateError  # noqa: F401
+from .grid import ProcessGrid  # noqa: F401
+from .matrix import (  # noqa: F401
+    BandMatrix, BaseMatrix, BaseTrapezoidMatrix, HermitianBandMatrix,
+    HermitianMatrix, Matrix, SymmetricMatrix, TrapezoidMatrix,
+    TriangularBandMatrix, TriangularMatrix,
+)
+from .options import Options, get_option  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+
+__version__ = "0.1.0"

@@ -1,0 +1,45 @@
+"""Global configuration, reference ``include/slate/config.hh:16-75``.
+
+The reference's one runtime config knob is GPU-aware MPI
+(``SLATE_GPU_AWARE_MPI``); on TPU collectives are always device-native so
+that knob is moot.  The knobs that matter on TPU instead:
+
+* ``matmul_precision`` — XLA dot precision for float32 inputs.  TPU MXU
+  natively multiplies bf16; ``HIGHEST`` forces full-f32 accumulation
+  (multi-pass) so residual gates ≤ 3·ε(f32) hold, matching the
+  reference's vendor-BLAS accuracy.  Set to ``"default"`` for maximum
+  throughput when bf16-grade accuracy suffices.
+* ``default_block_size`` — the global nb default (reference per-call
+  ``Option::BlockSize``), tuned for the 128×128 MXU: multiples of 256
+  keep every tile op MXU-shaped.
+
+Env vars: ``SLATE_TPU_PRECISION`` ∈ {highest, high, default},
+``SLATE_TPU_NB`` (int).
+"""
+
+from __future__ import annotations
+
+import os
+
+from jax import lax
+
+_PRECS = {
+    "highest": lax.Precision.HIGHEST,
+    "high": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+}
+
+matmul_precision = _PRECS.get(os.environ.get("SLATE_TPU_PRECISION", "highest"),
+                              lax.Precision.HIGHEST)
+
+default_block_size = int(os.environ.get("SLATE_TPU_NB", "256"))
+
+
+def set_matmul_precision(p) -> None:
+    """Set the dot precision used by every driver ('highest'|'high'|'default')."""
+    global matmul_precision
+    matmul_precision = _PRECS[p] if isinstance(p, str) else p
+
+
+def get_matmul_precision():
+    return matmul_precision

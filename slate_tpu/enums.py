@@ -1,0 +1,218 @@
+"""Enumerations mirroring the reference's public enum surface.
+
+TPU-native re-design of ``include/slate/enums.hh`` (reference
+``enums.hh:33-140``): the same vocabulary — ``Target``, ``Op``, ``Uplo``,
+``Diag``, ``Side``, ``Norm``, ``Layout``, ``GridOrder``, ``Option``,
+``MethodEig`` — expressed as Python enums.  Semantics differ where TPU
+hardware differs:
+
+* ``Target.HostTask / HostNest / HostBatch`` (OpenMP task variants in the
+  reference) collapse into ``Target.Host`` — on this stack XLA:CPU owns
+  intra-host threading, so there is exactly one host execution strategy.
+  They are kept as aliases so option-compatible callers keep working.
+* ``Target.Devices`` means "the JAX default backend" (a TPU chip, or the
+  full mesh for distributed drivers) rather than a CUDA stream set.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Target(enum.Enum):
+    """Execution target, reference ``enums.hh:33-39``.
+
+    The reference dispatches every driver over {HostTask, HostNest,
+    HostBatch, Devices}.  Here the host variants are aliases of ``Host``:
+    XLA compiles one fused program per driver and owns its own threading,
+    so the OpenMP-era split adds nothing on TPU.
+    """
+
+    Host = "host"
+    Devices = "devices"
+
+    # OpenMP-era aliases (reference parity; all mean Host here).
+    HostTask = "host"
+    HostNest = "host"
+    HostBatch = "host"
+
+
+class Op(enum.Enum):
+    """Transposition op, reference ``blaspp`` vocabulary (Tile.hh op_)."""
+
+    NoTrans = "notrans"
+    Trans = "trans"
+    ConjTrans = "conjtrans"
+
+
+class Uplo(enum.Enum):
+    Lower = "lower"
+    Upper = "upper"
+    General = "general"
+
+
+class Diag(enum.Enum):
+    NonUnit = "nonunit"
+    Unit = "unit"
+
+
+class Side(enum.Enum):
+    Left = "left"
+    Right = "right"
+
+
+class Norm(enum.Enum):
+    """Matrix norm selector (LAPACK vocabulary; reference norm drivers)."""
+
+    One = "one"
+    Two = "two"
+    Inf = "inf"
+    Fro = "fro"
+    Max = "max"
+
+
+class Layout(enum.Enum):
+    """Tile element layout, reference ``Tile.hh`` layout_.
+
+    On TPU this is advisory: XLA owns physical layout.  Kept because the
+    LAPACK/ScaLAPACK compat layers need to know how user host buffers are
+    laid out (they are always ColMajor there).
+    """
+
+    ColMajor = "colmajor"
+    RowMajor = "rowmajor"
+
+
+class GridOrder(enum.Enum):
+    """Process-grid ordering, reference ``enums.hh:127``."""
+
+    Col = "col"
+    Row = "row"
+
+
+class TileKind(enum.Enum):
+    """Reference ``Tile.hh:120-124``; retained for the compat layers."""
+
+    Workspace = "workspace"
+    SlateOwned = "slate_owned"
+    UserOwned = "user_owned"
+
+
+class MOSI(enum.Enum):
+    """Tile coherence states, reference ``MatrixStorage.hh:33-38``.
+
+    On TPU the XLA runtime owns placement, so MOSI never drives copies;
+    the enum exists for the debug API (`Debug.tiles_state`) so tooling
+    that introspected coherence in the reference has an equivalent.
+    """
+
+    Modified = "modified"
+    Shared = "shared"
+    Invalid = "invalid"
+    OnHold = "onhold"
+
+
+class Option(enum.Enum):
+    """Option keys, reference ``enums.hh:69-101``."""
+
+    ChunkSize = "chunk_size"
+    Lookahead = "lookahead"
+    BlockSize = "block_size"
+    InnerBlocking = "inner_blocking"
+    MaxPanelThreads = "max_panel_threads"
+    Tolerance = "tolerance"
+    Target = "target"
+    HoldLocalWorkspace = "hold_local_workspace"
+    Depth = "depth"
+    MaxIterations = "max_iterations"
+    UseFallbackSolver = "use_fallback_solver"
+    PivotThreshold = "pivot_threshold"
+    PrintVerbose = "print_verbose"
+    PrintEdgeItems = "print_edgeitems"
+    PrintWidth = "print_width"
+    PrintPrecision = "print_precision"
+    # Method selectors, reference method.hh
+    MethodCholQR = "method_cholqr"
+    MethodEig = "method_eig"
+    MethodGels = "method_gels"
+    MethodGemm = "method_gemm"
+    MethodHemm = "method_hemm"
+    MethodLU = "method_lu"
+    MethodTrsm = "method_trsm"
+    MethodSVD = "method_svd"
+
+
+class MethodGemm(enum.Enum):
+    """gemm variant, reference ``method.hh:77-126``."""
+
+    Auto = "auto"
+    GemmA = "A"
+    GemmC = "C"
+
+
+class MethodHemm(enum.Enum):
+    Auto = "auto"
+    HemmA = "A"
+    HemmC = "C"
+
+
+class MethodTrsm(enum.Enum):
+    Auto = "auto"
+    TrsmA = "A"
+    TrsmB = "B"
+
+
+class MethodCholQR(enum.Enum):
+    Auto = "auto"
+    GemmA = "gemmA"
+    GemmC = "gemmC"
+    HerkA = "herkA"
+    HerkC = "herkC"
+
+
+class MethodGels(enum.Enum):
+    Auto = "auto"
+    QR = "qr"
+    CholQR = "cholqr"
+
+
+class MethodLU(enum.Enum):
+    """LU pivoting variant, reference ``method.hh:279-315``.
+
+    On TPU the communication-avoiding tournament (CALU) is the natural
+    default for the distributed path; PartialPiv is kept for LAPACK-parity
+    numerics.
+    """
+
+    Auto = "auto"
+    PartialPiv = "partial"
+    CALU = "calu"
+    NoPiv = "nopiv"
+    RBT = "rbt"
+    BEAM = "beam"
+
+
+class MethodEig(enum.Enum):
+    """Tridiagonal eigensolver variant, reference ``enums.hh:60-63``."""
+
+    Auto = "auto"
+    QR = "qr"
+    DC = "dc"
+    Bisection = "bisection"
+    MRRR = "mrrr"
+
+
+class MethodSVD(enum.Enum):
+    Auto = "auto"
+    QR = "qr"
+    DC = "dc"
+    Bisection = "bisection"
+
+
+#: Reference ``enums.hh:134`` — host "device" index sentinel.
+HostNum = -1
+
+#: All LAPACK-style precisions the framework supports.  (TPU MXU natively
+#: does bf16/f32; f64 and complex are emulated by XLA — supported for
+#: parity, with mixed-precision drivers as the fast path.)
+PRECISIONS = ("float32", "float64", "complex64", "complex128", "bfloat16")

@@ -1,0 +1,28 @@
+"""Errors, reference ``include/slate/Exception.hh`` (122 LoC).
+
+The reference throws ``slate::Exception`` from ``slate_error`` /
+``slate_assert`` macros.  Numerical non-success (singular pivot, failed
+convergence) is reported via *info codes* in LAPACK style; on TPU the
+data-dependent branch can't throw from inside jit, so drivers return info
+values alongside results and ``check_info`` raises host-side.
+"""
+
+from __future__ import annotations
+
+
+class SlateError(RuntimeError):
+    """Reference ``slate::Exception``."""
+
+
+def slate_assert(cond: bool, msg: str = "assertion failed") -> None:
+    if not cond:
+        raise SlateError(msg)
+
+
+def check_info(info, what: str = "routine") -> None:
+    """Raise if a device-computed info code is nonzero (host sync point)."""
+    import numpy as np
+
+    i = int(np.asarray(info))
+    if i != 0:
+        raise SlateError(f"{what}: info = {i}")

@@ -1,0 +1,11 @@
+"""Driver/algorithm layer — the analog of the reference's ``src/*.cc``
+drivers enumerated in ``include/slate/slate.hh`` (93 public entry points).
+
+Every driver is a pure function (JAX-functional: returns results instead
+of mutating) and is jit-compatible; shapes and blocking are static.
+"""
+
+from .blas3 import (  # noqa: F401
+    gemm, symm, hemm, syrk, herk, syr2k, her2k, trmm, trsm,
+)
+from .cholesky import potrf, potrs, posv, potri, trtri, trtrm  # noqa: F401

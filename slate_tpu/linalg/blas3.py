@@ -1,0 +1,194 @@
+"""Parallel BLAS-3 drivers.
+
+TPU-native re-design of the reference drivers ``src/gemm.cc`` (method
+dispatch ``:72-86``), ``src/symm.cc``/``hemm.cc``, ``src/syrk.cc`` /
+``herk.cc`` / ``syr2k.cc`` / ``her2k.cc``, ``src/trmm.cc``, ``src/trsm.cc``
+(+ work loops ``src/work/work_trsm.cc``, ``work_trmm.cc``).
+
+Semantics follow the reference/BLAS: ``C = α·op(A)·op(B) + β·C`` etc.,
+with matrices carrying their op/uplo/diag; functions return the updated
+matrix (functional style) rather than writing in place.
+
+The reference's method selectors (``method.hh:77-126`` gemmA vs gemmC —
+*where* the reduction happens relative to data layout) govern collective
+placement only in the distributed path (``slate_tpu.parallel``); on a
+single chip XLA picks the contraction schedule, so ``MethodGemm`` is
+accepted and recorded but does not change the emitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from .. import config
+from ..enums import Diag, Op, Side, Uplo
+from ..matrix import (BaseMatrix, BaseTrapezoidMatrix, HermitianMatrix,
+                      Matrix, SymmetricMatrix, TriangularMatrix, as_array)
+from ..options import Options, get_option
+from ..ops import blocks
+from ..ops.blocks import matmul
+
+
+def _arr(x):
+    return as_array(x)
+
+
+def _uplo_of(a, default=Uplo.Lower):
+    if isinstance(a, BaseTrapezoidMatrix):
+        return a.logical_uplo
+    return default
+
+
+def _diag_of(a, default=Diag.NonUnit):
+    return getattr(a, "diag", default)
+
+
+def _wrap_like(template, data):
+    if isinstance(template, BaseMatrix):
+        out = template._like(data)
+        out.op = Op.NoTrans
+        return out
+    return data
+
+
+def _nb(a, opts):
+    """Blocking size: per-call option → matrix nb → SLATE_TPU_NB default."""
+    nb = get_option(opts, "block_size", None)
+    if nb is None:
+        nb = getattr(a, "nb", None) or config.default_block_size
+    return int(nb)
+
+
+def gemm(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """C ← α·op(A)·op(B) + β·C — reference ``slate::gemm`` (``src/gemm.cc``).
+
+    On a single chip this is one fused XLA dot (the MXU hot loop); on a
+    mesh, arrays sharded block-cyclic make XLA insert the SUMMA-style
+    collectives that ``listBcastMT`` performed explicitly in the reference
+    (``src/gemm.cc`` work loop); the hand-scheduled variant lives in
+    ``slate_tpu.parallel.dist_blas3``.
+    """
+
+    av, bv, cv = _arr(a), _arr(b), _arr(c)
+    out = alpha * matmul(av, bv) + beta * cv
+    return _wrap_like(c, out)
+
+
+def symm(side: Side, alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """C ← α·A·B + β·C with A symmetric (stored triangle), reference
+    ``slate::symm`` (``src/symm.cc``)."""
+
+    return _symm_hemm(side, alpha, a, b, beta, c, conj=False)
+
+
+def hemm(side: Side, alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """Hermitian variant, reference ``slate::hemm`` (``src/hemm.cc``)."""
+
+    return _symm_hemm(side, alpha, a, b, beta, c, conj=True)
+
+
+def _symm_hemm(side, alpha, a, b, beta, c, conj):
+    from ..ops.tile_ops import hermitize, symmetrize
+    # logical_uplo pairs with the op-applied array: after a transpose view,
+    # the valid triangle of .array sits in the flipped uplo position.
+    uplo = _uplo_of(a)
+    raw = a.array if isinstance(a, BaseMatrix) else jnp.asarray(a)
+    full = hermitize(uplo, raw) if conj else symmetrize(uplo, raw)
+    bv, cv = _arr(b), _arr(c)
+    if side is Side.Left:
+        out = alpha * matmul(full, bv) + beta * cv
+    else:
+        out = alpha * matmul(bv, full) + beta * cv
+    return _wrap_like(c, out)
+
+
+def _require_notrans_c(c):
+    """Rank-k/2k updates write C in place of its storage; an op-tagged C
+    would make 'preserve the unstored triangle' ambiguous — reject like
+    the reference's typed API does by construction."""
+    if isinstance(c, BaseMatrix) and c.op is not Op.NoTrans:
+        from ..exceptions import SlateError
+        raise SlateError("C of a rank-k/2k update must be a NoTrans view")
+
+
+def _rank_k(alpha, a, beta, c, conj):
+    """Shared syrk/herk core with triangle-restore semantics."""
+    _require_notrans_c(c)
+    uplo = _uplo_of(c)
+    av = _arr(a)
+    cv = c.data if isinstance(c, BaseMatrix) else jnp.asarray(c)
+    nb = getattr(c, "nb", None) or config.default_block_size
+    if conj:
+        alpha = jnp.real(jnp.asarray(alpha))
+        beta = jnp.real(jnp.asarray(beta))
+    new = blocks.herk_rec(uplo, alpha, av, beta, cv, int(nb), conj=conj)
+    # only the stored triangle is defined; keep the other triangle as-is
+    out = jnp.where(_tri_mask(cv.shape[-1], uplo, cv.dtype), new, cv)
+    return _wrap_like(c, out)
+
+
+def _tri_mask(n, uplo, dtype):
+    i = jnp.arange(n)[:, None]
+    j = jnp.arange(n)[None, :]
+    return (i >= j) if uplo is Uplo.Lower else (i <= j)
+
+
+def syrk(alpha, a, beta, c, opts: Optional[Options] = None):
+    """C ← α·op(A)·op(A)ᵀ + β·C on C's triangle, reference ``src/syrk.cc``."""
+    return _rank_k(alpha, a, beta, c, conj=False)
+
+
+def herk(alpha, a, beta, c, opts: Optional[Options] = None):
+    """C ← α·op(A)·op(A)ᴴ + β·C (α, β real), reference ``src/herk.cc``."""
+    return _rank_k(alpha, a, beta, c, conj=True)
+
+
+def _rank_2k(alpha, a, b, beta, c, conj):
+    _require_notrans_c(c)
+    uplo = _uplo_of(c)
+    av, bv = _arr(a), _arr(b)
+    cv = c.data if isinstance(c, BaseMatrix) else jnp.asarray(c)
+    nb = getattr(c, "nb", None) or config.default_block_size
+    if conj:
+        beta = jnp.real(jnp.asarray(beta))
+    new = blocks.her2k_rec(uplo, alpha, av, bv, beta, cv, int(nb), conj=conj)
+    out = jnp.where(_tri_mask(cv.shape[-1], uplo, cv.dtype), new, cv)
+    return _wrap_like(c, out)
+
+
+def syr2k(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """Reference ``src/syr2k.cc``."""
+    return _rank_2k(alpha, a, b, beta, c, conj=False)
+
+
+def her2k(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """Reference ``src/her2k.cc``."""
+    return _rank_2k(alpha, a, b, beta, c, conj=True)
+
+
+def trmm(side: Side, alpha, a, b, opts: Optional[Options] = None):
+    """B ← α·op(A)·B or α·B·op(A), A triangular — reference ``src/trmm.cc``
+    + ``src/work/work_trmm.cc:428``."""
+
+    uplo = _uplo_of(a)
+    diag = _diag_of(a)
+    av, bv = _arr(a), _arr(b)
+    nb = _nb(a, opts)
+    out = alpha * blocks.trmm_rec(side, uplo, diag, av, bv, nb)
+    return _wrap_like(b, out)
+
+
+def trsm(side: Side, alpha, a, b, opts: Optional[Options] = None):
+    """Solve op(A)·X = α·B or X·op(A) = α·B — reference ``src/trsm.cc``
+    (work loop ``src/work/work_trsm.cc:395``; the trsmA data-placement
+    variant ``src/trsmA.cc`` is a distributed-path concern, see
+    ``parallel.dist_blas3``)."""
+
+    uplo = _uplo_of(a)
+    diag = _diag_of(a)
+    av, bv = _arr(a), _arr(b)
+    nb = _nb(a, opts)
+    out = blocks.trsm_rec(side, uplo, diag, av, alpha * bv, nb)
+    return _wrap_like(b, out)

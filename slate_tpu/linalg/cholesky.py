@@ -1,0 +1,126 @@
+"""Cholesky family: potrf / potrs / posv / potri (+ trtri, trtrm).
+
+TPU-native re-design of the reference drivers ``src/potrf.cc`` (the
+canonical lookahead task-DAG driver, ``:54-133``), ``src/potrs.cc``,
+``src/posv.cc``, ``src/potri.cc`` (inverse via ``trtri`` + ``trtrm``,
+``src/trtri.cc`` / ``src/trtrm.cc``).
+
+Where the reference expresses panel/update overlap as an OpenMP task DAG
+with ``Option::Lookahead``, here the recursion in
+:func:`slate_tpu.ops.blocks.potrf_rec` hands XLA an explicit dependence
+graph and the compiler's static scheduler performs the overlap; on a mesh
+the distributed variant lives in ``slate_tpu.parallel.dist_factor``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import config
+from ..enums import Diag, Op, Side, Uplo
+from ..matrix import BaseMatrix, BaseTrapezoidMatrix, HermitianMatrix, TriangularMatrix
+from ..options import Options, get_option
+from ..ops import blocks
+from ..ops.tile_ops import hermitize
+from .blas3 import _arr, _diag_of, _nb, _uplo_of, _wrap_like
+
+
+def _hermitian_full(a):
+    """Expand the stored triangle of ``a`` into the full Hermitian array."""
+    if isinstance(a, BaseTrapezoidMatrix):
+        return hermitize(a.logical_uplo, a.array)
+    return jnp.asarray(a)  # raw array: assume full Hermitian given
+
+
+def potrf(a, opts: Optional[Options] = None):
+    """Cholesky factorization A = L·Lᴴ (or UᴴU) — reference ``slate::potrf``
+    (``src/potrf.cc:369``).
+
+    Parameters: ``a`` — HermitianMatrix (stored triangle) or full
+    Hermitian array.  Returns a TriangularMatrix holding the factor in the
+    same uplo (other triangle zeroed), matching the reference's in-place
+    overwrite of the stored triangle.
+    """
+
+    uplo = _uplo_of(a)
+    nb = _nb(a, opts)
+    full = _hermitian_full(a)
+    if full.shape[-1] != full.shape[-2]:
+        from ..exceptions import SlateError
+        raise SlateError(f"potrf requires a square matrix, got {full.shape}")
+    l = blocks.potrf_rec(full, nb)
+    fac = l if uplo is Uplo.Lower else jnp.conj(l.T)
+    out = TriangularMatrix(fac, uplo=uplo, diag=Diag.NonUnit,
+                           mb=getattr(a, "mb", nb), nb=nb,
+                           grid=getattr(a, "grid", None))
+    return out
+
+
+def potrs(a_factor, b, opts: Optional[Options] = None):
+    """Solve A·X = B given the Cholesky factor — reference ``src/potrs.cc``:
+    two triangular solves."""
+
+    uplo = _uplo_of(a_factor)
+    av = _arr(a_factor)
+    bv = _arr(b)
+    nb = _nb(a_factor, opts)
+    conj = jnp.iscomplexobj(av)
+    if uplo is Uplo.Lower:
+        # L y = b ; L^H x = y
+        y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit, av, bv, nb)
+        lh = jnp.conj(av.T) if conj else av.T
+        x = blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit, lh, y, nb)
+    else:
+        uh = jnp.conj(av.T) if conj else av.T
+        y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit, uh, bv, nb)
+        x = blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit, av, y, nb)
+    return _wrap_like(b, x)
+
+
+def posv(a, b, opts: Optional[Options] = None):
+    """Factor + solve — reference ``slate::posv`` (``src/posv.cc``).
+    Returns ``(factor, x)``."""
+
+    fac = potrf(a, opts)
+    x = potrs(fac, b, opts)
+    return fac, x
+
+
+def trtri(a, opts: Optional[Options] = None):
+    """Triangular inverse — reference ``slate::trtri`` (``src/trtri.cc``)."""
+
+    uplo = _uplo_of(a)
+    diag = _diag_of(a)
+    nb = _nb(a, opts)
+    inv = blocks.trtri_rec(uplo, diag, _arr(a), nb)
+    inv = jnp.tril(inv) if uplo is Uplo.Lower else jnp.triu(inv)
+    return _wrap_like(a, inv)
+
+
+def trtrm(a, opts: Optional[Options] = None):
+    """Triangular × triangular product Lᴴ·L / U·Uᴴ — reference
+    ``slate::trtrm`` (``src/trtrm.cc``, LAPACK ``lauum``)."""
+
+    uplo = _uplo_of(a)
+    nb = _nb(a, opts)
+    av = _arr(a)
+    out = blocks.lauum_rec(uplo, av, nb, conj=jnp.iscomplexobj(av))
+    return _wrap_like(a, out)
+
+
+def potri(a_factor, opts: Optional[Options] = None):
+    """Hermitian-positive-definite inverse from the Cholesky factor —
+    reference ``slate::potri`` (``src/potri.cc``): ``trtri`` then
+    ``trtrm`` (A⁻¹ = L⁻ᴴ·L⁻¹).  Returns a HermitianMatrix (stored
+    triangle valid)."""
+
+    uplo = _uplo_of(a_factor)
+    inv_t = trtri(a_factor, opts)
+    prod = trtrm(inv_t, opts)
+    data = prod.data if isinstance(prod, BaseMatrix) else prod
+    return HermitianMatrix(data, uplo=uplo,
+                           mb=getattr(a_factor, "mb", 256),
+                           nb=getattr(a_factor, "nb", 256),
+                           grid=getattr(a_factor, "grid", None))

@@ -1,0 +1,392 @@
+"""Matrix class hierarchy — TPU-native re-design of the reference's
+``BaseMatrix`` family (``include/slate/BaseMatrix.hh:40-738`` and the ten
+typed headers ``Matrix.hh``, ``TrapezoidMatrix.hh``, ``TriangularMatrix.hh``,
+``SymmetricMatrix.hh``, ``HermitianMatrix.hh``, ``BaseBandMatrix.hh`` …).
+
+Design stance (vs the reference):
+
+* The reference's ``BaseMatrix`` is a *logical view over shared
+  MatrixStorage* — a map (i,j) → per-device TileInstances with MOSI
+  coherence, life counters and nest-locks.  On TPU, XLA owns placement and
+  movement, so storage collapses to **one dense jax.Array** (possibly
+  sharded over a mesh; see :mod:`slate_tpu.parallel.dist`) and the whole
+  coherence layer (``MatrixStorage.hh:33-38``, ``BaseMatrix.hh:2783-3100``)
+  disappears by construction.  What survives is the *view algebra*:
+  ``sub()`` / ``slice()`` / ``transpose`` / ``conj_transpose`` as index
+  arithmetic, exactly like ``BaseMatrix::globalIndex``
+  (``BaseMatrix.hh:684-688``).
+* Matrices are immutable pytrees; drivers are functional (return new
+  matrices) in JAX style rather than mutating, matching jit semantics.
+* Tile size (mb, nb) is metadata steering the *blocking* of algorithms,
+  not the storage granularity.
+
+All classes register as JAX pytrees so they can cross ``jit`` boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .enums import Diag, Op, Uplo
+from .grid import ProcessGrid, ceildiv
+
+
+def _resolve_op(data, op: Op):
+    if op is Op.NoTrans:
+        return data
+    if op is Op.Trans:
+        return jnp.swapaxes(data, -1, -2)
+    return jnp.conj(jnp.swapaxes(data, -1, -2))
+
+
+@jax.tree_util.register_pytree_node_class
+class BaseMatrix:
+    """Common base: a logical (op-tagged) view over a dense 2-D array.
+
+    Reference: ``BaseMatrix.hh:40`` — here without storage/coherence.
+
+    Attributes
+    ----------
+    data : jax.Array
+        The (m, n) dense array in *storage orientation* (op not applied).
+    op : Op
+        Pending transposition, applied lazily by :attr:`array`
+        (reference ``BaseMatrix::op_``).
+    mb, nb : int
+        Tile (block) sizes steering algorithm blocking
+        (reference ``tileMb/tileNb``).
+    grid : ProcessGrid | None
+        Target process grid for distributed execution.
+    """
+
+    uplo: Uplo = Uplo.General
+
+    def __init__(self, data, mb: int = 256, nb: int = 256,
+                 op: Op = Op.NoTrans, grid: Optional[ProcessGrid] = None):
+        self.data = data
+        self.mb = int(mb)
+        self.nb = int(nb)
+        self.op = op
+        self.grid = grid
+
+    # -- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        return (self.data,), self._aux()
+
+    def _aux(self):
+        return (self.mb, self.nb, self.op, self.grid)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.data = children[0]
+        obj.mb, obj.nb, obj.op, obj.grid = aux
+        return obj
+
+    # -- shape queries (reference BaseMatrix::m/n/mt/nt) ------------------
+    @property
+    def m(self) -> int:
+        return self.data.shape[-1] if self.op is not Op.NoTrans else self.data.shape[-2]
+
+    @property
+    def n(self) -> int:
+        return self.data.shape[-2] if self.op is not Op.NoTrans else self.data.shape[-1]
+
+    @property
+    def mt(self) -> int:
+        """Number of block rows (reference ``BaseMatrix::mt()``)."""
+        return ceildiv(self.m, self.mb)
+
+    @property
+    def nt(self) -> int:
+        return ceildiv(self.n, self.nb)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def array(self):
+        """The dense array with the pending op applied."""
+        return _resolve_op(self.data, self.op)
+
+    # -- tile queries (reference BaseMatrix.hh:220-236) -------------------
+    def tile_mb(self, i: int) -> int:
+        return min(self.mb, self.m - i * self.mb)
+
+    def tile_nb(self, j: int) -> int:
+        return min(self.nb, self.n - j * self.nb)
+
+    def tile_rank(self, i: int, j: int) -> int:
+        g = self.grid or ProcessGrid(1, 1)
+        return g.tile_rank(i, j)
+
+    def tile(self, i: int, j: int):
+        """Return tile (i, j) of the logical (op-applied) matrix as an
+        array — the analog of ``BaseMatrix::operator()(i,j)``.
+
+        Index arithmetic mirrors ``BaseMatrix::globalIndex``
+        (``BaseMatrix.hh:684-688``): slice the *storage* with swapped
+        indices, then apply the op to the single tile, so iterating tiles
+        of a transposed view never materialises a full-matrix transpose.
+        """
+        if self.op is Op.NoTrans:
+            return self.data[i * self.mb:i * self.mb + self.tile_mb(i),
+                             j * self.nb:j * self.nb + self.tile_nb(j)]
+        t = self.data[j * self.nb:j * self.nb + self.tile_nb(j),
+                      i * self.mb:i * self.mb + self.tile_mb(i)]
+        return _resolve_op(t, self.op)
+
+    # -- view algebra -----------------------------------------------------
+    def _like(self, data, **kw):
+        obj = type(self).__new__(type(self))
+        obj.data = data
+        obj.mb = kw.get("mb", self.mb)
+        obj.nb = kw.get("nb", self.nb)
+        obj.op = kw.get("op", self.op)
+        obj.grid = kw.get("grid", self.grid)
+        for f in ("uplo", "diag", "kl", "ku", "kd"):
+            if hasattr(self, f):
+                setattr(obj, f, kw.get(f, getattr(self, f)))
+        return obj
+
+    def transpose(self):
+        """Shallow transposed view (reference ``transpose(A)`` free fn).
+
+        Like the reference (``BaseMatrix.hh``), composing a plain transpose
+        onto a ConjTrans view (or conj-transpose onto Trans) would need a
+        fourth "conj-no-trans" op which neither library models — raise.
+        """
+        if self.op is Op.ConjTrans:
+            from .exceptions import SlateError
+            raise SlateError("transpose of a ConjTrans view is unsupported "
+                             "(would need conj-no-trans)")
+        flip = {Op.NoTrans: Op.Trans, Op.Trans: Op.NoTrans}
+        return self._like(self.data, op=flip[self.op], mb=self.nb, nb=self.mb)
+
+    def conj_transpose(self):
+        if self.op is Op.Trans:
+            from .exceptions import SlateError
+            raise SlateError("conj_transpose of a Trans view is unsupported "
+                             "(would need conj-no-trans)")
+        flip = {Op.NoTrans: Op.ConjTrans, Op.ConjTrans: Op.NoTrans}
+        return self._like(self.data, op=flip[self.op], mb=self.nb, nb=self.mb)
+
+    def sub(self, i1: int, i2: int, j1: int, j2: int) -> "Matrix":
+        """Tile-index submatrix view [i1..i2] × [j1..j2] inclusive,
+        reference ``Matrix::sub`` (``Matrix.hh:131``)."""
+        a = self.array
+        r0, r1 = i1 * self.mb, min((i2 + 1) * self.mb, self.m)
+        c0, c1 = j1 * self.nb, min((j2 + 1) * self.nb, self.n)
+        return Matrix(a[r0:r1, c0:c1], mb=self.mb, nb=self.nb, grid=self.grid)
+
+    def slice(self, row1: int, row2: int, col1: int, col2: int) -> "Matrix":
+        """Element-index submatrix view (inclusive), reference
+        ``Matrix::slice`` (``Matrix.hh:135``)."""
+        a = self.array
+        return Matrix(a[row1:row2 + 1, col1:col2 + 1], mb=self.mb,
+                      nb=self.nb, grid=self.grid)
+
+    def empty_like(self, m: Optional[int] = None, n: Optional[int] = None):
+        """Reference ``emptyLike`` (``Matrix.hh:117``)."""
+        m = self.m if m is None else m
+        n = self.n if n is None else n
+        return self._like(jnp.zeros((m, n), self.dtype), op=Op.NoTrans)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self.m}x{self.n}, mb={self.mb}, "
+                f"nb={self.nb}, op={self.op.name}, dtype={self.dtype})")
+
+
+@jax.tree_util.register_pytree_node_class
+class Matrix(BaseMatrix):
+    """General rectangular matrix, reference ``Matrix.hh:26``."""
+
+    @classmethod
+    def zeros(cls, m: int, n: int, *, mb: int = 256, nb: int = 256,
+              dtype=jnp.float32, grid: Optional[ProcessGrid] = None):
+        """Allocate an m×n zero matrix — the analog of
+        ``Matrix(m, n, nb, p, q, comm)`` + ``insertLocalTiles``
+        (``Matrix.hh:51,163``)."""
+        return cls(jnp.zeros((m, n), dtype), mb=mb, nb=nb, grid=grid)
+
+    @classmethod
+    def from_array(cls, a, *, mb: int = 256, nb: int = 256,
+                   grid: Optional[ProcessGrid] = None):
+        """Wrap an existing array — the analog of ``fromLAPACK``
+        (``Matrix.hh:290``): zero-copy adoption of user data."""
+        a = jnp.asarray(a)
+        if a.ndim != 2:
+            raise ValueError("Matrix.from_array expects a 2-D array")
+        return cls(a, mb=mb, nb=nb, grid=grid)
+
+
+@jax.tree_util.register_pytree_node_class
+class BaseTrapezoidMatrix(BaseMatrix):
+    """Trapezoid storage (lower/upper), reference ``BaseTrapezoidMatrix.hh``."""
+
+    def __init__(self, data, uplo: Uplo, diag: Diag = Diag.NonUnit, **kw):
+        super().__init__(data, **kw)
+        self.uplo = uplo
+        self.diag = diag
+
+    def _aux(self):
+        return super()._aux() + (self.uplo, self.diag)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.data = children[0]
+        obj.mb, obj.nb, obj.op, obj.grid, obj.uplo, obj.diag = aux
+        return obj
+
+    @property
+    def logical_uplo(self) -> Uplo:
+        """uplo after applying the pending op (transpose swaps L/U)."""
+        if self.op is Op.NoTrans or self.uplo is Uplo.General:
+            return self.uplo
+        return Uplo.Upper if self.uplo is Uplo.Lower else Uplo.Lower
+
+    def tril_or_triu(self):
+        """Materialize the stored triangle of the logical matrix."""
+        a = self.array
+        if self.logical_uplo is Uplo.Lower:
+            return jnp.tril(a)
+        return jnp.triu(a)
+
+
+@jax.tree_util.register_pytree_node_class
+class TrapezoidMatrix(BaseTrapezoidMatrix):
+    pass
+
+
+@jax.tree_util.register_pytree_node_class
+class TriangularMatrix(BaseTrapezoidMatrix):
+    """Square triangular, reference ``TriangularMatrix.hh``."""
+
+
+@jax.tree_util.register_pytree_node_class
+class SymmetricMatrix(BaseTrapezoidMatrix):
+    """A = Aᵀ with one triangle stored, reference ``SymmetricMatrix.hh``."""
+
+    def full(self):
+        """Materialize the full symmetric matrix from the stored triangle."""
+        from .ops.tile_ops import symmetrize
+        return symmetrize(self.logical_uplo, self.array)
+
+
+@jax.tree_util.register_pytree_node_class
+class HermitianMatrix(BaseTrapezoidMatrix):
+    """A = Aᴴ with one triangle stored, reference ``HermitianMatrix.hh``."""
+
+    def full(self):
+        from .ops.tile_ops import hermitize
+        return hermitize(self.logical_uplo, self.array)
+
+
+@jax.tree_util.register_pytree_node_class
+class BaseBandMatrix(BaseMatrix):
+    """Band matrix with bandwidths (kl, ku), reference ``BaseBandMatrix.hh``.
+
+    Storage note: the reference stores only tiles intersecting the band.
+    Here the band is stored *dense with implicit zero outside the band* —
+    on TPU the MXU wants large dense blocks, and XLA DCEs masked regions;
+    a compact (kl+ku+1)-diagonal layout is used only by the band
+    factorizations' packed kernels (see ``linalg/band.py``).
+    """
+
+    def __init__(self, data, kl: int, ku: int, **kw):
+        super().__init__(data, **kw)
+        self.kl = int(kl)
+        self.ku = int(ku)
+
+    def _aux(self):
+        return super()._aux() + (self.kl, self.ku)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.data = children[0]
+        obj.mb, obj.nb, obj.op, obj.grid, obj.kl, obj.ku = aux
+        return obj
+
+    def transpose(self):
+        """Band transpose also swaps the bandwidths (ku ↔ kl)."""
+        out = super().transpose()
+        out.kl, out.ku = self.ku, self.kl
+        return out
+
+    def conj_transpose(self):
+        out = super().conj_transpose()
+        out.kl, out.ku = self.ku, self.kl
+        return out
+
+    def band_mask(self):
+        m, n = (self.m, self.n)
+        i = jnp.arange(m)[:, None]
+        j = jnp.arange(n)[None, :]
+        return (j - i <= self.ku) & (i - j <= self.kl)
+
+    def banded(self):
+        """The logical (op-applied) matrix with outside-band entries zeroed."""
+        return jnp.where(self.band_mask(), self.array, 0)
+
+
+@jax.tree_util.register_pytree_node_class
+class BandMatrix(BaseBandMatrix):
+    pass
+
+
+@jax.tree_util.register_pytree_node_class
+class TriangularBandMatrix(BaseBandMatrix):
+    def __init__(self, data, kd: int, uplo: Uplo, diag: Diag = Diag.NonUnit, **kw):
+        kl, ku = (kd, 0) if uplo is Uplo.Lower else (0, kd)
+        super().__init__(data, kl, ku, **kw)
+        self.uplo = uplo
+        self.diag = diag
+        self.kd = kd
+
+    def _aux(self):
+        return super()._aux() + (self.uplo, self.diag, self.kd)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        obj.data = children[0]
+        (obj.mb, obj.nb, obj.op, obj.grid, obj.kl, obj.ku,
+         obj.uplo, obj.diag, obj.kd) = aux
+        return obj
+
+
+@jax.tree_util.register_pytree_node_class
+class HermitianBandMatrix(BaseBandMatrix):
+    def __init__(self, data, kd: int, uplo: Uplo, **kw):
+        kl, ku = (kd, 0) if uplo is Uplo.Lower else (0, kd)
+        super().__init__(data, kl, ku, **kw)
+        self.uplo = uplo
+        self.kd = kd
+
+    def _aux(self):
+        return super()._aux() + (self.uplo, self.kd)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        obj = cls.__new__(cls)
+        (obj.mb, obj.nb, obj.op, obj.grid, obj.kl, obj.ku,
+         obj.uplo, obj.kd) = aux
+        obj.data = children[0]
+        return obj
+
+
+def as_array(a):
+    """Accept Matrix-family objects or raw arrays; return the logical array."""
+    if isinstance(a, BaseTrapezoidMatrix):
+        return a.array
+    if isinstance(a, BaseMatrix):
+        return a.array
+    return jnp.asarray(a)

@@ -1,0 +1,18 @@
+"""Internal op layer — TPU-native analog of the reference's
+``src/internal/`` tile-op layer (``src/internal/internal.hh``, 56 entry
+points) and device kernel set (``include/slate/internal/device.hh:82-266``).
+
+Organisation:
+
+* :mod:`slate_tpu.ops.tile_ops` — elementwise/norm tile kernels
+  (geadd/gecopy/gescale/geset/transpose/genorm…), batched over leading
+  dims the way the reference batches over tile pointer arrays.
+* :mod:`slate_tpu.ops.blocks` — recursive blocked Level-3 building
+  blocks (potrf/trsm/trmm/herk/trtri/lauum…) whose base cases are
+  nb×nb ``lax.linalg`` tile ops, mirroring how the reference base-cases
+  into vendor LAPACK on a single tile (``internal_potrf.cc:34-72``).
+* :mod:`slate_tpu.ops.pallas_kernels` — hand-written Pallas TPU kernels
+  for hot tile batches, with XLA fallbacks.
+"""
+
+from . import tile_ops, blocks  # noqa: F401

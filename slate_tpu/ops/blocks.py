@@ -1,0 +1,307 @@
+"""Recursive blocked Level-3 building blocks.
+
+This module is the TPU-native replacement for the reference's internal
+tile-op layer (``src/internal/internal_gemm.cc``, ``internal_trsm.cc``,
+``internal_herk.cc``, ``internal_potrf.cc`` …): where the reference walks
+a tile DAG and issues group-batched vendor-BLAS calls per device
+(``internal_gemm.cc:383-689``), here each op is a *recursive blocked
+algorithm over one dense array* whose base case is an nb×nb
+``lax.linalg`` tile op — the same role vendor LAPACK plays for the
+reference's diagonal tiles (``internal_potrf.cc:34-72``).
+
+Why recursion instead of a tile loop: every split level exposes one
+*large* matmul (trailing update), which is exactly what the MXU wants;
+the recursion depth is log(n/nb) so XLA traces O(log n) distinct shapes
+instead of O(n/nb) loop steps, and the schedule — panel op, then one big
+GEMM — is the static-dataflow equivalent of the reference's
+lookahead-pipelined task DAG (``src/potrf.cc:54-123``): XLA's scheduler
+overlaps the next panel with the tail of the previous update because the
+dependence structure is explicit in the graph.
+
+All functions assume the transposition op has already been *materialised*
+by the caller (drivers resolve ``Op`` into the effective array and
+effective uplo), so only NoTrans cases appear here.  All are
+shape-polymorphic in batch dims only where noted; shapes are static.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import config
+from ..enums import Diag, Side, Uplo
+from ..grid import ceildiv
+
+
+def matmul(a, b):
+    """Dot with the configured precision (see :mod:`slate_tpu.config`)."""
+    return jnp.matmul(a, b, precision=config.matmul_precision)
+
+
+def _split(n: int, nb: int) -> int:
+    """Split point for recursion: half of n rounded up to a multiple of nb."""
+    return max(nb, (ceildiv(n, 2 * nb)) * nb)
+
+
+def _ct(a):
+    """Conjugate-transpose (the ^H that appears throughout)."""
+    return jnp.conj(jnp.swapaxes(a, -1, -2))
+
+
+def _t(a, conj: bool):
+    return _ct(a) if conj else jnp.swapaxes(a, -1, -2)
+
+
+# ---------------------------------------------------------------------------
+# Cholesky
+# ---------------------------------------------------------------------------
+
+def potrf_rec(a, nb: int):
+    """Blocked lower Cholesky of SPD/HPD ``a``; returns L (lower triangle,
+    zeros above).
+
+    Recursive equivalent of the reference driver loop ``src/potrf.cc:210-288``
+    (panel potrf → trsm → herk trailing update), with the diagonal-tile base
+    case playing ``internal::potrf`` (``internal_potrf.cc:34-72``).
+    """
+
+    n = a.shape[-1]
+    if n <= nb:
+        return jnp.tril(lax.linalg.cholesky(a))
+    n1 = _split(n, nb)
+    a11 = a[..., :n1, :n1]
+    a21 = a[..., n1:, :n1]
+    a22 = a[..., n1:, n1:]
+    l11 = potrf_rec(a11, nb)
+    # L21 = A21 · L11^{-H}   (trailing panel trsm, src/potrf.cc:227-231)
+    l21 = lax.linalg.triangular_solve(
+        l11, a21, left_side=False, lower=True, transpose_a=True,
+        conjugate_a=jnp.iscomplexobj(a))
+    # A22 ← A22 − L21·L21^H  (herk trailing update, src/potrf.cc:256-259)
+    l22 = potrf_rec(a22 - matmul(l21, _ct(l21)), nb)
+    top = jnp.concatenate([l11, jnp.zeros_like(_t(a21, False))], axis=-1)
+    bot = jnp.concatenate([l21, l22], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Triangular solve / multiply
+# ---------------------------------------------------------------------------
+
+def trsm_rec(side: Side, uplo: Uplo, diag: Diag, a, b, nb: int):
+    """op-free blocked triangular solve: X with A·X = B (Left) or
+    X·A = B (Right); ``a`` is the effective triangle (op already applied).
+
+    Recursive form of ``src/work/work_trsm.cc`` — each level exposes one
+    big GEMM update.
+    """
+
+    unit = diag is Diag.Unit
+    n = a.shape[-1]
+    if n <= nb:
+        return lax.linalg.triangular_solve(
+            a, b, left_side=(side is Side.Left),
+            lower=(uplo is Uplo.Lower), unit_diagonal=unit)
+    n1 = _split(n, nb)
+    a11 = a[..., :n1, :n1]
+    a22 = a[..., n1:, n1:]
+    if side is Side.Left:
+        b1, b2 = b[..., :n1, :], b[..., n1:, :]
+        if uplo is Uplo.Lower:
+            a21 = a[..., n1:, :n1]
+            x1 = trsm_rec(side, uplo, diag, a11, b1, nb)
+            x2 = trsm_rec(side, uplo, diag, a22, b2 - matmul(a21, x1), nb)
+        else:
+            a12 = a[..., :n1, n1:]
+            x2 = trsm_rec(side, uplo, diag, a22, b2, nb)
+            x1 = trsm_rec(side, uplo, diag, a11, b1 - matmul(a12, x2), nb)
+        return jnp.concatenate([x1, x2], axis=-2)
+    else:
+        b1, b2 = b[..., :, :n1], b[..., :, n1:]
+        if uplo is Uplo.Lower:
+            a21 = a[..., n1:, :n1]
+            x2 = trsm_rec(side, uplo, diag, a22, b2, nb)
+            x1 = trsm_rec(side, uplo, diag, a11, b1 - matmul(x2, a21), nb)
+        else:
+            a12 = a[..., :n1, n1:]
+            x1 = trsm_rec(side, uplo, diag, a11, b1, nb)
+            x2 = trsm_rec(side, uplo, diag, a22, b2 - matmul(x1, a12), nb)
+        return jnp.concatenate([x1, x2], axis=-1)
+
+
+def _tri(a, uplo: Uplo, diag: Diag):
+    """Materialise the triangle (with implicit unit diagonal if asked)."""
+    t = jnp.tril(a) if uplo is Uplo.Lower else jnp.triu(a)
+    if diag is Diag.Unit:
+        n = a.shape[-1]
+        eye = jnp.eye(n, dtype=a.dtype)
+        t = t - t * jnp.eye(n, dtype=a.dtype) + eye  # force unit diagonal
+    return t
+
+
+def trmm_rec(side: Side, uplo: Uplo, diag: Diag, a, b, nb: int):
+    """Blocked triangular multiply B ← A·B (Left) or B·A (Right);
+    ``a`` effective triangle.  Ref ``src/work/work_trmm.cc``."""
+
+    n = a.shape[-1]
+    if n <= nb:
+        t = _tri(a, uplo, diag)
+        return matmul(t, b) if side is Side.Left else matmul(b, t)
+    n1 = _split(n, nb)
+    a11 = a[..., :n1, :n1]
+    a22 = a[..., n1:, n1:]
+    if side is Side.Left:
+        b1, b2 = b[..., :n1, :], b[..., n1:, :]
+        if uplo is Uplo.Lower:
+            a21 = a[..., n1:, :n1]
+            y2 = trmm_rec(side, uplo, diag, a22, b2, nb) + matmul(a21, b1)
+            y1 = trmm_rec(side, uplo, diag, a11, b1, nb)
+        else:
+            a12 = a[..., :n1, n1:]
+            y1 = trmm_rec(side, uplo, diag, a11, b1, nb) + matmul(a12, b2)
+            y2 = trmm_rec(side, uplo, diag, a22, b2, nb)
+        return jnp.concatenate([y1, y2], axis=-2)
+    else:
+        b1, b2 = b[..., :, :n1], b[..., :, n1:]
+        if uplo is Uplo.Lower:
+            a21 = a[..., n1:, :n1]
+            y1 = trmm_rec(side, uplo, diag, a11, b1, nb) + matmul(b2, a21)
+            y2 = trmm_rec(side, uplo, diag, a22, b2, nb)
+        else:
+            a12 = a[..., :n1, n1:]
+            y2 = trmm_rec(side, uplo, diag, a22, b2, nb) + matmul(b1, a12)
+            y1 = trmm_rec(side, uplo, diag, a11, b1, nb)
+        return jnp.concatenate([y1, y2], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Rank-k updates on a triangle
+# ---------------------------------------------------------------------------
+
+def herk_rec(uplo: Uplo, alpha, a, beta, c, nb: int, conj: bool = True):
+    """C ← α·A·A^H + β·C on the ``uplo`` triangle (full tiles are updated
+    at the base; the driver restores the untouched triangle).
+
+    ``conj=False`` gives syrk (A·Aᵀ).  Recursive form of
+    ``internal_herk.cc`` / ``internal_syrk.cc``: off-diagonal blocks are
+    plain GEMMs — the O(n²k) hot loop of ``src/potrf.cc:256-259``.
+    """
+
+    n = c.shape[-1]
+    if n <= nb:
+        return alpha * matmul(a, _t(a, conj)) + beta * c
+    n1 = _split(n, nb)
+    a1, a2 = a[..., :n1, :], a[..., n1:, :]
+    c11 = herk_rec(uplo, alpha, a1, beta, c[..., :n1, :n1], nb, conj)
+    c22 = herk_rec(uplo, alpha, a2, beta, c[..., n1:, n1:], nb, conj)
+    if uplo is Uplo.Lower:
+        c21 = alpha * matmul(a2, _t(a1, conj)) + beta * c[..., n1:, :n1]
+        top = jnp.concatenate([c11, c[..., :n1, n1:]], axis=-1)
+        bot = jnp.concatenate([c21, c22], axis=-1)
+    else:
+        c12 = alpha * matmul(a1, _t(a2, conj)) + beta * c[..., :n1, n1:]
+        top = jnp.concatenate([c11, c12], axis=-1)
+        bot = jnp.concatenate([c[..., n1:, :n1], c22], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def her2k_rec(uplo: Uplo, alpha, a, b, beta, c, nb: int, conj: bool = True):
+    """C ← α·A·B^H + ᾱ·B·A^H + β·C on a triangle (syr2k when conj=False,
+    with ᾱ→α).  Ref ``internal_her2k.cc`` / ``internal_syr2k.cc``."""
+
+    alpha2 = jnp.conj(alpha) if conj else alpha
+    n = c.shape[-1]
+    if n <= nb:
+        return (alpha * matmul(a, _t(b, conj))
+                + alpha2 * matmul(b, _t(a, conj)) + beta * c)
+    n1 = _split(n, nb)
+    a1, a2 = a[..., :n1, :], a[..., n1:, :]
+    b1, b2 = b[..., :n1, :], b[..., n1:, :]
+    c11 = her2k_rec(uplo, alpha, a1, b1, beta, c[..., :n1, :n1], nb, conj)
+    c22 = her2k_rec(uplo, alpha, a2, b2, beta, c[..., n1:, n1:], nb, conj)
+    if uplo is Uplo.Lower:
+        c21 = (alpha * matmul(a2, _t(b1, conj))
+               + alpha2 * matmul(b2, _t(a1, conj)) + beta * c[..., n1:, :n1])
+        top = jnp.concatenate([c11, c[..., :n1, n1:]], axis=-1)
+        bot = jnp.concatenate([c21, c22], axis=-1)
+    else:
+        c12 = (alpha * matmul(a1, _t(b2, conj))
+               + alpha2 * matmul(b1, _t(a2, conj)) + beta * c[..., :n1, n1:])
+        top = jnp.concatenate([c11, c12], axis=-1)
+        bot = jnp.concatenate([c[..., n1:, :n1], c22], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# Triangular inverse and L^H·L / U·U^H products (potri ingredients)
+# ---------------------------------------------------------------------------
+
+def trtri_rec(uplo: Uplo, diag: Diag, a, nb: int):
+    """Blocked triangular inverse (ref driver ``src/trtri.cc``).
+
+    Base case solves T·X = I with the tile-level triangular solver, the
+    analog of the reference's lapack::trtri on a diagonal tile.
+    """
+
+    n = a.shape[-1]
+    unit = diag is Diag.Unit
+    if n <= nb:
+        eye = jnp.eye(n, dtype=a.dtype)
+        if a.ndim > 2:
+            eye = jnp.broadcast_to(eye, a.shape)
+        return lax.linalg.triangular_solve(
+            a, eye, left_side=True, lower=(uplo is Uplo.Lower),
+            unit_diagonal=unit)
+    n1 = _split(n, nb)
+    a11 = a[..., :n1, :n1]
+    a22 = a[..., n1:, n1:]
+    x11 = trtri_rec(uplo, diag, a11, nb)
+    x22 = trtri_rec(uplo, diag, a22, nb)
+    if uplo is Uplo.Lower:
+        a21 = a[..., n1:, :n1]
+        x21 = -matmul(x22, matmul(a21, x11))
+        top = jnp.concatenate([x11, jnp.zeros_like(jnp.swapaxes(a21, -1, -2))], axis=-1)
+        bot = jnp.concatenate([x21, x22], axis=-1)
+    else:
+        a12 = a[..., :n1, n1:]
+        x12 = -matmul(x11, matmul(a12, x22))
+        top = jnp.concatenate([x11, x12], axis=-1)
+        bot = jnp.concatenate([jnp.zeros_like(jnp.swapaxes(a12, -1, -2)), x22], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def lauum_rec(uplo: Uplo, a, nb: int, conj: bool = True):
+    """Triangular in-place product (LAPACK ``lauum``, reference
+    ``internal::trtrm`` / ``src/trtrm.cc``): Lower → L^H·L, Upper → U·U^H.
+    Result is Hermitian; the ``uplo`` triangle of the result is valid.
+    """
+
+    n = a.shape[-1]
+    if n <= nb:
+        t = jnp.tril(a) if uplo is Uplo.Lower else jnp.triu(a)
+        return matmul(_t(t, conj), t) if uplo is Uplo.Lower else matmul(t, _t(t, conj))
+    n1 = _split(n, nb)
+    a11 = a[..., :n1, :n1]
+    a22 = a[..., n1:, n1:]
+    r11 = lauum_rec(uplo, a11, nb, conj)
+    r22 = lauum_rec(uplo, a22, nb, conj)
+    if uplo is Uplo.Lower:
+        l21 = a[..., n1:, :n1]
+        l22 = jnp.tril(a22)
+        # (L^H L)_11 = L11^H L11 + L21^H L21 ; _21 = L22^H L21
+        r11 = r11 + matmul(_t(l21, conj), l21)
+        r21 = matmul(_t(l22, conj), l21)
+        top = jnp.concatenate([r11, _t(r21, conj)], axis=-1)
+        bot = jnp.concatenate([r21, r22], axis=-1)
+    else:
+        u12 = a[..., :n1, n1:]
+        u22 = jnp.triu(a22)
+        # (U U^H)_11 = U11 U11^H + U12 U12^H ; _12 = U12 U22^H
+        r11 = r11 + matmul(u12, _t(u12, conj))
+        r12 = matmul(u12, _t(u22, conj))
+        top = jnp.concatenate([r11, r12], axis=-1)
+        bot = jnp.concatenate([_t(r12, conj), r22], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)

@@ -1,0 +1,148 @@
+"""Elementwise / norm tile kernels.
+
+TPU-native analog of the reference device kernel set declared in
+``include/slate/internal/device.hh:82-266`` and implemented three times in
+``src/cuda/``, ``src/hip/``, ``src/omptarget/`` (geadd, gecopy, genorm,
+gescale, gescale_row_col, geset, henorm, synorm, transpose, trnorm, tzadd,
+tzcopy, tzscale, tzset).  One implementation replaces all three backends:
+each op is a pure jnp function over arrays of shape ``(..., mb, nb)`` — the
+leading batch dims play the role of the reference's batched tile-pointer
+arrays, and XLA fuses these into neighbouring matmuls instead of launching
+standalone kernels.
+
+Precision-converting copy (reference ``gecopy`` with distinct src/dst
+types, ``src/cuda/device_gecopy.cu``) is ``gecopy(a, dtype=...)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..enums import Norm, Uplo
+
+
+def geset(shape, offdiag_value, diag_value, dtype=jnp.float32):
+    """Set tile to a constant with a different diagonal
+    (ref ``device::geset``, ``device.hh``)."""
+    m, n = shape[-2], shape[-1]
+    eye = jnp.eye(m, n, dtype=bool)
+    out = jnp.full(shape, offdiag_value, dtype)
+    return jnp.where(eye, jnp.asarray(diag_value, dtype), out)
+
+
+def tzset(shape, uplo: Uplo, offdiag_value, diag_value, dtype=jnp.float32):
+    """Trapezoid set (ref ``device::tzset``): only the stored triangle."""
+    m, n = shape[-2], shape[-1]
+    full = geset(shape, offdiag_value, diag_value, dtype)
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = (i >= j) if uplo is Uplo.Lower else (i <= j)
+    return jnp.where(keep, full, 0)
+
+
+def geadd(alpha, a, beta, b):
+    """B = alpha*A + beta*B (ref ``device::geadd``)."""
+    return alpha * a + beta * b
+
+
+def tzadd(uplo: Uplo, alpha, a, beta, b):
+    m, n = a.shape[-2], a.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = (i >= j) if uplo is Uplo.Lower else (i <= j)
+    return jnp.where(keep, alpha * a + beta * b, b)
+
+
+def gecopy(a, dtype=None):
+    """Copy, optionally precision-converting (ref ``device::gecopy``)."""
+    return a.astype(dtype) if dtype is not None else a
+
+
+def gescale(numer, denom, a):
+    """A *= numer/denom (ref ``device::gescale``) — the two-scalar form
+    avoids overflow when numer/denom would."""
+    return a * (jnp.asarray(numer, a.dtype) / jnp.asarray(denom, a.dtype))
+
+
+def gescale_row_col(r, c, a):
+    """A = diag(r) · A · diag(c) (ref ``device::gescale_row_col``)."""
+    return a * r[..., :, None] * c[..., None, :]
+
+
+def transpose(a, conj: bool = False):
+    """Batched (conjugate-)transpose (ref ``device::transpose``)."""
+    t = jnp.swapaxes(a, -1, -2)
+    return jnp.conj(t) if conj else t
+
+
+def _abs(a):
+    return jnp.abs(a)
+
+
+def genorm(norm: Norm, a, axis=(-2, -1)):
+    """Per-tile general-matrix norm (ref ``device::genorm``,
+    ``src/cuda/device_genorm.cu``).  Returns, per batch element:
+
+    * Max  → scalar max|a|
+    * One  → vector of column sums (reduced over rows)
+    * Inf  → vector of row sums
+    * Fro  → (scaled) sum of squares as a scalar ‖a‖_F
+    """
+    if norm is Norm.Max:
+        return jnp.max(_abs(a), axis=axis)
+    if norm is Norm.One:
+        return jnp.sum(_abs(a), axis=-2)
+    if norm is Norm.Inf:
+        return jnp.sum(_abs(a), axis=-1)
+    if norm is Norm.Fro:
+        return jnp.sqrt(jnp.sum(_abs(a) ** 2, axis=axis))
+    raise ValueError(f"unsupported norm {norm}")
+
+
+def trnorm(norm: Norm, uplo: Uplo, a, diag_one: bool = False):
+    """Trapezoid/triangular tile norm (ref ``device::trnorm``)."""
+    m, n = a.shape[-2], a.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = (i >= j) if uplo is Uplo.Lower else (i <= j)
+    masked = jnp.where(keep, a, 0)
+    if diag_one:
+        eye = jnp.eye(m, n, dtype=bool)
+        masked = jnp.where(eye, jnp.asarray(1, a.dtype), masked)
+    return genorm(norm, masked)
+
+
+def synorm(norm: Norm, uplo: Uplo, a):
+    """Symmetric tile norm over the stored triangle mirrored
+    (ref ``device::synorm``)."""
+    full = symmetrize(uplo, a)
+    return genorm(norm, full)
+
+
+def henorm(norm: Norm, uplo: Uplo, a):
+    full = hermitize(uplo, a)
+    return genorm(norm, full)
+
+
+def symmetrize(uplo: Uplo, a):
+    """Reflect the stored triangle to form the full symmetric matrix."""
+    n = a.shape[-1]
+    if uplo is Uplo.Lower:
+        t = jnp.tril(a, -1)
+    else:
+        t = jnp.triu(a, 1)
+    d = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return t + jnp.swapaxes(t, -1, -2) + d[..., None] * jnp.eye(n, dtype=a.dtype)
+
+
+def hermitize(uplo: Uplo, a):
+    """Reflect with conjugation; the diagonal is forced real
+    (Hermitian semantics, ref ``HermitianMatrix``)."""
+    n = a.shape[-1]
+    if uplo is Uplo.Lower:
+        t = jnp.tril(a, -1)
+    else:
+        t = jnp.triu(a, 1)
+    d = jnp.real(jnp.diagonal(a, axis1=-2, axis2=-1)).astype(a.dtype)
+    return t + jnp.conj(jnp.swapaxes(t, -1, -2)) + d[..., None] * jnp.eye(n, dtype=a.dtype)

@@ -1,0 +1,80 @@
+"""Per-call option map, reference ``types.hh:32-61`` + ``types.hh:170-205``.
+
+The reference passes ``Options = std::map<Option, OptionValue>`` into every
+driver and reads typed values with ``get_option<T>``.  Here options are a
+plain dict keyed by :class:`slate_tpu.enums.Option` (or its string value),
+with defaults resolved by :func:`get_option`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+from .enums import Option, Target
+
+#: Type alias for the per-call option mapping.
+Options = Mapping
+
+
+_UNSET = object()
+
+_DEFAULTS = {
+    Option.Lookahead: 1,
+    Option.InnerBlocking: 16,
+    Option.MaxPanelThreads: 1,
+    Option.Tolerance: None,
+    Option.Target: Target.Devices,
+    Option.HoldLocalWorkspace: False,
+    Option.Depth: 2,
+    Option.MaxIterations: 30,
+    Option.UseFallbackSolver: True,
+    Option.PivotThreshold: 1.0,
+    Option.PrintVerbose: 4,
+    Option.PrintEdgeItems: 16,
+    Option.PrintWidth: 10,
+    Option.PrintPrecision: 4,
+}
+
+
+def _canon(key) -> Option:
+    if isinstance(key, Option):
+        return key
+    if isinstance(key, str):
+        # accept both "lookahead" and "Lookahead"
+        for opt in Option:
+            if key == opt.value or key == opt.name:
+                return opt
+    raise KeyError(f"unknown option {key!r}")
+
+
+def get_option(opts: Optional[Options], key, default: Any = _UNSET) -> Any:
+    """Typed option lookup, reference ``types.hh:170-205``.
+
+    Resolution order: explicit entry in ``opts`` (keyed by enum, enum name,
+    or enum value string) → ``default`` argument (any value, including
+    None/False) → framework default table.  ``Option.BlockSize`` has no
+    table entry: its fallback chain (matrix nb → ``SLATE_TPU_NB`` env) is
+    resolved by the drivers so per-matrix blocking is honoured.
+    """
+
+    key = _canon(key)
+    if opts:
+        for k, v in opts.items():
+            try:
+                if _canon(k) is key:
+                    return v
+            except KeyError:
+                continue
+    if default is not _UNSET:
+        return default
+    return _DEFAULTS.get(key)
+
+
+def normalize_options(opts: Optional[Options]) -> dict:
+    """Return a dict keyed by Option enums, validating all keys."""
+
+    out = {}
+    if opts:
+        for k, v in opts.items():
+            out[_canon(k)] = v
+    return out

@@ -1,0 +1,1 @@
+from .matgen import generate_matrix, random_spd  # noqa: F401

@@ -1,0 +1,136 @@
+"""Spectrum-controlled matrix generator.
+
+TPU-native analog of the reference test generator
+``test/matrix_generator.cc:705-843`` (params ``test/matrix_params.hh:34``):
+named matrix kinds with controlled singular-/eigen-spectra so correctness
+checks are grid- and blocking-independent (reference guarantees
+determinism independent of the process grid, ``CHANGELOG.md:8-9``).
+
+Supported kinds (reference names kept):
+
+* ``zeros``, ``ones``, ``identity``, ``jordan``
+* ``rand`` / ``rands`` (uniform; rands is sign-symmetric), ``randn``
+* ``rand_dominant`` — random with diagonal dominance (LU-safe without pivots)
+* ``svd`` — A = U·Σ·Vᴴ with Σ from a named distribution
+* ``heev`` — Hermitian A = V·Λ·Vᴴ
+* ``poev`` — HPD A = V·Σ·Vᴴ (positive spectrum)
+* ``cond`` — geometric spectrum with condition number ``cond``
+
+Spectrum suffixes (e.g. ``svd:arith``): ``arith`` (default geometric
+``geo``), ``cluster0``, ``cluster1``, ``rarith``…; a plain float list can
+also be passed via ``sigma``.
+
+Determinism: seeded ``jax.random`` keys; generation happens at full
+precision then casts to the requested dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _spectrum(kind: str, n: int, cond: float) -> np.ndarray:
+    if kind in ("", "geo", "default"):
+        # geometric from 1 to 1/cond (reference default sigma distribution)
+        return np.geomspace(1.0, 1.0 / cond, n)
+    if kind == "arith":
+        return np.linspace(1.0, 1.0 / cond, n)
+    if kind == "cluster0":
+        s = np.full(n, 1.0 / cond); s[0] = 1.0
+        return s
+    if kind == "cluster1":
+        s = np.ones(n); s[-1] = 1.0 / cond
+        return s
+    if kind == "rgeo":
+        return np.geomspace(1.0 / cond, 1.0, n)
+    if kind == "rarith":
+        return np.linspace(1.0 / cond, 1.0, n)
+    raise ValueError(f"unknown spectrum {kind!r}")
+
+
+def _haar(key, m: int, n: int, dtype) -> jnp.ndarray:
+    """Random orthonormal columns (Haar via QR of Gaussian)."""
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        kr, ki = jax.random.split(key)
+        g = (jax.random.normal(kr, (m, n)) + 1j * jax.random.normal(ki, (m, n)))
+        g = g.astype(dtype)
+    else:
+        g = jax.random.normal(key, (m, n), dtype=dtype)
+    q, r = jnp.linalg.qr(g)
+    # fix phases so the distribution is Haar
+    d = jnp.diagonal(r)
+    ph = d / jnp.abs(d)
+    return q * jnp.conj(ph)[None, :]
+
+
+def generate_matrix(kind: str, m: int, n: Optional[int] = None, *,
+                    dtype=jnp.float32, seed: int = 0,
+                    cond: float = 1e2,
+                    sigma: Optional[Sequence[float]] = None):
+    """Generate an m×n test matrix of the named ``kind`` (see module doc)."""
+
+    n = m if n is None else n
+    key = jax.random.PRNGKey(seed)
+    base, _, spec = kind.partition(":")
+    # generate at the widest available precision (f64 only under x64 —
+    # on TPU without x64, generating in f32 avoids truncation warnings)
+    if jax.config.jax_enable_x64:
+        gen_dtype = jnp.complex128 if jnp.issubdtype(dtype, jnp.complexfloating) else jnp.float64
+    else:
+        gen_dtype = jnp.complex64 if jnp.issubdtype(dtype, jnp.complexfloating) else jnp.float32
+    real_gen = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    k = min(m, n)
+
+    if base == "zeros":
+        a = jnp.zeros((m, n), gen_dtype)
+    elif base == "ones":
+        a = jnp.ones((m, n), gen_dtype)
+    elif base == "identity":
+        a = jnp.eye(m, n, dtype=gen_dtype)
+    elif base == "jordan":
+        a = jnp.eye(m, n, dtype=gen_dtype) + jnp.eye(m, n, k=-1, dtype=gen_dtype)
+    elif base in ("rand", "rands", "randn", "rand_dominant"):
+        if base == "randn":
+            a = jax.random.normal(key, (m, n), dtype=real_gen)
+        else:
+            lo = -1.0 if base != "rand" else 0.0
+            a = jax.random.uniform(key, (m, n), dtype=real_gen,
+                                   minval=lo, maxval=1.0)
+        if jnp.issubdtype(dtype, jnp.complexfloating):
+            key2 = jax.random.fold_in(key, 1)
+            b = jax.random.uniform(key2, (m, n), dtype=real_gen,
+                                   minval=-1.0, maxval=1.0)
+            a = a + 1j * b
+        a = a.astype(gen_dtype)
+        if base == "rand_dominant":
+            a = a + 2 * max(m, n) * jnp.eye(m, n, dtype=gen_dtype)
+    elif base in ("svd", "heev", "poev", "cond"):
+        s = np.asarray(sigma) if sigma is not None else _spectrum(spec, k, cond)
+        s = jnp.asarray(s, gen_dtype)
+        ku, kv = jax.random.split(key)
+        u = _haar(ku, m, k, gen_dtype)
+        if base in ("heev", "poev"):
+            if base == "heev":
+                # mixed-sign spectrum: alternate signs (reference heev kind)
+                signs = jnp.asarray(np.where(np.arange(k) % 2 == 0, 1.0, -1.0),
+                                    gen_dtype)
+                s = s * signs
+            a = (u * s[None, :]) @ jnp.conj(u.T)
+            # force exact Hermitian-ness after rounding
+            a = (a + jnp.conj(a.T)) / 2
+        else:
+            v = _haar(kv, n, k, gen_dtype)
+            a = (u * s[None, :]) @ jnp.conj(v.T)
+    else:
+        raise ValueError(f"unknown matrix kind {kind!r}")
+
+    return a.astype(dtype)
+
+
+def random_spd(n: int, *, dtype=jnp.float32, seed: int = 0, cond: float = 1e2):
+    """Hermitian positive-definite test matrix (reference kind ``poev``)."""
+    return generate_matrix("poev", n, dtype=dtype, seed=seed, cond=cond)

@@ -1,0 +1,30 @@
+"""Test configuration: CPU mesh simulation.
+
+Mirrors the reference's laptop-testability strategy (SURVEY §4): where the
+reference links serial MPI stubs or runs ``mpirun -np 4`` on one box, we
+run the same SPMD code on 8 virtual CPU devices
+(``--xla_force_host_platform_device_count=8``), overriding the axon/TPU
+plugin that the environment pre-registers.
+
+float64 is enabled so residual checks can compare against LAPACK-grade
+reference results.
+"""
+
+import os
+
+_flag = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    """2×4 mesh over the 8 virtual CPU devices, axes ('p','q')."""
+    return jax.make_mesh((2, 4), ("p", "q"))

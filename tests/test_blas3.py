@@ -1,0 +1,140 @@
+"""BLAS-3 driver tests — residual checks in the reference tester's style
+(``test/test_gemm.cc:190-260``: ‖computed − reference‖ scaled ≤ 3ε)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.testing import generate_matrix
+
+DTYPES = [jnp.float32, jnp.float64, jnp.complex64, jnp.complex128]
+
+
+def tol(dtype, factor=50):
+    return factor * jnp.finfo(dtype).eps
+
+
+def relerr(x, y):
+    x = np.asarray(x); y = np.asarray(y)
+    d = np.linalg.norm(x - y)
+    s = max(np.linalg.norm(y), 1.0)
+    return d / s
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("opA,opB", [("n", "n"), ("t", "n"), ("n", "c"), ("c", "t")])
+def test_gemm(dtype, opA, opB):
+    m, n, k = 93, 71, 58
+    a = generate_matrix("randn", m, k, dtype=dtype, seed=1)
+    b = generate_matrix("randn", k, n, dtype=dtype, seed=2)
+    c = generate_matrix("randn", m, n, dtype=dtype, seed=3)
+    alpha, beta = 1.5, -0.5
+
+    def make_view(x, op):
+        """Store x under the given op so the logical (op-applied) matrix is x."""
+        x = np.asarray(x)
+        if op == "t":
+            return st.Matrix.from_array(x.T, mb=32, nb=32).transpose()
+        if op == "c":
+            return st.Matrix.from_array(np.conj(x.T), mb=32, nb=32).conj_transpose()
+        return st.Matrix.from_array(x, mb=32, nb=32)
+
+    A = make_view(a, opA)
+    B = make_view(b, opB)
+    C = st.Matrix.from_array(c, mb=32, nb=32)
+
+    out = st.gemm(alpha, A, B, beta, C)
+    ref = alpha * np.asarray(a) @ np.asarray(b) + beta * np.asarray(c)
+    assert relerr(out.array, ref) < tol(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("side", [st.Side.Left, st.Side.Right])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_trsm_trmm(dtype, side, uplo):
+    n, m = 96, 77
+    a = np.asarray(generate_matrix("randn", n, n, dtype=dtype, seed=4))
+    a = a + n * np.eye(n)  # well-conditioned
+    tri = np.tril(a) if uplo is st.Uplo.Lower else np.triu(a)
+    # Left: A (n×n) acts on B (n×m); Right: B (m×n) multiplied by A (n×n)
+    b = np.asarray(generate_matrix("randn",
+                                   n if side is st.Side.Left else m,
+                                   m if side is st.Side.Left else n,
+                                   dtype=dtype, seed=5))
+    A = st.TriangularMatrix(jnp.asarray(a), uplo=uplo, mb=32, nb=32)
+
+    x = np.asarray(st.trsm(side, 2.0, A, jnp.asarray(b)))
+    if side is st.Side.Left:
+        assert relerr(tri @ x, 2.0 * b) < tol(dtype, 200)
+    else:
+        assert relerr(x @ tri, 2.0 * b) < tol(dtype, 200)
+
+    y = np.asarray(st.trmm(side, 0.5, A, jnp.asarray(b)))
+    ref = 0.5 * (tri @ b if side is st.Side.Left else b @ tri)
+    assert relerr(y, ref) < tol(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_herk_syrk(dtype, uplo):
+    n, k = 64, 40
+    a = np.asarray(generate_matrix("randn", n, k, dtype=dtype, seed=6))
+    c0 = np.asarray(generate_matrix("randn", n, n, dtype=dtype, seed=7))
+    C = st.HermitianMatrix(jnp.asarray(c0), uplo=uplo, mb=16, nb=16)
+    out = np.asarray(st.herk(1.25, jnp.asarray(a), 0.5, C).data)
+    ref = 1.25 * a @ np.conj(a.T) + 0.5 * c0
+    mask = np.tril(np.ones((n, n), bool)) if uplo is st.Uplo.Lower else np.triu(np.ones((n, n), bool))
+    assert relerr(out[mask], ref[mask]) < tol(dtype)
+    # untouched triangle preserved
+    assert np.array_equal(out[~mask], c0[~mask])
+
+    Cs = st.SymmetricMatrix(jnp.asarray(c0), uplo=uplo, mb=16, nb=16)
+    outs = np.asarray(st.syrk(1.25, jnp.asarray(a), 0.5, Cs).data)
+    refs = 1.25 * a @ a.T + 0.5 * c0
+    assert relerr(outs[mask], refs[mask]) < tol(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+def test_her2k_syr2k(dtype):
+    n, k = 48, 33
+    a = np.asarray(generate_matrix("randn", n, k, dtype=dtype, seed=8))
+    b = np.asarray(generate_matrix("randn", n, k, dtype=dtype, seed=9))
+    c0 = np.asarray(generate_matrix("randn", n, n, dtype=dtype, seed=10))
+    mask = np.tril(np.ones((n, n), bool))
+    C = st.HermitianMatrix(jnp.asarray(c0), uplo=st.Uplo.Lower, mb=16, nb=16)
+    alpha = (1.0 + 0.5j) if np.iscomplexobj(a) else 1.5
+    out = np.asarray(st.her2k(alpha, jnp.asarray(a), jnp.asarray(b), 0.25, C).data)
+    ref = alpha * a @ np.conj(b.T) + np.conj(alpha) * b @ np.conj(a.T) + 0.25 * c0
+    assert relerr(out[mask], ref[mask]) < tol(dtype)
+
+    Cs = st.SymmetricMatrix(jnp.asarray(c0), uplo=st.Uplo.Lower, mb=16, nb=16)
+    outs = np.asarray(st.syr2k(alpha, jnp.asarray(a), jnp.asarray(b), 0.25, Cs).data)
+    refs = alpha * a @ b.T + alpha * b @ a.T + 0.25 * c0
+    assert relerr(outs[mask], refs[mask]) < tol(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("side", [st.Side.Left, st.Side.Right])
+def test_symm_hemm(dtype, side):
+    n, m = 52, 37
+    a = np.asarray(generate_matrix("randn", n, n, dtype=dtype, seed=11))
+    herm = (a + np.conj(a.T)) / 2
+    b = np.asarray(generate_matrix("randn",
+                                   n if side is st.Side.Left else m,
+                                   m if side is st.Side.Left else n,
+                                   dtype=dtype, seed=12))
+    c = np.asarray(generate_matrix("randn",
+                                   n if side is st.Side.Left else m,
+                                   m if side is st.Side.Left else n,
+                                   dtype=dtype, seed=13))
+    A = st.HermitianMatrix(jnp.asarray(herm), uplo=st.Uplo.Lower, mb=16, nb=16)
+    out = np.asarray(st.hemm(side, 1.5, A, jnp.asarray(b), -0.5, jnp.asarray(c)))
+    ref = 1.5 * (herm @ b if side is st.Side.Left else b @ herm) - 0.5 * c
+    assert relerr(out, ref) < tol(dtype)
+
+    sym = (a + a.T) / 2
+    As = st.SymmetricMatrix(jnp.asarray(sym), uplo=st.Uplo.Upper, mb=16, nb=16)
+    outs = np.asarray(st.symm(side, 1.5, As, jnp.asarray(b), -0.5, jnp.asarray(c)))
+    refs = 1.5 * (sym @ b if side is st.Side.Left else b @ sym) - 0.5 * c
+    assert relerr(outs, refs) < tol(dtype)

@@ -1,0 +1,113 @@
+"""Cholesky family tests — residual identities in the reference tester's
+style (``test/test_posv.cc``: ‖b − A·x‖ / (‖A‖·‖x‖·n) ≤ 3ε)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.testing import generate_matrix, random_spd
+
+DTYPES = [jnp.float32, jnp.float64, jnp.complex64, jnp.complex128]
+
+
+def eps(dtype):
+    return jnp.finfo(dtype).eps
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_potrf(dtype, uplo):
+    n = 120
+    a = np.asarray(random_spd(n, dtype=dtype, seed=1))
+    A = st.HermitianMatrix(jnp.asarray(a), uplo=uplo, mb=32, nb=32)
+    F = st.potrf(A)
+    f = np.asarray(F.data)
+    if uplo is st.Uplo.Lower:
+        rec = f @ np.conj(f.T)
+    else:
+        rec = np.conj(f.T) @ f
+    err = np.linalg.norm(rec - a) / (np.linalg.norm(a) * n)
+    assert err < 3 * eps(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_posv(dtype, uplo):
+    n, nrhs = 130, 7
+    a = np.asarray(random_spd(n, dtype=dtype, seed=2))
+    b = np.asarray(generate_matrix("randn", n, nrhs, dtype=dtype, seed=3))
+    A = st.HermitianMatrix(jnp.asarray(a), uplo=uplo, mb=32, nb=32)
+    _, x = st.posv(A, jnp.asarray(b))
+    x = np.asarray(x)
+    err = np.linalg.norm(b - a @ x) / (np.linalg.norm(a) * np.linalg.norm(x) * n)
+    assert err < 3 * eps(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_potri(dtype, uplo):
+    n = 96
+    a = np.asarray(random_spd(n, dtype=dtype, seed=4))
+    A = st.HermitianMatrix(jnp.asarray(a), uplo=uplo, mb=32, nb=32)
+    F = st.potrf(A)
+    Inv = st.potri(F)
+    from slate_tpu.ops.tile_ops import hermitize
+    inv_full = np.asarray(hermitize(uplo, Inv.data))
+    err = np.linalg.norm(inv_full @ a - np.eye(n)) / n
+    assert err < 100 * eps(dtype) * np.linalg.cond(a)
+
+
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+@pytest.mark.parametrize("diag", [st.Diag.NonUnit, st.Diag.Unit])
+def test_trtri(uplo, diag):
+    n = 80
+    dtype = jnp.float64
+    a = np.asarray(generate_matrix("randn", n, n, dtype=dtype, seed=5))
+    if diag is st.Diag.Unit:
+        # keep the strict triangle small: inv(unit + S) = Σ(−S)^k blows up
+        # exponentially for ‖S‖ ≳ 1, which would swamp any solver
+        a = a / (2 * np.linalg.norm(a, 2))
+    a = a + n * np.eye(n)
+    A = st.TriangularMatrix(jnp.asarray(a), uplo=uplo, diag=diag, mb=32, nb=32)
+    inv = np.asarray(st.trtri(A).data)
+    tri = np.tril(a) if uplo is st.Uplo.Lower else np.triu(a)
+    if diag is st.Diag.Unit:
+        np.fill_diagonal(tri, 1.0)
+    err = np.linalg.norm(inv @ tri - np.eye(n)) / n
+    assert err < 100 * eps(dtype)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float64, jnp.complex128])
+@pytest.mark.parametrize("uplo", [st.Uplo.Lower, st.Uplo.Upper])
+def test_trtrm_lauum(dtype, uplo):
+    n = 64
+    a = np.asarray(generate_matrix("randn", n, n, dtype=dtype, seed=6))
+    A = st.TriangularMatrix(jnp.asarray(a), uplo=uplo, mb=16, nb=16)
+    out = np.asarray(st.trtrm(A).data)
+    if uplo is st.Uplo.Lower:
+        t = np.tril(a)
+        ref = np.conj(t.T) @ t
+        mask = np.tril(np.ones((n, n), bool))
+    else:
+        t = np.triu(a)
+        ref = t @ np.conj(t.T)
+        mask = np.triu(np.ones((n, n), bool))
+    err = np.linalg.norm(out[mask] - ref[mask]) / max(np.linalg.norm(ref), 1)
+    assert err < 50 * eps(dtype)
+
+
+def test_matrix_views():
+    """sub/slice/transpose view algebra (reference Matrix.hh:131-135)."""
+    a = np.arange(64, dtype=np.float64).reshape(8, 8)
+    A = st.Matrix.from_array(a, mb=2, nb=2)
+    assert A.mt == 4 and A.nt == 4
+    s = A.sub(1, 2, 0, 1)
+    assert np.array_equal(np.asarray(s.array), a[2:6, 0:4])
+    sl = A.slice(1, 3, 2, 5)
+    assert np.array_equal(np.asarray(sl.array), a[1:4, 2:6])
+    At = A.transpose()
+    assert np.array_equal(np.asarray(At.array), a.T)
+    assert At.m == 8 and At.n == 8
+    t = A.tile(1, 2)
+    assert np.array_equal(np.asarray(t), a[2:4, 4:6])
