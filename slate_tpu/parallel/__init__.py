@@ -1,0 +1,18 @@
+"""Distributed execution over a TPU device mesh.
+
+TPU-native replacement for the reference's MPI communication backend
+(SURVEY §2.3; ``include/slate/Tile.hh:996-1191``,
+``BaseMatrix.hh:1887-2241``, ``src/internal/internal_comm.cc``): the
+tile-granular tagged P2P hypercube broadcasts become XLA collectives
+(``psum`` / ``all_gather`` / ``ppermute``) over a ``jax.sharding.Mesh``
+with axes ``('p', 'q')`` — the 2-D process grid of the reference
+(``MatrixStorage.hh:556-583``).
+
+Single-host "serial stub" semantics (reference ``src/stubs/mpi_stubs.cc``)
+fall out for free: the same SPMD code on a 1×1 mesh.
+"""
+
+from .mesh import default_mesh, make_grid_mesh, mesh_grid_shape  # noqa: F401
+from .dist import DistMatrix, distribute, undistribute  # noqa: F401
+from .dist_blas3 import pgemm  # noqa: F401
+from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401

@@ -1,0 +1,54 @@
+"""Mesh construction helpers.
+
+The reference builds a BLACS-style p×q process grid from MPI ranks
+(``MatrixStorage.hh:556-583``); here the grid is a ``jax.sharding.Mesh``
+over TPU devices with axes ``('p', 'q')``.  A square-ish grid balances
+ICI traffic between the two mesh axes the way a square BLACS grid
+balances row/column broadcast volume.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..grid import ProcessGrid, choose_grid
+
+AXIS_P = "p"
+AXIS_Q = "q"
+
+
+def make_grid_mesh(p: Optional[int] = None, q: Optional[int] = None,
+                   devices=None) -> jax.sharding.Mesh:
+    """Build a p×q mesh over ``devices`` (default: all available).
+
+    Analog of ``Cblacs_gridinit``; defaults to the squarest factorisation
+    like the reference tester's grid setup.
+    """
+
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if p is None and q is None:
+        p, q = choose_grid(n)
+    elif p is None:
+        p = n // q
+    elif q is None:
+        q = n // p
+    if p * q != n:
+        raise ValueError(f"grid {p}x{q} does not match {n} devices")
+    return jax.sharding.Mesh(devices.reshape(p, q), (AXIS_P, AXIS_Q))
+
+
+def default_mesh() -> jax.sharding.Mesh:
+    return make_grid_mesh()
+
+
+def mesh_grid_shape(mesh: jax.sharding.Mesh) -> Tuple[int, int]:
+    return mesh.shape[AXIS_P], mesh.shape[AXIS_Q]
+
+
+def grid_of(mesh: jax.sharding.Mesh) -> ProcessGrid:
+    p, q = mesh_grid_shape(mesh)
+    return ProcessGrid(p, q)
