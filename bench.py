@@ -1,0 +1,62 @@
+"""Headline benchmark: Cholesky factorization throughput on one chip.
+
+Reproduces the reference tester's metric — GFLOP/s from model flop counts
+(``/root/reference/test/test_gemm.cc:244-245``, ``params.gflops()``) — for
+the flagship driver ``potrf`` (BASELINE.md config #2: potrf fp32 n=8192,
+single device).  ``vs_baseline`` is measured against the reference's only
+in-repo per-device throughput anchor, 702 GFLOP/s/GPU
+(``/root/reference/docs/usage.md:36-44``).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from slate_tpu.ops import blocks
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n = 8192 if on_tpu else 1024
+    nb = 512 if on_tpu else 128
+    dtype = jnp.float32
+
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    a = jnp.asarray(g @ g.T + n * np.eye(n, dtype=np.float32), dtype)
+
+    # reduce on device and read one scalar back: a sync point that works
+    # even where block_until_ready only waits for enqueue (axon tunnel)
+    step = jax.jit(lambda a: blocks.potrf_rec(a, nb)[-1, -1])
+    float(step(a))  # compile + warm up
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(step(a))
+        times.append(time.perf_counter() - t0)
+    t = min(times)
+
+    flops = n ** 3 / 3.0  # LAPACK model count for potrf
+    gflops = flops / t / 1e9
+    print(json.dumps({
+        "metric": f"potrf_fp32_n{n}_gflops",
+        "value": round(gflops, 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
+    }))
+    print(f"# t={t:.4f}s n={n} nb={nb} platform={jax.devices()[0].platform}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,7 @@ local row-block ``il`` on mesh row ``r`` is global block ``i = il*p + r``.
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Optional
 
 import jax
@@ -85,11 +86,6 @@ def padded_tiles(m: int, nb: int, p: int) -> int:
     return ceildiv(mt, p) * p
 
 
-def _lcm(a: int, b: int) -> int:
-    import math
-    return a * b // math.gcd(a, b)
-
-
 def distribute(a, mesh: jax.sharding.Mesh, nb: int = 256,
                diag_pad: float = 0.0, row_mult: Optional[int] = None,
                col_mult: Optional[int] = None) -> DistMatrix:
@@ -105,8 +101,8 @@ def distribute(a, mesh: jax.sharding.Mesh, nb: int = 256,
     a = jnp.asarray(a)
     m, n = a.shape
     p, q = mesh_grid_shape(mesh)
-    mtp = padded_tiles(m, nb, _lcm(p, row_mult) if row_mult else p)
-    ntp = padded_tiles(n, nb, _lcm(q, col_mult) if col_mult else q)
+    mtp = padded_tiles(m, nb, math.lcm(p, row_mult) if row_mult else p)
+    ntp = padded_tiles(n, nb, math.lcm(q, col_mult) if col_mult else q)
     mp, np_ = mtp * nb, ntp * nb
     pad = jnp.zeros((mp, np_), a.dtype)
     pad = pad.at[:m, :n].set(a)

@@ -22,13 +22,9 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .. import config
+from ..ops.blocks import matmul as _mm
 from .dist import DistMatrix, like
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
-
-
-def _mm(a, b):
-    return jnp.matmul(a, b, precision=config.matmul_precision)
 
 
 @lru_cache(maxsize=None)
@@ -81,12 +77,18 @@ def pgemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
           c: DistMatrix = None) -> DistMatrix:
     """C ← α·A·B + β·C, all operands block-cyclic on the same mesh."""
 
+    if a.n != b.m:
+        raise ValueError(f"inner dimensions differ: A is {a.m}x{a.n}, "
+                         f"B is {b.m}x{b.n}")
     if a.nb != b.nb:
         raise ValueError("pgemm requires matching tile sizes")
+    if a.mesh is not b.mesh and a.mesh != b.mesh:
+        raise ValueError("pgemm operands must live on the same mesh")
     if a.ntp != b.mtp:
         raise ValueError(
-            f"inner padded tile counts differ: {a.ntp} vs {b.mtp} "
-            "(distribute A and B with the same nb on the same mesh)")
+            f"inner padded tile counts differ: {a.ntp} vs {b.mtp}; "
+            "distribute A with col_mult=p and B with row_mult=q "
+            "(or use pgemm_auto)")
     if c is None:
         p, q = a.grid_shape
         cdata = jnp.zeros((a.mtp * a.nb, b.ntp * b.nb), a.dtype)

@@ -33,13 +33,10 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from .. import config
+from ..grid import ceildiv
+from ..ops.blocks import matmul as _mm
 from .dist import DistMatrix, distribute, like, undistribute
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
-
-
-def _mm(a, b):
-    return jnp.matmul(a, b, precision=config.matmul_precision)
 
 
 def _conj(a, conj: bool):
@@ -116,12 +113,13 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
     """
 
     p, q = a.grid_shape
+    if a.m != a.n:
+        raise ValueError(f"ppotrf requires a square matrix, got {a.m}x{a.n}")
     if a.mtp != a.ntp:
         raise ValueError("ppotrf needs square padded storage "
                          "(distribute with row_mult=q, col_mult=p)")
     ml, nl = a.mtp // p, a.ntp // q
-    import math
-    nt = math.ceil(a.n / a.nb)
+    nt = ceildiv(a.n, a.nb)
     fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype))
     return like(a, fn(a.data))
 
@@ -205,10 +203,15 @@ def ppotrs(l: DistMatrix, b: DistMatrix) -> DistMatrix:
     adjoint back substitution (reference ``src/potrs.cc``)."""
 
     p, q = l.grid_shape
+    if b.nb != l.nb:
+        raise ValueError("ppotrs requires matching tile sizes")
+    if l.mesh is not b.mesh and l.mesh != b.mesh:
+        raise ValueError("ppotrs operands must live on the same mesh")
+    if b.m != l.n:
+        raise ValueError(f"B has {b.m} rows but the factor is {l.n}x{l.n}")
     ml, nl = l.mtp // p, l.ntp // q
     nrhs_l = (b.ntp // q) * b.nb
-    import math
-    nt = math.ceil(l.n / l.nb)
+    nt = ceildiv(l.n, l.nb)
     if b.mtp != l.mtp:
         raise ValueError("B row padding must match the factor "
                          "(distribute with row_mult=q)")
