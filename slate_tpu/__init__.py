@@ -26,6 +26,7 @@ from .matrix import (  # noqa: F401
     TriangularBandMatrix, TriangularMatrix,
 )
 from .options import Options, get_option  # noqa: F401
+from . import method  # noqa: F401
 from .linalg import *  # noqa: F401,F403
 
 __version__ = "0.1.0"

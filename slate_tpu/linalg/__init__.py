@@ -9,3 +9,7 @@ from .blas3 import (  # noqa: F401
     gemm, symm, hemm, syrk, herk, syr2k, her2k, trmm, trsm,
 )
 from .cholesky import potrf, potrs, posv, potri, trtri, trtrm  # noqa: F401
+from .norms import (  # noqa: F401
+    col_norms, gbnorm, genorm, hbnorm, henorm, norm, synorm, trnorm,
+)
+from .util import add, copy, scale, scale_row_col, set  # noqa: F401

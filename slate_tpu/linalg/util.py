@@ -1,0 +1,76 @@
+"""Element-wise utility drivers: add, copy, scale, scale_row_col, set.
+
+TPU-native analogs of the reference drivers ``src/add.cc``, ``src/copy.cc``
+(precision-converting, 411 LoC), ``src/scale.cc``, ``src/scale_row_col.cc``,
+``src/set.cc`` — thin functional wrappers over the tile kernel set in
+:mod:`slate_tpu.ops.tile_ops` (the analog of ``src/cuda/device_*.cu``),
+applied to whole logical arrays so XLA fuses them into neighbours.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..enums import Uplo
+from ..matrix import BaseMatrix, BaseTrapezoidMatrix, as_array
+from ..options import Options
+from ..ops import tile_ops
+
+
+def _wrap_like(template, data):
+    if isinstance(template, BaseMatrix):
+        out = template._like(data)
+        return out
+    return data
+
+
+def add(alpha, a, beta, b, opts: Optional[Options] = None):
+    """B ← α·A + β·B — reference ``slate::add`` (``src/add.cc``).
+    Trapezoid operands update only the stored triangle (``tzadd``)."""
+
+    av, bv = as_array(a), as_array(b)
+    if isinstance(b, BaseTrapezoidMatrix) and b.logical_uplo is not Uplo.General:
+        out = tile_ops.tzadd(b.logical_uplo, alpha, av, beta, bv)
+    else:
+        out = tile_ops.geadd(alpha, av, beta, bv)
+    return _wrap_like(b, out)
+
+
+def copy(a, dtype=None, opts: Optional[Options] = None):
+    """Precision-converting copy — reference ``slate::copy``
+    (``src/copy.cc``): C++ overloads on (src_type, dst_type); here the
+    destination dtype is an argument."""
+
+    av = as_array(a)
+    out = tile_ops.gecopy(av, dtype=dtype)
+    return _wrap_like(a, out)
+
+
+def scale(numer, denom, a, opts: Optional[Options] = None):
+    """A ← (numer/denom)·A — reference ``slate::scale`` (``src/scale.cc``)."""
+
+    out = tile_ops.gescale(numer, denom, as_array(a))
+    return _wrap_like(a, out)
+
+
+def scale_row_col(r, c, a, opts: Optional[Options] = None):
+    """A ← diag(r)·A·diag(c) — reference ``slate::scale_row_col``
+    (``src/scale_row_col.cc``), the equilibration primitive."""
+
+    out = tile_ops.gescale_row_col(jnp.asarray(r), jnp.asarray(c), as_array(a))
+    return _wrap_like(a, out)
+
+
+def set(offdiag_value, diag_value, a, opts: Optional[Options] = None):
+    """A ← offdiag constant with diag constant — reference ``slate::set``
+    (``src/set.cc``).  ``a`` supplies shape/dtype/wrapper."""
+
+    av = as_array(a)
+    if isinstance(a, BaseTrapezoidMatrix) and a.logical_uplo is not Uplo.General:
+        out = tile_ops.tzset(av.shape, a.logical_uplo, offdiag_value,
+                             diag_value, av.dtype)
+    else:
+        out = tile_ops.geset(av.shape, offdiag_value, diag_value, av.dtype)
+    return _wrap_like(a, out)

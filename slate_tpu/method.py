@@ -1,0 +1,99 @@
+"""Per-routine algorithm-variant auto-selection.
+
+TPU-native re-design of the reference's ``include/slate/method.hh`` (319
+LoC): each ``Method*`` family has a ``select_algo`` that picks a variant
+from problem shape and device count.  The decision *criteria* are
+TPU-reinterpreted:
+
+* The reference's gemmA-vs-gemmC split (``method.hh:77-126``) chooses
+  *where the reduction happens* relative to data placement.  On a mesh
+  that maps to which operand is broadcast vs psum-reduced in the SUMMA
+  loop (``parallel/dist_blas3.py``); on one chip XLA owns the schedule,
+  so the choice is recorded but does not change the emitted program.
+* MethodLU's TPU-native default is CALU tournament pivoting
+  (``method.hh:279-315`` keeps PartialPiv default on CPU/GPU): partial
+  pivoting's per-column argmax+swap serialises on data-dependent control
+  flow, while the tournament runs as batched LU over stacked tiles —
+  MXU-shaped work (see ``linalg/lu.py``).
+"""
+
+from __future__ import annotations
+
+from .enums import (MethodCholQR, MethodEig, MethodGels, MethodGemm,
+                    MethodHemm, MethodLU, MethodSVD, MethodTrsm)
+
+
+def select_gemm(method: MethodGemm, b_nt: int, n_devices: int = 1) -> MethodGemm:
+    """Reference ``MethodGemm::select_algo`` (``method.hh:106-121``):
+    gemmA when B is a single block column (reduction over A's layout is
+    cheaper than moving the big operand), else gemmC."""
+
+    if method is not MethodGemm.Auto:
+        return method
+    return MethodGemm.GemmA if b_nt <= 1 else MethodGemm.GemmC
+
+
+def select_trsm(method: MethodTrsm, b_nt: int, n_devices: int = 1) -> MethodTrsm:
+    """Reference ``MethodTrsm::select_algo`` (``method.hh:47-66``): trsmA
+    when B is one block column (move the solve to A's owners), else trsmB."""
+
+    if method is not MethodTrsm.Auto:
+        return method
+    return MethodTrsm.TrsmA if b_nt <= 1 else MethodTrsm.TrsmB
+
+
+def select_hemm(method: MethodHemm, b_nt: int, n_devices: int = 1) -> MethodHemm:
+    """Reference ``MethodHemm::select_algo`` (``method.hh:148-160``)."""
+
+    if method is not MethodHemm.Auto:
+        return method
+    return MethodHemm.HemmA if b_nt <= 1 else MethodHemm.HemmC
+
+
+def select_cholqr(method: MethodCholQR, m: int, n: int,
+                  n_devices: int = 1) -> MethodCholQR:
+    """Reference ``MethodCholQR::select_algo`` (``method.hh:203-224``):
+    the Gram matrix AᴴA is computed with herk when tall (C small), gemm
+    otherwise.  On TPU herk keeps the triangle update MXU-batched."""
+
+    if method is not MethodCholQR.Auto:
+        return method
+    return MethodCholQR.HerkC if m >= 2 * n else MethodCholQR.GemmC
+
+
+def select_gels(method: MethodGels, m: int, n: int) -> MethodGels:
+    """Reference ``MethodGels::select_algo`` (``method.hh:252-268``):
+    CholQR for strongly tall-skinny systems (fewer passes over A — on TPU
+    also one big herk instead of a panel sweep), Householder QR otherwise."""
+
+    if method is not MethodGels.Auto:
+        return method
+    return MethodGels.CholQR if m >= 3 * n else MethodGels.QR
+
+
+def select_lu(method: MethodLU, distributed: bool = False) -> MethodLU:
+    """LU variant (reference ``MethodLU::select_algo`` ``method.hh:298-311``
+    defaults to PartialPiv).  TPU-native default: PartialPiv on one chip
+    (the blocked panel runs as one fused kernel), CALU on a mesh (the
+    tournament's stacked-tile LUs batch on the MXU and avoid per-column
+    cross-device argmax latency, like ``getrf_tntpiv``)."""
+
+    if method is not MethodLU.Auto:
+        return method
+    return MethodLU.CALU if distributed else MethodLU.PartialPiv
+
+
+def select_eig(method: MethodEig, n: int, want_vectors: bool) -> MethodEig:
+    """Tridiagonal eigensolver variant (reference ``enums.hh:60-63``,
+    dispatch in ``src/heev.cc:141-176``): QR iteration without vectors is
+    cheapest; divide-and-conquer when vectors are wanted."""
+
+    if method is not MethodEig.Auto:
+        return method
+    return MethodEig.DC if want_vectors else MethodEig.QR
+
+
+def select_svd(method: MethodSVD, m: int, n: int, want_vectors: bool) -> MethodSVD:
+    if method is not MethodSVD.Auto:
+        return method
+    return MethodSVD.DC if want_vectors else MethodSVD.QR

@@ -1,0 +1,134 @@
+"""Norms + utility drivers + method selection.
+
+Mirrors the reference's norm testers (``test/test_gbnorm.cc`` etc.:
+compare against LAPACK ``lange``-style references) with numpy as the
+reference implementation.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as st
+from slate_tpu import linalg
+from slate_tpu.enums import Diag, Norm, Uplo
+from slate_tpu import method
+from slate_tpu.enums import (MethodCholQR, MethodEig, MethodGels, MethodGemm,
+                             MethodLU, MethodTrsm)
+
+
+def _ref_norm(norm, a):
+    a = np.abs(np.asarray(a))
+    if norm is Norm.Max:
+        return a.max()
+    if norm is Norm.One:
+        return a.sum(axis=0).max()
+    if norm is Norm.Inf:
+        return a.sum(axis=1).max()
+    return np.sqrt((a ** 2).sum())
+
+
+NORMS = [Norm.Max, Norm.One, Norm.Inf, Norm.Fro]
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_genorm(norm):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((53, 41))
+    m = st.Matrix.from_array(a, mb=16, nb=16)
+    got = float(linalg.norm(norm, m))
+    assert np.isclose(got, _ref_norm(norm, a), rtol=1e-6)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+@pytest.mark.parametrize("uplo", [Uplo.Lower, Uplo.Upper])
+def test_synorm_mirrors(norm, uplo):
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((37, 37))
+    sym = a + a.T
+    stored = np.tril(sym) if uplo is Uplo.Lower else np.triu(sym)
+    m = st.SymmetricMatrix(jnp.asarray(stored), uplo=uplo, mb=8, nb=8)
+    got = float(linalg.norm(norm, m))
+    assert np.isclose(got, _ref_norm(norm, sym), rtol=1e-6)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_trnorm_unit_diag(norm):
+    rng = np.random.default_rng(2)
+    a = np.tril(rng.standard_normal((29, 29)))
+    ref = a.copy()
+    np.fill_diagonal(ref, 1.0)
+    m = st.TriangularMatrix(jnp.asarray(a), uplo=Uplo.Lower, diag=Diag.Unit)
+    got = float(linalg.norm(norm, m))
+    assert np.isclose(got, _ref_norm(norm, ref), rtol=1e-6)
+
+
+@pytest.mark.parametrize("norm", NORMS)
+def test_gbnorm_masks_band(norm):
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((31, 31))
+    kl, ku = 3, 5
+    i, j = np.indices(a.shape)
+    banded = np.where((j - i <= ku) & (i - j <= kl), a, 0.0)
+    m = st.BandMatrix(jnp.asarray(a), kl=kl, ku=ku)
+    got = float(linalg.norm(norm, m))
+    assert np.isclose(got, _ref_norm(norm, banded), rtol=1e-6)
+
+
+def test_col_norms():
+    rng = np.random.default_rng(4)
+    a = rng.standard_normal((20, 7))
+    got = np.asarray(linalg.col_norms(Norm.Max, st.Matrix.from_array(a)))
+    np.testing.assert_allclose(got, np.abs(a).max(axis=0), rtol=1e-6)
+
+
+def test_fro_norm_no_overflow():
+    a = np.full((4, 4), 1e30)
+    got = float(linalg.norm(Norm.Fro, st.Matrix.from_array(jnp.asarray(a))))
+    assert np.isclose(got, np.sqrt(16) * 1e30, rtol=1e-6)
+
+
+def test_add_scale_set_copy():
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal((12, 9))
+    b = rng.standard_normal((12, 9))
+    out = linalg.add(2.0, st.Matrix.from_array(a), 0.5, st.Matrix.from_array(b))
+    np.testing.assert_allclose(np.asarray(out.array), 2 * a + 0.5 * b, rtol=1e-6)
+
+    s = linalg.scale(3.0, 2.0, st.Matrix.from_array(a))
+    np.testing.assert_allclose(np.asarray(s.array), 1.5 * a, rtol=1e-6)
+
+    r, c = rng.standard_normal(12), rng.standard_normal(9)
+    sc = linalg.scale_row_col(r, c, st.Matrix.from_array(a))
+    np.testing.assert_allclose(np.asarray(sc.array), a * r[:, None] * c[None, :],
+                               rtol=1e-6)
+
+    z = linalg.set(0.0, 1.0, st.Matrix.from_array(a))
+    np.testing.assert_allclose(np.asarray(z.array), np.eye(12, 9))
+
+    cv = linalg.copy(st.Matrix.from_array(a), dtype=jnp.float32)
+    assert cv.dtype == jnp.float32
+
+
+def test_tzadd_preserves_other_triangle():
+    rng = np.random.default_rng(6)
+    a = rng.standard_normal((8, 8))
+    b = rng.standard_normal((8, 8))
+    bt = st.TrapezoidMatrix(jnp.asarray(b), uplo=Uplo.Lower)
+    out = linalg.add(1.0, st.Matrix.from_array(a), 1.0, bt)
+    got = np.asarray(out.array)
+    np.testing.assert_allclose(np.tril(got), np.tril(a + b), rtol=1e-6)
+    np.testing.assert_allclose(np.triu(got, 1), np.triu(b, 1), rtol=1e-6)
+
+
+def test_method_selection():
+    assert method.select_gemm(MethodGemm.Auto, 1) is MethodGemm.GemmA
+    assert method.select_gemm(MethodGemm.Auto, 8) is MethodGemm.GemmC
+    assert method.select_gemm(MethodGemm.GemmA, 8) is MethodGemm.GemmA
+    assert method.select_trsm(MethodTrsm.Auto, 1) is MethodTrsm.TrsmA
+    assert method.select_gels(MethodGels.Auto, 9000, 100) is MethodGels.CholQR
+    assert method.select_gels(MethodGels.Auto, 100, 90) is MethodGels.QR
+    assert method.select_lu(MethodLU.Auto) is MethodLU.PartialPiv
+    assert method.select_lu(MethodLU.Auto, distributed=True) is MethodLU.CALU
+    assert method.select_eig(MethodEig.Auto, 100, True) is MethodEig.DC
+    assert method.select_cholqr(MethodCholQR.Auto, 4000, 100) is MethodCholQR.HerkC
