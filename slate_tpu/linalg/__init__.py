@@ -9,6 +9,10 @@ from .blas3 import (  # noqa: F401
     gemm, symm, hemm, syrk, herk, syr2k, her2k, trmm, trsm,
 )
 from .cholesky import potrf, potrs, posv, potri, trtri, trtrm  # noqa: F401
+from .lu import (  # noqa: F401
+    gesv, gesv_mixed, gesv_mixed_gmres, getrf, getrf_nopiv, getrf_tntpiv,
+    getri, getrs,
+)
 from .norms import (  # noqa: F401
     col_norms, gbnorm, genorm, hbnorm, henorm, norm, synorm, trnorm,
 )

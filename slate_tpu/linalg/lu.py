@@ -1,0 +1,520 @@
+"""LU family: getrf (partial-pivot / no-pivot / CALU tournament), getrs,
+gesv, getri, plus the distributed-pivot helpers.
+
+TPU-native re-design of the reference LU stack:
+
+* ``src/getrf.cc`` (381 LoC) — right-looking LU with a multithreaded
+  partial-pivot panel (``internal_getrf.cc`` + ``Tile_getrf.hh:154-320``:
+  per-thread argmax, barrier, MPI MAXLOC, explicit row swaps).
+* ``src/getrf_nopiv.cc`` — no pivoting.
+* ``src/getrf_tntpiv.cc`` (456 LoC) — CALU tournament pivoting: local LU
+  of stacked tiles + binary tournament (``internal_getrf_tntpiv.cc``).
+* ``src/getrs.cc`` / ``src/gesv.cc`` / ``src/getri.cc`` /
+  ``src/getriOOP.cc``.
+
+Design stance (TPU-first, not a translation):
+
+* **Pivots are permutation index vectors**, not LAPACK swap sequences
+  (reference ``Pivots``, ``types.hh:64-97``).  A gather ``a[perm]`` is
+  one XLA op that the compiler fuses and shards; a swap sequence is a
+  serial chain.  :func:`perm_to_ipiv` / :func:`ipiv_to_perm` convert at
+  the LAPACK-compat boundary.
+* The **panel** is XLA's fused ``lax.linalg.lu`` on a tall block — the
+  analog of the reference's multithreaded panel kernel
+  (``Tile_getrf.hh``), with XLA:TPU owning the within-panel schedule
+  instead of a hand-rolled ThreadBarrier.
+* The **recursion** exposes one big trsm + one big gemm per level (the
+  MXU hot loop), exactly like the reference's trailing update
+  (``src/getrf.cc:175-215``), with XLA overlapping panel k+1 against
+  update k the way OpenMP ``depend`` lookahead did.
+* **Tournament pivoting** batches the stacked-tile LUs with ``vmap`` —
+  MXU-shaped and free of cross-tile argmax latency — matching the
+  communication-avoiding design goal of ``getrf_tntpiv`` (its MPI
+  tournament becomes a tree reduction over the batch axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..enums import Diag, MethodLU, Norm, Op, Side, Uplo
+from ..matrix import Matrix, as_array
+from ..options import Options, get_option
+from ..ops import blocks
+from ..ops.blocks import matmul
+from .blas3 import _nb, _wrap_like
+from .norms import norm as _norm
+
+
+# ---------------------------------------------------------------------------
+# Pivot representation
+# ---------------------------------------------------------------------------
+
+def ipiv_to_perm(ipiv, m: int):
+    """LAPACK ipiv (1-based swap sequence) → permutation vector."""
+    perm = list(range(m))
+    for k, p in enumerate(ipiv):
+        p = int(p) - 1
+        perm[k], perm[p] = perm[p], perm[k]
+    return jnp.asarray(perm)
+
+
+def perm_to_ipiv(perm):
+    """Permutation vector → LAPACK 1-based swap sequence (for the
+    LAPACK/ScaLAPACK compat layers; reference ``Pivots`` ``types.hh:64``)."""
+    perm = [int(x) for x in perm]
+    m = len(perm)
+    ipiv = [0] * m
+    cur = list(range(m))      # current row order being built by swaps
+    loc = {r: i for i, r in enumerate(cur)}
+    for k in range(m):
+        j = loc[perm[k]]
+        ipiv[k] = j + 1
+        rk, rj = cur[k], cur[j]
+        cur[k], cur[j] = rj, rk
+        loc[rj], loc[rk] = k, j
+    return jnp.asarray(ipiv, jnp.int32)
+
+
+def inverse_perm(perm):
+    return jnp.argsort(perm)
+
+
+# ---------------------------------------------------------------------------
+# Panels
+# ---------------------------------------------------------------------------
+
+def _panel_lu(a):
+    """Partial-pivot panel factor: returns (lu, perm) with a[perm] = L·U.
+
+    One fused XLA kernel (the analog of ``internal::getrf_panel``'s
+    thread team, ``internal_getrf.cc:75-92``).
+    """
+    lu, _, perm = lax.linalg.lu(a)
+    return lu, perm
+
+
+def _panel_lu_nopiv(a, ib: int = 8):
+    """No-pivot panel via inner blocking ``ib`` (reference
+    ``Option::InnerBlocking``): recursion down to an unblocked masked
+    loop — each step is a rank-1 update, kept tiny (ib columns)."""
+
+    m, n = a.shape
+    if n <= ib:
+        def body(k, acc):
+            col = acc[:, k]
+            piv = acc[k, k]
+            rows = jnp.arange(m)
+            factor = jnp.where(rows > k, col / piv, 0)
+            urow = jnp.where(jnp.arange(n)[None, :] > k, acc[k, :][None, :], 0)
+            acc = acc - factor[:, None] * urow
+            return acc.at[:, k].set(jnp.where(rows > k, factor, col))
+        return lax.fori_loop(0, min(m, n), body, a)
+    n1 = n // 2
+    f1 = _panel_lu_nopiv(a[:, :n1], ib)
+    l11 = f1[:n1]
+    u12 = lax.linalg.triangular_solve(
+        l11, a[:n1, n1:], left_side=True, lower=True, unit_diagonal=True)
+    a22 = a[n1:, n1:] - matmul(f1[n1:], u12)
+    f2 = _panel_lu_nopiv(a22, ib)
+    top = jnp.concatenate([f1[:n1], u12], axis=1)
+    bot = jnp.concatenate([f1[n1:], f2], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def _panel_lu_tntpiv(a, nb: int):
+    """CALU tournament-pivot panel (reference ``getrf_tntpiv``,
+    ``internal_getrf_tntpiv.cc``): round 0 factors every mb-row tile
+    independently (batched — vmap over the stack, one MXU batch like the
+    reference's batched device getrf); each tournament round stacks pairs
+    of winners and re-factors, halving the candidate set; the final
+    pivot rows factor the panel exactly.
+
+    Returns (lu, perm) with a[perm] = L·U — same contract as
+    :func:`_panel_lu`, different (communication-avoiding) pivot choice.
+    """
+
+    m, n = a.shape
+    mb = max(nb, n)
+    nt = -(-m // mb)
+    pad_m = nt * mb
+    apad = jnp.zeros((pad_m, n), a.dtype)
+    # padded rows must never win the tournament: they are exact zeros
+    apad = apad.at[:m, :].set(a)
+    rows = jnp.arange(pad_m)
+
+    # round 0: independent LU of each tile (batched)
+    tiles = apad.reshape(nt, mb, n)
+    _, _, perms = jax.vmap(lax.linalg.lu)(tiles)
+    # candidate = top-n rows of each tile, in pivoted order
+    cand_rows = (perms[:, :n] + (jnp.arange(nt) * mb)[:, None]).reshape(-1)
+
+    # tournament tree: pairwise stack candidates, re-factor, keep top n
+    while cand_rows.shape[0] > n:
+        k = cand_rows.shape[0]
+        if (k // n) % 2 == 1:        # odd number of contenders: bye
+            bye = cand_rows[-n:]
+            cand_rows = cand_rows[:-n]
+        else:
+            bye = None
+        pairs = cand_rows.reshape(-1, 2 * n)
+        stacked = apad[pairs.reshape(-1)].reshape(-1, 2 * n, n)
+        _, _, perms = jax.vmap(lax.linalg.lu)(stacked)
+        win = jnp.take_along_axis(pairs, perms[:, :n], axis=1).reshape(-1)
+        cand_rows = jnp.concatenate([win, bye]) if bye is not None else win
+
+    # the n winning rows lead; the rest follow in original order (stable
+    # argsort); re-factor only the n×n winner block (pivoting *within*
+    # winners is local), then one trailing solve for L21 — no further
+    # pivoting, the tournament already guaranteed a strong pivot block
+    mask = jnp.zeros((pad_m,), bool).at[cand_rows].set(True)
+    order = jnp.argsort(~mask, stable=True)
+    ap = apad[order]
+    lu_top, _, p2 = lax.linalg.lu(ap[:n])
+    l21 = lax.linalg.triangular_solve(
+        jnp.triu(lu_top), ap[n:], left_side=False, lower=False)
+    lu = jnp.concatenate([lu_top, l21], axis=0)
+    order = jnp.concatenate([order[:n][p2], order[n:]])
+    # drop padded rows (they are exact zeros and never chosen as pivots)
+    sel = jnp.argsort(order >= m, stable=True)[:m]
+    return lu[sel], order[sel]
+
+
+# ---------------------------------------------------------------------------
+# Blocked factorization
+# ---------------------------------------------------------------------------
+
+def getrf_rec(a, nb: int, panel=_panel_lu):
+    """Blocked right-looking LU with row pivoting: a[perm] = L·U packed
+    LAPACK-style (unit L strictly below, U on/above the diagonal).
+
+    Recursive equivalent of the reference driver loop
+    ``src/getrf.cc:94-215`` (panel → pivot bcast → row swaps → trsm →
+    gemm trailing update).
+    """
+
+    m, n = a.shape
+    if m < n:
+        # wide: factor the square left part, then one trsm for the rest
+        # of U (LAPACK getrf semantics; reference supports m < n)
+        lu_l, perm = getrf_rec(a[:, :m], nb, panel)
+        u_r = lax.linalg.triangular_solve(
+            lu_l, a[perm][:, m:], left_side=True, lower=True,
+            unit_diagonal=True)
+        return jnp.concatenate([lu_l, u_r], axis=1), perm
+    if n <= nb:
+        return panel(a)
+    n1 = blocks._split(n, nb)
+    lu1, perm1 = getrf_rec(a[:, :n1], nb, panel)
+    right = a[perm1][:, n1:]           # permuteRows of the trailing block
+    u12 = lax.linalg.triangular_solve(
+        lu1[:n1], right[:n1], left_side=True, lower=True, unit_diagonal=True)
+    a22 = right[n1:] - matmul(lu1[n1:], u12)
+    lu2, perm2 = getrf_rec(a22, nb, panel)
+    l21 = lu1[n1:][perm2]
+    top = jnp.concatenate([lu1[:n1], u12], axis=1)
+    bot = jnp.concatenate([l21, lu2], axis=1)
+    perm = jnp.concatenate([perm1[:n1], perm1[n1:][perm2]])
+    return jnp.concatenate([top, bot], axis=0), perm
+
+
+def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
+    """LU factorization with partial pivoting — reference ``slate::getrf``
+    (``src/getrf.cc``).  Returns ``(LU, perm)`` with ``A[perm] = L·U``;
+    LU packed LAPACK-style in one Matrix.
+
+    ``Option.MethodLU`` picks the pivot strategy: PartialPiv (default,
+    ``lax.linalg.lu`` panel), CALU (tournament, reference
+    ``getrf_tntpiv``), NoPiv (reference ``getrf_nopiv``).
+    """
+
+    av = as_array(a)
+    nb = _nb(a, opts)
+    method = get_option(opts, "method_lu", MethodLU.Auto)
+    from ..method import select_lu
+    method = select_lu(method)
+    if method is MethodLU.NoPiv:
+        lu = getrf_nopiv_rec(av, nb)
+        perm = jnp.arange(av.shape[0])
+    elif method is MethodLU.CALU:
+        lu, perm = getrf_rec(av, nb, panel=lambda p: _panel_lu_tntpiv(p, nb))
+    elif method is MethodLU.PartialPiv:
+        lu, perm = getrf_rec(av, nb)
+    else:
+        raise NotImplementedError(f"MethodLU.{method.name} is not implemented "
+                                  "(supported: PartialPiv, CALU, NoPiv)")
+    return _wrap_like(a, lu), perm
+
+
+def getrf_nopiv_rec(a, nb: int):
+    m, n = a.shape
+    if m < n:
+        f_l = getrf_nopiv_rec(a[:, :m], nb)
+        u_r = lax.linalg.triangular_solve(
+            f_l, a[:, m:], left_side=True, lower=True, unit_diagonal=True)
+        return jnp.concatenate([f_l, u_r], axis=1)
+    if n <= nb:
+        return _panel_lu_nopiv(a)
+    n1 = blocks._split(n, nb)
+    f1 = getrf_nopiv_rec(a[:, :n1], nb)
+    u12 = lax.linalg.triangular_solve(
+        f1[:n1], a[:n1, n1:], left_side=True, lower=True, unit_diagonal=True)
+    a22 = a[n1:, n1:] - matmul(f1[n1:], u12)
+    f2 = getrf_nopiv_rec(a22, nb)
+    top = jnp.concatenate([f1[:n1], u12], axis=1)
+    bot = jnp.concatenate([f1[n1:], f2], axis=1)
+    return jnp.concatenate([top, bot], axis=0)
+
+
+def getrf_nopiv(a, opts: Optional[Options] = None):
+    """Reference ``slate::getrf_nopiv`` (``src/getrf_nopiv.cc``)."""
+    av = as_array(a)
+    return _wrap_like(a, getrf_nopiv_rec(av, _nb(a, opts)))
+
+
+def getrf_tntpiv(a, opts: Optional[Options] = None):
+    """CALU tournament-pivot LU — reference ``slate::getrf_tntpiv``
+    (``src/getrf_tntpiv.cc``)."""
+    av = as_array(a)
+    nb = _nb(a, opts)
+    lu, perm = getrf_rec(av, nb, panel=lambda p: _panel_lu_tntpiv(p, nb))
+    return _wrap_like(a, lu), perm
+
+
+# ---------------------------------------------------------------------------
+# Solves / inverse
+# ---------------------------------------------------------------------------
+
+def _lu_solve(luv, perm, bv, nb: int):
+    """permuteRows(Forward) → trsm(L, unit) → trsm(U) — the core of getrs,
+    shared by the mixed-precision solvers (reference ``src/getrs.cc``)."""
+    y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.Unit, luv, bv[perm], nb)
+    return blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit, luv, y, nb)
+
+
+def getrs(lu, perm, b, op: Op = Op.NoTrans, opts: Optional[Options] = None):
+    """Solve op(A)·X = B from the LU factor — reference ``slate::getrs``
+    (``src/getrs.cc``: permuteRows(Forward) → trsm(L) → trsm(U))."""
+
+    luv, bv = as_array(lu), as_array(b)
+    nb = _nb(lu, opts)
+    if op is Op.NoTrans:
+        x = _lu_solve(luv, perm, bv, nb)
+    else:
+        # op(A) = Uᵗ Lᵗ P (A[perm] = LU): solve Uᵗ y = B, Lᵗ w = y, x = Pᵗw
+        t = luv.T if op is Op.Trans else jnp.conj(luv.T)
+        y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit, t, bv, nb)
+        w = blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.Unit, t, y, nb)
+        x = jnp.zeros_like(w).at[perm].set(w)
+    return _wrap_like(b, x)
+
+
+def gesv(a, b, opts: Optional[Options] = None):
+    """Factor + solve — reference ``slate::gesv`` (``src/gesv.cc``).
+    Returns ``(lu, perm, x)``."""
+
+    lu, perm = getrf(a, opts)
+    x = getrs(lu, perm, b, opts=opts)
+    return lu, perm, x
+
+
+def getri(lu, perm, opts: Optional[Options] = None):
+    """Matrix inverse from the LU factor — reference ``slate::getri``
+    (``src/getri.cc``: trtri(U) then solve; out-of-place variant
+    ``getriOOP.cc``).  A⁻¹ = U⁻¹·L⁻¹·P, evaluated as two triangular
+    inverses and one triangular product plus a column gather."""
+
+    luv = as_array(lu)
+    n = luv.shape[-1]
+    nb = _nb(lu, opts)
+    uinv = blocks.trtri_rec(Uplo.Upper, Diag.NonUnit, luv, nb)
+    linv = blocks.trtri_rec(Uplo.Lower, Diag.Unit, luv, nb)
+    linv = jnp.tril(linv, -1) + jnp.eye(n, dtype=luv.dtype)
+    m = matmul(jnp.triu(uinv), linv)
+    inv = m[:, inverse_perm(perm)]    # · P as a column gather
+    return _wrap_like(lu, inv)
+
+
+# ---------------------------------------------------------------------------
+# Mixed precision + iterative refinement (gesv_mixed / gesv_mixed_gmres)
+# ---------------------------------------------------------------------------
+
+def _lo_dtype(dtype):
+    """The reference pairs fp64→fp32 (``gesv_mixed`` 278 LoC).  The TPU
+    fast path is fp32→bf16 is *not* accurate enough for IR's contraction
+    bound, so fp64→fp32 and fp32→fp32 (no-op refine) are used."""
+    d = jnp.dtype(dtype)
+    if d == jnp.float64:
+        return jnp.float32
+    if d == jnp.complex128:
+        return jnp.complex64
+    return d
+
+
+def gesv_mixed(a, b, opts: Optional[Options] = None, *, tol=None,
+               return_info: bool = False):
+    """Mixed-precision LU solve with iterative refinement — reference
+    ``slate::gesv_mixed`` (``src/gesv_mixed.cc``): factor in low
+    precision (fp32 — MXU-fast), refine the residual in working
+    precision, fall back to a full-precision factor if refinement stalls
+    (``Option.UseFallbackSolver``).
+
+    Returns ``(x, iters)``; ``iters < 0`` flags fallback (reference info
+    convention).
+    """
+
+    av, bv = as_array(a), as_array(b)
+    n = av.shape[-1]
+    nb = _nb(a, opts)
+    itermax = int(get_option(opts, "max_iterations", 30))
+    use_fallback = bool(get_option(opts, "use_fallback_solver", True))
+    eps = jnp.finfo(av.dtype).eps
+    # reference stopping criterion: ||r||∞ ≤ ||x||∞ · ||A||∞ · ε · √n
+    anorm = _norm(Norm.Inf, av)
+    thresh = (float(tol) if tol is not None
+              else float(eps) * float(jnp.sqrt(n)))
+
+    lo = _lo_dtype(av.dtype)
+    lu_lo, perm = getrf_rec(av.astype(lo), nb)
+
+    solve_lo = jax.jit(
+        lambda r: _lu_solve(lu_lo, perm, r.astype(lo), nb).astype(av.dtype))
+    residual = jax.jit(lambda x: bv - matmul(av, x))
+
+    x = solve_lo(bv)
+    iters = 0
+    converged = False
+    for it in range(itermax):
+        r = residual(x)
+        rnorm = float(jnp.max(jnp.abs(r)))
+        xnorm = float(jnp.max(jnp.abs(x)))
+        if rnorm <= xnorm * float(anorm) * thresh:
+            converged = True
+            iters = it
+            break
+        x = x + solve_lo(r)
+        iters = it + 1
+    if not converged:
+        r = residual(x)
+        rnorm = float(jnp.max(jnp.abs(r)))
+        xnorm = float(jnp.max(jnp.abs(x)))
+        converged = rnorm <= xnorm * float(anorm) * thresh
+    if not converged and use_fallback:
+        # full-precision fallback (reference gesv_mixed.cc fallback path)
+        lu, perm_f = getrf_rec(av, nb)
+        x = _lu_solve(lu, perm_f, bv, nb)
+        iters = -(iters + 1)
+    return (_wrap_like(b, x), iters)
+
+
+def gesv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
+                     restart: int = 30):
+    """GMRES-IR: FGMRES in working precision, left-preconditioned by the
+    low-precision LU solve — reference ``slate::gesv_mixed_gmres``
+    (``src/gesv_mixed_gmres.cc``, itermax 30, fallback on stagnation).
+
+    Single right-hand-side per GMRES cycle (reference restriction: it
+    iterates nrhs=1; multiple columns are solved column-by-column).
+    Returns ``(x, iters)``.
+    """
+
+    av, bv = as_array(a), as_array(b)
+    nb = _nb(a, opts)
+    itermax = int(get_option(opts, "max_iterations", 30))
+    use_fallback = bool(get_option(opts, "use_fallback_solver", True))
+    squeeze = bv.ndim == 1
+    if squeeze:
+        bv = bv[:, None]
+    n = av.shape[-1]
+    eps = jnp.finfo(av.dtype).eps
+    anorm = _norm(Norm.Inf, av)
+    thresh = float(tol) if tol is not None else float(eps) * float(jnp.sqrt(n))
+
+    lo = _lo_dtype(av.dtype)
+    lu_lo, perm = getrf_rec(av.astype(lo), nb)
+
+    precond = jax.jit(
+        lambda r: _lu_solve(lu_lo, perm, r.astype(lo), nb).astype(av.dtype))
+
+    matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
+
+    cols = []
+    total_iters = 0
+    any_fallback = False
+    for j in range(bv.shape[1]):
+        bj = bv[:, j]
+        x = precond(bj[:, None])[:, 0]
+        col_iters = 0
+        converged = False
+        # FGMRES(restart) cycles, bounded by the itermax option
+        # (reference gesv_mixed_gmres.cc:24-47)
+        while col_iters < itermax:
+            r = bj - matvec(x)
+            rnorm = float(jnp.linalg.norm(r))
+            xnorm = float(jnp.max(jnp.abs(x)))
+            if rnorm <= max(xnorm, 1.0) * float(anorm) * thresh:
+                converged = True
+                break
+            # Arnoldi with preconditioned directions (flexible GMRES)
+            import numpy as _np
+            V = [r / rnorm]
+            Z = []
+            H = _np.zeros((restart + 1, restart))
+            g = _np.zeros(restart + 1)
+            g[0] = rnorm
+            cs = _np.zeros(restart)
+            sn = _np.zeros(restart)
+            k_used = 0
+            for k in range(restart):
+                z = precond(V[k][:, None])[:, 0]
+                Z.append(z)
+                w = matvec(z)
+                for i in range(k + 1):
+                    H[i, k] = float(jnp.vdot(V[i], w).real)
+                    w = w - H[i, k] * V[i]
+                H[k + 1, k] = float(jnp.linalg.norm(w))
+                total_iters += 1
+                col_iters += 1
+                k_used = k + 1
+                if H[k + 1, k] > 0:
+                    V.append(w / H[k + 1, k])
+                # Givens updates of the Hessenberg column
+                for i in range(k):
+                    t = cs[i] * H[i, k] + sn[i] * H[i + 1, k]
+                    H[i + 1, k] = -sn[i] * H[i, k] + cs[i] * H[i + 1, k]
+                    H[i, k] = t
+                denom = _np.hypot(H[k, k], H[k + 1, k])
+                if denom == 0:
+                    break
+                cs[k], sn[k] = H[k, k] / denom, H[k + 1, k] / denom
+                H[k, k] = denom
+                H[k + 1, k] = 0.0
+                g[k + 1] = -sn[k] * g[k]
+                g[k] = cs[k] * g[k]
+                if abs(g[k + 1]) <= max(xnorm, 1.0) * float(anorm) * thresh:
+                    break
+            if k_used:
+                yk = _np.linalg.solve(_np.triu(H[:k_used, :k_used]),
+                                      g[:k_used])
+                for i in range(k_used):
+                    x = x + float(yk[i]) * Z[i]
+        if not converged:
+            r = bj - matvec(x)
+            rnorm = float(jnp.linalg.norm(r))
+            xnorm = float(jnp.max(jnp.abs(x)))
+            converged = rnorm <= max(xnorm, 1.0) * float(anorm) * thresh
+        if not converged and use_fallback:
+            # full-precision fallback (reference fallback path)
+            lu_f, perm_f = getrf_rec(av, nb)
+            x = _lu_solve(lu_f, perm_f, bj[:, None], nb)[:, 0]
+            any_fallback = True
+        cols.append(x)
+    x = jnp.stack(cols, axis=1)
+    if squeeze:
+        x = x[:, 0]
+    iters = -(total_iters + 1) if any_fallback else total_iters
+    return _wrap_like(b, x), iters
