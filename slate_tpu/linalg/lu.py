@@ -442,9 +442,11 @@ def gesv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
 
     matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
 
+    import numpy as _np
     cols = []
     total_iters = 0
     any_fallback = False
+    full_factor = None            # lazily-computed fallback, shared by columns
     for j in range(bv.shape[1]):
         bj = bv[:, j]
         x = precond(bj[:, None])[:, 0]
@@ -459,58 +461,56 @@ def gesv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
             if rnorm <= max(xnorm, 1.0) * float(anorm) * thresh:
                 converged = True
                 break
-            # Arnoldi with preconditioned directions (flexible GMRES)
-            import numpy as _np
+            # Arnoldi with preconditioned directions (flexible GMRES);
+            # the (restart+1)×restart Hessenberg LSQ is solved on host —
+            # complex-safe, O(restart³) ≪ one matvec
             V = [r / rnorm]
             Z = []
-            H = _np.zeros((restart + 1, restart))
-            g = _np.zeros(restart + 1)
-            g[0] = rnorm
-            cs = _np.zeros(restart)
-            sn = _np.zeros(restart)
+            H = _np.zeros((restart + 1, restart), dtype=_np.dtype(av.dtype))
             k_used = 0
             for k in range(restart):
                 z = precond(V[k][:, None])[:, 0]
                 Z.append(z)
                 w = matvec(z)
                 for i in range(k + 1):
-                    H[i, k] = float(jnp.vdot(V[i], w).real)
+                    H[i, k] = complex(jnp.vdot(V[i], w)) if \
+                        _np.iscomplexobj(H) else float(jnp.vdot(V[i], w).real)
                     w = w - H[i, k] * V[i]
-                H[k + 1, k] = float(jnp.linalg.norm(w))
+                hk1 = float(jnp.linalg.norm(w))
+                H[k + 1, k] = hk1
                 total_iters += 1
                 col_iters += 1
                 k_used = k + 1
-                if H[k + 1, k] > 0:
-                    V.append(w / H[k + 1, k])
-                # Givens updates of the Hessenberg column
-                for i in range(k):
-                    t = cs[i] * H[i, k] + sn[i] * H[i + 1, k]
-                    H[i + 1, k] = -sn[i] * H[i, k] + cs[i] * H[i + 1, k]
-                    H[i, k] = t
-                denom = _np.hypot(H[k, k], H[k + 1, k])
-                if denom == 0:
+                if hk1 == 0.0:       # happy breakdown
                     break
-                cs[k], sn[k] = H[k, k] / denom, H[k + 1, k] / denom
-                H[k, k] = denom
-                H[k + 1, k] = 0.0
-                g[k + 1] = -sn[k] * g[k]
-                g[k] = cs[k] * g[k]
-                if abs(g[k + 1]) <= max(xnorm, 1.0) * float(anorm) * thresh:
+                V.append(w / hk1)
+                # running LSQ residual of min‖β·e₁ − H·y‖ for early exit
+                g = _np.zeros(k + 2, H.dtype)
+                g[0] = rnorm
+                _, res, *_ = _np.linalg.lstsq(H[:k + 2, :k + 1], g,
+                                              rcond=None)
+                lsq_res = _np.sqrt(float(res[0])) if res.size else 0.0
+                if lsq_res <= max(xnorm, 1.0) * float(anorm) * thresh:
                     break
             if k_used:
-                yk = _np.linalg.solve(_np.triu(H[:k_used, :k_used]),
-                                      g[:k_used])
+                g = _np.zeros(k_used + 1, H.dtype)
+                g[0] = rnorm
+                yk, *_ = _np.linalg.lstsq(H[:k_used + 1, :k_used], g,
+                                          rcond=None)
                 for i in range(k_used):
-                    x = x + float(yk[i]) * Z[i]
+                    x = x + complex(yk[i]) * Z[i] if _np.iscomplexobj(H) \
+                        else x + float(yk[i].real) * Z[i]
         if not converged:
             r = bj - matvec(x)
             rnorm = float(jnp.linalg.norm(r))
             xnorm = float(jnp.max(jnp.abs(x)))
             converged = rnorm <= max(xnorm, 1.0) * float(anorm) * thresh
         if not converged and use_fallback:
-            # full-precision fallback (reference fallback path)
-            lu_f, perm_f = getrf_rec(av, nb)
-            x = _lu_solve(lu_f, perm_f, bj[:, None], nb)[:, 0]
+            # full-precision fallback (reference fallback path), factored
+            # once and reused across right-hand-side columns
+            if full_factor is None:
+                full_factor = getrf_rec(av, nb)
+            x = _lu_solve(full_factor[0], full_factor[1], bj[:, None], nb)[:, 0]
             any_fallback = True
         cols.append(x)
     x = jnp.stack(cols, axis=1)

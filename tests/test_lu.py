@@ -142,6 +142,21 @@ def test_gesv_mixed_gmres():
     assert res < 1e-12, f"gmres-ir residual {res}"
 
 
+def test_gesv_mixed_gmres_complex():
+    n = 48
+    rng = np.random.default_rng(12)
+    a = (rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n)))
+    a = a + n * np.eye(n)
+    b = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    x, iters = gesv_mixed_gmres(
+        st.Matrix.from_array(jnp.asarray(a, jnp.complex128), nb=16),
+        jnp.asarray(b, jnp.complex128))
+    assert iters >= 0, "complex GMRES-IR fell back"
+    xv = np.asarray(x)
+    res = np.linalg.norm(a @ xv - b) / (np.linalg.norm(a) * np.linalg.norm(xv))
+    assert res < 1e-12, f"complex gmres-ir residual {res}"
+
+
 def test_pivot_conversions_roundtrip():
     rng = np.random.default_rng(10)
     perm = rng.permutation(17)

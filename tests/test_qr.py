@@ -1,0 +1,128 @@
+"""QR/LQ family tests — residual + orthogonality gates like the
+reference tester (``test/test_geqrf.cc``: ‖A − QR‖/(m‖A‖ε) and
+‖I − QᴴQ‖/(mε) ≤ 3-ish)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as st
+from slate_tpu.enums import MethodGels, Op, Side, Uplo
+from slate_tpu.linalg.qr import (cholqr, gelqf, gels, gels_cholqr, gels_qr,
+                                 geqrf, larft_rec, ungqr, unmlq, unmqr)
+from slate_tpu.testing.matgen import generate_matrix
+
+
+def _qr_checks(a, packed, taus, nb=16):
+    a = np.asarray(a)
+    m, n = a.shape
+    k = min(m, n)
+    eps = np.finfo(a.dtype).eps
+    q = np.asarray(ungqr(packed, taus, n_cols=m))
+    r = np.triu(np.asarray(packed if not hasattr(packed, "array")
+                           else packed.array))[:k if m >= n else m]
+    # orthogonality
+    orth = np.linalg.norm(q.conj().T @ q - np.eye(m)) / (m * eps)
+    assert orth < 50, f"orthogonality {orth}"
+    # reconstruction: A = Q·[R; 0]
+    rfull = np.zeros((m, n), a.dtype)
+    rfull[:min(m, n), :] = np.triu(np.asarray(
+        packed.array if hasattr(packed, "array") else packed))[:min(m, n)]
+    res = np.linalg.norm(a - q @ rfull) / (np.linalg.norm(a) * m * eps)
+    assert res < 50, f"reconstruction {res}"
+
+
+@pytest.mark.parametrize("m,n", [(64, 64), (120, 40), (40, 96)])
+def test_geqrf(m, n):
+    a = np.asarray(generate_matrix("randn", m, n, dtype=jnp.float64, seed=1))
+    f, taus = geqrf(st.Matrix.from_array(a, nb=16))
+    _qr_checks(a, f, taus)
+
+
+def test_geqrf_complex():
+    a = np.asarray(generate_matrix("randn", 48, 48, dtype=jnp.complex128, seed=2))
+    f, taus = geqrf(st.Matrix.from_array(a, nb=16))
+    q = np.asarray(ungqr(f, taus, n_cols=48))
+    eps = np.finfo(np.float64).eps
+    assert np.linalg.norm(q.conj().T @ q - np.eye(48)) / (48 * eps) < 50
+    r = np.triu(np.asarray(f.array))
+    assert np.linalg.norm(a - q @ r) / (np.linalg.norm(a) * 48 * eps) < 50
+
+
+def test_larft_matches_product_of_reflectors():
+    rng = np.random.default_rng(3)
+    m, k = 20, 6
+    a = rng.standard_normal((m, k))
+    f, taus = geqrf(st.Matrix.from_array(a, nb=8))
+    v = np.tril(np.asarray(f.array), -1) + np.eye(m, k)
+    t = np.asarray(larft_rec(jnp.asarray(v), taus))
+    q_wy = np.eye(m) - v @ t @ v.T
+    q_prod = np.eye(m)
+    for i in range(k):
+        h = np.eye(m) - float(taus[i]) * np.outer(v[:, i], v[:, i])
+        q_prod = q_prod @ h
+    np.testing.assert_allclose(q_wy, q_prod, atol=1e-12)
+
+
+@pytest.mark.parametrize("side", [Side.Left, Side.Right])
+@pytest.mark.parametrize("op", [Op.NoTrans, Op.Trans])
+def test_unmqr_sides_ops(side, op):
+    rng = np.random.default_rng(4)
+    m, k = 40, 24
+    a = rng.standard_normal((m, k))
+    f, taus = geqrf(st.Matrix.from_array(a, nb=8))
+    q = np.asarray(ungqr(f, taus, n_cols=m))
+    c = rng.standard_normal((m, m))
+    got = np.asarray(unmqr(side, op, f, taus, jnp.asarray(c)))
+    qop = q if op is Op.NoTrans else q.T
+    want = qop @ c if side is Side.Left else c @ qop
+    np.testing.assert_allclose(got, want, atol=1e-11)
+
+
+def test_gelqf_unmlq():
+    rng = np.random.default_rng(5)
+    m, n = 30, 70
+    a = rng.standard_normal((m, n))
+    f, taus = gelqf(st.Matrix.from_array(a, nb=16))
+    l = np.tril(np.asarray(f.array))[:, :m]
+    # reconstruct A = L·Q by applying Q to [I_m; 0] rows: A = unmlq(L_ext)
+    lext = np.zeros((m, n))
+    lext[:, :m] = l
+    got = np.asarray(unmlq(Side.Right, Op.NoTrans, f, taus, jnp.asarray(lext)))
+    np.testing.assert_allclose(got, a, atol=1e-11)
+
+
+@pytest.mark.parametrize("m,n", [(90, 30), (30, 80)])
+def test_gels_qr(m, n):
+    rng = np.random.default_rng(6)
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal(m)
+    x = np.asarray(gels_qr(st.Matrix.from_array(a, nb=16), jnp.asarray(b)))
+    want, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(x, want, atol=1e-9)
+
+
+def test_cholqr():
+    a = np.asarray(generate_matrix("cond", 200, 24, dtype=jnp.float64,
+                                   seed=7, cond=1e3))
+    q, r = cholqr(st.Matrix.from_array(a, nb=16))
+    q, r = np.asarray(q), np.asarray(r)
+    eps = np.finfo(np.float64).eps
+    assert np.linalg.norm(q.T @ q - np.eye(24)) / (200 * eps) < 1e6  # cond² loss
+    np.testing.assert_allclose(q @ r, a, atol=1e-11)
+    assert np.allclose(r, np.triu(r))
+
+
+def test_gels_cholqr_and_auto():
+    rng = np.random.default_rng(8)
+    m, n = 300, 40
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal((m, 3))
+    want, *_ = np.linalg.lstsq(a, b, rcond=None)
+    x1 = np.asarray(gels_cholqr(st.Matrix.from_array(a, nb=16), jnp.asarray(b)))
+    np.testing.assert_allclose(x1, want, atol=1e-8)
+    x2 = np.asarray(gels(st.Matrix.from_array(a, nb=16), jnp.asarray(b)))
+    np.testing.assert_allclose(x2, want, atol=1e-8)
+    x3 = np.asarray(gels(st.Matrix.from_array(a, nb=16), jnp.asarray(b),
+                         {"method_gels": MethodGels.QR}))
+    np.testing.assert_allclose(x3, want, atol=1e-8)
