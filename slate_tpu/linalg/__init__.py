@@ -20,3 +20,10 @@ from .qr import (  # noqa: F401
     cholqr, gelqf, gels, gels_cholqr, gels_qr, geqrf, ungqr, unmlq, unmqr,
 )
 from .util import add, copy, scale, scale_row_col, set  # noqa: F401
+from .eig import (  # noqa: F401
+    he2hb, heev, heev_vals, hegst, hegv, hb2st, stedc, stemr, steqr, sterf,
+    syev, sygv, unmtr_he2hb, unmtr_hb2st,
+)
+from .svd import (  # noqa: F401
+    bdsqr, ge2tb, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd,
+)

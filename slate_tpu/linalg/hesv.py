@@ -1,0 +1,167 @@
+"""Hermitian-indefinite solvers: hetrf / hetrs / hesv (+ sy aliases) —
+reference ``src/hetrf.cc`` (625 LoC), ``src/hetrs.cc``, ``src/hesv.cc``:
+Aasen-style L·T·Lᴴ factorization with a banded T and a band solve.
+
+TPU-native design stance: the reference's blocked Aasen builds a
+bandwidth-nb T and solves it with ``gbtrf/gbtrs``; pivoting makes the
+panel control-flow heavy.  Here the factorization is a **pivoted
+Parlett–Reid congruence** — the same L·T·Lᴴ decomposition family with T
+*tridiagonal* — expressed as one ``lax.fori_loop`` of two-sided
+elementary eliminations (two masked rank-1 updates per step: outer
+products the MXU executes directly, with `lax`-traced dynamic pivot
+swaps).  The whole factorization jits as a single static-shape loop —
+the XLA-friendly replacement for the reference's panel/update task DAG.
+
+Solves then run L (unit lower, implicit), T (tridiagonal), Lᴴ — with the
+same pivot sequence applied/unapplied, mirroring ``hetrs``'s
+permute → trsm → band-solve → trsm → permute chain.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+import numpy as np
+
+from ..enums import Uplo
+from ..matrix import BaseTrapezoidMatrix, as_array
+from ..options import Options
+from ..ops.blocks import _ct, matmul
+from ..ops.tile_ops import hermitize
+from .blas3 import _wrap_like
+
+
+class HetrfFactors(NamedTuple):
+    """A = P·L·T·Lᴴ·Pᴴ-style factorization record (pivots interleaved
+    with the eliminations as in LAPACK ``sytrf_aa``): ``l`` holds the
+    multiplier columns (unit diagonal implicit, column 0 = e₀), ``d``/
+    ``e`` the real/complex tridiagonal of T, ``ipiv`` the pivot row
+    chosen at each step."""
+
+    l: jnp.ndarray
+    d: jnp.ndarray
+    e: jnp.ndarray
+    ipiv: jnp.ndarray
+
+
+def _hermitian_full(a):
+    if isinstance(a, BaseTrapezoidMatrix):
+        return hermitize(a.logical_uplo, a.array)
+    return as_array(a)
+
+
+def hetrf(a, opts: Optional[Options] = None) -> HetrfFactors:
+    """Factor a Hermitian (possibly indefinite) matrix A = L·T·Lᴴ with
+    unit-lower L and tridiagonal T, with symmetric partial pivoting —
+    reference ``slate::hetrf`` (``src/hetrf.cc``; Aasen LTLᵀ).
+
+    Step j eliminates column j below the first subdiagonal: pivot the
+    largest |A(i,j)|, i>j, into row j+1 (two-sided swap), then apply the
+    elementary congruence E·A·Eᴴ, E = I − l·e_{j+1}ᵀ.
+    """
+
+    av = _hermitian_full(a)
+    n = av.shape[-1]
+    dt = av.dtype
+    rows = jnp.arange(n)
+
+    def swap2(x, i, p, axis):
+        xi = jnp.take(x, i, axis=axis)
+        xp = jnp.take(x, p, axis=axis)
+        if axis == 0:
+            return x.at[i].set(xp).at[p].set(xi)
+        return x.at[:, i].set(xp).at[:, p].set(xi)
+
+    def body(j, carry):
+        a, l, ipiv = carry
+        # pivot: argmax |a[i, j]| over i >= j+1
+        col = jnp.where(rows >= j + 1, jnp.abs(a[:, j]), -1.0)
+        p = jnp.argmax(col)
+        a = swap2(swap2(a, j + 1, p, 0), j + 1, p, 1)
+        l = swap2(l, j + 1, p, 0)
+        alpha = a[j + 1, j]
+        safe = jnp.where(alpha == 0, 1, alpha)
+        lcol = jnp.where(rows >= j + 2, a[:, j] / safe, 0).astype(dt)
+        pivot_row = a[j + 1, :]
+        a = a - lcol[:, None] * pivot_row[None, :]
+        a = a - a[:, j + 1][:, None] * jnp.conj(lcol)[None, :]
+        l = l.at[:, j + 1].add(lcol)
+        return a, l, ipiv.at[j].set(p)
+
+    l0 = jnp.zeros((n, n), dt)
+    ipiv0 = jnp.zeros((n,), jnp.int32)
+    if n > 2:
+        av, l0, ipiv0 = lax.fori_loop(0, n - 2, body, (av, l0, ipiv0))
+    d = jnp.real(jnp.diagonal(av)) if jnp.iscomplexobj(av) \
+        else jnp.diagonal(av)
+    e = jnp.diagonal(av, -1)
+    return HetrfFactors(l=l0, d=d, e=e, ipiv=ipiv0)
+
+
+def _tridiag_dense(d, e, dt):
+    n = d.shape[0]
+    t = jnp.zeros((n, n), dt)
+    t = t + jnp.diag(d.astype(dt))
+    if n > 1:
+        t = t + jnp.diag(e, -1) + jnp.diag(jnp.conj(e), 1)
+    return t
+
+
+def hetrs(factors: HetrfFactors, b, opts: Optional[Options] = None):
+    """Solve with the :func:`hetrf` factorization — reference
+    ``slate::hetrs`` (``src/hetrs.cc``): pivots → L → T (tridiagonal
+    solve) → Lᴴ → pivots back."""
+
+    bv = as_array(b)
+    squeeze = bv.ndim == 1
+    if squeeze:
+        bv = bv[:, None]
+    l, d, e, ipiv = factors
+    n = l.shape[0]
+    dt = l.dtype
+    bv = bv.astype(dt)
+
+    def fwd(j, z):
+        p = ipiv[j]
+        zi = z[j + 1]
+        z = z.at[j + 1].set(z[p]).at[p].set(zi)
+        return z - l[:, j + 1][:, None] * z[j + 1][None, :]
+
+    if n > 2:
+        bv = lax.fori_loop(0, n - 2, fwd, bv)
+    # tridiagonal solve (dense LU with pivoting; T is n×n tridiag —
+    # the reference's band gbtrf/gbtrs; dense is the robust first cut)
+    t = _tridiag_dense(d, e, dt)
+    w = jnp.linalg.solve(t, bv)
+
+    def bwd(idx, z):
+        j = n - 3 - idx
+        # Eᴴ·z: z[j+1] −= l(:,j+1)ᴴ·z (multipliers live in rows ≥ j+2)
+        corr = jnp.sum(jnp.conj(l[:, j + 1])[:, None] * z, axis=0)
+        z = z.at[j + 1].add(-corr)
+        p = ipiv[j]
+        zi = z[j + 1]
+        return z.at[j + 1].set(z[p]).at[p].set(zi)
+
+    if n > 2:
+        w = lax.fori_loop(0, n - 2, bwd, w)
+    if squeeze:
+        w = w[:, 0]
+    return _wrap_like(b, w)
+
+
+def hesv(a, b, opts: Optional[Options] = None):
+    """Factor + solve — reference ``slate::hesv`` (``src/hesv.cc``).
+    Returns ``(factors, x)``."""
+
+    f = hetrf(a, opts)
+    return f, hetrs(f, b, opts)
+
+
+# real-symmetric aliases (reference ``slate::sytrf/sytrs/sysv``)
+sytrf = hetrf
+sytrs = hetrs
+sysv = hesv

@@ -1,0 +1,321 @@
+"""SVD family — the reference's two-stage chain ``src/svd.cc:207-372``:
+
+``ge2tb`` (dense→triangular-band, ``src/ge2tb.cc`` 589 LoC) → ``tb2bd``
+(band→bidiagonal bulge chasing, ``src/tb2bd.cc`` 421 LoC) → LAPACK
+``bdsqr`` on rank 0 → back-transforms ``unmbr_tb2bd`` / ``unmbr_ge2tb``.
+
+TPU-first stance mirrors :mod:`slate_tpu.linalg.eig`: stage 1 carries the
+O(mn²) flops as compact-WY panel QRs/LQs + whole-trailing-matrix GEMMs on
+the MXU; stage 2 is O(n²·nb), sequential, and runs on host exactly where
+the reference gathers to a single node; the bidiagonal core uses host
+LAPACK (the reference calls ``lapack::bdsqr`` on rank 0,
+``src/svd.cc:300+``); back-transforms are MXU matmul chains again.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..enums import MethodSVD, Op, Side
+from ..exceptions import SlateError
+from ..matrix import as_array
+from ..options import Options, get_option
+from ..ops.blocks import _ct, matmul
+from .blas3 import _nb
+from .eig import _givens, sterf
+from .qr import _unit_lower, geqrf_rec, larft_rec
+
+
+class Ge2tbFactors(NamedTuple):
+    """Stage-1 output: A = Q₁·B·P₁ᴴ with B upper-triangular band of
+    superdiagonal width ``kd``; ``qpanels``/``ppanels`` hold the
+    ``(offset, V, T)`` block reflectors of Q₁ (row space) and P₁
+    (column space) — reference ``src/ge2tb.cc`` stores the same U/V
+    factor matrices."""
+
+    band: jnp.ndarray
+    kd: int
+    qpanels: Tuple[Tuple[int, jnp.ndarray, jnp.ndarray], ...]
+    ppanels: Tuple[Tuple[int, jnp.ndarray, jnp.ndarray], ...]
+
+
+def ge2tb(a, opts: Optional[Options] = None) -> Ge2tbFactors:
+    """Reduce a general m×n (m ≥ n) matrix to upper-triangular band form
+    — reference ``slate::ge2tb`` (``src/ge2tb.cc``).
+
+    Per panel k: QR of the block column from the diagonal down (kills
+    below-diagonal), apply Q̂ᴴ to the trailing columns; then LQ of the
+    block row right of the band (kills right of the band), apply P̂ from
+    the right — each application two large GEMMs (the reference's
+    ``internal::unmqr/unmlq`` tile batches).
+    """
+
+    av = as_array(a)
+    m, n = av.shape
+    if m < n:
+        raise SlateError("ge2tb requires m >= n (drivers transpose)")
+    nb = _nb(a, opts)
+    qpanels: List[Tuple[int, jnp.ndarray, jnp.ndarray]] = []
+    ppanels: List[Tuple[int, jnp.ndarray, jnp.ndarray]] = []
+    for j0 in range(0, n, nb):
+        w = min(nb, n - j0)
+        # QR panel on rows j0.. of block column j0:j0+w
+        if m - j0 > 1:
+            p = av[j0:, j0:j0 + w]
+            f, tau = geqrf_rec(p, nb)
+            k = min(p.shape[0], w)
+            v = _unit_lower(f, k)
+            t = larft_rec(v, tau)
+            r_part = jnp.triu(f[:w]) if f.shape[0] >= w else jnp.triu(f)
+            zeros = jnp.zeros((p.shape[0] - r_part.shape[0], w), av.dtype)
+            av = av.at[j0:, j0:j0 + w].set(
+                jnp.concatenate([r_part, zeros], axis=0))
+            if j0 + w < n:
+                c = av[j0:, j0 + w:]
+                c = c - matmul(v, matmul(_ct(t), matmul(_ct(v), c)))
+                av = av.at[j0:, j0 + w:].set(c)
+            qpanels.append((j0, v, t))
+        # LQ panel on the block row, columns right of the band
+        c0 = j0 + nb
+        if c0 < n and n - c0 > 1:
+            wr = min(w, n - j0)
+            row = av[j0:j0 + wr, c0:]
+            # LQ(row) = (QR(rowᴴ))ᴴ
+            f, tau = geqrf_rec(_ct(row), nb)
+            k = min(f.shape[0], f.shape[1])
+            v = _unit_lower(f, k)
+            t = larft_rec(v, tau)
+            l_part = _ct(jnp.triu(f[:wr]) if f.shape[0] >= wr else jnp.triu(f))
+            zeros = jnp.zeros((wr, row.shape[1] - l_part.shape[1]), av.dtype)
+            av = av.at[j0:j0 + wr, c0:].set(
+                jnp.concatenate([l_part, zeros], axis=1))
+            # apply P̂ = I − V·T·Vᴴ from the right to the trailing rows
+            if j0 + wr < m:
+                c = av[j0 + wr:, c0:]
+                c = c - matmul(matmul(matmul(c, v), t), _ct(v))
+                av = av.at[j0 + wr:, c0:].set(c)
+            ppanels.append((c0, v, t))
+    # clamp to the upper band
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    band = jnp.where((j - i >= 0) & (j - i <= nb), av, 0)
+    return Ge2tbFactors(band=band, kd=nb, qpanels=tuple(qpanels),
+                        ppanels=tuple(ppanels))
+
+
+def unmbr_ge2tb(side: Side, op: Op, factors: Ge2tbFactors, c):
+    """Apply Q₁ (side=Left) or P₁ (side=Right, applied as P₁·C to row
+    space of C) from :func:`ge2tb` — reference ``slate::unmbr_ge2tb``
+    (``src/unmbr_ge2tb.cc``).
+
+    ``side`` selects which factor; ``op`` NoTrans applies Q₁ (P₁),
+    ConjTrans applies the adjoint.  C is multiplied from the left.
+    """
+
+    cv = as_array(c)
+    panels = factors.qpanels if side is Side.Left else factors.ppanels
+    seq = panels if op is not Op.NoTrans else panels[::-1]
+    for off, v, t in seq:
+        tt = _ct(t) if op is not Op.NoTrans else t
+        tail = cv[off:]
+        tail = tail - matmul(v, matmul(tt, matmul(_ct(v), tail)))
+        cv = jnp.concatenate([cv[:off], tail], axis=0)
+    return cv
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: triangular band → bidiagonal (host, Givens bulge chasing)
+# ---------------------------------------------------------------------------
+
+class Tb2bdRotations(NamedTuple):
+    """Rotation logs of :func:`tb2bd`: B = U₂·B_bd·V₂ᴴ with
+    U₂ = L₁ᴴ⋯L_qᴴ·diag(uphase), V₂ = M₁⋯M_p·diag(vphase)."""
+
+    lplanes: np.ndarray
+    lcs: np.ndarray
+    lss: np.ndarray
+    rplanes: np.ndarray
+    rcs: np.ndarray
+    rss: np.ndarray
+    uphase: np.ndarray
+    vphase: np.ndarray
+
+
+def tb2bd(band, kd: int) -> Tuple[np.ndarray, np.ndarray, Tb2bdRotations]:
+    """Reduce an upper-triangular band matrix (superdiagonal width ``kd``)
+    to real upper bidiagonal — reference ``slate::tb2bd``
+    (``src/tb2bd.cc``; the bulge-chasing sweeps of ``gebr1/2/3``,
+    ``internal_gebr.cc``, run in their sequential schedule on host).
+
+    Returns ``(d, e, rotations)`` with B = U₂·bidiag(d, e)·V₂ᴴ.
+    """
+
+    b = np.array(band)
+    n = b.shape[1]
+    b = b[:n, :n].copy()
+    ll: List[Tuple[int, float, complex]] = []
+    rl: List[Tuple[int, float, complex]] = []
+    for bw in range(kd, 1, -1):
+        for j in range(0, n - bw):
+            row, p = j, j + bw - 1
+            while True:
+                # right rotation on columns (p, p+1) kills B[row, p+1]
+                f, g = b[row, p], b[row, p + 1]
+                c, s = _givens(f, g)
+                gt = np.array([[c, s], [-np.conj(s), c]]).T
+                lo = max(0, p - bw - 1)
+                hi = min(n, p + 2)
+                b[lo:hi, [p, p + 1]] = b[lo:hi, [p, p + 1]] @ gt
+                rl.append((p + 1, c, s))
+                # bulge now at (p+1, p): kill with left rotation rows (p, p+1)
+                f, g = b[p, p], b[p + 1, p]
+                c, s = _givens(f, g)
+                gm = np.array([[c, s], [-np.conj(s), c]])
+                lo = max(0, p - 1)
+                hi = min(n, p + bw + 2)
+                b[[p, p + 1], lo:hi] = gm @ b[[p, p + 1], lo:hi]
+                ll.append((p + 1, c, s))
+                # bulge now at (p, p+1+bw) if inside
+                if p + 1 + bw >= n:
+                    break
+                row, p = p, p + bw
+    d_c = np.diagonal(b).copy()
+    e_c = np.diagonal(b, 1).copy()
+    uphase = np.ones((n,), dtype=b.dtype)
+    vphase = np.ones((n,), dtype=b.dtype)
+    if np.iscomplexobj(b):
+        for j in range(n):
+            val = d_c[j] * vphase[j]
+            absv = abs(val)
+            uphase[j] = val / absv if absv != 0 else 1.0
+            d_c[j] = absv
+            if j < n - 1:
+                val = np.conj(uphase[j]) * e_c[j]
+                absv = abs(val)
+                vphase[j + 1] = np.conj(val) / absv if absv != 0 else 1.0
+                e_c[j] = absv
+    d = np.real(d_c)
+    e = np.real(e_c)
+    rots = Tb2bdRotations(
+        lplanes=np.asarray([x[0] for x in ll], dtype=np.int32),
+        lcs=np.asarray([x[1] for x in ll], dtype=np.float64),
+        lss=np.asarray([x[2] for x in ll]),
+        rplanes=np.asarray([x[0] for x in rl], dtype=np.int32),
+        rcs=np.asarray([x[1] for x in rl], dtype=np.float64),
+        rss=np.asarray([x[2] for x in rl]),
+        uphase=uphase, vphase=vphase,
+    )
+    return d, e, rots
+
+
+def unmbr_tb2bd(side: Side, rots: Tb2bdRotations, z: np.ndarray) -> np.ndarray:
+    """Back-transform through the tb2bd chase — reference
+    ``slate::unmbr_tb2bd`` (``src/unmbr_tb2bd.cc``): Z ← U₂·Z
+    (side=Left) or Z ← V₂·Z (side=Right)."""
+
+    z = np.asarray(z)
+    if side is Side.Left:
+        phase, planes, cs, ss = rots.uphase, rots.lplanes, rots.lcs, rots.lss
+    else:
+        phase, planes, cs, ss = rots.vphase, rots.rplanes, rots.rcs, rots.rss
+    if np.iscomplexobj(phase):
+        z = z.astype(phase.dtype)
+    z = phase[:z.shape[0], None] * z
+    for idx in range(len(planes) - 1, -1, -1):
+        i = int(planes[idx])
+        c, s = cs[idx], ss[idx]
+        if side is Side.Left:
+            # L = [[c, s], [−s̄, c]] on rows; apply Lᴴ (reverse order)
+            m2 = np.array([[c, -s], [np.conj(s), c]])
+        else:
+            # M = Gᵀ = [[c, −s̄], [s, c]] on the plane; apply M itself
+            m2 = np.array([[c, -np.conj(s)], [s, c]])
+        z[[i - 1, i], :] = m2 @ z[[i - 1, i], :]
+    return z
+
+
+# ---------------------------------------------------------------------------
+# Bidiagonal core (host LAPACK, like the reference's rank-0 bdsqr)
+# ---------------------------------------------------------------------------
+
+def bdsqr(d, e, want_uv: bool = False, method: MethodSVD = MethodSVD.Auto):
+    """Singular values (and vectors) of a real upper bidiagonal matrix —
+    the reference calls LAPACK ``bdsqr`` on rank 0 (``src/svd.cc:300+``).
+
+    Values-only uses the Golub–Kahan tridiagonal (zero diagonal,
+    interleaved (d₁,e₁,d₂,…) off-diagonal; eigenvalues ±σ) with LAPACK
+    ``sterf``; vectors use the dense bidiagonal via LAPACK gesdd/gesvd
+    (D&C / QR per ``MethodSVD``).
+    """
+
+    d = np.asarray(d, dtype=np.float64)
+    e = np.asarray(e, dtype=np.float64)
+    n = d.shape[0]
+    if not want_uv:
+        if n == 0:
+            return d
+        gk_off = np.zeros((2 * n - 1,))
+        gk_off[0::2] = d
+        if n > 1:
+            gk_off[1::2] = e
+        w = sterf(np.zeros((2 * n,)), gk_off)
+        return np.sort(w[n:])[::-1]
+    b = np.diag(d) + (np.diag(e, 1) if n > 1 else 0)
+    if method is MethodSVD.QR:
+        import scipy.linalg as sla
+        u, s, vh = sla.svd(b, lapack_driver="gesvd")
+    else:
+        u, s, vh = np.linalg.svd(b)
+    return u, s, vh
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+def svd_vals(a, opts: Optional[Options] = None):
+    """Singular values — reference ``slate::svd_vals`` (``src/svd.cc``)."""
+    return svd(a, jobu=False, jobvt=False, opts=opts)[0]
+
+
+def svd(a, jobu: bool = True, jobvt: bool = True,
+        opts: Optional[Options] = None):
+    """Two-stage SVD — reference ``slate::svd`` (``src/svd.cc:207-372``).
+
+    Returns ``(sigma, U, Vᴴ)`` (economy: U is m×k, Vᴴ is k×n with
+    k = min(m, n)); U/Vᴴ are None when not requested.
+    """
+
+    av = as_array(a)
+    m, n = av.shape
+    if m < n:
+        # work on Aᴴ = V·Σ·Uᴴ and swap — reference ``src/svd.cc:207``
+        s, u, vh = svd(_ct(av), jobu=jobvt, jobvt=jobu, opts=opts)
+        return s, (None if vh is None else _ct(vh)), \
+            (None if u is None else _ct(u))
+    factors = ge2tb(a, opts)
+    band_np = np.asarray(factors.band)
+    d, e, rots = tb2bd(band_np, factors.kd)
+    want_uv = jobu or jobvt
+    if not want_uv:
+        return jnp.asarray(bdsqr(d, e).copy()), None, None
+    method = get_option(opts, "method_svd", MethodSVD.Auto)
+    u_b, s, vh_b = bdsqr(d, e, want_uv=True, method=method)
+    dtype = factors.band.dtype
+    u = vh = None
+    if jobu:
+        u2 = unmbr_tb2bd(Side.Left, rots, u_b)
+        if m > n:
+            u2 = np.concatenate(
+                [u2, np.zeros((m - n, n), dtype=u2.dtype)], axis=0)
+        u = unmbr_ge2tb(Side.Left, Op.NoTrans, factors,
+                        jnp.asarray(u2, dtype=dtype))
+    if jobvt:
+        v2 = unmbr_tb2bd(Side.Right, rots, _ct(vh_b))
+        v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
+                        jnp.asarray(v2, dtype=dtype))
+        vh = _ct(v)
+    return jnp.asarray(s), u, vh
