@@ -28,5 +28,6 @@ from .matrix import (  # noqa: F401
 from .options import Options, get_option  # noqa: F401
 from . import method  # noqa: F401
 from .linalg import *  # noqa: F401,F403
+from .printing import print_matrix, redistribute, sprint_matrix  # noqa: F401
 
 __version__ = "0.1.0"

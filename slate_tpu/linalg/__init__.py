@@ -27,3 +27,8 @@ from .eig import (  # noqa: F401
 from .svd import (  # noqa: F401
     bdsqr, ge2tb, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd,
 )
+from .hesv import hesv, hetrf, hetrs, sysv, sytrf, sytrs  # noqa: F401
+from .band import (  # noqa: F401
+    gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs, tbsm,
+)
+from .condest import gecondest, norm1est, pocondest, trcondest  # noqa: F401

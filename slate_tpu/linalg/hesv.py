@@ -115,6 +115,9 @@ def hetrs(factors: HetrfFactors, b, opts: Optional[Options] = None):
     ``slate::hetrs`` (``src/hetrs.cc``): pivots → L → T (tridiagonal
     solve) → Lᴴ → pivots back."""
 
+    from ..enums import Diag, Side
+    from ..ops import blocks
+
     bv = as_array(b)
     squeeze = bv.ndim == 1
     if squeeze:
@@ -124,33 +127,35 @@ def hetrs(factors: HetrfFactors, b, opts: Optional[Options] = None):
     dt = l.dtype
     bv = bv.astype(dt)
 
-    def fwd(j, z):
+    # row-swapped multiplier storage ⇒ P·A·Pᴴ = L·T·Lᴴ (same argument as
+    # LU's interleaved-pivot identity; swaps at step j only touch rows
+    # ≥ j+2, commuting past e_{j+1})
+    def fwd_swap(j, z):
         p = ipiv[j]
         zi = z[j + 1]
-        z = z.at[j + 1].set(z[p]).at[p].set(zi)
-        return z - l[:, j + 1][:, None] * z[j + 1][None, :]
+        return z.at[j + 1].set(z[p]).at[p].set(zi)
 
-    if n > 2:
-        bv = lax.fori_loop(0, n - 2, fwd, bv)
-    # tridiagonal solve (dense LU with pivoting; T is n×n tridiag —
-    # the reference's band gbtrf/gbtrs; dense is the robust first cut)
-    t = _tridiag_dense(d, e, dt)
-    w = jnp.linalg.solve(t, bv)
-
-    def bwd(idx, z):
+    def bwd_swap(idx, z):
         j = n - 3 - idx
-        # Eᴴ·z: z[j+1] −= l(:,j+1)ᴴ·z (multipliers live in rows ≥ j+2)
-        corr = jnp.sum(jnp.conj(l[:, j + 1])[:, None] * z, axis=0)
-        z = z.at[j + 1].add(-corr)
         p = ipiv[j]
         zi = z[j + 1]
         return z.at[j + 1].set(z[p]).at[p].set(zi)
 
     if n > 2:
-        w = lax.fori_loop(0, n - 2, bwd, w)
+        bv = lax.fori_loop(0, n - 2, fwd_swap, bv)
+    lfull = l + jnp.eye(n, dtype=dt)
+    nb = max(32, n // 8)
+    y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.Unit, lfull, bv, nb)
+    # tridiagonal solve (dense LU with pivoting; T is n×n tridiag —
+    # the reference's band gbtrf/gbtrs; dense is the robust first cut)
+    t = _tridiag_dense(d, e, dt)
+    w = jnp.linalg.solve(t, y)
+    v = blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.Unit, _ct(lfull), w, nb)
+    if n > 2:
+        v = lax.fori_loop(0, n - 2, bwd_swap, v)
     if squeeze:
-        w = w[:, 0]
-    return _wrap_like(b, w)
+        v = v[:, 0]
+    return _wrap_like(b, v)
 
 
 def hesv(a, b, opts: Optional[Options] = None):
