@@ -27,4 +27,5 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def mesh8():
     """2×4 mesh over the 8 virtual CPU devices, axes ('p','q')."""
-    return jax.make_mesh((2, 4), ("p", "q"))
+    from slate_tpu.parallel.mesh import make_grid_mesh
+    return make_grid_mesh(2, 4)
