@@ -1,0 +1,7 @@
+"""API compatibility layers — reference §2.7: simplified verb-named API
+(``include/slate/simplified_api.hh``), LAPACK-style API (``lapack_api/``),
+ScaLAPACK-style API (``scalapack_api/``), C API (``include/slate/c_api/``).
+"""
+
+from . import simplified  # noqa: F401
+from .simplified import *  # noqa: F401,F403

@@ -1,0 +1,161 @@
+"""Simplified verb-named API — reference
+``include/slate/simplified_api.hh`` (838 LoC): ``multiply``,
+``triangular_solve``, ``lu_solve``, ``chol_solve``,
+``least_squares_solve``, ``eig_vals``, ``svd_vals``, … forwarding to the
+BLAS-named drivers (``simplified_api.hh:19,110,133,230``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..enums import Diag, Norm, Op, Side, Uplo
+from ..options import Options
+from .. import linalg as L
+
+__all__ = [
+    "multiply", "triangular_multiply", "triangular_solve",
+    "rank_k_update", "rank_2k_update", "band_multiply",
+    "lu_factor", "lu_solve", "lu_solve_using_factor",
+    "lu_inverse_using_factor",
+    "chol_factor", "chol_solve", "chol_solve_using_factor",
+    "chol_inverse_using_factor",
+    "indefinite_factor", "indefinite_solve",
+    "least_squares_solve", "qr_factor", "lq_factor",
+    "qr_multiply_by_q", "lq_multiply_by_q",
+    "eig", "eig_vals", "svd", "svd_vals", "norm",
+]
+
+
+# -- Level 3 BLAS ----------------------------------------------------------
+
+def multiply(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """C ← α·A·B + β·C — ``simplified_api.hh:19`` → gemm (hemm/symm when
+    A is Hermitian/symmetric is dispatched by the driver's types)."""
+    return L.gemm(alpha, a, b, beta, c, opts)
+
+
+def triangular_multiply(alpha, a, b, side: Side = Side.Left,
+                        opts: Optional[Options] = None):
+    """B ← α·op(T)·B — → trmm."""
+    return L.trmm(side, alpha, a, b, opts)
+
+
+def triangular_solve(alpha, a, b, side: Side = Side.Left,
+                     opts: Optional[Options] = None):
+    """Solve op(T)·X = α·B — ``simplified_api.hh:110`` → trsm."""
+    return L.trsm(side, alpha, a, b, opts)
+
+
+def rank_k_update(alpha, a, beta, c, opts: Optional[Options] = None):
+    """C ← α·A·Aᴴ + β·C — → herk."""
+    return L.herk(alpha, a, beta, c, opts)
+
+
+def rank_2k_update(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """C ← α·A·Bᴴ + ᾱ·B·Aᴴ + β·C — → her2k."""
+    return L.her2k(alpha, a, b, beta, c, opts)
+
+
+def band_multiply(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """C ← α·A_band·B + β·C — → gbmm."""
+    return L.gbmm(alpha, a, b, beta, c, opts)
+
+
+# -- LU --------------------------------------------------------------------
+
+def lu_factor(a, opts: Optional[Options] = None):
+    """``simplified_api.hh`` lu_factor → getrf; returns (LU, pivots)."""
+    return L.getrf(a, opts)
+
+
+def lu_solve(a, b, opts: Optional[Options] = None):
+    """Solve A·X = B — ``simplified_api.hh:230`` → gesv; returns X."""
+    return L.gesv(a, b, opts)[2]
+
+
+def lu_solve_using_factor(lu, pivots, b, opts: Optional[Options] = None):
+    return L.getrs(lu, pivots, b, opts=opts)
+
+
+def lu_inverse_using_factor(lu, pivots, opts: Optional[Options] = None):
+    return L.getri(lu, pivots, opts)
+
+
+# -- Cholesky --------------------------------------------------------------
+
+def chol_factor(a, opts: Optional[Options] = None):
+    return L.potrf(a, opts)
+
+
+def chol_solve(a, b, opts: Optional[Options] = None):
+    """Solve SPD/HPD A·X = B — → posv; returns X."""
+    return L.posv(a, b, opts)[1]
+
+
+def chol_solve_using_factor(factor, b, opts: Optional[Options] = None):
+    return L.potrs(factor, b, opts)
+
+
+def chol_inverse_using_factor(factor, opts: Optional[Options] = None):
+    return L.potri(factor, opts)
+
+
+# -- Hermitian indefinite --------------------------------------------------
+
+def indefinite_factor(a, opts: Optional[Options] = None):
+    return L.hetrf(a, opts)
+
+
+def indefinite_solve(a, b, opts: Optional[Options] = None):
+    """Solve Hermitian-indefinite A·X = B — → hesv; returns X."""
+    return L.hesv(a, b, opts)[1]
+
+
+# -- Least squares / QR / LQ ----------------------------------------------
+
+def least_squares_solve(a, b, opts: Optional[Options] = None):
+    """min ‖A·X − B‖₂ — → gels."""
+    return L.gels(a, b, opts)
+
+
+def qr_factor(a, opts: Optional[Options] = None):
+    return L.geqrf(a, opts)
+
+
+def qr_multiply_by_q(side: Side, op: Op, factor, taus, c,
+                     opts: Optional[Options] = None):
+    return L.unmqr(side, op, factor, taus, c, opts)
+
+
+def lq_factor(a, opts: Optional[Options] = None):
+    return L.gelqf(a, opts)
+
+
+def lq_multiply_by_q(side: Side, op: Op, factor, taus, c,
+                     opts: Optional[Options] = None):
+    return L.unmlq(side, op, factor, taus, c, opts)
+
+
+# -- Eigen / SVD / norms ---------------------------------------------------
+
+def eig(a, opts: Optional[Options] = None):
+    """Hermitian eigendecomposition — returns (w, Z)."""
+    return L.heev(a, True, opts)
+
+
+def eig_vals(a, opts: Optional[Options] = None):
+    """``simplified_api.hh`` eig_vals → heev(values-only)."""
+    return L.heev(a, False, opts)[0]
+
+
+def svd(a, opts: Optional[Options] = None):
+    return L.svd(a, opts=opts)
+
+
+def svd_vals(a, opts: Optional[Options] = None):
+    return L.svd_vals(a, opts)
+
+
+def norm(norm_type: Norm, a, opts: Optional[Options] = None):
+    return L.norm(norm_type, a, opts)

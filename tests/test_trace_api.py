@@ -1,0 +1,104 @@
+"""Trace subsystem + simplified-API tests — mirroring the reference's
+``trace::Block``/SVG contract (``Trace.hh:24-108``, ``Trace.cc:330-448``)
+and ``simplified_api.hh`` forwarding."""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as st
+from slate_tpu import trace
+from slate_tpu.api import simplified as simp
+from slate_tpu.enums import Norm, Op, Side
+
+
+def test_trace_block_and_svg(tmp_path):
+    trace.clear()
+    trace.on()
+    with trace.Block("gemm"):
+        pass
+    with trace.Block("potrf", lane="device0"):
+        with trace.Block("panel"):
+            pass
+    trace.off()
+    evts = trace.events()
+    assert [e.name for e in evts] == ["gemm", "panel", "potrf"]
+    path = str(tmp_path / "trace.svg")
+    out = trace.finish(path)
+    assert out == path and os.path.exists(path)
+    svg = open(path).read()
+    assert svg.startswith("<svg") and "potrf" in svg and "device0" in svg
+    assert trace.events() == []          # finish resets
+
+
+def test_trace_off_records_nothing():
+    trace.clear()
+    trace.off()
+    with trace.Block("hidden"):
+        pass
+    assert trace.events() == []
+    assert trace.finish() is None
+
+
+def test_trace_decorator():
+    trace.clear()
+    trace.on()
+
+    @trace.Block("decorated")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    trace.off()
+    assert trace.events()[0].name == "decorated"
+
+
+def test_simplified_multiply_and_solves():
+    rng = np.random.default_rng(0)
+    n = 24
+    a = rng.standard_normal((n, n))
+    b = rng.standard_normal((n, 2))
+    c = rng.standard_normal((n, 2))
+    out = simp.multiply(2.0, jnp.asarray(a), jnp.asarray(b), 0.0,
+                        jnp.asarray(c))
+    assert np.abs(np.asarray(out) - 2 * a @ b).max() < 1e-12
+
+    x = simp.lu_solve(jnp.asarray(a + n * np.eye(n)), jnp.asarray(b))
+    assert np.abs((a + n * np.eye(n)) @ np.asarray(x) - b).max() < 1e-10
+
+    spd = a @ a.T + n * np.eye(n)
+    x = simp.chol_solve(jnp.asarray(spd), jnp.asarray(b))
+    assert np.abs(spd @ np.asarray(x) - b).max() < 1e-10
+
+    sym = (a + a.T) / 2
+    x = simp.indefinite_solve(jnp.asarray(sym), jnp.asarray(b))
+    assert np.abs(sym @ np.asarray(x) - b).max() < 1e-9
+
+
+def test_simplified_factor_roundtrips():
+    rng = np.random.default_rng(1)
+    n = 20
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    lu, piv = simp.lu_factor(jnp.asarray(a))
+    inv = simp.lu_inverse_using_factor(lu, piv)
+    assert np.abs(np.asarray(inv) @ a - np.eye(n)).max() < 1e-10
+
+    f, taus = simp.qr_factor(jnp.asarray(a))
+    q = st.ungqr(f, taus)
+    r = np.triu(np.asarray(f if not hasattr(f, "data") else f.data))
+    assert np.abs(np.asarray(q) @ r - a).max() < 1e-10
+
+
+def test_simplified_eig_svd():
+    rng = np.random.default_rng(2)
+    n = 24
+    a = rng.standard_normal((n, n))
+    sym = (a + a.T) / 2
+    w = simp.eig_vals(jnp.asarray(sym), {"block_size": 8})
+    assert np.abs(np.sort(np.asarray(w)) - np.linalg.eigvalsh(sym)).max() < 1e-10
+    s = simp.svd_vals(jnp.asarray(a), {"block_size": 8})
+    assert np.abs(np.asarray(s) - np.linalg.svd(a, compute_uv=False)).max() < 1e-10
+    nrm = simp.norm(Norm.Fro, jnp.asarray(a))
+    assert abs(float(nrm) - np.linalg.norm(a)) < 1e-10
