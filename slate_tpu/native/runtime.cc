@@ -1,0 +1,298 @@
+// slate_tpu native host runtime.
+//
+// TPU-native re-implementation of the reference's native host-side
+// components:
+//   * pooled fixed-block memory allocator       (include/slate/internal/Memory.hh,
+//                                                src/core/Memory.cc)
+//   * ScaLAPACK block-cyclic pack/unpack        (scalapack_api/ data marshaling,
+//                                                Matrix::fromScaLAPACK, Matrix.hh:344)
+//   * batched tile layout transpose             (Tile::layoutConvert, Tile.hh:707-857,
+//                                                src/cuda/device_transpose.cu)
+//   * OpenMP task-DAG tiled executors           (Target::HostTask drivers:
+//                                                src/potrf.cc:54-133 panel/lookahead
+//                                                task graph; internal_gemm.cc HostTask)
+//
+// The device compute path is JAX/XLA/Pallas; this library is the *runtime
+// around it*: host staging, layout conversion, compat-API marshaling, and a
+// host fallback executor, exactly the roles the reference implements in
+// C++.  C ABI only — bound from Python with ctypes (no pybind11 in the
+// image).
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC runtime.cc
+//        -o _slate_host.so -lblas -llapack
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include <omp.h>
+
+// ---------------------------------------------------------------------------
+// Fortran BLAS/LAPACK (netlib reference, 32-bit ints)
+// ---------------------------------------------------------------------------
+extern "C" {
+void dgemm_(const char*, const char*, const int*, const int*, const int*,
+            const double*, const double*, const int*, const double*,
+            const int*, const double*, double*, const int*);
+void sgemm_(const char*, const char*, const int*, const int*, const int*,
+            const float*, const float*, const int*, const float*,
+            const int*, const float*, float*, const int*);
+void dtrsm_(const char*, const char*, const char*, const char*, const int*,
+            const int*, const double*, const double*, const int*, double*,
+            const int*);
+void strsm_(const char*, const char*, const char*, const char*, const int*,
+            const int*, const float*, const float*, const int*, float*,
+            const int*);
+void dsyrk_(const char*, const char*, const int*, const int*, const double*,
+            const double*, const int*, const double*, double*, const int*);
+void ssyrk_(const char*, const char*, const int*, const int*, const float*,
+            const float*, const int*, const float*, float*, const int*);
+void dpotrf_(const char*, const int*, double*, const int*, int*);
+void spotrf_(const char*, const int*, float*, const int*, int*);
+}
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Memory pool — reference Memory.hh:29-95 / Memory.cc: fixed-block-size
+// stacks of free blocks per pool, 64-byte aligned like pinned staging
+// buffers.
+// ---------------------------------------------------------------------------
+
+struct Pool {
+    size_t block_bytes;
+    std::vector<void*> free_blocks;
+    size_t allocated = 0;   // total blocks ever carved
+    std::mutex mtx;
+};
+
+void* slate_pool_create(size_t block_bytes) {
+    Pool* p = new Pool();
+    p->block_bytes = (block_bytes + 63) & ~size_t(63);
+    return p;
+}
+
+void* slate_pool_alloc(void* pool) {
+    Pool* p = static_cast<Pool*>(pool);
+    std::lock_guard<std::mutex> g(p->mtx);
+    if (!p->free_blocks.empty()) {
+        void* b = p->free_blocks.back();
+        p->free_blocks.pop_back();
+        return b;
+    }
+    ++p->allocated;
+    return std::aligned_alloc(64, p->block_bytes);
+}
+
+void slate_pool_free(void* pool, void* block) {
+    Pool* p = static_cast<Pool*>(pool);
+    std::lock_guard<std::mutex> g(p->mtx);
+    p->free_blocks.push_back(block);
+}
+
+// Reference Debug::printNumFreeMemBlocks (Debug.cc:304).
+size_t slate_pool_num_free(void* pool) {
+    Pool* p = static_cast<Pool*>(pool);
+    std::lock_guard<std::mutex> g(p->mtx);
+    return p->free_blocks.size();
+}
+
+size_t slate_pool_num_allocated(void* pool) {
+    Pool* p = static_cast<Pool*>(pool);
+    std::lock_guard<std::mutex> g(p->mtx);
+    return p->allocated;
+}
+
+void slate_pool_destroy(void* pool) {
+    Pool* p = static_cast<Pool*>(pool);
+    for (void* b : p->free_blocks) std::free(b);
+    // leaked (still-held) blocks are the caller's to free; the reference
+    // asserts on them in Debug::checkHostMemoryLeaks (Debug.cc:316).
+    delete p;
+}
+
+// ---------------------------------------------------------------------------
+// ScaLAPACK 2-D block-cyclic pack/unpack — the data marshaling the
+// reference's scalapack_api does via fromScaLAPACK views
+// (scalapack_api/scalapack_potrf.cc:27-80).  Column-major both sides.
+// Byte-generic: elem is the element size.
+// ---------------------------------------------------------------------------
+
+// local row count of rank r among p ranks, block size b (ScaLAPACK numroc).
+int64_t slate_numroc(int64_t n, int64_t b, int64_t r, int64_t p) {
+    int64_t nblocks = n / b;
+    int64_t nloc = (nblocks / p) * b;
+    int64_t extra = nblocks % p;
+    if (r < extra) nloc += b;
+    else if (r == extra) nloc += n % b;
+    return nloc;
+}
+
+// pack global (m,n) col-major lda into rank (pr,pc)'s local col-major ldl
+void slate_scalapack_pack(const char* a, int64_t m, int64_t n, int64_t lda,
+                          int64_t mb, int64_t nb, int64_t p, int64_t q,
+                          int64_t pr, int64_t pc, char* local, int64_t ldl,
+                          int64_t elem) {
+    int64_t njblk = (n + nb - 1) / nb;
+    #pragma omp parallel for schedule(static)
+    for (int64_t jblk = pc; jblk < njblk; jblk += q) {
+        int64_t j0 = jblk * nb;
+        int64_t jw = std::min(nb, n - j0);
+        int64_t jl0 = (jblk / q) * nb;
+        for (int64_t jj = 0; jj < jw; ++jj) {
+            const char* src_col = a + (j0 + jj) * lda * elem;
+            char* dst_col = local + (jl0 + jj) * ldl * elem;
+            for (int64_t iblk = pr; iblk < (m + mb - 1) / mb; iblk += p) {
+                int64_t i0 = iblk * mb;
+                int64_t iw = std::min(mb, m - i0);
+                int64_t il0 = (iblk / p) * mb;
+                std::memcpy(dst_col + il0 * elem, src_col + i0 * elem,
+                            size_t(iw) * elem);
+            }
+        }
+    }
+}
+
+// inverse of slate_scalapack_pack
+void slate_scalapack_unpack(char* a, int64_t m, int64_t n, int64_t lda,
+                            int64_t mb, int64_t nb, int64_t p, int64_t q,
+                            int64_t pr, int64_t pc, const char* local,
+                            int64_t ldl, int64_t elem) {
+    int64_t njblk = (n + nb - 1) / nb;
+    #pragma omp parallel for schedule(static)
+    for (int64_t jblk = pc; jblk < njblk; jblk += q) {
+        int64_t j0 = jblk * nb;
+        int64_t jw = std::min(nb, n - j0);
+        int64_t jl0 = (jblk / q) * nb;
+        for (int64_t jj = 0; jj < jw; ++jj) {
+            char* dst_col = a + (j0 + jj) * lda * elem;
+            const char* src_col = local + (jl0 + jj) * ldl * elem;
+            for (int64_t iblk = pr; iblk < (m + mb - 1) / mb; iblk += p) {
+                int64_t i0 = iblk * mb;
+                int64_t iw = std::min(mb, m - i0);
+                int64_t il0 = (iblk / p) * mb;
+                std::memcpy(dst_col + i0 * elem, src_col + il0 * elem,
+                            size_t(iw) * elem);
+            }
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Batched tile layout transpose — reference Tile::layoutConvert
+// (Tile.hh:707-857) / device_transpose.cu: out-of-place blocked
+// transpose, OpenMP over tiles and 64x64 cache blocks.
+// ---------------------------------------------------------------------------
+
+static void transpose_one_f64(const double* src, double* dst,
+                              int64_t m, int64_t n) {
+    const int64_t B = 64;
+    for (int64_t ib = 0; ib < m; ib += B)
+        for (int64_t jb = 0; jb < n; jb += B) {
+            int64_t ie = std::min(ib + B, m), je = std::min(jb + B, n);
+            for (int64_t i = ib; i < ie; ++i)
+                for (int64_t j = jb; j < je; ++j)
+                    dst[i * n + j] = src[j * m + i];
+        }
+}
+
+// batch: nt tiles, each (m,n) col-major stride m -> row-major (n-stride)
+void slate_batch_transpose_f64(int64_t nt, int64_t m, int64_t n,
+                               const double* src, double* dst) {
+    #pragma omp parallel for schedule(dynamic)
+    for (int64_t t = 0; t < nt; ++t)
+        transpose_one_f64(src + t * m * n, dst + t * m * n, m, n);
+}
+
+// ---------------------------------------------------------------------------
+// Host tiled executors — the reference's Target::HostTask drivers: an
+// OpenMP task DAG with panel/lookahead dependencies (src/potrf.cc:54-133)
+// over nb-square tiles of a column-major matrix, tile math via BLAS.
+// ---------------------------------------------------------------------------
+
+// Cholesky (lower) of col-major n x n with leading dim n.
+// Task graph identical in shape to src/potrf.cc:210-288:
+//   potrf(diag) -> trsm(panel below) -> syrk/gemm(trailing).
+int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
+    int info_out = 0;
+    int64_t nt = (n + nb - 1) / nb;
+    auto tile = [&](int64_t i, int64_t j) { return a + j * nb * n + i * nb; };
+    auto tsz = [&](int64_t i) {
+        return (int)std::min(nb, n - i * nb);
+    };
+    const double one = 1.0, neg_one = -1.0;
+    const int in = (int)n;
+    #pragma omp parallel
+    #pragma omp master
+    for (int64_t k = 0; k < nt; ++k) {
+        #pragma omp task depend(inout: a[k * nb * n + k * nb])
+        {
+            int kn = tsz(k), info = 0;
+            dpotrf_("L", &kn, tile(k, k), &in, &info);
+            if (info != 0) {
+                #pragma omp atomic write
+                info_out = (int)(info + k * nb);
+            }
+        }
+        for (int64_t i = k + 1; i < nt; ++i) {
+            #pragma omp task depend(in: a[k * nb * n + k * nb]) \
+                             depend(inout: a[k * nb * n + i * nb])
+            {
+                int kn = tsz(k), im = tsz(i);
+                dtrsm_("R", "L", "C", "N", &im, &kn, &one, tile(k, k), &in,
+                       tile(i, k), &in);
+            }
+        }
+        for (int64_t j = k + 1; j < nt; ++j) {
+            #pragma omp task depend(in: a[k * nb * n + j * nb]) \
+                             depend(inout: a[j * nb * n + j * nb])
+            {
+                int jn = tsz(j), kn = tsz(k);
+                dsyrk_("L", "N", &jn, &kn, &neg_one, tile(j, k), &in, &one,
+                       tile(j, j), &in);
+            }
+            for (int64_t i = j + 1; i < nt; ++i) {
+                #pragma omp task depend(in: a[k * nb * n + i * nb]) \
+                                 depend(in: a[k * nb * n + j * nb]) \
+                                 depend(inout: a[j * nb * n + i * nb])
+                {
+                    int im = tsz(i), jn = tsz(j), kn = tsz(k);
+                    dgemm_("N", "C", &im, &jn, &kn, &neg_one, tile(i, k),
+                           &in, tile(j, k), &in, &one, tile(i, j), &in);
+                }
+            }
+        }
+    }
+    return info_out;
+}
+
+// C (m x n) += A (m x k) * B (k x n), all col-major with given lds; tiled
+// omp tasks per C tile (internal_gemm.cc HostTask variant).
+void slate_host_gemm_f64(int64_t m, int64_t n, int64_t k, double alpha,
+                         const double* a, int64_t lda, const double* b,
+                         int64_t ldb, double beta, double* c, int64_t ldc,
+                         int64_t nb) {
+    int64_t mt = (m + nb - 1) / nb, ntt = (n + nb - 1) / nb;
+    const int ik = (int)k, ilda = (int)lda, ildb = (int)ldb, ildc = (int)ldc;
+    #pragma omp parallel
+    #pragma omp master
+    for (int64_t i = 0; i < mt; ++i)
+        for (int64_t j = 0; j < ntt; ++j) {
+            #pragma omp task firstprivate(i, j)
+            {
+                int im = (int)std::min(nb, m - i * nb);
+                int jn = (int)std::min(nb, n - j * nb);
+                dgemm_("N", "N", &im, &jn, &ik, &alpha, a + i * nb, &ilda,
+                       b + j * nb * ldb, &ildb, &beta,
+                       c + j * nb * ldc + i * nb, &ildc);
+            }
+        }
+}
+
+int slate_host_num_threads() { return omp_get_max_threads(); }
+
+}  // extern "C"

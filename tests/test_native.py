@@ -1,0 +1,76 @@
+"""Native host-runtime tests — mirroring the reference unit tests
+``unit_test/test_Memory.cc`` (pool), the ``scalapack_api`` marshaling,
+``test_Tile.cc`` layout conversion, and the HostTask driver checks."""
+
+import numpy as np
+import pytest
+
+from slate_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason=f"native runtime unavailable: {native.build_error()}")
+
+
+def test_memory_pool_reuse():
+    pool = native.MemoryPool(64 * 64 * 8)
+    b1 = pool.alloc()
+    b2 = pool.alloc()
+    assert b1 != b2 and pool.num_allocated == 2
+    pool.free(b1)
+    assert pool.num_free == 1
+    assert pool.alloc() == b1          # LIFO reuse like Memory.cc stacks
+    assert pool.num_free == 0
+    pool.free(b1)
+    pool.free(b2)
+    pool.close()
+
+
+def test_numroc():
+    # ScaLAPACK numroc oracle values
+    assert native.numroc(100, 16, 0, 2) == 52
+    assert native.numroc(100, 16, 1, 2) == 48
+    assert native.numroc(10, 3, 2, 4) == 3
+    assert sum(native.numroc(37, 5, r, 3) for r in range(3)) == 37
+
+
+@pytest.mark.parametrize("m,n,mb,nb,p,q", [
+    (37, 23, 8, 5, 2, 3), (64, 64, 16, 16, 2, 2), (10, 90, 3, 32, 3, 1)])
+def test_scalapack_pack_roundtrip(m, n, mb, nb, p, q):
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((m, n))
+    lg = [[native.scalapack_pack(a, mb, nb, p, q, pr, pc)
+           for pc in range(q)] for pr in range(p)]
+    for pr in range(p):
+        for pc in range(q):
+            assert lg[pr][pc].shape == (native.numroc(m, mb, pr, p),
+                                        native.numroc(n, nb, pc, q))
+    back = native.scalapack_unpack(lg, m, n, mb, nb, p, q)
+    assert np.abs(back - a).max() == 0
+
+
+def test_batch_transpose():
+    rng = np.random.default_rng(1)
+    t = rng.standard_normal((5, 33, 17))
+    tt = native.batch_transpose(t)
+    assert np.abs(tt - t.transpose(0, 2, 1)).max() == 0
+
+
+def test_host_potrf():
+    rng = np.random.default_rng(2)
+    n = 300
+    s = rng.standard_normal((n, n))
+    s = s @ s.T + n * np.eye(n)
+    l = native.host_potrf(s, nb=64)
+    assert np.abs(l @ l.T - s).max() < 1e-11 * n
+    with pytest.raises(np.linalg.LinAlgError):
+        native.host_potrf(-np.eye(8), nb=4)
+
+
+def test_host_gemm():
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((130, 70))
+    b = rng.standard_normal((70, 90))
+    c = rng.standard_normal((130, 90))
+    out = native.host_gemm(a, b, nb=32, alpha=2.0, beta=-1.0, c=c)
+    assert np.abs(out - (2 * a @ b - c)).max() < 1e-12 * 70
