@@ -1,0 +1,124 @@
+"""LAPACK-style drop-in API — reference ``lapack_api/`` (26 files,
+2369 LoC): ``dgetrf``-style typed names over LAPACK-convention arguments,
+forwarding to the framework drivers (the reference wraps user buffers
+with ``fromLAPACK`` views and calls SLATE, ``lapack_api/lapack_potrf.cc``).
+
+Typed prefixes: s/d/c/z × each routine, generated over one dtype table —
+the Python analog of the reference's template instantiation + three
+Fortran-mangling aliases.  Arguments/returns follow scipy.linalg.lapack
+conventions (arrays in, (result..., info) out).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..enums import Norm, Op, Side, Uplo
+from .. import linalg as L
+
+_DTYPES = {"s": np.float32, "d": np.float64,
+           "c": np.complex64, "z": np.complex128}
+
+__all__ = []
+
+
+def _reg(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+
+
+def _uplo(ch) -> Uplo:
+    return Uplo.Lower if str(ch).upper().startswith("L") else Uplo.Upper
+
+
+def _data(x):
+    """Unwrap a Matrix-family result to its array (raw arrays pass
+    through)."""
+    from ..matrix import BaseMatrix
+    return x.data if isinstance(x, BaseMatrix) else x
+
+
+def _make_typed(letter, dt):
+    cast = lambda a: jnp.asarray(np.asarray(a, dtype=dt))
+
+    def gesv(a, b):
+        lu, piv, x = L.gesv(cast(a), cast(b))
+        return np.asarray(_data(lu)), np.asarray(piv), np.asarray(x), 0
+
+    def getrf(a):
+        lu, piv = L.getrf(cast(a))
+        return np.asarray(_data(lu)), np.asarray(piv), 0
+
+    def getrs(lu, piv, b, trans="N"):
+        op = {"N": Op.NoTrans, "T": Op.Trans, "C": Op.ConjTrans}[trans]
+        return np.asarray(L.getrs(cast(lu), jnp.asarray(piv), cast(b),
+                                  op=op)), 0
+
+    def getri(lu, piv):
+        return np.asarray(L.getri(cast(lu), jnp.asarray(piv))), 0
+
+    def potrf(a, lower=1):
+        from ..matrix import HermitianMatrix
+        u = Uplo.Lower if lower else Uplo.Upper
+        h = HermitianMatrix(cast(a), uplo=u)
+        fac = L.potrf(h)
+        return np.asarray(_data(fac)), 0
+
+    def potrs(fac, b, lower=1):
+        from ..matrix import TriangularMatrix
+        from ..enums import Diag
+        u = Uplo.Lower if lower else Uplo.Upper
+        t = TriangularMatrix(cast(fac), uplo=u, diag=Diag.NonUnit)
+        return np.asarray(L.potrs(t, cast(b))), 0
+
+    def posv(a, b, lower=1):
+        f, _ = potrf(a, lower)
+        x, _ = potrs(f, b, lower)
+        return f, x, 0
+
+    def geqrf(a):
+        f, taus = L.geqrf(cast(a))
+        return np.asarray(_data(f)), \
+            np.asarray(taus), 0
+
+    def gelqf(a):
+        f, taus = L.gelqf(cast(a))
+        return np.asarray(_data(f)), \
+            np.asarray(taus), 0
+
+    def gels(a, b):
+        return np.asarray(L.gels(cast(a), cast(b))), 0
+
+    def gesvd(a):
+        s, u, vh = L.svd(cast(a))
+        return np.asarray(u), np.asarray(s), np.asarray(vh), 0
+
+    def heev(a, jobz="V"):
+        w, z = L.heev(cast(a), jobz.upper() == "V")
+        return (np.asarray(w), None if z is None else np.asarray(z), 0)
+
+    def hesv(a, b):
+        f, x = L.hesv(cast(a), cast(b))
+        return np.asarray(x), 0
+
+    def lange(norm_ch, a):
+        nm = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
+              "F": Norm.Fro}[str(norm_ch).upper()]
+        return float(L.genorm(nm, cast(a)))
+
+    table = {"gesv": gesv, "getrf": getrf, "getrs": getrs, "getri": getri,
+             "potrf": potrf, "potrs": potrs, "posv": posv, "geqrf": geqrf,
+             "gelqf": gelqf, "gels": gels, "gesvd": gesvd, "lange": lange,
+             "hesv": hesv}
+    if letter in ("s", "d"):
+        table["syev"] = heev
+        table["sysv"] = hesv
+    else:
+        table["heev"] = heev
+    for base, fn in table.items():
+        _reg(letter + base, fn)
+
+
+for _l, _dt in _DTYPES.items():
+    _make_typed(_l, _dt)

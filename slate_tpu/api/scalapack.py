@@ -1,0 +1,166 @@
+"""ScaLAPACK-style drop-in API — reference ``scalapack_api/`` (28 files,
+3747 LoC): ``p?potrf``-style entry points that accept matrices already
+laid out 2-D block-cyclically (per-rank local arrays + a descriptor),
+wrap them, run the framework driver over the mesh, and return results in
+the same layout (``scalapack_api/scalapack_potrf.cc:27-80`` reads the
+BLACS grid with ``Cblacs_gridinfo`` and wraps with ``fromScaLAPACK``).
+
+Here the BLACS grid is a :class:`BlacsGrid` (p×q), the descriptor is
+:class:`Desc` (dtype/m/n/mb/nb), and the "per-rank local arrays" use the
+native runtime's pack/unpack marshaling (C++/OpenMP,
+:mod:`slate_tpu.native`) — the same role the reference's C++ shims play.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import linalg as L
+from ..enums import Diag, Norm, Uplo
+from ..matrix import HermitianMatrix, TriangularMatrix
+from .. import native
+
+__all__ = ["BlacsGrid", "Desc", "pgemm", "ppotrf", "ppotrs", "pposv",
+           "pgesv", "pgetrf", "pgeqrf", "pgels", "psyev", "pheev",
+           "plange", "to_local", "from_local"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlacsGrid:
+    """p×q process grid — analog of a BLACS context
+    (``Cblacs_gridinit``)."""
+    p: int
+    q: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Desc:
+    """Array descriptor — the 9-int ScaLAPACK ``desc`` reduced to what
+    matters here (``descinit``)."""
+    m: int
+    n: int
+    mb: int
+    nb: int
+
+
+LocalGrid = List[List[np.ndarray]]   # locals_grid[pr][pc]
+
+
+def to_local(a: np.ndarray, grid: BlacsGrid, desc: Desc) -> LocalGrid:
+    """Scatter a global array into per-rank block-cyclic locals (native
+    C++ pack)."""
+    return [[native.scalapack_pack(a, desc.mb, desc.nb, grid.p, grid.q,
+                                   pr, pc) for pc in range(grid.q)]
+            for pr in range(grid.p)]
+
+
+def from_local(lg: LocalGrid, grid: BlacsGrid, desc: Desc) -> np.ndarray:
+    """Gather per-rank locals back to the global array (native C++
+    unpack)."""
+    return native.scalapack_unpack(lg, desc.m, desc.n, desc.mb, desc.nb,
+                                   grid.p, grid.q)
+
+
+def _gather(lg, grid, desc):
+    return jnp.asarray(from_local(lg, grid, desc))
+
+
+def _scatter(arr, grid, desc):
+    return to_local(np.asarray(arr), grid, desc)
+
+
+def pgemm(transa: str, transb: str, alpha, a_lg, desca, b_lg, descb,
+          beta, c_lg, descc, grid: BlacsGrid,
+          mesh=None) -> LocalGrid:
+    """p?gemm — reference ``scalapack_api/scalapack_gemm.cc``.  When a
+    ``mesh`` is given the multiply runs as the distributed SUMMA
+    (``slate_tpu.parallel.dist_blas3.pgemm``); otherwise single-chip."""
+
+    av = _gather(a_lg, grid, desca)
+    bv = _gather(b_lg, grid, descb)
+    cv = _gather(c_lg, grid, descc)
+    op = lambda x, t: (x.T if t.upper() == "T"
+                       else jnp.conj(x.T) if t.upper() == "C" else x)
+    av, bv = op(av, transa), op(bv, transb)
+    if mesh is not None:
+        from ..parallel.dist import distribute, undistribute
+        from ..parallel.dist_blas3 import pgemm as dist_pgemm
+        da = distribute(av, mesh, desca.nb)
+        db = distribute(bv, mesh, desca.nb)
+        prod = undistribute(dist_pgemm(da, db))
+        out = alpha * prod + beta * cv
+    else:
+        out = alpha * (av @ bv) + beta * cv
+    return _scatter(out, grid, descc)
+
+
+def ppotrf(uplo: str, a_lg, desc, grid: BlacsGrid) -> LocalGrid:
+    """p?potrf — reference ``scalapack_api/scalapack_potrf.cc``."""
+    u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+    h = HermitianMatrix(_gather(a_lg, grid, desc), uplo=u, nb=desc.nb)
+    fac = L.potrf(h)
+    return _scatter(fac.data, grid, desc)
+
+
+def ppotrs(uplo: str, fac_lg, desca, b_lg, descb,
+           grid: BlacsGrid) -> LocalGrid:
+    u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+    t = TriangularMatrix(_gather(fac_lg, grid, desca), uplo=u,
+                         diag=Diag.NonUnit, nb=desca.nb)
+    x = L.potrs(t, _gather(b_lg, grid, descb))
+    return _scatter(x, grid, descb)
+
+
+def pposv(uplo: str, a_lg, desca, b_lg, descb, grid: BlacsGrid):
+    fac = ppotrf(uplo, a_lg, desca, grid)
+    return fac, ppotrs(uplo, fac, desca, b_lg, descb, grid)
+
+
+def pgetrf(a_lg, desc, grid: BlacsGrid):
+    lu, piv = L.getrf(_gather(a_lg, grid, desc), {"block_size": desc.nb})
+    return _scatter(lu.data, grid, desc), np.asarray(piv)
+
+
+def pgesv(a_lg, desca, b_lg, descb, grid: BlacsGrid):
+    _, piv, x = L.gesv(_gather(a_lg, grid, desca),
+                       _gather(b_lg, grid, descb),
+                       {"block_size": desca.nb})
+    return _scatter(x, grid, descb), np.asarray(piv)
+
+
+def pgeqrf(a_lg, desc, grid: BlacsGrid):
+    f, taus = L.geqrf(_gather(a_lg, grid, desc), {"block_size": desc.nb})
+    fd = f if isinstance(f, jnp.ndarray) else f.data
+    return _scatter(fd, grid, desc), np.asarray(taus)
+
+
+def pgels(a_lg, desca, b_lg, descb, grid: BlacsGrid):
+    x = L.gels(_gather(a_lg, grid, desca), _gather(b_lg, grid, descb),
+               {"block_size": desca.nb})
+    xd = np.asarray(x)
+    d = Desc(xd.shape[0], xd.shape[1] if xd.ndim > 1 else 1,
+             descb.mb, descb.nb)
+    return _scatter(xd.reshape(d.m, d.n), grid, d)
+
+
+def pheev(jobz: str, uplo: str, a_lg, desc, grid: BlacsGrid):
+    """p?syev/p?heev — reference ``scalapack_api/scalapack_heev.cc``."""
+    u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+    h = HermitianMatrix(_gather(a_lg, grid, desc), uplo=u, nb=desc.nb)
+    w, z = L.heev(h, jobz.upper() == "V", {"block_size": desc.nb})
+    if z is None:
+        return np.asarray(w), None
+    return np.asarray(w), _scatter(z, grid, desc)
+
+
+psyev = pheev
+
+
+def plange(norm_ch: str, a_lg, desc, grid: BlacsGrid) -> float:
+    nm = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
+          "F": Norm.Fro}[norm_ch.upper()]
+    return float(L.genorm(nm, _gather(a_lg, grid, desc)))
