@@ -49,4 +49,5 @@ def get_matmul_precision():
 #: (:mod:`slate_tpu.ops.pallas_kernels`) instead of stock XLA ops.
 #: Default off: XLA's fusion covers the dense drivers well; flip on (or
 #: ``SLATE_TPU_USE_PALLAS=1``) to use the hand-tuned VMEM kernels.
-use_pallas = os.environ.get("SLATE_TPU_USE_PALLAS", "0") not in ("0", "", "false")
+use_pallas = (os.environ.get("SLATE_TPU_USE_PALLAS", "0").lower()
+              not in ("0", "", "false", "off", "no"))

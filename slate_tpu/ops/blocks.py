@@ -37,7 +37,23 @@ from ..grid import ceildiv
 
 
 def matmul(a, b):
-    """Dot with the configured precision (see :mod:`slate_tpu.config`)."""
+    """Dot with the configured precision (see :mod:`slate_tpu.config`).
+
+    With ``config.use_pallas`` on, plain 2-D tile-grid-aligned products
+    route through the hand-tuned VMEM kernel
+    (:func:`slate_tpu.ops.pallas_kernels.matmul`); everything else (and
+    the default) uses stock XLA dot, whose fusion already covers the
+    dense drivers well.
+    """
+    if (config.use_pallas and a.ndim == 2 and b.ndim == 2
+            and jnp.issubdtype(a.dtype, jnp.floating)
+            and jnp.issubdtype(b.dtype, jnp.floating)
+            and a.shape[0] % 128 == 0 and b.shape[1] % 128 == 0
+            and a.shape[1] % 128 == 0):
+        from .pallas_kernels import matmul as pallas_matmul
+        return pallas_matmul(a, b, bm=min(256, a.shape[0]),
+                             bn=min(256, b.shape[1]),
+                             bk=min(512, a.shape[1]))
     return jnp.matmul(a, b, precision=config.matmul_precision)
 
 

@@ -65,9 +65,14 @@ def matmul(a, b, *, bm: int = 256, bn: int = 256, bk: int = 512,
     bm, bn, bk = min(bm, m), min(bn, n), min(bk, k)
     assert m % bm == 0 and n % bn == 0 and k % bk == 0, \
         "pad shapes to the tile grid"
+    if jnp.issubdtype(a.dtype, jnp.complexfloating):
+        raise NotImplementedError(
+            "Pallas TPU has no complex support; use the XLA path "
+            "(ops.blocks.matmul routes complex there automatically)")
     out_dtype = out_dtype or a.dtype
     k_steps = k // bk
-    acc_dtype = jnp.float32 if a.dtype != jnp.float64 else jnp.float64
+    # accumulate in at-least-fp32 (bf16/f16 widen, f64 stays f64)
+    acc_dtype = jnp.promote_types(a.dtype, jnp.float32)
     return pl.pallas_call(
         functools.partial(_matmul_kernel, k_steps=k_steps),
         grid=(m // bm, n // bn, k_steps),

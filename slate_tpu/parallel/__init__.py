@@ -16,3 +16,4 @@ from .mesh import default_mesh, make_grid_mesh, mesh_grid_shape  # noqa: F401
 from .dist import DistMatrix, distribute, undistribute  # noqa: F401
 from .dist_blas3 import pgemm  # noqa: F401
 from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401
+from .dist_lu import pgetrf, pgetrs, pgesv  # noqa: F401

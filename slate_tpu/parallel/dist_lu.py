@@ -1,0 +1,300 @@
+"""Distributed LU with partial pivoting over the ('p','q') mesh.
+
+TPU-native re-design of the reference's ``getrf`` driver
+(``src/getrf.cc:23-215``) and its multithreaded panel
+(``internal_getrf.cc:75-92``, ``Tile_getrf.hh:154-320``):
+
+* the reference's thread team + ``MPI_Allreduce(MAXLOC)`` per panel
+  column becomes a *redundant panel factorization*: the block column is
+  assembled on every device with one masked ``psum`` (along 'q') + one
+  ``all_gather`` (along 'p'), then every device runs the same fused
+  ``lax.linalg.lu`` on it.  nb³·(m/nb) flops of redundancy buys zero
+  per-column latency hops — the TPU trade (MXU flops are cheap, ICI
+  round-trips per column are not);
+* the reference's cross-rank row swaps (``internal::permuteRows``,
+  ``internal_swap.cc:500-750``) become one vectorized fetch/scatter:
+  a product of nb transpositions moves at most 2·nb rows, so the swap
+  set has the *static* shape (2nb,) = [destinations ‖ pivot targets];
+  sources are fetched with a masked ``psum`` along 'p' and written with
+  a single ``scatter`` in drop mode (rows a device does not own fall
+  out of range and are dropped);
+* trailing update = one local MXU matmul per device per step, exactly as
+  in :mod:`.dist_factor` (the group-batched ``blas::batch::gemm`` of
+  ``internal_gemm.cc:614-689`` collapses to a dense contraction over the
+  cyclic-shuffled local block).
+
+Pivots are tracked as a replicated global permutation ``gperm`` with
+``A[gperm] = L·U`` (the reference's ``Pivots`` list, ``types.hh:64-97``).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..grid import ceildiv
+from ..ops.blocks import matmul as _mm
+from .dist import DistMatrix, distribute, like
+from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+
+def _gather_positions(mtp: int, p: int) -> np.ndarray:
+    """Position of global row-block i inside a 'p'-axis all_gather of the
+    cyclic-shuffled local blocks: mesh row r's blocks come r-th, holding
+    i = r, r+p, r+2p, ...  (see dist.py layout)."""
+    i = np.arange(mtp)
+    return (i % p) * (mtp // p) + i // p
+
+
+def _roll_rows(x, shift):
+    """Row roll by a traced shift (gather form; jnp.roll-equivalent)."""
+    m = x.shape[0]
+    return jnp.take(x, (jnp.arange(m) + shift) % m, axis=0)
+
+
+@lru_cache(maxsize=None)
+def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
+    p, q = mesh_grid_shape(mesh)
+    mtp = p * ml
+    M = mtp * nb
+    pos = jnp.asarray(_gather_positions(mtp, p))
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        j_idx = jnp.arange(nl) * q + c           # my global col blocks
+        lrows = jnp.arange(ml * nb)
+        # global row of each of my local rows
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        rows_g = jnp.arange(M)
+
+        def owned_lrow(g):
+            """(ownership mask, local row index) for global rows g."""
+            blk = g // nb
+            own = (blk % p) == r
+            return own, (blk // p) * nb + g % nb
+
+        def body(k, carry):
+            a_loc, gperm = carry
+            kq, kp = k // q, k // p
+            # ---- assemble panel column k on every device (tileBcast +
+            # hypercube listBcast, src/getrf.cc:103-117 → psum + all_gather)
+            colk = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
+            ploc = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
+            pg = lax.all_gather(ploc, AXIS_P, axis=0, tiled=True)
+            panel = jnp.take(pg.reshape(mtp, nb, nb), pos, axis=0)
+            panel = panel.reshape(M, nb)
+            # shift so the diagonal block leads; zero the wrapped-around
+            # (already factored) rows so they never win a pivot
+            shifted = _roll_rows(panel, k * nb)
+            valid = (rows_g < M - k * nb)[:, None].astype(dt)
+            # ---- redundant panel LU (internal::getrf_panel analog)
+            lu_p, piv, perm = lax.linalg.lu(shifted * valid)
+            # ---- vectorized cross-mesh row swaps (internal::permuteRows):
+            # destinations = top nb positions ∪ pivot targets (static 2nb)
+            drel = jnp.concatenate([jnp.arange(nb), piv.astype(jnp.int32)])
+            srel = jnp.take(perm, drel).astype(jnp.int32)
+            dg = k * nb + drel
+            sg = k * nb + srel
+            own_s, lr_s = owned_lrow(sg)
+            fetched = jnp.take(a_loc, jnp.where(own_s, lr_s, 0), axis=0)
+            fetched = lax.psum(fetched * own_s[:, None].astype(dt), AXIS_P)
+            own_d, lr_d = owned_lrow(dg)
+            a_loc = a_loc.at[jnp.where(own_d, lr_d, ml * nb)].set(
+                fetched, mode="drop")
+            # ---- write the factored panel column back (L21 + L11\U11)
+            rel = grows - k * nb
+            myrows = jnp.take(lu_p, jnp.clip(rel, 0, M - 1), axis=0)
+            colk2 = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
+            newcol = jnp.where((rel >= 0)[:, None], myrows, colk2)
+            written = lax.dynamic_update_slice(a_loc, newcol, (0, kq * nb))
+            a_loc = jnp.where(k % q == c, written, a_loc)
+            # ---- trsm on block row k: U12 = L11^{-1} A12 (src/getrf.cc:121+)
+            rowblk = lax.dynamic_slice(a_loc, (kp * nb, 0), (nb, nl * nb))
+            rowblk = lax.psum(rowblk * (k % p == r).astype(dt), AXIS_P)
+            l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=dt)
+            u12 = lax.linalg.triangular_solve(
+                l11, rowblk, left_side=True, lower=True, unit_diagonal=True)
+            cmask = jnp.repeat(j_idx > k, nb).astype(dt)[None, :]
+            newrow = cmask * u12 + (1 - cmask) * rowblk
+            upd = lax.dynamic_update_slice(a_loc, newrow, (kp * nb, 0))
+            a_loc = jnp.where(k % p == r, upd, a_loc)
+            # ---- trailing update: one local MXU matmul (hot loop)
+            lmask = (rel >= nb)[:, None].astype(dt)
+            myl = jnp.take(lu_p, jnp.clip(rel, 0, M - 1), axis=0) * lmask
+            a_loc = a_loc - _mm(myl, newrow * cmask)
+            # ---- fold this panel's permutation into the global one
+            gp_shift = _roll_rows(gperm[:, None], k * nb)[:, 0]
+            gp_perm = jnp.take(gp_shift, perm)
+            gp_back = _roll_rows(gp_perm[:, None], -(k * nb))[:, 0]
+            gperm = jnp.where(rows_g < k * nb, gperm, gp_back)
+            return a_loc, gperm
+
+        gperm0 = jnp.arange(M, dtype=jnp.int32)
+        # the loop body derives gperm from 'p'-gathered data, making it
+        # device-varying in shard_map's type system; match the carry type
+        gperm0 = lax.pcast(gperm0, (AXIS_P, AXIS_Q), to="varying")
+        a_loc, gperm = lax.fori_loop(0, nt, body, (a_loc, gperm0))
+        # every device holds the same permutation; pmax makes that
+        # replication visible to the type system for the P() out-spec
+        gperm = lax.pmax(lax.pmax(gperm, AXIS_P), AXIS_Q)
+        return a_loc, gperm
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=(P(AXIS_P, AXIS_Q), P()))
+    return jax.jit(fn)
+
+
+def pgetrf(a: DistMatrix):
+    """Distributed partial-pivot LU: returns ``(lu, gperm)`` with
+    ``A[gperm] = tril(LU,-1)+I  @  triu(LU)`` (reference ``slate::getrf``,
+    ``src/getrf.cc:23``; pivot vector per ``types.hh:64-97``).
+
+    Distribute the operand with ``diag_pad=1.0, row_mult=q, col_mult=p``
+    (square padding) — see :func:`pgesv` for the glue.
+    """
+
+    p, q = a.grid_shape
+    if a.m != a.n:
+        raise ValueError(f"pgetrf requires a square matrix, got {a.m}x{a.n}")
+    if a.mtp != a.ntp:
+        raise ValueError("pgetrf needs square padded storage "
+                         "(distribute with row_mult=q, col_mult=p)")
+    ml, nl = a.mtp // p, a.ntp // q
+    nt = ceildiv(a.n, a.nb)
+    fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype))
+    lu_data, gperm = fn(a.data)
+    return like(a, lu_data), gperm
+
+
+@lru_cache(maxsize=None)
+def _build_plu_trsm(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
+                    upper: bool, dtype_name: str):
+    """Forward unit-lower (L) / backward upper (U) distributed solves —
+    the two halves of getrs (reference ``src/getrs.cc``)."""
+
+    p, q = mesh_grid_shape(mesh)
+
+    def kernel(lu_loc, b_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = lu_loc.dtype
+        i_idx = jnp.arange(ml) * p + r
+
+        def get_diag(k):
+            blk = lax.dynamic_slice(
+                lu_loc, ((k // p) * nb, (k // q) * nb), (nb, nb))
+            blk = blk * ((k % p == r) & (k % q == c)).astype(dt)
+            return lax.psum(lax.psum(blk, AXIS_P), AXIS_Q)
+
+        def get_brow(k, b_loc):
+            blk = lax.dynamic_slice(b_loc, ((k // p) * nb, 0), (nb, nrhs_l))
+            return lax.psum(blk * (k % p == r).astype(dt), AXIS_P)
+
+        def put_brow(k, b_loc, x):
+            upd = lax.dynamic_update_slice(b_loc, x, ((k // p) * nb, 0))
+            return jnp.where(k % p == r, upd, b_loc)
+
+        def get_col(k):
+            col = lax.dynamic_slice(lu_loc, (0, (k // q) * nb),
+                                    (ml * nb, nb))
+            return lax.psum(col * (k % q == c).astype(dt), AXIS_Q)
+
+        def rowmask(pred):
+            return jnp.repeat(pred(i_idx), nb).astype(dt)[:, None]
+
+        if not upper:
+            def body(k, b_loc):
+                d = jnp.tril(get_diag(k), -1) + jnp.eye(nb, dtype=dt)
+                x = lax.linalg.triangular_solve(
+                    d, get_brow(k, b_loc), left_side=True, lower=True,
+                    unit_diagonal=True)
+                b_loc = put_brow(k, b_loc, x)
+                lcol = get_col(k) * rowmask(lambda i: i > k)
+                return b_loc - _mm(lcol, x)
+        else:
+            def body(t, b_loc):
+                k = nt - 1 - t
+                d = jnp.triu(get_diag(k))
+                x = lax.linalg.triangular_solve(
+                    d, get_brow(k, b_loc), left_side=True, lower=False)
+                b_loc = put_brow(k, b_loc, x)
+                ucol = get_col(k) * rowmask(lambda i: i < k)
+                return b_loc - _mm(ucol, x)
+
+        return lax.fori_loop(0, nt, body, b_loc)
+
+    fn = shard_map(kernel, mesh=mesh,
+                   in_specs=(P(AXIS_P, AXIS_Q), P(AXIS_P, AXIS_Q)),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _build_permute_rows(mesh, nb: int, ml: int, ncols_l: int):
+    """Apply a replicated global row permutation to a row-distributed
+    matrix: B ← B[gperm] (reference ``internal::permuteRows`` forward)."""
+
+    p, q = mesh_grid_shape(mesh)
+    mtp = p * ml
+    pos = jnp.asarray(_gather_positions(mtp, p))
+
+    def kernel(b_loc, gperm):
+        r = lax.axis_index(AXIS_P)
+        lrows = jnp.arange(ml * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        bg = lax.all_gather(b_loc, AXIS_P, axis=0, tiled=True)
+        bg = jnp.take(bg.reshape(mtp, nb, ncols_l), pos, axis=0)
+        bg = bg.reshape(mtp * nb, ncols_l)
+        return jnp.take(bg, jnp.take(gperm, grows), axis=0)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q), P()),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def pgetrs(lu: DistMatrix, gperm, b: DistMatrix) -> DistMatrix:
+    """Solve A X = B from the distributed LU factor: row permute, then
+    unit-lower forward and upper backward substitution
+    (reference ``src/getrs.cc``)."""
+
+    p, q = lu.grid_shape
+    if b.nb != lu.nb:
+        raise ValueError("pgetrs requires matching tile sizes")
+    if b.mtp != lu.mtp:
+        raise ValueError("B row padding must match the factor "
+                         "(distribute with row_mult=q)")
+    ml, nl = lu.mtp // p, lu.ntp // q
+    nrhs_l = (b.ntp // q) * b.nb
+    nt = ceildiv(lu.n, lu.nb)
+    perm_fn = _build_permute_rows(lu.mesh, lu.nb, ml, nrhs_l)
+    fwd = _build_plu_trsm(lu.mesh, lu.nb, nt, ml, nl, nrhs_l, False,
+                          str(lu.dtype))
+    bwd = _build_plu_trsm(lu.mesh, lu.nb, nt, ml, nl, nrhs_l, True,
+                          str(lu.dtype))
+    pb = perm_fn(b.data, gperm)
+    y = fwd(lu.data, pb)
+    x = bwd(lu.data, y)
+    return like(b, x)
+
+
+def pgesv(a, b, mesh, nb: int = 256):
+    """Distributed LU factor + solve (reference ``slate::gesv``).
+
+    Accepts dense (replicated) operands, distributes them block-cyclic,
+    and returns ``(lu, gperm, x)`` with ``x`` a DistMatrix.
+    """
+
+    p, q = mesh_grid_shape(mesh)
+    ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    bd = distribute(b, mesh, nb, row_mult=q)
+    lu, gperm = pgetrf(ad)
+    x = pgetrs(lu, gperm, bd)
+    return lu, gperm, x

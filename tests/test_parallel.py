@@ -144,3 +144,50 @@ class TestPposv:
         xh = np.asarray(undistribute(x))
         np.testing.assert_allclose(xh, np.linalg.solve(a, b),
                                    rtol=1e-8, atol=1e-8)
+
+
+class TestPgetrf:
+    @pytest.mark.parametrize("n,nb", [(64, 16), (96, 16), (100, 16)])
+    def test_factor_matches_pivoted_product(self, mesh24, n, nb):
+        a = _rng(12).standard_normal((n, n))
+        from slate_tpu.parallel import pgetrf
+        da = distribute(a, mesh24, nb=nb, diag_pad=1.0, row_mult=4, col_mult=2)
+        lu, gperm = pgetrf(da)
+        luh = np.asarray(undistribute(lu))
+        gp = np.asarray(gperm)
+        l = np.tril(luh, -1) + np.eye(n)
+        u = np.triu(luh)
+        # A[gperm] = L U on the leading n rows
+        np.testing.assert_allclose(a[gp[:n]], l @ u, rtol=1e-10, atol=1e-10)
+
+    def test_serial_mesh(self, mesh11):
+        a = _rng(13).standard_normal((48, 48))
+        from slate_tpu.parallel import pgetrf
+        da = distribute(a, mesh11, nb=16, diag_pad=1.0)
+        lu, gperm = pgetrf(da)
+        luh = np.asarray(undistribute(lu))
+        gp = np.asarray(gperm)
+        l = np.tril(luh, -1) + np.eye(48)
+        u = np.triu(luh)
+        np.testing.assert_allclose(a[gp[:48]], l @ u, rtol=1e-10, atol=1e-10)
+
+
+class TestPgesv:
+    @pytest.mark.parametrize("n,nrhs,nb", [(96, 16, 16), (100, 7, 16)])
+    def test_residual(self, mesh24, n, nrhs, nb):
+        from slate_tpu.parallel import pgesv
+        a = _rng(14).standard_normal((n, n))
+        b = _rng(15).standard_normal((n, nrhs))
+        lu, gperm, x = pgesv(a, b, mesh24, nb=nb)
+        xh = np.asarray(undistribute(x))
+        res = np.linalg.norm(a @ xh - b) / (
+            np.linalg.norm(a) * np.linalg.norm(xh) + np.linalg.norm(b))
+        assert res < 3 * np.finfo(np.float64).eps * n
+
+    def test_matches_numpy(self, mesh24):
+        from slate_tpu.parallel import pgesv
+        a = _rng(16).standard_normal((64, 64))
+        b = _rng(17).standard_normal((64, 8))
+        _, _, x = pgesv(a, b, mesh24, nb=16)
+        np.testing.assert_allclose(np.asarray(undistribute(x)),
+                                   np.linalg.solve(a, b), rtol=1e-8, atol=1e-8)
