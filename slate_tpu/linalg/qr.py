@@ -34,6 +34,7 @@ from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -134,7 +135,13 @@ def _panel_geqrf(a):
         a = a.at[:, j].set(newcol)
         return a, taus.at[j].set(tau)
 
-    return lax.fori_loop(0, k, body, (a, jnp.zeros((k,), dt)))
+    taus0 = jnp.zeros((k,), dt)
+    # under shard_map the panel input is device-varying; the taus carry
+    # must carry the same varying-axes type or the fori_loop rejects it
+    vma = getattr(jax.typeof(a), "vma", ())
+    if vma:
+        taus0 = lax.pcast(taus0, tuple(vma), to="varying")
+    return lax.fori_loop(0, k, body, (a, taus0))
 
 
 def geqrf_rec(a, nb: int):

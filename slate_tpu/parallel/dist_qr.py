@@ -1,0 +1,233 @@
+"""Distributed Householder QR + least squares over the ('p','q') mesh.
+
+TPU-native re-design of the reference's CAQR driver
+(``src/geqrf.cc:196-208``: ``internal::geqrf`` panel + ``internal::ttqrt``
+triangle-triangle tree across ranks, applied with ``unmqr``/``ttmqr``):
+
+* the rank-local panel + cross-rank reduction tree becomes a *redundant
+  panel factorization*: the block column is assembled on every device
+  with one masked ``psum`` (along 'q') + one ``all_gather`` (along 'p'),
+  then every device runs the same fused Householder panel
+  (:func:`slate_tpu.linalg.qr._panel_geqrf`) and builds the compact-WY
+  ``T`` (:func:`slate_tpu.linalg.qr.larft_rec`).  The tournament tree's
+  purpose — avoiding per-column latency — is served by trading nb²·m
+  redundant MXU flops for zero extra hops, the same trade as
+  :mod:`.dist_lu`;
+* the trailing update C ← (I − V·Tᴴ·Vᴴ)·C distributes exactly like the
+  reference's ``unmqr`` fan-out (``src/geqrf.cc:277``): each device
+  forms its rows' contribution Vᴴ·C, one ``psum`` along 'p' makes the
+  nb×n_loc inner product W, and the rank-k update V·(TᴴW) is one local
+  MXU matmul;
+* ``pgels`` = forward sweep of Qᴴ over B + the distributed upper
+  triangular solve from :mod:`.dist_lu` (reference ``gels_qr``,
+  ``src/gels_qr.cc``).
+
+The factor layout matches LAPACK/the reference: R in the upper triangle,
+the V's packed below the diagonal, plus replicated per-panel T matrices
+(the reference stores them in the ``T`` triangular factor matrix).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..grid import ceildiv
+from ..linalg.qr import _panel_geqrf, larft_rec
+from ..ops.blocks import _ct, matmul as _mm
+from .dist import DistMatrix, distribute, like
+from .dist_lu import _build_plu_trsm, _gather_positions, _roll_rows
+from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+
+@lru_cache(maxsize=None)
+def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
+    p, q = mesh_grid_shape(mesh)
+    mtp = p * ml
+    M = mtp * nb
+    pos = jnp.asarray(_gather_positions(mtp, p))
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        j_idx = jnp.arange(nl) * q + c
+        lrows = jnp.arange(ml * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        rows_g = jnp.arange(M)
+        rr = rows_g[:, None]
+        cc = jnp.arange(nb)[None, :]
+
+        def body(k, carry):
+            a_loc, tmats, taus_all = carry
+            kq = k // q
+            # ---- assemble panel column k on every device
+            colk = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
+            ploc = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
+            pg = lax.all_gather(ploc, AXIS_P, axis=0, tiled=True)
+            panel = jnp.take(pg.reshape(mtp, nb, nb), pos, axis=0)
+            panel = panel.reshape(M, nb)
+            shifted = _roll_rows(panel, k * nb)
+            valid = (rows_g < M - k * nb)[:, None].astype(dt)
+            # ---- redundant Householder panel + compact-WY T
+            packed, taus = _panel_geqrf(shifted * valid)
+            v_full = jnp.where(rr > cc, packed,
+                               jnp.where(rr == cc, 1, 0).astype(dt))
+            tmat = larft_rec(v_full, taus)
+            # ---- write the packed factor back into column k
+            rel = grows - k * nb
+            myrows = jnp.take(packed, jnp.clip(rel, 0, M - 1), axis=0)
+            newcol = jnp.where((rel >= 0)[:, None], myrows, colk)
+            written = lax.dynamic_update_slice(a_loc, newcol, (0, kq * nb))
+            a_loc = jnp.where(k % q == c, written, a_loc)
+            # ---- trailing update C ← (I − V·Tᴴ·Vᴴ)·C on columns j > k
+            v_loc = jnp.take(v_full, jnp.clip(rel, 0, M - 1), axis=0)
+            v_loc = v_loc * (rel >= 0)[:, None].astype(dt)
+            cmask = jnp.repeat(j_idx > k, nb).astype(dt)[None, :]
+            w = lax.psum(_mm(_ct(v_loc), a_loc * cmask), AXIS_P)
+            upd = _mm(v_loc, _mm(_ct(tmat), w))
+            a_loc = a_loc - upd * cmask
+            tmats = lax.dynamic_update_slice(
+                tmats, tmat[None], (k, 0, 0))
+            taus_all = lax.dynamic_update_slice(
+                taus_all, taus[None], (k, 0))
+            return a_loc, tmats, taus_all
+
+        tmats0 = lax.pcast(jnp.zeros((nt, nb, nb), a_loc.dtype),
+                           (AXIS_P, AXIS_Q), to="varying")
+        taus0 = lax.pcast(jnp.zeros((nt, nb), a_loc.dtype),
+                          (AXIS_P, AXIS_Q), to="varying")
+        a_loc, tmats, taus = lax.fori_loop(
+            0, nt, body, (a_loc, tmats0, taus0))
+        # replicated values → invariant type for the P() out-specs
+        if jnp.issubdtype(a_loc.dtype, jnp.complexfloating):
+            unrep = lambda x: (lax.pmax(lax.pmax(x.real, AXIS_P), AXIS_Q)
+                               + 1j * lax.pmax(lax.pmax(x.imag, AXIS_P),
+                                               AXIS_Q)).astype(x.dtype)
+        else:
+            unrep = lambda x: lax.pmax(lax.pmax(x, AXIS_P), AXIS_Q)
+        return a_loc, unrep(tmats), unrep(taus)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=(P(AXIS_P, AXIS_Q), P(), P()))
+    return jax.jit(fn)
+
+
+def pgeqrf(a: DistMatrix):
+    """Distributed blocked Householder QR (reference ``slate::geqrf``,
+    ``src/geqrf.cc``): returns ``(qr, tmats, taus)`` with R in the upper
+    triangle of ``qr``, V's packed below, and replicated compact-WY T
+    blocks ``tmats[k]`` per panel."""
+
+    p, q = a.grid_shape
+    if a.m < a.n:
+        raise ValueError("pgeqrf requires m >= n (tall); use gelqf "
+                         "semantics for wide problems")
+    ml, nl = a.mtp // p, a.ntp // q
+    nt = ceildiv(a.n, a.nb)
+    if a.mtp < nt or a.ntp < nt:
+        raise ValueError("padded grid too small for the panel count")
+    fn = _build_pgeqrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype))
+    qr_data, tmats, taus = fn(a.data)
+    return like(a, qr_data), tmats, taus
+
+
+@lru_cache(maxsize=None)
+def _build_punmqr(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
+                  dtype_name: str):
+    """Apply Qᴴ (forward sweep) to a row-distributed B from the packed
+    distributed factor (reference ``unmqr``, ``src/unmqr.cc``)."""
+
+    p, q = mesh_grid_shape(mesh)
+
+    def kernel(qr_loc, tmats, b_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = qr_loc.dtype
+        lrows = jnp.arange(ml * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+
+        def body(k, b_loc):
+            kq = k // q
+            colk = lax.dynamic_slice(qr_loc, (0, kq * nb), (ml * nb, nb))
+            colk = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
+            rel = grows - k * nb
+            relc = rel[:, None]
+            cc = jnp.arange(nb)[None, :]
+            v_loc = jnp.where(relc > cc, colk,
+                              jnp.where(relc == cc, 1, 0).astype(dt))
+            v_loc = v_loc * (relc >= 0).astype(dt)
+            tmat = lax.dynamic_slice(tmats, (k, 0, 0), (1, nb, nb))[0]
+            w = lax.psum(_mm(_ct(v_loc), b_loc), AXIS_P)
+            return b_loc - _mm(v_loc, _mm(_ct(tmat), w))
+
+        return lax.fori_loop(0, nt, body, b_loc)
+
+    fn = shard_map(kernel, mesh=mesh,
+                   in_specs=(P(AXIS_P, AXIS_Q), P(), P(AXIS_P, AXIS_Q)),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def punmqr_conj(qr: DistMatrix, tmats, b: DistMatrix) -> DistMatrix:
+    """B ← Qᴴ·B from a :func:`pgeqrf` factor."""
+
+    p, q = qr.grid_shape
+    if b.mtp != qr.mtp or b.nb != qr.nb:
+        raise ValueError("B row padding/tile size must match the factor")
+    ml, nl = qr.mtp // p, qr.ntp // q
+    nrhs_l = (b.ntp // q) * b.nb
+    nt = ceildiv(qr.n, qr.nb)
+    fn = _build_punmqr(qr.mesh, qr.nb, nt, ml, nl, nrhs_l, str(qr.dtype))
+    return like(b, fn(qr.data, tmats, b.data))
+
+
+@lru_cache(maxsize=None)
+def _build_patch_diag_tail(mesh, nb: int, ml: int, nl: int, n_true: int):
+    """Set R[j,j] = 1 for pad columns j ≥ n_true so the padded upper
+    solve stays nonsingular (the pad rows of X are junk and sliced off,
+    but a zero diagonal would turn them into NaN·0 poison)."""
+
+    p, q = mesh_grid_shape(mesh)
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        lrows = jnp.arange(ml * nb)
+        lcols = jnp.arange(nl * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+        mask = (grows[:, None] == gcols[None, :]) & (grows[:, None] >= n_true)
+        return jnp.where(mask, jnp.ones((), a_loc.dtype), a_loc)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def pgels(a, b, mesh, nb: int = 256):
+    """Distributed least squares via QR (reference ``slate::gels_qr``,
+    ``src/gels_qr.cc``): minimizes ‖AX − B‖ for tall full-rank A.
+
+    Accepts dense (replicated) operands; returns ``(qr, tmats, x)`` with
+    ``x`` an n×nrhs DistMatrix (undistribute to read it back).
+    """
+
+    p, q = mesh_grid_shape(mesh)
+    m, n = a.shape
+    ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    bd = distribute(b, mesh, nb, row_mult=q)
+    qr, tmats, taus = pgeqrf(ad)
+    cb = punmqr_conj(qr, tmats, bd)
+    nt = ceildiv(n, nb)
+    ml, nl = qr.mtp // p, qr.ntp // q
+    nrhs_l = (cb.ntp // q) * cb.nb
+    patch = _build_patch_diag_tail(mesh, nb, ml, nl, n)
+    bwd = _build_plu_trsm(mesh, nb, nt, ml, nl, nrhs_l, True, str(qr.dtype))
+    x = bwd(patch(qr.data), cb.data)
+    return qr, tmats, like(cb, x, m=n)
