@@ -46,14 +46,18 @@ def matmul(a, b):
     dense drivers well.
     """
     if (config.use_pallas and a.ndim == 2 and b.ndim == 2
+            and a.dtype == b.dtype
             and jnp.issubdtype(a.dtype, jnp.floating)
-            and jnp.issubdtype(b.dtype, jnp.floating)
             and a.shape[0] % 128 == 0 and b.shape[1] % 128 == 0
             and a.shape[1] % 128 == 0):
         from .pallas_kernels import matmul as pallas_matmul
-        return pallas_matmul(a, b, bm=min(256, a.shape[0]),
-                             bn=min(256, b.shape[1]),
-                             bk=min(512, a.shape[1]))
+
+        def blk(dim, pref):
+            return pref if dim % pref == 0 else 128
+
+        return pallas_matmul(a, b, bm=blk(a.shape[0], 256),
+                             bn=blk(b.shape[1], 256),
+                             bk=blk(a.shape[1], 512))
     return jnp.matmul(a, b, precision=config.matmul_precision)
 
 

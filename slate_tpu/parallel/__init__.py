@@ -17,3 +17,5 @@ from .dist import DistMatrix, distribute, undistribute  # noqa: F401
 from .dist_blas3 import pgemm  # noqa: F401
 from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401
 from .dist_lu import pgetrf, pgetrs, pgesv  # noqa: F401
+from .dist_qr import pgeqrf, pgels, punmqr_conj  # noqa: F401
+from .dist_aux import pnorm, pherk, psyrk, ptrsm  # noqa: F401

@@ -1,0 +1,194 @@
+"""Distributed norms, rank-k updates, and triangular solves.
+
+TPU-native equivalents of the reference's two-phase distributed norms
+(``src/norm.cc`` + ``internal_genorm.cc:812``: per-tile device kernels,
+then MPI reduction) and distributed herk/syrk/trsm drivers
+(``src/herk.cc``, ``src/syrk.cc``, ``src/trsm.cc``): local partials are
+masked to the true (unpadded) region, then reduced with mesh-axis
+collectives — ``psum`` for sums, ``pmax`` for maxima — replacing the
+``MPI_Allreduce`` tail of each norm driver.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..enums import Diag, Norm, Op, Side, Uplo
+from ..ops.blocks import matmul as _mm
+from .dist import DistMatrix, like
+from .dist_lu import _gather_positions
+from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+
+def _local_index_maps(p, q, ml, nl, nb, r, c):
+    lrows = jnp.arange(ml * nb)
+    lcols = jnp.arange(nl * nb)
+    grows = ((lrows // nb) * p + r) * nb + lrows % nb
+    gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+    return grows, gcols
+
+
+@lru_cache(maxsize=None)
+def _build_pnorm(mesh, nb: int, ml: int, nl: int, m: int, n: int,
+                 which: str):
+    p, q = mesh_grid_shape(mesh)
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        grows, gcols = _local_index_maps(p, q, ml, nl, nb, r, c)
+        valid = ((grows < m)[:, None] & (gcols < n)[None, :])
+        absa = jnp.abs(a_loc) * valid
+        if which == "max":
+            v = jnp.max(absa)
+            return lax.pmax(lax.pmax(v, AXIS_P), AXIS_Q)
+        if which == "one":
+            colsums = lax.psum(jnp.sum(absa, axis=0), AXIS_P)
+            v = jnp.max(colsums)
+            return lax.pmax(lax.pmax(v, AXIS_Q), AXIS_P)
+        if which == "inf":
+            rowsums = lax.psum(jnp.sum(absa, axis=1), AXIS_Q)
+            v = jnp.max(rowsums)
+            return lax.pmax(lax.pmax(v, AXIS_P), AXIS_Q)
+        # fro
+        ss = lax.psum(lax.psum(jnp.sum(absa * absa), AXIS_P), AXIS_Q)
+        return jnp.sqrt(ss)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=P())
+    return jax.jit(fn)
+
+
+_NORM_KEY = {Norm.Max: "max", Norm.One: "one", Norm.Inf: "inf",
+             Norm.Fro: "fro"}
+
+
+def pnorm(a: DistMatrix, norm: Norm = Norm.Fro):
+    """Distributed matrix norm (reference ``slate::norm``,
+    ``src/norm.cc``): max/one/inf/fro over the true m×n region; padding
+    (including any ``diag_pad`` identity) is masked out."""
+
+    p, q = a.grid_shape
+    fn = _build_pnorm(a.mesh, a.nb, a.mtp // p, a.ntp // q, a.m, a.n,
+                      _NORM_KEY[norm])
+    real = jnp.abs(jnp.zeros((), a.dtype)).dtype
+    return fn(a.data).astype(real)
+
+
+@lru_cache(maxsize=None)
+def _build_pherk(mesh, nb: int, ktp: int, ml: int, nl: int, conj: bool,
+                 dtype_name: str):
+    p, q = mesh_grid_shape(mesh)
+    mtp = p * ml
+
+    def kernel(a_loc, c_loc, alpha, beta):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        j_idx = jnp.arange(nl) * q + c
+        # position of global row-block j inside the 'p'-axis all_gather
+        gpos = jnp.take(jnp.asarray(_gather_positions(mtp, p)), j_idx)
+
+        def body(k, acc):
+            # A block-column k → broadcast along 'q' (rows stay local)
+            a_panel = lax.dynamic_slice(a_loc, (0, (k // q) * nb),
+                                        (ml * nb, nb))
+            a_col = lax.psum(a_panel * (k % q == c).astype(dt), AXIS_Q)
+            # (Aᴴ) block-row k restricted to my column blocks: gather A's
+            # rows along 'p' and pick the ones matching j_idx (the same
+            # move as ppotrf's trailing W, dist_factor.py)
+            ag = lax.all_gather(a_col, AXIS_P, axis=0, tiled=True)
+            rows = jnp.take(ag.reshape(mtp, nb, nb), gpos, axis=0)
+            rows = jnp.conj(rows) if conj else rows
+            right = jnp.transpose(rows, (2, 0, 1)).reshape(nb, nl * nb)
+            return acc + _mm(a_col, right)
+
+        acc = lax.fori_loop(0, ktp, body, jnp.zeros_like(c_loc))
+        return alpha * acc + beta * c_loc
+
+    fn = shard_map(kernel, mesh=mesh,
+                   in_specs=(P(AXIS_P, AXIS_Q), P(AXIS_P, AXIS_Q), P(), P()),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def _pherk_like(alpha, a: DistMatrix, beta, c: DistMatrix, conj: bool):
+    p, q = a.grid_shape
+    if c is None:
+        # create C sharded from the start — a replicated (mtp·nb)² zeros
+        # buffer on one device would OOM at exactly the scale pherk targets
+        cdata = jnp.zeros(
+            (a.mtp * a.nb, a.mtp * a.nb), a.dtype,
+            device=jax.sharding.NamedSharding(a.mesh, P(AXIS_P, AXIS_Q)))
+        c = DistMatrix(cdata, a.m, a.m, a.nb, a.mesh)
+        beta = 0.0
+    if c.mtp != a.mtp or c.ntp != a.mtp:
+        raise ValueError("C padding must be square and match A's rows "
+                         "(distribute A with row_mult=q, C with both mults)")
+    ml = a.mtp // p
+    nl = c.ntp // q
+    fn = _build_pherk(a.mesh, a.nb, a.ntp, ml, nl, conj, str(a.dtype))
+    dt = a.dtype
+    out = fn(a.data, c.data, jnp.asarray(alpha, dt), jnp.asarray(beta, dt))
+    return like(c, out)
+
+
+def pherk(alpha, a: DistMatrix, beta=0.0, c: DistMatrix = None):
+    """C ← α·A·Aᴴ + β·C distributed (reference ``slate::herk``,
+    ``src/herk.cc``).  The full (not just triangular) result is stored —
+    dense storage makes the mirror element free on TPU."""
+    return _pherk_like(alpha, a, beta, c, True)
+
+
+def psyrk(alpha, a: DistMatrix, beta=0.0, c: DistMatrix = None):
+    """C ← α·A·Aᵀ + β·C distributed (reference ``slate::syrk``)."""
+    return _pherk_like(alpha, a, beta, c, False)
+
+
+def ptrsm(side: Side, uplo: Uplo, op: Op, diag: Diag,
+          a: DistMatrix, b: DistMatrix) -> DistMatrix:
+    """Distributed triangular solve A·X = B (reference ``slate::trsm``,
+    ``src/trsm.cc``).
+
+    Supported combinations (the ones the distributed drivers need):
+    Left Lower NoTrans (unit or non-unit), Left Lower ConjTrans
+    (non-unit), Left Upper NoTrans (non-unit).
+    """
+
+    from ..grid import ceildiv
+    from .dist_factor import _build_ptrsm as _chol_trsm
+    from .dist_lu import _build_plu_trsm as _lu_trsm
+
+    if side is not Side.Left:
+        raise NotImplementedError("ptrsm: only Side.Left is distributed; "
+                                  "transpose the equation for Right")
+    p, q = a.grid_shape
+    if b.nb != a.nb or b.mtp != a.mtp:
+        raise ValueError("B tiling must match A (distribute with "
+                         "row_mult=q)")
+    ml, nl = a.mtp // p, a.ntp // q
+    nrhs_l = (b.ntp // q) * b.nb
+    nt = ceildiv(a.n, a.nb)
+    key = (uplo, op, diag)
+    if key == (Uplo.Lower, Op.NoTrans, Diag.NonUnit):
+        fn = _chol_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, False,
+                        str(a.dtype))
+    elif key == (Uplo.Lower, Op.ConjTrans, Diag.NonUnit):
+        fn = _chol_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, True,
+                        str(a.dtype))
+    elif key == (Uplo.Lower, Op.NoTrans, Diag.Unit):
+        fn = _lu_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, False,
+                      str(a.dtype))
+    elif key == (Uplo.Upper, Op.NoTrans, Diag.NonUnit):
+        fn = _lu_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, True,
+                      str(a.dtype))
+    else:
+        raise NotImplementedError(f"ptrsm combination {key}")
+    return like(b, fn(a.data, b.data))

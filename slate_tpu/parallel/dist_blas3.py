@@ -91,9 +91,10 @@ def pgemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
             "(or use pgemm_auto)")
     if c is None:
         p, q = a.grid_shape
-        cdata = jnp.zeros((a.mtp * a.nb, b.ntp * b.nb), a.dtype)
-        cdata = jax.device_put(
-            cdata, jax.sharding.NamedSharding(a.mesh, P(AXIS_P, AXIS_Q)))
+        # sharded-at-creation zeros (a device-0 buffer would OOM at scale)
+        cdata = jnp.zeros(
+            (a.mtp * a.nb, b.ntp * b.nb), a.dtype,
+            device=jax.sharding.NamedSharding(a.mesh, P(AXIS_P, AXIS_Q)))
         c = DistMatrix(cdata, a.m, b.n, a.nb, a.mesh)
     fn = _build_pgemm(a.mesh, a.nb, a.ntp, str(a.dtype))
     out = fn(a.data, b.data, c.data,
