@@ -1,0 +1,7 @@
+"""Make the in-tree slate_tpu package importable when examples run from
+this directory (no install step, mirroring the reference's in-tree
+example builds)."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))

@@ -1,0 +1,53 @@
+/* C API smoke test (reference lapack_api/example_dgetrf.c analog):
+ * build:  gcc c_api_smoke.c -I../include -L../slate_tpu/native \
+ *             -l:_slate_host.so -Wl,-rpath,../slate_tpu/native -o c_smoke
+ * The Python package builds _slate_host.so on first use; run
+ * `python -c "import slate_tpu.native as n; n.available()"` first. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <math.h>
+#include "slate_tpu.h"
+
+int main(void) {
+    const int64_t n = 192, nrhs = 4, nb = 64;
+    double *a = malloc(n * n * sizeof *a);
+    double *acpy = malloc(n * n * sizeof *a);
+    double *b = malloc(n * nrhs * sizeof *b);
+    double *x = malloc(n * nrhs * sizeof *b);
+    srand(0);
+    /* SPD: A = G G^T + n I, col-major */
+    double *g = malloc(n * n * sizeof *g);
+    for (int64_t i = 0; i < n * n; ++i) g[i] = rand() / (double)RAND_MAX - 0.5;
+    for (int64_t j = 0; j < n; ++j)
+        for (int64_t i = 0; i < n; ++i) {
+            double s = (i == j) ? (double)n : 0.0;
+            for (int64_t k = 0; k < n; ++k) s += g[k * n + i] * g[k * n + j];
+            a[j * n + i] = s; acpy[j * n + i] = s;
+        }
+    for (int64_t i = 0; i < n * nrhs; ++i) { b[i] = rand() / (double)RAND_MAX; x[i] = b[i]; }
+
+    int info = slate_host_potrf_f64(a, n, nb);
+    if (info != 0) { printf("potrf failed: %d\n", info); return 1; }
+    slate_host_potrs_f64(a, n, x, nrhs, nb);
+
+    /* residual ||A x - b|| */
+    double r2 = 0, b2 = 0;
+    for (int64_t j = 0; j < nrhs; ++j)
+        for (int64_t i = 0; i < n; ++i) {
+            double s = -b[j * n + i];
+            for (int64_t k = 0; k < n; ++k) s += acpy[k * n + i] * x[j * n + k];
+            r2 += s * s; b2 += b[j * n + i] * b[j * n + i];
+        }
+    printf("relative residual: %.3e\n", sqrt(r2 / b2));
+    if (sqrt(r2 / b2) > 1e-10) return 1;
+
+    /* pool + numroc sanity */
+    void* pool = slate_pool_create(4096);
+    void* blk = slate_pool_alloc(pool);
+    slate_pool_free(pool, blk);
+    if (slate_pool_num_free(pool) != 1) return 1;
+    slate_pool_destroy(pool);
+    if (slate_numroc(100, 16, 1, 4) <= 0) return 1;
+    printf("ok: C API smoke\n");
+    return 0;
+}

@@ -1,0 +1,18 @@
+"""Matrix hierarchy and views (reference examples/ex01_matrix.cc).
+
+Create tiled matrices, inspect the tile grid, take transposed views.
+"""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+a = st.Matrix.from_array(jnp.arange(12.0 * 8).reshape(12, 8), mb=4, nb=4)
+print(a, "tiles:", a.mt, "x", a.nt)
+t = a.transpose()
+assert t.m == 8 and t.n == 12
+h = st.HermitianMatrix(jnp.eye(8) * 2, uplo=st.Uplo.Lower, mb=4, nb=4)
+tri = st.TriangularMatrix(jnp.tril(jnp.ones((8, 8))), uplo=st.Uplo.Lower,
+                          diag=st.Diag.Unit, mb=4, nb=4)
+band = st.BandMatrix(jnp.eye(8), kl=1, ku=2, mb=4, nb=4)
+print("ok: matrix hierarchy constructed")

@@ -1,0 +1,17 @@
+"""Precision/type conversion copies (reference examples/ex02_conversion.cc).
+
+slate::copy converts precision tile by tile; here `copy` is one fused cast.
+"""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+a = st.Matrix.from_array(jnp.asarray(np.random.default_rng(0)
+                                     .standard_normal((64, 64)), jnp.float32),
+                         mb=16, nb=16)
+a16 = st.copy(a, dtype=jnp.bfloat16)
+assert a16.array.dtype == jnp.bfloat16
+back = st.copy(a16, dtype=jnp.float32)
+assert np.abs(np.asarray(back.array) - np.asarray(a.array)).max() < 0.02
+print("ok: precision-converting copy")

@@ -1,0 +1,14 @@
+"""Submatrix and slice views (reference examples/ex03_submatrix.cc).
+
+sub() is tile-aligned, slice() element-aligned (BaseMatrix.hh sub/slice).
+"""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import slate_tpu as st
+
+a = st.Matrix.from_array(jnp.arange(16.0 * 16).reshape(16, 16), mb=4, nb=4)
+s = a.sub(1, 2, 1, 2)          # tile rows 1..2, tile cols 1..2
+assert s.m == 8 and s.n == 8
+sl = a.slice(2, 9, 3, 12)      # element rows 2..9, cols 3..12
+assert sl.m == 8 and sl.n == 10
+print("ok: sub/slice views")

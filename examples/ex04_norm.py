@@ -1,0 +1,15 @@
+"""Matrix norms (reference examples/ex04_norm.cc)."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+rng = np.random.default_rng(0)
+a = jnp.asarray(rng.standard_normal((96, 64)), jnp.float32)
+for which, ref in [(st.Norm.Max, np.abs(np.asarray(a)).max()),
+                   (st.Norm.One, np.linalg.norm(np.asarray(a), 1)),
+                   (st.Norm.Inf, np.linalg.norm(np.asarray(a), np.inf)),
+                   (st.Norm.Fro, np.linalg.norm(np.asarray(a)))]:
+    got = float(st.norm(which, a))
+    assert abs(got - ref) / ref < 1e-5, (which, got, ref)
+print("ok: norms match numpy")

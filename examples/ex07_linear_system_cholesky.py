@@ -1,0 +1,15 @@
+"""Cholesky linear systems (reference ex07_linear_system_cholesky.cc)."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+from slate_tpu.testing import random_spd
+
+n = 96
+a = random_spd(n, dtype=jnp.float32, seed=3)
+b = jnp.asarray(np.random.default_rng(4).standard_normal((n, 4)), jnp.float32)
+A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=32, nb=32)
+fac, x = st.posv(A, b)
+r = np.linalg.norm(np.asarray(a) @ np.asarray(x) - np.asarray(b))
+assert r / n < 1e-3
+print("ok: posv residual", r)

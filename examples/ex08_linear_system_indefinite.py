@@ -1,0 +1,17 @@
+"""Hermitian-indefinite solve (reference ex08_linear_system_indefinite.cc):
+hesv via the pivoted LTL^H factorization."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+rng = np.random.default_rng(5)
+n = 64
+x0 = rng.standard_normal((n, n))
+a = jnp.asarray((x0 + x0.T) / 2, jnp.float32)   # indefinite symmetric
+b = jnp.asarray(rng.standard_normal((n, 3)), jnp.float32)
+A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=16, nb=16)
+fac, x = st.hesv(A, b)
+r = np.linalg.norm(np.asarray(a) @ np.asarray(x) - np.asarray(b))
+assert r / (np.linalg.norm(np.asarray(a)) * n) < 1e-4, r
+print("ok: hesv residual", r)

@@ -1,0 +1,16 @@
+"""Least squares (reference ex09_least_squares.cc): gels auto-selects
+QR vs CholQR per shape/conditioning (method.hh:236)."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+rng = np.random.default_rng(6)
+m, n = 128, 48
+a = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+b = jnp.asarray(rng.standard_normal((m, 2)), jnp.float32)
+x = st.gels(a, b)
+xv = np.asarray(getattr(x, "array", x))
+xr = np.linalg.lstsq(np.asarray(a), np.asarray(b), rcond=None)[0]
+assert np.abs(xv - xr).max() < 5e-3
+print("ok: gels matches lstsq")

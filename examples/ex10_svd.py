@@ -1,0 +1,12 @@
+"""Two-stage SVD (reference ex10_svd.cc): ge2tb -> tb2bd -> bdsqr."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+rng = np.random.default_rng(7)
+a = jnp.asarray(rng.standard_normal((48, 32)), jnp.float32)
+s = st.svd_vals(a)
+sr = np.linalg.svd(np.asarray(a), compute_uv=False)
+assert np.abs(np.sort(np.asarray(s))[::-1] - sr).max() < 1e-3
+print("ok: singular values match")

@@ -1,0 +1,16 @@
+"""Hermitian eigensolver (reference ex11_hermitian_eig.cc): two-stage
+he2hb -> hb2st -> tridiagonal D&C."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+rng = np.random.default_rng(8)
+n = 48
+x0 = rng.standard_normal((n, n))
+a = jnp.asarray((x0 + x0.T) / 2, jnp.float32)
+A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=16, nb=16)
+w, z = st.heev(A)
+wr = np.linalg.eigvalsh(np.asarray(a))
+assert np.abs(np.asarray(w) - wr).max() < 2e-3 * max(1.0, np.abs(wr).max())
+print("ok: eigenvalues match")

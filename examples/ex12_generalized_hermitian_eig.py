@@ -1,0 +1,20 @@
+"""Generalized Hermitian-definite eig (reference
+ex12_generalized_hermitian_eig.cc): hegv = potrf + hegst + heev."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import scipy.linalg
+import slate_tpu as st
+from slate_tpu.testing import random_spd
+
+rng = np.random.default_rng(9)
+n = 32
+x0 = rng.standard_normal((n, n))
+a = jnp.asarray((x0 + x0.T) / 2, jnp.float32)
+b = random_spd(n, dtype=jnp.float32, seed=10)
+A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=16, nb=16)
+B = st.HermitianMatrix(b, uplo=st.Uplo.Lower, mb=16, nb=16)
+w, z = st.hegv(A, B)
+wr = scipy.linalg.eigh(np.asarray(a), np.asarray(b), eigvals_only=True)
+assert np.abs(np.asarray(w) - wr).max() < 1e-2
+print("ok: generalized eigenvalues match")

@@ -1,0 +1,15 @@
+"""Non-uniform tiles (reference ex13_non_uniform_block_size.cc):
+rectangular mb != nb tiling and ragged final tiles."""
+import _path  # noqa: F401  (in-tree import bootstrap)
+import jax.numpy as jnp
+import numpy as np
+import slate_tpu as st
+
+a = st.Matrix.from_array(jnp.arange(100.0 * 70).reshape(100, 70),
+                         mb=48, nb=32)
+assert a.mt == 3 and a.nt == 3          # ragged tails
+assert a.tile_mb(2) == 4 and a.tile_nb(2) == 6
+t = a.tile(2, 1)
+np.testing.assert_array_equal(np.asarray(t),
+                              np.asarray(a.array)[96:100, 32:64])
+print("ok: non-uniform tiling")

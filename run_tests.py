@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Suite orchestrator over tester.py — the analog of the reference's
+``test/run_tests.py`` (size classes, per-run timeouts, summary, exit
+code for CI).
+
+Usage:
+  python run_tests.py --quick              # small dims, every routine
+  python run_tests.py -m                   # medium dims
+  python run_tests.py --routines gemm,posv --types s,d
+  python run_tests.py --dist               # distributed routines too
+                                           # (use a CPU mesh: JAX_PLATFORMS=cpu
+                                           #  XLA_FLAGS=--xla_force_host_platform_device_count=8)
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+import sys
+import time
+
+QUICK = "128"
+SMALL = "256"
+MEDIUM = "512,1024"
+
+SINGLE = ["gemm", "symm", "hemm", "syrk", "herk", "syr2k", "her2k", "trmm",
+          "trsm", "norm", "potrf", "potrs", "posv", "getrf", "gesv",
+          "gesv_mixed", "getri", "geqrf", "cholqr", "gels", "hesv", "gbsv",
+          "heev", "svd"]
+DIST = ["ppotrf", "pgesv", "pgeqrf"]
+# the dense two-stage eig/SVD and inverse testers are O(n^3) with big
+# constants at small nb — keep their dims small in every class
+SLOW = {"heev", "svd", "getri", "gesv_mixed", "hesv"}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("-m", "--medium", action="store_true")
+    ap.add_argument("--dist", action="store_true",
+                    help="include distributed routines")
+    ap.add_argument("--routines", help="comma list (default: all)")
+    ap.add_argument("--types", default="s")
+    ap.add_argument("--nb", type=int, default=64)
+    ap.add_argument("--timeout", type=int, default=600)
+    args = ap.parse_args(argv)
+
+    dims = QUICK if args.quick else (MEDIUM if args.medium else SMALL)
+    routines = (args.routines.split(",") if args.routines
+                else SINGLE + (DIST if args.dist else []))
+    failures, t0 = [], time.time()
+    for r in routines:
+        d = QUICK if (r in SLOW and not args.quick) else dims
+        tester = str(pathlib.Path(__file__).resolve().parent / "tester.py")
+        cmd = [sys.executable, tester, r, "--dim", d,
+               "--type", args.types, "--nb", str(args.nb)]
+        print(f"=== {' '.join(cmd[1:])}", flush=True)
+        try:
+            rc = subprocess.run(cmd, timeout=args.timeout).returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+        if rc != 0:
+            failures.append((r, rc))
+    dt = time.time() - t0
+    print(f"\n==== {len(routines) - len(failures)}/{len(routines)} routine "
+          f"suites passed in {dt:.0f}s ====")
+    for r, rc in failures:
+        print(f"  FAILED: {r} (rc={rc})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,14 +87,13 @@ def pgemm(transa: str, transb: str, alpha, a_lg, desca, b_lg, descb,
                        else jnp.conj(x.T) if t.upper() == "C" else x)
     av, bv = op(av, transa), op(bv, transb)
     if mesh is not None:
-        from ..parallel.dist import distribute, undistribute
-        from ..parallel.dist_blas3 import pgemm as dist_pgemm
-        da = distribute(av, mesh, desca.nb)
-        db = distribute(bv, mesh, desca.nb)
-        prod = undistribute(dist_pgemm(da, db))
+        from ..parallel.dist import undistribute
+        from ..parallel.dist_blas3 import pgemm_auto
+        prod = undistribute(pgemm_auto(1.0, av, bv, mesh, desca.nb))
         out = alpha * prod + beta * cv
     else:
-        out = alpha * (av @ bv) + beta * cv
+        from ..ops.blocks import matmul
+        out = alpha * matmul(av, bv) + beta * cv
     return _scatter(out, grid, descc)
 
 

@@ -77,7 +77,7 @@ def larft_rec(v, tau):
     t1 = larft_rec(v[:, :k1], tau[:k1])
     t2 = larft_rec(v[:, k1:], tau[k1:])
     # the cross block only involves rows where V₂ is nonzero
-    t12 = -matmul(t1, matmul(_ct(v[k1:, :k1]), v[k1:, k1:]) @ t2)
+    t12 = -matmul(t1, matmul(matmul(_ct(v[k1:, :k1]), v[k1:, k1:]), t2))
     top = jnp.concatenate([t1, t12], axis=1)
     bot = jnp.concatenate([jnp.zeros((k - k1, k1), v.dtype), t2], axis=1)
     return jnp.concatenate([top, bot], axis=0)

@@ -87,9 +87,20 @@ def _load():
         lib.slate_batch_transpose_f64.argtypes = [i64, i64, i64, p, p]
         lib.slate_host_potrf_f64.restype = c.c_int
         lib.slate_host_potrf_f64.argtypes = [p, i64, i64]
+        lib.slate_host_potrf_f32.restype = c.c_int
+        lib.slate_host_potrf_f32.argtypes = [p, i64, i64]
         lib.slate_host_gemm_f64.argtypes = [
             i64, i64, i64, c.c_double, p, i64, p, i64, c.c_double, p, i64,
             i64]
+        lib.slate_host_gemm_f32.argtypes = [
+            i64, i64, i64, c.c_float, p, i64, p, i64, c.c_float, p, i64,
+            i64]
+        lib.slate_host_trsm_f64.argtypes = [
+            c.c_char, c.c_char, c.c_char, i64, i64, c.c_double, p, i64, p,
+            i64, i64]
+        lib.slate_host_potrs_f64.argtypes = [p, i64, p, i64, i64]
+        lib.slate_host_gesv_f64.restype = c.c_int
+        lib.slate_host_gesv_f64.argtypes = [p, i64, p, i64, p]
         lib.slate_host_num_threads.restype = c.c_int
         _lib = lib
         return _lib
@@ -244,6 +255,40 @@ def host_gemm(a: np.ndarray, b: np.ndarray, nb: int = 256,
     lib.slate_host_gemm_f64(m, n, k, alpha, _c_ptr(a), m, _c_ptr(b), k,
                             beta, _c_ptr(cv), m, nb)
     return cv
+
+
+def host_potrs(l: np.ndarray, b: np.ndarray, nb: int = 128) -> np.ndarray:
+    """Solve from the host Cholesky factor: two tiled trsm sweeps
+    (reference ``src/potrs.cc``)."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    l = np.asfortranarray(l, dtype=np.float64)
+    bv = np.asfortranarray(b, dtype=np.float64).copy(order="F")
+    bv2 = bv.reshape(bv.shape[0], -1)
+    lib.slate_host_potrs_f64(_c_ptr(l), l.shape[0], _c_ptr(bv2),
+                             bv2.shape[1], nb)
+    return bv.reshape(b.shape)
+
+
+def host_gesv(a: np.ndarray, b: np.ndarray):
+    """Dense LU solve on the host runtime (the C API's ``slate_gesv``
+    analog).  Returns ``(x, ipiv)``."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    av = np.asfortranarray(a, dtype=np.float64).copy(order="F")
+    bv = np.asfortranarray(b, dtype=np.float64).copy(order="F")
+    bv2 = bv.reshape(bv.shape[0], -1)
+    n = av.shape[0]
+    ipiv = np.zeros(n, dtype=np.int32)
+    info = lib.slate_host_gesv_f64(_c_ptr(av), n, _c_ptr(bv2),
+                                   bv2.shape[1], _c_ptr(ipiv))
+    if info != 0:
+        raise np.linalg.LinAlgError(f"gesv: singular factor ({info})")
+    return bv.reshape(b.shape), ipiv
 
 
 def num_threads() -> int:

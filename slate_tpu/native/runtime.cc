@@ -53,6 +53,9 @@ void ssyrk_(const char*, const char*, const int*, const int*, const float*,
             const float*, const int*, const float*, float*, const int*);
 void dpotrf_(const char*, const int*, double*, const int*, int*);
 void spotrf_(const char*, const int*, float*, const int*, int*);
+void dgetrf_(const int*, const int*, double*, const int*, int*, int*);
+void dgetrs_(const char*, const int*, const int*, const double*, const int*,
+             const int*, double*, const int*, int*);
 }
 
 extern "C" {
@@ -214,17 +217,68 @@ void slate_batch_transpose_f64(int64_t nt, int64_t m, int64_t n,
 // over nb-square tiles of a column-major matrix, tile math via BLAS.
 // ---------------------------------------------------------------------------
 
+}  // extern "C"
+
+// Precision-overloaded shims so the task DAGs below are written once.
+static inline void xpotrf(const char* u, const int* n, double* a,
+                          const int* lda, int* info) {
+    dpotrf_(u, n, a, lda, info);
+}
+static inline void xpotrf(const char* u, const int* n, float* a,
+                          const int* lda, int* info) {
+    spotrf_(u, n, a, lda, info);
+}
+static inline void xtrsm(const char* s, const char* u, const char* t,
+                         const char* d, const int* m, const int* n,
+                         const double* al, const double* a, const int* lda,
+                         double* b, const int* ldb) {
+    dtrsm_(s, u, t, d, m, n, al, a, lda, b, ldb);
+}
+static inline void xtrsm(const char* s, const char* u, const char* t,
+                         const char* d, const int* m, const int* n,
+                         const float* al, const float* a, const int* lda,
+                         float* b, const int* ldb) {
+    strsm_(s, u, t, d, m, n, al, a, lda, b, ldb);
+}
+static inline void xsyrk(const char* u, const char* t, const int* n,
+                         const int* k, const double* al, const double* a,
+                         const int* lda, const double* be, double* c,
+                         const int* ldc) {
+    dsyrk_(u, t, n, k, al, a, lda, be, c, ldc);
+}
+static inline void xsyrk(const char* u, const char* t, const int* n,
+                         const int* k, const float* al, const float* a,
+                         const int* lda, const float* be, float* c,
+                         const int* ldc) {
+    ssyrk_(u, t, n, k, al, a, lda, be, c, ldc);
+}
+static inline void xgemm(const char* ta, const char* tb, const int* m,
+                         const int* n, const int* k, const double* al,
+                         const double* a, const int* lda, const double* b,
+                         const int* ldb, const double* be, double* c,
+                         const int* ldc) {
+    dgemm_(ta, tb, m, n, k, al, a, lda, b, ldb, be, c, ldc);
+}
+static inline void xgemm(const char* ta, const char* tb, const int* m,
+                         const int* n, const int* k, const float* al,
+                         const float* a, const int* lda, const float* b,
+                         const int* ldb, const float* be, float* c,
+                         const int* ldc) {
+    sgemm_(ta, tb, m, n, k, al, a, lda, b, ldb, be, c, ldc);
+}
+
 // Cholesky (lower) of col-major n x n with leading dim n.
 // Task graph identical in shape to src/potrf.cc:210-288:
 //   potrf(diag) -> trsm(panel below) -> syrk/gemm(trailing).
-int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
+template <typename T>
+static int host_potrf_tiled(T* a, int64_t n, int64_t nb) {
     int info_out = 0;
     int64_t nt = (n + nb - 1) / nb;
     auto tile = [&](int64_t i, int64_t j) { return a + j * nb * n + i * nb; };
     auto tsz = [&](int64_t i) {
         return (int)std::min(nb, n - i * nb);
     };
-    const double one = 1.0, neg_one = -1.0;
+    const T one = 1, neg_one = -1;
     const int in = (int)n;
     #pragma omp parallel
     #pragma omp master
@@ -232,7 +286,7 @@ int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
         #pragma omp task depend(inout: a[k * nb * n + k * nb])
         {
             int kn = tsz(k), info = 0;
-            dpotrf_("L", &kn, tile(k, k), &in, &info);
+            xpotrf("L", &kn, tile(k, k), &in, &info);
             if (info != 0) {
                 #pragma omp atomic write
                 info_out = (int)(info + k * nb);
@@ -243,8 +297,8 @@ int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
                              depend(inout: a[k * nb * n + i * nb])
             {
                 int kn = tsz(k), im = tsz(i);
-                dtrsm_("R", "L", "C", "N", &im, &kn, &one, tile(k, k), &in,
-                       tile(i, k), &in);
+                xtrsm("R", "L", "C", "N", &im, &kn, &one, tile(k, k), &in,
+                      tile(i, k), &in);
             }
         }
         for (int64_t j = k + 1; j < nt; ++j) {
@@ -252,8 +306,8 @@ int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
                              depend(inout: a[j * nb * n + j * nb])
             {
                 int jn = tsz(j), kn = tsz(k);
-                dsyrk_("L", "N", &jn, &kn, &neg_one, tile(j, k), &in, &one,
-                       tile(j, j), &in);
+                xsyrk("L", "N", &jn, &kn, &neg_one, tile(j, k), &in, &one,
+                      tile(j, j), &in);
             }
             for (int64_t i = j + 1; i < nt; ++i) {
                 #pragma omp task depend(in: a[k * nb * n + i * nb]) \
@@ -261,8 +315,8 @@ int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
                                  depend(inout: a[j * nb * n + i * nb])
                 {
                     int im = tsz(i), jn = tsz(j), kn = tsz(k);
-                    dgemm_("N", "C", &im, &jn, &kn, &neg_one, tile(i, k),
-                           &in, tile(j, k), &in, &one, tile(i, j), &in);
+                    xgemm("N", "C", &im, &jn, &kn, &neg_one, tile(i, k),
+                          &in, tile(j, k), &in, &one, tile(i, j), &in);
                 }
             }
         }
@@ -272,10 +326,10 @@ int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
 
 // C (m x n) += A (m x k) * B (k x n), all col-major with given lds; tiled
 // omp tasks per C tile (internal_gemm.cc HostTask variant).
-void slate_host_gemm_f64(int64_t m, int64_t n, int64_t k, double alpha,
-                         const double* a, int64_t lda, const double* b,
-                         int64_t ldb, double beta, double* c, int64_t ldc,
-                         int64_t nb) {
+template <typename T>
+static void host_gemm_tiled(int64_t m, int64_t n, int64_t k, T alpha,
+                            const T* a, int64_t lda, const T* b, int64_t ldb,
+                            T beta, T* c, int64_t ldc, int64_t nb) {
     int64_t mt = (m + nb - 1) / nb, ntt = (n + nb - 1) / nb;
     const int ik = (int)k, ilda = (int)lda, ildb = (int)ldb, ildc = (int)ldc;
     #pragma omp parallel
@@ -286,11 +340,77 @@ void slate_host_gemm_f64(int64_t m, int64_t n, int64_t k, double alpha,
             {
                 int im = (int)std::min(nb, m - i * nb);
                 int jn = (int)std::min(nb, n - j * nb);
-                dgemm_("N", "N", &im, &jn, &ik, &alpha, a + i * nb, &ilda,
-                       b + j * nb * ldb, &ildb, &beta,
-                       c + j * nb * ldc + i * nb, &ildc);
+                xgemm("N", "N", &im, &jn, &ik, &alpha, a + i * nb, &ilda,
+                      b + j * nb * ldb, &ildb, &beta,
+                      c + j * nb * ldc + i * nb, &ildc);
             }
         }
+}
+
+extern "C" {
+
+int slate_host_potrf_f64(double* a, int64_t n, int64_t nb) {
+    return host_potrf_tiled(a, n, nb);
+}
+
+int slate_host_potrf_f32(float* a, int64_t n, int64_t nb) {
+    return host_potrf_tiled(a, n, nb);
+}
+
+void slate_host_gemm_f64(int64_t m, int64_t n, int64_t k, double alpha,
+                         const double* a, int64_t lda, const double* b,
+                         int64_t ldb, double beta, double* c, int64_t ldc,
+                         int64_t nb) {
+    host_gemm_tiled(m, n, k, alpha, a, lda, b, ldb, beta, c, ldc, nb);
+}
+
+// Left triangular solve over the tiles of B (n x nrhs, col-major, ld n):
+// uplo 'L'/'U', trans 'N'/'T'/'C', diag 'N'/'U'; A is n x n col-major.
+// Column-parallel omp tasks, one dtrsm per B block column
+// (src/work/work_trsm.cc shape).
+void slate_host_trsm_f64(char uplo, char trans, char diag, int64_t n,
+                         int64_t nrhs, double alpha, const double* a,
+                         int64_t lda, double* b, int64_t ldb, int64_t nb) {
+    int64_t ct = (nrhs + nb - 1) / nb;
+    const int in = (int)n, ilda = (int)lda, ildb = (int)ldb;
+    const char side = 'L';
+    #pragma omp parallel
+    #pragma omp master
+    for (int64_t j = 0; j < ct; ++j) {
+        #pragma omp task firstprivate(j)
+        {
+            int jn = (int)std::min(nb, nrhs - j * nb);
+            dtrsm_(&side, &uplo, &trans, &diag, &in, &jn, &alpha,
+                   a, &ilda, b + j * nb * ldb, &ildb);
+        }
+    }
+}
+
+// Solve A X = B from the lower Cholesky factor: L y = b; L^H x = y.
+void slate_host_potrs_f64(const double* l, int64_t n, double* b,
+                          int64_t nrhs, int64_t nb) {
+    slate_host_trsm_f64('L', 'N', 'N', n, nrhs, 1.0, l, n, b, n, nb);
+    slate_host_trsm_f64('L', 'C', 'N', n, nrhs, 1.0, l, n, b, n, nb);
+}
+
+// Dense LU solve (col-major) — the C-API convenience the reference
+// exposes as slate_gesv_* (include/slate/c_api/slate.h).
+int slate_host_gesv_f64(double* a, int64_t n, double* b, int64_t nrhs,
+                        int32_t* ipiv) {
+    const int in = (int)n, irhs = (int)nrhs;
+    int info = 0;
+    dgetrf_(&in, &in, a, &in, ipiv, &info);
+    if (info != 0) return info;
+    dgetrs_("N", &in, &irhs, a, &in, ipiv, b, &in, &info);
+    return info;
+}
+
+// f32 tiled gemm (internal_gemm.cc HostTask variant).
+void slate_host_gemm_f32(int64_t m, int64_t n, int64_t k, float alpha,
+                         const float* a, int64_t lda, const float* b,
+                         int64_t ldb, float beta, float* c, int64_t ldc,
+                         int64_t nb) {
+    host_gemm_tiled(m, n, k, alpha, a, lda, b, ldb, beta, c, ldc, nb);
 }
 
 int slate_host_num_threads() { return omp_get_max_threads(); }

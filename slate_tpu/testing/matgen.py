@@ -30,6 +30,8 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from ..ops.blocks import matmul as _mm
 import numpy as np
 
 
@@ -119,12 +121,12 @@ def generate_matrix(kind: str, m: int, n: Optional[int] = None, *,
                 signs = jnp.asarray(np.where(np.arange(k) % 2 == 0, 1.0, -1.0),
                                     gen_dtype)
                 s = s * signs
-            a = (u * s[None, :]) @ jnp.conj(u.T)
+            a = _mm(u * s[None, :], jnp.conj(u.T))
             # force exact Hermitian-ness after rounding
             a = (a + jnp.conj(a.T)) / 2
         else:
             v = _haar(kv, n, k, gen_dtype)
-            a = (u * s[None, :]) @ jnp.conj(v.T)
+            a = _mm(u * s[None, :], jnp.conj(v.T))
     else:
         raise ValueError(f"unknown matrix kind {kind!r}")
 

@@ -1,0 +1,464 @@
+#!/usr/bin/env python
+"""testsweeper-style routine tester for slate_tpu.
+
+The analog of the reference's ``./tester`` binary (``test/test.cc:83,783``
+driven by testsweeper): one registered tester per routine, a parameter
+sweep over dims/types/blocking, wall-clock + model-GFLOP/s reporting, and
+a residual gate per routine (the reference's ``≤ 3ε`` criterion,
+``test/test_gemm.cc:248-260``), with optional ``--ref`` comparison
+against NumPy/SciPy (standing in for ScaLAPACK, ``test/test_gemm.cc:263``).
+
+Usage:
+  python tester.py gemm --dim 512:2048:512 --type s,d --nb 256
+  python tester.py potrf --dim 1024 --type s --repeat 3
+  python tester.py gesv --dim 100,300 --type d --check y --ref y
+  python tester.py --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Parameter sweep plumbing (testsweeper's --dim start:stop:step grammar)
+# ---------------------------------------------------------------------------
+
+TYPE_MAP = {"s": "float32", "d": "float64", "c": "complex64", "z": "complex128"}
+
+
+def parse_dims(spec: str):
+    out = []
+    for part in spec.split(","):
+        if ":" in part:
+            pieces = [int(x) for x in part.split(":")]
+            start, stop = pieces[0], pieces[1]
+            step = pieces[2] if len(pieces) > 2 else max(1, stop - start)
+            out.extend(range(start, stop + 1, step))
+        else:
+            out.append(int(part))
+    return out
+
+
+def eps_of(dtype):
+    return np.finfo(np.dtype(dtype).name.replace("complex64", "float32")
+                    .replace("complex128", "float64")).eps
+
+
+# ---------------------------------------------------------------------------
+# Flop models (the reference's params.gflops() counts)
+# ---------------------------------------------------------------------------
+
+def fl_gemm(m, n, k):
+    return 2.0 * m * n * k
+
+
+FLOPS = {
+    "gemm": lambda p: fl_gemm(p["m"], p["n"], p["k"]),
+    "symm": lambda p: fl_gemm(p["m"], p["n"], p["m"]),
+    "hemm": lambda p: fl_gemm(p["m"], p["n"], p["m"]),
+    "syrk": lambda p: p["n"] * p["n"] * p["k"],
+    "herk": lambda p: p["n"] * p["n"] * p["k"],
+    "syr2k": lambda p: 2.0 * p["n"] * p["n"] * p["k"],
+    "her2k": lambda p: 2.0 * p["n"] * p["n"] * p["k"],
+    "trmm": lambda p: p["m"] * p["m"] * p["n"],
+    "trsm": lambda p: p["m"] * p["m"] * p["n"],
+    "potrf": lambda p: p["n"] ** 3 / 3.0,
+    "potrs": lambda p: 2.0 * p["n"] ** 2 * p["nrhs"],
+    "posv": lambda p: p["n"] ** 3 / 3.0 + 2.0 * p["n"] ** 2 * p["nrhs"],
+    "getrf": lambda p: 2.0 * p["n"] ** 3 / 3.0,
+    "gesv": lambda p: 2.0 * p["n"] ** 3 / 3.0 + 2.0 * p["n"] ** 2 * p["nrhs"],
+    "gesv_mixed": lambda p: 2.0 * p["n"] ** 3 / 3.0,
+    "getri": lambda p: 2.0 * p["n"] ** 3,
+    "geqrf": lambda p: 2.0 * p["m"] * p["n"] ** 2 - 2.0 * p["n"] ** 3 / 3.0,
+    "gels": lambda p: 2.0 * p["m"] * p["n"] ** 2,
+    "cholqr": lambda p: p["m"] * p["n"] ** 2 + p["n"] ** 3 / 3.0,
+    "heev": lambda p: 4.0 * p["n"] ** 3 / 3.0,
+    "svd": lambda p: 8.0 * p["n"] ** 3 / 3.0,
+    "hesv": lambda p: p["n"] ** 3 / 3.0,
+    "gbsv": lambda p: 2.0 * p["n"] * p["kl"] * p["ku"],
+    "norm": lambda p: p["m"] * p["n"],
+    "pgemm": lambda p: fl_gemm(p["m"], p["n"], p["k"]),
+    "ppotrf": lambda p: p["n"] ** 3 / 3.0,
+    "pgesv": lambda p: 2.0 * p["n"] ** 3 / 3.0,
+    "pgeqrf": lambda p: 2.0 * p["m"] * p["n"] ** 2 - 2.0 * p["n"] ** 3 / 3.0,
+}
+
+
+# ---------------------------------------------------------------------------
+# Testers: each returns (run_fn, check_fn, ref_fn)
+#   run_fn()            -> result (jax pytree; timed)
+#   check_fn(result)    -> scaled residual (gate: < 3, in units of eps*n)
+#   ref_fn(result)      -> max abs diff vs NumPy/SciPy reference or None
+# ---------------------------------------------------------------------------
+
+def _norms(*arrays):
+    return [np.linalg.norm(np.asarray(x)) for x in arrays]
+
+
+def make_tester(routine, p, jnp, st):
+    dt = p["dtype"]
+    m, n, k, nrhs, nb = p["m"], p["n"], p["k"], p["nrhs"], p["nb"]
+    eps = eps_of(dt)
+    opts = {"nb": nb}
+    rng = np.random.default_rng(p["seed"])
+
+    def arr(x):
+        return np.asarray(x)
+
+    def randn(shape):
+        a = rng.standard_normal(shape)
+        if np.dtype(dt).kind == "c":
+            a = a + 1j * rng.standard_normal(shape)
+        return jnp.asarray(a.astype(dt))
+
+    def herm(nn):
+        a = randn((nn, nn))
+        return (a + jnp.conj(a.T)) / 2 + nn * jnp.eye(nn, dtype=dt)
+
+    if routine == "gemm":
+        a, b, c = randn((m, k)), randn((k, n)), randn((m, n))
+        run = lambda: st.gemm(1.0, a, b, 1.0, c, opts)
+        def check(out):
+            na, nb_, nc = _norms(a, b, c)
+            r = np.linalg.norm(arr(out) - (arr(a) @ arr(b) + arr(c)))
+            return r / ((na * nb_ + nc) * eps * k)
+        return run, check, None
+
+    if routine in ("symm", "hemm"):
+        if routine == "symm":
+            x = randn((m, m))
+            a = (x + x.T) / 2
+        else:
+            a = herm(m)
+        b, c = randn((m, n)), randn((m, n))
+        fn = getattr(st, routine)
+        run = lambda: fn(st.Side.Left, 1.0, a, b, 1.0, c, opts)
+        def check(out):
+            na, nb_, nc = _norms(a, b, c)
+            r = np.linalg.norm(arr(out) - (arr(a) @ arr(b) + arr(c)))
+            return r / ((na * nb_ + nc) * eps * m)
+        return run, check, None
+
+    if routine in ("syrk", "herk", "syr2k", "her2k"):
+        a, b = randn((n, k)), randn((n, k))
+        if routine.startswith("her"):
+            c0 = herm(n)
+        else:
+            x = randn((n, n))
+            c0 = (x + x.T) / 2
+        fn = getattr(st, routine)
+        two = routine.endswith("2k")
+        tr = (lambda x: np.conj(x.T)) if routine.startswith("her") else (lambda x: x.T)
+        run = (lambda: fn(1.0, a, b, 1.0, c0, opts)) if two else \
+              (lambda: fn(1.0, a, 1.0, c0, opts))
+        def check(out):
+            an, cn = _norms(a, c0)
+            if two:
+                ref = arr(a) @ tr(arr(b)) + arr(b) @ tr(arr(a)) + arr(c0)
+            else:
+                ref = arr(a) @ tr(arr(a)) + arr(c0)
+            got = arr(getattr(out, "array", out))
+            # rank-k drivers update only the stored (lower) triangle
+            r = np.linalg.norm(np.tril(got) - np.tril(ref))
+            return r / ((an * an + cn) * eps * k)
+        return run, check, None
+
+    if routine in ("trmm", "trsm"):
+        a = jnp.tril(randn((m, m))) + 2 * m * jnp.eye(m, dtype=dt)
+        b = randn((m, n))
+        A = st.TriangularMatrix(a, uplo=st.Uplo.Lower, diag=st.Diag.NonUnit,
+                                mb=nb, nb=nb)
+        fn = getattr(st, routine)
+        run = lambda: fn(st.Side.Left, 1.0, A, b, opts)
+        def check(out):
+            o = arr(getattr(out, "array", out))
+            if routine == "trsm":
+                r = np.linalg.norm(arr(a) @ o - arr(b))
+            else:
+                r = np.linalg.norm(o - arr(a) @ arr(b))
+            na, nb_ = _norms(a, b)
+            return r / (na * max(np.linalg.norm(o), nb_) * eps * m)
+        return run, check, None
+
+    if routine == "norm":
+        a = randn((m, n))
+        run = lambda: [st.norm(w, a) for w in
+                       (st.Norm.Max, st.Norm.One, st.Norm.Inf, st.Norm.Fro)]
+        def check(out):
+            mx, one, inf, fro = [float(x) for x in out]
+            refs = [np.abs(arr(a)).max(), np.linalg.norm(arr(a), 1),
+                    np.linalg.norm(arr(a), np.inf), np.linalg.norm(arr(a))]
+            return max(abs(g - r) / (r + 1e-300) for g, r in
+                       zip((mx, one, inf, fro), refs)) / eps
+        return run, check, None
+
+    if routine in ("potrf", "posv", "potrs"):
+        a = herm(n)
+        b = randn((n, nrhs))
+        A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=nb, nb=nb)
+        if routine == "potrf":
+            run = lambda: st.potrf(A, opts)
+            def check(out):
+                l = arr(out.array)
+                r = np.linalg.norm(np.tril(l) @ np.conj(np.tril(l)).T - arr(a))
+                return r / (np.linalg.norm(arr(a)) * eps * n)
+            ref = lambda out: np.abs(np.tril(arr(out.array))
+                                     - np.linalg.cholesky(arr(a))).max()
+            return run, check, ref
+        if routine == "potrs":
+            fac = st.potrf(A, opts)
+            run = lambda: st.potrs(fac, b, opts)
+            def check(out):
+                x = arr(getattr(out, "array", out))
+                r = np.linalg.norm(arr(a) @ x - arr(b))
+                return r / (np.linalg.norm(arr(a)) * np.linalg.norm(x)
+                            * eps * n)
+            return run, check, None
+        run = lambda: st.posv(A, b, opts)
+        def check(out):
+            x = arr(out[1])
+            r = np.linalg.norm(arr(a) @ x - arr(b))
+            nx, nb_ = _norms(x, b)
+            return r / (np.linalg.norm(arr(a)) * nx * eps * n)
+        ref = lambda out: np.abs(arr(out[1])
+                                 - np.linalg.solve(arr(a), arr(b))).max()
+        return run, check, ref
+
+    if routine in ("getrf", "gesv", "gesv_mixed", "getri"):
+        a = randn((n, n)) + n * jnp.eye(n, dtype=dt)
+        b = randn((n, nrhs))
+        if routine == "getrf":
+            run = lambda: st.getrf(a, opts)
+            def check(out):
+                lu, perm = out
+                luv = arr(getattr(lu, "array", lu))
+                l = np.tril(luv, -1) + np.eye(n)
+                u = np.triu(luv)
+                r = np.linalg.norm(arr(a)[np.asarray(perm)] - l @ u)
+                return r / (np.linalg.norm(arr(a)) * eps * n)
+            return run, check, None
+        if routine == "getri":
+            lu, perm = st.getrf(a, opts)
+            run = lambda: st.getri(lu, perm, opts)
+            def check(out):
+                r = np.linalg.norm(arr(getattr(out, "array", out)) @ arr(a)
+                                   - np.eye(n))
+                return r / (eps * n * np.linalg.cond(arr(a), 1))
+            return run, check, None
+        fn = st.gesv if routine == "gesv" else st.gesv_mixed
+        run = lambda: fn(a, b, opts)
+        def check(out):
+            x = arr(out[-1] if routine == "gesv" else out[0])
+            r = np.linalg.norm(arr(a) @ x - arr(b))
+            return r / (np.linalg.norm(arr(a)) * np.linalg.norm(x) * eps * n)
+        ref = lambda out: np.abs(arr(out[-1] if routine == "gesv" else out[0])
+                                 - np.linalg.solve(arr(a), arr(b))).max()
+        return run, check, ref
+
+    if routine in ("geqrf", "cholqr", "gels"):
+        a = randn((m, n))
+        b = randn((m, nrhs))
+        if routine == "geqrf":
+            run = lambda: st.geqrf(a, opts)
+            def check(out):
+                packed, taus = out
+                pv = arr(getattr(packed, "array", packed))
+                rfac = np.triu(pv)[:n, :n]
+                _, rref = np.linalg.qr(arr(a))
+                return (np.abs(np.abs(rfac) - np.abs(rref)).max()
+                        / (np.linalg.norm(arr(a)) * eps * max(m, 1)))
+            return run, check, None
+        if routine == "cholqr":
+            # CholQR squares the condition number: meaningful only for
+            # tall-skinny panels (reference gels method selection)
+            if m <= n:
+                m_t = 4 * n
+                a = randn((m_t, n))
+                p["m"] = m = m_t
+            run = lambda: st.cholqr(a, opts)
+            def check(out):
+                qf, rf = arr(out[0]), arr(out[1])
+                r = np.linalg.norm(qf @ rf - arr(a))
+                o = np.linalg.norm(np.conj(qf.T) @ qf - np.eye(n))
+                return max(r / (np.linalg.norm(arr(a)) * eps * m), o / (eps * m))
+            return run, check, None
+        run = lambda: st.gels(a, b, opts)
+        def check(out):
+            x = arr(getattr(out, "array", out))
+            # normal-equations residual: A^H (A x - b) == 0
+            r = np.linalg.norm(np.conj(arr(a).T) @ (arr(a) @ x - arr(b)))
+            return r / (np.linalg.norm(arr(a)) ** 2
+                        * np.linalg.norm(x) * eps * m)
+        ref = lambda out: np.abs(arr(getattr(out, "array", out))
+                                 - np.linalg.lstsq(arr(a), arr(b),
+                                                   rcond=None)[0]).max()
+        return run, check, ref
+
+    if routine in ("heev", "svd"):
+        if routine == "heev":
+            a = herm(n)
+            A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=nb, nb=nb)
+            run = lambda: st.heev(A, True, opts)
+            def check(out):
+                w, z = arr(out[0]), arr(out[1])
+                r = np.linalg.norm(arr(a) @ z - z * w[None, :])
+                return r / (np.linalg.norm(arr(a)) * eps * n)
+            ref = lambda out: np.abs(arr(out[0])
+                                     - np.linalg.eigvalsh(arr(a))).max()
+            return run, check, ref
+        a = randn((m, n))
+        run = lambda: st.svd(a, True, True, opts)
+        def check(out):
+            s, u, vh = arr(out[0]), arr(out[1]), arr(out[2])
+            r = np.linalg.norm(u @ np.diag(s.astype(u.dtype)) @ vh - arr(a))
+            return r / (np.linalg.norm(arr(a)) * eps * max(m, n))
+        ref = lambda out: np.abs(np.sort(arr(out[0]))[::-1]
+                                 - np.linalg.svd(arr(a), compute_uv=False)).max()
+        return run, check, ref
+
+    if routine == "hesv":
+        a = herm(n)
+        b = randn((n, nrhs))
+        A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=nb, nb=nb)
+        run = lambda: st.hesv(A, b, opts)
+        def check(out):
+            x = arr(out[1])
+            r = np.linalg.norm(arr(a) @ x - arr(b))
+            return r / (np.linalg.norm(arr(a)) * np.linalg.norm(x) * eps * n)
+        return run, check, None
+
+    if routine == "gbsv":
+        kl = ku = min(p["kl"], n - 1)
+        full = np.asarray(randn((n, n)))
+        mask = np.abs(np.arange(n)[:, None] - np.arange(n)[None, :])
+        full = np.where(mask <= max(kl, ku), full, 0) + n * np.eye(n)
+        a = jnp.asarray(full.astype(dt))
+        b = randn((n, nrhs))
+        A = st.BandMatrix(a, kl=kl, ku=ku, mb=nb, nb=nb)
+        run = lambda: st.gbsv(A, b, opts)
+        def check(out):
+            x = arr(out[-1])
+            r = np.linalg.norm(full @ x - arr(b))
+            return r / (np.linalg.norm(full) * np.linalg.norm(x) * eps * n)
+        return run, check, None
+
+    if routine.startswith("p"):  # distributed testers on the active mesh
+        import jax
+        from slate_tpu import parallel as par
+        mesh = par.make_grid_mesh()
+        if routine == "ppotrf":
+            a = np.asarray(herm(n))
+            run = lambda: par.pposv(a, np.asarray(randn((n, nrhs))), mesh, nb)
+            def check(out):
+                l, x = out
+                lh = np.tril(np.asarray(par.undistribute(l)))
+                r = np.linalg.norm(lh @ np.conj(lh).T - a)
+                return r / (np.linalg.norm(a) * eps * n)
+            return run, check, None
+        if routine == "pgesv":
+            a = np.asarray(randn((n, n))) + n * np.eye(n, dtype=dt)
+            bb = np.asarray(randn((n, nrhs)))
+            run = lambda: par.pgesv(a, bb, mesh, nb)
+            def check(out):
+                x = np.asarray(par.undistribute(out[2]))
+                r = np.linalg.norm(a @ x - bb)
+                return r / (np.linalg.norm(a) * np.linalg.norm(x) * eps * n)
+            return run, check, None
+        if routine == "pgeqrf":
+            a = np.asarray(randn((m, n)))
+            bb = np.asarray(randn((m, nrhs)))
+            run = lambda: par.pgels(a, bb, mesh, nb)
+            def check(out):
+                x = np.asarray(par.undistribute(out[2]))
+                r = np.linalg.norm(np.conj(a.T) @ (a @ x - bb))
+                return r / (np.linalg.norm(a) ** 2
+                            * max(np.linalg.norm(x), 1) * eps * m)
+            return run, check, None
+
+    raise KeyError(routine)
+
+
+ROUTINES = sorted(set(FLOPS) - {"pgemm"})
+
+
+# ---------------------------------------------------------------------------
+# Main sweep loop
+# ---------------------------------------------------------------------------
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("routine", nargs="?", help="routine to test")
+    ap.add_argument("--list", action="store_true", help="list routines")
+    ap.add_argument("--dim", default="256", help="n (and m=k=n) sweep, "
+                    "start:stop:step or comma list")
+    ap.add_argument("--m", type=int, help="override m")
+    ap.add_argument("--k", type=int, help="override k")
+    ap.add_argument("--nrhs", type=int, default=8)
+    ap.add_argument("--type", default="s", help="comma list of s,d,c,z")
+    ap.add_argument("--nb", type=int, default=256)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--repeat", type=int, default=1, help="timed repeats "
+                    "(first extra run warms the jit cache)")
+    ap.add_argument("--check", default="y", choices=["y", "n"])
+    ap.add_argument("--ref", default="n", choices=["y", "n"],
+                    help="also compare against NumPy/SciPy")
+    ap.add_argument("--tol", type=float, default=3.0,
+                    help="residual gate in units of the scaled check")
+    args = ap.parse_args(argv)
+
+    if args.list or not args.routine:
+        print("routines:", " ".join(ROUTINES))
+        return 0
+
+    types = [t.strip() for t in args.type.split(",")]
+    if any(t in ("d", "z") for t in types):
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+    import jax
+    import jax.numpy as jnp
+    import slate_tpu as st
+
+    dims = parse_dims(args.dim)
+    header = (f"{'type':>4} {'m':>7} {'n':>7} {'k':>7} {'nb':>5} "
+              f"{'time(s)':>10} {'GFLOP/s':>10} {'error':>10}  status")
+    print(header)
+    print("-" * len(header))
+    failures = 0
+    for t in types:
+        dt = TYPE_MAP[t]
+        for n in dims:
+            p = dict(m=args.m or n, n=n, k=args.k or n, nrhs=args.nrhs,
+                     nb=args.nb, dtype=dt, seed=args.seed,
+                     kl=args.nb, ku=args.nb)
+            try:
+                run, check, ref = make_tester(args.routine, p, jnp, st)
+            except KeyError:
+                print(f"unknown routine {args.routine!r}; --list to see all")
+                return 2
+            out = jax.block_until_ready(run())     # warm the jit cache
+            times = []
+            for _ in range(args.repeat):
+                t0 = time.perf_counter()
+                out = jax.block_until_ready(run())
+                times.append(time.perf_counter() - t0)
+            tbest = min(times)
+            gflops = FLOPS[args.routine](p) / tbest / 1e9
+            err = float(check(out)) if args.check == "y" else float("nan")
+            ok = (args.check == "n") or (err < args.tol)
+            status = "ok" if ok else "FAILED"
+            if args.ref == "y" and ref is not None:
+                status += f"  |ref diff|={float(ref(out)):.2e}"
+            failures += 0 if ok else 1
+            print(f"{t:>4} {p['m']:>7} {n:>7} {p['k']:>7} {args.nb:>5} "
+                  f"{tbest:>10.4f} {gflops:>10.1f} {err:>10.2e}  {status}")
+    print(f"\n{'all tests passed' if failures == 0 else f'{failures} FAILED'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,6 +2,8 @@
 ``unit_test/test_Memory.cc`` (pool), the ``scalapack_api`` marshaling,
 ``test_Tile.cc`` layout conversion, and the HostTask driver checks."""
 
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -74,3 +76,53 @@ def test_host_gemm():
     c = rng.standard_normal((130, 90))
     out = native.host_gemm(a, b, nb=32, alpha=2.0, beta=-1.0, c=c)
     assert np.abs(out - (2 * a @ b - c)).max() < 1e-12 * 70
+
+
+class TestHostSolvers:
+    def test_host_potrs(self):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        rng = np.random.default_rng(40)
+        n = 96
+        g = rng.standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        b = rng.standard_normal((n, 5))
+        l = native.host_potrf(a, nb=32)
+        x = native.host_potrs(l, b, nb=32)
+        np.testing.assert_allclose(a @ x, b, rtol=1e-9, atol=1e-9)
+
+    def test_host_gesv(self):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        rng = np.random.default_rng(41)
+        n = 64
+        a = rng.standard_normal((n, n)) + n * np.eye(n)
+        b = rng.standard_normal((n, 3))
+        x, ipiv = native.host_gesv(a, b)
+        np.testing.assert_allclose(a @ x, b, rtol=1e-9, atol=1e-9)
+
+    def test_c_header_compiles_and_runs(self, tmp_path):
+        """Compile the C smoke example against include/slate_tpu.h and run
+        it — the reference's lapack_api/example_dgetrf.c smoke test."""
+        import shutil
+        import subprocess
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        if shutil.which("gcc") is None:
+            pytest.skip("no gcc")
+        root = pathlib.Path(__file__).resolve().parents[1]
+        so_dir = root / "slate_tpu" / "native"
+        exe = tmp_path / "c_smoke"
+        r = subprocess.run(
+            ["gcc", str(root / "examples" / "c_api_smoke.c"),
+             "-I" + str(root / "include"),
+             str(so_dir / "_slate_host.so"),
+             "-Wl,-rpath," + str(so_dir), "-lm", "-o", str(exe)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        out = subprocess.run([str(exe)], capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "ok: C API smoke" in out.stdout
