@@ -7,6 +7,18 @@ single device).  ``vs_baseline`` is measured against the reference's only
 in-repo per-device throughput anchor, 702 GFLOP/s/GPU
 (``/root/reference/docs/usage.md:36-44``).
 
+Timing: the factorization is run iters+1 times *chained inside one jit*
+(each iteration's input depends on the previous result, so XLA cannot
+collapse the chain) and the wall time is divided by iters+1.  This
+measures on-device time the way the reference's MPI-barrier wall clock
+does (``test/test_gemm.cc:224-245``) and amortizes the host↔device
+round-trip latency of the tunnel (~100 ms, which would otherwise swamp a
+~25 ms factorization) down to a few percent of the total.
+
+The metric only prints after the factorization passes the reference's
+scaled-residual gate (≤ 3, ``test/test_gemm.cc:260``); a broken factor
+exits nonzero instead of publishing a number.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
@@ -22,21 +34,33 @@ BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
 def main():
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from slate_tpu.ops import blocks
 
     on_tpu = jax.devices()[0].platform == "tpu"
     n = 8192 if on_tpu else 1024
-    nb = 512 if on_tpu else 128
+    nb = 4096 if on_tpu else 128
+    iters = 32 if on_tpu else 2
     dtype = jnp.float32
 
     rng = np.random.default_rng(0)
     g = rng.standard_normal((n, n)).astype(np.float32)
-    a = jnp.asarray(g @ g.T + n * np.eye(n, dtype=np.float32), dtype)
+    anp = g @ g.T + n * np.eye(n, dtype=np.float32)
+    a = jnp.asarray(anp, dtype)
 
-    # reduce on device and read one scalar back: a sync point that works
-    # even where block_until_ready only waits for enqueue (axon tunnel)
-    step = jax.jit(lambda a: blocks.potrf_rec(a, nb)[-1, -1])
+    def chained(a):
+        def body(i, x):
+            l = blocks.potrf_rec(x, nb)
+            # tie the next iteration to this result (prevents hoisting)
+            # without changing the factored matrix beyond rounding
+            return a + l[-1, -1] * jnp.float32(1e-30)
+        out = lax.fori_loop(0, iters, body, a)
+        # reduce to one scalar: the host float() below is the sync point
+        # (works even where block_until_ready only waits for enqueue)
+        return blocks.potrf_rec(out, nb)[-1, -1]
+
+    step = jax.jit(chained)
     float(step(a))  # compile + warm up
 
     times = []
@@ -44,7 +68,17 @@ def main():
         t0 = time.perf_counter()
         float(step(a))
         times.append(time.perf_counter() - t0)
-    t = min(times)
+    t = min(times) / (iters + 1)
+
+    # correctness gate on a single factorization (reference ≤ 3ε criterion)
+    l = np.asarray(jax.jit(lambda a: blocks.potrf_rec(a, nb))(a))
+    resid = (np.linalg.norm(np.tril(l) @ np.tril(l).T - anp)
+             / (np.linalg.norm(anp) * np.finfo(np.float32).eps * n))
+
+    if resid > 3.0:
+        print(f"# FAILED residual gate: scaled_resid={resid:.3e} > 3",
+              file=sys.stderr)
+        sys.exit(1)
 
     flops = n ** 3 / 3.0  # LAPACK model count for potrf
     gflops = flops / t / 1e9
@@ -54,8 +88,8 @@ def main():
         "unit": "GFLOP/s",
         "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
     }))
-    print(f"# t={t:.4f}s n={n} nb={nb} platform={jax.devices()[0].platform}",
-          file=sys.stderr)
+    print(f"# t={t:.4f}s n={n} nb={nb} iters={iters} scaled_resid={resid:.3e}"
+          f" platform={jax.devices()[0].platform}", file=sys.stderr)
 
 
 if __name__ == "__main__":
