@@ -6,12 +6,16 @@ of mutating) and is jit-compatible; shapes and blocking are static.
 """
 
 from .blas3 import (  # noqa: F401
-    gemm, symm, hemm, syrk, herk, syr2k, her2k, trmm, trsm,
+    gemm, gemmA, gemmC, symm, hemm, hemmA, hemmC, syrk, herk, syr2k, her2k,
+    trmm, trsm, trsmA, trsmB,
 )
-from .cholesky import potrf, potrs, posv, potri, trtri, trtrm  # noqa: F401
+from .cholesky import (  # noqa: F401
+    posv, posvMixed, posv_mixed, posv_mixed_gmres, potrf, potri, potrs,
+    trtri, trtrm,
+)
 from .lu import (  # noqa: F401
-    gesv, gesv_mixed, gesv_mixed_gmres, getrf, getrf_nopiv, getrf_tntpiv,
-    getri, getrs,
+    gesv, gesvMixed, gesv_mixed, gesv_mixed_gmres, gesv_nopiv, getrf,
+    getrf_nopiv, getrf_tntpiv, getri, getrs, getrs_nopiv,
 )
 from .norms import (  # noqa: F401
     col_norms, gbnorm, genorm, hbnorm, henorm, norm, synorm, trnorm,
@@ -22,10 +26,10 @@ from .qr import (  # noqa: F401
 from .util import add, copy, scale, scale_row_col, set  # noqa: F401
 from .eig import (  # noqa: F401
     he2hb, heev, heev_vals, hegst, hegv, hb2st, stedc, stemr, steqr, sterf,
-    syev, sygv, unmtr_he2hb, unmtr_hb2st,
+    syev, sygst, sygv, unmtr_he2hb, unmtr_hb2st,
 )
 from .svd import (  # noqa: F401
-    bdsqr, ge2tb, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd,
+    bdsqr, ge2tb, gesvd, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd,
 )
 from .hesv import hesv, hetrf, hetrs, sysv, sytrf, sytrs  # noqa: F401
 from .band import (  # noqa: F401

@@ -1,0 +1,161 @@
+"""Shared mixed-precision refinement cores.
+
+The reference implements the same two refinement loops four times —
+``src/gesv_mixed.cc``, ``src/posv_mixed.cc`` (classic iterative
+refinement) and ``src/gesv_mixed_gmres.cc``, ``src/posv_mixed_gmres.cc``
+(FGMRES-IR) — differing only in the factorization used for the
+low-precision solve.  Here the loops are written once over three
+callables:
+
+* ``solve_lo(r)``  — apply the low-precision factor to a residual block
+  (working-precision in, working-precision out),
+* ``solve_full(b)`` — factor in working precision and solve (fallback
+  path, ``Option::UseFallbackSolver``),
+* ``matvec`` is derived from the matrix itself.
+
+Stopping criterion (both loops, reference ``gesv_mixed.cc``):
+``‖r‖∞ ≤ ‖x‖∞ · ‖A‖∞ · ε · √n``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.blocks import matmul
+
+
+def ir_refine(av, bv, solve_lo, solve_full, *, anorm, thresh, itermax,
+              use_fallback):
+    """Classic iterative refinement.  Returns ``(x, iters)``; negative
+    ``iters`` flags the full-precision fallback (reference convention)."""
+
+    squeeze = bv.ndim == 1
+    if squeeze:
+        bv = bv[:, None]
+    residual = jax.jit(lambda x: bv - matmul(av, x))
+    x = solve_lo(bv)
+    iters = 0
+    converged = False
+    for it in range(itermax):
+        r = residual(x)
+        rnorm = float(jnp.max(jnp.abs(r)))
+        xnorm = float(jnp.max(jnp.abs(x)))
+        if rnorm <= xnorm * float(anorm) * thresh:
+            converged = True
+            iters = it
+            break
+        x = x + solve_lo(r)
+        iters = it + 1
+    if not converged:
+        r = residual(x)
+        rnorm = float(jnp.max(jnp.abs(r)))
+        xnorm = float(jnp.max(jnp.abs(x)))
+        converged = rnorm <= xnorm * float(anorm) * thresh
+    if not converged and use_fallback:
+        x = solve_full(bv)
+        iters = -(iters + 1)
+    if squeeze:
+        x = x[:, 0]
+    return x, iters
+
+
+def fgmres_refine(av, bv, precond, solve_full, *, anorm, thresh, itermax,
+                  restart, use_fallback):
+    """FGMRES-IR: flexible GMRES in working precision, left-preconditioned
+    by the low-precision solve; one GMRES sequence per right-hand-side
+    column (the reference iterates nrhs=1).  Returns ``(x, iters)``."""
+
+    squeeze = bv.ndim == 1
+    if squeeze:
+        bv = bv[:, None]
+    matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
+
+    cols = []
+    total_iters = 0
+    any_fallback = False
+    full_solution = None          # fallback solve, shared by all columns
+    for j in range(bv.shape[1]):
+        bj = bv[:, j]
+        x = precond(bj[:, None])[:, 0]
+        col_iters = 0
+        converged = False
+        # FGMRES(restart) cycles, bounded by the itermax option
+        # (reference gesv_mixed_gmres.cc:24-47)
+        while col_iters < itermax:
+            r = bj - matvec(x)
+            rnorm = float(jnp.linalg.norm(r))
+            xnorm = float(jnp.max(jnp.abs(x)))
+            if rnorm <= max(xnorm, 1.0) * float(anorm) * thresh:
+                converged = True
+                break
+            # Arnoldi with preconditioned directions (flexible GMRES);
+            # the (restart+1)×restart Hessenberg LSQ is solved on host —
+            # complex-safe, O(restart³) ≪ one matvec
+            V = [r / rnorm]
+            Z = []
+            H = np.zeros((restart + 1, restart), dtype=np.dtype(av.dtype))
+            k_used = 0
+            for k in range(restart):
+                z = precond(V[k][:, None])[:, 0]
+                Z.append(z)
+                w = matvec(z)
+                for i in range(k + 1):
+                    H[i, k] = complex(jnp.vdot(V[i], w)) if \
+                        np.iscomplexobj(H) else float(jnp.vdot(V[i], w).real)
+                    w = w - H[i, k] * V[i]
+                hk1 = float(jnp.linalg.norm(w))
+                H[k + 1, k] = hk1
+                total_iters += 1
+                col_iters += 1
+                k_used = k + 1
+                if hk1 == 0.0:       # happy breakdown
+                    break
+                V.append(w / hk1)
+                # running LSQ residual of min‖β·e₁ − H·y‖ for early exit
+                g = np.zeros(k + 2, H.dtype)
+                g[0] = rnorm
+                _, res, *_ = np.linalg.lstsq(H[:k + 2, :k + 1], g,
+                                             rcond=None)
+                lsq_res = np.sqrt(float(res[0])) if res.size else 0.0
+                if lsq_res <= max(xnorm, 1.0) * float(anorm) * thresh:
+                    break
+            if k_used:
+                g = np.zeros(k_used + 1, H.dtype)
+                g[0] = rnorm
+                yk, *_ = np.linalg.lstsq(H[:k_used + 1, :k_used], g,
+                                         rcond=None)
+                for i in range(k_used):
+                    x = x + complex(yk[i]) * Z[i] if np.iscomplexobj(H) \
+                        else x + float(yk[i].real) * Z[i]
+        if not converged:
+            r = bj - matvec(x)
+            rnorm = float(jnp.linalg.norm(r))
+            xnorm = float(jnp.max(jnp.abs(x)))
+            converged = rnorm <= max(xnorm, 1.0) * float(anorm) * thresh
+        if not converged and use_fallback:
+            # full-precision fallback (reference fallback path), factored
+            # once and reused across right-hand-side columns
+            if full_solution is None:
+                full_solution = solve_full(bv)
+            x = full_solution[:, j]
+            any_fallback = True
+        cols.append(x)
+    x = jnp.stack(cols, axis=1)
+    if squeeze:
+        x = x[:, 0]
+    iters = -(total_iters + 1) if any_fallback else total_iters
+    return x, iters
+
+
+def lo_dtype(dtype):
+    """The reference pairs fp64→fp32 (``gesv_mixed`` 278 LoC).  fp32→bf16
+    is *not* accurate enough for IR's contraction bound, so fp64→fp32 and
+    fp32→fp32 (no-op refine) are used."""
+    d = jnp.dtype(dtype)
+    if d == jnp.float64:
+        return jnp.float32
+    if d == jnp.complex128:
+        return jnp.complex64
+    return d

@@ -192,3 +192,49 @@ def trsm(side: Side, alpha, a, b, opts: Optional[Options] = None):
     nb = _nb(a, opts)
     out = blocks.trsm_rec(side, uplo, diag, av, alpha * bv, nb)
     return _wrap_like(b, out)
+
+
+# ---------------------------------------------------------------------------
+# Data-placement method variants.  The reference exposes gemmA/gemmC,
+# hemmA/hemmC and trsmA/trsmB as separate drivers that differ only in
+# *which operand stays resident* while the others move
+# (``src/gemmA.cc``/``src/gemmC.cc``, method dispatch ``src/gemm.cc:72-86``,
+# ``method.hh:25-126``).  Under XLA the compiler owns operand residency,
+# so the variants share one lowering; the names are kept so reference
+# call sites port unchanged, and the distributed path makes the real
+# stationary-operand choice in ``parallel.dist_blas3.pgemm_auto``.
+# ---------------------------------------------------------------------------
+
+def gemmA(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """gemm, A-stationary method — reference ``slate::gemmA``
+    (``src/gemmA.cc``, picked by ``MethodGemm`` when B is narrow)."""
+    return gemm(alpha, a, b, beta, c, opts)
+
+
+def gemmC(alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """gemm, C-stationary method — reference ``slate::gemmC``
+    (``src/gemmC.cc``, the default method)."""
+    return gemm(alpha, a, b, beta, c, opts)
+
+
+def hemmA(side: Side, alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """hemm, A-stationary method — reference ``slate::hemmA``
+    (``src/hemmA.cc``)."""
+    return hemm(side, alpha, a, b, beta, c, opts)
+
+
+def hemmC(side: Side, alpha, a, b, beta, c, opts: Optional[Options] = None):
+    """hemm, C-stationary method — reference ``slate::hemmC``."""
+    return hemm(side, alpha, a, b, beta, c, opts)
+
+
+def trsmA(side: Side, alpha, a, b, opts: Optional[Options] = None):
+    """trsm, A-stationary method — reference ``slate::trsmA``
+    (``src/trsmA.cc``, 589-line work variant)."""
+    return trsm(side, alpha, a, b, opts)
+
+
+def trsmB(side: Side, alpha, a, b, opts: Optional[Options] = None):
+    """trsm, B-stationary method — reference ``slate::trsmB`` (the
+    default; ``src/trsm.cc``)."""
+    return trsm(side, alpha, a, b, opts)

@@ -124,3 +124,85 @@ def potri(a_factor, opts: Optional[Options] = None):
                            mb=getattr(a_factor, "mb", 256),
                            nb=getattr(a_factor, "nb", 256),
                            grid=getattr(a_factor, "grid", None))
+
+
+# ---------------------------------------------------------------------------
+# Mixed precision + iterative refinement (posv_mixed / posv_mixed_gmres)
+# ---------------------------------------------------------------------------
+
+def _chol_solve(lv, bv, nb):
+    """Two triangular sweeps from the lower factor (src/potrs.cc shape)."""
+    conj = jnp.iscomplexobj(lv)
+    y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit, lv, bv, nb)
+    lh = jnp.conj(lv.T) if conj else lv.T
+    return blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit, lh, y, nb)
+
+
+def _posv_mixed_setup(a, b, opts, tol):
+    import jax
+
+    from ..enums import Norm
+    from ..options import get_option
+    from .norms import norm as _norm
+    from ._refine import lo_dtype
+
+    full = _hermitian_full(a)
+    bv = _arr(b)
+    n = full.shape[-1]
+    nb = _nb(a, opts)
+    itermax = int(get_option(opts, "max_iterations", 30))
+    use_fallback = bool(get_option(opts, "use_fallback_solver", True))
+    eps = jnp.finfo(full.dtype).eps
+    anorm = _norm(Norm.Inf, full)
+    thresh = (float(tol) if tol is not None
+              else float(eps) * float(jnp.sqrt(n)))
+
+    lo = lo_dtype(full.dtype)
+    l_lo = blocks.potrf_rec(full.astype(lo), nb)
+    solve_lo = jax.jit(
+        lambda r: _chol_solve(l_lo, r.astype(lo), nb).astype(full.dtype))
+
+    def solve_full(bv2):
+        # full-precision fallback (reference posv_mixed.cc fallback path);
+        # the refine cores always pass a 2-D block
+        l = blocks.potrf_rec(full, nb)
+        return _chol_solve(l, bv2, nb)
+
+    return full, bv, nb, dict(anorm=anorm, thresh=thresh, itermax=itermax,
+                              use_fallback=use_fallback), solve_lo, solve_full
+
+
+def posv_mixed(a, b, opts: Optional[Options] = None, *, tol=None):
+    """Mixed-precision Cholesky solve with iterative refinement —
+    reference ``slate::posv_mixed`` (``src/posv_mixed.cc``): factor the
+    HPD matrix in low precision, refine the residual in working
+    precision, full-precision fallback on stagnation.
+
+    Returns ``(x, iters)``; ``iters < 0`` flags fallback (reference info
+    convention)."""
+
+    from ._refine import ir_refine
+
+    full, bv, nb, kw, solve_lo, solve_full = _posv_mixed_setup(a, b, opts,
+                                                               tol)
+    x, iters = ir_refine(full, bv, solve_lo, solve_full, **kw)
+    return _wrap_like(b, x), iters
+
+
+def posv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
+                     restart: int = 30):
+    """FGMRES-IR over a low-precision Cholesky preconditioner — reference
+    ``slate::posv_mixed_gmres`` (``src/posv_mixed_gmres.cc``).  Returns
+    ``(x, iters)``."""
+
+    from ._refine import fgmres_refine
+
+    full, bv, nb, kw, solve_lo, solve_full = _posv_mixed_setup(a, b, opts,
+                                                               tol)
+    x, iters = fgmres_refine(full, bv, solve_lo, solve_full, restart=restart,
+                             **kw)
+    return _wrap_like(b, x), iters
+
+
+#: Deprecated camel-case alias kept by the reference (slate.hh).
+posvMixed = posv_mixed

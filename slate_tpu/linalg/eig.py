@@ -397,3 +397,9 @@ def sygv(a, b, itype: int = 1, jobz: bool = True,
          opts: Optional[Options] = None):
     """Real-symmetric generalized alias — reference ``slate::sygv``."""
     return hegv(a, b, itype, jobz, opts)
+
+
+def sygst(itype: int, a, b_factor, opts: Optional[Options] = None):
+    """Real-symmetric alias of :func:`hegst` — reference ``slate::sygst``
+    (``include/slate/slate.hh``)."""
+    return hegst(itype, a, b_factor, opts)

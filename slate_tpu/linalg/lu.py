@@ -343,16 +343,7 @@ def getri(lu, perm, opts: Optional[Options] = None):
 # Mixed precision + iterative refinement (gesv_mixed / gesv_mixed_gmres)
 # ---------------------------------------------------------------------------
 
-def _lo_dtype(dtype):
-    """The reference pairs fp64→fp32 (``gesv_mixed`` 278 LoC).  The TPU
-    fast path is fp32→bf16 is *not* accurate enough for IR's contraction
-    bound, so fp64→fp32 and fp32→fp32 (no-op refine) are used."""
-    d = jnp.dtype(dtype)
-    if d == jnp.float64:
-        return jnp.float32
-    if d == jnp.complex128:
-        return jnp.complex64
-    return d
+from ._refine import fgmres_refine, ir_refine, lo_dtype as _lo_dtype
 
 
 def gesv_mixed(a, b, opts: Optional[Options] = None, *, tol=None,
@@ -380,34 +371,17 @@ def gesv_mixed(a, b, opts: Optional[Options] = None, *, tol=None,
 
     lo = _lo_dtype(av.dtype)
     lu_lo, perm = getrf_rec(av.astype(lo), nb)
-
     solve_lo = jax.jit(
         lambda r: _lu_solve(lu_lo, perm, r.astype(lo), nb).astype(av.dtype))
-    residual = jax.jit(lambda x: bv - matmul(av, x))
 
-    x = solve_lo(bv)
-    iters = 0
-    converged = False
-    for it in range(itermax):
-        r = residual(x)
-        rnorm = float(jnp.max(jnp.abs(r)))
-        xnorm = float(jnp.max(jnp.abs(x)))
-        if rnorm <= xnorm * float(anorm) * thresh:
-            converged = True
-            iters = it
-            break
-        x = x + solve_lo(r)
-        iters = it + 1
-    if not converged:
-        r = residual(x)
-        rnorm = float(jnp.max(jnp.abs(r)))
-        xnorm = float(jnp.max(jnp.abs(x)))
-        converged = rnorm <= xnorm * float(anorm) * thresh
-    if not converged and use_fallback:
+    def solve_full(bv):
         # full-precision fallback (reference gesv_mixed.cc fallback path)
         lu, perm_f = getrf_rec(av, nb)
-        x = _lu_solve(lu, perm_f, bv, nb)
-        iters = -(iters + 1)
+        return _lu_solve(lu, perm_f, bv, nb)
+
+    x, iters = ir_refine(av, bv, solve_lo, solve_full, anorm=anorm,
+                         thresh=thresh, itermax=itermax,
+                         use_fallback=use_fallback)
     return (_wrap_like(b, x), iters)
 
 
@@ -426,9 +400,6 @@ def gesv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
     nb = _nb(a, opts)
     itermax = int(get_option(opts, "max_iterations", 30))
     use_fallback = bool(get_option(opts, "use_fallback_solver", True))
-    squeeze = bv.ndim == 1
-    if squeeze:
-        bv = bv[:, None]
     n = av.shape[-1]
     eps = jnp.finfo(av.dtype).eps
     anorm = _norm(Norm.Inf, av)
@@ -436,85 +407,44 @@ def gesv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
 
     lo = _lo_dtype(av.dtype)
     lu_lo, perm = getrf_rec(av.astype(lo), nb)
-
     precond = jax.jit(
         lambda r: _lu_solve(lu_lo, perm, r.astype(lo), nb).astype(av.dtype))
 
-    matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
+    _full = []                    # lazily-factored, shared by columns
 
-    import numpy as _np
-    cols = []
-    total_iters = 0
-    any_fallback = False
-    full_factor = None            # lazily-computed fallback, shared by columns
-    for j in range(bv.shape[1]):
-        bj = bv[:, j]
-        x = precond(bj[:, None])[:, 0]
-        col_iters = 0
-        converged = False
-        # FGMRES(restart) cycles, bounded by the itermax option
-        # (reference gesv_mixed_gmres.cc:24-47)
-        while col_iters < itermax:
-            r = bj - matvec(x)
-            rnorm = float(jnp.linalg.norm(r))
-            xnorm = float(jnp.max(jnp.abs(x)))
-            if rnorm <= max(xnorm, 1.0) * float(anorm) * thresh:
-                converged = True
-                break
-            # Arnoldi with preconditioned directions (flexible GMRES);
-            # the (restart+1)×restart Hessenberg LSQ is solved on host —
-            # complex-safe, O(restart³) ≪ one matvec
-            V = [r / rnorm]
-            Z = []
-            H = _np.zeros((restart + 1, restart), dtype=_np.dtype(av.dtype))
-            k_used = 0
-            for k in range(restart):
-                z = precond(V[k][:, None])[:, 0]
-                Z.append(z)
-                w = matvec(z)
-                for i in range(k + 1):
-                    H[i, k] = complex(jnp.vdot(V[i], w)) if \
-                        _np.iscomplexobj(H) else float(jnp.vdot(V[i], w).real)
-                    w = w - H[i, k] * V[i]
-                hk1 = float(jnp.linalg.norm(w))
-                H[k + 1, k] = hk1
-                total_iters += 1
-                col_iters += 1
-                k_used = k + 1
-                if hk1 == 0.0:       # happy breakdown
-                    break
-                V.append(w / hk1)
-                # running LSQ residual of min‖β·e₁ − H·y‖ for early exit
-                g = _np.zeros(k + 2, H.dtype)
-                g[0] = rnorm
-                _, res, *_ = _np.linalg.lstsq(H[:k + 2, :k + 1], g,
-                                              rcond=None)
-                lsq_res = _np.sqrt(float(res[0])) if res.size else 0.0
-                if lsq_res <= max(xnorm, 1.0) * float(anorm) * thresh:
-                    break
-            if k_used:
-                g = _np.zeros(k_used + 1, H.dtype)
-                g[0] = rnorm
-                yk, *_ = _np.linalg.lstsq(H[:k_used + 1, :k_used], g,
-                                          rcond=None)
-                for i in range(k_used):
-                    x = x + complex(yk[i]) * Z[i] if _np.iscomplexobj(H) \
-                        else x + float(yk[i].real) * Z[i]
-        if not converged:
-            r = bj - matvec(x)
-            rnorm = float(jnp.linalg.norm(r))
-            xnorm = float(jnp.max(jnp.abs(x)))
-            converged = rnorm <= max(xnorm, 1.0) * float(anorm) * thresh
-        if not converged and use_fallback:
-            # full-precision fallback (reference fallback path), factored
-            # once and reused across right-hand-side columns
-            if full_factor is None:
-                full_factor = getrf_rec(av, nb)
-            x = _lu_solve(full_factor[0], full_factor[1], bj[:, None], nb)[:, 0]
-            any_fallback = True
-        cols.append(x)
-    x = jnp.stack(cols, axis=1)
-    if squeeze:
-        x = x[:, 0]
-    iters = -(total_iters + 1) if any_fallback else total_iters
+    def solve_full(bv2):
+        # the refine cores always pass a 2-D block
+        if not _full:
+            _full.append(getrf_rec(av, nb))
+        lu, perm_f = _full[0]
+        return _lu_solve(lu, perm_f, bv2, nb)
+
+    x, iters = fgmres_refine(av, bv, precond, solve_full, anorm=anorm,
+                             thresh=thresh, itermax=itermax, restart=restart,
+                             use_fallback=use_fallback)
     return _wrap_like(b, x), iters
+
+
+def getrs_nopiv(lu, b, op: Op = Op.NoTrans, opts: Optional[Options] = None):
+    """Solve from a no-pivot factor — reference ``slate::getrs_nopiv``
+    (``src/getrs_nopiv.cc``): the two triangular sweeps of :func:`getrs`
+    with the identity permutation."""
+
+    luv = as_array(lu)
+    n = luv.shape[-1]
+    return getrs(lu, jnp.arange(n), b, op=op, opts=opts)
+
+
+def gesv_nopiv(a, b, opts: Optional[Options] = None):
+    """Factor (no pivoting) + solve — reference ``slate::gesv_nopiv``
+    (``src/gesv_nopiv.cc``).  Only stable for diagonally-dominant /
+    well-conditioned systems, as in the reference.  Returns
+    ``(lu, x)``."""
+
+    lu = getrf_nopiv(a, opts)
+    x = getrs_nopiv(lu, b, opts=opts)
+    return lu, x
+
+
+#: Deprecated camel-case alias kept by the reference (slate.hh).
+gesvMixed = gesv_mixed

@@ -319,3 +319,7 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
                         jnp.asarray(v2, dtype=dtype))
         vh = _ct(v)
     return jnp.asarray(s), u, vh
+
+
+#: Deprecated alias kept by the reference (``slate.hh``: ``gesvd``).
+gesvd = svd

@@ -111,3 +111,69 @@ def test_matrix_views():
     assert At.m == 8 and At.n == 8
     t = A.tile(1, 2)
     assert np.array_equal(np.asarray(t), a[2:4, 4:6])
+
+
+class TestMixedPrecision:
+    """posv_mixed / posv_mixed_gmres (reference src/posv_mixed*.cc)."""
+
+    def _spd(self, n, seed, dtype=np.float64):
+        rng = np.random.default_rng(seed)
+        g = rng.standard_normal((n, n)).astype(dtype)
+        return g @ g.T + n * np.eye(n, dtype=dtype)
+
+    def test_posv_mixed_converges(self):
+        n = 128
+        a = self._spd(n, 21)
+        b = np.random.default_rng(21).standard_normal((n, 2))
+        A = st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower,
+                               mb=32, nb=32)
+        x, iters = st.posv_mixed(A, jnp.asarray(b))
+        assert iters >= 0, "mixed solver fell back unexpectedly"
+        xv = np.asarray(x)
+        res = np.linalg.norm(a @ xv - b) / (np.linalg.norm(a)
+                                            * np.linalg.norm(xv))
+        assert res < 1e-13, f"refined residual {res}"  # fp64-grade
+
+    def test_posv_mixed_gmres(self):
+        n = 96
+        a = self._spd(n, 22)
+        b = np.random.default_rng(22).standard_normal(n)
+        A = st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower,
+                               mb=32, nb=32)
+        x, iters = st.posv_mixed_gmres(A, jnp.asarray(b))
+        xv = np.asarray(x)
+        res = np.linalg.norm(a @ xv - b) / (np.linalg.norm(a)
+                                            * np.linalg.norm(xv))
+        assert res < 1e-12, f"gmres-ir residual {res}"
+
+
+def test_gesv_nopiv_and_variant_aliases():
+    """gesv_nopiv/getrs_nopiv (src/gesv_nopiv.cc) + method-variant names."""
+    n = 64
+    rng = np.random.default_rng(23)
+    a = rng.standard_normal((n, n)) + n * np.eye(n)   # diagonally dominant
+    b = rng.standard_normal((n, 3))
+    lu, x = st.gesv_nopiv(st.Matrix.from_array(jnp.asarray(a), nb=16),
+                          jnp.asarray(b))
+    np.testing.assert_allclose(a @ np.asarray(x), b, atol=1e-9)
+    # method variants share the standard lowering
+    c = np.zeros((n, n))
+    out_a = np.asarray(st.gemmA(1.0, jnp.asarray(a), jnp.asarray(a), 0.0,
+                                jnp.asarray(c)))
+    out_c = np.asarray(st.gemmC(1.0, jnp.asarray(a), jnp.asarray(a), 0.0,
+                                jnp.asarray(c)))
+    np.testing.assert_allclose(out_a, out_c)
+
+
+def test_posv_mixed_vector_rhs():
+    n = 64
+    rng = np.random.default_rng(24)
+    g = rng.standard_normal((n, n))
+    a = g @ g.T + n * np.eye(n)
+    b = rng.standard_normal(n)
+    A = st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower, mb=16, nb=16)
+    x, iters = st.posv_mixed(A, jnp.asarray(b))
+    xv = np.asarray(x)
+    assert xv.shape == (n,)
+    res = np.linalg.norm(a @ xv - b) / (np.linalg.norm(a) * np.linalg.norm(xv))
+    assert res < 1e-13, f"vector-rhs refined residual {res}"
