@@ -261,8 +261,11 @@ def steqr(d, e, want_z: bool = True):
 
 def stedc(d, e, want_z: bool = True):
     """Divide-and-conquer tridiagonal eigensolver — reference ``stedc``
-    (``src/stedc.cc`` + ``stedc_deflate/merge/secular/solve/sort``)."""
-    return _tridiag_solve(d, e, want_z, "stevd")
+    (``src/stedc.cc``), implemented with the same stage decomposition
+    (``stedc_solve/merge/deflate/secular/sort/z_vector``) in
+    :mod:`slate_tpu.linalg._stedc`."""
+    from ._stedc import stedc as _dc_stedc
+    return _dc_stedc(d, e, want_z)
 
 
 def stemr(d, e, want_z: bool = True):

@@ -1,0 +1,166 @@
+"""Divide-and-conquer tridiagonal eigensolver — the analog of the
+reference's stedc stack tests (``unit_test/``, ``test/test_heev.cc``
+with D&C method).  Validates the full solver on varied spectra and the
+individual stages (deflate / secular / z_vector / sort)."""
+
+import numpy as np
+import pytest
+from scipy.linalg import eigvalsh_tridiagonal
+
+import slate_tpu as st
+from slate_tpu.linalg import _stedc as dc
+
+
+def _check(d, e):
+    w, q = dc.stedc(d, e)
+    n = d.size
+    t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+    res = np.linalg.norm(t @ q - q * w[None, :]) / (np.linalg.norm(t)
+                                                    + 1e-300)
+    orth = np.linalg.norm(q.T @ q - np.eye(n))
+    wref = eigvalsh_tridiagonal(d, e)
+    werr = np.abs(w - wref).max() / (np.abs(wref).max() + 1e-300)
+    assert res < 5e-14, f"residual {res}"
+    assert orth < 5e-13, f"orthogonality {orth}"
+    assert werr < 1e-12, f"eigenvalue error {werr}"
+
+
+class TestStedc:
+    def test_random(self):
+        rng = np.random.default_rng(0)
+        _check(rng.standard_normal(100), rng.standard_normal(99))
+
+    def test_random_odd(self):
+        rng = np.random.default_rng(1)
+        _check(rng.standard_normal(513), rng.standard_normal(512))
+
+    def test_clustered(self):
+        rng = np.random.default_rng(2)
+        d = np.ones(200) + 1e-14 * rng.standard_normal(200)
+        _check(d, 1e-13 * rng.standard_normal(199))
+
+    def test_decoupled(self):
+        rng = np.random.default_rng(3)
+        _check(rng.standard_normal(64), np.zeros(63))
+
+    def test_toeplitz(self):
+        # known analytic spectrum, maximal eigenvalue symmetry
+        n = 256
+        _check(2 * np.ones(n), -np.ones(n - 1))
+
+    def test_large_magnitude(self):
+        # scale 1e9 entries: catches tolerance tests that accidentally
+        # scale by the matrix norm twice (the deflation criterion must
+        # be absolute, as in dlaed2)
+        rng = np.random.default_rng(8)
+        _check(1e9 * rng.standard_normal(100),
+               1e9 * rng.standard_normal(99))
+
+    def test_large_magnitude_close_eigs(self):
+        # well-separated-by-1.0 eigenvalues at scale 1e9 must NOT deflate
+        d = np.concatenate([-1e9 + np.arange(50.0), 1e9 + np.arange(50.0)])
+        e = 10.0 * np.ones(99)
+        _check(d, e)
+
+    def test_graded(self):
+        # 12 decades of grading: stresses the under/overflow safety of
+        # the Gu-Eisenstat ratio products
+        d = np.logspace(0, -12, 128)
+        e = np.logspace(-1, -10, 127)
+        _check(d, e)
+
+    def test_want_z_false(self):
+        rng = np.random.default_rng(4)
+        d, e = rng.standard_normal(80), rng.standard_normal(79)
+        w = dc.stedc(d, e, want_z=False)
+        wref = eigvalsh_tridiagonal(d, e)
+        np.testing.assert_allclose(w, wref, atol=1e-12)
+
+
+class TestStages:
+    def test_sort(self):
+        d = np.array([3.0, 1.0, 2.0])
+        q = np.eye(3)
+        ds, qs = st.stedc_sort(d, q)
+        np.testing.assert_allclose(ds, [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(qs, np.eye(3)[:, [1, 2, 0]])
+
+    def test_z_vector_unit_norm(self):
+        rng = np.random.default_rng(5)
+        q1 = np.linalg.qr(rng.standard_normal((6, 6)))[0]
+        q2 = np.linalg.qr(rng.standard_normal((4, 4)))[0]
+        z = st.stedc_z_vector(q1[-1], q2[0])
+        assert abs(np.linalg.norm(z) - 1.0) < 1e-14
+
+    def test_deflate_tiny_coupling(self):
+        d = np.array([0.0, 1.0, 2.0, 3.0])
+        z = np.array([0.5, 1e-20, 0.5, 1e-20])
+        keep, d_u, z_u, givens = st.stedc_deflate(d, z, rho=1.0)
+        np.testing.assert_array_equal(keep, [True, False, True, False])
+        np.testing.assert_allclose(d_u[keep], [0.0, 2.0])
+        assert not givens
+
+    def test_deflate_duplicate_poles(self):
+        d = np.array([1.0, 1.0 + 1e-18, 2.0])
+        z = np.array([0.6, 0.8, 0.1])
+        keep, d_u, z_u, givens = st.stedc_deflate(d, z, rho=1.0)
+        assert keep.sum() == 2 and len(givens) == 1
+        # the rotated coupling keeps the combined weight
+        np.testing.assert_allclose(z_u[keep][0], np.hypot(0.6, 0.8))
+
+    def test_deflate_separated_poles_survive(self):
+        # poles 1.0 apart at scale 1e9: the absolute dlaed2 criterion
+        # must keep them (a norm-scaled tolerance would not)
+        d = np.array([-1e9, -1e9 + 1.0, 1e9])
+        z = np.array([0.6, 0.7, 0.38])
+        z = z / np.linalg.norm(z)
+        keep, d_u, z_u, givens = st.stedc_deflate(d, z, rho=2.0)
+        assert keep.all() and not givens
+
+    def test_secular_roots_interlace(self):
+        dk = np.array([0.0, 1.0, 2.0])
+        zk = np.array([0.5, 0.5, 0.5]) / np.sqrt(0.75)
+        rho = 0.3
+        lam, dmat = st.stedc_secular(dk, zk, rho)
+        # interlacing: d_i < lam_i < d_{i+1} (last above d_k)
+        assert np.all(lam[:2] > dk[:2]) and np.all(lam[:2] < dk[1:])
+        assert lam[2] > dk[2]
+        # each root satisfies the secular equation
+        f = 1.0 + rho * (zk[None, :] ** 2
+                         / (dk[None, :] - lam[:, None])).sum(axis=1)
+        assert np.abs(f).max() < 1e-10
+        # difference matrix consistency
+        np.testing.assert_allclose(dmat, dk[:, None] - lam[None, :],
+                                   atol=1e-12)
+
+    def test_merge_matches_dense_eig(self):
+        rng = np.random.default_rng(6)
+        n = 24
+        d = rng.standard_normal(n)
+        e = rng.standard_normal(n - 1)
+        m = n // 2
+        em = e[m - 1]
+        d1, d2 = d[:m].copy(), d[m:].copy()
+        d1[-1] -= abs(em)
+        d2[0] -= abs(em)
+        w1, q1 = dc._steqr_base(d1, e[:m - 1])
+        w2, q2 = dc._steqr_base(d2, e[m:])
+        w, q = st.stedc_merge(w1, q1, w2, q2, em)
+        t = np.diag(d) + np.diag(e, 1) + np.diag(e, -1)
+        np.testing.assert_allclose(w, np.linalg.eigvalsh(t), atol=1e-12)
+        assert np.linalg.norm(t @ q - q * w[None, :]) < 1e-12
+
+
+def test_heev_dc_uses_stedc():
+    """heev with MethodEig.DC goes through the in-house D&C solver."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(7)
+    n = 48
+    a = rng.standard_normal((n, n))
+    a = (a + a.T) / 2
+    A = st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower, mb=16, nb=16)
+    w, z = st.heev(A, True, {"method_eig": st.MethodEig.DC})
+    wv, zv = np.asarray(w), np.asarray(z)
+    res = np.linalg.norm(a @ zv - zv * wv[None, :]) / np.linalg.norm(a)
+    assert res < 1e-6
+    np.testing.assert_allclose(wv, np.linalg.eigvalsh(a), atol=1e-6)
