@@ -18,4 +18,7 @@ from .dist_blas3 import pgemm  # noqa: F401
 from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401
 from .dist_lu import pgetrf, pgetrs, pgesv  # noqa: F401
 from .dist_qr import pgeqrf, pgels, punmqr_conj  # noqa: F401
-from .dist_aux import pnorm, pherk, psyrk, ptrsm  # noqa: F401
+from .dist_aux import (  # noqa: F401
+    phemm, pher2k, pherk, pnorm, psymm, psyr2k, psyrk, ptri_mask, ptrmm,
+    ptrsm,
+)

@@ -83,12 +83,19 @@ def pnorm(a: DistMatrix, norm: Norm = Norm.Fro):
 
 
 @lru_cache(maxsize=None)
-def _build_pherk(mesh, nb: int, ktp: int, ml: int, nl: int, conj: bool,
-                 dtype_name: str):
+def _build_pgemm_nt(mesh, nb: int, ktp: int, ml: int, nl: int, conj: bool,
+                    same_operand: bool, dtype_name: str):
+    """C ← α·A·op(B)ᵀ + β·C where A and B share the same row
+    distribution (the herk/her2k shape: both m×k over mesh rows).
+    ``op`` is conj for Hermitian-family updates, identity for symmetric.
+    ``same_operand`` reuses A's broadcast column for B (the herk case:
+    B is A), halving the AXIS_Q collective traffic.
+    """
+
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
 
-    def kernel(a_loc, c_loc, alpha, beta):
+    def kernel(a_loc, b_loc, c_loc, alpha, beta):
         r = lax.axis_index(AXIS_P)
         c = lax.axis_index(AXIS_Q)
         dt = a_loc.dtype
@@ -101,11 +108,17 @@ def _build_pherk(mesh, nb: int, ktp: int, ml: int, nl: int, conj: bool,
             a_panel = lax.dynamic_slice(a_loc, (0, (k // q) * nb),
                                         (ml * nb, nb))
             a_col = lax.psum(a_panel * (k % q == c).astype(dt), AXIS_Q)
-            # (Aᴴ) block-row k restricted to my column blocks: gather A's
-            # rows along 'p' and pick the ones matching j_idx (the same
-            # move as ppotrf's trailing W, dist_factor.py)
-            ag = lax.all_gather(a_col, AXIS_P, axis=0, tiled=True)
-            rows = jnp.take(ag.reshape(mtp, nb, nb), gpos, axis=0)
+            # op(B)ᵀ block-row k restricted to my column blocks: gather
+            # B's column k along 'p' and pick the row-blocks matching
+            # j_idx (the same move as ppotrf's trailing W, dist_factor.py)
+            if same_operand:
+                b_col = a_col
+            else:
+                b_panel = lax.dynamic_slice(b_loc, (0, (k // q) * nb),
+                                            (ml * nb, nb))
+                b_col = lax.psum(b_panel * (k % q == c).astype(dt), AXIS_Q)
+            bg = lax.all_gather(b_col, AXIS_P, axis=0, tiled=True)
+            rows = jnp.take(bg.reshape(mtp, nb, nb), gpos, axis=0)
             rows = jnp.conj(rows) if conj else rows
             right = jnp.transpose(rows, (2, 0, 1)).reshape(nb, nl * nb)
             return acc + _mm(a_col, right)
@@ -114,12 +127,13 @@ def _build_pherk(mesh, nb: int, ktp: int, ml: int, nl: int, conj: bool,
         return alpha * acc + beta * c_loc
 
     fn = shard_map(kernel, mesh=mesh,
-                   in_specs=(P(AXIS_P, AXIS_Q), P(AXIS_P, AXIS_Q), P(), P()),
+                   in_specs=(P(AXIS_P, AXIS_Q), P(AXIS_P, AXIS_Q),
+                             P(AXIS_P, AXIS_Q), P(), P()),
                    out_specs=P(AXIS_P, AXIS_Q))
     return jax.jit(fn)
 
 
-def _pherk_like(alpha, a: DistMatrix, beta, c: DistMatrix, conj: bool):
+def _rank_update_c(a: DistMatrix, c, beta):
     p, q = a.grid_shape
     if c is None:
         # create C sharded from the start — a replicated (mtp·nb)² zeros
@@ -132,12 +146,35 @@ def _pherk_like(alpha, a: DistMatrix, beta, c: DistMatrix, conj: bool):
     if c.mtp != a.mtp or c.ntp != a.mtp:
         raise ValueError("C padding must be square and match A's rows "
                          "(distribute A with row_mult=q, C with both mults)")
+    return c, beta
+
+
+def _pgemm_nt(alpha, a: DistMatrix, b: DistMatrix, beta, c: DistMatrix,
+              conj: bool, same_operand: bool = False):
+    p, q = a.grid_shape
     ml = a.mtp // p
     nl = c.ntp // q
-    fn = _build_pherk(a.mesh, a.nb, a.ntp, ml, nl, conj, str(a.dtype))
+    fn = _build_pgemm_nt(a.mesh, a.nb, a.ntp, ml, nl, conj, same_operand,
+                         str(a.dtype))
     dt = a.dtype
-    out = fn(a.data, c.data, jnp.asarray(alpha, dt), jnp.asarray(beta, dt))
+    out = fn(a.data, b.data, c.data, jnp.asarray(alpha, dt),
+             jnp.asarray(beta, dt))
     return like(c, out)
+
+
+def _pherk_like(alpha, a: DistMatrix, beta, c: DistMatrix, conj: bool):
+    c, beta = _rank_update_c(a, c, beta)
+    return _pgemm_nt(alpha, a, a, beta, c, conj, same_operand=True)
+
+
+def _check_nt_operands(a: DistMatrix, b: DistMatrix):
+    if a.mesh is not b.mesh:
+        raise ValueError("A and B must live on the same mesh")
+    if (a.m, a.n) != (b.m, b.n) or a.dtype != b.dtype:
+        raise ValueError(f"A ({a.m}x{a.n} {a.dtype}) and B ({b.m}x{b.n} "
+                         f"{b.dtype}) must match in shape and dtype")
+    if (a.mtp, a.ntp, a.nb) != (b.mtp, b.ntp, b.nb):
+        raise ValueError("A and B must be distributed identically")
 
 
 def pherk(alpha, a: DistMatrix, beta=0.0, c: DistMatrix = None):
@@ -150,6 +187,104 @@ def pherk(alpha, a: DistMatrix, beta=0.0, c: DistMatrix = None):
 def psyrk(alpha, a: DistMatrix, beta=0.0, c: DistMatrix = None):
     """C ← α·A·Aᵀ + β·C distributed (reference ``slate::syrk``)."""
     return _pherk_like(alpha, a, beta, c, False)
+
+
+def pher2k(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
+           c: DistMatrix = None):
+    """C ← α·A·Bᴴ + ᾱ·B·Aᴴ + β·C distributed (reference ``slate::her2k``,
+    ``src/her2k.cc``): two A·op(B)ᵀ sweeps over the same kernel that
+    powers :func:`pherk`.  A and B must share shape and distribution."""
+
+    _check_nt_operands(a, b)
+    c, beta = _rank_update_c(a, c, beta)
+    c1 = _pgemm_nt(alpha, a, b, beta, c, True)
+    return _pgemm_nt(np.conj(alpha), b, a, 1.0, c1, True)
+
+
+def psyr2k(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
+           c: DistMatrix = None):
+    """C ← α·A·Bᵀ + α·B·Aᵀ + β·C distributed (reference
+    ``slate::syr2k``)."""
+
+    _check_nt_operands(a, b)
+    c, beta = _rank_update_c(a, c, beta)
+    c1 = _pgemm_nt(alpha, a, b, beta, c, False)
+    return _pgemm_nt(alpha, b, a, 1.0, c1, False)
+
+
+@lru_cache(maxsize=None)
+def _build_ptri_mask(mesh, nb: int, ml: int, nl: int, n: int, uplo: Uplo,
+                     unit: bool):
+    p, q = mesh_grid_shape(mesh)
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        grows, gcols = _local_index_maps(p, q, ml, nl, nb, r, c)
+        gi, gj = grows[:, None], gcols[None, :]
+        keep = (gi >= gj) if uplo is Uplo.Lower else (gi <= gj)
+        out = jnp.where(keep, a_loc, jnp.zeros((), a_loc.dtype))
+        if unit:
+            diag = (gi == gj) & (gi < n)
+            out = jnp.where(diag, jnp.ones((), a_loc.dtype), out)
+        return out
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def ptri_mask(a: DistMatrix, uplo: Uplo, diag: Diag = Diag.NonUnit
+              ) -> DistMatrix:
+    """Keep only the ``uplo`` triangle of a distributed square matrix
+    (unit diagonal written explicitly for ``Diag.Unit``) — a local,
+    communication-free masking pass using the block-cyclic index maps."""
+
+    p, q = a.grid_shape
+    fn = _build_ptri_mask(a.mesh, a.nb, a.mtp // p, a.ntp // q, a.n, uplo,
+                          diag is Diag.Unit)
+    return like(a, fn(a.data))
+
+
+def ptrmm(uplo: Uplo, diag: Diag, a: DistMatrix, b: DistMatrix,
+          alpha=1.0) -> DistMatrix:
+    """Distributed triangular multiply B ← α·A·B, A the ``uplo`` triangle
+    (reference ``slate::trmm``, ``src/trmm.cc`` / ``work_trmm.cc:428``).
+
+    TPU-first design: the triangle is *masked*, not specially scheduled —
+    the mask is a free local pass and the multiply then rides the SUMMA
+    pgemm kernel; the reference's triangular tile-skipping saves half the
+    flops on CPUs but costs load balance on a systolic mesh."""
+
+    from .dist_blas3 import pgemm
+    at = ptri_mask(a, uplo, diag)
+    return pgemm(alpha, at, b)
+
+
+def phemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
+          c: DistMatrix = None) -> DistMatrix:
+    """Distributed Hermitian multiply C ← α·A·B + β·C with Hermitian A
+    (reference ``slate::hemm``, ``src/hemm.cc``).
+
+    ``DistMatrix`` stores matrices dense (both triangles materialized),
+    so the multiply itself is the SUMMA pgemm — same flop count as the
+    reference's hemm, which also multiplies both triangles and saves
+    only the *storage* of one.  ``a`` must hold the full Hermitian
+    matrix (as produced by the distributed drivers)."""
+
+    from .dist_blas3 import pgemm
+    if a.m != a.n:
+        raise ValueError("phemm: A must be square")
+    if c is not None:
+        return pgemm(alpha, a, b, beta, c)
+    return pgemm(alpha, a, b)
+
+
+def psymm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
+          c: DistMatrix = None) -> DistMatrix:
+    """Distributed symmetric multiply (reference ``slate::symm``) — see
+    :func:`phemm`."""
+    return phemm(alpha, a, b, beta, c)
 
 
 def ptrsm(side: Side, uplo: Uplo, op: Op, diag: Diag,

@@ -305,3 +305,79 @@ class TestPtrsm:
         x = ptrsm(Side.Left, Uplo.Upper, Op.NoTrans, Diag.NonUnit, du, db)
         np.testing.assert_allclose(np.asarray(undistribute(x)),
                                    np.linalg.solve(u, b), rtol=1e-10, atol=1e-10)
+
+
+class TestDistBlas3Extended:
+    """pher2k/psyr2k, ptrmm, phemm/psymm (reference src/her2k.cc,
+    src/trmm.cc, src/hemm.cc over the mesh)."""
+
+    def test_pher2k_matches(self, mesh24):
+        n, k, nb = 64, 48, 16
+        rng = _rng(31)
+        a = rng.standard_normal((n, k))
+        b = rng.standard_normal((n, k))
+        from slate_tpu.parallel import pher2k
+        da = distribute(a, mesh24, nb=nb, row_mult=4)
+        db = distribute(b, mesh24, nb=nb, row_mult=4)
+        out = np.asarray(undistribute(pher2k(2.0, da, db)))[:n, :n]
+        ref = 2.0 * (a @ b.T) + 2.0 * (b @ a.T)
+        np.testing.assert_allclose(out, ref, atol=1e-10)
+
+    def test_psyr2k_beta(self, mesh24):
+        n, k, nb = 48, 32, 16
+        rng = _rng(32)
+        a = rng.standard_normal((n, k))
+        b = rng.standard_normal((n, k))
+        c = rng.standard_normal((n, n))
+        from slate_tpu.parallel import psyr2k
+        da = distribute(a, mesh24, nb=nb, row_mult=4)
+        db = distribute(b, mesh24, nb=nb, row_mult=4)
+        dcm = distribute(c, mesh24, nb=nb, row_mult=4, col_mult=2)
+        out = np.asarray(undistribute(psyr2k(1.5, da, db, beta=-1.0,
+                                             c=dcm)))[:n, :n]
+        ref = 1.5 * (a @ b.T + b @ a.T) - c
+        np.testing.assert_allclose(out, ref, atol=1e-10)
+
+    def test_ptrmm(self, mesh24):
+        import slate_tpu as st
+        n, nrhs, nb = 64, 32, 16
+        rng = _rng(33)
+        a = np.tril(rng.standard_normal((n, n)))
+        b = rng.standard_normal((n, nrhs))
+        from slate_tpu.parallel import ptrmm
+        # feed the full matrix: ptrmm must only read the triangle
+        full = a + np.triu(rng.standard_normal((n, n)), 1)
+        da = distribute(full, mesh24, nb=nb, col_mult=2)
+        db = distribute(b, mesh24, nb=nb)
+        out = np.asarray(undistribute(
+            ptrmm(st.Uplo.Lower, st.Diag.NonUnit, da, db)))
+        np.testing.assert_allclose(out, a @ b, atol=1e-11)
+
+    def test_ptrmm_unit_diag(self, mesh24):
+        import slate_tpu as st
+        n, nb = 48, 16
+        rng = _rng(34)
+        a = np.tril(rng.standard_normal((n, n)), -1) + np.eye(n)
+        b = rng.standard_normal((n, 8))
+        from slate_tpu.parallel import ptrmm
+        # only the strictly-lower part + unit diagonal may be read
+        da = distribute(np.triu(rng.standard_normal((n, n)), 1)
+                        + np.tril(a, -1), mesh24, nb=nb, col_mult=2)
+        db = distribute(b, mesh24, nb=nb)
+        out = np.asarray(undistribute(
+            ptrmm(st.Uplo.Lower, st.Diag.Unit, da, db)))
+        np.testing.assert_allclose(out, a @ b, atol=1e-11)
+
+    def test_phemm(self, mesh24):
+        n, nrhs, nb = 64, 16, 16
+        rng = _rng(35)
+        g = rng.standard_normal((n, n))
+        a = (g + g.T) / 2
+        b = rng.standard_normal((n, nrhs))
+        c = rng.standard_normal((n, nrhs))
+        from slate_tpu.parallel import phemm
+        da = distribute(a, mesh24, nb=nb, col_mult=2)
+        db = distribute(b, mesh24, nb=nb)
+        dcm = distribute(c, mesh24, nb=nb)
+        out = np.asarray(undistribute(phemm(1.0, da, db, beta=2.0, c=dcm)))
+        np.testing.assert_allclose(out, a @ b + 2.0 * c, atol=1e-11)
