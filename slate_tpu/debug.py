@@ -1,0 +1,85 @@
+"""Debug invariants — the analog of the reference's ``Debug`` class
+(``src/auxiliary/Debug.cc``): ``checkTilesLives`` (life-counter
+consistency, ``:66``), ``checkTilesLayout`` (``:100``),
+``checkHostMemoryLeaks/checkDeviceMemoryLeaks`` on the pool
+(``:316,336``) and the ``printTiles_`` state dumps (``:169``).
+
+The TPU design has no MOSI states or life counters (XLA owns placement),
+so the invariants that remain meaningful are value sanity (NaN/Inf per
+tile), distribution-layout consistency of :class:`DistMatrix`, and the
+native memory pool's leak counters.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .exceptions import SlateError
+
+
+def check_finite(a, nb: int = 256, name: str = "A") -> None:
+    """Raise :class:`SlateError` listing every (i, j) tile containing a
+    NaN/Inf — the debugging role of the reference's per-tile state dumps
+    (``Debug::printTiles_``)."""
+
+    arr = np.asarray(getattr(a, "array", a))
+    bad: List[Tuple[int, int]] = []
+    mt = -(-arr.shape[-2] // nb)
+    nt = -(-arr.shape[-1] // nb)
+    finite = np.isfinite(arr)
+    if finite.all():
+        return
+    for i in range(mt):
+        for j in range(nt):
+            blk = finite[..., i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]
+            if not blk.all():
+                bad.append((i, j))
+    raise SlateError(f"{name}: non-finite values in tiles {bad} (nb={nb})")
+
+
+def check_dist_layout(dm) -> None:
+    """Validate a :class:`~slate_tpu.parallel.DistMatrix`'s layout
+    invariants — the analog of ``Debug::checkTilesLayout``: padded shape
+    divisible by nb, tile counts divisible by the grid, true dims inside
+    the padding."""
+
+    p, q = dm.grid_shape
+    mp, np_ = dm.data.shape
+    if mp % dm.nb or np_ % dm.nb:
+        raise SlateError(f"padded shape {dm.data.shape} not a multiple of "
+                         f"nb={dm.nb}")
+    if dm.mtp % p or dm.ntp % q:
+        raise SlateError(f"tile grid {dm.mtp}x{dm.ntp} not divisible by "
+                         f"process grid {p}x{q}")
+    if dm.m > mp or dm.n > np_:
+        raise SlateError(f"true dims ({dm.m},{dm.n}) exceed padded storage "
+                         f"{dm.data.shape}")
+
+
+def check_pool_leaks(pool) -> None:
+    """Leak check on a native :class:`~slate_tpu.native.MemoryPool` —
+    ``Debug::checkHostMemoryLeaks`` (``Debug.cc:316``): every allocated
+    block must have been returned."""
+
+    outstanding = pool.num_allocated - pool.num_free
+    if outstanding:
+        raise SlateError(
+            f"memory pool leak: {outstanding} block(s) outstanding "
+            f"({pool.num_allocated} allocated, {pool.num_free} free)")
+
+
+def memory_stats() -> dict:
+    """Native runtime stats — ``Debug::printNumFreeMemBlocks``
+    (``Debug.cc:304``) territory.  Returns availability + thread count;
+    per-pool counters live on :class:`~slate_tpu.native.MemoryPool`."""
+
+    try:
+        from . import native
+    except Exception:                       # pragma: no cover
+        return {"available": False}
+    if not native.available():
+        return {"available": False}
+    return {"available": True, "host_threads": native.num_threads()}

@@ -13,9 +13,10 @@ TPU-native re-design of the reference QR stack:
 Design stance (TPU-first):
 
 * **Compact-WY everywhere.**  The reflector block (I − V·T·Vᴴ) turns the
-  panel's reflector chain into three MXU matmuls; the T factor is built
-  by a *recursive* ``larft`` (halving, one small matmul per level) so no
-  O(nb) sequential loop appears in the trace.
+  panel's reflector chain into three MXU matmuls; the T factor comes
+  from the closed form T⁻¹ = strict_upper(VᴴV) + diag(1/τ) — one Gram
+  matmul + one log-depth triangular inverse, so neither a sequential
+  column loop nor an O(k)-node recursion appears in the trace.
 * The factorization recursion mirrors :func:`~slate_tpu.ops.blocks.potrf_rec`:
   each level factors the left half, applies one block reflector to the
   right half (two big matmuls — the hot loop), and recurses.  XLA's
@@ -64,23 +65,30 @@ def _unit_lower(packed, k: int):
 def larft_rec(v, tau):
     """Forward column-wise compact-WY T: H₀⋯H_{k−1} = I − V·T·Vᴴ.
 
-    Recursive-halving form of LAPACK ``larft`` (the reference builds T
-    inside ``Tile_geqrf.hh``'s panel loop): T = [[T₁, −T₁·(V₁ᴴV₂)·T₂],
-    [0, T₂]] — log-depth, matmul-shaped, no sequential column loop in
-    the XLA graph.
+    Closed-form ``larft`` (the reference builds T column-by-column inside
+    ``Tile_geqrf.hh``'s panel loop): the larfg normalization guarantees
+    ``Re(1/τⱼ) = ‖vⱼ‖²/2``, so orthogonality of the block reflector
+    forces ``T⁻¹ = strict_upper(VᴴV) + diag(1/τ)``.  One Gram matmul and
+    one log-depth triangular inverse replace both LAPACK's sequential
+    column loop and the O(k) recursive-halving tree — two MXU-shaped ops
+    whose trace size is independent of k (the halving tree re-traced
+    ~2k nodes per distinct panel shape, dominating compile time).
+
+    Columns with τⱼ = 0 (Hⱼ = I) get T[:, j] = 0, matching ``dlarft``.
     """
 
     k = v.shape[1]
+    dt = v.dtype
     if k == 1:
-        return tau.reshape(1, 1).astype(v.dtype)
-    k1 = k // 2
-    t1 = larft_rec(v[:, :k1], tau[:k1])
-    t2 = larft_rec(v[:, k1:], tau[k1:])
-    # the cross block only involves rows where V₂ is nonzero
-    t12 = -matmul(t1, matmul(matmul(_ct(v[k1:, :k1]), v[k1:, k1:]), t2))
-    top = jnp.concatenate([t1, t12], axis=1)
-    bot = jnp.concatenate([jnp.zeros((k - k1, k1), v.dtype), t2], axis=1)
-    return jnp.concatenate([top, bot], axis=0)
+        return tau.reshape(1, 1).astype(dt)
+    s = matmul(_ct(v), v)                      # Gram matrix VᴴV
+    zero = tau == 0
+    safe_tau = jnp.where(zero, jnp.ones((), tau.dtype), tau)
+    tinv = jnp.triu(s, 1) + jnp.diag(1.0 / safe_tau).astype(dt)
+    t = blocks.trtri_rec(Uplo.Upper, Diag.NonUnit, tinv,
+                         max(32, k // 8))
+    t = jnp.triu(t)
+    return jnp.where(zero[None, :], jnp.zeros((), dt), t)
 
 
 def _apply_block_reflector(v, t, c, *, forward: bool):

@@ -59,6 +59,17 @@ def gecopy(a, dtype=None):
     return a.astype(dtype) if dtype is not None else a
 
 
+def tzcopy(uplo: Uplo, a, b, dtype=None):
+    """Copy the ``uplo`` trapezoid of A over B, optionally converting
+    precision (ref ``device::tzcopy``, ``src/cuda/device_tzcopy.cu``)."""
+    m, n = a.shape[-2], a.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = (i >= j) if uplo is Uplo.Lower else (i <= j)
+    out_dtype = dtype or b.dtype
+    return jnp.where(keep, a.astype(out_dtype), b.astype(out_dtype))
+
+
 def gescale(numer, denom, a):
     """A *= numer/denom (ref ``device::gescale``) — the two-scalar form
     avoids overflow when numer/denom would."""

@@ -136,3 +136,39 @@ def test_svd_float32():
     sref = np.linalg.svd(a.astype(np.float64), compute_uv=False)
     assert np.abs(s - sref).max() < 1e-3
     assert np.abs((u * s[None, :]) @ vh - a).max() < 1e-3
+
+
+class TestHeevBandFastPath:
+    """The Auto-method band fast path (host hbevd) — normally n > 512."""
+
+    def _run(self, n, nb, complex_=False, monkey_thresh=64):
+        from slate_tpu.linalg import eig as eig_mod
+        rng = np.random.default_rng(99)
+        a = rng.standard_normal((n, n))
+        if complex_:
+            a = a + 1j * rng.standard_normal((n, n))
+        a = (a + np.conj(a.T)) / 2
+        A = st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower,
+                               mb=nb, nb=nb)
+        saved = eig_mod._BAND_SOLVER_MIN_N
+        eig_mod._BAND_SOLVER_MIN_N = monkey_thresh
+        try:
+            w, z = st.heev(A)
+            wv_only, _ = st.heev(A, jobz=False)
+        finally:
+            eig_mod._BAND_SOLVER_MIN_N = saved
+        wv, zv = np.asarray(w), np.asarray(z)
+        res = np.linalg.norm(a @ zv - zv * wv[None, :]) / np.linalg.norm(a)
+        assert res < 1e-5, f"band fast path residual {res}"
+        np.testing.assert_allclose(wv, np.linalg.eigvalsh(a), atol=2e-4)
+        np.testing.assert_allclose(np.asarray(wv_only), wv, atol=1e-6)
+
+    def test_real(self):
+        self._run(96, 32)
+
+    def test_complex(self):
+        self._run(80, 16, complex_=True)
+
+    def test_kd_not_less_than_n(self):
+        # nb >= n makes he2hb's kd >= n: the banded conversion must clamp
+        self._run(72, 96, monkey_thresh=16)

@@ -132,3 +132,56 @@ def test_method_selection():
     assert method.select_lu(MethodLU.Auto, distributed=True) is MethodLU.CALU
     assert method.select_eig(MethodEig.Auto, 100, True) is MethodEig.DC
     assert method.select_cholqr(MethodCholQR.Auto, 4000, 100) is MethodCholQR.HerkC
+
+
+class TestDebugInvariants:
+    """slate_tpu.debug — the reference's Debug.cc invariant checks."""
+
+    def test_check_finite_passes(self):
+        from slate_tpu import debug
+        debug.check_finite(jnp.ones((64, 64)), nb=32)
+
+    def test_check_finite_locates_tile(self):
+        from slate_tpu import debug
+        from slate_tpu.exceptions import SlateError
+        a = np.ones((64, 64))
+        a[40, 10] = np.nan
+        with pytest.raises(SlateError) as ei:
+            debug.check_finite(jnp.asarray(a), nb=32, name="X")
+        assert "(1, 0)" in str(ei.value)
+
+    def test_check_pool_leaks(self):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from slate_tpu import debug
+        from slate_tpu.exceptions import SlateError
+        pool = native.MemoryPool(4096)
+        b = pool.alloc()
+        with pytest.raises(SlateError):
+            debug.check_pool_leaks(pool)
+        pool.free(b)
+        debug.check_pool_leaks(pool)
+        pool.close()
+
+    def test_check_dist_layout(self):
+        import jax
+        from slate_tpu import debug
+        from slate_tpu.parallel import distribute, make_grid_mesh
+        mesh = make_grid_mesh(2, 4)
+        dm = distribute(np.ones((60, 60)), mesh, nb=16)
+        debug.check_dist_layout(dm)
+
+
+def test_tzcopy():
+    from slate_tpu.ops.tile_ops import tzcopy
+    import slate_tpu as st
+    a = jnp.arange(16.0).reshape(4, 4)
+    b = -jnp.ones((4, 4))
+    out = np.asarray(tzcopy(st.Uplo.Lower, a, b))
+    ref = np.where(np.tril(np.ones((4, 4))) > 0, np.arange(16.0).reshape(4, 4),
+                   -1.0)
+    np.testing.assert_allclose(out, ref)
+    # precision-converting variant (reference gecopy/tzcopy s<->d)
+    out32 = tzcopy(st.Uplo.Upper, a.astype(jnp.float64), b, dtype=jnp.float32)
+    assert out32.dtype == jnp.float32
