@@ -84,7 +84,11 @@ def larft_rec(v, tau):
     s = matmul(_ct(v), v)                      # Gram matrix VᴴV
     zero = tau == 0
     safe_tau = jnp.where(zero, jnp.ones((), tau.dtype), tau)
-    tinv = jnp.triu(s, 1) + jnp.diag(1.0 / safe_tau).astype(dt)
+    # a τⱼ = 0 column contributes Hⱼ = I: zero both its row in T⁻¹'s
+    # strict-upper part (so the inversion propagates no cross terms
+    # through it) and, below, its column of T — matching dlarft
+    su = jnp.where(zero[:, None], jnp.zeros((), dt), jnp.triu(s, 1))
+    tinv = su + jnp.diag(1.0 / safe_tau).astype(dt)
     t = blocks.trtri_rec(Uplo.Upper, Diag.NonUnit, tinv,
                          max(32, k // 8))
     t = jnp.triu(t)

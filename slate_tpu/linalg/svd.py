@@ -276,6 +276,12 @@ def bdsqr(d, e, want_uv: bool = False, method: MethodSVD = MethodSVD.Auto):
 # Drivers
 # ---------------------------------------------------------------------------
 
+#: above this size svd's Auto method solves the band middle factor with
+#: one host-LAPACK gesdd call instead of the staged tb2bd chain (tests
+#: lower it to cover the fast path)
+_BAND_SOLVER_MIN_N = 512
+
+
 def svd_vals(a, opts: Optional[Options] = None):
     """Singular values — reference ``slate::svd_vals`` (``src/svd.cc``)."""
     return svd(a, jobu=False, jobvt=False, opts=opts)[0]
@@ -298,11 +304,41 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
             (None if u is None else _ct(u))
     factors = ge2tb(a, opts)
     band_np = np.asarray(factors.band)
+    method = get_option(opts, "method_svd", MethodSVD.Auto)
+    # Large-n fast path (Auto): solve the triangular-band middle factor
+    # with one host-LAPACK gesdd call instead of the staged
+    # tb2bd → bdsqr → unmbr_tb2bd chain, whose Python Givens sweeps cost
+    # O(n²·kd) interpreter steps.  The reference likewise runs stage 2
+    # on a single node (src/svd.cc:207-372); host gesdd is its C-speed
+    # analog.  The staged path remains for explicit methods.
+    if method is MethodSVD.Auto and n > _BAND_SOLVER_MIN_N:
+        # ge2tb leaves the middle factor upper-triangular-banded: only
+        # its top n rows are nonzero, so the host solve is n×n
+        band_sq = band_np[:n]
+        want_uv = jobu or jobvt
+        if not want_uv:
+            s = np.linalg.svd(band_sq, compute_uv=False)
+            return jnp.asarray(np.ascontiguousarray(s)), None, None
+        u_b, s, vh_b = np.linalg.svd(band_sq, full_matrices=False)
+        dtype = factors.band.dtype
+        u = vh = None
+        if jobu:
+            u2 = u_b
+            if m > n:
+                u2 = np.concatenate(
+                    [u2, np.zeros((m - n, u2.shape[1]), dtype=u2.dtype)],
+                    axis=0)
+            u = unmbr_ge2tb(Side.Left, Op.NoTrans, factors,
+                            jnp.asarray(u2, dtype=dtype))
+        if jobvt:
+            v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
+                            jnp.asarray(_ct(vh_b), dtype=dtype))
+            vh = _ct(v)
+        return jnp.asarray(s), u, vh
     d, e, rots = tb2bd(band_np, factors.kd)
     want_uv = jobu or jobvt
     if not want_uv:
         return jnp.asarray(bdsqr(d, e).copy()), None, None
-    method = get_option(opts, "method_svd", MethodSVD.Auto)
     u_b, s, vh_b = bdsqr(d, e, want_uv=True, method=method)
     dtype = factors.band.dtype
     u = vh = None

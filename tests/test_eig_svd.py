@@ -172,3 +172,47 @@ class TestHeevBandFastPath:
     def test_kd_not_less_than_n(self):
         # nb >= n makes he2hb's kd >= n: the banded conversion must clamp
         self._run(72, 96, monkey_thresh=16)
+
+
+class TestSvdBandFastPath:
+    """The Auto-method SVD band fast path (host gesdd) — normally n > 512."""
+
+    def _run(self, m, n, nb, complex_=False):
+        import sys
+        svd_mod = sys.modules["slate_tpu.linalg.svd"]
+        rng = np.random.default_rng(101)
+        a = rng.standard_normal((m, n))
+        if complex_:
+            a = a + 1j * rng.standard_normal((m, n))
+        saved = svd_mod._BAND_SOLVER_MIN_N
+        svd_mod._BAND_SOLVER_MIN_N = 16
+        try:
+            s, u, vh = st.svd(jnp.asarray(a), opts={"nb": nb})
+            s_only = st.svd_vals(jnp.asarray(a), opts={"nb": nb})
+        finally:
+            svd_mod._BAND_SOLVER_MIN_N = saved
+        sv = np.asarray(s)
+        uv, vhv = np.asarray(u), np.asarray(vh)
+        k = min(m, n)
+        rec = uv @ np.diag(sv.astype(uv.dtype)) @ vhv
+        res = np.linalg.norm(rec - a) / np.linalg.norm(a)
+        assert res < 1e-5, f"svd fast path residual {res}"
+        sref = np.linalg.svd(a, compute_uv=False)
+        np.testing.assert_allclose(sv, sref, atol=2e-4 * sref[0])
+        np.testing.assert_allclose(np.asarray(s_only), sv,
+                                   atol=1e-6 * sref[0])
+        # orthogonality of the factors
+        assert np.linalg.norm(np.conj(uv.T) @ uv - np.eye(k)) < 1e-4
+        assert np.linalg.norm(vhv @ np.conj(vhv.T) - np.eye(k)) < 1e-4
+
+    def test_square(self):
+        self._run(96, 96, 32)
+
+    def test_tall(self):
+        self._run(160, 64, 32)
+
+    def test_wide(self):
+        self._run(64, 144, 32)
+
+    def test_complex(self):
+        self._run(80, 80, 16, complex_=True)

@@ -126,3 +126,22 @@ def test_gels_cholqr_and_auto():
     x3 = np.asarray(gels(st.Matrix.from_array(a, nb=16), jnp.asarray(b),
                          {"method_gels": MethodGels.QR}))
     np.testing.assert_allclose(x3, want, atol=1e-8)
+
+
+def test_larft_interior_zero_tau():
+    """A tau=0 column (H_j = I) must contribute nothing to T — the
+    closed-form larft must zero both its row and column (dlarft)."""
+    from slate_tpu.linalg.qr import larft_rec
+    rng = np.random.default_rng(55)
+    m, k = 8, 3
+    v = np.tril(rng.standard_normal((m, k)), -1)
+    v[np.arange(k), np.arange(k)] = 1.0
+    tau = np.array([0.7, 0.0, 0.4])
+    t = np.asarray(larft_rec(jnp.asarray(v), jnp.asarray(tau)))
+    # reference: product of reflectors, skipping the identity one
+    q = np.eye(m)
+    for j in range(k):
+        h = np.eye(m) - tau[j] * np.outer(v[:, j], v[:, j])
+        q = q @ h
+    q_wy = np.eye(m) - v @ t @ v.T
+    np.testing.assert_allclose(q_wy, q, atol=1e-12)
