@@ -31,8 +31,10 @@ TPU-first design stance:
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -86,7 +88,22 @@ def he2hb(a, opts: Optional[Options] = None) -> He2hbFactors:
     n = full.shape[-1]
     if full.shape[-2] != n:
         raise SlateError(f"he2hb requires square, got {full.shape}")
-    panels: List[Tuple[int, jnp.ndarray, jnp.ndarray]] = []
+    band, vts = _he2hb_impl(full, nb)
+    # row0 is derivable from V's row count (V spans rows r0..n); store it
+    # for convenience but the shapes stay the single source of truth
+    panels = tuple((n - v.shape[0], v, t) for v, t in vts)
+    return He2hbFactors(band=band, kd=nb, panels=panels)
+
+
+@partial(jax.jit, static_argnums=1)
+def _he2hb_impl(full, nb: int):
+    """The whole panel loop under one jit: per-panel ops have static
+    (shrinking) shapes, XLA schedules the chain, and there is exactly
+    one device dispatch per call instead of dozens per panel (which over
+    a ~100 ms host↔device tunnel dominated the wall time)."""
+
+    n = full.shape[-1]
+    vts = []
     for j0 in range(0, max(n - nb, 0), nb):
         r0 = j0 + nb
         w = min(nb, n - j0)
@@ -111,13 +128,13 @@ def he2hb(a, opts: Optional[Options] = None) -> He2hbFactors:
         wmat = y - 0.5 * matmul(v, s)
         b = b - matmul(v, _ct(wmat)) - matmul(wmat, _ct(v))
         full = full.at[r0:, r0:].set(b)
-        panels.append((r0, v, t))
+        vts.append((v, t))
     # clamp to the band (numerical zeros outside) and re-hermitize
     i = jnp.arange(n)
     mask = jnp.abs(i[:, None] - i[None, :]) <= nb
     band = jnp.where(mask, full, 0)
     band = 0.5 * (band + _ct(band))
-    return He2hbFactors(band=band, kd=nb, panels=tuple(panels))
+    return band, tuple(vts)
 
 
 def unmtr_he2hb(side: Side, op: Op, factors: He2hbFactors, c,
@@ -131,9 +148,18 @@ def unmtr_he2hb(side: Side, op: Op, factors: He2hbFactors, c,
         # C·Q = (Qᴴ·Cᴴ)ᴴ
         flip = Op.NoTrans if op is not Op.NoTrans else Op.ConjTrans
         return _ct(unmtr_he2hb(Side.Left, flip, factors, _ct(cv), opts))
-    seq = factors.panels if op is not Op.NoTrans else factors.panels[::-1]
-    for r0, v, t in seq:
-        tt = _ct(t) if op is not Op.NoTrans else t
+    vts = tuple((v, t) for _, v, t in factors.panels)
+    return _unmtr_he2hb_impl(vts, cv, op is Op.NoTrans)
+
+
+@partial(jax.jit, static_argnums=2)
+def _unmtr_he2hb_impl(vts, cv, forward: bool):
+    """Reflector chain under one jit (one dispatch, see _he2hb_impl)."""
+    n = cv.shape[0]
+    seq = vts[::-1] if forward else vts
+    for v, t in seq:
+        r0 = n - v.shape[0]
+        tt = t if forward else _ct(t)
         tail = cv[r0:]
         tail = tail - matmul(v, matmul(tt, matmul(_ct(v), tail)))
         cv = jnp.concatenate([cv[:r0], tail], axis=0)

@@ -98,10 +98,14 @@ def _panel_lu(a):
     return lu, perm
 
 
-def _panel_lu_nopiv(a, ib: int = 8):
+def _panel_lu_nopiv(a, ib: int = 128):
     """No-pivot panel via inner blocking ``ib`` (reference
     ``Option::InnerBlocking``): recursion down to an unblocked masked
-    loop — each step is a rank-1 update, kept tiny (ib columns)."""
+    ``fori_loop`` of rank-1 updates.  The base is one traced loop body
+    regardless of width, so ``ib`` trades trace size (2·n/ib recursion
+    nodes) against how much of the update runs as VPU rank-1s instead
+    of MXU matmuls; 128 keeps compile time flat and the VPU share of a
+    512-wide panel under 2·m·128² flops per base."""
 
     m, n = a.shape
     if n <= ib:
@@ -250,30 +254,32 @@ def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
     return _wrap_like(a, lu), perm
 
 
-def getrf_nopiv_rec(a, nb: int):
+def getrf_nopiv_rec(a, nb: int, ib: int = 128):
     m, n = a.shape
     if m < n:
-        f_l = getrf_nopiv_rec(a[:, :m], nb)
+        f_l = getrf_nopiv_rec(a[:, :m], nb, ib)
         u_r = lax.linalg.triangular_solve(
             f_l, a[:, m:], left_side=True, lower=True, unit_diagonal=True)
         return jnp.concatenate([f_l, u_r], axis=1)
     if n <= nb:
-        return _panel_lu_nopiv(a)
+        return _panel_lu_nopiv(a, ib)
     n1 = blocks._split(n, nb)
-    f1 = getrf_nopiv_rec(a[:, :n1], nb)
+    f1 = getrf_nopiv_rec(a[:, :n1], nb, ib)
     u12 = lax.linalg.triangular_solve(
         f1[:n1], a[:n1, n1:], left_side=True, lower=True, unit_diagonal=True)
     a22 = a[n1:, n1:] - matmul(f1[n1:], u12)
-    f2 = getrf_nopiv_rec(a22, nb)
+    f2 = getrf_nopiv_rec(a22, nb, ib)
     top = jnp.concatenate([f1[:n1], u12], axis=1)
     bot = jnp.concatenate([f1[n1:], f2], axis=1)
     return jnp.concatenate([top, bot], axis=0)
 
 
 def getrf_nopiv(a, opts: Optional[Options] = None):
-    """Reference ``slate::getrf_nopiv`` (``src/getrf_nopiv.cc``)."""
+    """Reference ``slate::getrf_nopiv`` (``src/getrf_nopiv.cc``).
+    ``Option.InnerBlocking`` tunes the unblocked panel base width."""
     av = as_array(a)
-    return _wrap_like(a, getrf_nopiv_rec(av, _nb(a, opts)))
+    ib = int(get_option(opts, "inner_blocking", 128))
+    return _wrap_like(a, getrf_nopiv_rec(av, _nb(a, opts), ib))
 
 
 def getrf_tntpiv(a, opts: Optional[Options] = None):

@@ -20,7 +20,9 @@ _UNSET = object()
 
 _DEFAULTS = {
     Option.Lookahead: 1,
-    Option.InnerBlocking: 16,
+    # reference default is 16 (types.hh); 128 keeps the unblocked panel
+    # base a single traced fori_loop of MXU-adjacent width on TPU
+    Option.InnerBlocking: 128,
     Option.MaxPanelThreads: 1,
     Option.Tolerance: None,
     Option.Target: Target.Devices,
