@@ -149,21 +149,8 @@ def unmtr_he2hb(side: Side, op: Op, factors: He2hbFactors, c,
         flip = Op.NoTrans if op is not Op.NoTrans else Op.ConjTrans
         return _ct(unmtr_he2hb(Side.Left, flip, factors, _ct(cv), opts))
     vts = tuple((v, t) for _, v, t in factors.panels)
-    return _unmtr_he2hb_impl(vts, cv, op is Op.NoTrans)
-
-
-@partial(jax.jit, static_argnums=2)
-def _unmtr_he2hb_impl(vts, cv, forward: bool):
-    """Reflector chain under one jit (one dispatch, see _he2hb_impl)."""
-    n = cv.shape[0]
-    seq = vts[::-1] if forward else vts
-    for v, t in seq:
-        r0 = n - v.shape[0]
-        tt = t if forward else _ct(t)
-        tail = cv[r0:]
-        tail = tail - matmul(v, matmul(tt, matmul(_ct(v), tail)))
-        cv = jnp.concatenate([cv[:r0], tail], axis=0)
-    return cv
+    from .qr import apply_reflector_chain
+    return apply_reflector_chain(vts, cv, op is Op.NoTrans)
 
 
 # ---------------------------------------------------------------------------

@@ -242,7 +242,7 @@ def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
     from ..method import select_lu
     method = select_lu(method)
     if method is MethodLU.NoPiv:
-        lu = getrf_nopiv_rec(av, nb)
+        lu = getrf_nopiv_rec(av, nb, int(get_option(opts, "inner_blocking")))
         perm = jnp.arange(av.shape[0])
     elif method is MethodLU.CALU:
         lu, perm = getrf_rec(av, nb, panel=lambda p: _panel_lu_tntpiv(p, nb))
@@ -278,7 +278,7 @@ def getrf_nopiv(a, opts: Optional[Options] = None):
     """Reference ``slate::getrf_nopiv`` (``src/getrf_nopiv.cc``).
     ``Option.InnerBlocking`` tunes the unblocked panel base width."""
     av = as_array(a)
-    ib = int(get_option(opts, "inner_blocking", 128))
+    ib = int(get_option(opts, "inner_blocking"))  # table default
     return _wrap_like(a, getrf_nopiv_rec(av, _nb(a, opts), ib))
 
 

@@ -33,6 +33,7 @@ Design stance (TPU-first):
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -102,6 +103,23 @@ def _apply_block_reflector(v, t, c, *, forward: bool):
 
     tt = t if forward else _ct(t)
     return c - matmul(v, matmul(tt, matmul(_ct(v), c)))
+
+
+@partial(jax.jit, static_argnums=2)
+def apply_reflector_chain(vts, cv, forward: bool):
+    """Apply a chain of tail-aligned block reflectors under one jit (one
+    device dispatch for the whole chain): each (V, T) panel spans the
+    last ``V.shape[0]`` rows of C.  ``forward`` applies Q (panels
+    last-to-first), else Qᴴ.  Shared by ``unmqr``-style back-transforms
+    in the two-stage eig (``unmtr_he2hb``) and SVD (``unmbr_ge2tb``)."""
+
+    n = cv.shape[0]
+    seq = vts[::-1] if forward else vts
+    for v, t in seq:
+        r0 = n - v.shape[0]
+        tail = _apply_block_reflector(v, t, cv[r0:], forward=forward)
+        cv = jnp.concatenate([cv[:r0], tail], axis=0)
+    return cv
 
 
 # ---------------------------------------------------------------------------

@@ -14,8 +14,10 @@ LAPACK (the reference calls ``lapack::bdsqr`` on rank 0,
 
 from __future__ import annotations
 
+from functools import partial
 from typing import List, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -58,8 +60,23 @@ def ge2tb(a, opts: Optional[Options] = None) -> Ge2tbFactors:
     if m < n:
         raise SlateError("ge2tb requires m >= n (drivers transpose)")
     nb = _nb(a, opts)
-    qpanels: List[Tuple[int, jnp.ndarray, jnp.ndarray]] = []
-    ppanels: List[Tuple[int, jnp.ndarray, jnp.ndarray]] = []
+    band, qvts, pvts = _ge2tb_impl(av, nb)
+    # offsets derive from V row counts (single source of truth; the jit
+    # boundary carries only arrays)
+    qpanels = tuple((m - v.shape[0], v, t) for v, t in qvts)
+    ppanels = tuple((n - v.shape[0], v, t) for v, t in pvts)
+    return Ge2tbFactors(band=band, kd=nb, qpanels=qpanels, ppanels=ppanels)
+
+
+@partial(jax.jit, static_argnums=1)
+def _ge2tb_impl(av, nb: int):
+    """The whole two-sided panel chain under one jit — one device
+    dispatch per call instead of dozens per panel (see
+    ``eig._he2hb_impl``)."""
+
+    m, n = av.shape
+    qpanels = []
+    ppanels = []
     for j0 in range(0, n, nb):
         w = min(nb, n - j0)
         # QR panel on rows j0.. of block column j0:j0+w
@@ -77,7 +94,7 @@ def ge2tb(a, opts: Optional[Options] = None) -> Ge2tbFactors:
                 c = av[j0:, j0 + w:]
                 c = c - matmul(v, matmul(_ct(t), matmul(_ct(v), c)))
                 av = av.at[j0:, j0 + w:].set(c)
-            qpanels.append((j0, v, t))
+            qpanels.append((v, t))
         # LQ panel on the block row, columns right of the band
         c0 = j0 + nb
         if c0 < n and n - c0 > 1:
@@ -97,13 +114,12 @@ def ge2tb(a, opts: Optional[Options] = None) -> Ge2tbFactors:
                 c = av[j0 + wr:, c0:]
                 c = c - matmul(matmul(matmul(c, v), t), _ct(v))
                 av = av.at[j0 + wr:, c0:].set(c)
-            ppanels.append((c0, v, t))
+            ppanels.append((v, t))
     # clamp to the upper band
     i = jnp.arange(m)[:, None]
     j = jnp.arange(n)[None, :]
     band = jnp.where((j - i >= 0) & (j - i <= nb), av, 0)
-    return Ge2tbFactors(band=band, kd=nb, qpanels=tuple(qpanels),
-                        ppanels=tuple(ppanels))
+    return band, tuple(qpanels), tuple(ppanels)
 
 
 def unmbr_ge2tb(side: Side, op: Op, factors: Ge2tbFactors, c):
@@ -117,13 +133,9 @@ def unmbr_ge2tb(side: Side, op: Op, factors: Ge2tbFactors, c):
 
     cv = as_array(c)
     panels = factors.qpanels if side is Side.Left else factors.ppanels
-    seq = panels if op is not Op.NoTrans else panels[::-1]
-    for off, v, t in seq:
-        tt = _ct(t) if op is not Op.NoTrans else t
-        tail = cv[off:]
-        tail = tail - matmul(v, matmul(tt, matmul(_ct(v), tail)))
-        cv = jnp.concatenate([cv[:off], tail], axis=0)
-    return cv
+    vts = tuple((v, t) for _, v, t in panels)
+    from .qr import apply_reflector_chain
+    return apply_reflector_chain(vts, cv, op is Op.NoTrans)
 
 
 # ---------------------------------------------------------------------------
