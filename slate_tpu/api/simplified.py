@@ -16,11 +16,13 @@ from .. import linalg as L
 __all__ = [
     "multiply", "triangular_multiply", "triangular_solve",
     "rank_k_update", "rank_2k_update", "band_multiply",
-    "lu_factor", "lu_solve", "lu_solve_using_factor",
-    "lu_inverse_using_factor",
+    "lu_factor", "lu_factor_nopiv", "lu_solve", "lu_solve_nopiv",
+    "lu_solve_using_factor", "lu_solve_using_factor_nopiv",
+    "lu_inverse_using_factor", "lu_inverse_using_factor_out_of_place",
     "chol_factor", "chol_solve", "chol_solve_using_factor",
     "chol_inverse_using_factor",
     "indefinite_factor", "indefinite_solve",
+    "indefinite_solve_using_factor",
     "least_squares_solve", "qr_factor", "lq_factor",
     "qr_multiply_by_q", "lq_multiply_by_q",
     "eig", "eig_vals", "svd", "svd_vals", "norm",
@@ -82,6 +84,28 @@ def lu_inverse_using_factor(lu, pivots, opts: Optional[Options] = None):
     return L.getri(lu, pivots, opts)
 
 
+def lu_inverse_using_factor_out_of_place(lu, pivots,
+                                         opts: Optional[Options] = None):
+    """``simplified_api.hh`` lu_inverse_using_factor_out_of_place →
+    getriOOP; functional style is always out-of-place here, so this is
+    the same computation returning a fresh array."""
+    return L.getri(lu, pivots, opts)
+
+
+def lu_factor_nopiv(a, opts: Optional[Options] = None):
+    """``simplified_api.hh`` lu_factor_nopiv → getrf_nopiv."""
+    return L.getrf_nopiv(a, opts)
+
+
+def lu_solve_nopiv(a, b, opts: Optional[Options] = None):
+    """Solve A·X = B without pivoting — → gesv_nopiv; returns X."""
+    return L.gesv_nopiv(a, b, opts)[1]
+
+
+def lu_solve_using_factor_nopiv(lu, b, opts: Optional[Options] = None):
+    return L.getrs_nopiv(lu, b, opts=opts)
+
+
 # -- Cholesky --------------------------------------------------------------
 
 def chol_factor(a, opts: Optional[Options] = None):
@@ -110,6 +134,10 @@ def indefinite_factor(a, opts: Optional[Options] = None):
 def indefinite_solve(a, b, opts: Optional[Options] = None):
     """Solve Hermitian-indefinite A·X = B — → hesv; returns X."""
     return L.hesv(a, b, opts)[1]
+
+
+def indefinite_solve_using_factor(factors, b, opts: Optional[Options] = None):
+    return L.hetrs(factors, b, opts)
 
 
 # -- Least squares / QR / LQ ----------------------------------------------

@@ -107,3 +107,30 @@ class TestScalapackApi:
         sym = (a + a.T) / 2
         w, z_lg = sc.pheev("V", "L", sc.to_local(sym, grid, desc), desc, grid)
         assert np.abs(np.sort(w) - np.linalg.eigvalsh(sym)).max() < 1e-9
+
+
+def test_simplified_nopiv_and_indefinite_factor_verbs():
+    """The remaining simplified_api.hh verbs (lu_*_nopiv,
+    indefinite_solve_using_factor, lu_inverse_using_factor_out_of_place)."""
+    import jax.numpy as jnp
+
+    import slate_tpu as st
+    from slate_tpu.api import simplified as sapi
+    rng = np.random.default_rng(61)
+    n = 48
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    lu = sapi.lu_factor_nopiv(jnp.asarray(a), {"nb": 16})
+    x = sapi.lu_solve_using_factor_nopiv(lu, jnp.asarray(b), {"nb": 16})
+    np.testing.assert_allclose(a @ np.asarray(x), b, atol=1e-8)
+    x2 = sapi.lu_solve_nopiv(jnp.asarray(a), jnp.asarray(b), {"nb": 16})
+    np.testing.assert_allclose(a @ np.asarray(x2), b, atol=1e-8)
+    lu2, piv = sapi.lu_factor(jnp.asarray(a), {"nb": 16})
+    inv = sapi.lu_inverse_using_factor_out_of_place(lu2, piv, {"nb": 16})
+    np.testing.assert_allclose(np.asarray(inv) @ a, np.eye(n), atol=1e-8)
+    h = rng.standard_normal((n, n))
+    h = (h + h.T) / 2 + n * np.eye(n)
+    fac = sapi.indefinite_factor(
+        st.HermitianMatrix(jnp.asarray(h), uplo=st.Uplo.Lower, mb=16, nb=16))
+    xh = sapi.indefinite_solve_using_factor(fac, jnp.asarray(b))
+    np.testing.assert_allclose(h @ np.asarray(xh), b, atol=1e-7)
