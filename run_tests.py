@@ -25,13 +25,14 @@ SMALL = "256"
 MEDIUM = "512,1024"
 
 SINGLE = ["gemm", "symm", "hemm", "syrk", "herk", "syr2k", "her2k", "trmm",
-          "trsm", "norm", "potrf", "potrs", "posv", "getrf", "gesv",
-          "gesv_mixed", "getri", "geqrf", "cholqr", "gels", "hesv", "gbsv",
-          "heev", "svd"]
+          "trsm", "norm", "potrf", "potrs", "posv", "posv_mixed", "potri",
+          "trtri", "getrf", "gesv", "gesv_mixed", "getri", "geqrf", "gelqf",
+          "cholqr", "gels", "hesv", "gbsv", "heev", "svd"]
 DIST = ["ppotrf", "pgesv", "pgeqrf"]
 # the dense two-stage eig/SVD and inverse testers are O(n^3) with big
 # constants at small nb — keep their dims small in every class
-SLOW = {"heev", "svd", "getri", "gesv_mixed", "hesv"}
+SLOW = {"heev", "svd", "getri", "gesv_mixed", "hesv", "trtri",
+        "potri", "posv_mixed"}
 
 
 def main(argv=None):

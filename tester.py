@@ -70,6 +70,10 @@ FLOPS = {
     "potrs": lambda p: 2.0 * p["n"] ** 2 * p["nrhs"],
     "posv": lambda p: p["n"] ** 3 / 3.0 + 2.0 * p["n"] ** 2 * p["nrhs"],
     "getrf": lambda p: 2.0 * p["n"] ** 3 / 3.0,
+    "trtri": lambda p: p["n"] ** 3 / 3.0,
+    "potri": lambda p: 2.0 * p["n"] ** 3 / 3.0,
+    "posv_mixed": lambda p: p["n"] ** 3 / 3.0,
+    "gelqf": lambda p: 2.0 * p["n"] * p["m"] ** 2 - 2.0 * p["m"] ** 3 / 3.0,
     "gesv": lambda p: 2.0 * p["n"] ** 3 / 3.0 + 2.0 * p["n"] ** 2 * p["nrhs"],
     "gesv_mixed": lambda p: 2.0 * p["n"] ** 3 / 3.0,
     "getri": lambda p: 2.0 * p["n"] ** 3,
@@ -194,6 +198,53 @@ def make_tester(routine, p, jnp, st):
                     np.linalg.norm(arr(a), np.inf), np.linalg.norm(arr(a))]
             return max(abs(g - r) / (r + 1e-300) for g, r in
                        zip((mx, one, inf, fro), refs)) / eps
+        return run, check, None
+
+    if routine == "trtri":
+        a = jnp.tril(randn((n, n))) + 2 * n * jnp.eye(n, dtype=dt)
+        A = st.TriangularMatrix(a, uplo=st.Uplo.Lower, diag=st.Diag.NonUnit,
+                                mb=nb, nb=nb)
+        run = lambda: st.trtri(A, opts)
+        def check(out):
+            inv = arr(getattr(out, "array", out))
+            r = np.linalg.norm(np.tril(inv) @ arr(a) - np.eye(n))
+            return r / (eps * n * np.linalg.cond(arr(a), 1))
+        return run, check, None
+
+    if routine == "potri":
+        a = herm(n)
+        A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=nb, nb=nb)
+        fac = st.potrf(A, opts)
+        run = lambda: st.potri(fac, opts)
+        def check(out):
+            inv = arr(out.array)
+            inv = np.tril(inv) + np.conj(np.tril(inv, -1)).T
+            r = np.linalg.norm(inv @ arr(a) - np.eye(n))
+            return r / (eps * n * np.linalg.cond(arr(a), 1))
+        return run, check, None
+
+    if routine == "posv_mixed":
+        a = herm(n)
+        b = randn((n, nrhs))
+        A = st.HermitianMatrix(a, uplo=st.Uplo.Lower, mb=nb, nb=nb)
+        run = lambda: st.posv_mixed(A, b, opts)
+        def check(out):
+            x = arr(out[0])
+            r = np.linalg.norm(arr(a) @ x - arr(b))
+            return r / (np.linalg.norm(arr(a)) * np.linalg.norm(x) * eps * n)
+        return run, check, None
+
+    if routine == "gelqf":
+        a = randn((m, n))
+        run = lambda: st.gelqf(a, opts)
+        def check(out):
+            packed, taus = out
+            pv = arr(getattr(packed, "array", packed))
+            k = min(m, n)
+            lfac = np.tril(pv)[:k, :k]
+            lref = np.linalg.qr(np.conj(arr(a).T))[1]
+            return (np.abs(np.abs(lfac) - np.abs(np.conj(lref.T))[:k, :k]).max()
+                    / (np.linalg.norm(arr(a)) * eps * max(n, 1)))
         return run, check, None
 
     if routine in ("potrf", "posv", "potrs"):
