@@ -26,36 +26,50 @@ import numpy as np
 from ..ops.blocks import matmul
 
 
+def ir_refine_core(b, solve_lo, solve_full, residual, *, anorm, thresh,
+                   itermax, use_fallback,
+                   add=lambda x, d: x + d,
+                   absmax=lambda v: float(jnp.max(jnp.abs(v)))):
+    """Classic iterative refinement over opaque solution objects (dense
+    arrays here, :class:`~slate_tpu.parallel.DistMatrix` on the mesh via
+    the ``add``/``absmax`` hooks).  Returns ``(x, iters)``; negative
+    ``iters`` flags the full-precision fallback (reference convention)."""
+
+    x = solve_lo(b)
+    iters = 0
+    converged = False
+    for it in range(itermax):
+        r = residual(x)
+        rnorm = absmax(r)
+        xnorm = absmax(x)
+        if rnorm <= xnorm * float(anorm) * thresh:
+            converged = True
+            iters = it
+            break
+        x = add(x, solve_lo(r))
+        iters = it + 1
+    if not converged:
+        rnorm = absmax(residual(x))
+        xnorm = absmax(x)
+        converged = rnorm <= xnorm * float(anorm) * thresh
+    if not converged and use_fallback:
+        x = solve_full(b)
+        iters = -(iters + 1)
+    return x, iters
+
+
 def ir_refine(av, bv, solve_lo, solve_full, *, anorm, thresh, itermax,
               use_fallback):
-    """Classic iterative refinement.  Returns ``(x, iters)``; negative
-    ``iters`` flags the full-precision fallback (reference convention)."""
+    """Dense-array front end of :func:`ir_refine_core` (handles 1-D
+    right-hand sides and supplies the matmul residual)."""
 
     squeeze = bv.ndim == 1
     if squeeze:
         bv = bv[:, None]
     residual = jax.jit(lambda x: bv - matmul(av, x))
-    x = solve_lo(bv)
-    iters = 0
-    converged = False
-    for it in range(itermax):
-        r = residual(x)
-        rnorm = float(jnp.max(jnp.abs(r)))
-        xnorm = float(jnp.max(jnp.abs(x)))
-        if rnorm <= xnorm * float(anorm) * thresh:
-            converged = True
-            iters = it
-            break
-        x = x + solve_lo(r)
-        iters = it + 1
-    if not converged:
-        r = residual(x)
-        rnorm = float(jnp.max(jnp.abs(r)))
-        xnorm = float(jnp.max(jnp.abs(x)))
-        converged = rnorm <= xnorm * float(anorm) * thresh
-    if not converged and use_fallback:
-        x = solve_full(bv)
-        iters = -(iters + 1)
+    x, iters = ir_refine_core(bv, solve_lo, solve_full, residual,
+                              anorm=anorm, thresh=thresh, itermax=itermax,
+                              use_fallback=use_fallback)
     if squeeze:
         x = x[:, 0]
     return x, iters

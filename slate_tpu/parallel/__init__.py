@@ -16,7 +16,7 @@ from .mesh import default_mesh, make_grid_mesh, mesh_grid_shape  # noqa: F401
 from .dist import DistMatrix, distribute, undistribute  # noqa: F401
 from .dist_blas3 import pgemm  # noqa: F401
 from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401
-from .dist_lu import pgetrf, pgetrs, pgesv  # noqa: F401
+from .dist_lu import pgesv, pgesv_mixed, pgetrf, pgetrs  # noqa: F401
 from .dist_qr import pgeqrf, pgels, punmqr_conj  # noqa: F401
 from .dist_aux import (  # noqa: F401
     phemm, pher2k, pherk, pnorm, psymm, psyr2k, psyrk, ptri_mask, ptrmm,
