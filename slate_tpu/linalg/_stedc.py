@@ -253,9 +253,31 @@ def stedc_merge(d1: np.ndarray, q1: np.ndarray, d2: np.ndarray,
         lam, dmat = stedc_secular(dk, zk, rho)
         zhat = _gu_eisenstat_z(dk, dmat, zk)
         # secular eigenvectors: v_i ∝ ẑⱼ/(dⱼ−λᵢ), then normalize; the
-        # difference matrix comes from the shifted frames (stable)
+        # difference matrix comes from the shifted frames (stable).
+        # Clamp |dmat| away from exact zero: a bisection interval that
+        # collapses to zero width (mu underflow next to a pole) would
+        # otherwise turn a column into inf/nan.  Legitimate gaps are
+        # bounded below by the deflation tolerance (~eps·scale), so an
+        # eps-scaled floor cannot perturb undeflated roots; the max-abs
+        # prescale keeps the 2-norm from overflowing for near-pole
+        # columns (the column limits to the pole coordinate axis).
+        tiny = np.finfo(dmat.dtype).tiny ** 0.5 * max(np.abs(dk).max(), 1.0)
+        gap = np.abs(dmat).min(axis=0)
+        pole = np.abs(dmat).argmin(axis=0)
+        dmat = np.where(np.abs(dmat) < tiny,
+                        np.where(dmat < 0, -tiny, tiny), dmat)
         vs = zhat[:, None] / dmat
+        vs /= np.abs(vs).max(axis=0, keepdims=True)
         vs /= np.linalg.norm(vs, axis=0, keepdims=True)
+        # A root whose interval collapsed onto its pole (gap below the
+        # floor) has eigenvector → the pole coordinate axis; the clamped
+        # quotient cannot represent that (zhat at the pole is 0 too), so
+        # substitute e_pole explicitly.
+        collapsed = gap < tiny
+        if collapsed.any():
+            for i in np.flatnonzero(collapsed):
+                vs[:, i] = 0.0
+                vs[pole[i], i] = 1.0
         w[:k] = lam
         qout[:, :k] = qperm[:, keep] @ vs
 

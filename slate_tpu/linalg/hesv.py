@@ -89,7 +89,7 @@ def hetrf(a, opts: Optional[Options] = None) -> HetrfFactors:
         a = a - lcol[:, None] * pivot_row[None, :]
         a = a - a[:, j + 1][:, None] * jnp.conj(lcol)[None, :]
         l = l.at[:, j + 1].add(lcol)
-        return a, l, ipiv.at[j].set(p)
+        return a, l, ipiv.at[j].set(p.astype(jnp.int32))
 
     l0 = jnp.zeros((n, n), dt)
     ipiv0 = jnp.zeros((n,), jnp.int32)
