@@ -165,6 +165,7 @@ class Hb2stRotations(NamedTuple):
     cs: np.ndarray       # real[N]
     ss: np.ndarray       # scalar[N] (complex for Hermitian input)
     phase: np.ndarray    # complex[n] diagonal making the tridiagonal real
+    kd: int = 0          # chase bandwidth (0 = generic/legacy log)
 
 
 def _givens(f, g):
@@ -181,18 +182,58 @@ def _givens(f, g):
     return c, s
 
 
-def hb2st(band, kd: int) -> Tuple[np.ndarray, np.ndarray, Hb2stRotations]:
+def _phase_tridiag(e_c, n, dt):
+    """Phase-normalize a complex subdiagonal to real (LAPACK hbtrd's
+    final step); shared by the compiled and Python hb2st paths."""
+
+    phase = np.ones((n,), dtype=dt)
+    if np.iscomplexobj(np.zeros((), dtype=dt)):
+        for j in range(n - 1):
+            val = e_c[j] * phase[j]
+            absv = abs(val)
+            phase[j + 1] = val / absv if absv != 0 else 1.0
+            e_c[j] = absv
+    return phase
+
+
+def _hb2st_native(a: np.ndarray, kd: int, want_rots: bool = True):
+    """Compiled stage 2: the same rotation schedule as the Python loop
+    below, run by the native runtime on O(n·kd) band storage
+    (``native/runtime.cc`` ``slate_hb2st_*``)."""
+
+    from .. import native
+
+    n = a.shape[0]
+    dt = np.complex128 if np.iscomplexobj(a) else np.float64
+    kd_eff = min(kd, n - 1)
+    ab = np.zeros((n, kd_eff + 2), dtype=dt, order="C")
+    for dd in range(kd_eff + 1):
+        ab[:n - dd, dd] = np.diagonal(a, -dd)
+    planes, cs, ss = native.hb2st_banded(ab, n, kd_eff, want_rots)
+    d = np.real(ab[:, 0]).copy()
+    e_c = ab[:n - 1, 1].copy()
+    phase = _phase_tridiag(e_c, n, dt)
+    e = np.real(e_c)
+    return d, e, Hb2stRotations(planes=planes, cs=cs, ss=ss, phase=phase,
+                                kd=kd_eff)
+
+
+def hb2st(band, kd: int, want_rots: bool = True
+          ) -> Tuple[np.ndarray, np.ndarray, Hb2stRotations]:
     """Reduce a Hermitian band matrix (lower bandwidth ``kd``) to real
     symmetric tridiagonal — reference ``slate::hb2st``
-    (``src/hb2st.cc:23-90`` bulge-chasing sweeps; sequential schedule of
-    the same rotation set, run on host like the reference's
-    single-node stage 2, ``src/heev.cc:113``).
+    (``src/hb2st.cc:23-90`` bulge-chasing sweeps run on host like the
+    reference's single-node stage 2, ``src/heev.cc:113``; compiled via
+    the native runtime when available, Python schedule as fallback).
 
     Returns ``(d, e, rotations)`` with A_band = Q₂·T·Q₂ᴴ.
     """
 
     a = np.array(band)
     n = a.shape[0]
+    from .. import native
+    if native.available() and n > 2 and kd >= 2:
+        return _hb2st_native(a, kd, want_rots)
     planes: List[int] = []
     cs: List[float] = []
     ss: List[complex] = []
@@ -215,14 +256,7 @@ def hb2st(band, kd: int) -> Tuple[np.ndarray, np.ndarray, Hb2stRotations]:
     # phase-scale the subdiagonal real (LAPACK hbtrd's final step)
     d = np.real(np.diagonal(a)).copy()
     e_c = np.diagonal(a, -1).copy()
-    phase = np.ones((n,), dtype=a.dtype)
-    if np.iscomplexobj(a):
-        for j in range(n - 1):
-            # choose phase[j+1] s.t. conj(phase[j+1])·e_c[j]·phase[j] ≥ 0
-            val = e_c[j] * phase[j]
-            absv = abs(val)
-            phase[j + 1] = val / absv if absv != 0 else 1.0
-            e_c[j] = absv
+    phase = _phase_tridiag(e_c, n, a.dtype)
     e = np.real(e_c)
     rots = Hb2stRotations(
         planes=np.asarray(planes, dtype=np.int32),
@@ -238,6 +272,16 @@ def unmtr_hb2st(rots: Hb2stRotations, z: np.ndarray) -> np.ndarray:
     Z_band = Q₂·Z — reference ``slate::unmtr_hb2st``
     (``src/unmtr_hb2st.cc``, applied to the 1-D-distributed Z)."""
 
+    from .. import native
+    if native.available():
+        cplx = (np.iscomplexobj(rots.phase) or np.iscomplexobj(rots.ss)
+                or np.iscomplexobj(np.asarray(z)))
+        dt = np.complex128 if cplx else np.float64
+        zz = np.asarray(z, dtype=dt) * rots.phase[:, None].astype(dt)
+        if len(rots.planes):
+            zz = native.apply_rot_seq(zz, rots.planes, rots.cs, rots.ss, 0,
+                                      kd=getattr(rots, "kd", 0))
+        return zz
     z = np.asarray(z).astype(rots.phase.dtype if np.iscomplexobj(rots.phase)
                              else z.dtype)
     z = rots.phase[:, None] * z
@@ -321,6 +365,57 @@ _EIG_DRIVERS = {
 _BAND_SOLVER_MIN_N = 512
 
 
+def _band_eig(band_np, kd: int, jobz: bool, method, auto: bool):
+    """Stage 2+3 on the host band matrix, shared by single-chip
+    :func:`heev` and the distributed ``pheev``: band → tridiag → solve →
+    back-transform through the bulge-chase.  Returns ``(w, z_band)``
+    (numpy; ``z_band`` None when not ``jobz``).
+
+    Large-n Auto fast path: one host-LAPACK hbevd call (scipy
+    eig_banded).  The staged hb2st → tridiag → unmtr_hb2st chain stays
+    the explicit-method path; the reference likewise treats stage 2 as a
+    single-node host computation (``src/heev.cc:113``), and its rotation
+    sweeps are C++ where ours are Python — at n ≳ 512 the interpreter
+    cost of O(n²·kd) Givens steps dominates everything.
+    """
+
+    from .. import native
+
+    band_np = np.asarray(band_np)
+    n = band_np.shape[0]
+    # The scipy hbevd bypass survives only where the compiled stage 2 is
+    # unavailable (no toolchain); with the native runtime the staged
+    # chain is both the default and the faster path.
+    if auto and n > _BAND_SOLVER_MIN_N and not native.available():
+        from scipy.linalg import eig_banded, eigvals_banded
+        kd2 = min(kd, n - 1)
+        bands = np.asarray(
+            [np.concatenate([np.diagonal(band_np, -k),
+                             np.zeros(k, band_np.dtype)])
+             for k in range(kd2 + 1)])
+        if not jobz:
+            w = eigvals_banded(bands, lower=True)
+            return np.sort(np.real(w)), None
+        w, z_band = eig_banded(bands, lower=True)
+        return np.real(w), z_band
+    d, e, rots = hb2st(band_np, kd, want_rots=jobz)
+    if not jobz:
+        if method in (MethodEig.QR, MethodEig.Bisection):
+            w = sterf(d, e)
+        elif method is MethodEig.MRRR:
+            w = _tridiag_solve(d, e, False, "stemr")
+        else:
+            w = _tridiag_solve(d, e, False, "stevd")
+        return np.sort(w), None
+    if auto:
+        # Auto = fastest correct: LAPACK D&C (stevd) for the tridiagonal
+        w, z_tri = _tridiag_solve(d, e, True, "stevd")
+    else:
+        w, z_tri = _EIG_DRIVERS[method](d, e)
+    z_band = unmtr_hb2st(rots, z_tri)
+    return np.asarray(w), z_band
+
+
 def heev(a, jobz: bool = True, opts: Optional[Options] = None):
     """Hermitian eigensolver — reference ``slate::heev``
     (``src/heev.cc``; two-stage chain ``:104-176``).
@@ -336,40 +431,9 @@ def heev(a, jobz: bool = True, opts: Optional[Options] = None):
     if auto:
         method = MethodEig.DC
     factors = he2hb(a, opts)
-    band_np = np.asarray(factors.band)
-    # Large-n fast path: solve the band stage with one host-LAPACK hbevd
-    # call (scipy eig_banded).  The staged hb2st → tridiag → unmtr_hb2st
-    # chain stays the explicit-method path; the reference likewise treats
-    # stage 2 as a single-node host computation (src/heev.cc:113), and
-    # its rotation sweeps are C++ where ours are Python — at n ≳ 512 the
-    # interpreter cost of O(n²·kd) Givens steps dominates everything.
-    n = band_np.shape[0]
-    if auto and n > _BAND_SOLVER_MIN_N:
-        from scipy.linalg import eig_banded, eigvals_banded
-        kd = min(factors.kd, n - 1)
-        bands = np.asarray(
-            [np.concatenate([np.diagonal(band_np, -k),
-                             np.zeros(k, band_np.dtype)])
-             for k in range(kd + 1)])
-        if not jobz:
-            w = eigvals_banded(bands, lower=True)
-            return jnp.asarray(np.sort(np.real(w))), None
-        w, z_band = eig_banded(bands, lower=True)
-        dtype = factors.band.dtype
-        z = unmtr_he2hb(Side.Left, Op.NoTrans, factors,
-                        jnp.asarray(z_band, dtype=dtype), opts)
-        return jnp.asarray(np.real(w)), z
-    d, e, rots = hb2st(band_np, factors.kd)
+    w, z_band = _band_eig(factors.band, factors.kd, jobz, method, auto)
     if not jobz:
-        if method in (MethodEig.QR, MethodEig.Bisection):
-            w = sterf(d, e)
-        elif method is MethodEig.MRRR:
-            w = _tridiag_solve(d, e, False, "stemr")
-        else:
-            w = _tridiag_solve(d, e, False, "stevd")
-        return jnp.asarray(np.sort(w)), None
-    w, z_tri = _EIG_DRIVERS[method](d, e)
-    z_band = unmtr_hb2st(rots, z_tri)
+        return jnp.asarray(w), None
     dtype = factors.band.dtype
     z = unmtr_he2hb(Side.Left, Op.NoTrans, factors,
                     jnp.asarray(z_band, dtype=dtype), opts)

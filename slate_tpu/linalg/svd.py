@@ -154,13 +154,61 @@ class Tb2bdRotations(NamedTuple):
     rss: np.ndarray
     uphase: np.ndarray
     vphase: np.ndarray
+    kd: int = 0          # chase bandwidth (0 = generic/legacy log)
 
 
-def tb2bd(band, kd: int) -> Tuple[np.ndarray, np.ndarray, Tb2bdRotations]:
+def _phase_bidiag(d_c, e_c, n, dt):
+    """Phase-normalize a complex bidiagonal to real (LAPACK gebrd's final
+    step); shared by the Python and compiled tb2bd paths."""
+
+    uphase = np.ones((n,), dtype=dt)
+    vphase = np.ones((n,), dtype=dt)
+    if np.iscomplexobj(np.zeros((), dtype=dt)):
+        for j in range(n):
+            val = d_c[j] * vphase[j]
+            absv = abs(val)
+            uphase[j] = val / absv if absv != 0 else 1.0
+            d_c[j] = absv
+            if j < n - 1:
+                val = np.conj(uphase[j]) * e_c[j]
+                absv = abs(val)
+                vphase[j + 1] = np.conj(val) / absv if absv != 0 else 1.0
+                e_c[j] = absv
+    return uphase, vphase
+
+
+def _tb2bd_native(b: np.ndarray, kd: int, want_rots: bool = True):
+    """Compiled stage 2: the same rotation schedule as the Python loop
+    below, run by the native runtime on O(n·kd) band storage
+    (``native/runtime.cc`` ``slate_tb2bd_*``)."""
+
+    from .. import native
+
+    n = b.shape[0]
+    dt = np.complex128 if np.iscomplexobj(b) else np.float64
+    kd_eff = min(kd, n - 1)
+    ab = np.zeros((n, kd_eff + 3), dtype=dt, order="C")
+    for dd in range(kd_eff + 1):
+        ab[dd:, dd + 1] = np.diagonal(b, dd)
+    lrot, rrot = native.tb2bd_banded(ab, n, kd_eff, want_rots)
+    d_c = ab[:, 1].copy()
+    e_c = ab[1:, 2].copy()
+    uphase, vphase = _phase_bidiag(d_c, e_c, n, dt)
+    rots = Tb2bdRotations(
+        lplanes=lrot[0], lcs=lrot[1], lss=lrot[2],
+        rplanes=rrot[0], rcs=rrot[1], rss=rrot[2],
+        uphase=uphase, vphase=vphase, kd=kd_eff)
+    return np.real(d_c), np.real(e_c), rots
+
+
+def tb2bd(band, kd: int, want_rots: bool = True
+          ) -> Tuple[np.ndarray, np.ndarray, Tb2bdRotations]:
     """Reduce an upper-triangular band matrix (superdiagonal width ``kd``)
     to real upper bidiagonal — reference ``slate::tb2bd``
     (``src/tb2bd.cc``; the bulge-chasing sweeps of ``gebr1/2/3``,
-    ``internal_gebr.cc``, run in their sequential schedule on host).
+    ``internal_gebr.cc``, run on host like the reference's single-node
+    stage 2; compiled via the native runtime when available, Python
+    schedule as fallback).
 
     Returns ``(d, e, rotations)`` with B = U₂·bidiag(d, e)·V₂ᴴ.
     """
@@ -168,6 +216,9 @@ def tb2bd(band, kd: int) -> Tuple[np.ndarray, np.ndarray, Tb2bdRotations]:
     b = np.array(band)
     n = b.shape[1]
     b = b[:n, :n].copy()
+    from .. import native
+    if native.available() and n > 2 and kd >= 2:
+        return _tb2bd_native(b, kd, want_rots)
     ll: List[Tuple[int, float, complex]] = []
     rl: List[Tuple[int, float, complex]] = []
     for bw in range(kd, 1, -1):
@@ -196,19 +247,7 @@ def tb2bd(band, kd: int) -> Tuple[np.ndarray, np.ndarray, Tb2bdRotations]:
                 row, p = p, p + bw
     d_c = np.diagonal(b).copy()
     e_c = np.diagonal(b, 1).copy()
-    uphase = np.ones((n,), dtype=b.dtype)
-    vphase = np.ones((n,), dtype=b.dtype)
-    if np.iscomplexobj(b):
-        for j in range(n):
-            val = d_c[j] * vphase[j]
-            absv = abs(val)
-            uphase[j] = val / absv if absv != 0 else 1.0
-            d_c[j] = absv
-            if j < n - 1:
-                val = np.conj(uphase[j]) * e_c[j]
-                absv = abs(val)
-                vphase[j + 1] = np.conj(val) / absv if absv != 0 else 1.0
-                e_c[j] = absv
+    uphase, vphase = _phase_bidiag(d_c, e_c, n, b.dtype)
     d = np.real(d_c)
     e = np.real(e_c)
     rots = Tb2bdRotations(
@@ -233,6 +272,17 @@ def unmbr_tb2bd(side: Side, rots: Tb2bdRotations, z: np.ndarray) -> np.ndarray:
         phase, planes, cs, ss = rots.uphase, rots.lplanes, rots.lcs, rots.lss
     else:
         phase, planes, cs, ss = rots.vphase, rots.rplanes, rots.rcs, rots.rss
+    from .. import native
+    if native.available():
+        cplx = (np.iscomplexobj(phase) or np.iscomplexobj(ss)
+                or np.iscomplexobj(z))
+        dt = np.complex128 if cplx else np.float64
+        zz = np.asarray(z, dtype=dt) * phase[:z.shape[0], None].astype(dt)
+        if len(planes):
+            zz = native.apply_rot_seq(zz, planes, cs, ss,
+                                      0 if side is Side.Left else 1,
+                                      kd=getattr(rots, "kd", 0))
+        return zz
     if np.iscomplexobj(phase):
         z = z.astype(phase.dtype)
     z = phase[:z.shape[0], None] * z
@@ -294,6 +344,51 @@ def bdsqr(d, e, want_uv: bool = False, method: MethodSVD = MethodSVD.Auto):
 _BAND_SOLVER_MIN_N = 512
 
 
+def _band_svd(band_sq, kd: int, want_u: bool, want_vt: bool, method,
+              auto: bool):
+    """Stage 2+3 on the host n×n upper-band middle factor, shared by
+    single-chip :func:`svd` and the distributed ``psvd``: band →
+    bidiagonal → bdsqr → back-transform through the chase.  Returns
+    ``(s, u_b, vh_b)`` (numpy; None where not requested).
+
+    Large-n Auto fast path: one host-LAPACK gesdd call on the n×n band
+    instead of the staged tb2bd chain, whose Python Givens sweeps cost
+    O(n²·kd) interpreter steps; the reference likewise runs stage 2 on a
+    single node (``src/svd.cc:207-372``).
+    """
+
+    from .. import native
+
+    band_sq = np.asarray(band_sq)
+    n = band_sq.shape[0]
+    want_uv = want_u or want_vt
+    # The dense-gesdd bypass survives only where the compiled stage 2 is
+    # unavailable (no toolchain); with the native runtime the staged
+    # chain is both the default and the faster path.
+    if auto and n > _BAND_SOLVER_MIN_N and not native.available():
+        if not want_uv:
+            return np.ascontiguousarray(
+                np.linalg.svd(band_sq, compute_uv=False)), None, None
+        u_b, s, vh_b = np.linalg.svd(band_sq, full_matrices=False)
+        return s, (u_b if want_u else None), (vh_b if want_vt else None)
+    d, e, rots = tb2bd(band_sq, kd, want_rots=want_uv)
+    if not want_uv:
+        return bdsqr(d, e).copy(), None, None
+    if auto and native.available() and n > 1:
+        # compiled D&C bidiagonal core (LAPACK bdsdc; the reference's
+        # rank-0 lapack::bdsqr slot, src/svd.cc:300+)
+        u_bd, s, vh_bd = native.bdsdc(d, e)
+        u_bd = np.ascontiguousarray(u_bd)
+        vh_bd = np.ascontiguousarray(vh_bd)
+    else:
+        u_bd, s, vh_bd = bdsqr(d, e, want_uv=True, method=method)
+    u_b = unmbr_tb2bd(Side.Left, rots, u_bd) if want_u else None
+    vh_b = None
+    if want_vt:
+        vh_b = _ct(unmbr_tb2bd(Side.Right, rots, _ct(vh_bd)))
+    return s, u_b, vh_b
+
+
 def svd_vals(a, opts: Optional[Options] = None):
     """Singular values — reference ``slate::svd_vals`` (``src/svd.cc``)."""
     return svd(a, jobu=False, jobvt=False, opts=opts)[0]
@@ -317,54 +412,26 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
     factors = ge2tb(a, opts)
     band_np = np.asarray(factors.band)
     method = get_option(opts, "method_svd", MethodSVD.Auto)
-    # Large-n fast path (Auto): solve the triangular-band middle factor
-    # with one host-LAPACK gesdd call instead of the staged
-    # tb2bd → bdsqr → unmbr_tb2bd chain, whose Python Givens sweeps cost
-    # O(n²·kd) interpreter steps.  The reference likewise runs stage 2
-    # on a single node (src/svd.cc:207-372); host gesdd is its C-speed
-    # analog.  The staged path remains for explicit methods.
-    if method is MethodSVD.Auto and n > _BAND_SOLVER_MIN_N:
-        # ge2tb leaves the middle factor upper-triangular-banded: only
-        # its top n rows are nonzero, so the host solve is n×n
-        band_sq = band_np[:n]
-        want_uv = jobu or jobvt
-        if not want_uv:
-            s = np.linalg.svd(band_sq, compute_uv=False)
-            return jnp.asarray(np.ascontiguousarray(s)), None, None
-        u_b, s, vh_b = np.linalg.svd(band_sq, full_matrices=False)
-        dtype = factors.band.dtype
-        u = vh = None
-        if jobu:
-            u2 = u_b
-            if m > n:
-                u2 = np.concatenate(
-                    [u2, np.zeros((m - n, u2.shape[1]), dtype=u2.dtype)],
-                    axis=0)
-            u = unmbr_ge2tb(Side.Left, Op.NoTrans, factors,
-                            jnp.asarray(u2, dtype=dtype))
-        if jobvt:
-            v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
-                            jnp.asarray(_ct(vh_b), dtype=dtype))
-            vh = _ct(v)
-        return jnp.asarray(s), u, vh
-    d, e, rots = tb2bd(band_np, factors.kd)
-    want_uv = jobu or jobvt
-    if not want_uv:
-        return jnp.asarray(bdsqr(d, e).copy()), None, None
-    u_b, s, vh_b = bdsqr(d, e, want_uv=True, method=method)
+    auto = method is MethodSVD.Auto
+    # ge2tb leaves the middle factor upper-triangular-banded: only its
+    # top n rows are nonzero, so stage 2 operates on the n×n head
+    s, u_b, vh_b = _band_svd(band_np[:n], factors.kd, jobu, jobvt,
+                             method, auto)
+    if not (jobu or jobvt):
+        return jnp.asarray(s), None, None
     dtype = factors.band.dtype
     u = vh = None
     if jobu:
-        u2 = unmbr_tb2bd(Side.Left, rots, u_b)
+        u2 = np.asarray(u_b)
         if m > n:
             u2 = np.concatenate(
-                [u2, np.zeros((m - n, n), dtype=u2.dtype)], axis=0)
+                [u2, np.zeros((m - n, u2.shape[1]), dtype=u2.dtype)],
+                axis=0)
         u = unmbr_ge2tb(Side.Left, Op.NoTrans, factors,
                         jnp.asarray(u2, dtype=dtype))
     if jobvt:
-        v2 = unmbr_tb2bd(Side.Right, rots, _ct(vh_b))
         v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
-                        jnp.asarray(v2, dtype=dtype))
+                        jnp.asarray(_ct(vh_b), dtype=dtype))
         vh = _ct(v)
     return jnp.asarray(s), u, vh
 

@@ -37,13 +37,33 @@ def _find_lib(stem: str) -> str | None:
     return None
 
 
+def _find_scipy_openblas() -> str | None:
+    """scipy's vendored OpenBLAS (scipy_-prefixed symbols) — much faster
+    than the system netlib reference libraries when present."""
+    try:
+        import glob
+        import scipy
+        root = os.path.join(os.path.dirname(os.path.dirname(scipy.__file__)),
+                            "scipy.libs")
+        hits = sorted(glob.glob(os.path.join(root, "libscipy_openblas*.so")))
+        return hits[0] if hits else None
+    except Exception:
+        return None
+
+
 def _build() -> str | None:
-    blas = _find_lib("blas")
-    lapack = _find_lib("lapack")
-    if blas is None or lapack is None:
-        return "no system BLAS/LAPACK found"
+    openblas = _find_scipy_openblas()
+    if openblas is not None:
+        libs = ["-DSLATE_BLAS_PREFIX_SCIPY", openblas,
+                f"-Wl,-rpath,{os.path.dirname(openblas)}"]
+    else:
+        blas = _find_lib("blas")
+        lapack = _find_lib("lapack")
+        if blas is None or lapack is None:
+            return "no system BLAS/LAPACK found"
+        libs = [lapack, blas]
     cmd = ["g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-           _SRC, "-o", _SO, lapack, blas]
+           _SRC, "-o", _SO] + libs
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
     except (OSError, subprocess.TimeoutExpired) as ex:  # no toolchain
@@ -102,6 +122,21 @@ def _load():
         lib.slate_host_gesv_f64.restype = c.c_int
         lib.slate_host_gesv_f64.argtypes = [p, i64, p, i64, p]
         lib.slate_host_num_threads.restype = c.c_int
+        for name in ("slate_hb2st_f64", "slate_hb2st_c128"):
+            fn = getattr(lib, name)
+            fn.restype = i64
+            fn.argtypes = [p, i64, i64, i64, p, p, p]
+        for name in ("slate_tb2bd_f64", "slate_tb2bd_c128"):
+            fn = getattr(lib, name)
+            fn.restype = i64
+            fn.argtypes = [p, i64, i64, i64] + [p] * 6
+        for name in ("slate_apply_rot_seq_f64", "slate_apply_rot_seq_c128",
+                     "slate_apply_rot_skewed_f64",
+                     "slate_apply_rot_skewed_c128"):
+            fn = getattr(lib, name)
+            fn.argtypes = [i64, i64, p, p, p, p, i64, c.c_int]
+        lib.slate_bdsdc_f64.restype = c.c_int
+        lib.slate_bdsdc_f64.argtypes = [i64, p, p, p, p]
         _lib = lib
         return _lib
 
@@ -294,3 +329,142 @@ def host_gesv(a: np.ndarray, b: np.ndarray):
 def num_threads() -> int:
     lib = _load()
     return lib.slate_host_num_threads() if lib else 1
+
+
+# ---------------------------------------------------------------------------
+# Stage 2 of the two-stage eig/SVD (compiled bulge chasing)
+# ---------------------------------------------------------------------------
+
+def rot_count(n: int, kd: int) -> int:
+    """Rotation count of the direct-to-tri/bidiagonal chase schedule
+    (per kind): per column j, entries at distance d = 2..min(kd, n-1-j)
+    each start a chase of 1 + ⌊(n−1−j−d)/kd⌋ rotations."""
+    total = 0
+    for j in range(max(n - 2, 0)):
+        dmax = min(kd, n - 1 - j)
+        if dmax >= 2:
+            d = np.arange(2, dmax + 1)
+            total += int(np.sum(1 + (n - 1 - j - d) // kd))
+    return total
+
+
+def _stage2_dtype(dtype):
+    return (np.complex128 if np.issubdtype(np.dtype(dtype),
+                                           np.complexfloating)
+            else np.float64)
+
+
+def hb2st_banded(ab: np.ndarray, n: int, kd: int, want_rots: bool = True):
+    """Compiled band→tridiagonal bulge chase on lower-band storage
+    ``ab[(n, kd+2)]`` (row j holds column j of the band: ``ab[j, d]`` =
+    A[j+d, j]).  ``ab`` is modified in place.  Returns
+    ``(planes, cs, ss)`` — the rotation log (reference
+    ``src/hb2st.cc:23-90`` schedule, compiled); empty arrays when
+    ``want_rots`` is False (values-only callers skip the O(n²) log)."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    assert ab.shape == (n, kd + 2) and ab.flags.c_contiguous
+    fn = (lib.slate_hb2st_c128 if ab.dtype == np.complex128
+          else lib.slate_hb2st_f64)
+    if not want_rots:
+        fn(_c_ptr(ab), n, kd, kd + 2, None, None, None)
+        return (np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float64),
+                np.empty(0, dtype=ab.dtype))
+    cap = rot_count(n, kd)
+    planes = np.empty(cap, dtype=np.int32)
+    cs = np.empty(cap, dtype=np.float64)
+    ss = np.empty(cap, dtype=ab.dtype)
+    nrot = fn(_c_ptr(ab), n, kd, kd + 2, _c_ptr(planes), _c_ptr(cs),
+              _c_ptr(ss))
+    assert nrot == cap, (nrot, cap)
+    return planes, cs, ss
+
+
+def tb2bd_banded(ab: np.ndarray, n: int, kd: int, want_rots: bool = True):
+    """Compiled upper-band→bidiagonal chase on storage ``ab[(n, kd+3)]``
+    (``ab[c, (c-r)+1]`` = A[r, c]; row 0 = subdiagonal bulge).  Modified
+    in place; returns the left/right rotation logs (reference
+    ``src/tb2bd.cc`` schedule, compiled); empty logs when ``want_rots``
+    is False."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    assert ab.shape == (n, kd + 3) and ab.flags.c_contiguous
+    fn = (lib.slate_tb2bd_c128 if ab.dtype == np.complex128
+          else lib.slate_tb2bd_f64)
+    if not want_rots:
+        fn(_c_ptr(ab), n, kd, kd + 3, None, None, None, None, None, None)
+        empty = (np.empty(0, dtype=np.int32), np.empty(0, dtype=np.float64),
+                 np.empty(0, dtype=ab.dtype))
+        return empty, empty
+    cap = rot_count(n, kd)
+    lplanes = np.empty(cap, dtype=np.int32)
+    lcs = np.empty(cap, dtype=np.float64)
+    lss = np.empty(cap, dtype=ab.dtype)
+    rplanes = np.empty(cap, dtype=np.int32)
+    rcs = np.empty(cap, dtype=np.float64)
+    rss = np.empty(cap, dtype=ab.dtype)
+    nrot = fn(_c_ptr(ab), n, kd, kd + 3, _c_ptr(lplanes), _c_ptr(lcs),
+              _c_ptr(lss), _c_ptr(rplanes), _c_ptr(rcs), _c_ptr(rss))
+    assert nrot == cap, (nrot, cap)
+    return (lplanes, lcs, lss), (rplanes, rcs, rss)
+
+
+def apply_rot_seq(z: np.ndarray, planes, cs, ss, mode: int,
+                  kd: int = 0) -> np.ndarray:
+    """Apply a logged rotation sequence in reverse to ``z`` (n×k):
+    mode 0 = [[c, −s], [s̄, c]] (hb2st / tb2bd-left back-transform),
+    mode 1 = [[c, −s̄], [s, c]] (tb2bd-right).
+
+    When ``kd`` is given and the log matches the direct chase schedule,
+    the skewed-wavefront applier runs (a block of band columns advances
+    bottom-up in lockstep — cache-resident row windows); otherwise the
+    generic flat reverse sweep."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    dt = _stage2_dtype(np.result_type(z.dtype, ss.dtype))
+    z = np.ascontiguousarray(z, dtype=dt)
+    ss = np.ascontiguousarray(ss, dtype=dt)
+    planes = np.ascontiguousarray(planes, dtype=np.int32)
+    cs = np.ascontiguousarray(cs, dtype=np.float64)
+    n = z.shape[0]
+    cplx = dt == np.complex128
+    if kd and kd >= 2 and len(planes) == rot_count(n, kd):
+        fn = (lib.slate_apply_rot_skewed_c128 if cplx
+              else lib.slate_apply_rot_skewed_f64)
+        fn(n, z.shape[1], _c_ptr(z), _c_ptr(planes), _c_ptr(cs),
+           _c_ptr(ss), kd, mode)
+    else:
+        fn = (lib.slate_apply_rot_seq_c128 if cplx
+              else lib.slate_apply_rot_seq_f64)
+        fn(n, z.shape[1], _c_ptr(z), _c_ptr(planes), _c_ptr(cs),
+           _c_ptr(ss), len(planes), mode)
+    return z
+
+
+def bdsdc(d: np.ndarray, e: np.ndarray):
+    """Bidiagonal divide-and-conquer SVD (LAPACK ``bdsdc``) — the
+    compiled stage-3 core (the reference calls ``lapack::bdsqr`` on
+    rank 0, ``src/svd.cc:300+``).  Returns ``(u, s, vt)``, σ descending."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    d = np.ascontiguousarray(d, dtype=np.float64).copy()
+    n = d.shape[0]
+    ework = np.zeros(max(n - 1, 1), dtype=np.float64)
+    if n > 1:
+        ework[:n - 1] = np.asarray(e, dtype=np.float64)[:n - 1]
+    # LAPACK writes U, VT column-major; allocate F-order views
+    u = np.zeros((n, n), dtype=np.float64, order="F")
+    vt = np.zeros((n, n), dtype=np.float64, order="F")
+    info = lib.slate_bdsdc_f64(n, _c_ptr(d), _c_ptr(ework), _c_ptr(u),
+                               _c_ptr(vt))
+    if info != 0:
+        raise np.linalg.LinAlgError(f"bdsdc failed to converge ({info})")
+    return u, d, vt

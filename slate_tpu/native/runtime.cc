@@ -32,8 +32,24 @@
 #include <omp.h>
 
 // ---------------------------------------------------------------------------
-// Fortran BLAS/LAPACK (netlib reference, 32-bit ints)
+// Fortran BLAS/LAPACK (32-bit ints).  When built against scipy's vendored
+// OpenBLAS (fast; symbols carry a scipy_ prefix) the names are remapped
+// here; the fallback is the system netlib libblas/liblapack.
 // ---------------------------------------------------------------------------
+
+#ifdef SLATE_BLAS_PREFIX_SCIPY
+#define dgemm_  scipy_dgemm_
+#define sgemm_  scipy_sgemm_
+#define dtrsm_  scipy_dtrsm_
+#define strsm_  scipy_strsm_
+#define dsyrk_  scipy_dsyrk_
+#define ssyrk_  scipy_ssyrk_
+#define dpotrf_ scipy_dpotrf_
+#define spotrf_ scipy_spotrf_
+#define dgetrf_ scipy_dgetrf_
+#define dgetrs_ scipy_dgetrs_
+#define dbdsdc_ scipy_dbdsdc_
+#endif
 extern "C" {
 void dgemm_(const char*, const char*, const int*, const int*, const int*,
             const double*, const double*, const int*, const double*,
@@ -54,6 +70,9 @@ void ssyrk_(const char*, const char*, const int*, const int*, const float*,
 void dpotrf_(const char*, const int*, double*, const int*, int*);
 void spotrf_(const char*, const int*, float*, const int*, int*);
 void dgetrf_(const int*, const int*, double*, const int*, int*, int*);
+void dbdsdc_(const char*, const char*, const int*, double*, double*,
+             double*, const int*, double*, const int*, double*, int*,
+             double*, int*, int*);
 void dgetrs_(const char*, const int*, const int*, const double*, const int*,
              const int*, double*, const int*, int*);
 }
@@ -414,5 +433,436 @@ void slate_host_gemm_f32(int64_t m, int64_t n, int64_t k, float alpha,
 }
 
 int slate_host_num_threads() { return omp_get_max_threads(); }
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Stage 2 of the two-stage eig/SVD: band -> tridiagonal / bidiagonal by
+// Givens bulge chasing, with rotation logs for the back-transform.
+//
+// The reference runs this stage as native host code after gathering the
+// band to one node (src/hb2st.cc:23-90, src/tb2bd.cc, src/heev.cc:111-113);
+// these kernels are the compiled equivalents of the rotation schedules in
+// slate_tpu/linalg/eig.py (hb2st) and svd.py (tb2bd), operating on LAPACK
+// band storage so the working set is O(n*kd), not O(n^2).
+//
+// Layouts (column index j fastest over rows of the band array):
+//   hb2st:  lower Hermitian band, ab[d + j*ldab] = A[j+d, j], d in [0, kd+1]
+//           (one extra diagonal holds the chase bulge); ldab >= kd+2.
+//   tb2bd:  upper triangular band, ab[(j-i)+1 + j*ldab] = A[i, j],
+//           j-i in [-1, kd+1] (row 0 = subdiagonal bulge); ldab >= kd+3.
+// ---------------------------------------------------------------------------
+
+#include <complex>
+#include <cmath>
+
+namespace {
+
+using cplx = std::complex<double>;
+
+inline double conj_s(double x) { return x; }
+inline cplx conj_s(const cplx& x) { return std::conj(x); }
+inline double abs_s(double x) { return std::fabs(x); }
+inline double abs_s(const cplx& x) { return std::abs(x); }
+
+// Complex-safe Givens: [[c, s], [-conj(s), c]] . [f, g]^T = [r', 0]
+// (matches slate_tpu.linalg.eig._givens).
+template <typename T>
+inline void givens(const T& f, const T& g, double& c, T& s) {
+    double absf = abs_s(f), absg = abs_s(g);
+    if (absg == 0.0) { c = 1.0; s = T(0); return; }
+    double r = std::hypot(absf, absg);
+    T signf = absf != 0.0 ? f / absf : T(1);
+    c = absf / r;
+    s = signf * conj_s(g) / r;
+}
+
+// Hermitian two-sided plane rotation in plane (i-1, i) on lower band
+// storage, annihilating A[i, i-bw-1] (or the initial A[i, i-bw]).
+template <typename T>
+inline void hb_rotate(T* ab, int64_t ldab, int64_t n, int64_t bw,
+                      int64_t i, double c, const T& s) {
+    const T sc = conj_s(s);
+    // row pairs: columns left of the plane
+    int64_t clo = i - bw - 1; if (clo < 0) clo = 0;
+    for (int64_t col = clo; col <= i - 2; ++col) {
+        T& x = ab[(i - 1 - col) + col * ldab];
+        T& y = ab[(i - col) + col * ldab];
+        T nx = c * x + s * y;
+        T ny = -sc * x + c * y;
+        x = nx; y = ny;
+    }
+    // 2x2 diagonal block: M' = G M G^H with M = [[a, conj(b)], [b, d]]
+    {
+        T& aa = ab[0 + (i - 1) * ldab];
+        T& bb = ab[1 + (i - 1) * ldab];
+        T& dd = ab[0 + i * ldab];
+        T a0 = aa, b0 = bb, d0 = dd;
+        // row-apply G
+        T r00 = c * a0 + s * b0;
+        T r01 = c * conj_s(b0) + s * d0;
+        T r10 = -sc * a0 + c * b0;
+        T r11 = -sc * conj_s(b0) + c * d0;
+        // col-apply G^H: (x, y) -> (c x + conj(s) y, -s x + c y)
+        aa = c * r00 + sc * r01;
+        bb = c * r10 + sc * r11;
+        dd = -s * r10 + c * r11;
+    }
+    // column pairs: rows below the plane
+    int64_t rhi = i + bw; if (rhi > n - 1) rhi = n - 1;
+    for (int64_t row = i + 1; row <= rhi; ++row) {
+        T& x = ab[(row - i + 1) + (i - 1) * ldab];
+        T& y = ab[(row - i) + i * ldab];
+        T nx = c * x + sc * y;
+        T ny = -s * x + c * y;
+        x = nx; y = ny;
+    }
+}
+
+// One full hb2st run; logs (plane, c, s) per rotation when log != null.
+//
+// Direct-to-tridiagonal schedule (LAPACK sbtrd-style): per column j the
+// sub-band entries (j+d, j) are annihilated bottom-up and each bulge is
+// chased at stride kd — O(n^2/2) rotations total, vs the O(n^2·ln kd)
+// of a diagonal-by-diagonal (Rutishauser) sweep; the back-transform
+// cost is proportional to the rotation count, so the schedule choice
+// is what makes eigenvectors affordable.
+// Per-column log reordering: rotations are generated chase-major
+// (d = dmax..2, each chased to the end) but logged chase-DEPTH-major —
+// all depth-t rotations of a column are adjacent in the log, forming a
+// staircase on kd+1 consecutive rows.  Rotations at different depths
+// act on disjoint row pairs (they commute), so the stable reorder keeps
+// the factorization Q₂ = Π G_i^H exact while making the back-transform
+// walk contiguous row blocks (L1-resident chains instead of stride-kd
+// jumps).
+template <typename T>
+struct RotBuf {
+    std::vector<int32_t> plane;
+    std::vector<int32_t> depth;
+    std::vector<double> c;
+    std::vector<T> s;
+    std::vector<int64_t> counts;
+
+    void clear() { plane.clear(); depth.clear(); c.clear(); s.clear(); }
+
+    void push(int64_t i, int64_t t, double cc, const T& sv) {
+        plane.push_back((int32_t)i);
+        depth.push_back((int32_t)t);
+        c.push_back(cc);
+        s.push_back(sv);
+    }
+
+    // stable counting sort by depth into the global log at base
+    void flush(int32_t* planes, double* cs, T* ss, int64_t base) {
+        int32_t tmax = 0;
+        for (int32_t t : depth) tmax = std::max(tmax, t);
+        counts.assign((size_t)tmax + 2, 0);
+        for (int32_t t : depth) ++counts[(size_t)t + 1];
+        for (size_t t = 1; t < counts.size(); ++t) counts[t] += counts[t - 1];
+        for (size_t idx = 0; idx < plane.size(); ++idx) {
+            int64_t pos = base + counts[(size_t)depth[idx]]++;
+            planes[pos] = plane[idx];
+            cs[pos] = c[idx];
+            ss[pos] = s[idx];
+        }
+    }
+};
+
+template <typename T>
+int64_t hb2st_impl(T* ab, int64_t n, int64_t kd, int64_t ldab,
+                   int32_t* planes, double* cs, T* ss) {
+    int64_t nrot = 0;
+    RotBuf<T> buf;
+    for (int64_t j = 0; j <= n - 3; ++j) {
+        const int64_t dmax = std::min(kd, n - 1 - j);
+        if (planes) buf.clear();
+        for (int64_t d = dmax; d >= 2; --d) {
+            int64_t col = j, i = j + d, t = 0;
+            for (;;) {
+                double c; T s;
+                const T f = ab[(i - 1 - col) + col * ldab];
+                const T g = ab[(i - col) + col * ldab];
+                givens(f, g, c, s);
+                hb_rotate(ab, ldab, n, kd, i, c, s);
+                if (planes) buf.push(i, t, c, s);
+                if (i + kd >= n) break;
+                col = i - 1; i += kd; ++t;
+            }
+        }
+        if (planes) {
+            buf.flush(planes, cs, ss, nrot);
+            nrot += (int64_t)buf.plane.size();
+        } else {
+            for (int64_t d = dmax; d >= 2; --d)
+                nrot += 1 + (n - 1 - j - d) / kd;
+        }
+    }
+    return nrot;
+}
+
+// Upper-band two-sided rotations for tb2bd (see layout above).
+template <typename T>
+inline T& ub(T* ab, int64_t ldab, int64_t r, int64_t c) {
+    return ab[(c - r + 1) + c * ldab];
+}
+
+// Direct-to-bidiagonal schedule (see hb2st_impl: per-row elimination
+// with stride-kd chases, O(n^2/2) rotation pairs, depth-major logs).
+template <typename T>
+int64_t tb2bd_impl(T* ab, int64_t n, int64_t kd, int64_t ldab,
+                   int32_t* lplanes, double* lcs, T* lss,
+                   int32_t* rplanes, double* rcs, T* rss) {
+    int64_t nrot = 0;
+    RotBuf<T> lbuf, rbuf;
+    for (int64_t j = 0; j <= n - 3; ++j) {
+        const int64_t dmax = std::min(kd, n - 1 - j);
+        if (lplanes) { lbuf.clear(); rbuf.clear(); }
+        for (int64_t d = dmax; d >= 2; --d) {
+            int64_t row = j, p = j + d - 1, t = 0;
+            for (;;) {
+                // right rotation on columns (p, p+1): kill A[row, p+1]
+                double c; T s;
+                givens(ub(ab, ldab, row, p), ub(ab, ldab, row, p + 1), c, s);
+                {
+                    const T sc = conj_s(s);
+                    int64_t rlo = row; if (rlo < 0) rlo = 0;
+                    int64_t rhi = p + 1; if (rhi > n - 1) rhi = n - 1;
+                    for (int64_t r2 = rlo; r2 <= rhi; ++r2) {
+                        T& x = ub(ab, ldab, r2, p);
+                        T& y = ub(ab, ldab, r2, p + 1);
+                        // col-apply G^T: (x, y) -> (c x + s y, -s̄ x + c y)
+                        // (the right factor is G^T, not G^H — the kill
+                        // identity -s̄f + cg = 0 needs the unconjugated s
+                        // in the first slot)
+                        T nx = c * x + s * y;
+                        T ny = -sc * x + c * y;
+                        x = nx; y = ny;
+                    }
+                }
+                if (rplanes) rbuf.push(p + 1, t, c, s);
+                // left rotation on rows (p, p+1): kill the (p+1, p) bulge
+                givens(ub(ab, ldab, p, p), ub(ab, ldab, p + 1, p), c, s);
+                {
+                    const T sc = conj_s(s);
+                    int64_t chi = p + kd + 1; if (chi > n - 1) chi = n - 1;
+                    for (int64_t c2 = p; c2 <= chi; ++c2) {
+                        T& x = ub(ab, ldab, p, c2);
+                        T& y = ub(ab, ldab, p + 1, c2);
+                        T nx = c * x + s * y;
+                        T ny = -sc * x + c * y;
+                        x = nx; y = ny;
+                    }
+                }
+                if (lplanes) lbuf.push(p + 1, t, c, s);
+                if (p + 1 + kd >= n) break;
+                row = p; p += kd; ++t;
+            }
+        }
+        if (lplanes) {
+            lbuf.flush(lplanes, lcs, lss, nrot);
+            rbuf.flush(rplanes, rcs, rss, nrot);
+            nrot += (int64_t)lbuf.plane.size();
+        } else {
+            for (int64_t d = dmax; d >= 2; --d)
+                nrot += 1 + (n - 1 - j - d) / kd;
+        }
+    }
+    return nrot;
+}
+
+// Apply a logged rotation sequence in reverse to Z (n x k, row-major):
+// mode 0: G^H = [[c, -s], [s̄, c]]   (unmtr_hb2st / unmbr_tb2bd Left)
+// mode 1:       [[c, -s̄], [s, c]]   (unmbr_tb2bd Right)
+// OpenMP-parallel over column blocks; each thread streams the whole
+// rotation log over its block (rows of Z are contiguous).
+template <typename T, int MODE>
+void apply_rot_seq_t(int64_t n, int64_t k, T* z, const int32_t* planes,
+                     const double* cs, const T* ss, int64_t nrot) {
+    const int64_t blk = 512;
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t b0 = 0; b0 < k; b0 += blk) {
+        const int64_t w = std::min(blk, k - b0);
+        for (int64_t idx = nrot - 1; idx >= 0; --idx) {
+            const int64_t i = planes[idx];
+            const double c = cs[idx];
+            const T s = ss[idx];
+            const T m01 = (MODE == 0) ? -s : -conj_s(s);
+            const T m10 = (MODE == 0) ? conj_s(s) : s;
+            T* __restrict zu = z + (i - 1) * k + b0;
+            T* __restrict zl = z + i * k + b0;
+            for (int64_t t = 0; t < w; ++t) {
+                T u = zu[t], v = zl[t];
+                zu[t] = c * u + m01 * v;
+                zl[t] = m10 * u + c * v;
+            }
+        }
+    }
+}
+
+template <typename T>
+void apply_rot_seq(int64_t n, int64_t k, T* z, const int32_t* planes,
+                   const double* cs, const T* ss, int64_t nrot, int mode) {
+    if (mode == 0)
+        apply_rot_seq_t<T, 0>(n, k, z, planes, cs, ss, nrot);
+    else
+        apply_rot_seq_t<T, 1>(n, k, z, planes, cs, ss, nrot);
+}
+
+// Skewed-wavefront applier for logs produced by hb2st_impl / tb2bd_impl
+// (direct schedule, depth-major per column).  The flat reverse sweep
+// streams every active row of Z once per band column — L3-bandwidth
+// bound.  Here a block of B columns advances bottom-up in lockstep,
+// column j trailing column j+1 by two chase depths, so a row window is
+// revisited B times while still cache-resident.
+//
+// Legality: rotations of groups (j2,t2), (j1,t1) with j2 > j1 conflict
+// only when their row windows [j+1+t·kd, j+kd+t·kd] overlap, which
+// forces t1−t2 < Δj/kd + 1; the schedule time g(j,t) = (tmax_j − t) +
+// 2·(jhi−1−j) then gives g2 − g1 ≤ (Δj/kd + 1) − 2Δj < 0, i.e. the
+// higher column is always applied first, exactly as in the flat
+// reverse order.  Groups at equal g are provably row-disjoint, and
+// same-column groups at different depths are row-disjoint too, so the
+// remaining ordering freedom is genuine commutation.
+template <typename T, int MODE>
+void apply_rot_skewed_t(int64_t n, int64_t k, T* z, const int32_t* planes,
+                        const double* cs, const T* ss, int64_t kd) {
+    const int64_t ncols = std::max<int64_t>(n - 2, 0);
+    std::vector<int64_t> coloff((size_t)ncols + 1, 0);
+    for (int64_t j = 0; j < ncols; ++j) {
+        const int64_t dmax = std::min(kd, n - 1 - j);
+        int64_t tot = 0;
+        for (int64_t d = dmax; d >= 2; --d) tot += 1 + (n - 1 - j - d) / kd;
+        coloff[(size_t)j + 1] = coloff[(size_t)j] + tot;
+    }
+    auto cnt_jt = [&](int64_t j, int64_t t) {
+        int64_t dtop = std::min(std::min(kd, n - 1 - j), n - 1 - j - t * kd);
+        return std::max<int64_t>(dtop - 1, 0);
+    };
+    const int64_t W = 512;
+    const int64_t B = 64;
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t w0 = 0; w0 < k; w0 += W) {
+        const int64_t w = std::min(W, k - w0);
+        std::vector<int64_t> gstart;
+        for (int64_t jhi = ncols; jhi > 0; jhi -= B) {
+            const int64_t jlo = std::max<int64_t>(jhi - B, 0);
+            const int64_t nb = jhi - jlo;
+            const int64_t ntg = (n - 3 - jlo) / kd + 1;
+            gstart.assign((size_t)(nb * ntg), 0);
+            for (int64_t j = jlo; j < jhi; ++j) {
+                int64_t acc = coloff[(size_t)j];
+                const int64_t tmax_j = (n - 3 - j) / kd;
+                for (int64_t t = 0; t <= tmax_j; ++t) {
+                    gstart[(size_t)((j - jlo) * ntg + t)] = acc;
+                    acc += cnt_jt(j, t);
+                }
+            }
+            const int64_t gmax = (n - 3 - jlo) / kd + 2 * (jhi - 1 - jlo);
+            for (int64_t g = 0; g <= gmax; ++g) {
+                for (int64_t j = jhi - 1; j >= jlo; --j) {
+                    const int64_t tmax_j = (n - 3 - j) / kd;
+                    const int64_t t = tmax_j - (g - 2 * (jhi - 1 - j));
+                    if (t < 0 || t > tmax_j) continue;
+                    const int64_t cnt = cnt_jt(j, t);
+                    if (cnt <= 0) continue;
+                    const int64_t s0 = gstart[(size_t)((j - jlo) * ntg + t)];
+                    for (int64_t e = s0 + cnt - 1; e >= s0; --e) {
+                        const int64_t i = planes[e];
+                        const double c = cs[e];
+                        const T s = ss[e];
+                        const T m01 = (MODE == 0) ? -s : -conj_s(s);
+                        const T m10 = (MODE == 0) ? conj_s(s) : s;
+                        T* __restrict zu = z + (i - 1) * k + w0;
+                        T* __restrict zl = z + i * k + w0;
+                        for (int64_t x = 0; x < w; ++x) {
+                            T u = zu[x], v = zl[x];
+                            zu[x] = c * u + m01 * v;
+                            zl[x] = m10 * u + c * v;
+                        }
+                    }
+                }
+            }
+        }
+    }
+}
+
+template <typename T>
+void apply_rot_skewed(int64_t n, int64_t k, T* z, const int32_t* planes,
+                      const double* cs, const T* ss, int64_t kd, int mode) {
+    if (mode == 0)
+        apply_rot_skewed_t<T, 0>(n, k, z, planes, cs, ss, kd);
+    else
+        apply_rot_skewed_t<T, 1>(n, k, z, planes, cs, ss, kd);
+}
+
+
+}  // namespace
+
+extern "C" {
+
+int64_t slate_hb2st_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,
+                        int32_t* planes, double* cs, double* ss) {
+    return hb2st_impl<double>(ab, n, kd, ldab, planes, cs, ss);
+}
+
+int64_t slate_hb2st_c128(void* ab, int64_t n, int64_t kd, int64_t ldab,
+                         int32_t* planes, double* cs, void* ss) {
+    return hb2st_impl<cplx>((cplx*)ab, n, kd, ldab, planes, cs, (cplx*)ss);
+}
+
+int64_t slate_tb2bd_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,
+                        int32_t* lplanes, double* lcs, double* lss,
+                        int32_t* rplanes, double* rcs, double* rss) {
+    return tb2bd_impl<double>(ab, n, kd, ldab, lplanes, lcs, lss,
+                              rplanes, rcs, rss);
+}
+
+int64_t slate_tb2bd_c128(void* ab, int64_t n, int64_t kd, int64_t ldab,
+                         int32_t* lplanes, double* lcs, void* lss,
+                         int32_t* rplanes, double* rcs, void* rss) {
+    return tb2bd_impl<cplx>((cplx*)ab, n, kd, ldab, lplanes, lcs,
+                            (cplx*)lss, rplanes, rcs, (cplx*)rss);
+}
+
+void slate_apply_rot_seq_f64(int64_t n, int64_t k, double* z,
+                             const int32_t* planes, const double* cs,
+                             const double* ss, int64_t nrot, int mode) {
+    apply_rot_seq<double>(n, k, z, planes, cs, ss, nrot, mode);
+}
+
+// Bidiagonal divide-and-conquer SVD (LAPACK bdsdc) -- the stage-3 core
+// the reference reaches through lapack::bdsqr on rank 0 (src/svd.cc:300+);
+// D&C is its fast variant (what gesdd uses internally).
+int slate_bdsdc_f64(int64_t n, double* d, double* e, double* u, double* vt) {
+    const int in = (int)n;
+    int info = 0;
+    std::vector<double> work((size_t)(3 * n * n + 4 * n + 16));
+    std::vector<int> iwork((size_t)(8 * n + 8));
+    double qdum = 0; int iqdum = 0;
+    dbdsdc_("U", "I", &in, d, e, u, &in, vt, &in, &qdum, &iqdum,
+            work.data(), iwork.data(), &info);
+    return info;
+}
+
+void slate_apply_rot_seq_c128(int64_t n, int64_t k, void* z,
+                              const int32_t* planes, const double* cs,
+                              const void* ss, int64_t nrot, int mode) {
+    apply_rot_seq<cplx>(n, k, (cplx*)z, planes, cs, (const cplx*)ss,
+                        nrot, mode);
+}
+
+void slate_apply_rot_skewed_f64(int64_t n, int64_t k, double* z,
+                                const int32_t* planes, const double* cs,
+                                const double* ss, int64_t kd, int mode) {
+    apply_rot_skewed<double>(n, k, z, planes, cs, ss, kd, mode);
+}
+
+void slate_apply_rot_skewed_c128(int64_t n, int64_t k, void* z,
+                                 const int32_t* planes, const double* cs,
+                                 const void* ss, int64_t kd, int mode) {
+    apply_rot_skewed<cplx>(n, k, (cplx*)z, planes, cs, (const cplx*)ss,
+                           kd, mode);
+}
+
 
 }  // extern "C"

@@ -22,3 +22,7 @@ from .dist_aux import (  # noqa: F401
     phemm, pher2k, pherk, pnorm, psymm, psyr2k, psyrk, ptri_mask, ptrmm,
     ptrsm,
 )
+from .dist_twostage import (  # noqa: F401
+    band_tiles_to_dense, pge2tb, phe2hb, pheev, psvd, punmbr_ge2tb_p,
+    punmbr_ge2tb_q, punmtr_he2hb,
+)

@@ -1,0 +1,622 @@
+"""Distributed two-stage eigensolver / SVD stage 1 over the ('p','q') mesh.
+
+TPU-native re-design of the reference's distributed stage-1 reductions:
+
+* ``phe2hb`` — Hermitian dense → Hermitian band (lower bandwidth nb),
+  reference ``slate::he2hb`` (``src/he2hb.cc:53-177``): per panel a QR of
+  the block column below the band plus a two-sided her2k-shaped trailing
+  update (``internal_he2hb_hemm.cc`` / ``internal_he2hb_her2k_*``).
+* ``pge2tb`` — general dense → upper triangular band, reference
+  ``slate::ge2tb`` (``src/ge2tb.cc``): alternating QR panels on block
+  columns and LQ panels on block rows.
+
+Design (same trades as :mod:`.dist_qr` / :mod:`.dist_lu`):
+
+* the panel is assembled on every device with one masked ``psum`` (along
+  the owning axis) + one ``all_gather`` (along the other), then every
+  device runs the same fused Householder panel — redundant MXU flops for
+  zero per-column latency hops (replacing the reference's
+  ``internal::ttqrt`` tree);
+* the packed factor is written *in place*: R in the first sub-band (he2hb)
+  / diagonal (ge2tb) tile, the V's strictly below (exactly where the
+  reference zeroes the matrix, so the distributed back-transforms
+  ``punmtr_he2hb`` / ``punmbr_ge2tb`` read panels from the factor the way
+  ``punmqr`` does), while the compact-WY T blocks are replicated — O(n·nb)
+  extra state, the same as the reference's ``T`` matrix;
+* the two-sided trailing update runs as local MXU matmuls on the masked
+  trailing region: Y = B·(V·T) needs one ``psum`` (cols) + one
+  ``all_gather`` (rows) per panel; the symmetric update
+  B ← B − V·Wᴴ − W·Vᴴ is then purely local;
+* the band result is extracted tile-wise — O(n·nb) data, not O(n²) — and
+  replicated, mirroring the reference's band gather to the stage-2 node
+  (``src/heev.cc:111-113``, ``he2hbGather``).
+
+Stage 2 (band → tridiag/bidiag → solve) runs on host via the shared
+helpers in :mod:`slate_tpu.linalg.eig` / :mod:`slate_tpu.linalg.svd`,
+exactly as the reference runs its stage 2 on a single node.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..grid import ceildiv
+from ..linalg.qr import _panel_geqrf, larft_rec
+from ..ops.blocks import _ct, matmul as _mm
+from .dist import DistMatrix, distribute, like
+from .dist_lu import _gather_positions, _roll_rows
+from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+
+def _unrep(x):
+    """Make an everywhere-equal value replicated for a P() out-spec."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        return (lax.pmax(lax.pmax(x.real, AXIS_P), AXIS_Q)
+                + 1j * lax.pmax(lax.pmax(x.imag, AXIS_P), AXIS_Q)
+                ).astype(x.dtype)
+    return lax.pmax(lax.pmax(x, AXIS_P), AXIS_Q)
+
+
+def _varying(x):
+    return lax.pcast(x, (AXIS_P, AXIS_Q), to="varying")
+
+
+# ---------------------------------------------------------------------------
+# phe2hb: Hermitian dense → band
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _build_phe2hb(mesh, nb: int, nt: int, ml: int, nl: int, n_true: int,
+                  dtype_name: str):
+    p, q = mesh_grid_shape(mesh)
+    mtp = p * ml
+    M = mtp * nb
+    pos = jnp.asarray(_gather_positions(mtp, p))
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        lrows = jnp.arange(ml * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        lcols = jnp.arange(nl * nb)
+        gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+        rows_g = jnp.arange(M)
+        rr = rows_g[:, None]
+        cc = jnp.arange(nb)[None, :]
+
+        def body(k, carry):
+            a_loc, tmats = carry
+            r0 = (k + 1) * nb
+            kq = k // q
+            # ---- assemble block column k on every device (the
+            # reference's panel listBcast, src/he2hb.cc:86-101)
+            colk = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
+            ploc = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
+            pg = lax.all_gather(ploc, AXIS_P, axis=0, tiled=True)
+            panel = jnp.take(pg.reshape(mtp, nb, nb), pos, axis=0)
+            panel = panel.reshape(M, nb)
+            shifted = _roll_rows(panel, r0)
+            valid = (rows_g < n_true - r0)[:, None].astype(dt)
+            # ---- redundant Householder panel + compact-WY T
+            packed, taus = _panel_geqrf(shifted * valid)
+            v_full = jnp.where(rr > cc, packed,
+                               jnp.where(rr == cc, 1, 0).astype(dt))
+            tmat = larft_rec(v_full, taus)
+            # ---- write the packed factor (R upper / V strictly lower)
+            # into column block k, rows >= r0
+            rel = grows - r0
+            myrows = jnp.take(packed, jnp.clip(rel, 0, M - 1), axis=0)
+            newcol = jnp.where((rel >= 0)[:, None], myrows, colk)
+            written = lax.dynamic_update_slice(a_loc, newcol, (0, kq * nb))
+            a_loc = jnp.where(k % q == c, written, a_loc)
+            # ---- two-sided trailing update (rows, cols >= r0):
+            # Y = B·(V·T); S = Tᴴ·Vᴴ·Y; W = Y − ½·V·S;
+            # B ← B − V·Wᴴ − W·Vᴴ   (src/he2hb.cc:103-177)
+            rmask = ((grows >= r0) & (grows < n_true)).astype(dt)
+            cmask = ((gcols >= r0) & (gcols < n_true)).astype(dt)
+            a_masked = a_loc * rmask[:, None] * cmask[None, :]
+            vt = _mm(v_full, tmat)
+            crel = gcols - r0
+            vt_cols = jnp.take(vt, jnp.clip(crel, 0, M - 1), axis=0) \
+                * (crel >= 0)[:, None].astype(dt)
+            y_loc = lax.psum(_mm(a_masked, vt_cols), AXIS_Q)
+            yg = lax.all_gather(y_loc, AXIS_P, axis=0, tiled=True)
+            yg = jnp.take(yg.reshape(mtp, nb, nb), pos, axis=0)
+            yg = yg.reshape(M, nb)
+            relg = rows_g - r0
+            vg = jnp.take(v_full, jnp.clip(relg, 0, M - 1), axis=0) \
+                * (relg >= 0)[:, None].astype(dt)
+            s = _mm(_ct(tmat), _mm(_ct(vg), yg))
+            wg = yg - 0.5 * _mm(vg, s)
+            v_rows = jnp.take(vg, grows, axis=0)
+            w_rows = jnp.take(wg, grows, axis=0)
+            v_cols = jnp.take(vg, gcols, axis=0)
+            w_cols = jnp.take(wg, gcols, axis=0)
+            upd = _mm(v_rows, _ct(w_cols)) + _mm(w_rows, _ct(v_cols))
+            a_loc = a_loc - upd * rmask[:, None] * cmask[None, :]
+            tmats = lax.dynamic_update_slice(tmats, tmat[None], (k, 0, 0))
+            return a_loc, tmats
+
+        tmats0 = _varying(jnp.zeros((max(nt - 1, 1), nb, nb), a_loc.dtype))
+        a_loc, tmats = lax.fori_loop(0, nt - 1, body, (a_loc, tmats0))
+        return a_loc, _unrep(tmats)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=(P(AXIS_P, AXIS_Q), P()))
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _build_band_tiles(mesh, nb: int, ml: int, nl: int, lower: bool):
+    """Extract the band tile pairs — (j,j) and (j+1,j) for ``lower``
+    (he2hb), (i,i) and (i,i+1) for upper (ge2tb) — as a replicated
+    (ntiles, 2, nb, nb) stack: O(n·nb) data, the analog of the
+    reference's ``he2hbGather`` (``src/heev.cc:111``)."""
+
+    p, q = mesh_grid_shape(mesh)
+    mtp, ntp = p * ml, q * nl
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        ab = a_loc.reshape(ml, nb, nl, nb).transpose(0, 2, 1, 3)
+        if lower:
+            jl = jnp.arange(nl)
+            jg = jl * q + c
+            il_d = jg // p
+            own_d = ((jg % p) == r) & (jg < mtp)
+            diag_t = ab[jnp.clip(il_d, 0, ml - 1), jl] \
+                * own_d[:, None, None].astype(dt)
+            il_s = (jg + 1) // p
+            own_s = (((jg + 1) % p) == r) & (jg + 1 < mtp)
+            sub_t = ab[jnp.clip(il_s, 0, ml - 1), jl] \
+                * own_s[:, None, None].astype(dt)
+            stacked = jnp.stack([diag_t, sub_t], axis=1)
+            out = jnp.zeros((ntp, 2, nb, nb), dt).at[jg].set(stacked)
+        else:
+            il = jnp.arange(ml)
+            ig = il * p + r
+            jl_d = ig // q
+            own_d = ((ig % q) == c) & (ig < ntp)
+            diag_t = ab[il, jnp.clip(jl_d, 0, nl - 1)] \
+                * own_d[:, None, None].astype(dt)
+            jl_s = (ig + 1) // q
+            own_s = (((ig + 1) % q) == c) & (ig + 1 < ntp)
+            sup_t = ab[il, jnp.clip(jl_s, 0, nl - 1)] \
+                * own_s[:, None, None].astype(dt)
+            stacked = jnp.stack([diag_t, sup_t], axis=1)
+            out = jnp.zeros((mtp, 2, nb, nb), dt).at[ig].set(stacked)
+        out = lax.psum(lax.psum(out, AXIS_Q), AXIS_P)
+        return _unrep(out)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=P())
+    return jax.jit(fn)
+
+
+def phe2hb(a: DistMatrix):
+    """Distributed Hermitian → band reduction (reference ``slate::he2hb``,
+    ``src/he2hb.cc:53-177``).
+
+    Returns ``(factor, tmats, band_tiles)``: ``factor`` holds R/V packed in
+    the sub-band block columns, ``tmats`` the replicated compact-WY T
+    blocks (one per panel), and ``band_tiles`` the replicated
+    (nt, 2, nb, nb) diagonal/sub-diagonal tile pairs (use
+    :func:`band_tiles_to_dense` to assemble the stage-2 operand).
+    """
+
+    p, q = a.grid_shape
+    if a.m != a.n:
+        raise ValueError(f"phe2hb requires square, got {a.m}x{a.n}")
+    if a.mtp != a.ntp:
+        raise ValueError("phe2hb needs square padded storage "
+                         "(distribute with row_mult=q, col_mult=p)")
+    ml, nl = a.mtp // p, a.ntp // q
+    nt = ceildiv(a.n, a.nb)
+    fn = _build_phe2hb(a.mesh, a.nb, nt, ml, nl, a.n, str(a.dtype))
+    fac_data, tmats = fn(a.data)
+    band_tiles = _build_band_tiles(a.mesh, a.nb, ml, nl, True)(fac_data)
+    return like(a, fac_data), tmats, band_tiles
+
+
+def band_tiles_to_dense(tiles, n: int, nb: int, lower: bool = True):
+    """Assemble the (nt, 2, nb, nb) replicated tile stack into a dense
+    host band matrix (n×n): Hermitian with lower bandwidth nb when
+    ``lower`` (the sub-diagonal tile's strict lower part holds packed V's
+    and is masked off), general upper-banded otherwise."""
+
+    tiles = np.asarray(tiles)
+    nt = ceildiv(n, nb)
+    out = np.zeros((n, n), dtype=tiles.dtype)
+    for k in range(nt):
+        j0 = k * nb
+        w = min(nb, n - j0)
+        d = tiles[k, 0][:w, :w]
+        if lower:
+            out[j0:j0 + w, j0:j0 + w] = np.tril(d)
+            r0 = j0 + nb
+            if r0 < n:
+                h = min(nb, n - r0)
+                s = np.triu(tiles[k, 1][:h, :w])
+                out[r0:r0 + h, j0:j0 + w] = s
+        else:
+            out[j0:j0 + w, j0:j0 + w] = np.triu(d)
+            c0 = j0 + nb
+            if c0 < n:
+                h = min(nb, n - c0)
+                s = np.tril(tiles[k, 1][:w, :h])
+                out[j0:j0 + w, c0:c0 + h] = s
+    if lower:
+        out = out + out.conj().T - np.diag(np.diagonal(out))
+    return out
+
+
+@lru_cache(maxsize=None)
+def _build_papply_q(mesh, nb: int, npanels: int, shift_blocks: int,
+                    ml: int, forward: bool, dtype_name: str):
+    """Apply the packed column-panel reflector chain to a row-distributed
+    Z: forward applies Q = H₀·H₁⋯ (panels last-to-first with T), else Qᴴ
+    (first-to-last with Tᴴ).  ``shift_blocks`` is the sub-diagonal offset
+    of panel k's V (1 for he2hb, 0 for ge2tb/QR).  Reference
+    ``unmtr_he2hb`` / ``unmbr_ge2tb`` fan-out (``src/unmtr_he2hb.cc``)."""
+
+    p, q = mesh_grid_shape(mesh)
+
+    def kernel(fac_loc, tmats, z_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = fac_loc.dtype
+        lrows = jnp.arange(ml * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        cc = jnp.arange(nb)[None, :]
+
+        def body(i, z_loc):
+            k = (npanels - 1 - i) if forward else i
+            colk = lax.dynamic_slice(
+                fac_loc, (0, (k // q) * nb), (ml * nb, nb))
+            colk = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
+            relc = (grows - (k + shift_blocks) * nb)[:, None]
+            v_loc = jnp.where(relc > cc, colk,
+                              jnp.where(relc == cc, 1, 0).astype(dt))
+            v_loc = v_loc * (relc >= 0).astype(dt)
+            tmat = lax.dynamic_slice(tmats, (k, 0, 0), (1, nb, nb))[0]
+            tt = tmat if forward else _ct(tmat)
+            w = lax.psum(_mm(_ct(v_loc), z_loc), AXIS_P)
+            return z_loc - _mm(v_loc, _mm(tt, w))
+
+        return lax.fori_loop(0, npanels, body, z_loc)
+
+    fn = shard_map(kernel, mesh=mesh,
+                   in_specs=(P(AXIS_P, AXIS_Q), P(), P(AXIS_P, AXIS_Q)),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def punmtr_he2hb(fac: DistMatrix, tmats, z: DistMatrix,
+                 forward: bool = True) -> DistMatrix:
+    """Z ← Q₁·Z (forward) or Q₁ᴴ·Z from a :func:`phe2hb` factor —
+    reference ``slate::unmtr_he2hb``."""
+
+    p, q = fac.grid_shape
+    if z.mtp != fac.mtp or z.nb != fac.nb:
+        raise ValueError("Z row padding/tile size must match the factor")
+    ml = fac.mtp // p
+    npanels = max(ceildiv(fac.n, fac.nb) - 1, 0)
+    if npanels == 0:
+        return z
+    fn = _build_papply_q(fac.mesh, fac.nb, npanels, 1, ml,
+                         forward, str(fac.dtype))
+    return like(z, fn(fac.data, tmats, z.data))
+
+
+# ---------------------------------------------------------------------------
+# pge2tb: general dense → upper triangular band
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _build_pge2tb(mesh, nb: int, nt: int, ml: int, nl: int, m_true: int,
+                  n_true: int, dtype_name: str):
+    p, q = mesh_grid_shape(mesh)
+    mtp, ntp = p * ml, q * nl
+    M, N = mtp * nb, ntp * nb
+    pos_p = jnp.asarray(_gather_positions(mtp, p))
+    pos_q = jnp.asarray(_gather_positions(ntp, q))
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        lrows = jnp.arange(ml * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        lcols = jnp.arange(nl * nb)
+        gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+        rows_gM = jnp.arange(M)
+        rows_gN = jnp.arange(N)
+        cc = jnp.arange(nb)[None, :]
+
+        def body(k, carry):
+            a_loc, qtmats, ptmats = carry
+            j0 = k * nb
+            c0 = (k + 1) * nb
+            # ======== QR panel: block column k, rows >= j0 ========
+            kq = k // q
+            colk = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
+            ploc = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
+            pg = lax.all_gather(ploc, AXIS_P, axis=0, tiled=True)
+            panel = jnp.take(pg.reshape(mtp, nb, nb), pos_p, axis=0)
+            panel = panel.reshape(M, nb)
+            shifted = _roll_rows(panel, j0)
+            validq = (rows_gM < m_true - j0)[:, None].astype(dt)
+            packed, taus = _panel_geqrf(shifted * validq)
+            vq = jnp.where(rows_gM[:, None] > cc, packed,
+                           jnp.where(rows_gM[:, None] == cc, 1,
+                                     0).astype(dt))
+            tq = larft_rec(vq, taus)
+            # write back packed [R; V] into column block k, rows >= j0
+            rel = grows - j0
+            myrows = jnp.take(packed, jnp.clip(rel, 0, M - 1), axis=0)
+            newcol = jnp.where((rel >= 0)[:, None], myrows, colk)
+            written = lax.dynamic_update_slice(a_loc, newcol, (0, kq * nb))
+            a_loc = jnp.where(k % q == c, written, a_loc)
+            # left-apply Qᴴ to trailing columns (rows >= j0, cols >= c0)
+            rmask = ((grows >= j0) & (grows < m_true)).astype(dt)
+            cmask = ((gcols >= c0) & (gcols < n_true)).astype(dt)
+            a_masked = a_loc * rmask[:, None] * cmask[None, :]
+            v_rows = jnp.take(vq, jnp.clip(rel, 0, M - 1), axis=0) \
+                * (rel >= 0)[:, None].astype(dt)
+            wq = lax.psum(_mm(_ct(v_rows), a_masked), AXIS_P)
+            a_loc = a_loc - _mm(v_rows, _mm(_ct(tq), wq)) \
+                * rmask[:, None] * cmask[None, :]
+            qtmats = lax.dynamic_update_slice(qtmats, tq[None], (k, 0, 0))
+            # ======== LQ panel: block row k, cols >= c0 ========
+            kp = k // p
+            rowk = lax.dynamic_slice(a_loc, (kp * nb, 0), (nb, nl * nb))
+            rloc = lax.psum(rowk * (k % p == r).astype(dt), AXIS_P)
+            rg = lax.all_gather(rloc, AXIS_Q, axis=1, tiled=True)
+            rowg = jnp.take(rg.reshape(nb, ntp, nb), pos_q, axis=1)
+            rowg = rowg.reshape(nb, N)
+            panelr = _roll_rows(_ct(rowg), c0)
+            validp = (rows_gN < n_true - c0)[:, None].astype(dt)
+            packedr, tausr = _panel_geqrf(panelr * validp)
+            vp = jnp.where(rows_gN[:, None] > cc, packedr,
+                           jnp.where(rows_gN[:, None] == cc, 1,
+                                     0).astype(dt))
+            tp = larft_rec(vp, tausr)
+            # write back ct(packed) = [L ‖ ct(V)] into row block k,
+            # cols >= c0
+            crel = gcols - c0
+            myc = _ct(jnp.take(packedr, jnp.clip(crel, 0, N - 1), axis=0))
+            newrow = jnp.where((crel >= 0)[None, :], myc, rowk)
+            writtenr = lax.dynamic_update_slice(a_loc, newrow, (kp * nb, 0))
+            a_loc = jnp.where(k % p == r, writtenr, a_loc)
+            # right-apply P̂ to trailing rows (rows >= c0, cols >= c0):
+            # C ← C − (C·V)·T·Vᴴ
+            rmask2 = ((grows >= c0) & (grows < m_true)).astype(dt)
+            cmask2 = ((gcols >= c0) & (gcols < n_true)).astype(dt)
+            a_masked2 = a_loc * rmask2[:, None] * cmask2[None, :]
+            vp_cols = jnp.take(vp, jnp.clip(crel, 0, N - 1), axis=0) \
+                * (crel >= 0)[:, None].astype(dt)
+            z = lax.psum(_mm(a_masked2, vp_cols), AXIS_Q)
+            a_loc = a_loc - _mm(_mm(z, tp), _ct(vp_cols)) \
+                * rmask2[:, None] * cmask2[None, :]
+            ptmats = lax.dynamic_update_slice(ptmats, tp[None], (k, 0, 0))
+            return a_loc, qtmats, ptmats
+
+        qt0 = _varying(jnp.zeros((nt, nb, nb), a_loc.dtype))
+        pt0 = _varying(jnp.zeros((nt, nb, nb), a_loc.dtype))
+        a_loc, qtmats, ptmats = lax.fori_loop(0, nt, body,
+                                              (a_loc, qt0, pt0))
+        return a_loc, _unrep(qtmats), _unrep(ptmats)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=(P(AXIS_P, AXIS_Q), P(), P()))
+    return jax.jit(fn)
+
+
+def pge2tb(a: DistMatrix):
+    """Distributed general → upper-triangular-band reduction (reference
+    ``slate::ge2tb``, ``src/ge2tb.cc``).  Requires m ≥ n.
+
+    Returns ``(factor, qtmats, ptmats, band_tiles)`` with Q's V packed
+    below the diagonal of each block column, P's ct(V) packed right of
+    the first super-diagonal block of each block row, and the band tile
+    pairs replicated.
+    """
+
+    p, q = a.grid_shape
+    if a.m < a.n:
+        raise ValueError("pge2tb requires m >= n")
+    ml, nl = a.mtp // p, a.ntp // q
+    nt = ceildiv(a.n, a.nb)
+    if a.mtp < nt:
+        raise ValueError("padded grid too small for the panel count")
+    fn = _build_pge2tb(a.mesh, a.nb, nt, ml, nl, a.m, a.n, str(a.dtype))
+    fac_data, qtmats, ptmats = fn(a.data)
+    band_tiles = _build_band_tiles(a.mesh, a.nb, ml, nl, False)(fac_data)
+    return like(a, fac_data), qtmats, ptmats, band_tiles
+
+
+def punmbr_ge2tb_q(fac: DistMatrix, qtmats, z: DistMatrix,
+                   forward: bool = True) -> DistMatrix:
+    """Z ← Q₁·Z (forward) or Q₁ᴴ·Z from a :func:`pge2tb` factor —
+    reference ``slate::unmbr_ge2tb`` (U side)."""
+
+    p, q = fac.grid_shape
+    if z.mtp != fac.mtp or z.nb != fac.nb:
+        raise ValueError("Z row padding/tile size must match the factor")
+    ml = fac.mtp // p
+    npanels = ceildiv(fac.n, fac.nb)
+    fn = _build_papply_q(fac.mesh, fac.nb, npanels, 0, ml,
+                         forward, str(fac.dtype))
+    return like(z, fn(fac.data, qtmats, z.data))
+
+
+@lru_cache(maxsize=None)
+def _build_papply_p(mesh, nb: int, npanels: int, nl: int,
+                    ml_z: int, forward: bool, dtype_name: str):
+    """Apply the LQ-panel chain P₁ (packed as ct(V) in the factor's block
+    rows) to a row-distributed Z whose rows live in A's *column* space."""
+
+    p, q = mesh_grid_shape(mesh)
+    ntp = q * nl
+    N = ntp * nb
+    pos_q = jnp.asarray(_gather_positions(ntp, q))
+
+    def kernel(fac_loc, tmats, z_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = fac_loc.dtype
+        lrows = jnp.arange(ml_z * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        cc = jnp.arange(nb)[None, :]
+
+        def body(i, z_loc):
+            k = (npanels - 1 - i) if forward else i
+            # assemble block row k of the factor (replicated), cols >= c0
+            rowk = lax.dynamic_slice(
+                fac_loc, ((k // p) * nb, 0), (nb, nl * nb))
+            rloc = lax.psum(rowk * (k % p == r).astype(dt), AXIS_P)
+            rg = lax.all_gather(rloc, AXIS_Q, axis=1, tiled=True)
+            rowg = jnp.take(rg.reshape(nb, ntp, nb), pos_q, axis=1)
+            rowg = rowg.reshape(nb, N)
+            packed = _ct(rowg)              # (N, nb), rows = A's columns
+            relc = (grows - (k + 1) * nb)[:, None]
+            v_rows = jnp.take(packed, jnp.clip(grows, 0, N - 1), axis=0)
+            v_loc = jnp.where(relc > cc, v_rows,
+                              jnp.where(relc == cc, 1, 0).astype(dt))
+            v_loc = v_loc * (relc >= 0).astype(dt)
+            tmat = lax.dynamic_slice(tmats, (k, 0, 0), (1, nb, nb))[0]
+            tt = tmat if forward else _ct(tmat)
+            w = lax.psum(_mm(_ct(v_loc), z_loc), AXIS_P)
+            return z_loc - _mm(v_loc, _mm(tt, w))
+
+        return lax.fori_loop(0, npanels, body, z_loc)
+
+    fn = shard_map(kernel, mesh=mesh,
+                   in_specs=(P(AXIS_P, AXIS_Q), P(), P(AXIS_P, AXIS_Q)),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def punmbr_ge2tb_p(fac: DistMatrix, ptmats, z: DistMatrix,
+                   forward: bool = True) -> DistMatrix:
+    """Z ← P₁·Z (forward) or P₁ᴴ·Z from a :func:`pge2tb` factor, Z's rows
+    in A's column space — reference ``slate::unmbr_ge2tb`` (V side)."""
+
+    p, q = fac.grid_shape
+    if z.nb != fac.nb:
+        raise ValueError("Z tile size must match the factor")
+    if z.mtp != fac.ntp:
+        raise ValueError("Z rows live in A's column space: z.mtp must "
+                         "equal the factor's ntp")
+    nl = fac.ntp // q
+    ml_z = z.mtp // p
+    npanels = ceildiv(fac.n, fac.nb)
+    fn = _build_papply_p(fac.mesh, fac.nb, npanels, nl, ml_z,
+                         forward, str(fac.dtype))
+    return like(z, fn(fac.data, ptmats, z.data))
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+def pheev(a, mesh=None, nb: int = 256, jobz: bool = True, opts=None):
+    """Distributed Hermitian eigensolver — reference ``slate::heev``
+    (``src/heev.cc:104-176``): distributed ``phe2hb`` stage 1, band
+    gathered (O(n·nb)) to host for stage 2 + tridiagonal solve exactly as
+    the reference's single-node stage 2, distributed back-transform.
+
+    Returns ``(w, Z)`` with ``Z`` a DistMatrix (or None when not
+    ``jobz``).  ``a`` may be a dense array (with ``mesh`` given) or an
+    already-distributed DistMatrix.
+    """
+
+    from ..linalg.eig import _band_eig
+    from ..enums import MethodEig
+    from ..options import get_option
+
+    if isinstance(a, DistMatrix):
+        ad = a
+        mesh = ad.mesh
+        nb = ad.nb
+    else:
+        av = jnp.asarray(a)
+        p, q = mesh_grid_shape(mesh)
+        ad = distribute(av, mesh, nb, row_mult=q, col_mult=p)
+    n = ad.n
+    fac, tmats, band_tiles = phe2hb(ad)
+    band = band_tiles_to_dense(band_tiles, n, nb, lower=True)
+    method = get_option(opts, "method_eig", MethodEig.Auto)
+    auto = method is MethodEig.Auto
+    if auto:
+        method = MethodEig.DC
+    w, z_band = _band_eig(band, min(nb, n - 1), jobz, method, auto)
+    if not jobz:
+        return jnp.asarray(w), None
+    p, q = mesh_grid_shape(mesh)
+    zd = distribute(jnp.asarray(z_band, dtype=ad.dtype), mesh, nb,
+                    row_mult=q, col_mult=p)
+    z = punmtr_he2hb(fac, tmats, zd, forward=True)
+    return jnp.asarray(w), z
+
+
+def psvd(a, mesh=None, nb: int = 256, jobu: bool = True, jobvt: bool = True,
+         opts=None):
+    """Distributed two-stage SVD — reference ``slate::svd``
+    (``src/svd.cc:207-372``): distributed ``pge2tb`` stage 1, band to host
+    for stage 2 (tb2bd → bdsqr), distributed back-transforms.
+
+    Returns ``(sigma, U, Vᴴ_rowspace)`` where U is an m×n DistMatrix and
+    the third element is V (n×n DistMatrix, columns are right singular
+    vectors) — undistribute and conj-transpose for the dense Vᴴ.
+    Requires m ≥ n (transpose on the host for wide problems).
+    """
+
+    from ..linalg.svd import _band_svd
+    from ..enums import MethodSVD
+    from ..options import get_option
+
+    if isinstance(a, DistMatrix):
+        ad = a
+        mesh = ad.mesh
+        nb = ad.nb
+    else:
+        av = jnp.asarray(a)
+        p, q = mesh_grid_shape(mesh)
+        ad = distribute(av, mesh, nb, row_mult=q, col_mult=p)
+    m, n = ad.m, ad.n
+    if m < n:
+        raise ValueError("psvd requires m >= n (transpose the input)")
+    fac, qtmats, ptmats, band_tiles = pge2tb(ad)
+    band = band_tiles_to_dense(band_tiles, n, nb, lower=False)
+    method = get_option(opts, "method_svd", MethodSVD.Auto)
+    auto = method is MethodSVD.Auto
+    s, u_b, vh_b = _band_svd(band, min(nb, max(n - 1, 1)), jobu, jobvt,
+                             method, auto)
+    p, q = mesh_grid_shape(mesh)
+    u = v = None
+    if jobu:
+        u2 = np.asarray(u_b)
+        if m > n:
+            u2 = np.concatenate(
+                [u2, np.zeros((m - n, u2.shape[1]), dtype=u2.dtype)],
+                axis=0)
+        ud = distribute(jnp.asarray(u2, dtype=ad.dtype), mesh, nb,
+                        row_mult=q, col_mult=p)
+        u = punmbr_ge2tb_q(fac, qtmats, ud, forward=True)
+    if jobvt:
+        v2 = np.asarray(vh_b).conj().T
+        vd = distribute(jnp.asarray(v2, dtype=ad.dtype), mesh, nb,
+                        row_mult=q, col_mult=p)
+        v = punmbr_ge2tb_p(fac, ptmats, vd, forward=True)
+    return jnp.asarray(s), u, v

@@ -89,6 +89,8 @@ FLOPS = {
     "ppotrf": lambda p: p["n"] ** 3 / 3.0,
     "pgesv": lambda p: 2.0 * p["n"] ** 3 / 3.0,
     "pgeqrf": lambda p: 2.0 * p["m"] * p["n"] ** 2 - 2.0 * p["n"] ** 3 / 3.0,
+    "pheev": lambda p: 4.0 * p["n"] ** 3 / 3.0,
+    "psvd": lambda p: 8.0 * p["n"] ** 3 / 3.0,
 }
 
 
@@ -428,6 +430,26 @@ def make_tester(routine, p, jnp, st):
                 r = np.linalg.norm(np.conj(a.T) @ (a @ x - bb))
                 return r / (np.linalg.norm(a) ** 2
                             * max(np.linalg.norm(x), 1) * eps * m)
+            return run, check, None
+        if routine == "pheev":
+            a = np.asarray(herm(n))
+            run = lambda: par.pheev(a, mesh, nb)
+            def check(out):
+                w, zd = out
+                z = np.asarray(par.undistribute(zd))
+                r = np.linalg.norm(a @ z - z * np.asarray(w)[None, :])
+                return r / (np.linalg.norm(a) * eps * n * n)
+            return run, check, None
+        if routine == "psvd":
+            a = np.asarray(randn((m, n)))
+            run = lambda: par.psvd(a, mesh, nb)
+            def check(out):
+                s, ud, vd = out
+                u = np.asarray(par.undistribute(ud))[:, :n]
+                v = np.asarray(par.undistribute(vd))
+                rec = u @ np.diag(np.asarray(s)) @ np.conj(v.T)
+                return (np.linalg.norm(a - rec)
+                        / (np.linalg.norm(a) * eps * n))
             return run, check, None
 
     raise KeyError(routine)

@@ -1,0 +1,152 @@
+"""Distributed two-stage eig/SVD over the CPU mesh.
+
+Mirrors the reference's rank-count-independent validation (SURVEY §4):
+the same residual gates on a 2×4 mesh and the serial-stub 1×1 mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from slate_tpu.parallel import (band_tiles_to_dense, distribute, pge2tb,
+                                phe2hb, pheev, psvd, punmbr_ge2tb_q,
+                                punmtr_he2hb, undistribute,
+                                make_grid_mesh)
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    return make_grid_mesh(2, 4)
+
+
+@pytest.fixture(scope="module")
+def mesh11():
+    return make_grid_mesh(1, 1, devices=jax.devices()[:1])
+
+
+def _rand_herm(n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    a = (a + a.conj().T) / 2
+    return a.astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_phe2hb_band_similarity(mesh24, dtype):
+    """Band from phe2hb has the same spectrum as A (unitary congruence)."""
+    n, nb = 96, 16
+    a = _rand_herm(n, dtype)
+    ad = distribute(a, mesh24, nb, row_mult=4, col_mult=2)
+    fac, tmats, tiles = phe2hb(ad)
+    band = band_tiles_to_dense(tiles, n, nb, lower=True)
+    # band is Hermitian with lower bandwidth nb
+    assert np.allclose(band, band.conj().T)
+    mask = np.abs(np.subtract.outer(np.arange(n), np.arange(n))) > nb
+    assert np.abs(band[mask]).max() < 1e-10
+    wa = np.linalg.eigvalsh(a)
+    wb = np.linalg.eigvalsh(band)
+    assert np.allclose(wa, wb, atol=1e-8 * max(1, np.abs(wa).max()))
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_pheev_vectors(mesh24, dtype):
+    n, nb = 96, 16
+    a = _rand_herm(n, dtype)
+    w, zd = pheev(a, mesh24, nb)
+    z = np.asarray(undistribute(zd))
+    w = np.asarray(w)
+    anorm = np.linalg.norm(a)
+    assert np.linalg.norm(a @ z - z * w[None, :]) / (anorm * n) < 1e-12
+    assert np.linalg.norm(z.conj().T @ z - np.eye(n)) < 1e-10
+    assert np.allclose(w, np.linalg.eigvalsh(a), atol=1e-9 * anorm)
+
+
+def test_pheev_values_only(mesh24):
+    n, nb = 80, 16
+    a = _rand_herm(n, np.float64, seed=3)
+    w, z = pheev(a, mesh24, nb, jobz=False)
+    assert z is None
+    assert np.allclose(np.asarray(w), np.linalg.eigvalsh(a), atol=1e-10)
+
+
+def test_pheev_mesh11(mesh11):
+    n, nb = 48, 16
+    a = _rand_herm(n, np.float64, seed=5)
+    w, zd = pheev(a, mesh11, nb)
+    z = np.asarray(undistribute(zd))
+    assert np.linalg.norm(a @ z - z * np.asarray(w)[None, :]) < 1e-10 * n
+
+
+def test_pheev_odd_n(mesh24):
+    """n not a multiple of nb exercises the padded-tile masking."""
+    n, nb = 90, 16
+    a = _rand_herm(n, np.float64, seed=7)
+    w, zd = pheev(a, mesh24, nb)
+    z = np.asarray(undistribute(zd))
+    assert z.shape == (n, n)
+    assert np.linalg.norm(a @ z - z * np.asarray(w)[None, :]) < 1e-10 * n
+    assert np.allclose(np.asarray(w), np.linalg.eigvalsh(a), atol=1e-9)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_pge2tb_band_svd_match(mesh24, dtype):
+    """pge2tb band has the same singular values as A."""
+    m, n, nb = 128, 96, 16
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((m, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        a = a + 1j * rng.standard_normal((m, n))
+    a = a.astype(dtype)
+    ad = distribute(a, mesh24, nb, row_mult=4, col_mult=2)
+    fac, qt, pt, tiles = pge2tb(ad)
+    band = band_tiles_to_dense(tiles, n, nb, lower=False)
+    # upper-banded
+    i, j = np.indices((n, n))
+    assert np.abs(band[(j - i < 0) | (j - i > nb)]).max() < 1e-10
+    sa = np.linalg.svd(a, compute_uv=False)
+    sb = np.linalg.svd(band, compute_uv=False)
+    assert np.allclose(sa, sb, atol=1e-9 * sa[0])
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_psvd_full(mesh24, dtype):
+    m, n, nb = 128, 96, 16
+    rng = np.random.default_rng(13)
+    a = rng.standard_normal((m, n))
+    if np.issubdtype(np.dtype(dtype), np.complexfloating):
+        a = a + 1j * rng.standard_normal((m, n))
+    a = a.astype(dtype)
+    s, ud, vd = psvd(a, mesh24, nb)
+    s = np.asarray(s)
+    u = np.asarray(undistribute(ud))
+    v = np.asarray(undistribute(vd))
+    assert np.allclose(s, np.linalg.svd(a, compute_uv=False),
+                       atol=1e-9 * s[0])
+    rec = u[:, :n] @ np.diag(s) @ v.conj().T
+    assert np.linalg.norm(a - rec) / np.linalg.norm(a) < 1e-10
+    assert np.linalg.norm(u[:, :n].conj().T @ u[:, :n] - np.eye(n)) < 1e-9
+    assert np.linalg.norm(v.conj().T @ v - np.eye(n)) < 1e-9
+
+
+def test_psvd_values_only_mesh11(mesh11):
+    m, n, nb = 64, 48, 16
+    rng = np.random.default_rng(17)
+    a = rng.standard_normal((m, n))
+    s, u, v = psvd(a, mesh11, nb, jobu=False, jobvt=False)
+    assert u is None and v is None
+    assert np.allclose(np.asarray(s), np.linalg.svd(a, compute_uv=False),
+                       atol=1e-10)
+
+
+def test_psvd_square_odd(mesh24):
+    m = n = 90
+    nb = 16
+    rng = np.random.default_rng(19)
+    a = rng.standard_normal((m, n))
+    s, ud, vd = psvd(a, mesh24, nb)
+    u = np.asarray(undistribute(ud))
+    v = np.asarray(undistribute(vd))
+    rec = u @ np.diag(np.asarray(s)) @ v.conj().T
+    assert np.linalg.norm(a - rec) / np.linalg.norm(a) < 1e-10

@@ -126,3 +126,92 @@ class TestHostSolvers:
         out = subprocess.run([str(exe)], capture_output=True, text=True)
         assert out.returncode == 0, out.stdout + out.stderr
         assert "ok: C API smoke" in out.stdout
+
+
+class TestStage2:
+    """Compiled band→tridiag/bidiag bulge chase + back-transforms
+    (``slate_hb2st_* / slate_tb2bd_* / slate_apply_rot_* / slate_bdsdc``)."""
+
+    @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+    @pytest.mark.parametrize("n,kd", [(37, 5), (64, 8), (97, 16)])
+    def test_hb2st_matches_spectrum_and_vectors(self, dtype, n, kd):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from scipy.linalg import eigh_tridiagonal
+        from slate_tpu.linalg import eig as E
+        rng = np.random.default_rng(7)
+        a = rng.standard_normal((n, n))
+        if np.issubdtype(np.dtype(dtype), np.complexfloating):
+            a = a + 1j * rng.standard_normal((n, n))
+        a = (a + a.conj().T)
+        dm = np.subtract.outer(np.arange(n), np.arange(n))
+        band = np.where(np.abs(dm) <= kd, a, 0).astype(dtype)
+        d, e, rots = E._hb2st_native(band, kd)
+        assert rots.kd == min(kd, n - 1)
+        w, ztri = eigh_tridiagonal(d, e, lapack_driver="stevd")
+        assert np.allclose(np.sort(w), np.linalg.eigvalsh(band), atol=1e-10)
+        zb = E.unmtr_hb2st(rots, ztri)
+        r = np.linalg.norm(band @ zb - zb * w[None, :])
+        assert r < 1e-10 * n
+
+    def test_hb2st_values_only_skips_log(self):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from slate_tpu.linalg import eig as E
+        rng = np.random.default_rng(9)
+        n, kd = 50, 6
+        a = rng.standard_normal((n, n)); a = a + a.T
+        dm = np.subtract.outer(np.arange(n), np.arange(n))
+        band = np.where(np.abs(dm) <= kd, a, 0)
+        d, e, rots = E._hb2st_native(band, kd, want_rots=False)
+        assert len(rots.planes) == 0
+        from scipy.linalg import eigvalsh_tridiagonal
+        w = eigvalsh_tridiagonal(d, e)
+        assert np.allclose(np.sort(w), np.linalg.eigvalsh(band), atol=1e-10)
+
+    @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+    def test_tb2bd_bdsdc_roundtrip(self, dtype):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        import importlib
+        from slate_tpu.enums import Side
+        S = importlib.import_module("slate_tpu.linalg.svd")
+        rng = np.random.default_rng(11)
+        n, kd = 61, 7
+        a = rng.standard_normal((n, n))
+        if np.issubdtype(np.dtype(dtype), np.complexfloating):
+            a = a + 1j * rng.standard_normal((n, n))
+        dm = np.subtract.outer(np.arange(n), np.arange(n))
+        bu = np.where((dm <= 0) & (dm >= -kd), a, 0).astype(dtype)
+        d, e, rots = S._tb2bd_native(bu.copy(), kd)
+        u_bd, s, vh_bd = native.bdsdc(d, e)
+        assert np.allclose(np.sort(s),
+                           np.sort(np.linalg.svd(bu, compute_uv=False)),
+                           atol=1e-10)
+        u2 = S.unmbr_tb2bd(Side.Left, rots, np.ascontiguousarray(u_bd))
+        v2 = S.unmbr_tb2bd(Side.Right, rots,
+                           np.ascontiguousarray(vh_bd.conj().T))
+        rec = u2 @ np.diag(s) @ v2.conj().T
+        assert np.linalg.norm(rec - bu) / np.linalg.norm(bu) < 1e-12
+
+    def test_rot_count_matches_kernel(self):
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from slate_tpu.linalg import eig as E
+        rng = np.random.default_rng(13)
+        for n, kd in [(11, 2), (30, 29), (40, 3)]:
+            a = rng.standard_normal((n, n)); a = a + a.T
+            dm = np.subtract.outer(np.arange(n), np.arange(n))
+            band = np.where(np.abs(dm) <= kd, a, 0)
+            d, e, rots = E._hb2st_native(band, kd)
+            # capacity formula agreed with the C++ loop (asserted inside);
+            # spectrum preserved
+            assert np.allclose(
+                np.sort(np.linalg.eigvalsh(band)),
+                np.sort(np.linalg.eigvalsh(
+                    np.diag(d) + np.diag(e, 1) + np.diag(e, -1))),
+                atol=1e-9)
