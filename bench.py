@@ -1,25 +1,33 @@
-"""Headline benchmark: Cholesky factorization throughput on one chip.
+"""Benchmark suite: gemm / potrf / getrf / geqrf throughput on one chip.
 
 Reproduces the reference tester's metric — GFLOP/s from model flop counts
-(``/root/reference/test/test_gemm.cc:244-245``, ``params.gflops()``) — for
-the flagship driver ``potrf`` (BASELINE.md config #2: potrf fp32 n=8192,
-single device).  ``vs_baseline`` is measured against the reference's only
-in-repo per-device throughput anchor, 702 GFLOP/s/GPU
-(``/root/reference/docs/usage.md:36-44``).
+(``/root/reference/test/test_gemm.cc:244-245``, ``params.gflops()``) — at
+the BASELINE.md configs (fp32, nb in the reference's 256-512 range or the
+vendor-dispatch default):
 
-Timing: the factorization is run iters+1 times *chained inside one jit*
-(each iteration's input depends on the previous result, so XLA cannot
-collapse the chain) and the wall time is divided by iters+1.  This
-measures on-device time the way the reference's MPI-barrier wall clock
-does (``test/test_gemm.cc:224-245``) and amortizes the host↔device
-round-trip latency of the tunnel (~100 ms, which would otherwise swamp a
-~25 ms factorization) down to a few percent of the total.
+* gemm  n=8192                      (config 1 scaled to the chip)
+* potrf n=8192                      (config 2)
+* getrf n=8192, nb=512              (config 3, single chip)
+* geqrf m=32768 n=4096              (config 4)
 
-The metric only prints after the factorization passes the reference's
-scaled-residual gate (≤ 3, ``test/test_gemm.cc:260``); a broken factor
-exits nonzero instead of publishing a number.
+``vs_baseline`` compares against the reference's only in-repo per-device
+throughput anchor, 702 GFLOP/s/GPU (``/root/reference/docs/usage.md:36-44``).
+The headline value is the geometric mean of the four routines; the
+``submetrics`` key carries each routine's GFLOP/s and its fraction of the
+measured gemm rate (the chip's practical fp32 peak).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Timing: each routine is run iters times *chained inside one jit* (each
+iteration's input depends on the previous result, so XLA cannot collapse
+the chain) and the wall time is divided by iters.  This measures on-device
+time the way the reference's MPI-barrier wall clock does
+(``test/test_gemm.cc:224-245``) and amortizes the host↔device round-trip
+latency of the tunnel (~100 ms) to a few percent.
+
+Every number only prints after the routine passes a scaled-residual gate
+(≤ 3 in units of eps·n, the reference's criterion ``test/test_gemm.cc:260``),
+checked with O(n²) matrix-vector probes so the gate itself stays cheap.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
@@ -31,65 +39,160 @@ import numpy as np
 BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
 
 
+def _timeit(fn, args, iters):
+    float(fn(*args))  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return min(times) / iters
+
+
 def main():
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from slate_tpu.ops import blocks
+    from slate_tpu.linalg.lu import getrf_rec
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    n = 8192 if on_tpu else 1024
-    nb = 4096 if on_tpu else 128
-    iters = 32 if on_tpu else 2
-    dtype = jnp.float32
-
+    scale = 1 if on_tpu else 8
+    eps = float(np.finfo(np.float32).eps)
     rng = np.random.default_rng(0)
-    g = rng.standard_normal((n, n)).astype(np.float32)
-    anp = g @ g.T + n * np.eye(n, dtype=np.float32)
-    a = jnp.asarray(anp, dtype)
+    sub = {}
+    fails = []
 
-    def chained(a):
+    def gate(name, resid):
+        if resid > 3.0:
+            fails.append(f"{name}: scaled_resid={resid:.3e} > 3")
+
+    def mv(mat, x):
+        return mat @ x
+
+    # ---- gemm --------------------------------------------------------
+    n = 8192 // scale
+    iters = 8 if on_tpu else 2
+    a_np = rng.standard_normal((n, n)).astype(np.float32)
+    b_np = rng.standard_normal((n, n)).astype(np.float32)
+    a = jnp.asarray(a_np)
+    b = jnp.asarray(b_np)
+
+    @jax.jit
+    def gemm_chain(a, b):
         def body(i, x):
-            l = blocks.potrf_rec(x, nb)
-            # tie the next iteration to this result (prevents hoisting)
-            # without changing the factored matrix beyond rounding
-            return a + l[-1, -1] * jnp.float32(1e-30)
-        out = lax.fori_loop(0, iters, body, a)
-        # reduce to one scalar: the host float() below is the sync point
-        # (works even where block_until_ready only waits for enqueue)
-        return blocks.potrf_rec(out, nb)[-1, -1]
+            return (x @ b) * jnp.float32(1e-4)
+        return lax.fori_loop(0, iters, body, a)[0, 0]
 
-    step = jax.jit(chained)
-    float(step(a))  # compile + warm up
+    t = _timeit(gemm_chain, (a, b), iters)
+    gemm_gf = 2.0 * n ** 3 / t / 1e9
+    c_np = np.asarray(jax.jit(jnp.matmul)(a, b))
+    x = rng.standard_normal((n,)).astype(np.float32)
+    resid = (np.linalg.norm(mv(c_np, x) - mv(a_np, mv(b_np, x)))
+             / (np.linalg.norm(a_np) * np.linalg.norm(mv(b_np, x))
+                * eps * n))
+    gate("gemm", resid)
+    sub["gemm_fp32_n%d" % n] = round(gemm_gf, 1)
 
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        float(step(a))
-        times.append(time.perf_counter() - t0)
-    t = min(times) / (iters + 1)
+    # ---- potrf -------------------------------------------------------
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    spd_np = g @ g.T + n * np.eye(n, dtype=np.float32)
+    spd = jnp.asarray(spd_np)
 
-    # correctness gate on a single factorization (reference ≤ 3ε criterion)
-    l = np.asarray(jax.jit(lambda a: blocks.potrf_rec(a, nb))(a))
-    resid = (np.linalg.norm(np.tril(l) @ np.tril(l).T - anp)
-             / (np.linalg.norm(anp) * np.finfo(np.float32).eps * n))
+    @jax.jit
+    def potrf_chain(spd):
+        def body(i, x):
+            l = jnp.tril(lax.linalg.cholesky(x))
+            return spd + l[-1, -1] * jnp.float32(1e-30)
+        out = lax.fori_loop(0, iters, body, spd)
+        return jnp.tril(lax.linalg.cholesky(out))[-1, -1]
 
-    if resid > 3.0:
-        print(f"# FAILED residual gate: scaled_resid={resid:.3e} > 3",
-              file=sys.stderr)
+    t = _timeit(potrf_chain, (spd,), iters + 1)
+    potrf_gf = n ** 3 / 3.0 / t / 1e9
+    l_np = np.asarray(jax.jit(
+        lambda a: jnp.tril(lax.linalg.cholesky(a)))(spd))
+    resid = (np.linalg.norm(mv(l_np, mv(l_np.T, x)) - mv(spd_np, x))
+             / (np.linalg.norm(spd_np) * np.linalg.norm(x) * eps * n))
+    gate("potrf", resid)
+    sub["potrf_fp32_n%d" % n] = round(potrf_gf, 1)
+
+    # ---- getrf (partial-pivot LU, nb=512) ----------------------------
+    nb_lu = 512 // scale
+    am_np = (rng.standard_normal((n, n)).astype(np.float32)
+             + n * np.eye(n, dtype=np.float32))
+    am = jnp.asarray(am_np)
+    lu_iters = 4 if on_tpu else 2
+
+    @jax.jit
+    def getrf_chain(am):
+        def body(i, x):
+            lu, piv = getrf_rec(x, nb_lu)
+            return am + lu[-1, -1] * jnp.float32(1e-30)
+        out = lax.fori_loop(0, lu_iters - 1, body, am)
+        return getrf_rec(out, nb_lu)[0][-1, -1]
+
+    t = _timeit(getrf_chain, (am,), lu_iters)
+    getrf_gf = 2.0 * n ** 3 / 3.0 / t / 1e9
+    lu_np, perm_np = map(np.asarray,
+                         jax.jit(lambda a: getrf_rec(a, nb_lu))(am))
+    l_f = np.tril(lu_np, -1) + np.eye(n, dtype=np.float32)
+    u_f = np.triu(lu_np)
+    resid = (np.linalg.norm(mv(l_f, mv(u_f, x)) - mv(am_np[perm_np], x))
+             / (np.linalg.norm(am_np) * np.linalg.norm(x) * eps * n))
+    gate("getrf", resid)
+    sub["getrf_fp32_n%d_nb%d" % (n, nb_lu)] = round(getrf_gf, 1)
+
+    # ---- geqrf (tall QR, vendor dispatch) ----------------------------
+    m2, n2 = 32768 // scale, 4096 // scale
+    tall_np = rng.standard_normal((m2, n2)).astype(np.float32)
+    tall = jnp.asarray(tall_np)
+    qr_iters = 4 if on_tpu else 2
+
+    def geqrf_raw(x):
+        h, tau = jnp.linalg.qr(x, mode="raw")
+        return jnp.swapaxes(h, -1, -2), tau
+
+    @jax.jit
+    def geqrf_chain(tall):
+        def body(i, x):
+            f2, taus = geqrf_raw(x)
+            return tall + f2[-1, -1] * jnp.float32(1e-30)
+        out = lax.fori_loop(0, qr_iters - 1, body, tall)
+        return geqrf_raw(out)[0][-1, -1]
+
+    t = _timeit(geqrf_chain, (tall,), qr_iters)
+    qr_flops = 2.0 * m2 * n2 ** 2 - 2.0 * n2 ** 3 / 3.0
+    geqrf_gf = qr_flops / t / 1e9
+    r_np = np.triu(np.asarray(jax.jit(geqrf_raw)(tall)[0])[:n2])
+    x2 = rng.standard_normal((n2,)).astype(np.float32)
+    # Gram identity AᵀA = RᵀR probed with a vector
+    resid = (np.linalg.norm(mv(tall_np.T, mv(tall_np, x2))
+                            - mv(r_np.T, mv(r_np, x2)))
+             / (np.linalg.norm(tall_np) ** 2 * np.linalg.norm(x2)
+                * eps * np.sqrt(m2)))
+    gate("geqrf", resid)
+    sub["geqrf_fp32_m%d_n%d" % (m2, n2)] = round(geqrf_gf, 1)
+
+    if fails:
+        for f in fails:
+            print(f"# FAILED residual gate: {f}", file=sys.stderr)
         sys.exit(1)
 
-    flops = n ** 3 / 3.0  # LAPACK model count for potrf
-    gflops = flops / t / 1e9
+    vals = [gemm_gf, potrf_gf, getrf_gf, geqrf_gf]
+    geomean = float(np.exp(np.mean(np.log(vals))))
+    peak = {k: round(v / sub["gemm_fp32_n%d" % n], 3)
+            for k, v in sub.items()}
     print(json.dumps({
-        "metric": f"potrf_fp32_n{n}_gflops",
-        "value": round(gflops, 2),
+        "metric": "factor_suite_fp32_geomean",
+        "value": round(geomean, 1),
         "unit": "GFLOP/s",
-        "vs_baseline": round(gflops / BASELINE_GFLOPS, 4),
+        "vs_baseline": round(geomean / BASELINE_GFLOPS, 2),
+        "submetrics": sub,
+        "fraction_of_measured_gemm": peak,
     }))
-    print(f"# t={t:.4f}s n={n} nb={nb} iters={iters} scaled_resid={resid:.3e}"
-          f" platform={jax.devices()[0].platform}", file=sys.stderr)
+    print(f"# platform={jax.devices()[0].platform} "
+          f"all residual gates passed", file=sys.stderr)
 
 
 if __name__ == "__main__":

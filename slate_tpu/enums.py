@@ -134,6 +134,7 @@ class Option(enum.Enum):
     # Method selectors, reference method.hh
     MethodCholQR = "method_cholqr"
     MethodEig = "method_eig"
+    MethodFactor = "method_factor"
     MethodGels = "method_gels"
     MethodGemm = "method_gemm"
     MethodHemm = "method_hemm"

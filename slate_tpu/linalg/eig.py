@@ -196,12 +196,25 @@ def _phase_tridiag(e_c, n, dt):
     return phase
 
 
-def _hb2st_native(a: np.ndarray, kd: int, want_rots: bool = True):
-    """Compiled stage 2: the same rotation schedule as the Python loop
-    below, run by the native runtime on O(n·kd) band storage
-    (``native/runtime.cc`` ``slate_hb2st_*``)."""
+def _hb2st_ab(ab: np.ndarray, kd_eff: int, want_rots: bool = True):
+    """Compiled stage 2 core on prepared lower-band storage
+    ``ab[(n, kd_eff+2)]`` (modified in place) — O(n·kd) end to end."""
 
     from .. import native
+
+    n = ab.shape[0]
+    planes, cs, ss = native.hb2st_banded(ab, n, kd_eff, want_rots)
+    d = np.real(ab[:, 0]).copy()
+    e_c = ab[:n - 1, 1].copy()
+    phase = _phase_tridiag(e_c, n, ab.dtype)
+    e = np.real(e_c)
+    return d, e, Hb2stRotations(planes=planes, cs=cs, ss=ss, phase=phase,
+                                kd=kd_eff)
+
+
+def _hb2st_native(a: np.ndarray, kd: int, want_rots: bool = True):
+    """Compiled stage 2 from a dense band matrix: pack the band storage
+    and run :func:`_hb2st_ab` (``native/runtime.cc`` ``slate_hb2st_*``)."""
 
     n = a.shape[0]
     dt = np.complex128 if np.iscomplexobj(a) else np.float64
@@ -209,13 +222,7 @@ def _hb2st_native(a: np.ndarray, kd: int, want_rots: bool = True):
     ab = np.zeros((n, kd_eff + 2), dtype=dt, order="C")
     for dd in range(kd_eff + 1):
         ab[:n - dd, dd] = np.diagonal(a, -dd)
-    planes, cs, ss = native.hb2st_banded(ab, n, kd_eff, want_rots)
-    d = np.real(ab[:, 0]).copy()
-    e_c = ab[:n - 1, 1].copy()
-    phase = _phase_tridiag(e_c, n, dt)
-    e = np.real(e_c)
-    return d, e, Hb2stRotations(planes=planes, cs=cs, ss=ss, phase=phase,
-                                kd=kd_eff)
+    return _hb2st_ab(ab, kd_eff, want_rots)
 
 
 def hb2st(band, kd: int, want_rots: bool = True
@@ -399,6 +406,12 @@ def _band_eig(band_np, kd: int, jobz: bool, method, auto: bool):
         w, z_band = eig_banded(bands, lower=True)
         return np.real(w), z_band
     d, e, rots = hb2st(band_np, kd, want_rots=jobz)
+    return _stage3_eig(d, e, rots, jobz, method, auto)
+
+
+def _stage3_eig(d, e, rots, jobz, method, auto):
+    """Tridiagonal solve + bulge-chase back-transform (stage 3)."""
+
     if not jobz:
         if method in (MethodEig.QR, MethodEig.Bisection):
             w = sterf(d, e)
@@ -414,6 +427,27 @@ def _band_eig(band_np, kd: int, jobz: bool, method, auto: bool):
         w, z_tri = _EIG_DRIVERS[method](d, e)
     z_band = unmtr_hb2st(rots, z_tri)
     return np.asarray(w), z_band
+
+
+def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
+    """Stage 2+3 from O(n·kd) band storage directly (the distributed
+    drivers\' path — no dense n×n host operand is ever built when the
+    compiled stage 2 is available)."""
+
+    from .. import native
+
+    n = ab.shape[0]
+    if not (native.available() and n > 2 and kd_eff >= 2):
+        # fallback (no toolchain / tiny n): reconstruct the dense band —
+        # this path only runs where the dense operand is small
+        dense = np.zeros((n, n), dtype=ab.dtype)
+        idx = np.arange(n)
+        for dd in range(min(kd_eff, n - 1) + 1):
+            dense[idx[:n - dd] + dd, idx[:n - dd]] = ab[:n - dd, dd]
+        dense = dense + np.tril(dense, -1).conj().T
+        return _band_eig(dense, kd_eff, jobz, method, auto)
+    d, e, rots = _hb2st_ab(ab, kd_eff, want_rots=jobz)
+    return _stage3_eig(d, e, rots, jobz, method, auto)
 
 
 def heev(a, jobz: bool = True, opts: Optional[Options] = None):

@@ -177,12 +177,27 @@ def _phase_bidiag(d_c, e_c, n, dt):
     return uphase, vphase
 
 
-def _tb2bd_native(b: np.ndarray, kd: int, want_rots: bool = True):
-    """Compiled stage 2: the same rotation schedule as the Python loop
-    below, run by the native runtime on O(n·kd) band storage
-    (``native/runtime.cc`` ``slate_tb2bd_*``)."""
+def _tb2bd_ab(ab: np.ndarray, kd_eff: int, want_rots: bool = True):
+    """Compiled stage 2 core on prepared upper-band storage
+    ``ab[(n, kd_eff+3)]`` (modified in place) — O(n·kd) end to end."""
 
     from .. import native
+
+    n = ab.shape[0]
+    lrot, rrot = native.tb2bd_banded(ab, n, kd_eff, want_rots)
+    d_c = ab[:, 1].copy()
+    e_c = ab[1:, 2].copy()
+    uphase, vphase = _phase_bidiag(d_c, e_c, n, ab.dtype)
+    rots = Tb2bdRotations(
+        lplanes=lrot[0], lcs=lrot[1], lss=lrot[2],
+        rplanes=rrot[0], rcs=rrot[1], rss=rrot[2],
+        uphase=uphase, vphase=vphase, kd=kd_eff)
+    return np.real(d_c), np.real(e_c), rots
+
+
+def _tb2bd_native(b: np.ndarray, kd: int, want_rots: bool = True):
+    """Compiled stage 2 from a dense band matrix: pack the band storage
+    and run :func:`_tb2bd_ab` (``native/runtime.cc`` ``slate_tb2bd_*``)."""
 
     n = b.shape[0]
     dt = np.complex128 if np.iscomplexobj(b) else np.float64
@@ -190,15 +205,7 @@ def _tb2bd_native(b: np.ndarray, kd: int, want_rots: bool = True):
     ab = np.zeros((n, kd_eff + 3), dtype=dt, order="C")
     for dd in range(kd_eff + 1):
         ab[dd:, dd + 1] = np.diagonal(b, dd)
-    lrot, rrot = native.tb2bd_banded(ab, n, kd_eff, want_rots)
-    d_c = ab[:, 1].copy()
-    e_c = ab[1:, 2].copy()
-    uphase, vphase = _phase_bidiag(d_c, e_c, n, dt)
-    rots = Tb2bdRotations(
-        lplanes=lrot[0], lcs=lrot[1], lss=lrot[2],
-        rplanes=rrot[0], rcs=rrot[1], rss=rrot[2],
-        uphase=uphase, vphase=vphase, kd=kd_eff)
-    return np.real(d_c), np.real(e_c), rots
+    return _tb2bd_ab(ab, kd_eff, want_rots)
 
 
 def tb2bd(band, kd: int, want_rots: bool = True
@@ -372,6 +379,16 @@ def _band_svd(band_sq, kd: int, want_u: bool, want_vt: bool, method,
         u_b, s, vh_b = np.linalg.svd(band_sq, full_matrices=False)
         return s, (u_b if want_u else None), (vh_b if want_vt else None)
     d, e, rots = tb2bd(band_sq, kd, want_rots=want_uv)
+    return _stage3_svd(d, e, rots, want_u, want_vt, method, auto)
+
+
+def _stage3_svd(d, e, rots, want_u, want_vt, method, auto):
+    """Bidiagonal SVD + chase back-transforms (stage 3)."""
+
+    from .. import native
+
+    n = d.shape[0]
+    want_uv = want_u or want_vt
     if not want_uv:
         return bdsqr(d, e).copy(), None, None
     if auto and native.available() and n > 1:
@@ -387,6 +404,24 @@ def _band_svd(band_sq, kd: int, want_u: bool, want_vt: bool, method,
     if want_vt:
         vh_b = _ct(unmbr_tb2bd(Side.Right, rots, _ct(vh_bd)))
     return s, u_b, vh_b
+
+
+def _band_svd_ab(ab, kd_eff: int, want_u: bool, want_vt: bool, method,
+                 auto: bool):
+    """Stage 2+3 from O(n·kd) upper-band storage directly (the
+    distributed drivers\' path)."""
+
+    from .. import native
+
+    n = ab.shape[0]
+    if not (native.available() and n > 2 and kd_eff >= 2):
+        dense = np.zeros((n, n), dtype=ab.dtype)
+        idx = np.arange(n)
+        for dd in range(min(kd_eff, n - 1) + 1):
+            dense[idx[:n - dd], idx[:n - dd] + dd] = ab[dd:, dd + 1]
+        return _band_svd(dense, kd_eff, want_u, want_vt, method, auto)
+    d, e, rots = _tb2bd_ab(ab, kd_eff, want_rots=want_u or want_vt)
+    return _stage3_svd(d, e, rots, want_u, want_vt, method, auto)
 
 
 def svd_vals(a, opts: Optional[Options] = None):

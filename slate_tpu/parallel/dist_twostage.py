@@ -260,6 +260,57 @@ def band_tiles_to_dense(tiles, n: int, nb: int, lower: bool = True):
     return out
 
 
+def band_tiles_to_banded(tiles, n: int, nb: int, lower: bool = True):
+    """Assemble the replicated tile stack straight into O(n·kd) LAPACK
+    band storage — the stage-2 operand of
+    :func:`slate_tpu.linalg.eig._band_eig_ab` (lower Hermitian,
+    ``ab[j, d]`` = A[j+d, j], shape (n, kd+2)) or
+    :func:`slate_tpu.linalg.svd._band_svd_ab` (upper,
+    ``ab[c, (c-r)+1]`` = A[r, c], shape (n, kd+3)).  No dense n×n host
+    matrix is ever built (the reviewer-flagged alternative to
+    :func:`band_tiles_to_dense`, which remains for the no-toolchain
+    fallback and tests)."""
+
+    tiles = np.asarray(tiles)
+    dt = (np.complex128 if np.issubdtype(tiles.dtype, np.complexfloating)
+          else np.float64)
+    kd_eff = min(nb, n - 1)
+    nt = ceildiv(n, nb)
+    ab = np.zeros((n, kd_eff + (2 if lower else 3)), dtype=dt, order="C")
+    for k in range(nt):
+        j0 = k * nb
+        w = min(nb, n - j0)
+        d_t = tiles[k, 0][:w, :w]
+        s_t = tiles[k, 1]
+        if lower:
+            # diag tile: sub-diagonals dd of tril(d) → ab[j0+b, dd]
+            for dd in range(min(w, kd_eff + 1)):
+                ab[j0:j0 + w - dd, dd] = np.diagonal(d_t, -dd)
+            # sub tile triu part: A[(k+1)nb+a, j0+b], a <= b
+            r0 = j0 + nb
+            if r0 < n:
+                h = min(nb, n - r0)
+                for dd2 in range(w):
+                    dlen = min(w - dd2, h)
+                    if dlen <= 0 or nb - dd2 > kd_eff:
+                        continue
+                    ab[j0 + dd2:j0 + dd2 + dlen, nb - dd2] = \
+                        np.diagonal(s_t[:h, :w], dd2)[:dlen]
+        else:
+            for dd in range(min(w, kd_eff + 1)):
+                ab[j0 + dd:j0 + w, dd + 1] = np.diagonal(d_t, dd)
+            c0 = j0 + nb
+            if c0 < n:
+                h = min(nb, n - c0)
+                for dd2 in range(w):
+                    dlen = min(w - dd2, h)
+                    if dlen <= 0 or nb - dd2 > kd_eff + 1:
+                        continue
+                    ab[c0:c0 + dlen, nb - dd2 + 1] = \
+                        np.diagonal(s_t[:w, :h], -dd2)[:dlen]
+    return ab
+
+
 @lru_cache(maxsize=None)
 def _build_papply_q(mesh, nb: int, npanels: int, shift_blocks: int,
                     ml: int, forward: bool, dtype_name: str):
@@ -541,7 +592,6 @@ def pheev(a, mesh=None, nb: int = 256, jobz: bool = True, opts=None):
     already-distributed DistMatrix.
     """
 
-    from ..linalg.eig import _band_eig
     from ..enums import MethodEig
     from ..options import get_option
 
@@ -555,12 +605,14 @@ def pheev(a, mesh=None, nb: int = 256, jobz: bool = True, opts=None):
         ad = distribute(av, mesh, nb, row_mult=q, col_mult=p)
     n = ad.n
     fac, tmats, band_tiles = phe2hb(ad)
-    band = band_tiles_to_dense(band_tiles, n, nb, lower=True)
     method = get_option(opts, "method_eig", MethodEig.Auto)
     auto = method is MethodEig.Auto
     if auto:
         method = MethodEig.DC
-    w, z_band = _band_eig(band, min(nb, n - 1), jobz, method, auto)
+    # stage 2 operand stays O(n·nb): tiles → band storage directly
+    from ..linalg.eig import _band_eig_ab
+    ab = band_tiles_to_banded(band_tiles, n, nb, lower=True)
+    w, z_band = _band_eig_ab(ab, min(nb, n - 1), jobz, method, auto)
     if not jobz:
         return jnp.asarray(w), None
     p, q = mesh_grid_shape(mesh)
@@ -582,7 +634,6 @@ def psvd(a, mesh=None, nb: int = 256, jobu: bool = True, jobvt: bool = True,
     Requires m ≥ n (transpose on the host for wide problems).
     """
 
-    from ..linalg.svd import _band_svd
     from ..enums import MethodSVD
     from ..options import get_option
 
@@ -598,11 +649,12 @@ def psvd(a, mesh=None, nb: int = 256, jobu: bool = True, jobvt: bool = True,
     if m < n:
         raise ValueError("psvd requires m >= n (transpose the input)")
     fac, qtmats, ptmats, band_tiles = pge2tb(ad)
-    band = band_tiles_to_dense(band_tiles, n, nb, lower=False)
     method = get_option(opts, "method_svd", MethodSVD.Auto)
     auto = method is MethodSVD.Auto
-    s, u_b, vh_b = _band_svd(band, min(nb, max(n - 1, 1)), jobu, jobvt,
-                             method, auto)
+    from ..linalg.svd import _band_svd_ab
+    ab = band_tiles_to_banded(band_tiles, n, nb, lower=False)
+    s, u_b, vh_b = _band_svd_ab(ab, min(nb, max(n - 1, 1)), jobu, jobvt,
+                                method, auto)
     p, q = mesh_grid_shape(mesh)
     u = v = None
     if jobu:

@@ -65,3 +65,26 @@ def test_geadd_scale_rc(rng):
     np.testing.assert_allclose(
         np.asarray(w),
         np.asarray(r)[:, None] * np.asarray(x) * np.asarray(c)[None, :])
+
+
+@pytest.mark.parametrize("nb", [128, 256])
+def test_chol_inv_panel(nb):
+    """Fused Cholesky+inverse panel kernel (interpret mode on CPU)."""
+    from slate_tpu.ops.pallas_kernels import chol_inv_panel
+    rng = np.random.default_rng(3)
+    g = rng.standard_normal((nb, nb)).astype(np.float32)
+    spd = g @ g.T + nb * np.eye(nb, dtype=np.float32)
+    l, linv = map(np.asarray, chol_inv_panel(jnp.asarray(spd)))
+    assert np.allclose(np.triu(l, 1), 0) and np.allclose(np.triu(linv, 1), 0)
+    assert np.linalg.norm(l @ l.T - spd) / np.linalg.norm(spd) < 1e-5
+    assert np.linalg.norm(l @ linv - np.eye(nb)) < 1e-4
+
+
+def test_trtri_panel():
+    from slate_tpu.ops.pallas_kernels import trtri_panel
+    rng = np.random.default_rng(5)
+    nb = 256
+    l = np.tril(rng.standard_normal((nb, nb))).astype(np.float32)
+    l += nb * np.eye(nb, dtype=np.float32)
+    linv = np.asarray(trtri_panel(jnp.asarray(l)))
+    assert np.linalg.norm(l @ linv - np.eye(nb)) < 1e-4
