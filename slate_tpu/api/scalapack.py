@@ -26,7 +26,8 @@ from .. import native
 
 __all__ = ["BlacsGrid", "Desc", "pgemm", "ppotrf", "ppotrs", "pposv",
            "pgesv", "pgetrf", "pgeqrf", "pgels", "psyev", "pheev",
-           "plange", "to_local", "from_local"]
+           "plange", "to_local", "from_local", "dist_from_locals",
+           "locals_from_dist"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,13 +74,148 @@ def _scatter(arr, grid, desc):
     return to_local(np.asarray(arr), grid, desc)
 
 
+# ---------------------------------------------------------------------------
+# In-place distributed path: a ScaLAPACK local array IS a DistMatrix
+# shard.  Rank (pr,pc)'s block-cyclic local layout (tiles (i,j) with
+# i%p==pr, j%q==pc in local order) equals device (pr,pc)'s slice of the
+# cyclic-shuffled padded global that DistMatrix stores — so the p?
+# routines can run distributed without ever materializing the global
+# array, exactly like the reference's zero-copy ``fromScaLAPACK`` wrap
+# (``scalapack_api/scalapack_potrf.cc:27-80``).
+# ---------------------------------------------------------------------------
+
+def _mesh_matches(mesh, grid: BlacsGrid) -> bool:
+    if mesh is None:
+        return False
+    from ..parallel.mesh import mesh_grid_shape
+    return mesh_grid_shape(mesh) == (grid.p, grid.q)
+
+
+def dist_from_locals(lg: LocalGrid, grid: BlacsGrid, desc: Desc, mesh,
+                     diag_pad: float = 0.0):
+    """Assemble per-rank locals directly into a sharded DistMatrix (each
+    device's shard is built from its own local array; no global
+    operand)."""
+
+    import math
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..grid import ceildiv
+    from ..parallel.dist import DistMatrix
+    from ..parallel.mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+    p, q = mesh_grid_shape(mesh)
+    if (p, q) != (grid.p, grid.q):
+        raise ValueError(f"mesh {p}x{q} does not match grid "
+                         f"{grid.p}x{grid.q}")
+    if desc.mb != desc.nb:
+        raise ValueError("the distributed path needs square tiles "
+                         "(mb == nb)")
+    nb, m, n = desc.nb, desc.m, desc.n
+    lcm = math.lcm(p, q)
+    mtp = ceildiv(ceildiv(m, nb), lcm) * lcm
+    ntp = ceildiv(ceildiv(n, nb), lcm) * lcm
+    mlb, nlb = mtp // p, ntp // q
+    shard_shape = (mlb * nb, nlb * nb)
+    dt = np.asarray(lg[0][0]).dtype
+
+    def make_local(r, c):
+        buf = np.zeros(shard_shape, dtype=dt)
+        loc = np.asarray(lg[r][c])
+        buf[:loc.shape[0], :loc.shape[1]] = loc
+        if diag_pad != 0.0:
+            kmax = min(mtp * nb - m, ntp * nb - n)
+            for i in range(kmax):
+                gr, gc = m + i, n + i
+                rt, ct = gr // nb, gc // nb
+                if rt % p == r and ct % q == c:
+                    buf[(rt // p) * nb + gr % nb,
+                        (ct // q) * nb + gc % nb] = diag_pad
+        return buf
+
+    sharding = NamedSharding(mesh, P(AXIS_P, AXIS_Q))
+
+    def cb(index):
+        r = (index[0].start or 0) // shard_shape[0]
+        c = (index[1].start or 0) // shard_shape[1]
+        return make_local(r, c)
+
+    data = jax.make_array_from_callback((mtp * nb, ntp * nb), sharding, cb)
+    return DistMatrix(data, m, n, nb, mesh)
+
+
+def locals_from_dist(dm, grid: BlacsGrid, desc: Desc) -> LocalGrid:
+    """Read the per-device shards back as ScaLAPACK locals (no global
+    gather)."""
+
+    p, q = grid.p, grid.q
+    mshard = (dm.mtp // p) * dm.nb
+    nshard = (dm.ntp // q) * dm.nb
+    out: LocalGrid = [[None] * q for _ in range(p)]
+    for sh in dm.data.addressable_shards:
+        r = (sh.index[0].start or 0) // mshard
+        c = (sh.index[1].start or 0) // nshard
+        ml = native.numroc(desc.m, desc.mb, r, p)
+        nl = native.numroc(desc.n, desc.nb, c, q)
+        out[r][c] = np.asarray(sh.data)[:ml, :nl]
+    return out
+
+
+def _diag_pad_data(dm, value: float):
+    """Sharded pad-diagonal correction for an assembled DistMatrix: ones
+    on the padded part of the diagonal (keeps padded factorizations
+    nonsingular without a host-side global)."""
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+    p, q = mesh_grid_shape(dm.mesh)
+    nb, m, n = dm.nb, dm.m, dm.n
+    mlb, nlb = dm.mtp // p, dm.ntp // q
+
+    def kernel():
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        lrows = jnp.arange(mlb * nb)
+        lcols = jnp.arange(nlb * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+        pad = ((grows[:, None] - m) == (gcols[None, :] - n)) & \
+            (grows[:, None] >= m) & (gcols[None, :] >= n)
+        return jnp.asarray(value, dm.dtype) * pad.astype(dm.dtype)
+
+    fn = shard_map(kernel, mesh=dm.mesh, in_specs=(),
+                   out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)()
+
+
 def pgemm(transa: str, transb: str, alpha, a_lg, desca, b_lg, descb,
           beta, c_lg, descc, grid: BlacsGrid,
           mesh=None) -> LocalGrid:
     """p?gemm — reference ``scalapack_api/scalapack_gemm.cc``.  When a
-    ``mesh`` is given the multiply runs as the distributed SUMMA
-    (``slate_tpu.parallel.dist_blas3.pgemm``); otherwise single-chip."""
+    matching ``mesh`` is given and no transpose is requested the multiply
+    runs as the distributed SUMMA straight from the locals
+    (``slate_tpu.parallel.dist_blas3.pgemm``, zero global gather);
+    otherwise the operands are gathered to one chip."""
 
+    notrans = transa.upper() == "N" and transb.upper() == "N"
+    # SUMMA needs matching tiles and one consistent K tile count —
+    # decidable from the descriptors alone, before any device transfer
+    if _mesh_matches(mesh, grid) and notrans \
+            and desca.nb == descb.mb == descb.nb == descc.nb:
+        from ..parallel.dist_blas3 import pgemm as dpgemm
+        ad = dist_from_locals(a_lg, grid, desca, mesh)
+        bd = dist_from_locals(b_lg, grid, descb, mesh)
+        cd = dist_from_locals(c_lg, grid, descc, mesh)
+        out = dpgemm(alpha, ad, bd, beta, cd)
+        return locals_from_dist(out, grid, descc)
     av = _gather(a_lg, grid, desca)
     bv = _gather(b_lg, grid, descb)
     cv = _gather(c_lg, grid, descc)
@@ -97,47 +233,101 @@ def pgemm(transa: str, transb: str, alpha, a_lg, desca, b_lg, descb,
     return _scatter(out, grid, descc)
 
 
-def ppotrf(uplo: str, a_lg, desc, grid: BlacsGrid) -> LocalGrid:
-    """p?potrf — reference ``scalapack_api/scalapack_potrf.cc``."""
+def ppotrf(uplo: str, a_lg, desc, grid: BlacsGrid,
+           mesh=None) -> LocalGrid:
+    """p?potrf — reference ``scalapack_api/scalapack_potrf.cc``.  With a
+    matching ``mesh`` the factorization runs distributed straight from
+    the locals (zero global gather, like ``fromScaLAPACK``)."""
     u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        from ..parallel.dist import like as _dlike
+        from ..parallel.dist_util import phermitize, ptranspose
+        import jax.numpy as _jnp
+        ad = dist_from_locals(a_lg, grid, desc, mesh)
+        full = phermitize(ad, u)
+        full = _dlike(full, full.data + _diag_pad_data(full, 1.0))
+        lfac = par.ppotrf(full)
+        if u is Uplo.Upper:   # return U = Lᴴ in the upper triangle
+            lfac = ptranspose(lfac, conj=True)
+        return locals_from_dist(lfac, grid, desc)
     h = HermitianMatrix(_gather(a_lg, grid, desc), uplo=u, nb=desc.nb)
     fac = L.potrf(h)
     return _scatter(fac.data, grid, desc)
 
 
-def ppotrs(uplo: str, fac_lg, desca, b_lg, descb,
-           grid: BlacsGrid) -> LocalGrid:
+def ppotrs(uplo: str, fac_lg, desca, b_lg, descb, grid: BlacsGrid,
+           mesh=None) -> LocalGrid:
     u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        from ..parallel.dist import like as _dlike
+        from ..parallel.dist_util import ptranspose
+        fd = dist_from_locals(fac_lg, grid, desca, mesh)
+        if u is Uplo.Upper:   # stored U with A = UᴴU → lower L = Uᴴ
+            fd = ptranspose(fd, conj=True)
+        fd = _dlike(fd, fd.data + _diag_pad_data(fd, 1.0))
+        bd = dist_from_locals(b_lg, grid, descb, mesh)
+        return locals_from_dist(par.ppotrs(fd, bd), grid, descb)
     t = TriangularMatrix(_gather(fac_lg, grid, desca), uplo=u,
                          diag=Diag.NonUnit, nb=desca.nb)
     x = L.potrs(t, _gather(b_lg, grid, descb))
     return _scatter(x, grid, descb)
 
 
-def pposv(uplo: str, a_lg, desca, b_lg, descb, grid: BlacsGrid):
-    fac = ppotrf(uplo, a_lg, desca, grid)
-    return fac, ppotrs(uplo, fac, desca, b_lg, descb, grid)
+def pposv(uplo: str, a_lg, desca, b_lg, descb, grid: BlacsGrid,
+          mesh=None):
+    fac = ppotrf(uplo, a_lg, desca, grid, mesh)
+    return fac, ppotrs(uplo, fac, desca, b_lg, descb, grid, mesh)
 
 
-def pgetrf(a_lg, desc, grid: BlacsGrid):
+def pgetrf(a_lg, desc, grid: BlacsGrid, mesh=None):
+    """With a mesh, returns ``(lu_locals, gperm)`` — gperm is the global
+    row-permutation vector of the distributed factor (``types.hh:64-97``
+    analog), not per-panel ipiv."""
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        ad = dist_from_locals(a_lg, grid, desc, mesh, diag_pad=1.0)
+        lu, gperm = par.pgetrf(ad)
+        return locals_from_dist(lu, grid, desc), np.asarray(gperm)
     lu, piv = L.getrf(_gather(a_lg, grid, desc), {"block_size": desc.nb})
     return _scatter(lu.data, grid, desc), np.asarray(piv)
 
 
-def pgesv(a_lg, desca, b_lg, descb, grid: BlacsGrid):
+def pgesv(a_lg, desca, b_lg, descb, grid: BlacsGrid, mesh=None):
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        ad = dist_from_locals(a_lg, grid, desca, mesh, diag_pad=1.0)
+        bd = dist_from_locals(b_lg, grid, descb, mesh)
+        _, gperm, x = par.pgesv(ad, bd, mesh, desca.nb)
+        return locals_from_dist(x, grid, descb), np.asarray(gperm)
     _, piv, x = L.gesv(_gather(a_lg, grid, desca),
                        _gather(b_lg, grid, descb),
                        {"block_size": desca.nb})
     return _scatter(x, grid, descb), np.asarray(piv)
 
 
-def pgeqrf(a_lg, desc, grid: BlacsGrid):
+def pgeqrf(a_lg, desc, grid: BlacsGrid, mesh=None):
+    """With a mesh, returns ``(qr_locals, tmats)`` — the packed
+    distributed factor plus the replicated compact-WY T blocks."""
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        ad = dist_from_locals(a_lg, grid, desc, mesh, diag_pad=1.0)
+        qr, tmats, _ = par.pgeqrf(ad)
+        return locals_from_dist(qr, grid, desc), np.asarray(tmats)
     f, taus = L.geqrf(_gather(a_lg, grid, desc), {"block_size": desc.nb})
     fd = f if isinstance(f, jnp.ndarray) else f.data
     return _scatter(fd, grid, desc), np.asarray(taus)
 
 
-def pgels(a_lg, desca, b_lg, descb, grid: BlacsGrid):
+def pgels(a_lg, desca, b_lg, descb, grid: BlacsGrid, mesh=None):
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        ad = dist_from_locals(a_lg, grid, desca, mesh, diag_pad=1.0)
+        bd = dist_from_locals(b_lg, grid, descb, mesh)
+        _, _, x = par.pgels(ad, bd, mesh, desca.nb)
+        d = Desc(desca.n, descb.n, descb.mb, descb.nb)
+        return locals_from_dist(x, grid, d)
     x = L.gels(_gather(a_lg, grid, desca), _gather(b_lg, grid, descb),
                {"block_size": desca.nb})
     xd = np.asarray(x)
@@ -146,8 +336,19 @@ def pgels(a_lg, desca, b_lg, descb, grid: BlacsGrid):
     return _scatter(xd.reshape(d.m, d.n), grid, d)
 
 
-def pheev(jobz: str, uplo: str, a_lg, desc, grid: BlacsGrid):
-    """p?syev/p?heev — reference ``scalapack_api/scalapack_heev.cc``."""
+def pheev(jobz: str, uplo: str, a_lg, desc, grid: BlacsGrid, mesh=None):
+    """p?syev/p?heev — reference ``scalapack_api/scalapack_heev.cc``.
+    With a mesh this routes to the distributed two-stage eigensolver
+    (``slate_tpu.parallel.pheev``)."""
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        from ..parallel.dist_util import phermitize
+        u0 = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+        ad = phermitize(dist_from_locals(a_lg, grid, desc, mesh), u0)
+        w, zd = par.pheev(ad, jobz=jobz.upper() == "V")
+        if zd is None:
+            return np.asarray(w), None
+        return np.asarray(w), locals_from_dist(zd, grid, desc)
     u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
     h = HermitianMatrix(_gather(a_lg, grid, desc), uplo=u, nb=desc.nb)
     w, z = L.heev(h, jobz.upper() == "V", {"block_size": desc.nb})
@@ -159,7 +360,12 @@ def pheev(jobz: str, uplo: str, a_lg, desc, grid: BlacsGrid):
 psyev = pheev
 
 
-def plange(norm_ch: str, a_lg, desc, grid: BlacsGrid) -> float:
+def plange(norm_ch: str, a_lg, desc, grid: BlacsGrid,
+           mesh=None) -> float:
     nm = {"M": Norm.Max, "1": Norm.One, "O": Norm.One, "I": Norm.Inf,
           "F": Norm.Fro}[norm_ch.upper()]
+    if _mesh_matches(mesh, grid):
+        from .. import parallel as par
+        ad = dist_from_locals(a_lg, grid, desc, mesh)
+        return float(par.pnorm(ad, nm))
     return float(L.genorm(nm, _gather(a_lg, grid, desc)))

@@ -347,20 +347,17 @@ def chol_inv_panel(a):
 
 
 def _trtri_panel_kernel(l_in_ref, inv_ref, *, nb, ib):
-    f32 = jnp.float32
-    nblk = nb // ib
-    for bi in range(nblk):
+    for bi in range(nb // ib):
         k0 = bi * ib
         inv_ref[k0:k0 + ib, k0:k0 + ib] = \
             _trtri_unblocked(l_in_ref[k0:k0 + ib, k0:k0 + ib], ib)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
-    cols = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
     _block_forward_subst(l_in_ref, inv_ref, nb, ib)
 
 
 def trtri_panel(l):
     """Inverse of an (nb, nb) f32 lower-triangular panel in one fused
-    VMEM kernel (used to turn panel trsm into gemm in the LU driver)."""
+    VMEM kernel — the companion of :func:`chol_inv_panel` for factor
+    layouts where L arrives pre-computed (config.use_pallas path)."""
 
     nb = l.shape[-1]
     ib = min(128, nb)

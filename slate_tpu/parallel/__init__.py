@@ -23,6 +23,11 @@ from .dist_aux import (  # noqa: F401
     ptrsm,
 )
 from .dist_twostage import (  # noqa: F401
-    band_tiles_to_dense, pge2tb, phe2hb, pheev, psvd, punmbr_ge2tb_p,
-    punmbr_ge2tb_q, punmtr_he2hb,
+    band_tiles_to_banded, band_tiles_to_dense, pge2tb, phe2hb, pheev,
+    psvd, punmbr_ge2tb_p, punmbr_ge2tb_q, punmtr_he2hb,
 )
+from .dist_util import peye, predistribute, ptranspose  # noqa: F401
+from .dist_lu import pgecondest, pgetri  # noqa: F401
+from .dist_qr import pgelqf, punmlq  # noqa: F401
+from .dist_aux import pcolnorms  # noqa: F401
+from .dist_band import pgbsv, ppbsv  # noqa: F401

@@ -289,21 +289,45 @@ def psymm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
 
 def ptrsm(side: Side, uplo: Uplo, op: Op, diag: Diag,
           a: DistMatrix, b: DistMatrix) -> DistMatrix:
-    """Distributed triangular solve A·X = B (reference ``slate::trsm``,
-    ``src/trsm.cc``).
+    """Distributed triangular solve op(A)·X = B (Left) or X·op(A) = B
+    (Right) — reference ``slate::trsm`` (``src/trsm.cc``; Right/trans
+    variants per ``src/work/work_trsm.cc:395``).
 
-    Supported combinations (the ones the distributed drivers need):
-    Left Lower NoTrans (unit or non-unit), Left Lower ConjTrans
-    (non-unit), Left Upper NoTrans (non-unit).
+    All side/uplo/op/diag combinations are supported: transposed
+    operators and the Right side reduce to the four native Left NoTrans
+    sweeps through :func:`~slate_tpu.parallel.dist_util.ptranspose`
+    (the distributed re-tiling XLA lowers to collectives).
     """
 
     from ..grid import ceildiv
     from .dist_factor import _build_ptrsm as _chol_trsm
     from .dist_lu import _build_plu_trsm as _lu_trsm
+    from .dist_util import ptranspose
 
     if side is not Side.Left:
-        raise NotImplementedError("ptrsm: only Side.Left is distributed; "
-                                  "transpose the equation for Right")
+        # X·op(A) = B  ⟺  op(A)ᵀ·Xᵀ = Bᵀ
+        if op is Op.NoTrans:
+            a2, op2 = ptranspose(a), Op.NoTrans
+            uplo2 = Uplo.Upper if uplo is Uplo.Lower else Uplo.Lower
+        elif op is Op.Trans:
+            a2, op2, uplo2 = a, Op.NoTrans, uplo
+        else:  # ConjTrans: op(A)ᵀ = conj(A) — same layout, local conj
+            a2 = like(a, jnp.conj(a.data))
+            op2, uplo2 = Op.NoTrans, uplo
+        xt = ptrsm(Side.Left, uplo2, op2, diag, a2, ptranspose(b))
+        return ptranspose(xt)
+    if (uplo, op, diag) == (Uplo.Lower, Op.ConjTrans, Diag.NonUnit):
+        # native backward Lᴴ sweep (the potrs second half) — no re-tiling
+        p, q = a.grid_shape
+        fn = _chol_trsm(a.mesh, a.nb, ceildiv(a.n, a.nb), a.mtp // p,
+                        a.ntp // q, (b.ntp // q) * b.nb, True,
+                        str(a.dtype))
+        return like(b, fn(a.data, b.data))
+    if op is not Op.NoTrans:
+        # op(A)·X = B with op(A) materialized once (XLA collectives)
+        a = ptranspose(a, conj=op is Op.ConjTrans)
+        uplo = Uplo.Upper if uplo is Uplo.Lower else Uplo.Lower
+        op = Op.NoTrans
     p, q = a.grid_shape
     if b.nb != a.nb or b.mtp != a.mtp:
         raise ValueError("B tiling must match A (distribute with "
@@ -311,19 +335,48 @@ def ptrsm(side: Side, uplo: Uplo, op: Op, diag: Diag,
     ml, nl = a.mtp // p, a.ntp // q
     nrhs_l = (b.ntp // q) * b.nb
     nt = ceildiv(a.n, a.nb)
-    key = (uplo, op, diag)
-    if key == (Uplo.Lower, Op.NoTrans, Diag.NonUnit):
+    if uplo is Uplo.Lower and diag is Diag.NonUnit:
         fn = _chol_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, False,
                         str(a.dtype))
-    elif key == (Uplo.Lower, Op.ConjTrans, Diag.NonUnit):
-        fn = _chol_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, True,
-                        str(a.dtype))
-    elif key == (Uplo.Lower, Op.NoTrans, Diag.Unit):
+    elif uplo is Uplo.Lower:
         fn = _lu_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, False,
                       str(a.dtype))
-    elif key == (Uplo.Upper, Op.NoTrans, Diag.NonUnit):
-        fn = _lu_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, True,
-                      str(a.dtype))
     else:
-        raise NotImplementedError(f"ptrsm combination {key}")
+        fn = _lu_trsm(a.mesh, a.nb, nt, ml, nl, nrhs_l, True,
+                      str(a.dtype), unit=diag is Diag.Unit)
     return like(b, fn(a.data, b.data))
+
+
+@lru_cache(maxsize=None)
+def _build_pcolnorms(mesh, nb: int, ml: int, nl: int, m_true: int,
+                     n_true: int):
+    p, q = mesh_grid_shape(mesh)
+    ntp = q * nl
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        lrows = jnp.arange(ml * nb)
+        lcols = jnp.arange(nl * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+        valid = ((grows[:, None] < m_true) &
+                 (gcols[None, :] < n_true))
+        mag = jnp.where(valid, jnp.abs(a_loc), 0.0)
+        colmax = lax.pmax(jnp.max(mag, axis=0), AXIS_P)
+        full = jnp.zeros((ntp * nb,), colmax.dtype).at[gcols].set(colmax)
+        return lax.psum(full, AXIS_Q)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=P())
+    return jax.jit(fn)
+
+
+def pcolnorms(a: DistMatrix):
+    """Per-column max-abs norms, replicated (n,) — reference
+    ``slate::colNorms`` (``src/colNorms.cc``): local column maxima,
+    ``pmax`` down mesh rows, disjoint scatter-sum across mesh columns."""
+
+    p, q = a.grid_shape
+    fn = _build_pcolnorms(a.mesh, a.nb, a.mtp // p, a.ntp // q, a.m, a.n)
+    return fn(a.data)[:a.n]

@@ -1,0 +1,137 @@
+"""Distributed band solvers — reference ``slate::gbsv`` / ``pbsv``
+(``src/gbsv.cc``, ``src/pbsv.cc``).
+
+Design: a bandwidth-k solve is O(n·k²) flops on O(n·k) data — at mesh
+granularity the per-panel collectives dominate that work by orders of
+magnitude, so the TPU-native shape of this solver is the same as the
+two-stage eigensolver's stage 2 (``src/heev.cc:111-113``): keep the
+operand distributed, extract the O(n·k) band tile-wise (one shard_map,
+no dense gather), run the compiled band factorization on the host, and
+scatter the solution back across the mesh.  The right-hand sides stay
+distributed throughout.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..grid import ceildiv
+from .dist import DistMatrix, distribute, like
+from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+
+@lru_cache(maxsize=None)
+def _build_tridiag_block_tiles(mesh, nb: int, ml: int, nl: int):
+    """Extract tiles (j-1,j), (j,j), (j+1,j) for every column block j as
+    a replicated (nt, 3, nb, nb) stack — covers any band with
+    max(kl, ku) ≤ nb (one shard_map, O(n·nb) data)."""
+
+    p, q = mesh_grid_shape(mesh)
+    mtp, ntp = p * ml, q * nl
+
+    def kernel(a_loc):
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        dt = a_loc.dtype
+        ab = a_loc.reshape(ml, nb, nl, nb).transpose(0, 2, 1, 3)
+        jl = jnp.arange(nl)
+        jg = jl * q + c
+        out = jnp.zeros((ntp, 3, nb, nb), dt)
+        stack = []
+        for off in (-1, 0, 1):
+            ig = jg + off
+            il = ig // p
+            own = ((ig % p) == r) & (ig >= 0) & (ig < mtp)
+            t = ab[jnp.clip(il, 0, ml - 1), jl] * own[:, None, None].astype(dt)
+            stack.append(t)
+        out = out.at[jg].set(jnp.stack(stack, axis=1))
+        # disjoint masked contributions: the double psum both sums and
+        # makes the value replicated for the P() out-spec
+        return lax.psum(lax.psum(out, AXIS_Q), AXIS_P)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
+                   out_specs=P())
+    return jax.jit(fn)
+
+
+def _extract_band(a: DistMatrix, kl: int, ku: int) -> np.ndarray:
+    """Pull the (kl, ku) band to host LAPACK band storage
+    ``ab[(kl+ku+1, n)]``: ``ab[ku + i - j, j] = A[i, j]``."""
+
+    if max(kl, ku) > a.nb:
+        raise ValueError(f"band width {max(kl, ku)} exceeds tile size "
+                         f"{a.nb}; re-tile with a larger nb")
+    p, q = a.grid_shape
+    tiles = np.asarray(_build_tridiag_block_tiles(
+        a.mesh, a.nb, a.mtp // p, a.ntp // q)(a.data))
+    n, nb = a.n, a.nb
+    nt = ceildiv(n, nb)
+    ab = np.zeros((kl + ku + 1, n), dtype=tiles.dtype)
+    for k in range(nt):
+        j0 = k * nb
+        w = min(nb, n - j0)
+        for off, which in ((-1, 0), (0, 1), (1, 2)):
+            i0 = (k + off) * nb
+            if i0 < 0 or i0 >= n:
+                continue
+            h = min(nb, n - i0)
+            t = tiles[k, which][:h, :w]
+            for d in range(-kl, ku + 1):
+                # global diagonal d (j - i = d) within this tile:
+                # local diagonal = (j0 + b) - (i0 + a) = d
+                ld = d - (j0 - i0)
+                if -h < ld < w:
+                    diag = np.diagonal(t, ld)
+                    if ld >= 0:
+                        js = np.arange(j0 + ld, j0 + ld + diag.size)
+                    else:
+                        js = np.arange(j0, j0 + diag.size)
+                    ab[ku - d, js] = diag
+    return ab
+
+
+def pgbsv(a: DistMatrix, kl: int, ku: int, b: DistMatrix) -> DistMatrix:
+    """Distributed general band solve — reference ``slate::gbsv``
+    (``src/gbsv.cc``): band extracted tile-wise, partial-pivot band LU on
+    host (scipy's LAPACK gbsv), distributed solution."""
+
+    from scipy.linalg import solve_banded
+
+    ab = _extract_band(a, kl, ku)
+    bh = np.asarray(jax.device_get(_gather_rhs(b)))
+    x = solve_banded((kl, ku), ab, bh)
+    p, q = b.grid_shape
+    xd = distribute(jnp.asarray(x, dtype=b.dtype), b.mesh, b.nb,
+                    row_mult=q)
+    return xd
+
+
+def ppbsv(a: DistMatrix, kd: int, b: DistMatrix,
+          lower: bool = True) -> DistMatrix:
+    """Distributed SPD band solve — reference ``slate::pbsv``
+    (``src/pbsv.cc``): band Cholesky on the host band (scipy pbsv),
+    distributed solution."""
+
+    from scipy.linalg import solveh_banded
+
+    # with (kl, ku) = (kd, 0) or (0, kd), _extract_band's rows are
+    # exactly scipy's lower/upper Hermitian band storage
+    hb = _extract_band(a, kd if lower else 0, 0 if lower else kd)
+    bh = np.asarray(jax.device_get(_gather_rhs(b)))
+    x = solveh_banded(hb, bh, lower=lower)
+    p, q = b.grid_shape
+    return distribute(jnp.asarray(x, dtype=b.dtype), b.mesh, b.nb,
+                      row_mult=q)
+
+
+def _gather_rhs(b: DistMatrix):
+    """Right-hand sides to host (O(n·nrhs), the small operand)."""
+    from .dist import undistribute
+    return undistribute(b)

@@ -230,8 +230,10 @@ def pposv(a, b, mesh, nb: int = 256):
     """
 
     p, q = mesh_grid_shape(mesh)
-    ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
-    bd = distribute(b, mesh, nb, row_mult=q)
+    ad = a if isinstance(a, DistMatrix) else \
+        distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    bd = b if isinstance(b, DistMatrix) else \
+        distribute(b, mesh, nb, row_mult=q)
     l = ppotrf(ad)
     x = ppotrs(l, bd)
     return l, x

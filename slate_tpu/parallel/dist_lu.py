@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
 from ..ops.blocks import matmul as _mm
-from .dist import DistMatrix, distribute, like
+from .dist import DistMatrix, distribute, like, undistribute
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
@@ -176,10 +176,14 @@ def pgetrf(a: DistMatrix):
 
 @lru_cache(maxsize=None)
 def _build_plu_trsm(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
-                    upper: bool, dtype_name: str):
-    """Forward unit-lower (L) / backward upper (U) distributed solves —
-    the two halves of getrs (reference ``src/getrs.cc``)."""
+                    upper: bool, dtype_name: str, unit=None):
+    """Forward lower / backward upper distributed solves — the two
+    halves of getrs (reference ``src/getrs.cc``).  ``unit`` overrides
+    the diagonal convention (default: lower=unit, upper=non-unit, the
+    LU-factor convention)."""
 
+    if unit is None:
+        unit = not upper
     p, q = mesh_grid_shape(mesh)
 
     def kernel(lu_loc, b_loc):
@@ -210,21 +214,28 @@ def _build_plu_trsm(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
         def rowmask(pred):
             return jnp.repeat(pred(i_idx), nb).astype(dt)[:, None]
 
+        def diag_of(k):
+            raw = get_diag(k)
+            if not upper:
+                return (jnp.tril(raw, -1) + jnp.eye(nb, dtype=dt) if unit
+                        else jnp.tril(raw))
+            return (jnp.triu(raw, 1) + jnp.eye(nb, dtype=dt) if unit
+                    else jnp.triu(raw))
+
         if not upper:
             def body(k, b_loc):
-                d = jnp.tril(get_diag(k), -1) + jnp.eye(nb, dtype=dt)
                 x = lax.linalg.triangular_solve(
-                    d, get_brow(k, b_loc), left_side=True, lower=True,
-                    unit_diagonal=True)
+                    diag_of(k), get_brow(k, b_loc), left_side=True,
+                    lower=True, unit_diagonal=unit)
                 b_loc = put_brow(k, b_loc, x)
                 lcol = get_col(k) * rowmask(lambda i: i > k)
                 return b_loc - _mm(lcol, x)
         else:
             def body(t, b_loc):
                 k = nt - 1 - t
-                d = jnp.triu(get_diag(k))
                 x = lax.linalg.triangular_solve(
-                    d, get_brow(k, b_loc), left_side=True, lower=False)
+                    diag_of(k), get_brow(k, b_loc), left_side=True,
+                    lower=False, unit_diagonal=unit)
                 b_loc = put_brow(k, b_loc, x)
                 ucol = get_col(k) * rowmask(lambda i: i < k)
                 return b_loc - _mm(ucol, x)
@@ -293,8 +304,10 @@ def pgesv(a, b, mesh, nb: int = 256):
     """
 
     p, q = mesh_grid_shape(mesh)
-    ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
-    bd = distribute(b, mesh, nb, row_mult=q)
+    ad = a if isinstance(a, DistMatrix) else \
+        distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    bd = b if isinstance(b, DistMatrix) else \
+        distribute(b, mesh, nb, row_mult=q)
     lu, gperm = pgetrf(ad)
     x = pgetrs(lu, gperm, bd)
     return lu, gperm, x
@@ -352,3 +365,71 @@ def pgesv_mixed(a, b, mesh, nb: int = 256, *, tol=None, itermax: int = 30,
         use_fallback=use_fallback,
         add=lambda x, d: like(x, x.data + d.data),
         absmax=lambda v: float(jnp.max(jnp.abs(v.data))))
+
+
+def pgetri(a: DistMatrix):
+    """Distributed matrix inverse from LU — reference ``slate::getri``
+    (``src/getri.cc``): factor, then solve A·X = I against a sharded
+    identity (:func:`~slate_tpu.parallel.dist_util.peye`; no host-side
+    global operand)."""
+
+    from .dist_util import peye
+
+    lu, gperm = pgetrf(a)
+    eye = peye(a.n, a.nb, a.mesh, dtype=a.dtype, pad_mult=a.mtp)
+    if eye.mtp != lu.mtp:
+        raise ValueError("identity padding mismatch")
+    return pgetrs(lu, gperm, eye)
+
+
+def pgecondest(lu: DistMatrix, gperm, anorm: float, iters: int = 5):
+    """1-norm reciprocal condition estimate from a distributed LU factor
+    — reference ``slate::gecondest`` (``src/gecondest.cc``): Hager/Higham
+    power iterations on ‖A⁻¹‖₁ with distributed solves (A via
+    :func:`pgetrs`; Aᴴ via the general :func:`~.dist_aux.ptrsm` sweeps).
+    """
+
+    import numpy as np
+
+    from ..enums import Diag, Op, Side, Uplo
+    from .dist_aux import ptrsm
+
+    n = lu.n
+    p, q = lu.grid_shape
+    mesh = lu.mesh
+    ginv = np.argsort(np.asarray(gperm))
+
+    def solve_a(xd):
+        return pgetrs(lu, gperm, xd)
+
+    def solve_ah(xd):
+        # Aᴴ z = x with A[gperm] = L·U:  Aᴴ = Uᴴ·Lᴴ·P, so
+        # w = U⁻ᴴ x;  v = L⁻ᴴ w;  z = Pᵀ v = v[argsort(gperm)]
+        w = ptrsm(Side.Left, Uplo.Upper, Op.ConjTrans, Diag.NonUnit,
+                  lu, xd)
+        v = ptrsm(Side.Left, Uplo.Lower, Op.ConjTrans, Diag.Unit, lu, w)
+        fn = _build_permute_rows(mesh, lu.nb, lu.mtp // p,
+                                 (v.ntp // q) * v.nb)
+        gp = jnp.zeros(lu.mtp * lu.nb, dtype=jnp.int32)
+        gp = gp.at[:n].set(jnp.asarray(ginv, dtype=jnp.int32))
+        gp = gp.at[n:].set(jnp.arange(n, lu.mtp * lu.nb, dtype=jnp.int32))
+        return like(v, fn(v.data, gp))
+
+    x = np.full((n, 1), 1.0 / n)
+    est = 0.0
+    for _ in range(max(iters, 1)):
+        xd = distribute(jnp.asarray(x, dtype=lu.dtype), mesh, lu.nb,
+                        row_mult=q)
+        y = np.asarray(undistribute(solve_a(xd)))
+        est = float(np.abs(y).sum())
+        xi = np.sign(y) + (y == 0)
+        xid = distribute(jnp.asarray(xi, dtype=lu.dtype), mesh, lu.nb,
+                         row_mult=q)
+        z = np.asarray(undistribute(solve_ah(xid)))
+        j = int(np.argmax(np.abs(z)))
+        if np.abs(z).max() <= float(np.real((z.conj() * x).sum())):
+            break
+        x = np.zeros((n, 1))
+        x[j] = 1.0
+    rcond = 0.0 if est == 0 or anorm == 0 else 1.0 / (est * float(anorm))
+    return rcond, est

@@ -219,9 +219,14 @@ def pgels(a, b, mesh, nb: int = 256):
     """
 
     p, q = mesh_grid_shape(mesh)
-    m, n = a.shape
-    ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
-    bd = distribute(b, mesh, nb, row_mult=q)
+    if isinstance(a, DistMatrix):
+        m, n = a.m, a.n
+        ad = a
+    else:
+        m, n = a.shape
+        ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    bd = b if isinstance(b, DistMatrix) else \
+        distribute(b, mesh, nb, row_mult=q)
     qr, tmats, taus = pgeqrf(ad)
     cb = punmqr_conj(qr, tmats, bd)
     nt = ceildiv(n, nb)
@@ -231,3 +236,38 @@ def pgels(a, b, mesh, nb: int = 256):
     bwd = _build_plu_trsm(mesh, nb, nt, ml, nl, nrhs_l, True, str(qr.dtype))
     x = bwd(patch(qr.data), cb.data)
     return qr, tmats, like(cb, x, m=n)
+
+
+def pgelqf(a: DistMatrix):
+    """Distributed LQ factorization — reference ``slate::gelqf``
+    (``src/gelqf.cc``): QR of Aᴴ transposed back
+    (:func:`~.dist_util.ptranspose`; the re-tiling is XLA collectives).
+    Returns ``(lq, tmats, taus)`` with L on/below the diagonal and the
+    reflectors' Vᴴ packed above (LAPACK ``gelqf`` layout)."""
+
+    from .dist_util import ptranspose
+
+    at = ptranspose(a, conj=True)
+    qr, tmats, taus = pgeqrf(at)
+    return ptranspose(qr, conj=True), tmats, taus
+
+
+def punmlq(lq: DistMatrix, tmats, b: DistMatrix,
+           adjoint: bool = False) -> DistMatrix:
+    """Apply the LQ's Q̃ (A = L·Q̃) to a matrix whose rows live in A's
+    column space: B ← Q̃·B (or Q̃ᴴ·B) — reference ``slate::unmlq``
+    (``src/unmlq.cc``)."""
+
+    from ..grid import ceildiv
+    from .dist_util import ptranspose
+
+    qr = ptranspose(lq, conj=True)   # the underlying QR(Aᴴ) factor
+    if not adjoint:
+        # Q̃ = (Q_qr)ᴴ
+        return punmqr_conj(qr, tmats, b)
+    from .dist_twostage import _build_papply_q
+    p, q = qr.grid_shape
+    npanels = ceildiv(qr.n, qr.nb)
+    fn = _build_papply_q(qr.mesh, qr.nb, npanels, 0, qr.mtp // p, True,
+                         str(qr.dtype))
+    return like(b, fn(qr.data, tmats, b.data))

@@ -1,0 +1,174 @@
+"""Distributed layout utilities: transpose, redistribution, identity.
+
+The reference moves data between layouts with tile-wise MPI sends
+(``src/redistribute.cc:20``, ``src/transpose.cc`` views); here the moves
+are expressed as whole-array permutations under ``jit`` with sharding
+constraints — XLA's SPMD partitioner inserts the collective traffic
+(all-to-all / collective-permute), which is exactly the ICI-native form
+of the reference's P2P re-tiling.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..grid import ceildiv, cyclic_permutation, inverse_permutation
+from .dist import DistMatrix, _permute_blocks, like
+from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
+
+
+def _spec(mesh):
+    return NamedSharding(mesh, P(AXIS_P, AXIS_Q))
+
+
+@lru_cache(maxsize=None)
+def _build_peye(mesh, nb: int, mlb: int, nlb: int, n_true: int, dtype_name):
+    p, q = mesh_grid_shape(mesh)
+    dt = jnp.dtype(dtype_name)
+
+    def kernel():
+        r = lax.axis_index(AXIS_P)
+        c = lax.axis_index(AXIS_Q)
+        lrows = jnp.arange(mlb * nb)
+        lcols = jnp.arange(nlb * nb)
+        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        gcols = ((lcols // nb) * q + c) * nb + lcols % nb
+        eye = (grows[:, None] == gcols[None, :]) & \
+            (grows[:, None] < n_true)
+        return eye.astype(dt)
+
+    fn = shard_map(kernel, mesh=mesh, in_specs=(), out_specs=P(AXIS_P,
+                                                               AXIS_Q))
+    return jax.jit(fn)
+
+
+def peye(n: int, nb: int, mesh, dtype=jnp.float32,
+         pad_mult: Optional[int] = None) -> DistMatrix:
+    """Sharded identity built locally on every device (no host global) —
+    for :func:`pgetri`-style solves against I."""
+
+    p, q = mesh_grid_shape(mesh)
+    mult = pad_mult or math.lcm(p, q)
+    ntp = ceildiv(ceildiv(n, nb), mult) * mult
+    mlb, nlb = ntp // p, ntp // q
+    data = _build_peye(mesh, nb, mlb, nlb, n, jnp.dtype(dtype).name)()
+    return DistMatrix(data, n, n, nb, mesh)
+
+
+def _unshuffle(data, mtp, ntp, nb, p, q):
+    a = _permute_blocks(data, inverse_permutation(cyclic_permutation(mtp, p)),
+                        0, nb)
+    return _permute_blocks(a, inverse_permutation(cyclic_permutation(ntp, q)),
+                           1, nb)
+
+
+def _shuffle(data, mtp, ntp, nb, p, q):
+    a = _permute_blocks(data, cyclic_permutation(mtp, p), 0, nb)
+    return _permute_blocks(a, cyclic_permutation(ntp, q), 1, nb)
+
+
+@lru_cache(maxsize=None)
+def _build_ptranspose(mesh, nb: int, mtp: int, ntp: int, mtp2: int,
+                      ntp2: int, conj: bool, dtype_name: str):
+    p, q = mesh_grid_shape(mesh)
+
+    def fn(data):
+        a = _unshuffle(data, mtp, ntp, nb, p, q)
+        at = jnp.conj(a.T) if conj else a.T
+        # pad the transposed tile grid so rows divide p and cols divide q
+        at = jnp.pad(at, ((0, mtp2 * nb - at.shape[0]),
+                          (0, ntp2 * nb - at.shape[1])))
+        at = _shuffle(at, mtp2, ntp2, nb, p, q)
+        return lax.with_sharding_constraint(at, _spec(mesh))
+
+    return jax.jit(fn)
+
+
+def ptranspose(dm: DistMatrix, conj: bool = False) -> DistMatrix:
+    """Distributed (conj-)transpose: returns Aᵀ (or Aᴴ) as a DistMatrix
+    on the same mesh; XLA SPMD lowers the block re-tiling to collectives
+    (reference transpose views + ``redistribute``)."""
+
+    p, q = dm.grid_shape
+    lcm = math.lcm(p, q)
+    mtp2 = ceildiv(dm.ntp, lcm) * lcm   # new row tiles = old col tiles
+    ntp2 = ceildiv(dm.mtp, lcm) * lcm
+    fn = _build_ptranspose(dm.mesh, dm.nb, dm.mtp, dm.ntp, mtp2, ntp2,
+                           conj, str(dm.dtype))
+    return DistMatrix(fn(dm.data), dm.n, dm.m, dm.nb, dm.mesh)
+
+
+def predistribute(dm: DistMatrix, nb_new: Optional[int] = None,
+                  mesh_new=None) -> DistMatrix:
+    """Re-tile a distributed matrix to a new block size and/or mesh —
+    reference ``slate::redistribute`` (``src/redistribute.cc:20``).
+
+    Same-mesh re-tiling stays on-device under one jit (XLA collectives);
+    a mesh change reshards via ``device_put`` between the two jits.
+    """
+
+    nb_new = nb_new or dm.nb
+    mesh_new = mesh_new if mesh_new is not None else dm.mesh
+    p2, q2 = mesh_grid_shape(mesh_new)
+    lcm2 = math.lcm(p2, q2)
+    mtp2 = ceildiv(ceildiv(dm.m, nb_new), lcm2) * lcm2
+    ntp2 = ceildiv(ceildiv(dm.n, nb_new), lcm2) * lcm2
+
+    stage1 = _build_redist_unpack(dm.mesh, dm.nb, dm.mtp, dm.ntp, dm.m,
+                                  dm.n, mtp2 * nb_new, ntp2 * nb_new)
+    natural = stage1(dm.data)
+    if mesh_new is not dm.mesh and mesh_new != dm.mesh:
+        natural = jax.device_put(natural, _spec(mesh_new))
+    stage2 = _build_redist_pack(mesh_new, nb_new, mtp2, ntp2)
+    return DistMatrix(stage2(natural), dm.m, dm.n, nb_new, mesh_new)
+
+
+@lru_cache(maxsize=None)
+def _build_redist_unpack(mesh, nb, mtp, ntp, m, n, mp2, np2):
+    p, q = mesh_grid_shape(mesh)
+
+    @jax.jit
+    def fn(data):
+        a = _unshuffle(data, mtp, ntp, nb, p, q)
+        a = a[:m, :n]
+        # pad to the NEW padded dims while still on the old mesh, so the
+        # cross-mesh device_put sees cleanly divisible extents
+        return jnp.pad(a, ((0, mp2 - m), (0, np2 - n)))
+
+    return fn
+
+
+@lru_cache(maxsize=None)
+def _build_redist_pack(mesh, nb, mtp, ntp):
+    p, q = mesh_grid_shape(mesh)
+
+    @jax.jit
+    def fn(a):
+        a = _shuffle(a, mtp, ntp, nb, p, q)
+        return lax.with_sharding_constraint(a, _spec(mesh))
+
+    return fn
+
+
+def phermitize(a: DistMatrix, uplo) -> DistMatrix:
+    """Fill the unreferenced triangle from the stored one: A ← tri(A) +
+    tri(A)ᴴ − diag (the ScaLAPACK single-triangle contract made full
+    Hermitian for the dense distributed kernels)."""
+
+    from ..enums import Uplo
+    from .dist_aux import ptri_mask
+
+    keep = ptri_mask(a, uplo)
+    mirror = ptranspose(keep, conj=True)
+    dmat = ptri_mask(ptri_mask(keep, Uplo.Lower), Uplo.Upper)
+    full = keep.data + mirror.data - jnp.conj(dmat.data)
+    return like(a, full)

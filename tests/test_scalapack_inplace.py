@@ -1,0 +1,199 @@
+"""In-place ScaLAPACK path: p? routines run distributed straight from
+per-rank locals — the global array is NEVER materialized (reference
+``scalapack_api/scalapack_potrf.cc:27-80`` zero-copy ``fromScaLAPACK``).
+
+The no-gather property is asserted by poisoning ``from_local`` for the
+duration of each mesh-path call.
+"""
+
+import contextlib
+
+import jax
+import numpy as np
+import pytest
+
+from slate_tpu.api import scalapack as sc
+from slate_tpu.parallel import make_grid_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    return make_grid_mesh(2, 4)
+
+
+GRID = sc.BlacsGrid(2, 4)
+
+
+@contextlib.contextmanager
+def no_gather(monkeypatch):
+    def boom(*a, **k):
+        raise AssertionError("global array materialized (from_local called)")
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(sc, "from_local", boom)
+        yield
+
+
+def _mk(m, n, seed=0, spd=False):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((m, n))
+    if spd:
+        a = a @ a.T + m * np.eye(m)
+    return a
+
+
+def test_roundtrip_dist_locals(mesh24):
+    a = _mk(90, 70, 1)
+    desc = sc.Desc(90, 70, 16, 16)
+    lg = sc.to_local(a, GRID, desc)
+    dm = sc.dist_from_locals(lg, GRID, desc, mesh24)
+    from slate_tpu.parallel import undistribute
+    assert np.allclose(np.asarray(undistribute(dm)), a)
+    lg2 = sc.locals_from_dist(dm, GRID, desc)
+    for r in range(2):
+        for c in range(4):
+            assert np.allclose(lg2[r][c], lg[r][c])
+
+
+def test_ppotrf_ppotrs_inplace(mesh24, monkeypatch):
+    n, nb = 96, 16
+    a = _mk(n, n, 2, spd=True)
+    b = _mk(n, 8, 3)
+    desc = sc.Desc(n, n, nb, nb)
+    descb = sc.Desc(n, 8, nb, nb)
+    a_lg = sc.to_local(a, GRID, desc)
+    b_lg = sc.to_local(b, GRID, descb)
+    with no_gather(monkeypatch):
+        fac_lg, x_lg = sc.pposv("L", a_lg, desc, b_lg, descb, GRID,
+                                mesh=mesh24)
+    x = sc.from_local(x_lg, GRID, descb)
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-10
+    l = np.tril(sc.from_local(fac_lg, GRID, desc))
+    assert np.linalg.norm(l @ l.T - a) / np.linalg.norm(a) < 1e-10
+
+
+def test_pgesv_inplace(mesh24, monkeypatch):
+    n, nb = 80, 16
+    a = _mk(n, n, 4) + n * np.eye(n)
+    b = _mk(n, 4, 5)
+    desc = sc.Desc(n, n, nb, nb)
+    descb = sc.Desc(n, 4, nb, nb)
+    a_lg = sc.to_local(a, GRID, desc)
+    b_lg = sc.to_local(b, GRID, descb)
+    with no_gather(monkeypatch):
+        x_lg, gperm = sc.pgesv(a_lg, desc, b_lg, descb, GRID, mesh=mesh24)
+    x = sc.from_local(x_lg, GRID, descb)
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-10
+
+
+def test_pgeqrf_pgels_inplace(mesh24, monkeypatch):
+    m, n, nb = 128, 48, 16
+    a = _mk(m, n, 6)
+    b = _mk(m, 3, 7)
+    desca = sc.Desc(m, n, nb, nb)
+    descb = sc.Desc(m, 3, nb, nb)
+    a_lg = sc.to_local(a, GRID, desca)
+    b_lg = sc.to_local(b, GRID, descb)
+    with no_gather(monkeypatch):
+        qr_lg, tmats = sc.pgeqrf(a_lg, desca, GRID, mesh=mesh24)
+        x_lg = sc.pgels(a_lg, desca, b_lg, descb, GRID, mesh=mesh24)
+    r = np.triu(sc.from_local(qr_lg, GRID, desca)[:n])
+    # Gram identity A^T A = R^T R
+    assert np.allclose(r.T @ r, a.T @ a, atol=1e-8 * np.linalg.norm(a) ** 2)
+    x = sc.from_local(x_lg, GRID, sc.Desc(n, 3, nb, nb))
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    assert np.allclose(x, xref, atol=1e-8)
+
+
+def test_pheev_inplace(mesh24, monkeypatch):
+    n, nb = 96, 16
+    a = _mk(n, n, 8)
+    a = (a + a.T) / 2
+    desc = sc.Desc(n, n, nb, nb)
+    a_lg = sc.to_local(a, GRID, desc)
+    with no_gather(monkeypatch):
+        w, z_lg = sc.pheev("V", "L", a_lg, desc, GRID, mesh=mesh24)
+    z = sc.from_local(z_lg, GRID, desc)
+    assert np.allclose(np.asarray(w), np.linalg.eigvalsh(a), atol=1e-9)
+    assert np.linalg.norm(a @ z - z * np.asarray(w)[None, :]) < 1e-9 * n
+
+
+def test_pgemm_inplace(mesh24, monkeypatch):
+    m, k, n, nb = 64, 80, 48, 16
+    a, b, c = _mk(m, k, 9), _mk(k, n, 10), _mk(m, n, 11)
+    da, db, dc = sc.Desc(m, k, nb, nb), sc.Desc(k, n, nb, nb), \
+        sc.Desc(m, n, nb, nb)
+    a_lg = sc.to_local(a, GRID, da)
+    b_lg = sc.to_local(b, GRID, db)
+    c_lg = sc.to_local(c, GRID, dc)
+    with no_gather(monkeypatch):
+        out_lg = sc.pgemm("N", "N", 2.0, a_lg, da, b_lg, db, 0.5, c_lg,
+                          dc, GRID, mesh=mesh24)
+    out = sc.from_local(out_lg, GRID, dc)
+    assert np.allclose(out, 2.0 * a @ b + 0.5 * c, atol=1e-10)
+
+
+def test_plange_inplace(mesh24, monkeypatch):
+    a = _mk(70, 90, 12)
+    desc = sc.Desc(70, 90, 16, 16)
+    a_lg = sc.to_local(a, GRID, desc)
+    with no_gather(monkeypatch):
+        for ch, ref in (("F", np.linalg.norm(a)),
+                        ("M", np.abs(a).max()),
+                        ("1", np.abs(a).sum(0).max()),
+                        ("I", np.abs(a).sum(1).max())):
+            assert np.isclose(sc.plange(ch, a_lg, desc, GRID, mesh=mesh24),
+                              ref)
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_ppotrf_uplo_single_triangle(mesh24, monkeypatch, uplo):
+    """Only the stored triangle is referenced (ScaLAPACK contract); the
+    other triangle carries garbage.  'U' returns the factor in the upper
+    triangle."""
+    n, nb = 80, 16
+    a = _mk(n, n, 20, spd=True)
+    stored = np.tril(a) if uplo == "L" else np.triu(a)
+    garbage = stored + (np.triu(np.full((n, n), 7.0), 1) if uplo == "L"
+                        else np.tril(np.full((n, n), 7.0), -1))
+    desc = sc.Desc(n, n, nb, nb)
+    a_lg = sc.to_local(garbage, GRID, desc)
+    with no_gather(monkeypatch):
+        fac_lg = sc.ppotrf(uplo, a_lg, desc, GRID, mesh=mesh24)
+    fac = sc.from_local(fac_lg, GRID, desc)
+    if uplo == "L":
+        l = np.tril(fac)
+        assert np.linalg.norm(l @ l.T - a) / np.linalg.norm(a) < 1e-10
+    else:
+        u = np.triu(fac)
+        assert np.linalg.norm(u.T @ u - a) / np.linalg.norm(a) < 1e-10
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
+def test_pposv_uplo_roundtrip(mesh24, monkeypatch, uplo):
+    n, nb = 64, 16
+    a = _mk(n, n, 21, spd=True)
+    b = _mk(n, 5, 22)
+    stored = np.tril(a) if uplo == "L" else np.triu(a)
+    desc = sc.Desc(n, n, nb, nb)
+    descb = sc.Desc(n, 5, nb, nb)
+    a_lg = sc.to_local(stored, GRID, desc)
+    b_lg = sc.to_local(b, GRID, descb)
+    with no_gather(monkeypatch):
+        _, x_lg = sc.pposv(uplo, a_lg, desc, b_lg, descb, GRID,
+                           mesh=mesh24)
+    x = sc.from_local(x_lg, GRID, descb)
+    assert np.linalg.norm(a @ x - b) / np.linalg.norm(b) < 1e-9
+
+
+def test_pheev_uplo_upper(mesh24, monkeypatch):
+    n, nb = 64, 16
+    a = _mk(n, n, 23)
+    a = (a + a.T) / 2
+    stored = np.triu(a) + np.tril(np.full((n, n), 9.0), -1)  # garbage low
+    desc = sc.Desc(n, n, nb, nb)
+    a_lg = sc.to_local(stored, GRID, desc)
+    with no_gather(monkeypatch):
+        w, z_lg = sc.pheev("V", "U", a_lg, desc, GRID, mesh=mesh24)
+    z = sc.from_local(z_lg, GRID, desc)
+    assert np.allclose(np.asarray(w), np.linalg.eigvalsh(a), atol=1e-9)
+    assert np.linalg.norm(a @ z - z * np.asarray(w)[None, :]) < 1e-9 * n
