@@ -209,7 +209,8 @@ def pgemm(transa: str, transb: str, alpha, a_lg, desca, b_lg, descb,
     # SUMMA needs matching tiles and one consistent K tile count —
     # decidable from the descriptors alone, before any device transfer
     if _mesh_matches(mesh, grid) and notrans \
-            and desca.nb == descb.mb == descb.nb == descc.nb:
+            and desca.mb == desca.nb == descb.mb == descb.nb \
+            == descc.mb == descc.nb:
         from ..parallel.dist_blas3 import pgemm as dpgemm
         ad = dist_from_locals(a_lg, grid, desca, mesh)
         bd = dist_from_locals(b_lg, grid, descb, mesh)

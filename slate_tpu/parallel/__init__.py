@@ -19,8 +19,8 @@ from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401
 from .dist_lu import pgesv, pgesv_mixed, pgetrf, pgetrs  # noqa: F401
 from .dist_qr import pgeqrf, pgels, punmqr_conj  # noqa: F401
 from .dist_aux import (  # noqa: F401
-    phemm, pher2k, pherk, pnorm, psymm, psyr2k, psyrk, ptri_mask, ptrmm,
-    ptrsm,
+    pcolnorms, phemm, pher2k, pherk, pnorm, psymm, psyr2k, psyrk,
+    ptri_mask, ptrmm, ptrsm,
 )
 from .dist_twostage import (  # noqa: F401
     band_tiles_to_banded, band_tiles_to_dense, pge2tb, phe2hb, pheev,
@@ -29,5 +29,4 @@ from .dist_twostage import (  # noqa: F401
 from .dist_util import peye, predistribute, ptranspose  # noqa: F401
 from .dist_lu import pgecondest, pgetri  # noqa: F401
 from .dist_qr import pgelqf, punmlq  # noqa: F401
-from .dist_aux import pcolnorms  # noqa: F401
 from .dist_band import pgbsv, ppbsv  # noqa: F401
