@@ -163,6 +163,30 @@ def locals_from_dist(dm, grid: BlacsGrid, desc: Desc) -> LocalGrid:
     return out
 
 
+def _blend_triangle(fac_lg: LocalGrid, orig_lg: LocalGrid,
+                    grid: BlacsGrid, desc: Desc, uplo: Uplo) -> LocalGrid:
+    """Merge the factored (stored) triangle into the caller's locals,
+    leaving the unreferenced triangle's original contents untouched —
+    the ScaLAPACK contract (the reference's scalapack_api wraps the user
+    buffer in place and never writes the other triangle)."""
+
+    out: LocalGrid = [[None] * grid.q for _ in range(grid.p)]
+    for r in range(grid.p):
+        for c in range(grid.q):
+            fac = np.asarray(fac_lg[r][c])
+            orig = np.asarray(orig_lg[r][c])
+            li = np.arange(fac.shape[0])
+            lj = np.arange(fac.shape[1])
+            gi = (li // desc.mb) * grid.p * desc.mb + r * desc.mb \
+                + li % desc.mb
+            gj = (lj // desc.nb) * grid.q * desc.nb + c * desc.nb \
+                + lj % desc.nb
+            stored = (gi[:, None] >= gj[None, :]) if uplo is Uplo.Lower \
+                else (gi[:, None] <= gj[None, :])
+            out[r][c] = np.where(stored, fac, orig)
+    return out
+
+
 def _diag_pad_data(dm, value: float):
     """Sharded pad-diagonal correction for an assembled DistMatrix: ones
     on the padded part of the diagonal (keeps padded factorizations
@@ -251,10 +275,12 @@ def ppotrf(uplo: str, a_lg, desc, grid: BlacsGrid,
         lfac = par.ppotrf(full)
         if u is Uplo.Upper:   # return U = Lᴴ in the upper triangle
             lfac = ptranspose(lfac, conj=True)
-        return locals_from_dist(lfac, grid, desc)
+        return _blend_triangle(locals_from_dist(lfac, grid, desc),
+                               a_lg, grid, desc, u)
     h = HermitianMatrix(_gather(a_lg, grid, desc), uplo=u, nb=desc.nb)
     fac = L.potrf(h)
-    return _scatter(fac.data, grid, desc)
+    return _blend_triangle(_scatter(fac.data, grid, desc),
+                           a_lg, grid, desc, u)
 
 
 def ppotrs(uplo: str, fac_lg, desca, b_lg, descb, grid: BlacsGrid,
@@ -283,16 +309,26 @@ def pposv(uplo: str, a_lg, desca, b_lg, descb, grid: BlacsGrid,
 
 
 def pgetrf(a_lg, desc, grid: BlacsGrid, mesh=None):
-    """With a mesh, returns ``(lu_locals, gperm)`` — gperm is the global
-    row-permutation vector of the distributed factor (``types.hh:64-97``
-    analog), not per-panel ipiv."""
+    """Returns ``(lu_locals, perm)``.  Both the mesh and the gather path
+    return the same pivot representation: a global row-permutation vector
+    with ``A[perm] = L·U`` (``types.hh:64-97`` analog) — not ScaLAPACK's
+    per-step ipiv."""
     if _mesh_matches(mesh, grid):
         from .. import parallel as par
         ad = dist_from_locals(a_lg, grid, desc, mesh, diag_pad=1.0)
         lu, gperm = par.pgetrf(ad)
-        return locals_from_dist(lu, grid, desc), np.asarray(gperm)
+        # padded identity rows never win a pivot race (they are zero in
+        # real columns), so gperm[:m] is the real permutation — same
+        # representation as the gather path.  A singular input CAN pivot
+        # a pad row in (every real candidate 0), so guard the invariant.
+        perm = np.asarray(gperm)[:desc.m]
+        if perm.size and perm.max() >= desc.m:
+            raise FloatingPointError(
+                "pgetrf: exactly singular matrix (a padded pivot row was "
+                "selected) — factorization has no valid permutation")
+        return locals_from_dist(lu, grid, desc), perm
     lu, piv = L.getrf(_gather(a_lg, grid, desc), {"block_size": desc.nb})
-    return _scatter(lu.data, grid, desc), np.asarray(piv)
+    return _scatter(getattr(lu, "data", lu), grid, desc), np.asarray(piv)
 
 
 def pgesv(a_lg, desca, b_lg, descb, grid: BlacsGrid, mesh=None):
@@ -301,7 +337,12 @@ def pgesv(a_lg, desca, b_lg, descb, grid: BlacsGrid, mesh=None):
         ad = dist_from_locals(a_lg, grid, desca, mesh, diag_pad=1.0)
         bd = dist_from_locals(b_lg, grid, descb, mesh)
         _, gperm, x = par.pgesv(ad, bd, mesh, desca.nb)
-        return locals_from_dist(x, grid, descb), np.asarray(gperm)
+        perm = np.asarray(gperm)[:desca.m]
+        if perm.size and perm.max() >= desca.m:
+            raise FloatingPointError(
+                "pgesv: exactly singular matrix (a padded pivot row was "
+                "selected)")
+        return locals_from_dist(x, grid, descb), perm
     _, piv, x = L.gesv(_gather(a_lg, grid, desca),
                        _gather(b_lg, grid, descb),
                        {"block_size": desca.nb})

@@ -256,11 +256,12 @@ def stedc_merge(d1: np.ndarray, q1: np.ndarray, d2: np.ndarray,
         # difference matrix comes from the shifted frames (stable).
         # Clamp |dmat| away from exact zero: a bisection interval that
         # collapses to zero width (mu underflow next to a pole) would
-        # otherwise turn a column into inf/nan.  Legitimate gaps are
-        # bounded below by the deflation tolerance (~eps·scale), so an
-        # eps-scaled floor cannot perturb undeflated roots; the max-abs
-        # prescale keeps the 2-norm from overflowing for near-pole
-        # columns (the column limits to the pole coordinate axis).
+        # otherwise turn a column into inf/nan.  The floor is
+        # sqrt(tiny)·scale (~1e-154·scale) — far below the deflation
+        # tolerance (~eps·scale) that bounds legitimate gaps, so it
+        # cannot perturb undeflated roots; the max-abs prescale keeps
+        # the 2-norm from overflowing for near-pole columns (the column
+        # limits to the pole coordinate axis).
         tiny = np.finfo(dmat.dtype).tiny ** 0.5 * max(np.abs(dk).max(), 1.0)
         gap = np.abs(dmat).min(axis=0)
         pole = np.abs(dmat).argmin(axis=0)

@@ -60,7 +60,9 @@ def potrf(a, opts: Optional[Options] = None):
     method = get_option(opts, "method_factor", "auto")
     if method == "auto" and config.use_pallas \
             and full.dtype == jnp.float32 and full.ndim == 2:
-        l = blocks.potrf_panels(full, max(nb, 256))
+        # chol_inv_panel requires nb % 128 == 0 (ib=128): round the user's
+        # block size up rather than tripping its trace-time assert.
+        l = blocks.potrf_panels(full, max(256, -(-nb // 128) * 128))
     elif method == "auto":
         import jax.numpy as _jnp
         from jax import lax as _lax

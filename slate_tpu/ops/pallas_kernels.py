@@ -42,8 +42,11 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps):
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    # HIGHEST: in-kernel DEFAULT is a single bf16 MXU pass — ~1e-3
+    # relative error on f32 data, far beyond the 3·eps residual gates
     acc_ref[:] += jnp.dot(a_ref[:], b_ref[:],
-                          preferred_element_type=acc_ref.dtype)
+                          preferred_element_type=acc_ref.dtype,
+                          precision=jax.lax.Precision.HIGHEST)
 
     @pl.when(pl.program_id(2) == k_steps - 1)
     def _store():

@@ -169,6 +169,45 @@ def test_ppotrf_uplo_single_triangle(mesh24, monkeypatch, uplo):
 
 
 @pytest.mark.parametrize("uplo", ["L", "U"])
+def test_ppotrf_preserves_other_triangle(mesh24, monkeypatch, uplo):
+    """The unreferenced triangle comes back bit-identical — ScaLAPACK
+    leaves it untouched and callers rely on that (ADVICE r2)."""
+    n, nb = 80, 16
+    a = _mk(n, n, 24, spd=True)
+    sentinel = (np.triu(np.full((n, n), 7.25), 1) if uplo == "L"
+                else np.tril(np.full((n, n), 7.25), -1))
+    stored = (np.tril(a) if uplo == "L" else np.triu(a)) + sentinel
+    desc = sc.Desc(n, n, nb, nb)
+    a_lg = sc.to_local(stored, GRID, desc)
+    with no_gather(monkeypatch):
+        fac_lg = sc.ppotrf(uplo, a_lg, desc, GRID, mesh=mesh24)
+    fac = sc.from_local(fac_lg, GRID, desc)
+    untouched = (np.triu(fac, 1) if uplo == "L" else np.tril(fac, -1))
+    assert np.array_equal(untouched, sentinel)
+    # gather path honors the same contract
+    fac_lg2 = sc.ppotrf(uplo, sc.to_local(stored, GRID, desc), desc, GRID,
+                        mesh=None)
+    fac2 = sc.from_local(fac_lg2, GRID, desc)
+    untouched2 = (np.triu(fac2, 1) if uplo == "L" else np.tril(fac2, -1))
+    assert np.array_equal(untouched2, sentinel)
+
+
+def test_pgetrf_pivots_same_both_paths(mesh24, monkeypatch):
+    """Mesh and gather paths return the same global-perm representation
+    (ADVICE r2 asked for unified pivot semantics)."""
+    n, nb = 80, 16
+    a = _mk(n, n, 25)   # no diagonal dominance: real pivoting happens
+    desc = sc.Desc(n, n, nb, nb)
+    with no_gather(monkeypatch):
+        _, piv_mesh = sc.pgetrf(sc.to_local(a, GRID, desc), desc, GRID,
+                                mesh=mesh24)
+    _, piv_gather = sc.pgetrf(sc.to_local(a, GRID, desc), desc, GRID,
+                              mesh=None)
+    assert np.array_equal(np.asarray(piv_mesh), np.asarray(piv_gather))
+    assert not np.array_equal(np.asarray(piv_mesh), np.arange(n))
+
+
+@pytest.mark.parametrize("uplo", ["L", "U"])
 def test_pposv_uplo_roundtrip(mesh24, monkeypatch, uplo):
     n, nb = 64, 16
     a = _mk(n, n, 21, spd=True)

@@ -1,0 +1,138 @@
+"""Decomposition probe: where does potrf/getrf/geqrf time go on the
+chip?  Uses SLOPE timing: each op is chained inside one jit at two
+different iteration counts and the per-iteration time is the slope
+(t_hi - t_lo) / (hi - lo) — this cancels the host↔device tunnel
+round-trip (~100 ms/call) that poisons naive small-op timings.
+Not part of the test suite."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def wall(f, args, reps=3):
+    float(np.asarray(f(*args)).ravel()[0])   # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(np.asarray(f(*args)).ravel()[0])
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def slope(step, args, lo, hi):
+    """Per-iteration seconds of step(x, aux) via two chain lengths."""
+    def chain(iters):
+        def fn(x, aux):
+            def body(i, v):
+                return step(v, aux)
+            return lax.fori_loop(0, iters, body, x).ravel()[0]
+        return jax.jit(fn)
+    t_lo = wall(chain(lo), args)
+    t_hi = wall(chain(hi), args)
+    return (t_hi - t_lo) / (hi - lo)
+
+
+def report(name, secs, flops=None):
+    msg = f"{name}: {secs*1e6:.0f} us"
+    if flops:
+        msg += f"  {flops/secs/1e12:.2f} TF/s"
+    print(msg, flush=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n, nb = 8192, 512
+
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    spd = jnp.asarray(g @ g.T + n * np.eye(n, dtype=np.float32))
+    spd_small = jnp.asarray((g[:nb, :nb] @ g[:nb, :nb].T
+                             + nb * np.eye(nb)).astype(np.float32))
+
+    # call overhead: trivial op
+    t = wall(jax.jit(lambda x: (x + 1.0).ravel()[0]),
+             (jnp.float32([0.0]),))
+    print(f"tunnel round-trip (trivial call): {t*1e3:.1f} ms", flush=True)
+
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+    t = slope(lambda x, aux: (x @ aux) * 1e-4, (a, a), 2, 6)
+    report(f"gemm {n}", t, 2 * n**3)
+
+    t = slope(lambda x, aux: jnp.tril(lax.linalg.cholesky(x)) + aux * 1e-30,
+              (spd_small, spd_small), 8, 40)
+    report(f"xla chol {nb}", t, nb**3 / 3)
+
+    t = slope(lambda x, aux: jnp.tril(lax.linalg.cholesky(x)) + aux * 1e-30,
+              (spd, spd), 2, 5)
+    report(f"xla chol {n}", t, n**3 / 3)
+
+    from slate_tpu.ops.pallas_kernels import chol_inv_panel
+
+    def pstep(x, aux):
+        l, li = chol_inv_panel(x)
+        return l + li * 1e-30 + aux * 1e-30
+    try:
+        t = slope(pstep, (spd_small, spd_small), 8, 40)
+        report(f"pallas chol_inv {nb}", t)
+    except Exception as e:
+        print("pallas chol_inv failed:", repr(e)[:200], flush=True)
+
+    lsm = jnp.asarray(np.linalg.cholesky(np.asarray(spd_small)))
+    pan = jnp.asarray(rng.standard_normal((n - nb, nb)).astype(np.float32))
+
+    t = slope(lambda x, aux: lax.linalg.triangular_solve(
+        aux, x, left_side=False, lower=True, transpose_a=True)
+        * jnp.float32(1.0 + 1e-30), (pan, lsm), 8, 24)
+    report(f"xla trsm panel ({n-nb}x{nb})", t, (n - nb) * nb**2)
+
+    t = slope(lambda x, aux: (x @ aux) * jnp.float32(1.0 + 1e-30),
+              (pan, lsm), 8, 24)
+    report(f"panel gemm ({n-nb}x{nb})@({nb}x{nb})", t, 2 * (n - nb) * nb**2)
+
+    # rank-nb trailing update shape: (n,nb)@(nb,n)
+    pb = jnp.asarray(rng.standard_normal((nb, n)).astype(np.float32))
+
+    def tr_step(x, aux):
+        return x + 1e-6 * (x[:, :nb] @ aux)
+    t = slope(tr_step, (a, pb), 2, 6)
+    report(f"trailing gemm ({n}x{nb})@({nb}x{n})", t, 2 * n * n * nb)
+
+    from slate_tpu.linalg.lu import getrf_rec
+    am = jnp.asarray((rng.standard_normal((n, n))
+                      + n * np.eye(n)).astype(np.float32))
+
+    def lstep(x, aux):
+        lu, piv = getrf_rec(x, nb)
+        return lu * 1e-30 + aux
+    t = slope(lstep, (am, am), 2, 4)
+    report(f"getrf_rec {n} nb={nb}", t, 2 * n**3 / 3)
+
+    pan2 = jnp.asarray(rng.standard_normal((n, nb)).astype(np.float32))
+
+    def lupan(x, aux):
+        lu, _, perm = lax.linalg.lu(x)
+        return lu * 1e-30 + aux
+    t = slope(lupan, (pan2, pan2), 2, 6)
+    report(f"xla lu panel ({n}x{nb})", t, n * nb**2)
+
+    def qrpan(x, aux):
+        h, tau = jnp.linalg.qr(x, mode="raw")
+        return jnp.swapaxes(h, -1, -2) * 1e-30 + aux
+    t = slope(qrpan, (pan2, pan2), 2, 6)
+    report(f"xla qr panel ({n}x{nb})", t, 2 * n * nb**2)
+
+    m2, n2 = 32768, 4096
+    tall = jnp.asarray(rng.standard_normal((m2, n2)).astype(np.float32))
+    t = slope(qrpan, (tall, tall), 1, 3)
+    report(f"xla qr {m2}x{n2}", t, 2 * m2 * n2**2 - 2 * n2**3 / 3)
+
+
+if __name__ == "__main__":
+    main()
