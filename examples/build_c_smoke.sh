@@ -1,0 +1,23 @@
+#!/bin/sh
+# Build + run both C smoke tests (host-runtime ABI and driver ABI).
+set -e
+cd "$(dirname "$0")"
+
+# host-runtime smoke (links the native .so; built on first python use)
+PYTHONPATH="$(cd .. && pwd)${PYTHONPATH:+:$PYTHONPATH}" python -c "import slate_tpu.native as n; assert n.available(), n.build_error()"
+gcc c_api_smoke.c -I../include -L../slate_tpu/native \
+    -l:_slate_host.so -Wl,-rpath,"$(cd ../slate_tpu/native && pwd)" \
+    -O2 -lm -o /tmp/c_smoke
+/tmp/c_smoke
+
+# driver smoke (embeds CPython, runs the JAX drivers)
+gcc c_api_driver_smoke.c ../src/c_api/c_api_core.c \
+    ../src/c_api/driver_api.c -I../include \
+    $(python3-config --includes) $(python3-config --ldflags --embed) \
+    -O2 -lm -o /tmp/c_driver_smoke
+SITE="$(python - <<'PY'
+import site, sys
+print(":".join(p for p in sys.path if p))
+PY
+)"
+PYTHONPATH="$(cd .. && pwd):$SITE" JAX_PLATFORMS=cpu /tmp/c_driver_smoke

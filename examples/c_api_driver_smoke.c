@@ -1,0 +1,170 @@
+/* Driver C API smoke test — exercises gesv/posv/gels/heev/svd through
+ * the generated C ABI (include/slate_tpu_driver.h), the analog of the
+ * reference's C API examples (include/slate/c_api/).
+ *
+ * build (see examples/build_c_smoke.sh):
+ *   gcc c_api_driver_smoke.c ../src/c_api/c_api_core.c \
+ *       ../src/c_api/driver_api.c -I../include \
+ *       $(python3-config --includes) $(python3-config --ldflags --embed) \
+ *       -o c_driver_smoke
+ * run with PYTHONPATH pointing at the repo + venv site-packages.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <math.h>
+#include "slate_tpu_driver.h"
+
+static double frand(void) { return rand() / (double)RAND_MAX - 0.5; }
+
+int main(void) {
+    const int64_t n = 96, nrhs = 3, m = 160;
+    int fails = 0;
+    srand(7);
+
+    if (slate_c_init() != 0) { printf("init failed\n"); return 1; }
+
+    /* ---- dgesv ---- */
+    double *a = malloc(n * n * sizeof *a);
+    double *b = malloc(n * nrhs * sizeof *b);
+    double *x = malloc(n * nrhs * sizeof *x);
+    int64_t *ipiv = malloc(n * sizeof *ipiv);
+    for (int64_t i = 0; i < n * n; ++i) a[i] = frand();
+    for (int64_t i = 0; i < n; ++i) a[i * n + i] += n;
+    for (int64_t i = 0; i < n * nrhs; ++i) b[i] = frand();
+    if (slate_dgesv(n, n, a, n, nrhs, b, n, x, ipiv) != 0) {
+        printf("dgesv: call failed\n"); fails++;
+    } else {
+        double r = 0, nb2 = 0;
+        for (int64_t c = 0; c < nrhs; ++c)
+            for (int64_t i = 0; i < n; ++i) {
+                double s = 0;
+                for (int64_t k = 0; k < n; ++k)
+                    s += a[k * n + i] * x[c * n + k];
+                double d = s - b[c * n + i];
+                r += d * d; nb2 += b[c * n + i] * b[c * n + i];
+            }
+        printf("dgesv resid: %.2e %s\n", sqrt(r / nb2),
+               sqrt(r / nb2) < 1e-10 ? "ok" : "FAIL");
+        if (!(sqrt(r / nb2) < 1e-10)) fails++;
+    }
+
+    /* ---- dposv ---- */
+    double *spd = malloc(n * n * sizeof *spd);
+    for (int64_t j = 0; j < n; ++j)
+        for (int64_t i = 0; i <= j; ++i) {
+            double s = (i == j) ? (double)n : 0.0;
+            for (int64_t k = 0; k < n; ++k)
+                s += a[i * n + k] * a[j * n + k];
+            spd[j * n + i] = s; spd[i * n + j] = s;
+        }
+    if (slate_dposv(n, n, spd, n, nrhs, b, n, x, 'L') != 0) {
+        printf("dposv: call failed\n"); fails++;
+    } else {
+        double r = 0, nb2 = 0;
+        for (int64_t c = 0; c < nrhs; ++c)
+            for (int64_t i = 0; i < n; ++i) {
+                double s = 0;
+                for (int64_t k = 0; k < n; ++k)
+                    s += spd[k * n + i] * x[c * n + k];
+                double d = s - b[c * n + i];
+                r += d * d; nb2 += b[c * n + i] * b[c * n + i];
+            }
+        printf("dposv resid: %.2e %s\n", sqrt(r / nb2),
+               sqrt(r / nb2) < 1e-9 ? "ok" : "FAIL");
+        if (!(sqrt(r / nb2) < 1e-9)) fails++;
+    }
+
+    /* ---- dgels (tall least squares) ---- */
+    double *ta = malloc(m * n * sizeof *ta);
+    double *tb = malloc(m * nrhs * sizeof *tb);
+    double *tx = malloc(n * nrhs * sizeof *tx);
+    for (int64_t i = 0; i < m * n; ++i) ta[i] = frand();
+    for (int64_t i = 0; i < m * nrhs; ++i) tb[i] = frand();
+    if (slate_dgels(m, n, ta, m, nrhs, tb, m, tx, 'L') != 0) {
+        printf("dgels: call failed\n"); fails++;
+    } else {
+        /* normal equations residual: A^T (A x - b) ~ 0 */
+        double r = 0;
+        for (int64_t c = 0; c < nrhs; ++c)
+            for (int64_t j = 0; j < n; ++j) {
+                double s = 0;
+                for (int64_t i = 0; i < m; ++i) {
+                    double ax = 0;
+                    for (int64_t k = 0; k < n; ++k)
+                        ax += ta[k * m + i] * tx[c * n + k];
+                    s += ta[j * m + i] * (ax - tb[c * m + i]);
+                }
+                r += s * s;
+            }
+        printf("dgels normal-eq resid: %.2e %s\n", sqrt(r),
+               sqrt(r) < 1e-8 ? "ok" : "FAIL");
+        if (!(sqrt(r) < 1e-8)) fails++;
+    }
+
+    /* ---- dheev ---- */
+    double *w = malloc(n * sizeof *w);
+    double *z = malloc(n * n * sizeof *z);
+    if (slate_dheev(n, spd, n, w, z, 'L') != 0) {
+        printf("dheev: call failed\n"); fails++;
+    } else {
+        /* A z_0 = w_0 z_0 */
+        double r = 0, nz = 0;
+        for (int64_t i = 0; i < n; ++i) {
+            double s = 0;
+            for (int64_t k = 0; k < n; ++k)
+                s += spd[k * n + i] * z[0 * n + k];
+            double d = s - w[0] * z[0 * n + i];
+            r += d * d; nz += z[0 * n + i] * z[0 * n + i];
+        }
+        printf("dheev resid: %.2e %s\n", sqrt(r / nz) / w[n - 1],
+               sqrt(r / nz) / w[n - 1] < 1e-10 ? "ok" : "FAIL");
+        if (!(sqrt(r / nz) / w[n - 1] < 1e-10)) fails++;
+    }
+
+    /* ---- dsvd ---- */
+    double *s = malloc(n * sizeof *s);
+    double *u = malloc(m * n * sizeof *u);
+    double *vt = malloc(n * n * sizeof *vt);
+    if (slate_dsvd(m, n, ta, m, s, u, vt) != 0) {
+        printf("dsvd: call failed\n"); fails++;
+    } else {
+        /* || A v_0 - s_0 u_0 || */
+        double r = 0;
+        for (int64_t i = 0; i < m; ++i) {
+            double av = 0;
+            for (int64_t k = 0; k < n; ++k)
+                av += ta[k * m + i] * vt[k * n + 0];
+            double d = av - s[0] * u[0 * m + i];
+            r += d * d;
+        }
+        printf("dsvd resid: %.2e %s\n", sqrt(r) / s[0],
+               sqrt(r) / s[0] < 1e-10 ? "ok" : "FAIL");
+        if (!(sqrt(r) / s[0] < 1e-10)) fails++;
+    }
+
+    /* ---- sgemm (f32 path) ---- */
+    float *fa = malloc(n * n * sizeof *fa);
+    float *fc = malloc(n * n * sizeof *fc);
+    for (int64_t i = 0; i < n * n; ++i) fa[i] = (float)frand();
+    if (slate_sgemm(n, n, fa, n, n, fa, n, fc, 'L') != 0) {
+        printf("sgemm: call failed\n"); fails++;
+    } else {
+        double maxd = 0;
+        for (int64_t j = 0; j < n; j += 17)
+            for (int64_t i = 0; i < n; i += 13) {
+                double s2 = 0;
+                for (int64_t k = 0; k < n; ++k)
+                    s2 += (double)fa[k * n + i] * fa[j * n + k];
+                double d = fabs(s2 - fc[j * n + i]);
+                if (d > maxd) maxd = d;
+            }
+        printf("sgemm maxdiff: %.2e %s\n", maxd,
+               maxd < 1e-3 ? "ok" : "FAIL");
+        if (!(maxd < 1e-3)) fails++;
+    }
+
+    slate_c_finalize();
+    printf(fails ? "C DRIVER SMOKE: %d FAILURES\n"
+                 : "C DRIVER SMOKE: all ok\n", fails);
+    return fails ? 1 : 0;
+}

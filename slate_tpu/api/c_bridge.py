@@ -1,0 +1,133 @@
+"""Python side of the C driver API.
+
+The generated C wrappers (``src/c_api/driver_api.c``, from
+``tools/generate_c_api.py``) embed CPython and funnel every driver call
+through :func:`call`: NumPy views of the caller's column-major buffers
+come in, driver results go back as a tuple of arrays that the C core
+copies into caller-allocated output buffers, in order.
+
+This mirrors the reference's generated C API (``tools/c_api/
+generate_wrappers.py`` → ``include/slate/c_api/slate.h``): there the
+wrappers call the C++ templates directly; here the compute path is
+JAX/XLA, so the shim hops through the interpreter — the TPU still does
+the math.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# the C ABI promises d/z precision — keep f64 inputs f64 (this module
+# is only imported by the embedded interpreter the C core starts)
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _j(a):
+    import jax.numpy as jnp
+    return jnp.asarray(np.ascontiguousarray(a))
+
+
+def _np(x):
+    return np.ascontiguousarray(np.asarray(x))
+
+
+def call(op: str, a, b=None, uplo: str = "L", trans: str = "N"):
+    """Dispatch one driver call.  ``a``/``b`` arrive as column-major
+    NumPy views of the caller's buffers (transposed to row-major here).
+    Returns a tuple of row-major arrays; the C core transposes back."""
+
+    from .. import linalg as L
+    from ..enums import Diag, Norm, Side, Uplo, Op
+    from ..matrix import HermitianMatrix, TriangularMatrix
+
+    a = np.asarray(a).T          # column-major view -> row-major array
+    if b is not None:
+        b = np.asarray(b).T
+    u = Uplo.Lower if uplo.upper().startswith("L") else Uplo.Upper
+
+    if op == "gesv":
+        lu, piv, x = L.gesv(_j(a), _j(b))
+        return (_np(x).T, _np(piv).astype(np.int64))
+    if op == "getrf":
+        lu, piv = L.getrf(_j(a))
+        return (_np(getattr(lu, "data", lu)).T, _np(piv).astype(np.int64))
+    if op == "getri":
+        lu, piv = L.getrf(_j(a))
+        inv = L.getri(getattr(lu, "data", lu), piv)
+        return (_np(getattr(inv, "data", inv)).T,)
+    if op == "posv":
+        h = HermitianMatrix(_j(a), uplo=u)
+        fac, x = L.posv(h, _j(b))
+        return (_np(x).T,)
+    if op == "potrf":
+        h = HermitianMatrix(_j(a), uplo=u)
+        fac = L.potrf(h)
+        return (_np(fac.data).T,)
+    if op == "potri":
+        h = HermitianMatrix(_j(a), uplo=u)
+        inv = L.potri(L.potrf(h))
+        return (_np(getattr(inv, "data", inv)).T,)
+    if op == "trtri":
+        t = TriangularMatrix(_j(a), uplo=u, diag=Diag.NonUnit)
+        inv = L.trtri(t)
+        return (_np(getattr(inv, "data", inv)).T,)
+    if op == "hesv" or op == "sysv":
+        fac, x = L.hesv(_j(a), _j(b))
+        return (_np(x).T,)
+    if op == "gels":
+        x = L.gels(_j(a), _j(b))
+        return (_np(getattr(x, "data", x)).T,)
+    if op == "geqrf":
+        f, taus = L.geqrf(_j(a))
+        return (_np(getattr(f, "data", f)).T, _np(taus))
+    if op == "gelqf":
+        f, taus = L.gelqf(_j(a))
+        return (_np(getattr(f, "data", f)).T, _np(taus))
+    if op == "heev" or op == "syev":
+        w, z = L.heev(HermitianMatrix(_j(a), uplo=u), jobz=True)
+        return (_np(w).astype(np.float64), _np(z).T)
+    if op == "heev_vals" or op == "syev_vals":
+        w = L.heev(HermitianMatrix(_j(a), uplo=u), jobz=False)[0]
+        return (_np(w).astype(np.float64),)
+    if op == "svd":
+        s, uu, vt = L.svd(_j(a), jobu=True, jobvt=True)
+        return (_np(s).astype(np.float64), _np(uu).T, _np(vt).T)
+    if op == "svd_vals":
+        s = L.svd_vals(_j(a))
+        return (_np(s).astype(np.float64),)
+    if op == "gemm":
+        zero = np.zeros((a.shape[0], b.shape[1]), a.dtype)
+        c = L.gemm(1.0, _j(a), _j(b), 0.0, _j(zero))
+        return (_np(getattr(c, "data", c)).T,)
+    if op == "symm" or op == "hemm":
+        h = HermitianMatrix(_j(a), uplo=u)
+        zero = np.zeros((a.shape[0], b.shape[1]), a.dtype)
+        c = (L.hemm if op == "hemm" else L.symm)(
+            Side.Left, 1.0, h, _j(b), 0.0, _j(zero))
+        return (_np(getattr(c, "data", c)).T,)
+    if op == "syrk" or op == "herk":
+        zero = np.zeros((a.shape[0], a.shape[0]), a.dtype)
+        c = (L.herk if op == "herk" else L.syrk)(
+            1.0, _j(a), 0.0, HermitianMatrix(_j(zero), uplo=u))
+        return (_np(getattr(c, "data", c)).T,)
+    if op == "trsm":
+        t = TriangularMatrix(_j(a), uplo=u, diag=Diag.NonUnit)
+        x = L.trsm(Side.Left, 1.0, t, _j(b))
+        return (_np(getattr(x, "data", x)).T,)
+    if op == "trmm":
+        t = TriangularMatrix(_j(a), uplo=u, diag=Diag.NonUnit)
+        x = L.trmm(Side.Left, 1.0, t, _j(b))
+        return (_np(getattr(x, "data", x)).T,)
+    if op == "lange":
+        nm = {"M": Norm.Max, "1": Norm.One, "I": Norm.Inf,
+              "F": Norm.Fro}[trans.upper()]
+        v = L.norm(nm, _j(a))
+        return (np.asarray([float(v)], np.float64),)
+    if op == "gecondest":
+        lu, piv = L.getrf(_j(a))
+        v = L.gecondest(Norm.One, getattr(lu, "data", lu), piv,
+                        anorm=float(L.norm(Norm.One, _j(a))))
+        return (np.asarray([float(v)], np.float64),)
+    raise ValueError(f"unknown driver op: {op}")

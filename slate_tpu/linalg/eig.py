@@ -274,6 +274,108 @@ def hb2st(band, kd: int, want_rots: bool = True
     return d, e, rots
 
 
+def _hb_sweep_counts(n, kd):
+    """Per-sweep reflector counts of the symmetric Householder chase
+    (mirrors the deterministic window logic; boundary inference from
+    row0 alone is ambiguous when consecutive sweeps have one step
+    each)."""
+    counts = []
+    for j in range(max(n - 2, 0)):
+        L = min(kd, n - 1 - j)
+        if L < 2:
+            continue
+        cnt, r0 = 1, j + 1
+        while True:
+            r1 = r0 + L
+            lt = min(kd, n - r1)
+            if lt < 2:
+                break
+            cnt += 1
+            r0, L = r1, lt
+        counts.append(cnt)
+    return counts
+
+
+def _pack_hh_log(v, tau, row0, length, n, kd, counts=None):
+    """Group the flat reflector log by sweep into padded (nsweeps, tmax,
+    kd) tensors.  Within one sweep the windows are adjacent disjoint
+    kd-strided rows starting at the sweep's first row — the property
+    that makes the whole sweep one batched WY apply."""
+
+    row0 = np.asarray(row0)
+    if len(row0) == 0:
+        return (np.zeros((0, 1, kd)), np.zeros((0, 1)),
+                np.zeros((0,), np.int32))
+    if counts is None:
+        counts = _hb_sweep_counts(n, kd)
+    counts = np.asarray(counts)
+    starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    assert counts.sum() == len(row0), (counts.sum(), len(row0))
+    nsweeps = len(starts)
+    tmax = int(counts.max())
+    v3 = np.zeros((nsweeps, tmax, kd), dtype=v.dtype)
+    t2 = np.zeros((nsweeps, tmax), dtype=tau.dtype)
+    s0 = np.zeros((nsweeps,), dtype=np.int32)
+    for s, (b, c) in enumerate(zip(starts, counts)):
+        v3[s, :c] = v[b:b + c]
+        t2[s, :c] = tau[b:b + c]
+        s0[s] = row0[b]
+    return v3, t2, s0
+
+
+def unmtr_hb2st_hh(v3, t2, s0, z, kd: int):
+    """Back-transform through the Householder chase ON DEVICE:
+    Z ← Q₂·Z as one ``lax.scan`` over sweeps (reverse order), each step
+    a batched WY apply — two batched contractions over the sweep's
+    disjoint reflector windows (reference ``src/unmtr_hb2st.cc`` applies
+    its V blocks the same way; here the accelerator does it instead of
+    single-core rotation streaming)."""
+
+    import jax
+    from jax import lax as _lax
+
+    v3 = jnp.asarray(v3)
+    t2 = jnp.asarray(t2)
+    s0 = jnp.asarray(s0)
+    z = jnp.asarray(z)
+    if v3.shape[0] == 0:
+        return z
+    nsweeps, tmax, _ = v3.shape
+    n, ncols = z.shape
+    win = tmax * kd
+    zp = jnp.zeros((n + win, ncols), z.dtype).at[:n].set(z)
+
+    def body(zc, inp):
+        vj, tj, start = inp
+        zw = _lax.dynamic_slice(zc, (start, jnp.zeros((), start.dtype)),
+                                (win, ncols))
+        zw = zw.reshape(tmax, kd, ncols)
+        u = jnp.einsum("tk,tkc->tc", vj, zw,
+                       precision=_lax.Precision.HIGHEST)
+        zw = zw - vj[:, :, None] * (tj[:, None] * u)[:, None, :]
+        zc = _lax.dynamic_update_slice(zc, zw.reshape(win, ncols),
+                                       (start, jnp.zeros((), start.dtype)))
+        return zc, None
+
+    out, _ = _lax.scan(body, zp, (v3[::-1], t2[::-1], s0[::-1]))
+    return out[:n]
+
+
+def _hb2st_hh_ab(abw: np.ndarray, kd_eff: int):
+    """Compiled Householder stage 2 on WIDE band storage
+    ``abw[(n, 2·kd+2)]`` (modified in place) — the real-f64 fast path
+    whose log back-transforms on device.  Returns
+    ``(d, e, (v3, t2, s0))``."""
+
+    from .. import native
+
+    n = abw.shape[0]
+    v, tau, row0, length = native.hb2st_hh_banded(abw, n, kd_eff)
+    d = abw[:, 0].copy()
+    e = abw[:n - 1, 1].copy()
+    return d, e, _pack_hh_log(v, tau, row0, length, n, kd_eff)
+
+
 def unmtr_hb2st(rots: Hb2stRotations, z: np.ndarray) -> np.ndarray:
     """Back-transform tridiagonal eigenvectors through the bulge-chase:
     Z_band = Q₂·Z — reference ``slate::unmtr_hb2st``
@@ -405,6 +507,17 @@ def _band_eig(band_np, kd: int, jobz: bool, method, auto: bool):
             return np.sort(np.real(w)), None
         w, z_band = eig_banded(bands, lower=True)
         return np.real(w), z_band
+    import jax as _jax
+    if jobz and band_np.dtype == np.float64 and native.available() \
+            and n > 2 and min(kd, n - 1) >= 2 \
+            and _jax.default_backend() != "cpu":
+        # route through the band-storage path so the real-f64 case gets
+        # the Householder chase + on-device WY back-transform
+        kd_eff = min(kd, n - 1)
+        ab = np.zeros((n, kd_eff + 2), dtype=np.float64)
+        for dd in range(kd_eff + 1):
+            ab[:n - dd, dd] = np.real(np.diagonal(band_np, -dd))
+        return _band_eig_ab(ab, kd_eff, jobz, method, auto)
     d, e, rots = hb2st(band_np, kd, want_rots=jobz)
     return _stage3_eig(d, e, rots, jobz, method, auto)
 
@@ -432,7 +545,14 @@ def _stage3_eig(d, e, rots, jobz, method, auto):
 def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
     """Stage 2+3 from O(n·kd) band storage directly (the distributed
     drivers\' path — no dense n×n host operand is ever built when the
-    compiled stage 2 is available)."""
+    compiled stage 2 is available).
+
+    Real f64 with vectors takes the Householder chase whose reflector
+    log back-transforms ON DEVICE as batched WY gemms
+    (:func:`unmtr_hb2st_hh`) — the round-3 answer to the single-core
+    rotation-streaming applier.  Complex (and values-only, which needs
+    no log at all) keeps the Givens chase.
+    """
 
     from .. import native
 
@@ -446,6 +566,25 @@ def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
             dense[idx[:n - dd] + dd, idx[:n - dd]] = ab[:n - dd, dd]
         dense = dense + np.tril(dense, -1).conj().T
         return _band_eig(dense, kd_eff, jobz, method, auto)
+    import jax as _jax
+    if jobz and ab.dtype == np.float64 \
+            and _jax.default_backend() != "cpu":
+        # Householder chase + device WY back-transform: a win only when
+        # an accelerator applies the log (the scan applier is HBM-bound;
+        # on host the cache-blocked Givens applier is far faster)
+        abw = np.zeros((n, 2 * kd_eff + 2), dtype=np.float64)
+        abw[:, :min(ab.shape[1], kd_eff + 1)] = \
+            ab[:, :min(ab.shape[1], kd_eff + 1)]
+        d, e, log = _hb2st_hh_ab(abw, kd_eff)
+        if auto:
+            w, z_tri = _tridiag_solve(d, e, True, "stevd")
+        elif method in (MethodEig.QR, MethodEig.DC, MethodEig.MRRR,
+                        MethodEig.Bisection):
+            w, z_tri = _EIG_DRIVERS[method](d, e)
+        else:
+            w, z_tri = _tridiag_solve(d, e, True, "stevd")
+        z_band = np.asarray(unmtr_hb2st_hh(*log, z_tri, kd_eff))
+        return np.asarray(w), z_band
     d, e, rots = _hb2st_ab(ab, kd_eff, want_rots=jobz)
     return _stage3_eig(d, e, rots, jobz, method, auto)
 

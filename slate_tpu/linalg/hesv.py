@@ -240,13 +240,64 @@ def _hetrf_blocked(av, nb: int):
     return l, d, e, ipiv
 
 
-def _tridiag_dense(d, e, dt):
+def _gtsv_scan(d, e, b):
+    """Traceable partial-pivot tridiagonal solve (LAPACK ``gtsv``
+    algorithm as two ``lax.scan`` sweeps), O(n·nrhs) — the jit-safe
+    replacement for the host banded solve.  T is Hermitian tridiagonal:
+    diag ``d``, sub ``e``, super ``conj(e)``.
+
+    Forward sweep: the carry is the not-yet-finalized current row
+    (d, du, du2, rhs); each step compares it against the next row's
+    subdiagonal and either eliminates (no swap) or swaps then
+    eliminates, emitting the finalized row — exactly dgtsv's adjacent
+    -row pivoting with its single extra ``du2`` fill-in band.  Backward
+    sweep: standard 2-term back substitution.
+    """
+
+    dt = jnp.result_type(d.dtype, e.dtype, b.dtype)
     n = d.shape[0]
-    t = jnp.zeros((n, n), dt)
-    t = t + jnp.diag(d.astype(dt))
-    if n > 1:
-        t = t + jnp.diag(e, -1) + jnp.diag(jnp.conj(e), 1)
-    return t
+    d = d.astype(dt)
+    e = e.astype(dt)
+    b = b.astype(dt)
+    if n == 1:
+        return b / d[0]
+    du = jnp.conj(e)
+    zero = jnp.zeros((), dt)
+    zrow = jnp.zeros(b.shape[1:], dt)
+
+    def fwd(carry, row):
+        cd, cdu, cdu2, cb = carry
+        dl_i, d_next, du_next, b_next = row
+        swap = jnp.abs(cd) < jnp.abs(dl_i)
+        fact = jnp.where(swap, cd, dl_i) / jnp.where(swap, dl_i, cd)
+        out_d = jnp.where(swap, dl_i, cd)
+        out_du = jnp.where(swap, d_next, cdu)
+        out_du2 = jnp.where(swap, du_next, cdu2)
+        out_b = jnp.where(swap, b_next, cb)
+        new_d = jnp.where(swap, cdu - fact * d_next, d_next - fact * cdu)
+        new_du = jnp.where(swap, cdu2 - fact * du_next,
+                           du_next - fact * cdu2)
+        new_b = jnp.where(swap, cb - fact * b_next, b_next - fact * cb)
+        return (new_d, new_du, zero, new_b), (out_d, out_du, out_du2, out_b)
+
+    rows = (e, d[1:], jnp.concatenate([du[1:], zero[None]]), b[1:])
+    (last_d, _, _, last_b), (fd, fdu, fdu2, fb) = lax.scan(
+        fwd, (d[0], du[0], zero, b[0]), rows)
+    # finalized rows 0..n-2 plus the remaining carry as row n-1
+    fd = jnp.concatenate([fd, last_d[None]])
+    fdu = jnp.concatenate([fdu, zero[None]])
+    fdu2 = jnp.concatenate([fdu2, zero[None]])
+    fb = jnp.concatenate([fb, last_b[None]])
+
+    def bwd(carry, row):
+        x1, x2 = carry
+        di, dui, du2i, bi = row
+        xi = (bi - dui * x1 - du2i * x2) / di
+        return (xi, x1), xi
+
+    _, xs = lax.scan(bwd, (zrow, zrow),
+                     (fd, fdu, fdu2, fb), reverse=True)
+    return xs
 
 
 def hetrs(factors: HetrfFactors, b, opts: Optional[Options] = None):
@@ -287,10 +338,12 @@ def hetrs(factors: HetrfFactors, b, opts: Optional[Options] = None):
     y = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.Unit, lfull, bv, nb)
     # tridiagonal solve — the reference's band gbtrf/gbtrs on T
     # (``src/hetrs.cc``): LAPACK banded solve on host, O(n·nrhs).  Under
-    # tracing (jit/vmap callers) fall back to the traceable dense solve.
+    # tracing (jit/vmap callers) the traceable scan-based gtsv keeps the
+    # same O(n·nrhs) cost — the dense jnp.linalg.solve fallback it
+    # replaces was silently O(n³) exactly where users wrap hesv in jit.
     import jax as _jax
     if isinstance(y, _jax.core.Tracer):
-        w = jnp.linalg.solve(_tridiag_dense(d, e, dt), y)
+        w = _gtsv_scan(d, e, y)
     else:
         from scipy.linalg import solve_banded
         dnp = np.asarray(d)

@@ -204,22 +204,106 @@ def geqrf_rec(a, nb: int):
     return jnp.concatenate([top, bot], axis=0), jnp.concatenate([tau1, tau2])
 
 
+def _cholqr2_panel(pan):
+    """Panel QR via shifted CholQR² + Householder reconstruction
+    (Ballard et al., "Reconstructing Householder Vectors from TSQR"):
+    three MXU gemm pairs + two fused Pallas kernels replace XLA's
+    sequential Householder panel.  Returns ``(y, rprime, tau, tmat)``
+    with A_panel = (I − Y·T·Yᵀ)·R′ exactly (Y unit lower trapezoid,
+    R′ = diag(s)·R upper, τᵢ = −sᵢ·Uᵢᵢ from the no-pivot LU of
+    Q − [diag(s); 0]).  f32, panel width a power of two ≥ 32.
+
+    The tiny diagonal shift before the first Cholesky keeps the Gram
+    factorization well-posed for ill-conditioned panels; the identity
+    A = Q·(L₁L₂)ᵀ holds for any shift, and the second pass restores
+    orthogonality — so the shift costs nothing in exactness.
+    """
+
+    from ..ops.pallas_kernels import chol_inv_panel, lu_inv_panel
+
+    mk, w = pan.shape
+    gram = matmul(_ct(pan), pan)
+    eps = jnp.finfo(pan.dtype).eps
+    shift = (100.0 * w) * eps * jnp.max(jnp.diag(gram))
+    l1, l1inv = chol_inv_panel(gram + shift * jnp.eye(w, dtype=pan.dtype))
+    q = matmul(pan, _ct(l1inv))
+    g2 = matmul(_ct(q), q)
+    l2, l2inv = chol_inv_panel(g2)
+    q = matmul(q, _ct(l2inv))
+    r = _ct(matmul(l1, l2))
+    dq = jnp.diag(q[:w])
+    s = jnp.where(dq >= 0, -1.0, 1.0).astype(pan.dtype)
+    b = q.at[:w].add(-jnp.diag(s))
+    lu, _, uinv = lu_inv_panel(b[:w])
+    ytop = jnp.tril(lu, -1) + jnp.eye(w, dtype=pan.dtype)
+    y = jnp.concatenate([ytop, matmul(b[w:], uinv)], axis=0)
+    tau = -s * jnp.diag(lu)
+    rprime = s[:, None] * r
+    tinv = jnp.triu(matmul(_ct(y), y), 1) + jnp.diag(1.0 / tau)
+    from ..ops.pallas_kernels import trtri_panel
+    tmat = jnp.triu(trtri_panel(tinv[::-1, ::-1])[::-1, ::-1])
+    return y, rprime, tau, tmat
+
+
+def geqrf_panels(a, nb: int = 512):
+    """Loop-based blocked Householder QR whose panel step is
+    :func:`_cholqr2_panel` — the TPU-default geqrf path.  Returns
+    ``(packed, taus)`` in exact LAPACK form (V unit-lower below the
+    diagonal, R above, Q = H₀·H₁⋯).  Ragged or non-power-of-two
+    panels fall back to XLA's fused geqrf panel."""
+
+    m, n = a.shape
+    k = min(m, n)
+    taus = []
+    for k0 in range(0, k, nb):
+        w = min(nb, k - k0)
+        pan = a[k0:, k0:k0 + w]
+        # CholQR² wants a tall panel (orthogonality degrades with
+        # cond², and a square panel is as conditioned as the matrix);
+        # short/ragged panels take XLA's fused Householder panel
+        if w == nb and (nb & (nb - 1)) == 0 and nb >= 32 \
+                and pan.shape[0] >= 2 * nb and a.dtype == jnp.float32:
+            y, rp, tau, tmat = _cholqr2_panel(pan)
+            col = jnp.concatenate(
+                [rp + jnp.tril(y[:w], -1), y[w:]], axis=0)
+        else:
+            f, tau = _panel_geqrf(pan)
+            y = _unit_lower(f, w)
+            tmat = larft_rec(y, tau)
+            col = f
+        a = a.at[k0:, k0:k0 + w].set(col)
+        taus.append(tau)
+        if k0 + w < n:
+            c = a[k0:, k0 + w:]
+            c = c - matmul(y, matmul(_ct(tmat), matmul(_ct(y), c)))
+            a = a.at[k0:, k0 + w:].set(c)
+    return a, jnp.concatenate(taus) if len(taus) > 1 else taus[0]
+
+
 def geqrf(a, opts: Optional[Options] = None):
     """QR factorization — reference ``slate::geqrf`` (``src/geqrf.cc``).
     Returns ``(packed, taus)`` with R on/above the diagonal and the
     Householder V below (unit lower).
 
-    Method dispatch (reference ``method.hh``): Auto hands the
-    single-chip factorization to XLA's blocked geqrf (the vendor
-    library slot, ~1.9× our recursion on the MXU at 32768×4096 fp32);
-    "recursive" keeps the explicit-nb blocked recursion.
+    Method dispatch (reference ``method.hh``): on TPU, Auto routes f32
+    through :func:`geqrf_panels` (shifted-CholQR² panels + Householder
+    reconstruction — all-MXU, no sequential panel); elsewhere Auto
+    hands the factorization to XLA's blocked geqrf (the vendor library
+    slot); "recursive" keeps the explicit-nb blocked recursion.
     """
 
     from ..options import get_option
 
+    import jax as _jax
+    from .. import config
+
     av = as_array(a)
     method = get_option(opts, "method_factor", "auto")
-    if method == "auto":
+    if method == "auto" and av.dtype == jnp.float32 and av.ndim == 2 \
+            and (config.use_pallas or _jax.default_backend() == "tpu"):
+        nb = _nb(a, opts)
+        packed, taus = geqrf_panels(av, 512 if nb <= 256 else nb)
+    elif method == "auto":
         h, taus = jnp.linalg.qr(av, mode="raw")
         # numpy/LAPACK raw mode returns the F-order factor transposed
         packed = jnp.swapaxes(h, -1, -2)

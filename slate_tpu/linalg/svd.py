@@ -378,6 +378,16 @@ def _band_svd(band_sq, kd: int, want_u: bool, want_vt: bool, method,
                 np.linalg.svd(band_sq, compute_uv=False)), None, None
         u_b, s, vh_b = np.linalg.svd(band_sq, full_matrices=False)
         return s, (u_b if want_u else None), (vh_b if want_vt else None)
+    import jax as _jax
+    if want_uv and not np.iscomplexobj(band_sq) and native.available() \
+            and n > 2 and min(kd, n - 1) >= 2 \
+            and _jax.default_backend() != "cpu":
+        # real with vectors: Householder chase + on-device WY appliers
+        kd_eff = min(kd, n - 1)
+        st = np.zeros((n, 3 * kd_eff + 2), dtype=np.float64)
+        for dd in range(kd_eff + 1):
+            st[:n - dd, dd + kd_eff] = np.real(np.diagonal(band_sq, dd))
+        return _band_svd_hh_ab(st, kd_eff, want_u, want_vt, method, auto)
     d, e, rots = tb2bd(band_sq, kd, want_rots=want_uv)
     return _stage3_svd(d, e, rots, want_u, want_vt, method, auto)
 
@@ -406,10 +416,58 @@ def _stage3_svd(d, e, rots, want_u, want_vt, method, auto):
     return s, u_b, vh_b
 
 
+def _bd_sweep_counts(n, kd):
+    """Per-sweep reflector counts of the bidiagonal Householder chase
+    (mirrors ``native.bd_step_count``'s window logic per sweep)."""
+    counts = []
+    for s in range(max(n - 1, 0)):
+        hi = min(s + kd, n - 1)
+        if hi <= s + 1:
+            continue
+        cnt, b = 1, 1
+        while b * kd + 1 + s <= n - 1:
+            cnt += 1
+            b += 1
+        counts.append(cnt)
+    return counts
+
+
+def _band_svd_hh_ab(st: np.ndarray, kd_eff: int, want_u: bool,
+                    want_vt: bool, method, auto: bool):
+    """Real-f64 stage 2+3 via the Householder bidiagonal chase: the U
+    and V reflector logs back-transform ON DEVICE as batched WY gemms
+    (reference ``unmbr_tb2bd`` applies its V blocks the same way)."""
+
+    from .. import native
+    from .eig import _pack_hh_log, unmtr_hb2st_hh
+
+    n = st.shape[0]
+    ulog, vlog = native.tb2bd_hh_banded(st, n, kd_eff)
+    d = st[:, kd_eff].copy()
+    e = st[:n - 1, kd_eff + 1].copy()
+    if auto and native.available() and n > 1:
+        u_bd, s, vh_bd = native.bdsdc(d, e)
+        u_bd = np.ascontiguousarray(u_bd)
+        vh_bd = np.ascontiguousarray(vh_bd)
+    else:
+        u_bd, s, vh_bd = bdsqr(d, e, want_uv=True, method=method)
+    counts = _bd_sweep_counts(n, kd_eff)
+    u_b = vh_b = None
+    if want_u:
+        pu = _pack_hh_log(*ulog, n, kd_eff, counts=counts)
+        u_b = np.asarray(unmtr_hb2st_hh(*pu, u_bd, kd_eff))
+    if want_vt:
+        pv = _pack_hh_log(*vlog, n, kd_eff, counts=counts)
+        vh_b = np.asarray(unmtr_hb2st_hh(*pv, vh_bd.T, kd_eff)).T
+    return s, u_b, vh_b
+
+
 def _band_svd_ab(ab, kd_eff: int, want_u: bool, want_vt: bool, method,
                  auto: bool):
     """Stage 2+3 from O(n·kd) upper-band storage directly (the
-    distributed drivers\' path)."""
+    distributed drivers\' path).  Real f64 with vectors takes the
+    Householder chase + on-device WY back-transform; complex (and
+    values-only) keeps the Givens chase."""
 
     from .. import native
 
@@ -420,6 +478,14 @@ def _band_svd_ab(ab, kd_eff: int, want_u: bool, want_vt: bool, method,
         for dd in range(min(kd_eff, n - 1) + 1):
             dense[idx[:n - dd], idx[:n - dd] + dd] = ab[dd:, dd + 1]
         return _band_svd(dense, kd_eff, want_u, want_vt, method, auto)
+    import jax as _jax
+    if (want_u or want_vt) and ab.dtype == np.float64 \
+            and _jax.default_backend() != "cpu":
+        # device WY back-transform only pays off off-host (see eig.py)
+        st = np.zeros((n, 3 * kd_eff + 2), dtype=np.float64)
+        for dd in range(kd_eff + 1):
+            st[:n - dd, dd + kd_eff] = ab[dd:, dd + 1]
+        return _band_svd_hh_ab(st, kd_eff, want_u, want_vt, method, auto)
     d, e, rots = _tb2bd_ab(ab, kd_eff, want_rots=want_u or want_vt)
     return _stage3_svd(d, e, rots, want_u, want_vt, method, auto)
 

@@ -126,6 +126,10 @@ def _load():
             fn = getattr(lib, name)
             fn.restype = i64
             fn.argtypes = [p, i64, i64, i64, p, p, p]
+        lib.slate_hb2st_hh_f64.restype = i64
+        lib.slate_hb2st_hh_f64.argtypes = [p, i64, i64, i64, p, p, p, p]
+        lib.slate_tb2bd_hh_f64.restype = i64
+        lib.slate_tb2bd_hh_f64.argtypes = [p, i64, i64, i64] + [p] * 8
         for name in ("slate_tb2bd_f64", "slate_tb2bd_c128"):
             fn = getattr(lib, name)
             fn.restype = i64
@@ -380,6 +384,93 @@ def hb2st_banded(ab: np.ndarray, n: int, kd: int, want_rots: bool = True):
               _c_ptr(ss))
     assert nrot == cap, (nrot, cap)
     return planes, cs, ss
+
+
+def hh_step_count(n: int, kd: int) -> int:
+    """Reflector count of the Householder chase (one per chase window)."""
+    total = 0
+    for j in range(max(n - 2, 0)):
+        L = min(kd, n - 1 - j)
+        if L < 2:
+            continue
+        total += 1
+        r0 = j + 1
+        while True:
+            r1 = r0 + L
+            Lt = min(kd, n - r1)
+            if Lt < 2:
+                break
+            total += 1
+            r0, L = r1, Lt
+    return total
+
+
+def hb2st_hh_banded(abw: np.ndarray, n: int, kd: int):
+    """Compiled Householder band→tridiagonal chase (SLATE hebr1/2/3
+    schedule) on WIDE lower-band storage ``abw[(n, 2·kd+2)]``
+    (``abw[c, d]`` = A[c+d, c]; the extra width holds the bulge block).
+    Modified in place.  Returns ``(v, tau, row0, length)`` — the
+    reflector log: ``v[(nstep, kd)]`` (v[0] = 1 stored), disjoint
+    adjacent row windows within each sweep, enabling the batched WY
+    device back-transform.  Real f64 only."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    assert abw.shape == (n, 2 * kd + 2) and abw.flags.c_contiguous
+    assert abw.dtype == np.float64
+    cap = hh_step_count(n, kd)
+    v = np.zeros((cap, kd), dtype=np.float64)
+    tau = np.zeros(cap, dtype=np.float64)
+    row0 = np.zeros(cap, dtype=np.int32)
+    length = np.zeros(cap, dtype=np.int32)
+    nstep = lib.slate_hb2st_hh_f64(_c_ptr(abw), n, kd, 2 * kd + 2,
+                                   _c_ptr(v), _c_ptr(tau), _c_ptr(row0),
+                                   _c_ptr(length))
+    assert nstep == cap, (nstep, cap)
+    return v, tau, row0, length
+
+
+def bd_step_count(n: int, kd: int) -> int:
+    """Reflector count per log of the bidiagonal Householder chase."""
+    total = 0
+    for s in range(max(n - 1, 0)):
+        c_hi = min(s + kd, n - 1)
+        r_hi = min(s + kd, n - 1)
+        if c_hi <= s + 1 and r_hi <= s + 1:
+            continue
+        total += 1
+        b = 1
+        while b * kd + 1 + s <= n - 1:
+            total += 1
+            b += 1
+    return total
+
+
+def tb2bd_hh_banded(st: np.ndarray, n: int, kd: int):
+    """Compiled Householder band→bidiagonal chase (SLATE gebr1/2/3
+    schedule) on row-major general-band storage ``st[(n, 3·kd+2)]``
+    (``st[r, c-r+kd]`` = A[r, c]).  Modified in place.  Returns
+    ``((uv, utau, urow0, ulen), (vv, vtau, vrow0, vlen))`` — the left
+    (U) and right (V) reflector logs, each with per-sweep disjoint
+    kd-strided windows.  Real f64 only."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    assert st.shape == (n, 3 * kd + 2) and st.flags.c_contiguous
+    assert st.dtype == np.float64
+    cap = bd_step_count(n, kd)
+    mk = lambda: (np.zeros((cap, kd)), np.zeros(cap),
+                  np.zeros(cap, np.int32), np.zeros(cap, np.int32))
+    uv, utau, urow0, ulen = mk()
+    vv, vtau, vrow0, vlen = mk()
+    nstep = lib.slate_tb2bd_hh_f64(
+        _c_ptr(st), n, kd, 3 * kd + 2, _c_ptr(uv), _c_ptr(utau),
+        _c_ptr(urow0), _c_ptr(ulen), _c_ptr(vv), _c_ptr(vtau),
+        _c_ptr(vrow0), _c_ptr(vlen))
+    assert nstep == cap, (nstep, cap)
+    return (uv, utau, urow0, ulen), (vv, vtau, vrow0, vlen)
 
 
 def tb2bd_banded(ab: np.ndarray, n: int, kd: int, want_rots: bool = True):

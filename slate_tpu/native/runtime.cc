@@ -600,6 +600,252 @@ int64_t hb2st_impl(T* ab, int64_t n, int64_t kd, int64_t ldab,
     return nrot;
 }
 
+// ---------------------------------------------------------------------
+// Householder-based band→tridiagonal chase (SLATE's hebr1/2/3 schedule,
+// src/internal/internal_hebr.cc; Bischof–Lang SBR): one length-≤kd
+// reflector per chase step instead of kd Givens rotations.  Same
+// O(n²·kd) band work, but the logged reflectors of one sweep occupy
+// DISJOINT adjacent row windows — so the eigenvector back-transform
+// becomes per-sweep batched WY gemms on the accelerator (the reference
+// applies its V blocks the same way in unmtr_hb2st.cc), instead of
+// 6-flop rotation streaming on the host.
+//
+// Storage: lower band, ab[c*ldab + (i-c)] = A[i, c]; the bulge block
+// spans i-c ≤ 2·kd−1, so callers hand a WIDE band with ldab ≥ 2kd+1.
+// Real double only (the complex path keeps the Givens chase).
+// ---------------------------------------------------------------------
+
+static inline void larfg_d(int64_t L, double* x, double& tau) {
+    double xnorm = 0.0;
+    for (int64_t i = 1; i < L; ++i) xnorm = std::hypot(xnorm, x[i]);
+    double alpha = x[0];
+    if (xnorm == 0.0) { tau = 0.0; return; }
+    double beta = -std::copysign(std::hypot(alpha, xnorm), alpha);
+    tau = (beta - alpha) / beta;
+    double scal = 1.0 / (alpha - beta);
+    for (int64_t i = 1; i < L; ++i) x[i] *= scal;
+    x[0] = beta;
+}
+
+struct HhLog {
+    double* v;        // (cap, kd) row-major, v[0] = 1 implicit NOT stored?
+    double* tau;      // (cap,)
+    int32_t* row0;    // (cap,)
+    int32_t* len;     // (cap,)
+    int64_t kd;
+    int64_t count = 0;
+
+    void push(int64_t r0, int64_t L, const double* vv, double tv) {
+        if (v) {
+            double* dst = v + count * kd;
+            for (int64_t i = 0; i < L; ++i) dst[i] = vv[i];
+            for (int64_t i = L; i < kd; ++i) dst[i] = 0.0;
+            tau[count] = tv;
+            row0[count] = (int32_t)r0;
+            len[count] = (int32_t)L;
+        }
+        ++count;
+    }
+};
+
+// Symmetric two-sided reflector application on the stored lower band:
+// S ← (I−τvvᵀ)·S·(I−τvvᵀ) over rows/cols [r, r+L).
+static void hh_two_sided(double* ab, int64_t ldab, int64_t r, int64_t L,
+                         const double* v, double tau, double* w) {
+    auto S = [&](int64_t i, int64_t c) -> double& {
+        return (i >= c) ? ab[(r + c) * ldab + (i - c)]
+                        : ab[(r + i) * ldab + (c - i)];
+    };
+    for (int64_t i = 0; i < L; ++i) {
+        double acc = 0.0;
+        for (int64_t c = 0; c < L; ++c) acc += S(i, c) * v[c];
+        w[i] = tau * acc;
+    }
+    double dot = 0.0;
+    for (int64_t i = 0; i < L; ++i) dot += w[i] * v[i];
+    double half = 0.5 * tau * dot;
+    for (int64_t i = 0; i < L; ++i) w[i] -= half * v[i];
+    for (int64_t c = 0; c < L; ++c)
+        for (int64_t i = c; i < L; ++i)
+            ab[(r + c) * ldab + (i - c)] -= v[i] * w[c] + w[i] * v[c];
+}
+
+static int64_t hb2st_hh_impl(double* ab, int64_t n, int64_t kd,
+                             int64_t ldab, HhLog& log) {
+    std::vector<double> vbuf((size_t)kd), wbuf((size_t)kd),
+        colbuf((size_t)kd);
+    auto BA = [&](int64_t i, int64_t c) -> double& {
+        return ab[c * ldab + (i - c)];   // i >= c
+    };
+    for (int64_t j = 0; j <= n - 3; ++j) {
+        int64_t L = std::min(kd, n - 1 - j);
+        if (L < 2) continue;
+        int64_t r0 = j + 1;
+        // reflector 0 from column j's sub-band (keep A[j+1, j])
+        for (int64_t i = 0; i < L; ++i) vbuf[i] = BA(r0 + i, j);
+        double tau;
+        larfg_d(L, vbuf.data(), tau);
+        BA(r0, j) = vbuf[0];             // β
+        for (int64_t i = 1; i < L; ++i) BA(r0 + i, j) = 0.0;
+        vbuf[0] = 1.0;
+        hh_two_sided(ab, ldab, r0, L, vbuf.data(), tau, wbuf.data());
+        log.push(r0, L, vbuf.data(), tau);
+        for (;;) {
+            int64_t r1 = r0 + L;
+            int64_t Lt = std::min(kd, n - r1);
+            if (Lt < 1) break;
+            // right-apply the previous reflector to the coupling block
+            // B = A[r1:r1+Lt, r0:r0+L)  (creates the bulge)
+            for (int64_t i = 0; i < Lt; ++i) {
+                double acc = 0.0;
+                for (int64_t c = 0; c < L; ++c)
+                    acc += BA(r1 + i, r0 + c) * vbuf[c];
+                acc *= tau;
+                for (int64_t c = 0; c < L; ++c)
+                    BA(r1 + i, r0 + c) -= acc * vbuf[c];
+            }
+            if (Lt < 2) break;
+            // new reflector from B's first column
+            for (int64_t i = 0; i < Lt; ++i) colbuf[i] = BA(r1 + i, r0);
+            double tau2;
+            larfg_d(Lt, colbuf.data(), tau2);
+            BA(r1, r0) = colbuf[0];
+            for (int64_t i = 1; i < Lt; ++i) BA(r1 + i, r0) = 0.0;
+            colbuf[0] = 1.0;
+            // left-apply it to the remaining columns of B
+            for (int64_t c = 1; c < L; ++c) {
+                double acc = 0.0;
+                for (int64_t i = 0; i < Lt; ++i)
+                    acc += colbuf[i] * BA(r1 + i, r0 + c);
+                acc *= tau2;
+                for (int64_t i = 0; i < Lt; ++i)
+                    BA(r1 + i, r0 + c) -= acc * colbuf[i];
+            }
+            hh_two_sided(ab, ldab, r1, Lt, colbuf.data(), tau2,
+                         wbuf.data());
+            log.push(r1, Lt, colbuf.data(), tau2);
+            std::swap(vbuf, colbuf);
+            tau = tau2;
+            r0 = r1;
+            L = Lt;
+        }
+    }
+    return log.count;
+}
+
+// Householder band→bidiagonal chase (SLATE's gebr1/2/3 task partition,
+// src/internal/internal_gebr.cc + src/tb2bd.cc block slicing): per sweep
+// s, a right reflector kills row s beyond the superdiagonal, a left
+// reflector kills the resulting first-column bulge, then per chase block
+// b: left-apply the previous U to the off-diagonal block, generate the
+// next right reflector from its first row, right-apply to the diagonal
+// block, generate the next left reflector from its first column.  Both
+// logs have the per-sweep disjoint kd-strided window structure (U rows
+// from s+1, V cols from s+1) that the batched WY device appliers need.
+//
+// Storage: row-major general band st[r*ldw + (c-r+kd)], c-r ∈
+// [-kd, 2kd+1], ldw = 3kd+2.  Real double only.
+static int64_t tb2bd_hh_impl(double* st, int64_t n, int64_t kd,
+                             int64_t ldw, HhLog& ulog, HhLog& vlog) {
+    auto A = [&](int64_t r, int64_t c) -> double& {
+        return st[r * ldw + (c - r + kd)];
+    };
+    std::vector<double> ubuf((size_t)kd), xbuf((size_t)kd);
+    for (int64_t s = 0; s <= n - 2; ++s) {
+        int64_t c_lo = s + 1, c_hi = std::min(s + kd, n - 1);
+        int64_t r_hi = std::min(s + kd, n - 1);
+        if (c_hi <= c_lo && r_hi <= s + 1) continue;
+        int64_t Lv = c_hi - c_lo + 1;
+        double tauv = 0.0, tauu = 0.0;
+        // right reflector v0 from row s (keep A[s, s+1])
+        for (int64_t c = 0; c < Lv; ++c) xbuf[c] = A(s, c_lo + c);
+        larfg_d(Lv, xbuf.data(), tauv);
+        A(s, c_lo) = xbuf[0];
+        for (int64_t c = 1; c < Lv; ++c) A(s, c_lo + c) = 0.0;
+        xbuf[0] = 1.0;
+        for (int64_t r = s + 1; r <= r_hi; ++r) {
+            double acc = 0.0;
+            for (int64_t c = 0; c < Lv; ++c) acc += A(r, c_lo + c) * xbuf[c];
+            acc *= tauv;
+            for (int64_t c = 0; c < Lv; ++c) A(r, c_lo + c) -= acc * xbuf[c];
+        }
+        vlog.push(c_lo, Lv, xbuf.data(), tauv);
+        // left reflector u0 from column s+1 below the diagonal
+        int64_t Lu = r_hi - s;
+        for (int64_t r = 0; r < Lu; ++r) ubuf[r] = A(s + 1 + r, c_lo);
+        larfg_d(Lu, ubuf.data(), tauu);
+        A(s + 1, c_lo) = ubuf[0];
+        for (int64_t r = 1; r < Lu; ++r) A(s + 1 + r, c_lo) = 0.0;
+        ubuf[0] = 1.0;
+        for (int64_t c = c_lo + 1; c <= c_hi; ++c) {
+            double acc = 0.0;
+            for (int64_t r = 0; r < Lu; ++r)
+                acc += ubuf[r] * A(s + 1 + r, c);
+            acc *= tauu;
+            for (int64_t r = 0; r < Lu; ++r)
+                A(s + 1 + r, c) -= acc * ubuf[r];
+        }
+        ulog.push(s + 1, Lu, ubuf.data(), tauu);
+        for (int64_t b = 1;; ++b) {
+            int64_t i_lo = (b - 1) * kd + 1 + s;
+            int64_t i_hi = std::min(i_lo + kd - 1, n - 1);
+            int64_t j_lo = b * kd + 1 + s;
+            int64_t j_hi = std::min(j_lo + kd - 1, n - 1);
+            if (j_lo > n - 1) break;
+            int64_t Li = i_hi - i_lo + 1, Lj = j_hi - j_lo + 1;
+            // gebr2: left-apply u_{b-1} to the off-diagonal block
+            for (int64_t c = j_lo; c <= j_hi; ++c) {
+                double acc = 0.0;
+                for (int64_t r = 0; r < Li; ++r)
+                    acc += ubuf[r] * A(i_lo + r, c);
+                acc *= tauu;
+                for (int64_t r = 0; r < Li; ++r)
+                    A(i_lo + r, c) -= acc * ubuf[r];
+            }
+            // next right reflector from the block's first row
+            for (int64_t c = 0; c < Lj; ++c) xbuf[c] = A(i_lo, j_lo + c);
+            larfg_d(Lj, xbuf.data(), tauv);
+            A(i_lo, j_lo) = xbuf[0];
+            for (int64_t c = 1; c < Lj; ++c) A(i_lo, j_lo + c) = 0.0;
+            xbuf[0] = 1.0;
+            for (int64_t r = i_lo + 1; r <= i_hi; ++r) {
+                double acc = 0.0;
+                for (int64_t c = 0; c < Lj; ++c)
+                    acc += A(r, j_lo + c) * xbuf[c];
+                acc *= tauv;
+                for (int64_t c = 0; c < Lj; ++c)
+                    A(r, j_lo + c) -= acc * xbuf[c];
+            }
+            vlog.push(j_lo, Lj, xbuf.data(), tauv);
+            // gebr3: right-apply it to the diagonal block
+            for (int64_t r = j_lo; r <= j_hi; ++r) {
+                double acc = 0.0;
+                for (int64_t c = 0; c < Lj; ++c)
+                    acc += A(r, j_lo + c) * xbuf[c];
+                acc *= tauv;
+                for (int64_t c = 0; c < Lj; ++c)
+                    A(r, j_lo + c) -= acc * xbuf[c];
+            }
+            // next left reflector from the block's first column
+            for (int64_t r = 0; r < Lj; ++r) ubuf[r] = A(j_lo + r, j_lo);
+            larfg_d(Lj, ubuf.data(), tauu);
+            A(j_lo, j_lo) = ubuf[0];
+            for (int64_t r = 1; r < Lj; ++r) A(j_lo + r, j_lo) = 0.0;
+            ubuf[0] = 1.0;
+            for (int64_t c = j_lo + 1; c <= j_hi; ++c) {
+                double acc = 0.0;
+                for (int64_t r = 0; r < Lj; ++r)
+                    acc += ubuf[r] * A(j_lo + r, c);
+                acc *= tauu;
+                for (int64_t r = 0; r < Lj; ++r)
+                    A(j_lo + r, c) -= acc * ubuf[r];
+            }
+            ulog.push(j_lo, Lj, ubuf.data(), tauu);
+        }
+    }
+    return ulog.count;
+}
+
 // Upper-band two-sided rotations for tb2bd (see layout above).
 template <typename T>
 inline T& ub(T* ab, int64_t ldab, int64_t r, int64_t c) {
@@ -803,6 +1049,22 @@ extern "C" {
 int64_t slate_hb2st_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,
                         int32_t* planes, double* cs, double* ss) {
     return hb2st_impl<double>(ab, n, kd, ldab, planes, cs, ss);
+}
+
+int64_t slate_hb2st_hh_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,
+                           double* v, double* tau, int32_t* row0,
+                           int32_t* len) {
+    HhLog log{v, tau, row0, len, kd};
+    return hb2st_hh_impl(ab, n, kd, ldab, log);
+}
+
+int64_t slate_tb2bd_hh_f64(double* st, int64_t n, int64_t kd, int64_t ldw,
+                           double* uv, double* utau, int32_t* urow0,
+                           int32_t* ulen, double* vv, double* vtau,
+                           int32_t* vrow0, int32_t* vlen) {
+    HhLog ulog{uv, utau, urow0, ulen, kd};
+    HhLog vlog{vv, vtau, vrow0, vlen, kd};
+    return tb2bd_hh_impl(st, n, kd, ldw, ulog, vlog);
 }
 
 int64_t slate_hb2st_c128(void* ab, int64_t n, int64_t kd, int64_t ldab,

@@ -330,22 +330,28 @@ def lauum_rec(uplo: Uplo, a, nb: int, conj: bool = True):
 def potrf_panels(a, nb: int = 512):
     """Right-looking blocked Cholesky whose panel step is the fused
     Pallas ``chol_inv_panel`` kernel (L and L⁻¹ of the diagonal block in
-    one VMEM launch): every panel trsm becomes an MXU gemm against L⁻¹.
+    one VMEM launch): every panel trsm becomes an MXU gemm against L⁻¹,
+    and the trailing herk touches only block-column strips at/below the
+    diagonal — half the flops of the full-square update (the reference's
+    ``internal::herk`` also updates only the stored triangle,
+    ``internal_herk.cc``).
 
-    The ``config.use_pallas`` hand-tuned path of the potrf driver
-    (reference ``internal_potrf.cc:53-72`` + batched trsm).  f32 only;
-    measured slightly behind XLA's own blocked cholesky on current
-    Mosaic (the in-kernel rank-1 loops are latency-bound), kept as the
-    kernel-path proof and for future Mosaic improvements.
+    The TPU-default potrf path (reference ``internal_potrf.cc:53-72`` +
+    batched trsm): the round-3 unrolled kernel factors a 512² diagonal
+    block + inverse in ~290 µs vs ~1190 µs for XLA's cholesky on the
+    same chip.  f32 only (other dtypes take the XLA base case).
     """
 
     from .pallas_kernels import chol_inv_panel
 
     n = a.shape[-1]
+    # trailing strip width: measured optimum on v5e (tools sweep:
+    # ws=2048 → 54.9 TF/s, 4096 → 39.9, full-square → 29.9 at n=8192)
+    ws = max(nb, 2048)
     for k0 in range(0, n, nb):
         w = min(nb, n - k0)
         akk = a[k0:k0 + w, k0:k0 + w]
-        if w == nb and a.dtype == jnp.float32:
+        if w == nb and (nb & (nb - 1)) == 0 and a.dtype == jnp.float32:
             lkk, linv = chol_inv_panel(akk)
         else:
             lkk = jnp.tril(lax.linalg.cholesky(akk))
@@ -355,5 +361,11 @@ def potrf_panels(a, nb: int = 512):
         if k0 + w < n:
             l21 = matmul(a[k0 + w:, k0:k0 + w], _ct(linv))
             a = a.at[k0 + w:, k0:k0 + w].set(l21)
-            a = a.at[k0 + w:, k0 + w:].add(-matmul(l21, _ct(l21)))
+            # triangular trailing update in block-column strips: strip j
+            # only updates rows >= its own start
+            for j0 in range(k0 + w, n, ws):
+                jw = min(ws, n - j0)
+                lj = l21[j0 - (k0 + w):j0 - (k0 + w) + jw]
+                a = a.at[j0:, j0:j0 + jw].add(
+                    -matmul(l21[j0 - (k0 + w):], _ct(lj)))
     return jnp.tril(a)

@@ -44,12 +44,16 @@ class DistMatrix:
 
     Fields
     ------
-    data : jax.Array of shape (mtp*nb, ntp*nb), sharded P('p','q')
+    data : jax.Array of shape (mtp*mb, ntp*nb), sharded P('p','q')
         Padded storage in shuffled tile order.
     m, n : true (unpadded) dimensions.
-    nb : square tile size (the dist path uses mb == nb, like the
-        reference tester's default).
+    nb : column tile size.
     mesh : the p×q device mesh.
+    mb : row tile size; None (the default and the common case — the
+        reference tester's default is square tiles too) means ``nb``.
+        The factorization/solve drivers require mb == nb; pgemm and the
+        elementwise ops accept rectangular tiles (reference lambda tile
+        ctor, ``BaseMatrix.hh:765-771``).
     """
 
     data: jax.Array
@@ -57,6 +61,12 @@ class DistMatrix:
     n: int
     nb: int
     mesh: jax.sharding.Mesh
+    mb: Optional[int] = None
+
+    @property
+    def row_nb(self) -> int:
+        """Effective row tile size (mb, defaulting to nb)."""
+        return self.nb if self.mb is None else self.mb
 
     @property
     def grid_shape(self):
@@ -64,7 +74,7 @@ class DistMatrix:
 
     @property
     def mtp(self) -> int:
-        return self.data.shape[0] // self.nb
+        return self.data.shape[0] // self.row_nb
 
     @property
     def ntp(self) -> int:
@@ -76,7 +86,9 @@ class DistMatrix:
 
     def __repr__(self):
         p, q = self.grid_shape
-        return (f"DistMatrix({self.m}x{self.n}, nb={self.nb}, grid={p}x{q}, "
+        tile = (f"nb={self.nb}" if self.mb is None
+                else f"mb={self.mb}, nb={self.nb}")
+        return (f"DistMatrix({self.m}x{self.n}, {tile}, grid={p}x{q}, "
                 f"padded={self.data.shape}, dtype={self.dtype})")
 
 
@@ -88,7 +100,8 @@ def padded_tiles(m: int, nb: int, p: int) -> int:
 
 def distribute(a, mesh: jax.sharding.Mesh, nb: int = 256,
                diag_pad: float = 0.0, row_mult: Optional[int] = None,
-               col_mult: Optional[int] = None) -> DistMatrix:
+               col_mult: Optional[int] = None,
+               mb: Optional[int] = None) -> DistMatrix:
     """Scatter a dense (m, n) array block-cyclically over ``mesh``.
 
     Analog of ``Matrix::fromLAPACK`` + ``redistribute`` (``Matrix.hh:290``,
@@ -101,19 +114,20 @@ def distribute(a, mesh: jax.sharding.Mesh, nb: int = 256,
     a = jnp.asarray(a)
     m, n = a.shape
     p, q = mesh_grid_shape(mesh)
-    mtp = padded_tiles(m, nb, math.lcm(p, row_mult) if row_mult else p)
+    rb = nb if mb is None else mb
+    mtp = padded_tiles(m, rb, math.lcm(p, row_mult) if row_mult else p)
     ntp = padded_tiles(n, nb, math.lcm(q, col_mult) if col_mult else q)
-    mp, np_ = mtp * nb, ntp * nb
+    mp, np_ = mtp * rb, ntp * nb
     pad = jnp.zeros((mp, np_), a.dtype)
     pad = pad.at[:m, :n].set(a)
     if diag_pad != 0.0 and mp > m and np_ > n:
         k = min(mp - m, np_ - n)
         pad = pad.at[m:m + k, n:n + k].set(
             diag_pad * jnp.eye(k, dtype=a.dtype))
-    pad = _permute_blocks(pad, cyclic_permutation(mtp, p), 0, nb)
+    pad = _permute_blocks(pad, cyclic_permutation(mtp, p), 0, rb)
     pad = _permute_blocks(pad, cyclic_permutation(ntp, q), 1, nb)
     sharding = NamedSharding(mesh, P(AXIS_P, AXIS_Q))
-    return DistMatrix(jax.device_put(pad, sharding), m, n, nb, mesh)
+    return DistMatrix(jax.device_put(pad, sharding), m, n, nb, mesh, mb=mb)
 
 
 def undistribute(dm: DistMatrix) -> jax.Array:
@@ -122,7 +136,7 @@ def undistribute(dm: DistMatrix) -> jax.Array:
 
     p, q = dm.grid_shape
     a = dm.data
-    a = _permute_blocks(a, inverse_permutation(cyclic_permutation(dm.mtp, p)), 0, dm.nb)
+    a = _permute_blocks(a, inverse_permutation(cyclic_permutation(dm.mtp, p)), 0, dm.row_nb)
     a = _permute_blocks(a, inverse_permutation(cyclic_permutation(dm.ntp, q)), 1, dm.nb)
     return a[:dm.m, :dm.n]
 
@@ -130,4 +144,4 @@ def undistribute(dm: DistMatrix) -> jax.Array:
 def like(dm: DistMatrix, data: jax.Array, m: Optional[int] = None,
          n: Optional[int] = None) -> DistMatrix:
     return DistMatrix(data, dm.m if m is None else m,
-                      dm.n if n is None else n, dm.nb, dm.mesh)
+                      dm.n if n is None else n, dm.nb, dm.mesh, mb=dm.mb)

@@ -28,7 +28,10 @@ from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
 @lru_cache(maxsize=None)
-def _build_pgemm(mesh, nb: int, ktp: int, dtype_name: str):
+def _build_pgemm(mesh, kb: int, ktp: int, dtype_name: str):
+    """kb is the contraction tile size: A's column tiles == B's row
+    tiles (A's row tiles and B's column tiles may differ — rectangular
+    tiles ride through untouched)."""
     p, q = mesh_grid_shape(mesh)
 
     def kernel(a_loc, b_loc, c_loc, alpha, beta):
@@ -39,11 +42,11 @@ def _build_pgemm(mesh, nb: int, ktp: int, dtype_name: str):
 
         def body(k, acc):
             # A block-column k lives on mesh column k%q at local column k//q
-            a_panel = lax.dynamic_slice(a_loc, (0, (k // q) * nb), (mal, nb))
+            a_panel = lax.dynamic_slice(a_loc, (0, (k // q) * kb), (mal, kb))
             a_panel = a_panel * (k % q == c).astype(a_panel.dtype)
             a_col = lax.psum(a_panel, AXIS_Q)
             # B block-row k lives on mesh row k%p at local row k//p
-            b_panel = lax.dynamic_slice(b_loc, ((k // p) * nb, 0), (nb, nbl))
+            b_panel = lax.dynamic_slice(b_loc, ((k // p) * kb, 0), (kb, nbl))
             b_panel = b_panel * (k % p == r).astype(b_panel.dtype)
             b_row = lax.psum(b_panel, AXIS_P)
             return acc + _mm(a_col, b_row)
@@ -80,8 +83,9 @@ def pgemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
     if a.n != b.m:
         raise ValueError(f"inner dimensions differ: A is {a.m}x{a.n}, "
                          f"B is {b.m}x{b.n}")
-    if a.nb != b.nb:
-        raise ValueError("pgemm requires matching tile sizes")
+    if a.nb != b.row_nb:
+        raise ValueError("pgemm requires A's column tiles to match B's "
+                         f"row tiles, got {a.nb} vs {b.row_nb}")
     if a.mesh is not b.mesh and a.mesh != b.mesh:
         raise ValueError("pgemm operands must live on the same mesh")
     if a.ntp != b.mtp:
@@ -93,9 +97,10 @@ def pgemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
         p, q = a.grid_shape
         # sharded-at-creation zeros (a device-0 buffer would OOM at scale)
         cdata = jnp.zeros(
-            (a.mtp * a.nb, b.ntp * b.nb), a.dtype,
+            (a.mtp * a.row_nb, b.ntp * b.nb), a.dtype,
             device=jax.sharding.NamedSharding(a.mesh, P(AXIS_P, AXIS_Q)))
-        c = DistMatrix(cdata, a.m, b.n, a.nb, a.mesh)
+        c = DistMatrix(cdata, a.m, b.n, b.nb, a.mesh,
+                       mb=a.row_nb if a.row_nb != b.nb else None)
     fn = _build_pgemm(a.mesh, a.nb, a.ntp, str(a.dtype))
     out = fn(a.data, b.data, c.data,
              jnp.asarray(alpha, a.dtype), jnp.asarray(beta, a.dtype))

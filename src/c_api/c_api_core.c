@@ -1,0 +1,130 @@
+/* slate_tpu C API core: one generic entry funnels every generated typed
+ * wrapper (driver_api.c) into the Python bridge
+ * (slate_tpu.api.c_bridge.call), which runs the full JAX/XLA driver.
+ * Reference analog: src/c_api/wrappers.cc calls the C++ templates; here
+ * the compute path is JAX, so the shim embeds CPython — the accelerator
+ * still does the math.
+ *
+ * build:  gcc -shared -fPIC c_api_core.c driver_api.c -I../../include \
+ *             $(python3-config --includes --embed --ldflags) \
+ *             -o libslate_tpu_c.so
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static PyObject* g_call = NULL;   /* slate_tpu.api.c_bridge.call */
+static int g_we_initialized = 0;
+
+int slate_c_init(void) {
+    if (g_call) return 0;
+    if (!Py_IsInitialized()) {
+        Py_Initialize();
+        g_we_initialized = 1;
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    PyObject* mod = PyImport_ImportModule("slate_tpu.api.c_bridge");
+    if (!mod) { PyErr_Print(); PyGILState_Release(st); return 1; }
+    g_call = PyObject_GetAttrString(mod, "call");
+    Py_DECREF(mod);
+    PyGILState_Release(st);
+    return g_call ? 0 : 1;
+}
+
+void slate_c_finalize(void) {
+    if (g_call) { Py_XDECREF(g_call); g_call = NULL; }
+    if (g_we_initialized && Py_IsInitialized()) Py_Finalize();
+}
+
+/* dtype char -> (numpy letter code, element bytes) */
+static int dt_info(char d, char* np_code, int64_t* elem) {
+    switch (d) {
+        case 's': *np_code = 'f'; *elem = 4; return 0;   /* float32 */
+        case 'd': *np_code = 'd'; *elem = 8; return 0;
+        case 'c': *np_code = 'F'; *elem = 8; return 0;   /* complex64 */
+        case 'z': *np_code = 'D'; *elem = 16; return 0;
+    }
+    return 1;
+}
+
+/* Build a numpy array (copy) from a column-major C buffer: produced as
+ * np.ndarray of shape (n, m)? No: we hand the bridge an array of shape
+ * (m, n) in Fortran order by building from a transposed C-order copy. */
+static PyObject* np_from_colmajor(char np_code, int64_t m, int64_t n,
+                                  const void* a, int64_t lda,
+                                  int64_t elem) {
+    /* make a contiguous (n, m) C-order buffer = the transpose view the
+     * bridge expects (it transposes back to logical (m, n)) */
+    PyObject* np = PyImport_ImportModule("numpy");
+    if (!np) return NULL;
+    char code[2] = {np_code, 0};
+    PyObject* dt = PyObject_CallMethod(np, "dtype", "s", code);
+    PyObject* bytes = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)(m * n * elem));
+    if (!bytes || !dt) { Py_XDECREF(dt); Py_XDECREF(bytes); Py_DECREF(np); return NULL; }
+    char* dst = PyBytes_AS_STRING(bytes);
+    const char* src = (const char*)a;
+    for (int64_t c = 0; c < n; ++c)
+        memcpy(dst + c * m * elem, src + c * lda * elem, (size_t)(m * elem));
+    /* frombuffer -> shape (n, m) C-order == (m, n) column-major data */
+    PyObject* flat = PyObject_CallMethod(np, "frombuffer", "OO", bytes, dt);
+    Py_DECREF(bytes); Py_DECREF(dt);
+    if (!flat) { Py_DECREF(np); return NULL; }
+    PyObject* shaped = PyObject_CallMethod(flat, "reshape", "(LL)",
+                                           (long long)n, (long long)m);
+    Py_DECREF(flat); Py_DECREF(np);
+    return shaped;   /* bridge receives the (n, m) transpose view */
+}
+
+/* Copy one returned array (any shape, C-order) into the caller's buffer.
+ * The bridge returns arrays already transposed so that a flat C-order
+ * copy IS the caller's column-major layout. */
+static int copy_out(PyObject* arr, void* out) {
+    if (!out || arr == Py_None) return 0;
+    PyObject* np = PyImport_ImportModule("numpy");
+    PyObject* contig = PyObject_CallMethod(np, "ascontiguousarray", "O", arr);
+    Py_DECREF(np);
+    if (!contig) return 1;
+    PyObject* tob = PyObject_CallMethod(contig, "tobytes", NULL);
+    Py_DECREF(contig);
+    if (!tob) return 1;
+    memcpy(out, PyBytes_AS_STRING(tob), (size_t)PyBytes_GET_SIZE(tob));
+    Py_DECREF(tob);
+    return 0;
+}
+
+int slate_c_call(const char* op, char dtype, int64_t m, int64_t n,
+                 const void* a, int64_t lda, int64_t m2, int64_t n2,
+                 const void* b, int64_t ldb, void* out0, void* out1,
+                 void* out2, char uplo) {
+    if (slate_c_init()) return -1;
+    char np_code; int64_t elem;
+    if (dt_info(dtype, &np_code, &elem)) return -2;
+    PyGILState_STATE st = PyGILState_Ensure();
+    int rc = 0;
+    PyObject *pa = NULL, *pb = NULL, *res = NULL;
+    pa = np_from_colmajor(np_code, m, n, a, lda ? lda : m, elem);
+    if (!pa) { rc = -3; goto done; }
+    if (b) {
+        pb = np_from_colmajor(np_code, m2, n2, b, ldb ? ldb : m2, elem);
+        if (!pb) { rc = -3; goto done; }
+    } else {
+        pb = Py_None; Py_INCREF(pb);
+    }
+    {
+        char us[2] = {uplo ? uplo : 'L', 0};
+        res = PyObject_CallFunction(g_call, "sOOss", op, pa, pb, us, us);
+    }
+    if (!res) { PyErr_Print(); rc = -4; goto done; }
+    {
+        void* outs[3] = {out0, out1, out2};
+        Py_ssize_t cnt = PyTuple_Check(res) ? PyTuple_GET_SIZE(res) : 0;
+        for (Py_ssize_t i = 0; i < cnt && i < 3; ++i)
+            if (copy_out(PyTuple_GET_ITEM(res, i), outs[i])) { rc = -5; break; }
+    }
+done:
+    Py_XDECREF(pa); Py_XDECREF(pb); Py_XDECREF(res);
+    PyGILState_Release(st);
+    return rc;
+}

@@ -216,3 +216,55 @@ class TestSvdBandFastPath:
 
     def test_complex(self):
         self._run(80, 80, 16, complex_=True)
+
+
+class TestHouseholderChase:
+    """Round-3 Householder stage 2 (hebr/gebr schedules) + batched WY
+    device appliers — unit-level (the drivers gate this path to
+    accelerator backends, so CI exercises it directly)."""
+
+    def test_hb2st_hh_eig_roundtrip(self):
+        from slate_tpu import native
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from slate_tpu.linalg.eig import (_hb2st_hh_ab, unmtr_hb2st_hh,
+                                          _tridiag_solve)
+        rng = np.random.default_rng(11)
+        n, kd = 150, 16
+        ab = np.zeros((n, 2 * kd + 2))
+        ab[:, 0] = rng.standard_normal(n)
+        for d in range(1, kd + 1):
+            ab[:n - d, d] = rng.standard_normal(n - d)
+        a = np.zeros((n, n))
+        for d in range(kd + 1):
+            for c in range(n - d):
+                a[c + d, c] = ab[c, d]
+        a = a + np.tril(a, -1).T
+        d_t, e_t, log = _hb2st_hh_ab(ab.copy(), kd)
+        w, z_tri = _tridiag_solve(d_t, e_t, True, "stevd")
+        z = np.asarray(unmtr_hb2st_hh(*log, z_tri, kd))
+        assert np.linalg.norm(a @ z - z * w[None, :]) / np.linalg.norm(a) \
+            < 1e-13
+        assert np.abs(z.T @ z - np.eye(n)).max() < 1e-13
+
+    def test_tb2bd_hh_svd_roundtrip(self):
+        from slate_tpu import native
+        if not native.available():
+            pytest.skip("no native toolchain")
+        from slate_tpu.linalg.svd import _band_svd_hh_ab
+        rng = np.random.default_rng(12)
+        n, kd = 120, 8
+        b = np.zeros((n, n))
+        for d in range(kd + 1):
+            b += np.diag(rng.standard_normal(n - d), d)
+        st = np.zeros((n, 3 * kd + 2))
+        for r in range(n):
+            for c in range(max(0, r - kd), min(n, r + 2 * kd + 2)):
+                st[r, c - r + kd] = b[r, c]
+        from slate_tpu.enums import MethodSVD
+        s, u_b, vh_b = _band_svd_hh_ab(st, kd, True, True,
+                                       MethodSVD.Auto, True)
+        assert np.linalg.norm(u_b @ np.diag(s) @ vh_b - b) \
+            / np.linalg.norm(b) < 1e-13
+        assert np.abs(u_b.T @ u_b - np.eye(n)).max() < 1e-13
+        assert np.abs(vh_b @ vh_b.T - np.eye(n)).max() < 1e-13

@@ -409,3 +409,40 @@ class TestPgesvMixed:
         xv = np.asarray(undistribute(x))[:, 0]
         res = np.linalg.norm(a @ xv - b) / np.linalg.norm(b)
         assert res < 1e-12
+
+
+class TestRectangularTiles:
+    """mb != nb DistMatrix support (reference lambda tile ctor,
+    ``BaseMatrix.hh:765-771``) — VERDICT r2 item 10."""
+
+    @pytest.mark.parametrize("m,n,mb,nb", [(90, 70, 32, 16), (64, 96, 8, 24)])
+    def test_roundtrip_rect(self, mesh24, m, n, mb, nb):
+        rng = np.random.default_rng(40)
+        a = rng.standard_normal((m, n))
+        dm = distribute(a, mesh24, nb=nb, mb=mb)
+        assert dm.row_nb == mb and dm.nb == nb
+        assert np.allclose(np.asarray(undistribute(dm)), a)
+
+    def test_pgemm_rect_tiles(self, mesh24):
+        """SUMMA with A (mb=32, nb=16), B (mb=16, nb=24): contraction
+        tiles match (16), row/col tiles differ."""
+        from slate_tpu.parallel.dist_blas3 import pgemm
+        rng = np.random.default_rng(41)
+        m, k, n = 96, 80, 72
+        a = rng.standard_normal((m, k))
+        b = rng.standard_normal((k, n))
+        p, q = 2, 4
+        da = distribute(a, mesh24, nb=16, mb=32, col_mult=p)
+        db = distribute(b, mesh24, nb=24, mb=16, row_mult=q)
+        dc = pgemm(2.0, da, db)
+        assert dc.row_nb == 32 and dc.nb == 24
+        assert np.allclose(np.asarray(undistribute(dc)), 2.0 * a @ b,
+                           atol=1e-12)
+
+    def test_pgemm_rect_mismatch_raises(self, mesh24):
+        rng = np.random.default_rng(42)
+        da = distribute(rng.standard_normal((32, 32)), mesh24, nb=16, mb=32)
+        db = distribute(rng.standard_normal((32, 32)), mesh24, nb=16, mb=32)
+        from slate_tpu.parallel.dist_blas3 import pgemm
+        with pytest.raises(ValueError, match="row tiles"):
+            pgemm(1.0, da, db)

@@ -1,0 +1,276 @@
+#!/usr/bin/env python3
+"""Generate the C driver API: typed s/d/c/z wrappers over the embedded
+-CPython core call, plus the matching Fortran interface module.
+
+The analog of the reference's generated C API
+(``/root/reference/tools/c_api/generate_wrappers.py`` →
+``include/slate/c_api/slate.h``, ``src/c_api/wrappers.cc``): one table
+of drivers drives header, C bodies, and Fortran module generation.
+
+Outputs (checked in; rerun on table changes):
+  include/slate_tpu_driver.h   — typed driver declarations
+  src/c_api/driver_api.c       — generated bodies over slate_c_call()
+  fortran/slate_tpu.f90        — regenerated Fortran interfaces
+"""
+
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+
+CTYPES = {"s": "float", "d": "double",
+          "c": "float _Complex", "z": "double _Complex"}
+NPDT = {"s": "f32", "d": "f64", "c": "c64", "z": "c128"}
+
+# (op, kinds, signature, outputs-doc)
+# signature kinds:
+#   ab_x    : in a(m,n), in b(n,nrhs) -> out0 x(n,nrhs)           [+info]
+#   ab_xp   : like ab_x plus out1 ipiv(int64 n)
+#   a_f     : in a(m,n) -> out0 factor(m,n)
+#   a_fp    : a_f plus out1 ipiv(int64 min(m,n))
+#   a_ft    : a_f plus out1 tau(double/complex min(m,n))
+#   a_winv  : in a(n,n) -> out0 inverse(n,n)
+#   a_eig   : in a(n,n) -> out0 w(double n), out1 z(n,n)
+#   a_eigv  : in a(n,n) -> out0 w(double n)
+#   a_svd   : in a(m,n) -> out0 s(double k), out1 u(m,k), out2 vt(k,n)
+#   a_svdv  : in a(m,n) -> out0 s(double k)
+#   ab_c    : in a, in b -> out0 c (gemm-like)
+#   a_scal  : in a -> out0 scalar double
+DRIVERS = [
+    ("gesv", "sdcz", "ab_xp", "x = A^{-1} B, row pivots"),
+    ("posv", "sdcz", "ab_x", "x = A^{-1} B, A HPD (uplo)"),
+    ("hesv", "sdcz", "ab_x", "x = A^{-1} B, A Hermitian indefinite"),
+    ("sysv", "sd", "ab_x", "x = A^{-1} B, A symmetric indefinite"),
+    ("gels", "sdcz", "ab_x", "least-squares solution (m >= n)"),
+    ("getrf", "sdcz", "a_fp", "packed LU + row permutation"),
+    ("potrf", "sdcz", "a_f", "Cholesky factor in the stored triangle"),
+    ("geqrf", "sdcz", "a_ft", "packed QR + taus"),
+    ("gelqf", "sdcz", "a_ft", "packed LQ + taus"),
+    ("getri", "sdcz", "a_winv", "inverse from LU"),
+    ("potri", "sdcz", "a_winv", "inverse from Cholesky (uplo)"),
+    ("trtri", "sdcz", "a_winv", "triangular inverse (uplo)"),
+    ("heev", "sdcz", "a_eig", "eigenvalues + vectors (uplo)"),
+    ("syev", "sd", "a_eig", "eigenvalues + vectors (uplo)"),
+    ("heev_vals", "sdcz", "a_eigv", "eigenvalues only"),
+    ("svd", "sdcz", "a_svd", "singular values + U + V^H"),
+    ("svd_vals", "sdcz", "a_svdv", "singular values only"),
+    ("gemm", "sdcz", "ab_c", "C = A B"),
+    ("symm", "sd", "ab_c", "C = A B, A symmetric (uplo)"),
+    ("hemm", "cz", "ab_c", "C = A B, A Hermitian (uplo)"),
+    ("syrk", "sd", "a_f", "C = A A^T (uplo stored)"),
+    ("herk", "cz", "a_f", "C = A A^H (uplo stored)"),
+    ("trsm", "sdcz", "ab_c", "X = A^{-1} B, A triangular (uplo)"),
+    ("trmm", "sdcz", "ab_c", "X = A B, A triangular (uplo)"),
+    ("lange", "sdcz", "a_scal", "norm (norm char in `uplo` slot: M/1/I/F)"),
+    ("gecondest", "sd", "a_scal", "1-norm condition estimate"),
+]
+
+SIGS = {
+    "ab_x": ("int64_t m, int64_t n, const {T}* a, int64_t lda, "
+             "int64_t nrhs, const {T}* b, int64_t ldb, {T}* x, "
+             "char uplo",
+             "m, n, a, lda, m, nrhs, b, ldb, x, NULL, NULL, uplo"),
+    "ab_xp": ("int64_t m, int64_t n, const {T}* a, int64_t lda, "
+              "int64_t nrhs, const {T}* b, int64_t ldb, {T}* x, "
+              "int64_t* ipiv",
+              "m, n, a, lda, m, nrhs, b, ldb, x, ipiv, NULL, 'L'"),
+    "a_f": ("int64_t m, int64_t n, const {T}* a, int64_t lda, {T}* f, "
+            "char uplo",
+            "m, n, a, lda, 0, 0, NULL, 0, f, NULL, NULL, uplo"),
+    "a_fp": ("int64_t m, int64_t n, const {T}* a, int64_t lda, {T}* f, "
+             "int64_t* ipiv",
+             "m, n, a, lda, 0, 0, NULL, 0, f, ipiv, NULL, 'L'"),
+    "a_ft": ("int64_t m, int64_t n, const {T}* a, int64_t lda, {T}* f, "
+             "{T}* tau",
+             "m, n, a, lda, 0, 0, NULL, 0, f, tau, NULL, 'L'"),
+    "a_winv": ("int64_t n, const {T}* a, int64_t lda, {T}* inv, char uplo",
+               "n, n, a, lda, 0, 0, NULL, 0, inv, NULL, NULL, uplo"),
+    "a_eig": ("int64_t n, const {T}* a, int64_t lda, double* w, {T}* z, "
+              "char uplo",
+              "n, n, a, lda, 0, 0, NULL, 0, w, z, NULL, uplo"),
+    "a_eigv": ("int64_t n, const {T}* a, int64_t lda, double* w, char uplo",
+               "n, n, a, lda, 0, 0, NULL, 0, w, NULL, NULL, uplo"),
+    "a_svd": ("int64_t m, int64_t n, const {T}* a, int64_t lda, double* s, "
+              "{T}* u, {T}* vt",
+              "m, n, a, lda, 0, 0, NULL, 0, s, u, vt, 'L'"),
+    "a_svdv": ("int64_t m, int64_t n, const {T}* a, int64_t lda, double* s",
+               "m, n, a, lda, 0, 0, NULL, 0, s, NULL, NULL, 'L'"),
+    "ab_c": ("int64_t m, int64_t k, const {T}* a, int64_t lda, int64_t n, "
+             "const {T}* b, int64_t ldb, {T}* c, char uplo",
+             "m, k, a, lda, k, n, b, ldb, c, NULL, NULL, uplo"),
+    "a_scal": ("int64_t m, int64_t n, const {T}* a, int64_t lda, "
+               "double* value, char norm",
+               "m, n, a, lda, 0, 0, NULL, 0, value, NULL, NULL, norm"),
+}
+
+
+def gen_header():
+    lines = [
+        "/* slate_tpu driver C API — GENERATED by tools/generate_c_api.py;",
+        " * do not edit.  The analog of the reference's generated",
+        " * include/slate/c_api/slate.h: every driver callable from C,",
+        " * s/d/c/z.  Matrices are COLUMN-major with leading dimension ld*;",
+        " * outputs are caller-allocated.  Returns 0 on success.",
+        " * Implementation: src/c_api/driver_api.c embeds CPython and runs",
+        " * the full JAX/XLA driver (the TPU does the math).  Call",
+        " * slate_c_init() once first; slate_c_finalize() at exit. */",
+        "",
+        "#ifndef SLATE_TPU_DRIVER_H",
+        "#define SLATE_TPU_DRIVER_H",
+        "",
+        "#include <stdint.h>",
+        "",
+        "#ifdef __cplusplus",
+        'extern "C" {',
+        "#endif",
+        "",
+        "int slate_c_init(void);",
+        "void slate_c_finalize(void);",
+        "",
+        "/* generic core: every typed wrapper funnels through this */",
+        "int slate_c_call(const char* op, char dtype, int64_t m, int64_t n,",
+        "                 const void* a, int64_t lda, int64_t m2, int64_t n2,",
+        "                 const void* b, int64_t ldb, void* out0, void* out1,",
+        "                 void* out2, char uplo);",
+        "",
+    ]
+    for op, kinds, sig, doc in DRIVERS:
+        lines.append(f"/* {op}: {doc} */")
+        for kch in kinds:
+            T = CTYPES[kch]
+            decl = SIGS[sig][0].format(T=T)
+            lines.append(f"int slate_{kch}{op}({decl});")
+        lines.append("")
+    lines += ["#ifdef __cplusplus", "}", "#endif", "",
+              "#endif /* SLATE_TPU_DRIVER_H */", ""]
+    return "\n".join(lines)
+
+
+def gen_c_bodies():
+    lines = [
+        "/* GENERATED by tools/generate_c_api.py — do not edit.",
+        " * Typed driver wrappers over slate_c_call() (core in",
+        " * c_api_core.c).  Reference analog: src/c_api/wrappers.cc. */",
+        "",
+        '#include "slate_tpu_driver.h"',
+        "#include <stddef.h>",
+        "",
+    ]
+    for op, kinds, sig, _doc in DRIVERS:
+        for kch in kinds:
+            T = CTYPES[kch]
+            decl = SIGS[sig][0].format(T=T)
+            args = SIGS[sig][1]
+            lines += [
+                f"int slate_{kch}{op}({decl}) {{",
+                f'    return slate_c_call("{op}", \'{kch}\', {args});',
+                "}",
+                "",
+            ]
+    return "\n".join(lines)
+
+
+def gen_fortran():
+    FT = {"s": "real(c_float)", "d": "real(c_double)",
+          "c": "complex(c_float_complex)", "z": "complex(c_double_complex)"}
+    lines = [
+        "! slate_tpu Fortran module — GENERATED by tools/generate_c_api.py",
+        "! (the analog of the reference's tools/fortran/",
+        "! generate_fortran_module.py output).  Bindings over the C driver",
+        "! API; matrices column-major, as Fortran wants them anyway.",
+        "module slate_tpu",
+        "    use iso_c_binding",
+        "    implicit none",
+        "",
+        "    interface",
+        "        function slate_c_init() bind(c, name='slate_c_init')",
+        "            use iso_c_binding",
+        "            integer(c_int) :: slate_c_init",
+        "        end function",
+        "        subroutine slate_c_finalize() "
+        "bind(c, name='slate_c_finalize')",
+        "        end subroutine",
+    ]
+
+    fsig = {
+        "ab_x": ("m, n, a, lda, nrhs, b, ldb, x, uplo",
+                 ["integer(c_int64_t), value :: m, n, lda, nrhs, ldb",
+                  "{FT} :: a(lda,*), b(ldb,*), x(n,*)",
+                  "character(kind=c_char), value :: uplo"]),
+        "ab_xp": ("m, n, a, lda, nrhs, b, ldb, x, ipiv",
+                  ["integer(c_int64_t), value :: m, n, lda, nrhs, ldb",
+                   "{FT} :: a(lda,*), b(ldb,*), x(n,*)",
+                   "integer(c_int64_t) :: ipiv(*)"]),
+        "a_f": ("m, n, a, lda, f, uplo",
+                ["integer(c_int64_t), value :: m, n, lda",
+                 "{FT} :: a(lda,*), f(m,*)",
+                 "character(kind=c_char), value :: uplo"]),
+        "a_fp": ("m, n, a, lda, f, ipiv",
+                 ["integer(c_int64_t), value :: m, n, lda",
+                  "{FT} :: a(lda,*), f(m,*)",
+                  "integer(c_int64_t) :: ipiv(*)"]),
+        "a_ft": ("m, n, a, lda, f, tau",
+                 ["integer(c_int64_t), value :: m, n, lda",
+                  "{FT} :: a(lda,*), f(m,*), tau(*)"]),
+        "a_winv": ("n, a, lda, inv, uplo",
+                   ["integer(c_int64_t), value :: n, lda",
+                    "{FT} :: a(lda,*), inv(n,*)",
+                    "character(kind=c_char), value :: uplo"]),
+        "a_eig": ("n, a, lda, w, z, uplo",
+                  ["integer(c_int64_t), value :: n, lda",
+                   "{FT} :: a(lda,*), z(n,*)",
+                   "real(c_double) :: w(*)",
+                   "character(kind=c_char), value :: uplo"]),
+        "a_eigv": ("n, a, lda, w, uplo",
+                   ["integer(c_int64_t), value :: n, lda",
+                    "{FT} :: a(lda,*)",
+                    "real(c_double) :: w(*)",
+                    "character(kind=c_char), value :: uplo"]),
+        "a_svd": ("m, n, a, lda, s, u, vt",
+                  ["integer(c_int64_t), value :: m, n, lda",
+                   "{FT} :: a(lda,*), u(m,*), vt(n,*)",
+                   "real(c_double) :: s(*)"]),
+        "a_svdv": ("m, n, a, lda, s",
+                   ["integer(c_int64_t), value :: m, n, lda",
+                    "{FT} :: a(lda,*)",
+                    "real(c_double) :: s(*)"]),
+        "ab_c": ("m, k, a, lda, n, b, ldb, c, uplo",
+                 ["integer(c_int64_t), value :: m, k, lda, n, ldb",
+                  "{FT} :: a(lda,*), b(ldb,*), c(m,*)",
+                  "character(kind=c_char), value :: uplo"]),
+        "a_scal": ("m, n, a, lda, value, norm",
+                   ["integer(c_int64_t), value :: m, n, lda",
+                    "{FT} :: a(lda,*)",
+                    "real(c_double) :: value",
+                    "character(kind=c_char), value :: norm"]),
+    }
+
+    for op, kinds, sig, _doc in DRIVERS:
+        for kch in kinds:
+            name = f"slate_{kch}{op}"
+            argl, decls = fsig[sig]
+            lines.append(f"        function {name}({argl}) &")
+            lines.append(f"                bind(c, name='{name}')")
+            lines.append("            use iso_c_binding")
+            for d in decls:
+                lines.append("            " + d.format(FT=FT[kch]))
+            lines.append(f"            integer(c_int) :: {name}")
+            lines.append("        end function")
+    lines += ["    end interface", "end module slate_tpu", ""]
+    return "\n".join(lines)
+
+
+def main():
+    with open(os.path.join(ROOT, "include", "slate_tpu_driver.h"), "w") as f:
+        f.write(gen_header())
+    os.makedirs(os.path.join(ROOT, "src", "c_api"), exist_ok=True)
+    with open(os.path.join(ROOT, "src", "c_api", "driver_api.c"), "w") as f:
+        f.write(gen_c_bodies())
+    with open(os.path.join(ROOT, "fortran", "slate_tpu.f90"), "w") as f:
+        f.write(gen_fortran())
+    n = sum(len(k) for _, k, _, _ in DRIVERS)
+    print(f"generated {len(DRIVERS)} drivers, {n} typed entry points")
+
+
+if __name__ == "__main__":
+    main()

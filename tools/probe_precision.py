@@ -1,0 +1,58 @@
+"""What do DEFAULT / HIGH / HIGHEST dot precisions cost on this chip,
+and which one does `@` use?  Long chains so the tunnel RT is noise."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def wall(f, args, reps=3):
+    np.asarray(f(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(f(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 4096
+    a_np = rng.standard_normal((n, n)).astype(np.float32)
+    a = jnp.asarray(a_np)
+    iters = 24
+
+    for name, prec in [("default(@)", None),
+                       ("DEFAULT", lax.Precision.DEFAULT),
+                       ("HIGH", lax.Precision.HIGH),
+                       ("HIGHEST", lax.Precision.HIGHEST)]:
+        def fn(x, b, prec=prec):
+            def body(i, v):
+                if prec is None:
+                    return (v @ b) * jnp.float32(1e-4)
+                return jnp.matmul(v, b, precision=prec) * jnp.float32(1e-4)
+            return lax.fori_loop(0, iters, body, x)[0, 0]
+        f = jax.jit(fn)
+        t = wall(f, (a, a)) / iters
+        # accuracy of one product vs float64
+        if prec is None:
+            c = np.asarray(jax.jit(lambda x, b: x @ b)(a, a))
+        else:
+            c = np.asarray(jax.jit(
+                lambda x, b, p=prec: jnp.matmul(x, b, precision=p))(a, a))
+        ref = a_np.astype(np.float64) @ a_np.astype(np.float64)
+        err = np.abs(c - ref).max() / np.abs(ref).max()
+        print(f"{name:11s}: {t*1e3:6.2f} ms  {2*n**3/t/1e12:6.1f} TF/s  "
+              f"maxrel {err:.2e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
