@@ -76,15 +76,20 @@ def ir_refine(av, bv, solve_lo, solve_full, *, anorm, thresh, itermax,
 
 
 def fgmres_refine(av, bv, precond, solve_full, *, anorm, thresh, itermax,
-                  restart, use_fallback):
+                  restart, use_fallback, matvec=None):
     """FGMRES-IR: flexible GMRES in working precision, left-preconditioned
     by the low-precision solve; one GMRES sequence per right-hand-side
-    column (the reference iterates nrhs=1).  Returns ``(x, iters)``."""
+    column (the reference iterates nrhs=1).  Returns ``(x, iters)``.
+
+    ``matvec`` (v ↦ A·v on 1-D vectors) may be supplied by distributed
+    callers whose A never exists as one dense array (``av`` is then only
+    used by ``solve_full``/norm bookkeeping and may be None)."""
 
     squeeze = bv.ndim == 1
     if squeeze:
         bv = bv[:, None]
-    matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
+    if matvec is None:
+        matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
 
     cols = []
     total_iters = 0
@@ -109,7 +114,8 @@ def fgmres_refine(av, bv, precond, solve_full, *, anorm, thresh, itermax,
             # complex-safe, O(restart³) ≪ one matvec
             V = [r / rnorm]
             Z = []
-            H = np.zeros((restart + 1, restart), dtype=np.dtype(av.dtype))
+            H = np.zeros((restart + 1, restart),
+                         dtype=np.dtype(bv.dtype))
             k_used = 0
             for k in range(restart):
                 z = precond(V[k][:, None])[:, 0]

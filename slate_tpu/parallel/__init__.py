@@ -15,7 +15,8 @@ fall out for free: the same SPMD code on a 1×1 mesh.
 from .mesh import default_mesh, make_grid_mesh, mesh_grid_shape  # noqa: F401
 from .dist import DistMatrix, distribute, undistribute  # noqa: F401
 from .dist_blas3 import pgemm  # noqa: F401
-from .dist_factor import ppotrf, ppotrs, pposv  # noqa: F401
+from .dist_factor import (ppotrf, ppotrs, pposv, pposv_mixed,  # noqa: F401
+                          pposv_mixed_gmres)
 from .dist_lu import pgesv, pgesv_mixed, pgetrf, pgetrs  # noqa: F401
 from .dist_qr import pgeqrf, pgels, punmqr_conj  # noqa: F401
 from .dist_aux import (  # noqa: F401
@@ -29,4 +30,6 @@ from .dist_twostage import (  # noqa: F401
 from .dist_util import peye, predistribute, ptranspose  # noqa: F401
 from .dist_lu import pgecondest, pgetri  # noqa: F401
 from .dist_qr import pgelqf, punmlq  # noqa: F401
-from .dist_band import pgbsv, ppbsv  # noqa: F401
+from .dist_band import (pgbsv, ppbsv, pgbmm, phbmm, ptbsm  # noqa: F401
+                        )
+from .dist_hesv import phetrf, phetrs, phesv  # noqa: F401

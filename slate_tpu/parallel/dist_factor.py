@@ -237,3 +237,116 @@ def pposv(a, b, mesh, nb: int = 256):
     l = ppotrf(ad)
     x = ppotrs(l, bd)
     return l, x
+
+
+def pposv_mixed(a, b, mesh=None, nb: int = 256, *, tol=None,
+                itermax: int = 30, use_fallback: bool = True):
+    """Distributed mixed-precision Cholesky solve with iterative
+    refinement — the reference's ``posv_mixed`` over the mesh
+    (``src/posv_mixed.cc``): factor once in low precision with
+    :func:`ppotrf`, iterate working-precision residuals with the SUMMA
+    pgemm, re-solve corrections against the low factor; the loop is the
+    shared :func:`~slate_tpu.linalg._refine.ir_refine_core` with
+    DistMatrix hooks (the pgesv_mixed pattern).
+
+    ``a`` is the dense Hermitian matrix (replicated) or a ready
+    DistMatrix with square padding.  Returns ``(x, iters)`` with the
+    reference's negative-``iters`` fallback convention.
+    """
+
+    from ..linalg._refine import ir_refine_core, lo_dtype
+    from .dist import distribute, like
+    from .dist_blas3 import pgemm
+    from .mesh import mesh_grid_shape
+
+    if isinstance(a, DistMatrix):
+        ad = a
+        mesh = ad.mesh
+    else:
+        p, q = mesh_grid_shape(mesh)
+        a = jnp.asarray(a)
+        ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    b = jnp.asarray(b)
+    if b.ndim == 1:
+        b = b[:, None]
+    p, q = mesh_grid_shape(mesh)
+    bd = distribute(b, mesh, ad.nb, row_mult=q)
+    n = ad.n
+    lo = lo_dtype(ad.dtype)
+    eps = float(jnp.finfo(ad.dtype).eps)
+    anorm = float(jnp.max(jnp.sum(jnp.abs(
+        a if not isinstance(a, DistMatrix) else ad.data), axis=1)))
+    thresh = float(tol) if tol is not None else eps * float(n) ** 0.5
+
+    l_lo = ppotrf(like(ad, ad.data.astype(lo)))
+
+    def solve_lo(rd):
+        xc = ppotrs(l_lo, like(rd, rd.data.astype(lo)))
+        return like(rd, xc.data.astype(ad.dtype))
+
+    def solve_full(bd2):
+        return ppotrs(ppotrf(ad), bd2)
+
+    def residual(x):
+        return like(bd, bd.data - pgemm(1.0, ad, x).data)
+
+    return ir_refine_core(
+        bd, solve_lo, solve_full, residual,
+        anorm=anorm, thresh=thresh, itermax=itermax,
+        use_fallback=use_fallback,
+        add=lambda x, d: like(x, x.data + d.data),
+        absmax=lambda v: float(jnp.max(jnp.abs(v.data))))
+
+
+def pposv_mixed_gmres(a, b, mesh=None, nb: int = 256, *, tol=None,
+                      itermax: int = 30, restart: int = 30,
+                      use_fallback: bool = True):
+    """Distributed FGMRES-IR over a low-precision distributed Cholesky
+    preconditioner — reference ``slate::posv_mixed_gmres``
+    (``src/posv_mixed_gmres.cc``).  The Krylov vectors live replicated
+    (O(n·restart)); every matvec and preconditioner apply rides the
+    mesh (SUMMA pgemm / ppotrs).  Returns ``(x, iters)``.
+    """
+
+    from ..linalg._refine import fgmres_refine, lo_dtype
+    from .dist import distribute, like, undistribute
+    from .dist_blas3 import pgemm
+    from .mesh import mesh_grid_shape
+
+    if isinstance(a, DistMatrix):
+        ad = a
+        mesh = ad.mesh
+    else:
+        p, q = mesh_grid_shape(mesh)
+        a = jnp.asarray(a)
+        ad = distribute(a, mesh, nb, diag_pad=1.0, row_mult=q, col_mult=p)
+    b = jnp.asarray(b)
+    p, q = mesh_grid_shape(mesh)
+    n = ad.n
+    lo = lo_dtype(ad.dtype)
+    eps = float(jnp.finfo(ad.dtype).eps)
+    anorm = float(jnp.max(jnp.sum(jnp.abs(
+        a if not isinstance(a, DistMatrix) else ad.data), axis=1)))
+    thresh = float(tol) if tol is not None else eps * float(n) ** 0.5
+
+    l_lo = ppotrf(like(ad, ad.data.astype(lo)))
+
+    def dvec(v):
+        return distribute(v.astype(ad.dtype), mesh, ad.nb, row_mult=q)
+
+    def precond(vcol):
+        rd = dvec(jnp.asarray(vcol))
+        xc = ppotrs(l_lo, like(rd, rd.data.astype(lo)))
+        return jnp.asarray(undistribute(like(rd, xc.data.astype(ad.dtype))))
+
+    def matvec(v):
+        vd = dvec(v[:, None])
+        return jnp.asarray(undistribute(pgemm(1.0, ad, vd)))[:, 0]
+
+    def solve_full(bv2):
+        bd2 = dvec(jnp.asarray(bv2))
+        return jnp.asarray(undistribute(ppotrs(ppotrf(ad), bd2)))
+
+    return fgmres_refine(None, b, precond, solve_full, anorm=anorm,
+                         thresh=thresh, itermax=itermax, restart=restart,
+                         use_fallback=use_fallback, matvec=matvec)

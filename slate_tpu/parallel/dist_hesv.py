@@ -1,0 +1,291 @@
+"""Distributed Hermitian-indefinite factor/solve — reference
+``slate::hetrf/hetrs/hesv`` as grid drivers (``src/hetrf.cc``, 625 LoC).
+
+``phetrf`` runs the blocked Parlett–Reid (Aasen) LTLᴴ of
+:mod:`slate_tpu.linalg.hesv` with the matrix SHARDED throughout:
+
+* the (n × nb+1) panel window is fetched to replicated storage with one
+  static-index gather per panel (the storage shuffle maps are
+  host-static, so logical↔storage coordinates are ``jnp.take`` with
+  precomputed index vectors);
+* per-step pivot swaps move one row + one column of the sharded global
+  array (dynamic-index scatters, O(n) each — the reference's hetrf
+  swap phase has the same cost);
+* the deferred two-sided trailing update — the O(n³) her2k part — is
+  applied as TWO distributed gemms per panel on the cyclic-shuffled
+  (load-balanced) storage: the deferred V·Uᴴ + C·Vᴴ of the single-chip
+  blocked panel, watermark masks included, followed by the same
+  re-hermitization of the trailing square.
+
+``phetrs`` applies the interleaved pivots to the gathered right-hand
+sides (O(n·nrhs) host), runs both unit-L solves as the existing
+distributed ptrsm sweeps, and the Hermitian-tridiagonal T solve on host
+(O(n·nrhs), the reference's banded gbtrf/gbtrs slot).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..grid import cyclic_permutation, inverse_permutation
+from .dist import DistMatrix, distribute, like, undistribute
+from .mesh import mesh_grid_shape
+
+
+def _storage_maps(dm: DistMatrix):
+    """Static logical↔storage index vectors for rows and columns of the
+    padded cyclic-shuffled global array."""
+    p, q = dm.grid_shape
+    nb = dm.nb
+
+    def maps(ntiles, g):
+        perm = cyclic_permutation(ntiles, g)        # storage tile -> global
+        inv = inverse_permutation(perm)             # global tile -> storage
+        base = np.arange(ntiles * nb)
+        g2s = inv[base // nb] * nb + base % nb      # global idx -> storage
+        s2g = perm[base // nb] * nb + base % nb     # storage idx -> global
+        return g2s, s2g
+    r_g2s, r_s2g = maps(dm.mtp, p)
+    c_g2s, c_s2g = maps(dm.ntp, q)
+    return r_g2s, r_s2g, c_g2s, c_s2g
+
+
+def phetrf(a, mesh=None, nb: int = 32):
+    """Distributed blocked Aasen LTLᴴ: ``P·A·Pᴴ = L·T·Lᴴ`` with T
+    Hermitian tridiagonal, L unit lower (first column e₁, row-swapped
+    multiplier storage — the single-chip :func:`~slate_tpu.linalg.hesv.
+    hetrf` convention, so ``phetrs`` shares its pivot algebra).
+
+    ``a`` is a dense Hermitian array (with ``mesh``) or a square-padded
+    DistMatrix.  Returns ``(l_dist, d, e, ipiv)``: ``l_dist`` a
+    DistMatrix holding the strict multipliers (no unit diagonal),
+    d/e/ipiv replicated host vectors (O(n))."""
+
+    if isinstance(a, DistMatrix):
+        ad = a
+        mesh = ad.mesh
+    else:
+        p, q = mesh_grid_shape(mesh)
+        a = jnp.asarray(a)
+        ad = distribute(a, mesh, nb, row_mult=q, col_mult=p)
+    if ad.mtp != ad.ntp:
+        raise ValueError("phetrf needs square padded storage "
+                         "(distribute with row_mult=q, col_mult=p)")
+    n = ad.n
+    maps_ = _storage_maps(ad)
+    data, l_data, ipiv = _phetrf_impl(ad.mesh, n, ad.mtp * ad.nb, ad.nb,
+                                      maps_, str(ad.dtype))(ad.data)
+    host = np.asarray(jax.device_get(data))
+    r_g2s, _, c_g2s, _ = maps_
+    d = np.real(host[r_g2s[np.arange(n)], c_g2s[np.arange(n)]]).copy()
+    e = host[r_g2s[np.arange(1, n)], c_g2s[np.arange(n - 1)]].copy()
+    return like(ad, l_data), d, e, np.asarray(ipiv)[:max(n - 2, 0)]
+
+
+def _phetrf_impl(mesh, n, M, nb, maps_, dtype_name):
+    """Build the jitted factorization: python-unrolled panels, fori
+    panel steps, mirroring ``linalg.hesv._hetrf_blocked`` exactly with
+    the trailing square in sharded (shuffled) storage."""
+
+    from functools import lru_cache
+
+    from ..ops.blocks import matmul as _mm
+
+    r_g2s_h, r_s2g_h, c_g2s_h, c_s2g_h = maps_
+    r_g2s = jnp.asarray(r_g2s_h)
+    c_g2s = jnp.asarray(c_g2s_h)
+    r_s2g = jnp.asarray(r_s2g_h)
+    c_s2g = jnp.asarray(c_s2g_h)
+    # storage-coordinate logical-conj-transpose index maps (square pad)
+    tr_rows = jnp.asarray(r_g2s_h[c_s2g_h])
+    tr_cols = jnp.asarray(c_g2s_h[r_s2g_h])
+    # storage-coordinate logical index of each row/col
+    row_lg = jnp.asarray(r_s2g_h)
+    col_lg = jnp.asarray(c_s2g_h)
+
+    @jax.jit
+    def run(a):
+        dt = a.dtype
+        lmat = jnp.zeros_like(a)
+        ipiv = jnp.zeros((max(n - 2, 1),), jnp.int32)
+        rows_l = jnp.arange(M)
+        steps_cache = {}
+
+        for j0 in range(0, max(n - 2, 0), nb):
+            w = min(nb, n - 2 - j0)
+            if w <= 0:
+                break
+            wide = min(w + 1, n - j0)
+            wcols = np.arange(j0, j0 + wide)
+            win = jnp.take(jnp.take(a, c_g2s_h[wcols], axis=1),
+                           r_g2s, axis=0)
+            V0 = jnp.zeros((M, w), dt)
+            U0 = jnp.zeros((M, w), dt)
+            C0 = jnp.zeros((M, w), dt)
+            wm0 = jnp.zeros((M,), jnp.int32)
+            steps = jnp.arange(w)
+
+            def body(t, carry, j0=j0, w=w, wide=wide):
+                a, lmat, win, V, U, C, wm, ipiv = carry
+                jt = j0 + t
+                col = jnp.where(rows_l >= jt + 1,
+                                jnp.abs(win[:, t]), -1.0)
+                p_ = jnp.argmax(col).astype(jnp.int32)
+                # physical two-sided swap (rows+cols jt+1 ↔ p_) on the
+                # sharded array, and rows on the L store
+                s1r = jnp.take(r_g2s, jt + 1)
+                s2r = jnp.take(r_g2s, p_)
+                row1 = a[s1r]
+                a = a.at[s1r].set(a[s2r]).at[s2r].set(row1)
+                lrow1 = lmat[s1r]
+                lmat = lmat.at[s1r].set(lmat[s2r]).at[s2r].set(lrow1)
+                s1c = jnp.take(c_g2s, jt + 1)
+                s2c = jnp.take(c_g2s, p_)
+                col1 = a[:, s1c]
+                a = a.at[:, s1c].set(a[:, s2c]).at[:, s2c].set(col1)
+
+                def vswap(x):
+                    xi = x[jt + 1]
+                    return x.at[jt + 1].set(x[p_]).at[p_].set(xi)
+                win = vswap(win)
+                V = vswap(V)
+                U = vswap(U)
+                C = vswap(C)
+                wmi = wm[jt + 1]
+                wm = wm.at[jt + 1].set(wm[p_]).at[p_].set(wmi)
+                # refetch the swapped-in window column t+1 and refresh
+                # its missing deferred panel terms (steps wm..t-1)
+                cj1 = jnp.take(jnp.take(a, s1c, axis=1), r_g2s, axis=0)
+                mask = ((steps >= wm[jt + 1]) & (steps < t)).astype(dt)
+                cj1 = cj1 - _mm(V, mask * jnp.conj(U[jt + 1])) \
+                    - _mm(C, mask * jnp.conj(V[jt + 1]))
+                win = win.at[:, t + 1].set(cj1)
+                # elimination multipliers from window column t
+                colj = win[:, t]
+                aj1 = colj[jt + 1]
+                safe = jnp.where(aj1 == 0, jnp.ones((), dt), aj1)
+                lcol = jnp.where(rows_l >= jt + 2, colj / safe,
+                                 jnp.zeros((), dt)).astype(dt)
+                u_t = cj1
+                pr_win = win[jt + 1, :]
+                win = win - lcol[:, None] * pr_win[None, :]
+                c_t = win[:, t + 1]
+                lwin = lax.dynamic_slice(lcol, (j0,), (wide,))
+                win = win - c_t[:, None] * jnp.conj(lwin)[None, :]
+                V = V.at[:, t].set(lcol)
+                U = U.at[:, t].set(u_t)
+                C = C.at[:, t].set(c_t)
+                ipiv = ipiv.at[jt].set(p_)
+                wm = jnp.where((rows_l >= j0) & (rows_l < j0 + wide),
+                               t + 1, wm)
+                return a, lmat, win, V, U, C, wm, ipiv
+
+            a, lmat, win, V, U, C, wm, ipiv = lax.fori_loop(
+                0, w, body, (a, lmat, win, V0, U0, C0, wm0, ipiv))
+            # fully-updated window back into the sharded array
+            a = a.at[:, c_g2s_h[wcols]].set(jnp.take(win, r_s2g, axis=0))
+            # deferred trailing update (two distributed gemms), columns
+            # with logical index >= j0+wide only, watermark-masked
+            sel = (steps[None, :] >= wm[:, None]).astype(dt)
+            trail = (rows_l >= j0 + wide).astype(dt)
+            Uc = jnp.conj(U) * sel * trail[:, None]
+            Vc = jnp.conj(V) * sel * trail[:, None]
+            upd = _mm(jnp.take(V, r_s2g, axis=0),
+                      jnp.swapaxes(jnp.take(Uc, c_s2g, axis=0), 0, 1)) \
+                + _mm(jnp.take(C, r_s2g, axis=0),
+                      jnp.swapaxes(jnp.take(Vc, c_s2g, axis=0), 0, 1))
+            a = a - upd
+            # re-hermitize the trailing square (same stability fix as
+            # the single-chip panel): storage-coordinate logical
+            # conj-transpose via the precomposed index maps
+            at_ = jnp.conj(jnp.take(jnp.take(a, tr_rows, axis=0),
+                                    tr_cols, axis=1))
+            both = ((row_lg >= j0 + wide)[:, None]
+                    & (col_lg >= j0 + wide)[None, :])
+            a = jnp.where(both, 0.5 * (a + at_), a)
+            # install this panel's multipliers as L[:, j0+1 : j0+w+1]
+            lcols = np.arange(j0 + 1, j0 + 1 + w)
+            lmat = lmat.at[:, c_g2s_h[lcols]].set(
+                jnp.take(V, r_s2g, axis=0))
+        return a, lmat, ipiv
+
+    return run
+
+
+def phetrs(l: DistMatrix, d, e, ipiv, b, mesh=None):
+    """Solve with the :func:`phetrf` factorization — reference
+    ``slate::hetrs``: pivots → distributed unit-L solve (ptrsm sweep) →
+    host Hermitian-tridiagonal solve (O(n·nrhs)) → distributed Lᴴ solve
+    → pivots back."""
+
+    from scipy.linalg import solve_banded
+
+    from ..enums import Diag, Op, Side, Uplo
+    from .dist_aux import ptrsm
+
+    mesh = l.mesh
+    p, q = l.grid_shape
+    n = l.n
+    bv = np.asarray(b)
+    squeeze = bv.ndim == 1
+    if squeeze:
+        bv = bv[:, None]
+    bv = np.array(bv.astype(np.asarray(jnp.zeros((), l.dtype)).dtype))
+    ipiv = np.asarray(ipiv)
+    for j in range(len(ipiv)):          # forward interleaved pivots
+        p_ = int(ipiv[j])
+        bv[[j + 1, p_]] = bv[[p_, j + 1]]
+    bd = distribute(jnp.asarray(bv), mesh, l.nb, row_mult=q)
+    # unit-L solve on the mesh; L's unit diagonal is implicit, its first
+    # column is e1 (strict multipliers only in l) → add I via diag_pad
+    lfull = like(l, l.data + _unit_diag(l))
+    y = ptrsm(Side.Left, Uplo.Lower, Op.NoTrans, Diag.Unit, lfull, bd)
+    yh = np.array(jax.device_get(undistribute(y)))
+    ab = np.zeros((3, n), dtype=yh.dtype)
+    ab[1, :] = d
+    if n > 1:
+        ab[0, 1:] = np.conj(e)
+        ab[2, :-1] = e
+    wv = solve_banded((1, 1), ab, yh)
+    wd = distribute(jnp.asarray(wv, dtype=l.dtype), mesh, l.nb, row_mult=q)
+    v = ptrsm(Side.Left, Uplo.Lower, Op.ConjTrans, Diag.Unit, lfull, wd)
+    vh = np.array(jax.device_get(undistribute(v)))
+    for j in range(len(ipiv) - 1, -1, -1):  # backward pivots
+        p_ = int(ipiv[j])
+        vh[[j + 1, p_]] = vh[[p_, j + 1]]
+    if squeeze:
+        vh = vh[:, 0]
+    return vh
+
+
+def _unit_diag(l: DistMatrix):
+    """Sharded identity on the logical diagonal (incl. padded rows so
+    the triangular sweep stays nonsingular)."""
+    from .dist import distribute as _d
+    import jax.numpy as _jnp
+    eye = _jnp.eye(l.mtp * l.nb, dtype=l.dtype)
+    # build through the same shuffle as distribute: cheap O(n) host work
+    from ..grid import cyclic_permutation as _cp
+    p, q = l.grid_shape
+    rperm = np.asarray(_cp(l.mtp, p))
+    cperm = np.asarray(_cp(l.ntp, q))
+    idx_r = (rperm[np.arange(l.mtp * l.nb) // l.nb] * l.nb
+             + np.arange(l.mtp * l.nb) % l.nb)
+    idx_c = (cperm[np.arange(l.ntp * l.nb) // l.nb] * l.nb
+             + np.arange(l.ntp * l.nb) % l.nb)
+    diag = (idx_r[:, None] == idx_c[None, :]).astype(np.asarray(
+        jnp.zeros((), l.dtype)).dtype)
+    return jnp.asarray(diag)
+
+
+def phesv(a, b, mesh=None, nb: int = 32):
+    """Distributed factor + solve — reference ``slate::hesv``.
+    Returns ``((l, d, e, ipiv), x)`` with ``x`` a replicated host
+    array."""
+
+    l, d, e, ipiv = phetrf(a, mesh, nb)
+    x = phetrs(l, d, e, ipiv, b)
+    return (l, d, e, ipiv), x

@@ -159,3 +159,90 @@ def test_ptranspose_peye(mesh):
         np.asarray(undistribute(ptranspose(da, conj=True))), a.conj().T)
     e = peye(45, 16, mesh)
     assert np.allclose(np.asarray(undistribute(e)), np.eye(45))
+
+
+class TestBandMultsAndMixedPosv:
+    """Round-3 additions: distributed band multiplies, triangular band
+    solve, and mixed-precision Cholesky (VERDICT r2 item 7)."""
+
+    def _band(self, n, kl, ku, herm=False, seed=50):
+        rng = np.random.default_rng(seed)
+        full = rng.standard_normal((n, n))
+        mask = np.arange(n)[None, :] - np.arange(n)[:, None]
+        full = np.where((mask <= ku) & (mask >= -kl), full, 0)
+        if herm:
+            full = (full + full.T) / 2 + n * np.eye(n)
+        return full
+
+    def test_pgbmm(self, mesh):
+        from slate_tpu.parallel import distribute, pgbmm, undistribute
+        from slate_tpu.parallel.mesh import mesh_grid_shape
+        mesh24 = mesh
+        n, kl, ku, nb = 96, 5, 3, 16
+        full = self._band(n, kl, ku)
+        rng = np.random.default_rng(51)
+        bm = rng.standard_normal((n, 24))
+        p, q = mesh_grid_shape(mesh)
+        # hand a DENSE matrix in: the mask must enforce the band
+        dense = full + np.where(full == 0, 0.1, 0.0)
+        ad = distribute(dense, mesh24, nb, col_mult=p)
+        bd = distribute(bm, mesh24, nb, row_mult=q)
+        out = np.asarray(undistribute(pgbmm(2.0, ad, kl, ku, bd)))
+        assert np.allclose(out, 2.0 * full @ bm, atol=1e-12)
+
+    def test_phbmm(self, mesh):
+        from slate_tpu.parallel import distribute, phbmm, undistribute
+        from slate_tpu.parallel.mesh import mesh_grid_shape
+        mesh24 = mesh
+        n, kd, nb = 96, 4, 16
+        full = self._band(n, kd, 0, seed=52)
+        sym = np.tril(full) + np.tril(full, -1).T
+        rng = np.random.default_rng(53)
+        bm = rng.standard_normal((n, 8))
+        p, q = mesh_grid_shape(mesh)
+        # square padding: phermitize transposes the shard layout
+        ad = distribute(np.tril(full), mesh24, nb, row_mult=q, col_mult=p)
+        bd = distribute(bm, mesh24, nb, row_mult=q)
+        out = np.asarray(undistribute(phbmm(1.0, ad, kd, bd)))
+        assert np.allclose(out, sym @ bm, atol=1e-12)
+
+    def test_ptbsm(self, mesh):
+        from slate_tpu.parallel import distribute, ptbsm, undistribute
+        from slate_tpu.parallel.mesh import mesh_grid_shape
+        mesh24 = mesh
+        n, kd, nb = 96, 4, 16
+        full = self._band(n, kd, 0, seed=54)
+        tri = np.tril(full) + 2 * n * np.eye(n)
+        rng = np.random.default_rng(55)
+        bm = rng.standard_normal((n, 6))
+        p, q = mesh_grid_shape(mesh)
+        ad = distribute(tri, mesh24, nb, row_mult=q, col_mult=p)
+        bd = distribute(bm, mesh24, nb, row_mult=q)
+        x = np.asarray(undistribute(ptbsm(
+            Side.Left, Uplo.Lower, Op.NoTrans, Diag.NonUnit, ad, kd, bd)))
+        assert np.linalg.norm(tri @ x - bm) / np.linalg.norm(bm) < 1e-11
+
+    def test_pposv_mixed(self, mesh):
+        from slate_tpu.parallel import pposv_mixed, undistribute
+        mesh24 = mesh
+        rng = np.random.default_rng(56)
+        n = 80
+        g = rng.standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        b = rng.standard_normal((n, 4))
+        x, iters = pposv_mixed(a, b, mesh24, nb=16)
+        xh = np.asarray(undistribute(x))
+        assert np.linalg.norm(a @ xh - b) / np.linalg.norm(b) < 1e-10
+        assert iters >= 0   # converged without fallback
+
+    def test_pposv_mixed_gmres(self, mesh):
+        from slate_tpu.parallel import pposv_mixed_gmres
+        mesh24 = mesh
+        rng = np.random.default_rng(57)
+        n = 64
+        g = rng.standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        b = rng.standard_normal((n,))
+        x, iters = pposv_mixed_gmres(a, b, mesh24, nb=16)
+        xh = np.asarray(x)
+        assert np.linalg.norm(a @ xh - b) / np.linalg.norm(b) < 1e-10
