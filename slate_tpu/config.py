@@ -12,7 +12,10 @@ that knob is moot.  The knobs that matter on TPU instead:
   orders of magnitude inside every 3·ε(f32)·n residual gate (the
   reference tester's criterion) at twice the throughput of ``highest``.
   Use ``highest`` for full-f32 vendor-BLAS-grade accuracy, ``default``
-  when bf16-grade suffices.
+  when bf16-grade suffices.  Accuracy-critical compositions (iterative-
+  refinement residuals, CholQR Gram products) are pinned to ``highest``
+  internally (:func:`slate_tpu.ops.blocks.matmul_hi`) and do not follow
+  this knob.
 * ``default_block_size`` — the global nb default (reference per-call
   ``Option::BlockSize``), tuned for the 128×128 MXU: multiples of 256
   keep every tile op MXU-shaped.

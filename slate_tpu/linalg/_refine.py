@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.blocks import matmul
+from ..ops.blocks import matmul_hi
 
 
 def ir_refine_core(b, solve_lo, solve_full, residual, *, anorm, thresh,
@@ -66,7 +66,7 @@ def ir_refine(av, bv, solve_lo, solve_full, *, anorm, thresh, itermax,
     squeeze = bv.ndim == 1
     if squeeze:
         bv = bv[:, None]
-    residual = jax.jit(lambda x: bv - matmul(av, x))
+    residual = jax.jit(lambda x: bv - matmul_hi(av, x))
     x, iters = ir_refine_core(bv, solve_lo, solve_full, residual,
                               anorm=anorm, thresh=thresh, itermax=itermax,
                               use_fallback=use_fallback)
@@ -89,7 +89,7 @@ def fgmres_refine(av, bv, precond, solve_full, *, anorm, thresh, itermax,
     if squeeze:
         bv = bv[:, None]
     if matvec is None:
-        matvec = jax.jit(lambda v: matmul(av, v[:, None])[:, 0])
+        matvec = jax.jit(lambda v: matmul_hi(av, v[:, None])[:, 0])
 
     cols = []
     total_iters = 0

@@ -279,12 +279,71 @@ def _tall_panel_lu(pan, max_rows: int = _MAX_LU_PANEL_ROWS):
     return jnp.concatenate([top, l21], axis=0), pl
 
 
-def getrf_panels(a, nb: int = 512):
+def _tall_panel_lu_pp(pan, ib: int = 64):
+    """TRUE partial-pivot factorization of a panel taller than the fused
+    XLA LU kernel's VMEM limit — the analog of the reference's
+    multithreaded panel (``Tile_getrf.hh:154-320``: per-column global
+    argmax, swap, rank-1), expressed as an inner-blocked
+    ``lax.fori_loop`` so each rank-1 update touches only an ib-wide
+    slab.  Unlike :func:`_tall_panel_lu` (tournament/CALU), every pivot
+    is the argmax of the fully-updated column, preserving partial
+    pivoting's element-growth guarantee for callers who explicitly
+    selected ``MethodLU.PartialPiv``.
+
+    Returns ``(lu_packed, pl)`` with ``pan[pl] = L·U`` — the same
+    contract as ``lax.linalg.lu``'s first/third outputs.
+    """
+
+    m, w = pan.shape
+    a = pan
+    gperm = jnp.arange(m)
+    for b0 in range(0, w, ib):
+        bw = min(ib, w - b0)
+        slab = a[b0:, b0:b0 + bw]
+        mrows = slab.shape[0]
+        rows = jnp.arange(mrows)
+
+        def body(jj, carry):
+            slab, bperm = carry
+            mag = jnp.abs(slab[:, jj])
+            mag = jnp.where(rows >= jj, mag, -1.0)
+            p = jnp.argmax(mag)
+            rj, rp = slab[jj], slab[p]
+            slab = slab.at[jj].set(rp).at[p].set(rj)
+            bj, bp = bperm[jj], bperm[p]
+            bperm = bperm.at[jj].set(bp).at[p].set(bj)
+            pivval = slab[jj, jj]
+            denom = jnp.where(pivval == 0, 1, pivval)
+            lcol = jnp.where(rows > jj, slab[:, jj] / denom, slab[:, jj])
+            slab = slab.at[:, jj].set(lcol)
+            upd = jnp.outer(jnp.where(rows > jj, lcol, 0),
+                            jnp.where(jnp.arange(bw) > jj, slab[jj], 0))
+            return slab - upd, bperm
+
+        slab, bperm = lax.fori_loop(0, bw, body, (slab, jnp.arange(mrows)))
+        body_rows = a[b0:][bperm]
+        body_rows = body_rows.at[:, b0:b0 + bw].set(slab)
+        gperm = gperm.at[b0:].set(gperm[b0:][bperm])
+        if b0 + bw < w:
+            u12 = lax.linalg.triangular_solve(
+                slab[:bw], body_rows[:bw, b0 + bw:], left_side=True,
+                lower=True, unit_diagonal=True)
+            body_rows = body_rows.at[:bw, b0 + bw:].set(u12)
+            body_rows = body_rows.at[bw:, b0 + bw:].add(
+                -matmul(slab[bw:], u12))
+        a = a.at[b0:].set(body_rows)
+    return a, gperm
+
+
+def getrf_panels(a, nb: int = 512, tall_panel: str = "tournament"):
     """Right-looking blocked partial-pivot LU (loop form): per panel,
     XLA's fused panel kernel (``lax.linalg.lu`` — the vendor ``getrf``
-    slot, ``internal_getrf.cc:75-92``) or the tournament for panels
-    taller than the kernel's VMEM limit, then ONE permutation gather of
-    the sub-matrix rows and one big trailing gemm.  Returns
+    slot, ``internal_getrf.cc:75-92``) or, for panels taller than the
+    kernel's VMEM limit, either the CALU tournament (``tall_panel=
+    "tournament"``, the Auto default — stronger MXU utilisation, weaker
+    growth bound) or the true partial-pivot loop (``"pp"`` — what an
+    explicit ``MethodLU.PartialPiv`` request gets), then ONE permutation
+    gather of the sub-matrix rows and one big trailing gemm.  Returns
     ``(lu, perm)`` with ``a[perm] = L·U``.
 
     The per-panel gather reads/rewrites the (m-k0)×n trailing slab —
@@ -300,7 +359,10 @@ def getrf_panels(a, nb: int = 512):
         w = min(nb, k - k0)
         pan = a[k0:, k0:k0 + w]
         if pan.shape[0] > _MAX_LU_PANEL_ROWS:
-            lu_p, pl = _tall_panel_lu(pan)
+            if tall_panel == "pp":
+                lu_p, pl = _tall_panel_lu_pp(pan)
+            else:
+                lu_p, pl = _tall_panel_lu(pan)
         else:
             lu_p, _, pl = lax.linalg.lu(pan)
         # one permutation gather of the sub-matrix rows (left L-blocks +
@@ -332,9 +394,9 @@ def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
 
     av = as_array(a)
     nb = _nb(a, opts)
-    method = get_option(opts, "method_lu", MethodLU.Auto)
+    raw_method = get_option(opts, "method_lu", MethodLU.Auto)
     from ..method import select_lu
-    method = select_lu(method)
+    method = select_lu(raw_method)
     if method is MethodLU.NoPiv:
         lu = getrf_nopiv_rec(av, nb, int(get_option(opts, "inner_blocking")))
         perm = jnp.arange(av.shape[0])
@@ -342,9 +404,13 @@ def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
         lu, perm = getrf_rec(av, nb, panel=lambda p: _panel_lu_tntpiv(p, nb))
     elif method is MethodLU.PartialPiv:
         if av.ndim == 2 and av.shape[0] > _MAX_LU_PANEL_ROWS:
-            # the loop form's tournament panel is the only path whose
-            # panels fit XLA's scoped-VMEM LU limit above 8192 rows
-            lu, perm = getrf_panels(av, max(nb, 512))
+            # tall panels exceed XLA's scoped-VMEM fused-LU limit; under
+            # Auto the tournament (CALU) panel substitutes — documented,
+            # like the reference exposing tntpiv as a variant — while an
+            # EXPLICIT PartialPiv request keeps true partial pivoting
+            # via the inner-blocked loop panel
+            tall = "pp" if raw_method is MethodLU.PartialPiv else "tournament"
+            lu, perm = getrf_panels(av, max(nb, 512), tall_panel=tall)
         else:
             lu, perm = getrf_rec(av, nb)
     else:

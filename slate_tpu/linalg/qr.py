@@ -44,7 +44,7 @@ from ..enums import Diag, MethodGels, Op, Side, Uplo
 from ..matrix import as_array
 from ..options import Options, get_option
 from ..ops import blocks
-from ..ops.blocks import _ct, matmul
+from ..ops.blocks import _ct, matmul, matmul_hi
 from .blas3 import _nb, _wrap_like
 
 
@@ -217,18 +217,35 @@ def _cholqr2_panel(pan):
     factorization well-posed for ill-conditioned panels; the identity
     A = Q·(L₁L₂)ᵀ holds for any shift, and the second pass restores
     orthogonality — so the shift costs nothing in exactness.
+
+    Also returns ``dev = max|g₂ − I|``, the departure of the first-pass
+    Q from orthogonality: CholQR² restores ‖I − QᵀQ‖ to O(ε) only while
+    dev < 1 (Yamamoto et al.), i.e. cond(panel) ≲ 1/√ε — callers use it
+    to fall back to the unconditionally stable Householder panel.
+
+    The Gram products are pinned to ``Precision.HIGHEST``: their error
+    enters Q's orthogonality directly, so the library-wide ``high``
+    (3-pass bf16) default would put a ~1e-5 floor under it.
     """
 
     from ..ops.pallas_kernels import chol_inv_panel, lu_inv_panel
 
     mk, w = pan.shape
-    gram = matmul(_ct(pan), pan)
+    gram = matmul_hi(_ct(pan), pan)
     eps = jnp.finfo(pan.dtype).eps
     shift = (100.0 * w) * eps * jnp.max(jnp.diag(gram))
     l1, l1inv = chol_inv_panel(gram + shift * jnp.eye(w, dtype=pan.dtype))
     q = matmul(pan, _ct(l1inv))
-    g2 = matmul(_ct(q), q)
+    g2 = matmul_hi(_ct(q), q)
     l2, l2inv = chol_inv_panel(g2)
+    # departure of the first-pass Q from orthogonality, spectral-norm
+    # sensitive: the elementwise max|g₂ − I| alone misses a *spread*
+    # near-null direction (g₂ ≈ I − v·vᵀ with small entries but
+    # λ_min ≈ 0), so also watch the second Cholesky factor's diagonal —
+    # one eigenvalue collapsing drags min(diag(L₂)) toward √λ_min
+    dev = jnp.maximum(
+        jnp.max(jnp.abs(g2 - jnp.eye(w, dtype=pan.dtype))),
+        1.0 - jnp.min(jnp.real(jnp.diag(l2))))
     q = matmul(q, _ct(l2inv))
     r = _ct(matmul(l1, l2))
     dq = jnp.diag(q[:w])
@@ -242,7 +259,7 @@ def _cholqr2_panel(pan):
     tinv = jnp.triu(matmul(_ct(y), y), 1) + jnp.diag(1.0 / tau)
     from ..ops.pallas_kernels import trtri_panel
     tmat = jnp.triu(trtri_panel(tinv[::-1, ::-1])[::-1, ::-1])
-    return y, rprime, tau, tmat
+    return y, rprime, tau, tmat, dev
 
 
 def geqrf_panels(a, nb: int = 512):
@@ -263,9 +280,26 @@ def geqrf_panels(a, nb: int = 512):
         # short/ragged panels take XLA's fused Householder panel
         if w == nb and (nb & (nb - 1)) == 0 and nb >= 32 \
                 and pan.shape[0] >= 2 * nb and a.dtype == jnp.float32:
-            y, rp, tau, tmat = _cholqr2_panel(pan)
+            y, rp, tau, tmat, dev = _cholqr2_panel(pan)
             col = jnp.concatenate(
                 [rp + jnp.tril(y[:w], -1), y[w:]], axis=0)
+
+            # conditioning guard: CholQR² loses orthogonality once the
+            # first-pass Gram departure nears 1 (cond(panel) ≳ 1/√ε for
+            # f32 ≈ 3e3); such panels take the unconditionally stable
+            # Householder path instead.  lax.cond runs one branch, so
+            # the slow path costs nothing when the guard passes.
+            def _hh_branch(_):
+                f, tauh = _panel_geqrf(pan)
+                yh = _unit_lower(f, w)
+                return yh, f, tauh, larft_rec(yh, tauh)
+
+            def _cholqr_branch(_):
+                return y, col, tau, tmat
+
+            ok = jnp.isfinite(dev) & (dev < 0.25)
+            y, col, tau, tmat = lax.cond(
+                ok, _cholqr_branch, _hh_branch, operand=None)
         else:
             f, tau = _panel_geqrf(pan)
             y = _unit_lower(f, w)

@@ -518,9 +518,12 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
     # top n rows are nonzero, so stage 2 operates on the n×n head
     s, u_b, vh_b = _band_svd(band_np[:n], factors.kd, jobu, jobvt,
                              method, auto)
-    if not (jobu or jobvt):
-        return jnp.asarray(s), None, None
     dtype = factors.band.dtype
+    # stage 2/3 may run in float64 internally (the HH fast path); the
+    # dtype contract is LAPACK's: sigma in the real precision of A
+    real_dt = np.zeros(0, dtype=dtype).real.dtype
+    if not (jobu or jobvt):
+        return jnp.asarray(s, dtype=real_dt), None, None
     u = vh = None
     if jobu:
         u2 = np.asarray(u_b)
@@ -534,7 +537,7 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
         v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
                         jnp.asarray(_ct(vh_b), dtype=dtype))
         vh = _ct(v)
-    return jnp.asarray(s), u, vh
+    return jnp.asarray(s, dtype=real_dt), u, vh
 
 
 #: Deprecated alias kept by the reference (``slate.hh``: ``gesvd``).

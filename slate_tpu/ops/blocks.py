@@ -61,6 +61,16 @@ def matmul(a, b):
     return jnp.matmul(a, b, precision=config.matmul_precision)
 
 
+def matmul_hi(a, b):
+    """Dot pinned to ``Precision.HIGHEST`` regardless of the library
+    default.  Accuracy-critical compositions — iterative-refinement
+    residuals, CholQR Gram matrices — use this so the global
+    ``matmul_precision`` knob (default ``high``, ~1.3e-5 on f32) cannot
+    loosen them: these sites feed error estimates whose own error must
+    sit well below what they measure."""
+    return jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+
+
 def _split(n: int, nb: int) -> int:
     """Split point for recursion: half of n rounded up to a multiple of nb."""
     return max(nb, (ceildiv(n, 2 * nb)) * nb)
@@ -346,15 +356,22 @@ def potrf_panels(a, nb: int = 512):
 
     n = a.shape[-1]
     # trailing strip width: measured optimum on v5e (tools sweep:
-    # ws=2048 → 54.9 TF/s, 4096 → 39.9, full-square → 29.9 at n=8192)
-    ws = max(nb, 2048)
+    # ws=2048 → 54.9 TF/s, 4096 → 39.9, full-square → 29.9 at n=8192),
+    # rounded to a multiple of nb so strip boundaries never fall inside a
+    # later diagonal block (the strip update only writes rows >= its own
+    # start, so an interior boundary would leave that block's upper
+    # triangle stale)
+    ws = nb * max(1, 2048 // nb)
     for k0 in range(0, n, nb):
         w = min(nb, n - k0)
         akk = a[k0:k0 + w, k0:k0 + w]
         if w == nb and (nb & (nb - 1)) == 0 and a.dtype == jnp.float32:
             lkk, linv = chol_inv_panel(akk)
         else:
-            lkk = jnp.tril(lax.linalg.cholesky(akk))
+            # read only the stored lower triangle: the strip updates never
+            # touch the strictly-upper part, so it may hold stale values
+            lkk = jnp.tril(lax.linalg.cholesky(
+                jnp.tril(akk), symmetrize_input=False))
             linv = lax.linalg.triangular_solve(
                 lkk, jnp.eye(w, dtype=a.dtype), left_side=True, lower=True)
         a = a.at[k0:k0 + w, k0:k0 + w].set(lkk)

@@ -171,3 +171,28 @@ def test_method_option_dispatch():
     lu, perm = getrf(st.Matrix.from_array(a, nb=16),
                      {"method_lu": MethodLU.NoPiv})
     np.testing.assert_array_equal(np.asarray(perm), np.arange(n))
+
+
+def test_tall_panel_lu_pp_true_partial_pivot():
+    """_tall_panel_lu_pp must produce a genuine partial-pivot factor:
+    pan[pl] = L·U with every |L| entry ≤ 1 (the growth guarantee the
+    tournament panel cannot make)."""
+    from slate_tpu.linalg.lu import _tall_panel_lu_pp
+    rng = np.random.default_rng(3)
+    pan = jnp.asarray(rng.standard_normal((300, 64)))
+    lu_p, pl = _tall_panel_lu_pp(pan, ib=16)
+    lu_np, pl_np = np.asarray(lu_p), np.asarray(pl)
+    l = np.tril(lu_np, -1)[:, :64]
+    l[np.arange(64), np.arange(64)] = 1.0
+    u = np.triu(lu_np[:64])
+    np.testing.assert_allclose(np.asarray(pan)[pl_np], l @ u,
+                               atol=1e-12, rtol=0)
+    assert np.max(np.abs(np.tril(lu_np, -1))) <= 1.0 + 1e-12
+    # same pivots as LAPACK partial pivoting (argmax of updated column):
+    # replay scipy's swap sequence and demand the identical permutation
+    import scipy.linalg as sla
+    _, piv = sla.lu_factor(np.asarray(pan), check_finite=False)
+    want = np.arange(300)
+    for k, p in enumerate(piv):
+        want[k], want[p] = want[p], want[k]
+    np.testing.assert_array_equal(pl_np, want)

@@ -1,0 +1,162 @@
+"""Probe: where does getrf time go on one chip?
+
+Times the LU building blocks at the bench config (n=8192 fp32, nb=512)
+using the chained-jit pattern (each iteration depends on the previous, so
+XLA cannot collapse the chain; tunnel latency amortizes out).
+
+Usage: python tools/probe_lu.py [n]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timeit(fn, *args, iters=1):
+    # float() forces the scalar transfer: block_until_ready on the axon
+    # tunnel returns before remote execution finishes (the round-2 lesson
+    # baked into bench.py's _timeit)
+    float(fn(*args))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / iters
+
+
+def chain(fn, x, iters):
+    @jax.jit
+    def run(x):
+        def body(i, v):
+            out = fn(v)
+            return x + out * jnp.float32(1e-30)
+        v = lax.fori_loop(0, iters - 1, body, x)
+        return fn(v)
+    return run
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    nb = 512
+    rng = np.random.default_rng(0)
+    a_np = rng.standard_normal((n, n)).astype(np.float32) + n * np.eye(
+        n, dtype=np.float32)
+    a = jnp.asarray(a_np)
+    results = {}
+
+    # 1. full current getrf_rec
+    from slate_tpu.linalg.lu import (getrf_rec, getrf_panels,
+                                     _panel_lu_tntpiv)
+
+    it = 6
+    f = chain(lambda x: getrf_rec(x, nb)[0][-1, -1], a, it)
+    t = timeit(f, a, iters=it)
+    results["getrf_rec"] = t
+    print(f"getrf_rec      n={n}: {t*1e3:9.2f} ms  "
+          f"{2*n**3/3/t/1e12:6.2f} TF/s", flush=True)
+
+    f = chain(lambda x: getrf_panels(x, nb)[0][-1, -1], a, it)
+    t = timeit(f, a, iters=it)
+    results["getrf_panels"] = t
+    print(f"getrf_panels   n={n}: {t*1e3:9.2f} ms  "
+          f"{2*n**3/3/t/1e12:6.2f} TF/s", flush=True)
+
+    # 2. XLA fused LU panel at several heights
+    for mh in (n, n // 2, n // 4):
+        pan = jnp.asarray(a_np[:mh, :nb])
+        it = 20
+        f = chain(lambda x: lax.linalg.lu(x)[0][-1, -1], pan, it)
+        t = timeit(f, pan, iters=it)
+        results[f"xla_lu_panel_{mh}"] = t
+        print(f"xla lu panel {mh}x{nb}: {t*1e3:9.2f} ms", flush=True)
+
+    # 3. tournament panel, same heights
+    for mh in (n, n // 2):
+        pan = jnp.asarray(a_np[:mh, :nb])
+        it = 20
+        f = chain(lambda x: _panel_lu_tntpiv(x, nb)[0][-1, -1], pan, it)
+        t = timeit(f, pan, iters=it)
+        results[f"tnt_panel_{mh}"] = t
+        print(f"tnt panel   {mh}x{nb}: {t*1e3:9.2f} ms", flush=True)
+
+    # 4. full row gather (the per-panel permutation cost today)
+    perm = jnp.asarray(rng.permutation(n))
+
+    @jax.jit
+    def gath(x):
+        def body(i, v):
+            return v[perm] * jnp.float32(1.0)
+        return lax.fori_loop(0, 20, body, x)[0, 0]
+
+    t = timeit(gath, a, iters=20)
+    results["row_gather_full"] = t
+    print(f"row gather {n}x{n}: {t*1e3:9.2f} ms "
+          f"({2*n*n*4/t/1e9:6.0f} GB/s)", flush=True)
+
+    # 5. scatter-add rows
+    upd = jnp.asarray(rng.standard_normal((n // 2, n)).astype(np.float32))
+    rows = jnp.asarray(rng.permutation(n)[: n // 2])
+
+    @jax.jit
+    def scat(x, u):
+        def body(i, v):
+            return v.at[rows].add(u * jnp.float32(1e-6))
+        return lax.fori_loop(0, 20, body, x)[0, 0]
+
+    t = timeit(scat, a, upd, iters=20)
+    results["row_scatter_add_half"] = t
+    print(f"row scatter-add {n//2}x{n}: {t*1e3:9.2f} ms "
+          f"({3*n/2*n*4/t/1e9:6.0f} GB/s)", flush=True)
+
+    # 6. trsm vs inv-gemm for U12 (512 x n)
+    l11 = jnp.tril(jnp.asarray(a_np[:nb, :nb] / n), -1) + jnp.eye(
+        nb, dtype=jnp.float32)
+    a12 = jnp.asarray(a_np[:nb, :])
+
+    @jax.jit
+    def trsm20(x):
+        def body(i, v):
+            return lax.linalg.triangular_solve(
+                l11, v, left_side=True, lower=True, unit_diagonal=True) \
+                * jnp.float32(1.0)
+        return lax.fori_loop(0, 20, body, x)[0, 0]
+
+    t = timeit(trsm20, a12, iters=20)
+    results["trsm_512xn"] = t
+    print(f"trsm 512x{n}: {t*1e3:9.2f} ms", flush=True)
+
+    from slate_tpu.ops.pallas_kernels import trtri_panel
+
+    @jax.jit
+    def invgemm20(x):
+        linv = trtri_panel(l11)
+        def body(i, v):
+            return (linv @ v) * jnp.float32(1.0)
+        return lax.fori_loop(0, 20, body, x)[0, 0]
+
+    t = timeit(invgemm20, a12, iters=20)
+    results["invgemm_512xn"] = t
+    print(f"trtri+gemm 512x{n}: {t*1e3:9.2f} ms", flush=True)
+
+    # 7. gemm anchor
+    b = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
+
+    @jax.jit
+    def g20(x):
+        def body(i, v):
+            return jnp.matmul(v, b, precision=lax.Precision.HIGH) \
+                * jnp.float32(1e-4)
+        return lax.fori_loop(0, 8, body, x)[0, 0]
+
+    t = timeit(g20, a, iters=8)
+    print(f"gemm {n}: {t*1e3:9.2f} ms  {2*n**3/t/1e12:6.2f} TF/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

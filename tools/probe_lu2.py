@@ -1,0 +1,125 @@
+"""Probe 2: candidate getrf panel + trailing structures, timed honestly.
+
+All operands passed as jit args (no giant closure constants — the axon
+remote-compile rejects >~100MB programs); sync via float() scalar pull.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def timeit(fn, *args, iters=1):
+    float(fn(*args))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / iters
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    nb = 512
+    rng = np.random.default_rng(0)
+    a_np = rng.standard_normal((n, n)).astype(np.float32) + n * np.eye(
+        n, dtype=np.float32)
+    a = jnp.asarray(a_np)
+
+    # ---- realistic trailing step: v <- v - L21 @ (Linv @ v[:nb]) ----
+    l21_np = rng.standard_normal((n - nb, nb)).astype(np.float32) * 0.01
+    linv_np = np.tril(rng.standard_normal((nb, nb)).astype(np.float32) * .01)
+    l21 = jnp.asarray(l21_np)
+    linv = jnp.asarray(linv_np)
+    reps = 8
+
+    @jax.jit
+    def trail(v, l21, linv):
+        def body(i, v):
+            u12 = jnp.matmul(linv, v[:nb],
+                             precision=lax.Precision.HIGHEST)
+            upd = jnp.matmul(l21, u12, precision=lax.Precision.HIGH)
+            return v.at[nb:].add(-upd)
+        return lax.fori_loop(0, reps, body, v)[0, 0]
+
+    t = timeit(trail, a, l21, linv, iters=reps)
+    fl = 2 * nb * n * (n - nb) + 2 * nb * nb * n
+    print(f"trailing step (k={nb}, n={n}): {t*1e3:8.2f} ms "
+          f"{fl/t/1e12:6.2f} TF/s", flush=True)
+
+    # ---- XLA LU panel narrow widths ----
+    for wdt in (128, 256):
+        pan = jnp.asarray(a_np[:, :wdt])
+        it = 20
+
+        @jax.jit
+        def panl(x):
+            def body(i, v):
+                lu, _, pl = lax.linalg.lu(v)
+                return x + lu * jnp.float32(1e-30)
+            v = lax.fori_loop(0, it - 1, body, x)
+            return lax.linalg.lu(v)[0][-1, -1]
+
+        t = timeit(panl, pan, iters=it)
+        print(f"xla lu panel {n}x{wdt}: {t*1e3:8.2f} ms", flush=True)
+
+    # ---- _tall_panel_lu_pp at several ib ----
+    from slate_tpu.linalg import lu as lumod
+
+    for ib in (32, 64, 128):
+        pan = jnp.asarray(a_np[:, :nb])
+        it = 8
+
+        @jax.jit
+        def panl2(x):
+            def body(i, v):
+                lu, pl = lumod._tall_panel_lu_pp(v, ib=ib)
+                return x + lu * jnp.float32(1e-30)
+            v = lax.fori_loop(0, it - 1, body, x)
+            return lumod._tall_panel_lu_pp(v, ib=ib)[0][-1, -1]
+
+        t = timeit(panl2, pan, iters=it)
+        print(f"pp panel ib={ib} {n}x{nb}: {t*1e3:8.2f} ms", flush=True)
+
+    # ---- per-panel slab gather as used today (fused into consumer?) ----
+    perm = jnp.asarray(rng.permutation(n))
+
+    @jax.jit
+    def gath2(x, l21, linv):
+        def body(i, v):
+            vp = v[perm]                      # full-slab row permute
+            u12 = jnp.matmul(linv, vp[:nb],
+                             precision=lax.Precision.HIGHEST)
+            upd = jnp.matmul(l21, u12, precision=lax.Precision.HIGH)
+            return vp.at[nb:].add(-upd)
+        return lax.fori_loop(0, reps, body, x)[0, 0]
+
+    t = timeit(gath2, a, l21, linv, iters=reps)
+    print(f"permute+trailing step: {t*1e3:8.2f} ms "
+          f"{fl/t/1e12:6.2f} TF/s", flush=True)
+
+    # ---- scatter-add trailing (deferred pivoting shape) ----
+    rows = jnp.asarray(rng.permutation(n)[: n - nb])
+
+    @jax.jit
+    def scat2(x, l21, linv):
+        def body(i, v):
+            rws = v[rows[:nb]]               # gather nb pivot rows
+            u12 = jnp.matmul(linv, rws[:, nb:],
+                             precision=lax.Precision.HIGHEST)
+            upd = jnp.matmul(l21[: n - 2 * nb], u12,
+                             precision=lax.Precision.HIGH)
+            return v.at[rows[nb:], nb:].add(-upd)
+        return lax.fori_loop(0, reps, body, x)[0, 0]
+
+    t = timeit(scat2, a, l21, linv, iters=reps)
+    print(f"gather-rows+scatter-add step: {t*1e3:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()
