@@ -21,3 +21,12 @@ print(":".join(p for p in sys.path if p))
 PY
 )"
 PYTHONPATH="$(cd .. && pwd):$SITE" JAX_PLATFORMS=cpu /tmp/c_driver_smoke
+
+# ScaLAPACK compatibility smoke (2x2-grid round-trip through the
+# drop-in p? symbols; single-controller BLACS emulation)
+gcc scalapack_smoke.c ../src/c_api/c_api_core.c \
+    ../src/c_api/driver_api.c ../src/c_api/scalapack_api.c -I../include \
+    $(python3-config --includes) $(python3-config --ldflags --embed) \
+    -O2 -lm -o /tmp/scalapack_smoke
+PYTHONPATH="$(cd .. && pwd):$SITE" PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    /tmp/scalapack_smoke

@@ -1,0 +1,214 @@
+/* ScaLAPACK compatibility API smoke: round-trip a 2x2-grid
+ * block-cyclic pdpotrf + pdgesv + pdgemm through the drop-in symbols
+ * (reference analog: scalapack_api/example_pdgetrf.c).
+ *
+ * The single-controller BLACS emulation plays all four virtual ranks
+ * in sequence: Cblacs_gridinfo reports the coordinates of the rank
+ * whose turn it is, and the fourth p? call triggers the actual
+ * computation (see src/c_api/scalapack_api.c header).
+ *
+ * build: see examples/build_c_smoke.sh
+ */
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern void Cblacs_gridinit(int*, const char*, int, int);
+extern void Cblacs_gridinfo(int, int*, int*, int*, int*);
+extern void Cblacs_gridexit(int);
+extern int numroc_(const int*, const int*, const int*, const int*,
+                   const int*);
+extern void descinit_(int*, const int*, const int*, const int*, const int*,
+                      const int*, const int*, const int*, const int*, int*);
+extern void pdpotrf_(const char*, const int*, double*, const int*,
+                     const int*, const int*, int*);
+extern void pdgesv_(const int*, const int*, double*, const int*, const int*,
+                    const int*, int*, double*, const int*, const int*,
+                    const int*, int*);
+extern void pdgemm_(const char*, const char*, const int*, const int*,
+                    const int*, const double*, double*, const int*,
+                    const int*, const int*, double*, const int*, const int*,
+                    const int*, const double*, double*, const int*,
+                    const int*, const int*, int*);
+extern int slate_c_init(void);
+extern void slate_c_finalize(void);
+
+#define N 48
+#define NB 8
+#define P 2
+#define Q 2
+
+static void scatter(const double* g, double* loc, int m, int n,
+                    int mb, int nb, int pr, int pc, int lld) {
+    /* smoke-side independent block-cyclic indexing (checks ours) */
+    const int izero = 0, pp = P, qq = Q;
+    int mloc = numroc_(&m, &mb, &pr, &izero, &pp);
+    int nloc = numroc_(&n, &nb, &pc, &izero, &qq);
+    for (int jl = 0; jl < nloc; ++jl) {
+        int jg = ((jl / nb) * Q + pc) * nb + jl % nb;
+        for (int il = 0; il < mloc; ++il) {
+            int ig = ((il / mb) * P + pr) * mb + il % mb;
+            loc[jl * lld + il] = g[jg * m + ig];
+        }
+    }
+}
+
+static void gather(double* g, const double* loc, int m, int n,
+                   int mb, int nb, int pr, int pc, int lld) {
+    const int izero = 0, pp = P, qq = Q;
+    int mloc = numroc_(&m, &mb, &pr, &izero, &pp);
+    int nloc = numroc_(&n, &nb, &pc, &izero, &qq);
+    for (int jl = 0; jl < nloc; ++jl) {
+        int jg = ((jl / nb) * Q + pc) * nb + jl % nb;
+        for (int il = 0; il < mloc; ++il) {
+            int ig = ((il / mb) * P + pr) * mb + il % mb;
+            g[jg * m + ig] = loc[jl * lld + il];
+        }
+    }
+}
+
+int main(void) {
+    if (slate_c_init()) { fprintf(stderr, "init failed\n"); return 1; }
+    int ctxt, info, p, q, pr, pc;
+    const int n = N, nb = NB, ione = 1, izero = 0;
+    Cblacs_gridinit(&ctxt, "Col", P, Q);
+
+    /* SPD global matrix, column-major */
+    static double A[N * N], L[N * N], Afac[N * N];
+    srand(7);
+    for (int j = 0; j < N; ++j)
+        for (int i = 0; i <= j; ++i) {
+            double v = (double)rand() / RAND_MAX - 0.5;
+            A[j * N + i] = A[i * N + j] = v;
+        }
+    for (int i = 0; i < N; ++i) A[i * N + i] += N;
+
+    /* ---- pdpotrf on the 2x2 grid ---- */
+    double* loc[P * Q];
+    int desc[9], lld[P * Q];
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P, pcc = r / P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        int nloc = numroc_(&n, &nb, &pcc, &izero, (const int[]){Q});
+        lld[r] = mloc > 1 ? mloc : 1;
+        loc[r] = (double*)malloc(sizeof(double) * (size_t)mloc * nloc);
+        scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+    }
+    for (int r = 0; r < P * Q; ++r) {
+        Cblacs_gridinfo(ctxt, &p, &q, &pr, &pc);
+        descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt,
+                  &lld[r], &info);
+        pdpotrf_("L", &n, loc[r], &ione, &ione, desc, &info);
+        if (info != 0) { fprintf(stderr, "pdpotrf info=%d\n", info);
+                         return 2; }
+    }
+    for (int r = 0; r < P * Q; ++r)
+        gather(Afac, loc[r], n, n, nb, nb, r % P, r / P, lld[r]);
+    /* residual |A - L L^T| / (|A| n eps) over the lower triangle */
+    memset(L, 0, sizeof(L));
+    for (int j = 0; j < N; ++j)
+        for (int i = j; i < N; ++i) L[j * N + i] = Afac[j * N + i];
+    double maxe = 0, amax = 0;
+    for (int j = 0; j < N; ++j)
+        for (int i = j; i < N; ++i) {
+            double s = 0;
+            for (int k = 0; k < N; ++k) s += L[k * N + i] * L[k * N + j];
+            double e = fabs(s - A[j * N + i]);
+            if (e > maxe) maxe = e;
+            if (fabs(A[j * N + i]) > amax) amax = fabs(A[j * N + i]);
+        }
+    double scaled = maxe / (amax * N * 2.22e-16);
+    printf("pdpotrf 2x2 scaled residual: %.3f\n", scaled);
+    if (scaled > 10) { fprintf(stderr, "pdpotrf FAILED\n"); return 3; }
+
+    /* ---- pdgesv on the same grid ---- */
+    #define NRHS 4
+    const int nrhs = NRHS;
+    static double B[N * NRHS], X[N * NRHS];
+    for (int i = 0; i < N * nrhs; ++i)
+        B[i] = (double)rand() / RAND_MAX - 0.5;
+    double* bloc[P * Q];
+    int* iploc[P * Q];
+    int descb[9];
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P, pcc = r / P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        int nloc = numroc_(&nrhs, &nb, &pcc, &izero, (const int[]){Q});
+        scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        bloc[r] = (double*)malloc(sizeof(double)
+                                  * (size_t)mloc * (nloc ? nloc : 1));
+        iploc[r] = (int*)malloc(sizeof(int) * (size_t)(mloc + nb));
+        scatter(B, bloc[r], n, nrhs, nb, nb, prr, pcc, mloc);
+    }
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        int lldb = mloc > 1 ? mloc : 1;
+        descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt,
+                  &lld[r], &info);
+        descinit_(descb, &n, &nrhs, &nb, &nb, &izero, &izero, &ctxt,
+                  &lldb, &info);
+        pdgesv_(&n, &nrhs, loc[r], &ione, &ione, desc, iploc[r],
+                bloc[r], &ione, &ione, descb, &info);
+        if (info != 0) { fprintf(stderr, "pdgesv info=%d\n", info);
+                         return 4; }
+    }
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        gather(X, bloc[r], n, nrhs, nb, nb, prr, r / P, mloc);
+    }
+    maxe = 0;
+    for (int j = 0; j < nrhs; ++j)
+        for (int i = 0; i < N; ++i) {
+            double s = 0;
+            for (int k = 0; k < N; ++k) s += A[k * N + i] * X[j * N + k];
+            double e = fabs(s - B[j * N + i]);
+            if (e > maxe) maxe = e;
+        }
+    scaled = maxe / (amax * N * 2.22e-16);
+    printf("pdgesv 2x2 scaled residual: %.3f\n", scaled);
+    if (scaled > 100) { fprintf(stderr, "pdgesv FAILED\n"); return 5; }
+
+    /* ---- pdgemm C = 0.5*A^T*A - 0.25*C ---- */
+    static double C0[N * N], Cres[N * N];
+    for (int i = 0; i < N * N; ++i) C0[i] = (double)rand() / RAND_MAX;
+    double* cloc[P * Q];
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P, pcc = r / P;
+        scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        cloc[r] = (double*)malloc(sizeof(double) * (size_t)N * N);
+        scatter(C0, cloc[r], n, n, nb, nb, prr, pcc, lld[r]);
+    }
+    const double alpha = 0.5, beta = -0.25;
+    for (int r = 0; r < P * Q; ++r) {
+        descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt,
+                  &lld[r], &info);
+        pdgemm_("T", "N", &n, &n, &n, &alpha, loc[r], &ione, &ione, desc,
+                loc[r], &ione, &ione, desc, &beta, cloc[r], &ione, &ione,
+                desc, &info);
+        if (info != 0) { fprintf(stderr, "pdgemm info=%d\n", info);
+                         return 6; }
+    }
+    for (int r = 0; r < P * Q; ++r)
+        gather(Cres, cloc[r], n, n, nb, nb, r % P, r / P, lld[r]);
+    maxe = 0;
+    for (int j = 0; j < N; ++j)
+        for (int i = 0; i < N; ++i) {
+            double s = 0;
+            for (int k = 0; k < N; ++k) s += A[i * N + k] * A[j * N + k];
+            double want = alpha * s + beta * C0[j * N + i];
+            double e = fabs(want - Cres[j * N + i]);
+            if (e > maxe) maxe = e;
+        }
+    scaled = maxe / (amax * amax * N * 2.22e-16);
+    printf("pdgemm 2x2 scaled residual: %.3f\n", scaled);
+    if (scaled > 10) { fprintf(stderr, "pdgemm FAILED\n"); return 7; }
+
+    Cblacs_gridexit(ctxt);
+    printf("ok: ScaLAPACK API smoke (2x2 grid round-trip)\n");
+    slate_c_finalize();
+    return 0;
+}

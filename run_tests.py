@@ -3,6 +3,12 @@
 ``test/run_tests.py`` (size classes, per-run timeouts, summary, exit
 code for CI).
 
+Routines run IN-PROCESS by default so every sweep shares one jit cache
+(the round-3 suite paid a fresh XLA compile per routine subprocess and
+blew past the reference's --quick CI budget); ``--isolate`` restores the
+one-subprocess-per-routine mode (fresh compile, hard timeouts) for
+debugging a routine that corrupts global state.
+
 Usage:
   python run_tests.py --quick              # small dims, every routine
   python run_tests.py -m                   # medium dims
@@ -45,22 +51,38 @@ def main(argv=None):
     ap.add_argument("--types", default="s")
     ap.add_argument("--nb", type=int, default=64)
     ap.add_argument("--timeout", type=int, default=600)
+    ap.add_argument("--isolate", action="store_true",
+                    help="one subprocess per routine (fresh jit cache, "
+                    "hard timeout) instead of the shared-process default")
     args = ap.parse_args(argv)
 
     dims = QUICK if args.quick else (MEDIUM if args.medium else SMALL)
     routines = (args.routines.split(",") if args.routines
                 else SINGLE + (DIST if args.dist else []))
     failures, t0 = [], time.time()
+    if not args.isolate:
+        import tester
     for r in routines:
         d = QUICK if (r in SLOW and not args.quick) else dims
-        tester = str(pathlib.Path(__file__).resolve().parent / "tester.py")
-        cmd = [sys.executable, tester, r, "--dim", d,
-               "--type", args.types, "--nb", str(args.nb)]
-        print(f"=== {' '.join(cmd[1:])}", flush=True)
-        try:
-            rc = subprocess.run(cmd, timeout=args.timeout).returncode
-        except subprocess.TimeoutExpired:
-            rc = 124
+        targv = [r, "--dim", d, "--type", args.types, "--nb", str(args.nb)]
+        print(f"=== tester.py {' '.join(targv)}", flush=True)
+        if args.isolate:
+            tester_path = str(pathlib.Path(__file__).resolve().parent
+                              / "tester.py")
+            cmd = [sys.executable, tester_path] + targv
+            try:
+                rc = subprocess.run(cmd, timeout=args.timeout).returncode
+            except subprocess.TimeoutExpired:
+                rc = 124
+        else:
+            try:
+                rc = tester.main(targv)
+            except SystemExit as e:       # argparse or explicit exits
+                rc = (e.code if isinstance(e.code, int)
+                      else 0 if e.code is None else 1)
+            except Exception as e:        # a crashed routine fails alone
+                print(f"  CRASH: {type(e).__name__}: {e}", flush=True)
+                rc = 3
         if rc != 0:
             failures.append((r, rc))
     dt = time.time() - t0

@@ -50,6 +50,16 @@ def call(op: str, a, b=None, uplo: str = "L", trans: str = "N"):
     if op == "gesv":
         lu, piv, x = L.gesv(_j(a), _j(b))
         return (_np(x).T, _np(piv).astype(np.int64))
+    if op == "gesv_full":
+        # ScaLAPACK pdgesv semantics: return the LU factor, the LAPACK
+        # 1-based swap sequence, AND the solution (A and B both
+        # overwritten on the caller side)
+        from ..linalg.lu import perm_to_ipiv
+        lu, perm = L.getrf(_j(a))
+        x = L.getrs(getattr(lu, "data", lu), perm, _j(b))
+        ipiv = perm_to_ipiv(perm)
+        return (_np(getattr(lu, "data", lu)).T,
+                _np(ipiv).astype(np.int64), _np(x).T)
     if op == "getrf":
         lu, piv = L.getrf(_j(a))
         return (_np(getattr(lu, "data", lu)).T, _np(piv).astype(np.int64))

@@ -22,6 +22,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from ..grid import ceildiv
 from ..ops.blocks import matmul as _mm
 from .dist import DistMatrix, like
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
@@ -77,9 +78,17 @@ def pgemm_auto(alpha, a, b, mesh, nb: int = 256) -> DistMatrix:
 
 
 def pgemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
-          c: DistMatrix = None) -> DistMatrix:
-    """C ← α·A·B + β·C, all operands block-cyclic on the same mesh."""
+          c: DistMatrix = None, method: str = "auto") -> DistMatrix:
+    """C ← α·A·B + β·C, all operands block-cyclic on the same mesh.
 
+    ``method`` ∈ {"auto", "A", "C"} picks the stationary operand
+    (reference ``MethodGemm::select_algo``, ``method.hh:77-126``):
+    Auto routes single-column-tile B through the A-stationary layout
+    (:func:`pgemm_a` — collectives move O(|B|+|C|), not O(|A|)) and
+    everything else through SUMMA (C-stationary)."""
+
+    if select_pgemm(a, b, method) == "A":
+        return pgemm_a(alpha, a, b, beta, c)
     if a.n != b.m:
         raise ValueError(f"inner dimensions differ: A is {a.m}x{a.n}, "
                          f"B is {b.m}x{b.n}")
@@ -105,3 +114,109 @@ def pgemm(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
     out = fn(a.data, b.data, c.data,
              jnp.asarray(alpha, a.dtype), jnp.asarray(beta, a.dtype))
     return like(c, out)
+
+
+# ---------------------------------------------------------------------------
+# gemmA: A-stationary layout for narrow B/C (reference src/gemmA.cc +
+# internal_gemmA.cc, selection method.hh:77-126)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _build_pgemm_a(mesh, kb: int, ntc_loc: int, cnb: int,
+                   cnb_b: int, dtype_name: str):
+    """A-stationary distributed gemm: A never moves; B (narrow) is
+    gathered onto every rank, each rank contracts its resident A tiles
+    against the matching B block-rows, and the C contributions are
+    summed along the mesh rows' k-partition (one ``psum`` of the narrow
+    C) — the collective profile the reference's gemmA exists for: move
+    O(|B| + |C|), not O(|A|) (``internal_gemmA.cc``)."""
+
+    p, q = mesh_grid_shape(mesh)
+
+    def _gather_global_rows(x, axis_name, bs, axis):
+        """all_gather along a mesh axis + cyclic un-shuffle to global
+        tile order (local block l on rank r is global l*nranks + r)."""
+        g = lax.all_gather(x, axis_name, axis=axis, tiled=False)
+        # the ranks dimension lands AT `axis`; local block l on rank r
+        # is global tile l*nranks + r, so swap (ranks, blocks) order
+        nranks = g.shape[axis]
+        nblk = g.shape[axis + 1] // bs
+        shp = g.shape[:axis] + (nranks, nblk, bs) + g.shape[axis + 2:]
+        g = g.reshape(shp)
+        g = jnp.swapaxes(g, axis, axis + 1)
+        out_shape = list(x.shape)
+        out_shape[axis] = x.shape[axis] * nranks
+        return g.reshape(out_shape)
+
+    def kernel(a_loc, b_loc, c_loc, alpha, beta):
+        c_idx = lax.axis_index(AXIS_Q)
+        mal, kal = a_loc.shape
+        # gather B globally (narrow: O(K·n) bytes, the point of gemmA)
+        b_full = _gather_global_rows(b_loc, AXIS_P, kb, 0)
+        b_full = _gather_global_rows(b_full, AXIS_Q, cnb_b, 1)
+        ktot = b_full.shape[0] // kb
+        # select the block-rows matching this rank's resident A columns
+        idx = jnp.arange(kal // kb) * q + c_idx
+        b_sel = jnp.take(b_full.reshape(ktot, kb, -1), idx,
+                         axis=0).reshape(kal, -1)
+        part = _mm(a_loc, b_sel)
+        csum = lax.psum(part, AXIS_Q)             # narrow C, rows = A rows
+        cidx = jnp.arange(ntc_loc) * q + c_idx
+        csel = jnp.take(csum.reshape(mal, -1, cnb), cidx,
+                        axis=1).reshape(mal, ntc_loc * cnb)
+        return alpha * csel + beta * c_loc
+
+    fn = shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(AXIS_P, AXIS_Q), P(AXIS_P, AXIS_Q), P(AXIS_P, AXIS_Q),
+                  P(), P()),
+        out_specs=P(AXIS_P, AXIS_Q))
+    return jax.jit(fn)
+
+
+def pgemm_a(alpha, a: DistMatrix, b: DistMatrix, beta=0.0,
+            c: DistMatrix = None) -> DistMatrix:
+    """C ← α·A·B + β·C with the A-stationary layout — reference
+    ``slate::gemmA`` (``src/gemmA.cc``): the right choice when B and C
+    are narrow, so the collectives move O(|B|+|C|) instead of O(|A|)."""
+
+    if a.n != b.m:
+        raise ValueError(f"inner dimensions differ: A is {a.m}x{a.n}, "
+                         f"B is {b.m}x{b.n}")
+    if a.nb != b.row_nb:
+        raise ValueError("pgemm_a requires A's column tiles to match "
+                         f"B's row tiles, got {a.nb} vs {b.row_nb}")
+    if a.ntp != b.mtp:
+        raise ValueError(
+            f"inner padded tile counts differ: {a.ntp} vs {b.mtp}; "
+            "distribute A with col_mult=p and B with row_mult=q")
+    p, q = a.grid_shape
+    if c is None:
+        cdata = jnp.zeros(
+            (a.mtp * a.row_nb, b.ntp * b.nb), a.dtype,
+            device=jax.sharding.NamedSharding(a.mesh, P(AXIS_P, AXIS_Q)))
+        c = DistMatrix(cdata, a.m, b.n, b.nb, a.mesh,
+                       mb=a.row_nb if a.row_nb != b.nb else None)
+    fn = _build_pgemm_a(a.mesh, a.nb, c.ntp // q, c.nb,
+                        b.nb, str(a.dtype))
+    out = fn(a.data, b.data, c.data,
+             jnp.asarray(alpha, a.dtype), jnp.asarray(beta, a.dtype))
+    return like(c, out)
+
+
+def select_pgemm(a: DistMatrix, b: DistMatrix, method: str = "auto"):
+    """Mesh-side gemm method selection mirroring
+    ``MethodGemm::select_algo`` (``method.hh:77-126``): A-stationary
+    when B has a single column tile (narrow), C-stationary (SUMMA)
+    otherwise.  (The reference additionally forces gemmC on multi-GPU
+    targets because its gemmA lacked a device path — this gemmA is
+    mesh-native, so Auto keeps it.)"""
+
+    if method == "auto":
+        ntb = ceildiv(b.n, b.nb) if b.n else 1
+        # Auto may only pick A when pgemm_a's distribution preconditions
+        # hold — otherwise operands SUMMA accepts would start raising
+        if ntb < 2 and a.nb == b.row_nb and a.ntp == b.mtp:
+            return "A"
+        return "C"
+    return method

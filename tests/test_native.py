@@ -215,3 +215,47 @@ class TestStage2:
                 np.sort(np.linalg.eigvalsh(
                     np.diag(d) + np.diag(e, 1) + np.diag(e, -1))),
                 atol=1e-9)
+
+
+def test_scalapack_api_smoke(tmp_path):
+    """Build + run the drop-in ScaLAPACK API smoke: pdpotrf_/pdgesv_/
+    pdgemm_ round-trip a 2x2-grid block-cyclic layout through the
+    single-controller BLACS emulation (reference
+    scalapack_api/scalapack_potrf.cc:27-80)."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+    import sysconfig
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    root = pathlib.Path(__file__).resolve().parents[1]
+    inc = sysconfig.get_paths()["include"]
+    cfg = f"python3.{sys.version_info.minor}-config"
+    if shutil.which(cfg) is None:
+        cfg = "python3-config"
+    if shutil.which(cfg) is None:
+        pytest.skip("no python3-config on PATH")
+    ldflags = subprocess.run(
+        [cfg, "--ldflags", "--embed"],
+        capture_output=True, text=True).stdout.split()
+    exe = tmp_path / "scal_smoke"
+    r = subprocess.run(
+        ["gcc", str(root / "examples" / "scalapack_smoke.c"),
+         str(root / "src" / "c_api" / "c_api_core.c"),
+         str(root / "src" / "c_api" / "driver_api.c"),
+         str(root / "src" / "c_api" / "scalapack_api.c"),
+         "-I" + str(root / "include"), "-I" + inc]
+        + ldflags + ["-O2", "-lm", "-o", str(exe)],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("embed build unavailable: " + r.stderr[-500:])
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(root) + ":" + ":".join(
+        p for p in sys.path if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    out = subprocess.run([str(exe)], capture_output=True, text=True,
+                         env=env, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ok: ScaLAPACK API smoke" in out.stdout

@@ -260,6 +260,462 @@ def gen_fortran():
     return "\n".join(lines)
 
 
+
+
+# ---------------------------------------------------------------------------
+# Drop-in ScaLAPACK API (reference scalapack_api/: p?potrf/p?gesv/p?gemm
+# with BLACS descriptors, 3 Fortran manglings each).
+# ---------------------------------------------------------------------------
+
+SCALAPACK_CORE = r"""/* slate_tpu ScaLAPACK compatibility API — GENERATED by
+ * tools/generate_c_api.py; do not edit.
+ *
+ * Drop-in desc-based symbols (p?potrf / p?gesv / p?gemm, three Fortran
+ * manglings each) over the embedded-CPython driver core, mirroring the
+ * reference's scalapack_api/ (scalapack_potrf.cc:27-80 etc.).
+ *
+ * SINGLE-CONTROLLER BLACS EMULATION.  The reference runs one MPI rank
+ * per grid cell; a JAX/TPU program is a single controller that owns
+ * every device.  These stubs therefore implement the BLACS surface for
+ * ONE process that plays all p*q ranks in sequence:
+ *
+ *   - Cblacs_gridinit(&ctxt, order, p, q) creates a virtual p x q grid.
+ *   - Cblacs_gridinfo(ctxt, ...) reports the coordinates of the grid's
+ *     CURRENT virtual rank (initially (0,0)).
+ *   - Each p? routine call registers the current virtual rank's local
+ *     buffer and advances the rank cursor; when the LAST rank of the
+ *     grid has called (the SPMD program unrolled sequentially), the
+ *     routine assembles the global matrix from the block-cyclic local
+ *     pieces (numroc layout), runs the driver on the accelerator,
+ *     scatters results back into every registered local buffer, and
+ *     returns the real info.  Earlier (pending) registration calls
+ *     return info = 0; their output buffers are valid once the final
+ *     rank's call returns — the sequential-emulation analog of the
+ *     collective completing.
+ *   - On a 1 x 1 grid every call computes immediately: a true drop-in
+ *     for serial ScaLAPACK usage.
+ *
+ * Submatrix offsets ia/ja must be 1 (whole-matrix operation), matching
+ * the dominant ScaLAPACK usage; other values set *info = -900.
+ */
+
+#include "slate_tpu_driver.h"
+#include <complex.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* ---------------- BLACS emulation ---------------- */
+
+#define SLATE_MAX_CTXT 64
+#define SLATE_MAX_RANKS 256
+
+typedef struct { int p, q, cur, used; } blacs_ctx;
+static blacs_ctx g_ctx[SLATE_MAX_CTXT];
+
+/* forward decl: pending-collective table (defined below) */
+typedef struct pending_s pending_t;
+static void pend_abandon_ctxt(int ctxt);
+
+static blacs_ctx* ctx_of(int ic) {
+    if (ic < 0 || ic >= SLATE_MAX_CTXT || !g_ctx[ic].used) return 0;
+    return &g_ctx[ic];
+}
+
+void Cblacs_pinfo(int* mypnum, int* nprocs) {
+    if (mypnum) *mypnum = 0;
+    if (nprocs) *nprocs = SLATE_MAX_RANKS;
+}
+
+void Cblacs_get(int ctxt, int what, int* val) {
+    (void)ctxt; (void)what;
+    if (val) *val = 0;   /* system default "context" handle */
+}
+
+void Cblacs_gridinit(int* ctxt, const char* order, int p, int q) {
+    (void)order;   /* column-major rank order assumed, BLACS default */
+    for (int i = 0; i < SLATE_MAX_CTXT; ++i) {
+        if (!g_ctx[i].used) {
+            g_ctx[i].used = 1; g_ctx[i].p = p; g_ctx[i].q = q;
+            g_ctx[i].cur = 0;
+            *ctxt = i;
+            return;
+        }
+    }
+    *ctxt = -1;
+}
+
+void Cblacs_gridinfo(int ctxt, int* np_row, int* np_col,
+                     int* my_row, int* my_col) {
+    blacs_ctx* c = ctx_of(ctxt);
+    if (!c) { if (np_row) *np_row = -1; return; }
+    if (np_row) *np_row = c->p;
+    if (np_col) *np_col = c->q;
+    /* column-major rank order: rank r -> (r % p, r / p).  The cursor
+     * marks WHICH virtual rank the sequential program is currently
+     * simulating; it advances on Cblacs_barrier (the natural "end of
+     * this rank's turn" marker when an SPMD loop is unrolled), NOT on
+     * p? calls — so a loop body may invoke several routines per rank. */
+    if (my_row) *my_row = c->cur % c->p;
+    if (my_col) *my_col = c->cur / c->p;
+}
+
+void Cblacs_gridexit(int ctxt) {
+    blacs_ctx* c = ctx_of(ctxt);
+    if (c) c->used = 0;
+    /* abandon any half-registered collectives on this context so the
+     * pending slots cannot leak (pend_get would otherwise return NULL
+     * after 8 abandoned collectives) */
+    pend_abandon_ctxt(ctxt);
+}
+
+void Cblacs_exit(int notdone) { (void)notdone; }
+
+void Cblacs_barrier(int ctxt, const char* scope) {
+    (void)scope;
+    blacs_ctx* c = ctx_of(ctxt);
+    if (c) c->cur = (c->cur + 1) % (c->p * c->q);
+}
+
+/* ---------------- numroc / descinit (3 manglings) ---------------- */
+
+static int numroc_impl(int n, int nb, int iproc, int isrcproc, int nprocs) {
+    int mydist = (nprocs + iproc - isrcproc) % nprocs;
+    int nblocks = n / nb;
+    int out = (nblocks / nprocs) * nb;
+    int extra = nblocks % nprocs;
+    if (mydist < extra) out += nb;
+    else if (mydist == extra) out += n % nb;
+    return out;
+}
+
+int numroc_(const int* n, const int* nb, const int* iproc,
+            const int* isrcproc, const int* nprocs) {
+    return numroc_impl(*n, *nb, *iproc, *isrcproc, *nprocs);
+}
+int numroc(const int* n, const int* nb, const int* iproc,
+           const int* isrcproc, const int* nprocs) {
+    return numroc_impl(*n, *nb, *iproc, *isrcproc, *nprocs);
+}
+int NUMROC(const int* n, const int* nb, const int* iproc,
+           const int* isrcproc, const int* nprocs) {
+    return numroc_impl(*n, *nb, *iproc, *isrcproc, *nprocs);
+}
+
+static void descinit_impl(int* desc, int m, int n, int mb, int nb,
+                          int irsrc, int icsrc, int ctxt, int lld,
+                          int* info) {
+    desc[0] = 1; desc[1] = ctxt; desc[2] = m; desc[3] = n;
+    desc[4] = mb; desc[5] = nb; desc[6] = irsrc; desc[7] = icsrc;
+    desc[8] = lld;
+    if (info) *info = 0;
+}
+
+void descinit_(int* desc, const int* m, const int* n, const int* mb,
+               const int* nb, const int* irsrc, const int* icsrc,
+               const int* ctxt, const int* lld, int* info) {
+    descinit_impl(desc, *m, *n, *mb, *nb, *irsrc, *icsrc, *ctxt, *lld, info);
+}
+void descinit(int* desc, const int* m, const int* n, const int* mb,
+              const int* nb, const int* irsrc, const int* icsrc,
+              const int* ctxt, const int* lld, int* info) {
+    descinit_impl(desc, *m, *n, *mb, *nb, *irsrc, *icsrc, *ctxt, *lld, info);
+}
+void DESCINIT(int* desc, const int* m, const int* n, const int* mb,
+              const int* nb, const int* irsrc, const int* icsrc,
+              const int* ctxt, const int* lld, int* info) {
+    descinit_impl(desc, *m, *n, *mb, *nb, *irsrc, *icsrc, *ctxt, *lld, info);
+}
+
+/* ---------------- block-cyclic gather / scatter ---------------- */
+
+#define D_CTXT(d) ((d)[1])
+#define D_M(d)    ((d)[2])
+#define D_N(d)    ((d)[3])
+#define D_MB(d)   ((d)[4])
+#define D_NB(d)   ((d)[5])
+#define D_LLD(d)  ((d)[8])
+
+/* copy between global (col-major, ld = M) and the (pr, pc) rank's local
+ * buffer (col-major, ld = lld); dir 0 = local->global, 1 = global->local */
+static void cyclic_copy(void* glob, void* loc, const int* desc, int lld,
+                        int pr, int pc, int p, int q, int elem, int dir) {
+    int M = D_M(desc), N = D_N(desc), MB = D_MB(desc), NB = D_NB(desc);
+    int mloc = numroc_impl(M, MB, pr, 0, p);
+    int nloc = numroc_impl(N, NB, pc, 0, q);
+    char* g = (char*)glob; char* l = (char*)loc;
+    for (int jl = 0; jl < nloc; ++jl) {
+        int jg = ((jl / NB) * q + pc) * NB + jl % NB;
+        for (int il0 = 0; il0 < mloc; il0 += MB) {
+            int ig0 = ((il0 / MB) * p + pr) * MB;
+            int len = mloc - il0 < MB ? mloc - il0 : MB;
+            char* gp = g + ((size_t)jg * M + ig0) * elem;
+            char* lp = l + ((size_t)jl * lld + il0) * elem;
+            if (dir) memcpy(lp, gp, (size_t)len * elem);
+            else memcpy(gp, lp, (size_t)len * elem);
+        }
+    }
+}
+
+/* ---------------- collective registration ---------------- */
+
+struct pending_s {
+    int tag;                       /* routine id, 0 = slot free */
+    int ctxt;
+    int nreg;                      /* registrations so far (rank order) */
+    void* locals[SLATE_MAX_RANKS];     /* A local buffers, rank order */
+    void* locals2[SLATE_MAX_RANKS];    /* B local buffers (solvers) */
+    void* locals3[SLATE_MAX_RANKS];    /* C local buffers (gemm) */
+    int*  ipivs[SLATE_MAX_RANKS];
+    /* lld is the one per-rank descriptor field — captured per call */
+    int llds[SLATE_MAX_RANKS];
+    int llds2[SLATE_MAX_RANKS];
+    int llds3[SLATE_MAX_RANKS];
+};
+
+static pending_t g_pend[8];
+
+static void pend_abandon_ctxt(int ctxt) {
+    for (int i = 0; i < 8; ++i)
+        if (g_pend[i].ctxt == ctxt) g_pend[i].tag = 0;
+}
+
+static pending_t* pend_get(int tag, int ctxt) {
+    for (int i = 0; i < 8; ++i)
+        if (g_pend[i].tag == tag && g_pend[i].ctxt == ctxt)
+            return &g_pend[i];
+    for (int i = 0; i < 8; ++i)
+        if (g_pend[i].tag == 0) {
+            memset(&g_pend[i], 0, sizeof(pending_t));
+            g_pend[i].tag = tag; g_pend[i].ctxt = ctxt;
+            return &g_pend[i];
+        }
+    return 0;
+}
+
+static int elem_of(char dt) {
+    switch (dt) { case 's': return 4; case 'd': return 8;
+                  case 'c': return 8; case 'z': return 16; }
+    return 0;
+}
+
+/* register this rank's buffers under the routine's OWN registration
+ * counter (virtual ranks register in column-major rank order, the
+ * natural unrolled-SPMD loop order); returns 1 when the grid is
+ * complete — time to compute */
+static int pend_step(pending_t* pe, blacs_ctx* c,
+                     void* a, int lda, void* b, int ldb,
+                     void* cc, int ldc, int* ipiv) {
+    int r = pe->nreg;
+    pe->locals[r] = a; pe->locals2[r] = b; pe->locals3[r] = cc;
+    pe->ipivs[r] = ipiv;
+    pe->llds[r] = lda; pe->llds2[r] = ldb; pe->llds3[r] = ldc;
+    pe->nreg += 1;
+    return pe->nreg == c->p * c->q;
+}
+
+/* ---------------- generic p? implementations ---------------- */
+
+static int check_sub(int ia, int ja, int* info) {
+    if (ia != 1 || ja != 1) { if (info) *info = -900; return 1; }
+    return 0;
+}
+
+static void ppotrf_impl(char dt, const char* uplo, int n,
+                        void* a, int ia, int ja, const int* desca,
+                        int* info) {
+    if (check_sub(ia, ja, info)) return;
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { *info = -901; return; }
+    if (D_M(desca) != n || D_N(desca) != n) { *info = -902; return; }
+    pending_t* pe = pend_get(1000 + dt, D_CTXT(desca));
+    if (!pe) { *info = -903; return; }
+    *info = 0;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, 0))
+        return;   /* wait for the full grid */
+    int elem = elem_of(dt);
+    size_t gsz = (size_t)D_M(desca) * D_N(desca) * elem;
+    char* glob = (char*)malloc(gsz);
+    char* gout = (char*)malloc(gsz);
+    for (int r = 0; r < c->p * c->q; ++r)
+        cyclic_copy(glob, pe->locals[r], desca, pe->llds[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+    int rc = slate_c_call("potrf", dt, n, n, glob, n, 0, 0, 0, 0,
+                          gout, 0, 0, uplo[0]);
+    for (int r = 0; r < c->p * c->q; ++r)
+        cyclic_copy(gout, pe->locals[r], desca, pe->llds[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 1);
+    free(glob); free(gout);
+    pe->tag = 0;
+    *info = rc;
+}
+
+static void pgesv_impl(char dt, int n, int nrhs,
+                       void* a, int ia, int ja, const int* desca,
+                       int* ipiv, void* b, int ib, int jb,
+                       const int* descb, int* info) {
+    if (check_sub(ia, ja, info) || check_sub(ib, jb, info)) return;
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { *info = -901; return; }
+    if (D_M(desca) != n || D_N(desca) != n
+        || D_M(descb) != n || D_N(descb) != nrhs) { *info = -902; return; }
+    pending_t* pe = pend_get(2000 + dt, D_CTXT(desca));
+    if (!pe) { *info = -903; return; }
+    *info = 0;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, ipiv))
+        return;
+    int elem = elem_of(dt);
+    size_t asz = (size_t)D_M(desca) * D_N(desca) * elem;
+    size_t bsz = (size_t)D_M(descb) * D_N(descb) * elem;
+    char* ag = (char*)malloc(asz); char* bg = (char*)malloc(bsz);
+    char* lu = (char*)malloc(asz); char* xg = (char*)malloc(bsz);
+    int64_t* piv = (int64_t*)malloc(sizeof(int64_t) * (size_t)n);
+    for (int r = 0; r < c->p * c->q; ++r) {
+        cyclic_copy(ag, pe->locals[r], desca, pe->llds[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+        cyclic_copy(bg, pe->locals2[r], descb, pe->llds2[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+    }
+    int rc = slate_c_call("gesv_full", dt, n, n, ag, n, n, nrhs,
+                          bg, n, lu, piv, xg, 'L');
+    for (int r = 0; r < c->p * c->q; ++r) {
+        int pr = r % c->p, pc_ = r / c->p;
+        cyclic_copy(lu, pe->locals[r], desca, pe->llds[r], pr, pc_,
+                    c->p, c->q, elem, 1);
+        cyclic_copy(xg, pe->locals2[r], descb, pe->llds2[r], pr, pc_,
+                    c->p, c->q, elem, 1);
+        if (pe->ipivs[r]) {
+            /* distributed ipiv: local row il of this process row holds
+             * the global 1-based swap target of its global row */
+            int MB = D_MB(desca);
+            int mloc = numroc_impl(n, MB, pr, 0, c->p);
+            for (int il = 0; il < mloc; ++il) {
+                int igr = ((il / MB) * c->p + pr) * MB + il % MB;
+                if (igr < n) pe->ipivs[r][il] = (int)piv[igr];
+            }
+        }
+    }
+    free(ag); free(bg); free(lu); free(xg); free(piv);
+    pe->tag = 0;
+    *info = rc;
+}
+"""
+
+PGEMM_IMPL = r"""
+/* typed alpha*op(A)*op(B) + beta*C combine + op() builders */
+static void opmat_{k}(char tr, int m, int n, const {T}* g, {T}* out) {{
+    /* g is (m x n) col-major; out is op(g): N -> copy, T/C -> (n x m) */
+    if (tr == 'N' || tr == 'n') {{
+        memcpy(out, g, sizeof({T}) * (size_t)m * n);
+        return;
+    }}
+    for (int j = 0; j < n; ++j)
+        for (int i = 0; i < m; ++i) {{
+            {T} v = g[(size_t)j * m + i];
+            out[(size_t)i * n + j] = {CONJ};
+        }}
+}}
+
+static void pgemm_impl_{k}(const char* transa, const char* transb,
+                           int m, int n, int k, {T} alpha,
+                           {T}* a, int ia, int ja, const int* desca,
+                           {T}* b, int ib, int jb, const int* descb,
+                           {T} beta,
+                           {T}* cc, int ic, int jc, const int* descc,
+                           int* info) {{
+    if (check_sub(ia, ja, info) || check_sub(ib, jb, info)
+        || check_sub(ic, jc, info)) return;
+    blacs_ctx* c = ctx_of(D_CTXT(descc));
+    if (!c) {{ *info = -901; return; }}
+    int opa = (transa[0] == 'N' || transa[0] == 'n');
+    int opb = (transb[0] == 'N' || transb[0] == 'n');
+    if (D_M(desca) != (opa ? m : k) || D_N(desca) != (opa ? k : m)
+        || D_M(descb) != (opb ? k : n) || D_N(descb) != (opb ? n : k)
+        || D_M(descc) != m || D_N(descc) != n) {{ *info = -902; return; }}
+    pending_t* pe = pend_get(3000 + (int)'{k}', D_CTXT(descc));
+    if (!pe) {{ *info = -903; return; }}
+    *info = 0;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb),
+                   cc, D_LLD(descc), 0)) return;
+    int elem = (int)sizeof({T});
+    int Am = D_M(desca), An = D_N(desca);
+    int Bm = D_M(descb), Bn = D_N(descb);
+    {T}* ag = ({T}*)malloc(sizeof({T}) * (size_t)Am * An);
+    {T}* bg = ({T}*)malloc(sizeof({T}) * (size_t)Bm * Bn);
+    {T}* cg = ({T}*)malloc(sizeof({T}) * (size_t)m * n);
+    {T}* oa = ({T}*)malloc(sizeof({T}) * (size_t)m * k);
+    {T}* ob = ({T}*)malloc(sizeof({T}) * (size_t)k * n);
+    {T}* pg = ({T}*)malloc(sizeof({T}) * (size_t)m * n);
+    for (int r = 0; r < c->p * c->q; ++r) {{
+        cyclic_copy(ag, pe->locals[r], desca, pe->llds[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+        cyclic_copy(bg, pe->locals2[r], descb, pe->llds2[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+        cyclic_copy(cg, pe->locals3[r], descc, pe->llds3[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+    }}
+    opmat_{k}(transa[0], Am, An, ag, oa);
+    opmat_{k}(transb[0], Bm, Bn, bg, ob);
+    int rc = slate_c_call("gemm", '{k}', m, k, oa, m, k, n, ob, k,
+                          pg, 0, 0, 'L');
+    for (size_t i = 0; i < (size_t)m * n; ++i)
+        cg[i] = alpha * pg[i] + beta * cg[i];
+    for (int r = 0; r < c->p * c->q; ++r)
+        cyclic_copy(cg, pe->locals3[r], descc, pe->llds3[r],
+                    r % c->p, r / c->p, c->p, c->q, elem, 1);
+    free(ag); free(bg); free(cg); free(oa); free(ob); free(pg);
+    pe->tag = 0;
+    *info = rc;
+}}
+"""
+
+
+def gen_scalapack():
+    lines = [SCALAPACK_CORE]
+    for k in "sdcz":
+        T = CTYPES[k]
+        if k == "c":
+            conj = "((tr == 'C' || tr == 'c') ? conjf(v) : v)"
+        elif k == "z":
+            conj = "((tr == 'C' || tr == 'c') ? conj(v) : v)"
+        else:
+            conj = "v"
+        lines.append(PGEMM_IMPL.format(k=k, T=T, CONJ=conj))
+    # the 3-mangled typed wrappers
+    for k in "sdcz":
+        T = CTYPES[k]
+        for name in (f"p{k}potrf",):
+            for mang in (name.upper(), name, name + "_"):
+                lines.append(
+                    f"void {mang}(const char* uplo, const int* n, {T}* a, "
+                    f"const int* ia, const int* ja, const int* desca, "
+                    f"int* info)\n"
+                    f"{{ ppotrf_impl('{k}', uplo, *n, a, *ia, *ja, desca, "
+                    f"info); }}\n")
+        for name in (f"p{k}gesv",):
+            for mang in (name.upper(), name, name + "_"):
+                lines.append(
+                    f"void {mang}(const int* n, const int* nrhs, {T}* a, "
+                    f"const int* ia, const int* ja, const int* desca, "
+                    f"int* ipiv, {T}* b, const int* ib, const int* jb, "
+                    f"const int* descb, int* info)\n"
+                    f"{{ pgesv_impl('{k}', *n, *nrhs, a, *ia, *ja, desca, "
+                    f"ipiv, b, *ib, *jb, descb, info); }}\n")
+        for name in (f"p{k}gemm",):
+            for mang in (name.upper(), name, name + "_"):
+                lines.append(
+                    f"void {mang}(const char* transa, const char* transb, "
+                    f"const int* m, const int* n, const int* k, "
+                    f"const {T}* alpha, {T}* a, const int* ia, "
+                    f"const int* ja, const int* desca, {T}* b, "
+                    f"const int* ib, const int* jb, const int* descb, "
+                    f"const {T}* beta, {T}* c, const int* ic, "
+                    f"const int* jc, const int* descc, int* info)\n"
+                    f"{{ pgemm_impl_{k}(transa, transb, *m, *n, *k, *alpha, "
+                    f"a, *ia, *ja, desca, b, *ib, *jb, descb, *beta, "
+                    f"c, *ic, *jc, descc, info); }}\n")
+    return "\n".join(lines)
+
+
 def main():
     with open(os.path.join(ROOT, "include", "slate_tpu_driver.h"), "w") as f:
         f.write(gen_header())
@@ -268,6 +724,9 @@ def main():
         f.write(gen_c_bodies())
     with open(os.path.join(ROOT, "fortran", "slate_tpu.f90"), "w") as f:
         f.write(gen_fortran())
+    with open(os.path.join(ROOT, "src", "c_api", "scalapack_api.c"),
+              "w") as f:
+        f.write(gen_scalapack())
     n = sum(len(k) for _, k, _, _ in DRIVERS)
     print(f"generated {len(DRIVERS)} drivers, {n} typed entry points")
 
