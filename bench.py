@@ -252,12 +252,175 @@ def main():
 
     _run_routine("geqrf", bench_geqrf, sub, fails, infra)
 
-    vals = [v for v in sub.values() if isinstance(v, (int, float)) and v > 0]
+    # ---- gels (config 4: least squares, m=32768 n=4096) -------------
+    def bench_gels():
+        rng = np.random.default_rng(4)
+        m2, n2 = 32768 // scale, 4096 // scale
+        a_np = rng.standard_normal((m2, n2)).astype(np.float32)
+        b_np = rng.standard_normal((m2,)).astype(np.float32)
+        a = jnp.asarray(a_np)
+        b = jnp.asarray(b_np)
+        import slate_tpu as st
+
+        gl_iters = 4 if on_tpu else 2
+
+        @jax.jit
+        def gels_chain(a, b):
+            def body(i, x):
+                xs = st.gels(a, x)
+                pad = jnp.zeros((a.shape[0] - xs.shape[0],), a.dtype)
+                return b + jnp.concatenate([xs, pad]) * jnp.float32(1e-30)
+            out = lax.fori_loop(0, gl_iters - 1, body, b)
+            return st.gels(a, out)[-1]
+
+        t = _timeit(gels_chain, (a, b), gl_iters)
+        fl = 2.0 * m2 * n2 ** 2 - 2.0 * n2 ** 3 / 3.0 + 4.0 * m2 * n2
+        gf = fl / t / 1e9
+        x_np = np.asarray(jax.jit(lambda a, b: st.gels(a, b))(a, b))
+        # normal-equations residual: Aᵀ(Ax − b) ≈ 0
+        r = a_np.T @ (a_np @ x_np - b_np)
+        resid = (np.linalg.norm(r)
+                 / (np.linalg.norm(a_np) ** 2 * np.linalg.norm(x_np)
+                    * eps * np.sqrt(m2)))
+        return "gels_fp32_m%d_n%d" % (m2, n2), gf, resid
+
+    _run_routine("gels", bench_gels, sub, fails, infra)
+
+    # ---- fp64 anchors (config 2: gemm + potrf fp64) ------------------
+    # TPU matrix units are fp32/bf16; fp64 runs emulated.  The honest
+    # report: measure the fp64 gemm anchor and express fp64 routines as
+    # a fraction of THAT (the reference's A100 does native fp64 — this
+    # is the one place the hardware class differs; BASELINE.md notes it)
+    n64 = (4096 if on_tpu else 512)
+    def bench_gemm64():
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        rng = np.random.default_rng(5)
+        a_np = rng.standard_normal((n64, n64))
+        b_np = rng.standard_normal((n64, n64))
+        a = jnp.asarray(a_np, jnp.float64)
+        b = jnp.asarray(b_np, jnp.float64)
+
+        g_iters = 2
+
+        @jax.jit
+        def chain64(a, b):
+            def body(i, x):
+                return jnp.matmul(x, b) * jnp.float64(1e-4)
+            return lax.fori_loop(0, g_iters, body, a)[0, 0]
+
+        t = _timeit(chain64, (a, b), g_iters)
+        gf = 2.0 * n64 ** 3 / t / 1e9
+        c = np.asarray(jax.jit(jnp.matmul)(a, b))
+        x = rng.standard_normal(n64)
+        e64 = float(np.finfo(np.float64).eps)
+        resid = (np.linalg.norm(c @ x - a_np @ (b_np @ x))
+                 / (np.linalg.norm(a_np) * np.linalg.norm(b_np @ x)
+                    * e64 * n64))
+        return "gemm_fp64_n%d" % n64, gf, resid
+
+    gemm64_gf = _run_routine("gemm_fp64", bench_gemm64, sub, fails, infra)
+
+    def bench_potrf64():
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        rng = np.random.default_rng(6)
+        g = rng.standard_normal((n64, n64))
+        spd_np = g @ g.T + n64 * np.eye(n64)
+        spd = jnp.asarray(spd_np, jnp.float64)
+        import slate_tpu as st
+        from slate_tpu.enums import Uplo
+
+        def po(x):
+            return st.potrf(st.HermitianMatrix(x, uplo=Uplo.Lower)).data
+
+        @jax.jit
+        def chain(x):
+            l = po(x)
+            return po(x + l[-1, -1] * jnp.float64(1e-30))[-1, -1]
+
+        t = _timeit(chain, (spd,), 2)
+        gf = n64 ** 3 / 3.0 / t / 1e9
+        l_np = np.asarray(jax.jit(po)(spd))
+        l_np = np.tril(l_np)
+        x = rng.standard_normal(n64)
+        e64 = float(np.finfo(np.float64).eps)
+        resid = (np.linalg.norm(l_np @ (l_np.T @ x) - spd_np @ x)
+                 / (np.linalg.norm(spd_np) * np.linalg.norm(x)
+                    * e64 * n64))
+        return "potrf_fp64_n%d" % n64, gf, resid
+
+    _run_routine("potrf_fp64", bench_potrf64, sub, fails, infra)
+
+    # ---- heev / svd fp64 (config 5 scaled to one chip) ---------------
+    nev = 2048 if on_tpu else 256
+    def bench_heev64():
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        rng = np.random.default_rng(7)
+        g = rng.standard_normal((nev, nev))
+        herm = (g + g.T) / 2
+        import slate_tpu as st
+        from slate_tpu.enums import Uplo
+        hm = st.HermitianMatrix(jnp.asarray(herm, jnp.float64),
+                                uplo=Uplo.Lower)
+        st.heev(hm, jobz=True)          # warm the jit cache
+        t0 = time.perf_counter()
+        w, z = st.heev(hm, jobz=True)
+        w = np.asarray(w); z = np.asarray(z)
+        t = time.perf_counter() - t0
+        gf = (4.0 / 3.0) * nev ** 3 / t / 1e9
+        e64 = float(np.finfo(np.float64).eps)
+        resid = (np.linalg.norm(herm @ z - z * w[None, :])
+                 / (np.linalg.norm(herm) * nev * e64))
+        return "heev_fp64_n%d" % nev, gf, resid
+
+    _run_routine("heev_fp64", bench_heev64, sub, fails, infra)
+
+    def bench_svd64():
+        import jax
+        jax.config.update("jax_enable_x64", True)
+        rng = np.random.default_rng(8)
+        a_np = rng.standard_normal((nev, nev))
+        import slate_tpu as st
+        st.svd(jnp.asarray(a_np, jnp.float64))   # warm the jit cache
+        t0 = time.perf_counter()
+        sv, u, vt = st.svd(jnp.asarray(a_np, jnp.float64))
+        sv = np.asarray(sv); u = np.asarray(u); vt = np.asarray(vt)
+        t = time.perf_counter() - t0
+        gf = (8.0 / 3.0) * nev ** 3 / t / 1e9
+        e64 = float(np.finfo(np.float64).eps)
+        resid = (np.linalg.norm(a_np - (u * sv[None, :]) @ vt)
+                 / (np.linalg.norm(a_np) * nev * e64))
+        return "svd_fp64_n%d" % nev, gf, resid
+
+    _run_routine("svd_fp64", bench_svd64, sub, fails, infra)
+
+    # headline geomean: fp32 factor suite ONLY (the metric BENCH_r01-r03
+    # track); fp64/eig/svd submetrics are reported but kept out so the
+    # round-over-round number keeps meaning what its name says
+    headline_keys = [k for k in sub
+                     if k.startswith(("gemm_fp32", "potrf_fp32",
+                                      "getrf_fp32", "geqrf_fp32",
+                                      "gels_fp32"))]
+    vals = [sub[k] for k in headline_keys
+            if isinstance(sub[k], (int, float)) and sub[k] > 0]
     geomean = (float(np.exp(np.mean(np.log(vals)))) if vals else 0.0)
     gemm_key = "gemm_fp32_n%d" % n
+    gemm64_key = "gemm_fp64_n%d" % n64
     peak = {}
+    low = []
     if gemm_gf and sub.get(gemm_key):
-        peak = {k: round(v / sub[gemm_key], 3) for k, v in sub.items()}
+        for k, v in sub.items():
+            anchor = (sub.get(gemm64_key) if "fp64" in k
+                      else sub.get(gemm_key))
+            if anchor:
+                peak[k] = round(v / anchor, 3)
+                if peak[k] < 0.10 and "gemm" not in k and "mxu" not in k \
+                        and "heev" not in k and "svd" not in k:
+                    # two-stage eig/svd run partly on host; their
+                    # fraction is informational, not flagged
+                    low.append(k)
     out = {
         "metric": "factor_suite_fp32_geomean",
         "value": round(geomean, 1),
@@ -266,6 +429,8 @@ def main():
         "submetrics": sub,
         "fraction_of_measured_gemm": peak,
     }
+    if low:
+        out["below_10pct_of_anchor"] = low
     if fails or infra:
         out["failed"] = fails + [f"infra: {s}" for s in infra]
     print(json.dumps(out))

@@ -141,6 +141,9 @@ class Option(enum.Enum):
     MethodLU = "method_lu"
     MethodTrsm = "method_trsm"
     MethodSVD = "method_svd"
+    #: route pheev's tridiagonal stage through the distributed D&C
+    #: (parallel.dist_stedc.pstedc) — default on for n >= 2048
+    StedcDist = "stedc_dist"
 
 
 class MethodGemm(enum.Enum):

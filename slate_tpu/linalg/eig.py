@@ -274,13 +274,16 @@ def hb2st(band, kd: int, want_rots: bool = True
     return d, e, rots
 
 
-def _hb_sweep_counts(n, kd):
+def _hb_sweep_counts(n, kd, j0: int = 0, j1=None):
     """Per-sweep reflector counts of the symmetric Householder chase
     (mirrors the deterministic window logic; boundary inference from
     row0 alone is ambiguous when consecutive sweeps have one step
-    each)."""
+    each).  ``j0``/``j1`` restrict to a sweep range — the checkpointed
+    streaming back-transform packs one chunk at a time."""
     counts = []
-    for j in range(max(n - 2, 0)):
+    if j1 is None:
+        j1 = max(n - 2, 0)
+    for j in range(j0, min(j1, max(n - 2, 0))):
         L = min(kd, n - 1 - j)
         if L < 2:
             continue

@@ -128,6 +128,9 @@ def _load():
             fn.argtypes = [p, i64, i64, i64, p, p, p]
         lib.slate_hb2st_hh_f64.restype = i64
         lib.slate_hb2st_hh_f64.argtypes = [p, i64, i64, i64, p, p, p, p]
+        lib.slate_hb2st_hh_range_f64.restype = i64
+        lib.slate_hb2st_hh_range_f64.argtypes = [p, i64, i64, i64,
+                                                 p, p, p, p, i64, i64]
         lib.slate_tb2bd_hh_f64.restype = i64
         lib.slate_tb2bd_hh_f64.argtypes = [p, i64, i64, i64] + [p] * 8
         for name in ("slate_tb2bd_f64", "slate_tb2bd_c128"):
@@ -386,10 +389,16 @@ def hb2st_banded(ab: np.ndarray, n: int, kd: int, want_rots: bool = True):
     return planes, cs, ss
 
 
-def hh_step_count(n: int, kd: int) -> int:
-    """Reflector count of the Householder chase (one per chase window)."""
+def hh_step_count(n: int, kd: int, j0: int = 0,
+                  j1: int | None = None) -> int:
+    """Reflector count of the Householder chase (one per chase window),
+    optionally restricted to sweeps ``[j0, j1)`` (the checkpointed
+    streaming back-transform regenerates the log one sweep chunk at a
+    time)."""
     total = 0
-    for j in range(max(n - 2, 0)):
+    if j1 is None:
+        j1 = max(n - 2, 0)
+    for j in range(j0, min(j1, max(n - 2, 0))):
         L = min(kd, n - 1 - j)
         if L < 2:
             continue
@@ -427,6 +436,30 @@ def hb2st_hh_banded(abw: np.ndarray, n: int, kd: int):
     nstep = lib.slate_hb2st_hh_f64(_c_ptr(abw), n, kd, 2 * kd + 2,
                                    _c_ptr(v), _c_ptr(tau), _c_ptr(row0),
                                    _c_ptr(length))
+    assert nstep == cap, (nstep, cap)
+    return v, tau, row0, length
+
+
+def hb2st_hh_banded_range(abw: np.ndarray, n: int, kd: int,
+                          j0: int, j1: int):
+    """Sweeps ``[j0, j1)`` of :func:`hb2st_hh_banded` — the band is the
+    full inter-call state, so a caller that checkpoints it can
+    regenerate any chunk's reflector log later (the streaming
+    back-transform that keeps the O(n²) chase log off the host)."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    assert abw.shape == (n, 2 * kd + 2) and abw.flags.c_contiguous
+    assert abw.dtype == np.float64
+    cap = hh_step_count(n, kd, j0, j1)
+    v = np.zeros((cap, kd), dtype=np.float64)
+    tau = np.zeros(cap, dtype=np.float64)
+    row0 = np.zeros(cap, dtype=np.int32)
+    length = np.zeros(cap, dtype=np.int32)
+    nstep = lib.slate_hb2st_hh_range_f64(
+        _c_ptr(abw), n, kd, 2 * kd + 2, _c_ptr(v), _c_ptr(tau),
+        _c_ptr(row0), _c_ptr(length), j0, j1)
     assert nstep == cap, (nstep, cap)
     return v, tau, row0, length
 

@@ -670,14 +670,20 @@ static void hh_two_sided(double* ab, int64_t ldab, int64_t r, int64_t L,
             ab[(r + c) * ldab + (i - c)] -= v[i] * w[c] + w[i] * v[c];
 }
 
-static int64_t hb2st_hh_impl(double* ab, int64_t n, int64_t kd,
-                             int64_t ldab, HhLog& log) {
+// Sweep-range variant: factors sweeps j in [j0, j1) only.  The band is
+// the complete state between calls, so a caller can checkpoint it and
+// regenerate any chunk's reflector log later — the streaming that keeps
+// the O(n^2/2) chase log off the host (pheev's distributed middle).
+static int64_t hb2st_hh_impl_range(double* ab, int64_t n, int64_t kd,
+                                   int64_t ldab, HhLog& log,
+                                   int64_t j0, int64_t j1) {
     std::vector<double> vbuf((size_t)kd), wbuf((size_t)kd),
         colbuf((size_t)kd);
     auto BA = [&](int64_t i, int64_t c) -> double& {
         return ab[c * ldab + (i - c)];   // i >= c
     };
-    for (int64_t j = 0; j <= n - 3; ++j) {
+    if (j1 > n - 2) j1 = n - 2;
+    for (int64_t j = j0; j < j1; ++j) {
         int64_t L = std::min(kd, n - 1 - j);
         if (L < 2) continue;
         int64_t r0 = j + 1;
@@ -731,6 +737,11 @@ static int64_t hb2st_hh_impl(double* ab, int64_t n, int64_t kd,
         }
     }
     return log.count;
+}
+
+static int64_t hb2st_hh_impl(double* ab, int64_t n, int64_t kd,
+                             int64_t ldab, HhLog& log) {
+    return hb2st_hh_impl_range(ab, n, kd, ldab, log, 0, n - 2);
 }
 
 // Householder band→bidiagonal chase (SLATE's gebr1/2/3 task partition,
@@ -1049,6 +1060,14 @@ extern "C" {
 int64_t slate_hb2st_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,
                         int32_t* planes, double* cs, double* ss) {
     return hb2st_impl<double>(ab, n, kd, ldab, planes, cs, ss);
+}
+
+int64_t slate_hb2st_hh_range_f64(double* ab, int64_t n, int64_t kd,
+                                 int64_t ldab, double* v, double* tau,
+                                 int32_t* row0, int32_t* length,
+                                 int64_t j0, int64_t j1) {
+    HhLog log{v, tau, row0, length, kd};
+    return hb2st_hh_impl_range(ab, n, kd, ldab, log, j0, j1);
 }
 
 int64_t slate_hb2st_hh_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,

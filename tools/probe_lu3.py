@@ -1,0 +1,26 @@
+"""Queued-dispatch timing: getrf_scattered vs getrf_rec at n=8192."""
+import time, numpy as np, jax, jax.numpy as jnp
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from slate_tpu.linalg.lu import getrf_scattered, getrf_rec
+
+rng = np.random.default_rng(0)
+n = 8192
+a_np = rng.standard_normal((n, n)).astype(np.float32) + n*np.eye(n, dtype=np.float32)
+am = jnp.asarray(a_np)
+
+for name, fn in (("getrf_scattered", lambda x: getrf_scattered(x, 512)),
+                 ("getrf_rec      ", lambda x: getrf_rec(x, 512))):
+    f = jax.jit(fn)
+    lu, perm = f(am)
+    float(lu[-1, -1])
+    N = 8
+    t0 = time.perf_counter()
+    x = am
+    for _ in range(N):
+        lu, perm = f(x)
+        x = x + lu * jnp.float32(1e-30)
+    float(x[-1, -1])
+    t = (time.perf_counter() - t0) / N
+    print(f"{name} n={n}: {t*1e3:.2f} ms  {2*n**3/3/t/1e12:.2f} TF/s",
+          flush=True)

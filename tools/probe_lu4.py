@@ -1,0 +1,87 @@
+"""True per-step cost ablation of the transposed masked LU block kernel."""
+import functools, time, numpy as np, jax, jax.numpy as jnp
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+m, bb, ib = 8192, 128, 16
+f32 = jnp.float32
+
+def make_kernel(level):
+    def kern(slab_in, act_in, out_ref, piv_ref, act_out, ohsub):
+        iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
+        iota_sub = jax.lax.broadcasted_iota(jnp.int32, (ib, 1), 0)
+        piv_cols = jax.lax.broadcasted_iota(jnp.int32, (1, bb), 1)
+        out_ref[:] = slab_in[:]
+        act_out[:] = act_in[:]
+        piv_ref[:] = jnp.zeros((1, bb), jnp.int32)
+        for s in range(bb // ib):
+            s0 = s * ib
+            def col_step(j, _, s0=s0):
+                col = out_ref[pl.ds(s0 + j, 1), :]
+                act = act_out[:]
+                mag = jnp.abs(col) * act
+                if level >= 2:     # argmax reduces
+                    mx = jnp.max(mag)
+                    cand = jnp.where((mag >= mx) & (act > 0), iota_lane, m)
+                    p = jnp.min(cand).astype(jnp.int32)
+                else:
+                    p = jnp.int32(0)
+                piv_ref[:] = jnp.where(piv_cols == s0 + j, p, piv_ref[:])
+                oh = (iota_lane == p).astype(f32)
+                if level >= 3:     # pval reduce + lrow
+                    pval = jnp.sum(col * oh)
+                    safe = jnp.where(pval == 0, 1.0, pval)
+                    live = (act > 0) & (oh == 0)
+                    lrow = jnp.where(live, col / safe, 0.0)
+                    newcol = jnp.where(live, lrow, col)
+                else:
+                    lrow = col; newcol = col
+                if level >= 4:     # sub-slab rank-1
+                    sub = out_ref[s0:s0 + ib, :]
+                    pcol = jnp.sum(sub * oh, axis=1, keepdims=True)
+                    out_ref[s0:s0 + ib, :] = jnp.where(
+                        iota_sub == j, newcol,
+                        sub - jnp.where(iota_sub > j, pcol, 0.0) * lrow)
+                if level >= 5:     # ohsub accumulate
+                    ohsub[:] = jnp.where(iota_sub == j, oh, ohsub[:])
+                act_out[:] = act * (1.0 - oh)
+                return 0
+            ohsub[:] = jnp.zeros((ib, m), f32)
+            jax.lax.fori_loop(0, ib, col_step, 0)
+    return kern
+
+rng = np.random.default_rng(0)
+slab_t = jnp.asarray(rng.standard_normal((bb, m)).astype(np.float32))
+act = jnp.ones((1, m), f32)
+ITERS = 512
+for level in (1, 2, 3, 4, 5):
+    f = pl.pallas_call(
+        make_kernel(level),
+        out_shape=(jax.ShapeDtypeStruct((bb, m), f32),
+                   jax.ShapeDtypeStruct((1, bb), jnp.int32),
+                   jax.ShapeDtypeStruct((1, m), f32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((ib, m), f32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+    )
+    @jax.jit
+    def chain(s, a, f=f):
+        def body(i, carry):
+            s2, _, _ = f(carry, a)
+            return s + s2 * jnp.float32(1e-30)
+        v = lax.fori_loop(0, ITERS - 1, body, s)
+        return f(v, a)[0][-1, -1]
+    float(chain(slab_t, act))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter(); float(chain(slab_t, act)); ts.append(time.perf_counter()-t0)
+    print(f"level {level}: {min(ts)/ITERS*1e3:.3f} ms/call "
+          f"({min(ts)/ITERS/128*1e6:.2f} us/step)", flush=True)
