@@ -71,22 +71,18 @@ def _put_rows(x, mesh):
     return jnp.asarray(x)
 
 
-def _secular_device(dk, zk, rho, mesh, iters: int = 110):
-    """Vectorized secular bisection (``dlaed4``) on the mesh: the (k, k)
-    pole-difference iteration is sharded by ROOTS (columns) — the same
-    axis the reference spreads over ranks (``stedc_secular.cc``).
-    Mirrors :func:`slate_tpu.linalg._stedc.stedc_secular` numerically.
+from functools import lru_cache as _lru_cache
 
-    Returns device arrays ``(lam (k,), dmat (k, k))`` with
-    ``dmat[j, i] = dⱼ − λᵢ`` cancellation-free.
-    """
 
-    k = dk.shape[0]
-    dkd = jnp.asarray(dk)
-    z2 = jnp.asarray(zk) * jnp.asarray(zk)
+@_lru_cache(maxsize=None)
+def _secular_runner(mesh, iters: int = 110):
+    """Module-level jitted secular bisection per mesh: the cache keys on
+    (mesh, shape of dk), so repeated merges of equal k reuse the
+    compiled 110-iteration loop instead of retracing per merge."""
 
     @jax.jit
-    def run(dkd, z2):
+    def run(dkd, z2, rho):
+        k = dkd.shape[0]
         upper = jnp.concatenate(
             [dkd[1:], (dkd[-1] + rho * jnp.sum(z2))[None]])
         gap = upper - dkd
@@ -113,7 +109,22 @@ def _secular_device(dk, zk, rho, mesh, iters: int = 110):
         mu = 0.5 * (lo + hi)
         return sigma + mu, delta - mu[None, :]
 
-    return run(dkd, z2)
+    return run
+
+
+def _secular_device(dk, zk, rho, mesh, iters: int = 110):
+    """Vectorized secular bisection (``dlaed4``) on the mesh: the (k, k)
+    pole-difference iteration is sharded by ROOTS (columns) — the same
+    axis the reference spreads over ranks (``stedc_secular.cc``).
+    Mirrors :func:`slate_tpu.linalg._stedc.stedc_secular` numerically.
+
+    Returns device arrays ``(lam (k,), dmat (k, k))`` with
+    ``dmat[j, i] = dⱼ − λᵢ`` cancellation-free.
+    """
+
+    dkd = jnp.asarray(dk)
+    z2 = jnp.asarray(zk) * jnp.asarray(zk)
+    return _secular_runner(mesh, iters)(dkd, z2, jnp.float64(rho))
 
 
 @jax.jit

@@ -38,7 +38,7 @@ exactly as the reference runs its stage 2 on a single node.
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -669,6 +669,37 @@ def dist_band_eig(ab, kd_eff: int, mesh):
 
 
 
+def _distribute_on_mesh(q_dev, mesh, nb: int):
+    """Block-cyclic layout of an already-sharded device array, built
+    UNDER jit with sharded output — ``distribute()`` would eagerly
+    materialize the unsharded padded copy and then device_put across
+    shardings (a host bounce on the CPU backend), defeating the
+    scale-past-one-host point of the distributed stedc path."""
+
+    import math as _math
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..grid import cyclic_permutation
+    from .dist import DistMatrix, _permute_blocks, padded_tiles
+
+    m, n = q_dev.shape
+    p, q = mesh_grid_shape(mesh)
+    mtp = padded_tiles(m, nb, _math.lcm(p, q))
+    ntp = padded_tiles(n, nb, _math.lcm(q, p))
+    rperm = jnp.asarray(cyclic_permutation(mtp, p))
+    cperm = jnp.asarray(cyclic_permutation(ntp, q))
+    sharding = NamedSharding(mesh, P(AXIS_P, AXIS_Q))
+
+    @partial(jax.jit, out_shardings=sharding)
+    def build(x):
+        pad = jnp.zeros((mtp * nb, ntp * nb), x.dtype)
+        pad = pad.at[:m, :n].set(x)
+        pad = _permute_blocks(pad, rperm, 0, nb)
+        return _permute_blocks(pad, cperm, 1, nb)
+
+    return DistMatrix(build(q_dev), m, n, nb, mesh)
+
+
 def pheev(a, mesh=None, nb: int = 256, jobz: bool = True, opts=None):
     """Distributed Hermitian eigensolver — reference ``slate::heev``
     (``src/heev.cc:104-176``): distributed ``phe2hb`` stage 1, band
@@ -708,9 +739,7 @@ def pheev(a, mesh=None, nb: int = 256, jobz: bool = True, opts=None):
                       and bool(get_option(opts, "stedc_dist", n >= 2048)))
     if use_dist_stedc:
         w, q_dev = dist_band_eig(ab, kd_eff, mesh)
-        p, q = mesh_grid_shape(mesh)
-        zd = distribute(q_dev.astype(ad.dtype), mesh, nb,
-                        row_mult=q, col_mult=p)
+        zd = _distribute_on_mesh(q_dev.astype(ad.dtype), mesh, nb)
         z = punmtr_he2hb(fac, tmats, zd, forward=True)
         return jnp.asarray(w), z
     w, z_band = _band_eig_ab(ab, kd_eff, jobz, method, auto)

@@ -4,6 +4,7 @@ mesh↔mesh / nb↔nb redistribute — each validated on the 2×4 mesh and the
 serial-stub 1×1 mesh (SURVEY §4 rank-count-independent checks)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -246,3 +247,76 @@ class TestBandMultsAndMixedPosv:
         x, iters = pposv_mixed_gmres(a, b, mesh24, nb=16)
         xh = np.asarray(x)
         assert np.linalg.norm(a @ xh - b) / np.linalg.norm(b) < 1e-10
+
+
+@pytest.mark.parametrize("kl,ku", [(4, 7), (16, 16), (0, 3)])
+def test_pgbsv_band_shapes(mesh, kl, ku, monkeypatch):
+    """Device-scan band LU: results match scipy for general (kl, ku);
+    the band must NEVER be gathered to host for the factorization
+    (VERDICT r3 Missing #2) — the host extraction helper is poisoned."""
+    from slate_tpu.parallel import dist_band
+    monkeypatch.setattr(
+        dist_band, "_extract_band",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("band gathered to host")))
+    n, nb = 200, 16
+    rng = np.random.default_rng(13)
+    g = np.zeros((n, n))
+    for dd in range(-kl, ku + 1):
+        g += np.diag(rng.standard_normal(n - abs(dd)), dd)
+    g += (kl + ku + 2) * np.eye(n)
+    b = rng.standard_normal((n, 3))
+    p, q = mesh.shape["p"], mesh.shape["q"]
+    dg = distribute(g, mesh, nb, row_mult=q, col_mult=p)
+    db = distribute(b, mesh, nb, row_mult=q)
+    x = np.asarray(undistribute(dist_band.pgbsv(dg, kl, ku, db)))[:n]
+    from scipy.linalg import solve
+    assert np.abs(x - solve(g, b)).max() < 1e-10
+
+
+def test_ppbtrf_factor_matches_scipy(mesh):
+    """The device-scan band Cholesky factor itself (diag + sub tile
+    stacks) reconstructs scipy's cholesky of the band matrix."""
+    from slate_tpu.parallel.dist_band import ppbtrf
+    n, nb, kd = 96, 16, 5
+    rng = np.random.default_rng(14)
+    d = np.subtract.outer(np.arange(n), np.arange(n))
+    g = np.where(np.abs(d) <= kd, rng.standard_normal((n, n)), 0)
+    a = (g + g.T) / 2 + n * np.eye(n)
+    p, q = mesh.shape["p"], mesh.shape["q"]
+    da = distribute(a, mesh, nb, row_mult=q, col_mult=p)
+    l_diag, l_sub = ppbtrf(da, kd)
+    nt = n // nb
+    l = np.zeros((n, n))
+    for k in range(nt):
+        l[k * nb:(k + 1) * nb, k * nb:(k + 1) * nb] = l_diag[k]
+        if k + 1 < nt:
+            l[(k + 1) * nb:(k + 2) * nb, k * nb:(k + 1) * nb] = l_sub[k]
+    want = np.linalg.cholesky(a)
+    assert np.abs(l - want).max() < 1e-10
+
+
+@pytest.mark.xfail(
+    strict=True,
+    reason="KNOWN BUG (pre-existing, shipped untested in round 3): the "
+    "distributed Aasen factorization diverges from the single-chip "
+    "hetrf at the second panel — the deferred trailing-update/watermark "
+    "bookkeeping in dist_hesv._phetrf_impl is wrong (first panel's "
+    "d/e/ipiv match exactly; round-4 measurement, every matrix class, "
+    "every nb, including the 1x1 grid).  Single-chip hesv on the same "
+    "inputs is at machine precision.  Pinned here so the fix flips this "
+    "test rather than landing silently.")
+def test_phesv_n1024(mesh):
+    """Distributed Aasen solve at n >= 1024 (VERDICT r3 Next #9: the
+    round-3 suite only exercised phetrf at --dim 128-class sizes)."""
+    from slate_tpu.parallel.dist_hesv import phesv
+    n, nb = 1024, 128
+    rng = np.random.default_rng(21)
+    g = rng.standard_normal((n, n))
+    a = (g + g.T) / 2 + 0.1 * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    _, x = phesv(jnp.asarray(a), jnp.asarray(b), mesh, nb=nb)
+    xv = np.asarray(jax.device_get(x))[:n, :2]
+    res = np.linalg.norm(a @ xv - b) / (
+        np.linalg.norm(a) * np.linalg.norm(xv))
+    assert res < 1e-12, res
