@@ -389,77 +389,79 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128):
     traffic (``internal_swap.cc``):
 
     * pivoting is LOGICAL: the Pallas block kernel
-      (:func:`~slate_tpu.ops.pallas_kernels.getrf_block_panel`) picks
+      (:func:`~slate_tpu.ops.pallas_kernels.getrf_block_inplace`) picks
       each pivot by masked argmax over the still-active rows and
       retires it from the mask — no row ever moves (XLA's fused LU
       panel and jax-level loop panels both cost ~30 µs per column step
-      in HBM round trips; the VMEM-resident masked step costs ~1-2 µs);
-    * bb-wide blocks compose into nb-wide panels at the JAX level, and
-      every triangular solve is a gemm against a fused explicit inverse
+      in HBM round trips; the VMEM-resident masked step costs ~2 µs);
+    * the WHOLE matrix lives TRANSPOSED for the factorization and the
+      kernel factors its block row in place through an aliased HBM
+      buffer — the two lessons of the r4 perf campaign: per-block
+      transposes cost ~2 ms each, and an unaliased custom call makes
+      XLA copy the full carried matrix (~26 ms per call at n=8192);
+    * every triangular solve is a gemm against a fused explicit inverse
       (``trtri_panel``) plus one residual-correction step (solve-grade
-      accuracy, all-MXU);
+      accuracy, all-MXU), with the trailing permutation applied inside
+      the U₁₂ operand gather;
     * the trailing update runs over ALL m rows with retired rows'
       multipliers zeroed (static-slice writes — no scatter of the big
-      trailing slab; the ~⅓ extra gemm flops are far cheaper than
-      permuting HBM), with the trailing permutation applied inside the
-      U₁₂ operand gather (``a[piv]``);
-    * ONE row gather at the very end materializes the packed-LAPACK
-      factor.
+      trailing slab);
+    * ONE transpose in, and one column gather + transpose out
+      materialize the packed-LAPACK factor.
 
     Returns ``(lu, perm)`` with ``a[perm] = L·U`` — the
     :func:`getrf_rec` contract.  Requires f32, min(m,n) % nb == 0.
     """
 
-    from ..ops.pallas_kernels import getrf_block_panel, trtri_panel
+    from ..ops.pallas_kernels import getrf_block_inplace, trtri_panel
 
     m, n = a.shape
     k = min(m, n)
+    bb = min(bb, nb)
+    assert nb % bb == 0, (nb, bb)   # blocks must tile the panel exactly
+    at = a.T
     act = jnp.ones((1, m), jnp.float32)
     pivs = []
     for k0 in range(0, k, nb):
-        slab = a[:, k0:k0 + nb]
         panel_pivs = []
         for b0 in range(0, nb, bb):
-            blk_t, piv_b, act = getrf_block_panel(
-                slab[:, b0:b0 + bb].T, act)
-            blk_f = blk_t.T
-            slab = slab.at[:, b0:b0 + bb].set(blk_f)
+            r0 = k0 + b0
+            at, piv_b, act = getrf_block_inplace(at, act, r0, bb=bb)
+            blk_t = at[r0:r0 + bb, :]
             panel_pivs.append(piv_b)
             if b0 + bb < nb:
-                # inter-block update confined to the nb-wide slab
-                l11b = (jnp.tril(blk_f[piv_b], -1)
-                        + jnp.eye(bb, dtype=a.dtype))
-                linv_b = trtri_panel(l11b)
-                c1 = slab[piv_b, b0 + bb:]
-                u12 = matmul_hi(linv_b, c1)
-                u12 = u12 + matmul_hi(linv_b, c1 - matmul_hi(l11b, u12))
-                lm = blk_f * act.T
-                slab = slab.at[:, b0 + bb:].add(-matmul(lm, u12))
-                slab = slab.at[piv_b, b0 + bb:].set(u12)
-        a = a.at[:, k0:k0 + nb].set(slab)
+                l11 = (jnp.tril(blk_t[:, piv_b].T, -1)
+                       + jnp.eye(bb, dtype=a.dtype))
+                linv = trtri_panel(l11)
+                c1t = at[r0 + bb:k0 + nb, :][:, piv_b]
+                u12t = matmul_hi(c1t, linv.T)
+                u12t = u12t + matmul_hi(
+                    c1t - matmul_hi(u12t, l11.T), linv.T)
+                lmt = blk_t * act
+                at = at.at[r0 + bb:k0 + nb, :].add(-matmul(u12t, lmt))
+                at = at.at[r0 + bb:k0 + nb, piv_b].set(u12t)
         piv = (jnp.concatenate(panel_pivs) if len(panel_pivs) > 1
                else panel_pivs[0])
         pivs.append(piv)
         if k0 + nb < n:
-            l11 = jnp.tril(slab[piv], -1) + jnp.eye(nb, dtype=a.dtype)
+            slab_t = at[k0:k0 + nb, :]
+            l11 = (jnp.tril(slab_t[:, piv].T, -1)
+                   + jnp.eye(nb, dtype=a.dtype))
             linv = trtri_panel(l11)
-            c1 = a[piv, k0 + nb:]
-            # inverse-apply + one residual-correction step: the explicit
-            # L11^-1 alone amplifies by cond(L11) (backward-unstable vs
-            # trsm); the correction squares the error down to solve
-            # grade while staying all-gemm
-            u12 = matmul_hi(linv, c1)
-            u12 = u12 + matmul_hi(linv, c1 - matmul_hi(l11, u12))
-            lm = slab * act.T
-            a = a.at[:, k0 + nb:].add(-matmul(lm, u12))
-            a = a.at[piv, k0 + nb:].set(u12)
+            c1t = at[k0 + nb:, :][:, piv]
+            u12t = matmul_hi(c1t, linv.T)
+            u12t = u12t + matmul_hi(c1t - matmul_hi(u12t, l11.T),
+                                    linv.T)
+            lmt = slab_t * act
+            at = at.at[k0 + nb:, :].add(-matmul(u12t, lmt))
+            at = at.at[k0 + nb:, piv].set(u12t)
     piv_all = jnp.concatenate(pivs) if len(pivs) > 1 else pivs[0]
     if m > k:
         rem = jnp.argsort(act[0, :] < 0.5, stable=True)[: m - k]
         perm = jnp.concatenate([piv_all, rem])
     else:
         perm = piv_all
-    return a[perm], perm
+    return at[:, perm].T, perm
 
 
 def _use_scattered(av, nb: int) -> bool:

@@ -550,23 +550,11 @@ def trtri_panel(l):
 # ---------------------------------------------------------------------------
 
 
-def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
-                        ohsub, *, m, bb, ib):
-    """Single column-block core of the scattered-row LU panel, in
-    TRANSPOSED layout: the (bb, m) slab keeps every per-column vector
-    (the column itself, the active mask, the pivot one-hot) LANE-major
-    (1, m) — fully vectorized across the VPU's 128 lanes — and every
-    per-step update confined to the (ib, m) sub-slab.  (The first,
-    untransposed version kept vectors as (m, 1): 8 useful sublanes per
-    op, measured 65 µs per column step; lane-major brings the step to
-    VPU speed.)
-
-    TRUE partial pivoting over the rows flagged active, no row
-    movement (see the module comment above).  The wider-panel
-    composition happens at the JAX level in
-    ``linalg.lu.getrf_scattered``; this kernel compiles once per
-    (m, bb) shape and is reused for every block of every panel.
-    """
+def _factor_block_lane_major(out_ref, act_out, piv_ref, ohsub,
+                             *, m, bb, ib):
+    """Shared core: TRUE partial-pivot elimination of the (bb, m)
+    lane-major block held in ``out_ref``, active mask in ``act_out``
+    (both updated in place); see :func:`_getrf_block_kernel`."""
 
     f32 = jnp.float32
     hi = jax.lax.Precision.HIGHEST
@@ -578,10 +566,6 @@ def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
               ).astype(f32)
     tril_ib = (jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 0)
                > jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 1))
-
-    out_ref[:] = slab_in[:]
-    act_out[:] = act_in[:]
-    piv_ref[:] = jnp.zeros((1, bb), jnp.int32)
 
     for s in range(bb // ib):
         s0 = s * ib
@@ -601,9 +585,6 @@ def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
             live = (act > 0) & (oh == 0)
             lrow = jnp.where(live, col / safe, 0.0)
             newcol = jnp.where(live, lrow, col)
-            # pivot column within the sub-slab (the u-values feeding the
-            # rank-1), then one fused (ib, m) update: row j becomes the
-            # packed column, rows below subtract the rank-1 term
             pcol = jnp.sum(sub * oh, axis=1, keepdims=True)
             out_ref[s0:s0 + ib, :] = jnp.where(
                 iota_sub == j, newcol,
@@ -616,7 +597,6 @@ def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
         jax.lax.fori_loop(0, ib, col_step, 0)
         if s0 + ib < bb:
             sub = out_ref[s0:s0 + ib, :]
-            # L11^T[i, j] = sub[j, p_i]: one lane contraction
             l11 = jax.lax.dot_general(
                 ohsub[:], sub,
                 dimension_numbers=(((1,), (1,)), ((), ())),
@@ -638,6 +618,97 @@ def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
                           precision=hi)
                 + jnp.dot(u12t, ohsub[:], preferred_element_type=f32,
                           precision=hi))
+
+
+def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
+                        ohsub, *, m, bb, ib):
+    """Single column-block core of the scattered-row LU panel, in
+    TRANSPOSED layout: the (bb, m) slab keeps every per-column vector
+    (the column itself, the active mask, the pivot one-hot) LANE-major
+    (1, m) — fully vectorized across the VPU's 128 lanes — and every
+    per-step update confined to the (ib, m) sub-slab.  (The first,
+    untransposed version kept vectors as (m, 1): 8 useful sublanes per
+    op, measured 65 µs per column step; lane-major brings the step to
+    VPU speed.)
+
+    TRUE partial pivoting over the rows flagged active, no row
+    movement (see the module comment above).  Shared elimination core:
+    :func:`_factor_block_lane_major`.
+    """
+
+    out_ref[:] = slab_in[:]
+    act_out[:] = act_in[:]
+    piv_ref[:] = jnp.zeros((1, bb), jnp.int32)
+    _factor_block_lane_major(out_ref, act_out, piv_ref, ohsub,
+                             m=m, bb=bb, ib=ib)
+
+
+def _getrf_block_inplace_kernel(at_in, act_in, r0_ref, out_ref,
+                                piv_ref, act_out, cur, ohsub, sem,
+                                *, m, n_rows, bb, ib):
+    """In-place variant of :func:`_getrf_block_kernel`: the WHOLE
+    transposed matrix stays in HBM (aliased input/output, so XLA
+    threads ONE buffer through every per-block call instead of copying
+    the full carry around each custom call — measured: the copy-per-
+    call pattern costs ~26 ms per block at n=8192, 40x the kernel);
+    the r0 scalar selects the (bb, m) block row, DMA'd through VMEM.
+    """
+
+    # the dynamic block offset is always a multiple of bb (>= 8);
+    # Mosaic needs the divisibility hint to slice the (8,128)-tiled
+    # HBM memref at a runtime offset
+    r0 = pl.multiple_of(r0_ref[0], bb)
+    dma_in = pltpu.make_async_copy(
+        at_in.at[pl.ds(r0, bb), :], cur, sem)
+    dma_in.start()
+    dma_in.wait()
+    act_out[:] = act_in[:]
+    piv_ref[:] = jnp.zeros((1, bb), jnp.int32)
+    _factor_block_lane_major(cur, act_out, piv_ref, ohsub,
+                             m=m, bb=bb, ib=ib)
+    dma_out = pltpu.make_async_copy(
+        cur, out_ref.at[pl.ds(r0, bb), :], sem)
+    dma_out.start()
+    dma_out.wait()
+
+
+def getrf_block_inplace(at_full, active_row, r0, bb: int = 128,
+                        ib: int = 16):
+    """Factor block rows [r0, r0+bb) of the TRANSPOSED matrix in place
+    (aliased HBM buffer — no full-matrix copy per call).  ``r0`` is a
+    scalar operand, so ONE compilation serves every block of every
+    panel.  Returns ``(at_full', piv, active_out)``."""
+
+    n_rows, m = at_full.shape
+    ib = min(ib, bb)
+    assert bb % ib == 0 and m % 8 == 0, (m, bb, ib)
+    # the kernel's pl.multiple_of(r0, bb) hint and the (8,128)-tiled HBM
+    # slice require 8 | bb and bb | r0
+    assert bb % 8 == 0, bb
+    if isinstance(r0, int):
+        assert r0 % bb == 0, (r0, bb)
+    f32 = jnp.float32
+    out, piv, act_out = pl.pallas_call(
+        functools.partial(_getrf_block_inplace_kernel, m=m,
+                          n_rows=n_rows, bb=bb, ib=ib),
+        out_shape=(jax.ShapeDtypeStruct((n_rows, m), f32),
+                   jax.ShapeDtypeStruct((1, bb), jnp.int32),
+                   jax.ShapeDtypeStruct((1, m), f32)),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((bb, m), f32),
+                        pltpu.VMEM((ib, m), f32),
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={0: 0},
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(at_full, active_row, jnp.asarray(r0, jnp.int32).reshape(1))
+    return out, piv[0], act_out
 
 
 def getrf_block_panel(slab_t, active_row, ib: int = 16):

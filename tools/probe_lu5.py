@@ -1,0 +1,80 @@
+"""Ablate getrf_scattered's driver stages to find the non-kernel cost.
+
+Variant A: panel blocks only (64 kernel calls + slab writes, no updates)
+Variant B: A + inter-block updates (trtri+gemms within each 512 slab)
+Variant C: full driver (B + trailing updates + final gather)
+"""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from slate_tpu.ops.pallas_kernels import getrf_block_panel, trtri_panel
+from slate_tpu.ops.blocks import matmul, matmul_hi
+from slate_tpu.linalg.lu import getrf_scattered
+
+
+def variant(level, a, nb=512, bb=128):
+    m, n = a.shape
+    k = min(m, n)
+    act = jnp.ones((1, m), jnp.float32)
+    pivs = []
+    for k0 in range(0, k, nb):
+        slab = a[:, k0:k0 + nb]
+        panel_pivs = []
+        for b0 in range(0, nb, bb):
+            blk_t, piv_b, act = getrf_block_panel(
+                slab[:, b0:b0 + bb].T, act)
+            blk_f = blk_t.T
+            slab = slab.at[:, b0:b0 + bb].set(blk_f)
+            panel_pivs.append(piv_b)
+            if level >= 2 and b0 + bb < nb:
+                l11b = (jnp.tril(blk_f[piv_b], -1)
+                        + jnp.eye(bb, dtype=a.dtype))
+                linv_b = trtri_panel(l11b)
+                c1 = slab[piv_b, b0 + bb:]
+                u12 = matmul_hi(linv_b, c1)
+                u12 = u12 + matmul_hi(linv_b, c1 - matmul_hi(l11b, u12))
+                lm = blk_f * act.T
+                slab = slab.at[:, b0 + bb:].add(-matmul(lm, u12))
+                slab = slab.at[piv_b, b0 + bb:].set(u12)
+        a = a.at[:, k0:k0 + nb].set(slab)
+        piv = jnp.concatenate(panel_pivs)
+        pivs.append(piv)
+        if level >= 3 and k0 + nb < n:
+            l11 = jnp.tril(slab[piv], -1) + jnp.eye(nb, dtype=a.dtype)
+            linv = trtri_panel(l11)
+            c1 = a[piv, k0 + nb:]
+            u12 = matmul_hi(linv, c1)
+            u12 = u12 + matmul_hi(linv, c1 - matmul_hi(l11, u12))
+            lm = slab * act.T
+            a = a.at[:, k0 + nb:].add(-matmul(lm, u12))
+            a = a.at[piv, k0 + nb:].set(u12)
+    piv_all = jnp.concatenate(pivs)
+    if level >= 3:
+        return a[piv_all], piv_all
+    return a, piv_all
+
+
+def qtime(f, am, N=6):
+    lu, piv = f(am)
+    float(lu[-1, -1])
+    t0 = time.perf_counter()
+    x = am
+    for _ in range(N):
+        lu, piv = f(x)
+        x = x + lu * jnp.float32(1e-30)
+    float(x[-1, -1])
+    return (time.perf_counter() - t0) / N
+
+
+n = 8192
+rng = np.random.default_rng(0)
+am = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)
+                 + n * np.eye(n, dtype=np.float32))
+for lv in (1, 2, 3):
+    f = jax.jit(lambda x, lv=lv: variant(lv, x))
+    t = qtime(f, am)
+    print(f"variant {lv}: {t*1e3:.1f} ms", flush=True)
