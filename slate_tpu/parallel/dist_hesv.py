@@ -151,14 +151,43 @@ def _phetrf_impl(mesh, n, M, nb, maps_, dtype_name):
                     xi = x[jt + 1]
                     return x.at[jt + 1].set(x[p_]).at[p_].set(xi)
                 win = vswap(win)
+                # THE r3 BUG (both halves): `win` is the only current
+                # copy of the window columns mid-panel — `a`'s copies
+                # are stale until the panel-end writeback.  The
+                # single-chip reference works on asq directly so its
+                # column swap moves CURRENT data; here the swap must be
+                # completed by hand:
+                # (1) the outgoing column (current win col t+1, rows
+                #     already swapped) must land in the vacated slot —
+                #     a's trailing column p_ when the pivot came from
+                #     the trailing matrix, win's column p_−j0 when it
+                #     came from inside the window;
+                # (2) the incoming column's CURRENT content is a's
+                #     (post-swap) column jt+1 for a trailing pivot, but
+                #     win's pre-overwrite column p_−j0 for an in-window
+                #     pivot (a's copy of it is stale).
+                inwin = (p_ >= j0) & (p_ < j0 + wide)
+                out_col = jnp.take(win, t + 1, axis=1)
+                oldc2 = jnp.take(win, jnp.clip(p_ - j0, 0, wide - 1),
+                                 axis=1)
+                colids = jnp.arange(wide)
+                win = jnp.where(
+                    (colids[None, :] == (p_ - j0)) & inwin,
+                    out_col[:, None], win)
+                a = a.at[:, s2c].set(
+                    jnp.where(inwin, a[:, s2c],
+                              jnp.take(out_col, r_s2g)))
                 V = vswap(V)
                 U = vswap(U)
                 C = vswap(C)
                 wmi = wm[jt + 1]
                 wm = wm.at[jt + 1].set(wm[p_]).at[p_].set(wmi)
-                # refetch the swapped-in window column t+1 and refresh
-                # its missing deferred panel terms (steps wm..t-1)
-                cj1 = jnp.take(jnp.take(a, s1c, axis=1), r_g2s, axis=0)
+                # swapped-in window column t+1: current content per (2),
+                # then refresh its missing deferred panel terms
+                # (steps wm..t-1)
+                cj1 = jnp.where(
+                    inwin, oldc2,
+                    jnp.take(jnp.take(a, s1c, axis=1), r_g2s, axis=0))
                 mask = ((steps >= wm[jt + 1]) & (steps < t)).astype(dt)
                 cj1 = cj1 - _mm(V, mask * jnp.conj(U[jt + 1])) \
                     - _mm(C, mask * jnp.conj(V[jt + 1]))
@@ -201,8 +230,15 @@ def _phetrf_impl(mesh, n, M, nb, maps_, dtype_name):
             # re-hermitize the trailing square (same stability fix as
             # the single-chip panel): storage-coordinate logical
             # conj-transpose via the precomposed index maps
-            at_ = jnp.conj(jnp.take(jnp.take(a, tr_rows, axis=0),
-                                    tr_cols, axis=1))
+            # storage-layout Hermitian transpose: gather the mixed-map
+            # permutation THEN transpose — without the final swap this
+            # was conj(a) un-transposed (for REAL dtypes on identity
+            # maps that degraded to a no-op average of a with itself,
+            # which is why r3's real-only 1x1 tests never caught it;
+            # complex input and p != q grids both corrupted)
+            at_ = jnp.swapaxes(
+                jnp.conj(jnp.take(jnp.take(a, tr_rows, axis=0),
+                                  tr_cols, axis=1)), 0, 1)
             both = ((row_lg >= j0 + wide)[:, None]
                     & (col_lg >= j0 + wide)[None, :])
             a = jnp.where(both, 0.5 * (a + at_), a)

@@ -296,25 +296,41 @@ def test_ppbtrf_factor_matches_scipy(mesh):
     assert np.abs(l - want).max() < 1e-10
 
 
-@pytest.mark.xfail(
-    strict=True,
-    reason="KNOWN BUG (pre-existing, shipped untested in round 3): the "
-    "distributed Aasen factorization diverges from the single-chip "
-    "hetrf at the second panel — the deferred trailing-update/watermark "
-    "bookkeeping in dist_hesv._phetrf_impl is wrong (first panel's "
-    "d/e/ipiv match exactly; round-4 measurement, every matrix class, "
-    "every nb, including the 1x1 grid).  Single-chip hesv on the same "
-    "inputs is at machine precision.  Pinned here so the fix flips this "
-    "test rather than landing silently.")
 def test_phesv_n1024(mesh):
     """Distributed Aasen solve at n >= 1024 (VERDICT r3 Next #9: the
-    round-3 suite only exercised phetrf at --dim 128-class sizes)."""
+    round-3 suite only exercised phetrf at --dim 128-class sizes).
+
+    This test exposed two pre-existing r3 bugs, both fixed in round 4:
+    the column swap moved a STALE copy of the outgoing window column
+    (the win buffer is the only current copy mid-panel), and the
+    trailing re-hermitization gathered the mixed-map permutation
+    without the final transpose (for REAL input on identity maps that
+    reduced to averaging a with itself — why real-only tests never
+    caught it; on p != q grids it corrupted the trailing block)."""
     from slate_tpu.parallel.dist_hesv import phesv
     n, nb = 1024, 128
     rng = np.random.default_rng(21)
     g = rng.standard_normal((n, n))
     a = (g + g.T) / 2 + 0.1 * np.eye(n)
     b = rng.standard_normal((n, 2))
+    _, x = phesv(jnp.asarray(a), jnp.asarray(b), mesh, nb=nb)
+    xv = np.asarray(jax.device_get(x))[:n, :2]
+    res = np.linalg.norm(a @ xv - b) / (
+        np.linalg.norm(a) * np.linalg.norm(xv))
+    assert res < 1e-12, res
+
+
+def test_phesv_complex_hermitian(mesh):
+    """Complex Hermitian distributed Aasen: guards every conj in the
+    deferred refresh and the re-hermitization (the r3 bugs were masked
+    by real-only tests — Re(A) averaging is a no-op on real data but
+    zeroes imaginary parts on complex)."""
+    from slate_tpu.parallel.dist_hesv import phesv
+    n, nb = 192, 32
+    rng = np.random.default_rng(9)
+    g = rng.standard_normal((n, n)) + 1j * rng.standard_normal((n, n))
+    a = (g + g.conj().T) / 2 + 0.1 * np.eye(n)
+    b = rng.standard_normal((n, 2)) + 1j * rng.standard_normal((n, 2))
     _, x = phesv(jnp.asarray(a), jnp.asarray(b), mesh, nb=nb)
     xv = np.asarray(jax.device_get(x))[:n, :2]
     res = np.linalg.norm(a @ xv - b) / (
