@@ -83,6 +83,45 @@ def call(op: str, a, b=None, uplo: str = "L", trans: str = "N"):
         t = TriangularMatrix(_j(a), uplo=u, diag=Diag.NonUnit)
         inv = L.trtri(t)
         return (_np(getattr(inv, "data", inv)).T,)
+    if op == "potrs":
+        # a holds the Cholesky factor in the `uplo` triangle
+        t = TriangularMatrix(_j(a), uplo=u, diag=Diag.NonUnit)
+        x = L.potrs(t, _j(b))
+        return (_np(getattr(x, "data", x)).T,)
+    if op == "posv_full":
+        # ScaLAPACK pdposv semantics: factor AND solution
+        h = HermitianMatrix(_j(a), uplo=u)
+        fac, x = L.posv(h, _j(b))
+        return (_np(getattr(fac, "data", fac)).T, _np(x).T)
+    if op == "lu_solve_factored":
+        # a = packed LU (unit lower + upper), b already row-permuted
+        import jax.numpy as jnp
+        from jax import lax as _lax
+        aj, bj = _j(a), _j(b)
+        y = _lax.linalg.triangular_solve(
+            aj, bj, left_side=True, lower=True, unit_diagonal=True)
+        x = _lax.linalg.triangular_solve(
+            aj, y, left_side=True, lower=False)
+        return (_np(x).T,)
+    if op == "lu_solve_trans":
+        # solve op(A) x = b from packed LU where op per `uplo` slot:
+        # 'T' -> A^T = U^T L^T P, 'C' -> A^H; caller applies the final
+        # P^T row swaps.  (uplo carries the trans char here.)
+        from jax import lax as _lax
+        conj = uplo.upper().startswith("C")
+        aj, bj = _j(a), _j(b)
+        y = _lax.linalg.triangular_solve(
+            aj, bj, left_side=True, lower=False, transpose_a=True,
+            conjugate_a=conj)
+        x = _lax.linalg.triangular_solve(
+            aj, y, left_side=True, lower=True, unit_diagonal=True,
+            transpose_a=True, conjugate_a=conj)
+        return (_np(x).T,)
+    if op == "potri_factored":
+        # a holds the Cholesky factor in the `uplo` triangle
+        t = TriangularMatrix(_j(a), uplo=u, diag=Diag.NonUnit)
+        inv = L.potri(t)
+        return (_np(getattr(inv, "data", inv)).T,)
     if op == "hesv" or op == "sysv":
         fac, x = L.hesv(_j(a), _j(b))
         return (_np(x).T,)

@@ -58,3 +58,11 @@ def get_matmul_precision():
 #: ``SLATE_TPU_USE_PALLAS=1``) to use the hand-tuned VMEM kernels.
 use_pallas = (os.environ.get("SLATE_TPU_USE_PALLAS", "0").lower()
               not in ("0", "", "false", "off", "no"))
+
+#: Route real-fp64 2-D matmuls on TPU through the Ozaki-split MXU
+#: kernel (:mod:`slate_tpu.ops.ozaki`) instead of XLA's software fp64
+#: emulation (~3.5x faster at fp64-grade accuracy).  Off on CPU
+#: backends automatically (native fp64 there).  ``SLATE_TPU_F64_MXU=0``
+#: restores the emulated path.
+f64_mxu = (os.environ.get("SLATE_TPU_F64_MXU", "1").lower()
+           not in ("0", "", "false", "off", "no"))

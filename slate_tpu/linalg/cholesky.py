@@ -65,6 +65,21 @@ def potrf(a, opts: Optional[Options] = None):
     if method == "auto" and full.dtype == jnp.float32 and full.ndim == 2 \
             and (config.use_pallas or _jax.default_backend() == "tpu"):
         l = blocks.potrf_panels(full, 512 if nb <= 256 else nb)
+    elif method == "auto" and full.dtype == jnp.float64 and full.ndim == 2 \
+            and config.f64_mxu and _jax.default_backend() == "tpu":
+        # fp64 on TPU: f32 Pallas panel + fp64 Newton refinement, Ozaki
+        # MXU trailing updates — replaces XLA's emulated-fp64 cholesky.
+        # A panel whose f32 seed breaks down (SPD but cond ≳ 1/ε₃₂)
+        # propagates NaN; rerun those inputs on the emulated path so
+        # every fp64-factorizable matrix still factors (genuinely
+        # non-SPD input stays NaN there too — the info signal).
+        from jax import lax as _lax
+        fast = blocks.potrf_panels_f64(full, 512 if nb <= 256 else nb)
+        l = _lax.cond(
+            jnp.all(jnp.isfinite(fast)),
+            lambda ops: ops[0],
+            lambda ops: jnp.tril(_lax.linalg.cholesky(ops[1])),
+            (fast, full))
     elif method == "auto":
         import jax.numpy as _jnp
         from jax import lax as _lax

@@ -32,6 +32,29 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _x32_trace(fn):
+    """Trace the kernel with x64 OFF: under ``jax_enable_x64`` the
+    pallas machinery (grid index maps, weakly-typed scalars) produces
+    int64/f64 intermediates that Mosaic's vector layout rejects
+    (``bitwidth_ <= 32`` check).  Every kernel here is ≤32-bit by
+    contract, so a 32-bit trace context is semantics-preserving; it
+    lets fp64 drivers (e.g. :func:`blocks.potrf_panels_f64`) call the
+    f32 kernels mid-graph."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        if any(getattr(getattr(x, "dtype", None), "itemsize", 0) > 4
+               for x in leaves):
+            # 64-bit operands: only legal in interpret mode (CPU CI);
+            # the x32 context would silently truncate them
+            return fn(*args, **kwargs)
+        with jax.enable_x64(False):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
 # ---------------------------------------------------------------------------
 # Tiled matmul with K-loop accumulation — the MXU hot loop (the role
 # vendor blas::batch::gemm plays in the reference).
@@ -53,6 +76,7 @@ def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_steps):
         o_ref[:] = acc_ref[:].astype(o_ref.dtype)
 
 
+@_x32_trace
 def matmul(a, b, *, bm: int = 256, bn: int = 256, bk: int = 512,
            out_dtype=None):
     """C = A·B as a Pallas MXU kernel with fp32 VMEM accumulation.
@@ -105,6 +129,7 @@ def _norm_fro_kernel(x_ref, o_ref):
                           if jnp.iscomplexobj(v) else v * v)
 
 
+@_x32_trace
 def tile_norms(x, norm: str = "max"):
     """Per-tile partial norms of a (nt, mb, nb) tile batch — reference
     ``device::genorm`` (``device_genorm.cu``; two-phase norm,
@@ -149,6 +174,7 @@ def _tz_kernel(a_ref, o_ref, *, lower, offdiag, diag, op, bm, bn):
     o_ref[:] = out.astype(o_ref.dtype)
 
 
+@_x32_trace
 def tzset(a, lower: bool, offdiag_value, diag_value,
           bm: int = 256, bn: int = 256):
     """Set the stored triangle to constants — ``device::tzset``
@@ -156,6 +182,7 @@ def tzset(a, lower: bool, offdiag_value, diag_value,
     return _tz_call(a, lower, offdiag_value, diag_value, "set", bm, bn)
 
 
+@_x32_trace
 def tzscale(a, lower: bool, offdiag_factor, diag_factor,
             bm: int = 256, bn: int = 256):
     """Scale the stored triangle — ``device::tzscale``."""
@@ -186,6 +213,7 @@ def _geadd_kernel(a_ref, b_ref, o_ref, *, alpha, beta):
     o_ref[:] = (alpha * a_ref[:] + beta * b_ref[:]).astype(o_ref.dtype)
 
 
+@_x32_trace
 def geadd(alpha, a, beta, b, bm: int = 256, bn: int = 256):
     """B ← α·A + β·B — ``device::geadd`` (``device_geadd.cu``)."""
     m, n = a.shape
@@ -206,6 +234,7 @@ def _scale_rc_kernel(r_ref, c_ref, a_ref, o_ref):
                 c_ref[:].reshape(1, -1)).astype(o_ref.dtype)
 
 
+@_x32_trace
 def gescale_row_col(r, c, a, bm: int = 256, bn: int = 256):
     """A ← diag(r)·A·diag(c) — ``device::gescale_row_col``."""
     m, n = a.shape
@@ -345,6 +374,7 @@ def _chol_inv_kernel(a_ref, l_ref, inv_ref, *, nb, ib):
     _block_inv_doubling(l_ref, inv_ref, nb, ib)
 
 
+@_x32_trace
 @functools.partial(jax.jit, static_argnums=())
 def chol_inv_panel(a):
     """Factor an (nb, nb) f32 SPD panel: returns ``(L, L⁻¹)`` (both
@@ -485,6 +515,7 @@ def _lu_inv_kernel(a_ref, lu_ref, linv_ref, uinv_ref, *, nb, ib):
     _block_uinv_doubling(ufull, uinv_ref, nb, ib)
 
 
+@_x32_trace
 def lu_inv_panel(a):
     """No-pivot LU of an (nb, nb) f32 block in one fused VMEM kernel:
     returns ``(LU_packed, L⁻¹, U⁻¹)`` (L unit lower).  nb must be a
@@ -517,6 +548,7 @@ def _trtri_panel_kernel(l_in_ref, inv_ref, *, nb, ib):
     _block_inv_doubling(l_in_ref, inv_ref, nb, ib)
 
 
+@_x32_trace
 def trtri_panel(l):
     """Inverse of an (nb, nb) f32 lower-triangular panel in one fused
     VMEM kernel — the companion of :func:`chol_inv_panel` for factor
@@ -672,6 +704,7 @@ def _getrf_block_inplace_kernel(at_in, act_in, r0_ref, out_ref,
     dma_out.wait()
 
 
+@_x32_trace
 def getrf_block_inplace(at_full, active_row, r0, bb: int = 128,
                         ib: int = 16):
     """Factor block rows [r0, r0+bb) of the TRANSPOSED matrix in place
@@ -711,6 +744,7 @@ def getrf_block_inplace(at_full, active_row, r0, bb: int = 128,
     return out, piv[0], act_out
 
 
+@_x32_trace
 def getrf_block_panel(slab_t, active_row, ib: int = 16):
     """TRUE partial-pivot LU of a TRANSPOSED (bb, m) f32 column block
     over the active rows, scattered-row form — the per-block core that

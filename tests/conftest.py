@@ -29,3 +29,121 @@ def mesh8():
     """2×4 mesh over the 8 virtual CPU devices, axes ('p','q')."""
     from slate_tpu.parallel.mesh import make_grid_mesh
     return make_grid_mesh(2, 4)
+
+
+# ---------------------------------------------------------------------------
+# Two test tiers, the reference's --quick CI practice
+# (``/root/reference/test/run_tests.py``): the default ``pytest tests/``
+# gate finishes in ~5 min on a 1-core host; the full sweep runs with
+# ``--runslow``.  The slow set was measured with ``--durations`` on a
+# 1-core build host (r5): every test ≥ 3.4 s lands here EXCEPT one kept
+# representative per driver family, so the fast tier still touches
+# gesv/geqrf/heev/svd/ScaLAPACK end to end.
+# ---------------------------------------------------------------------------
+
+# Hand-kept fast representatives (measured ≥ 3.4 s but deliberately NOT
+# in the slow set, one per driver family): test_getrs_and_gesv,
+# test_geqrf[64-64], test_heev[MethodEig.DC-float64],
+# test_svd[40-40-float64], test_scalapack_api_smoke.
+_SLOW_TESTS = frozenset({
+    "tests/test_cholesky.py::test_posv[Uplo.Lower-complex64]",
+    "tests/test_cholesky.py::test_posv[Uplo.Lower-float32]",
+    "tests/test_compat_api.py::TestScalapackApi::test_pgesv_pheev",
+    "tests/test_compat_api.py::test_simplified_nopiv_and_indefinite_factor_verbs",
+    "tests/test_dist_gaps.py::test_pgbsv[mesh11]",
+    "tests/test_dist_gaps.py::test_pgbsv[mesh24]",
+    "tests/test_dist_gaps.py::test_pgbsv_band_shapes[mesh11-4-7]",
+    "tests/test_dist_gaps.py::test_pgbsv_band_shapes[mesh24-4-7]",
+    "tests/test_dist_gaps.py::test_pgecondest[mesh11]",
+    "tests/test_dist_gaps.py::test_pgelqf_punmlq[mesh11]",
+    "tests/test_dist_gaps.py::test_pgelqf_punmlq[mesh24]",
+    "tests/test_dist_gaps.py::test_pgetri[mesh24]",
+    "tests/test_dist_gaps.py::test_phesv_complex_hermitian[mesh11]",
+    "tests/test_dist_gaps.py::test_phesv_complex_hermitian[mesh24]",
+    "tests/test_dist_gaps.py::test_phesv_n1024[mesh11]",
+    "tests/test_dist_gaps.py::test_phesv_n1024[mesh24]",
+    "tests/test_dist_twostage.py::TestDistStedc::test_dist_band_eig_no_replicated_host_array",
+    "tests/test_dist_twostage.py::TestDistStedc::test_pheev_dist_stedc_numerics",
+    "tests/test_dist_twostage.py::TestDistStedc::test_pstedc_clustered_deflation",
+    "tests/test_dist_twostage.py::TestDistStedc::test_pstedc_matches_scipy",
+    "tests/test_dist_twostage.py::test_pge2tb_band_svd_match[complex128]",
+    "tests/test_dist_twostage.py::test_phe2hb_band_similarity[complex128]",
+    "tests/test_dist_twostage.py::test_pheev_mesh11",
+    "tests/test_eig_svd.py::TestHeevBandFastPath::test_complex",
+    "tests/test_eig_svd.py::test_he2hb_preserves_spectrum[32-8-complex128]",
+    "tests/test_eig_svd.py::test_heev[MethodEig.DC-complex128]",
+    "tests/test_eig_svd.py::test_heev[MethodEig.DC-float32]",
+    "tests/test_eig_svd.py::test_hegv[1]",
+    "tests/test_eig_svd.py::test_svd[40-40-complex128]",
+    "tests/test_eig_svd.py::test_svd[56-32-complex128]",
+    "tests/test_eig_svd.py::test_svd[56-32-float64]",
+    "tests/test_eig_svd.py::test_svd_float32",
+    "tests/test_hesv_band.py::test_hesv[65-float64]",
+    "tests/test_hesv_band.py::test_hetrf_blocked_matches_unblocked[131-32-complex128]",
+    "tests/test_hesv_band.py::test_hetrf_blocked_matches_unblocked[131-32-float64]",
+    "tests/test_hesv_band.py::test_hetrf_blocked_matches_unblocked[200-48-complex128]",
+    "tests/test_hesv_band.py::test_hetrf_blocked_matches_unblocked[200-48-float64]",
+    "tests/test_hesv_band.py::test_hetrf_blocked_matches_unblocked[96-16-complex128]",
+    "tests/test_hesv_band.py::test_hetrf_blocked_matches_unblocked[96-16-float64]",
+    "tests/test_hesv_band.py::test_hetrs_under_jit_matches_eager",
+    "tests/test_hesv_band.py::test_pbsv[1]",
+    "tests/test_lu.py::TestScatteredLU::test_wide_f32_residual_gate",
+    "tests/test_lu.py::test_gesv_mixed_converges",
+    "tests/test_lu.py::test_gesv_mixed_gmres_complex",
+    "tests/test_lu.py::test_getrf_nopiv_dominant",
+    "tests/test_lu.py::test_getrf_partial[130-float32]",
+    "tests/test_lu.py::test_getrf_partial[130-float64]",
+    "tests/test_lu.py::test_getrf_rectangular",
+    "tests/test_lu.py::test_getrf_tntpiv[100-32]",
+    "tests/test_lu.py::test_getrf_tntpiv[64-16]",
+    "tests/test_lu.py::test_getrf_wide",
+    "tests/test_lu.py::test_getri",
+    "tests/test_lu.py::test_tall_panel_lu_pp_true_partial_pivot",
+    "tests/test_pallas.py::test_chol_inv_panel[256]",
+    "tests/test_parallel.py::TestPgemmA::test_gemm_a_collective_profile",
+    "tests/test_qr.py::test_cholqr",
+    "tests/test_qr.py::test_cholqr2_panel_guard_ill_conditioned",
+    "tests/test_qr.py::test_gelqf_unmlq",
+    "tests/test_qr.py::test_gels_cholqr_and_auto",
+    "tests/test_qr.py::test_gels_qr[30-80]",
+    "tests/test_qr.py::test_gels_qr[90-30]",
+    "tests/test_qr.py::test_geqrf[120-40]",
+    "tests/test_qr.py::test_geqrf[40-96]",
+    "tests/test_qr.py::test_geqrf_complex",
+    "tests/test_qr.py::test_unmqr_sides_ops[Op.NoTrans-Side.Left]",
+})
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run the slow tier too (full sweep; ~20 min on one core)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: slow tier, skipped unless --runslow is given")
+
+
+def _canonical_nodeid(item):
+    """``tests/<file>::<test>`` regardless of pytest's rootdir (the ids
+    in _SLOW_TESTS are repo-root-relative; a bare ``cd tests && pytest``
+    would otherwise match nothing and silently run the full sweep)."""
+    import pathlib
+    here = pathlib.Path(__file__).parent
+    try:
+        rel = pathlib.Path(str(item.fspath)).resolve().relative_to(here)
+    except ValueError:
+        return item.nodeid
+    rest = item.nodeid.split("::", 1)
+    tail = ("::" + rest[1]) if len(rest) > 1 else ""
+    return "tests/" + str(rel) + tail
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow tier: use --runslow")
+    for item in items:
+        if _canonical_nodeid(item) in _SLOW_TESTS or "slow" in item.keywords:
+            item.add_marker(skip)

@@ -1,0 +1,87 @@
+"""Ozaki-split fp64 matmul (ops/ozaki.py): exactness-based MXU fp64.
+
+The CPU build exercises the same int8 slice products and s32/f64
+accumulation as the chip (lax.dot with preferred_element_type is
+platform-agnostic), so these componentwise bounds pin the scheme's
+arithmetic, not just a residual."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from slate_tpu.ops.ozaki import matmul_f64
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)
+
+
+@pytest.mark.parametrize("m,k,n", [(64, 64, 64), (96, 256, 64),
+                                   (128, 1000, 64)])
+def test_componentwise_fp64_grade(rng, m, k, n):
+    a = rng.standard_normal((m, k))
+    b = rng.standard_normal((k, n))
+    c = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+    # |C − AB| ≤ tol · |A||B| componentwise, tol well inside k·eps64
+    err = np.abs(c - a @ b)
+    env = np.abs(a) @ np.abs(b)
+    assert err.max() == 0 or (err / np.maximum(env, 1e-300)).max() < 1e-12
+
+
+def test_wide_dynamic_range_and_zero_rows(rng):
+    m = k = n = 96
+    a = rng.standard_normal((m, k)) * np.exp2(
+        rng.integers(-180, 180, size=(m, 1)).astype(np.float64))
+    b = rng.standard_normal((k, n)) * np.exp2(
+        rng.integers(-180, 180, size=(1, n)).astype(np.float64))
+    a[3, :] = 0.0
+    b[:, 5] = 0.0
+    c = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+    env = np.abs(a) @ np.abs(b)
+    rel = np.abs(c - a @ b) / np.maximum(env, 1e-300)
+    assert rel.max() < 1e-12
+    assert np.all(c[3, :] == 0.0)
+    assert np.all(c[:, 5] == 0.0)
+
+
+def test_exact_powers_of_two(rng):
+    # rows whose max is an exact power of two hit the log2-fixup path
+    a = np.full((32, 32), 0.5)
+    b = np.eye(32)
+    c = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_array_equal(c, a)
+
+
+def test_long_contraction_correlated(rng):
+    # k beyond the per-chunk s32 exactness cap with all-positive
+    # operands: pins the chunked accumulation (a single unchunked
+    # diagonal group would silently wrap int32 here)
+    k = (1 << 16) * 3 + 17
+    a = np.full((2, k), 1 - 2 ** -24)
+    c = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(a.T)))
+    true = float((a[0] * a[0]).sum())
+    assert abs(c[0, 0] - true) / true < 1e-12
+
+
+def test_extreme_exponent_scales():
+    # huge-scale rows against tiny-scale columns: the product is in
+    # range even though a single exp2 of either scale would be Inf
+    a = np.full((4, 4), 2.0 ** 1023)
+    b = np.full((4, 4), 2.0 ** -1000)
+    c = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+    assert np.isfinite(c).all()
+    assert abs(c[0, 0] - 4 * 2.0 ** 23) <= 1.0
+    # subnormal inputs flush to zero (DAZ/FTZ semantics), never NaN/Inf
+    a = np.full((4, 4), 2.0 ** -1060)
+    b = np.full((4, 4), 2.0 ** 1000)
+    c = np.asarray(matmul_f64(jnp.asarray(a), jnp.asarray(b)))
+    assert np.isfinite(c).all()
+
+
+def test_type_and_shape_guards(rng):
+    a64 = jnp.asarray(rng.standard_normal((8, 8)))
+    with pytest.raises(TypeError):
+        matmul_f64(a64.astype(jnp.float32), a64.astype(jnp.float32))
+    with pytest.raises(ValueError):
+        matmul_f64(a64[None], a64[None])
