@@ -18,6 +18,7 @@
 extern void Cblacs_gridinit(int*, const char*, int, int);
 extern void Cblacs_gridinfo(int, int*, int*, int*, int*);
 extern void Cblacs_gridexit(int);
+extern void Cblacs_barrier(int, const char*);
 extern int numroc_(const int*, const int*, const int*, const int*,
                    const int*);
 extern void descinit_(int*, const int*, const int*, const int*, const int*,
@@ -31,7 +32,25 @@ extern void pdgemm_(const char*, const char*, const int*, const int*,
                     const int*, const double*, double*, const int*,
                     const int*, const int*, double*, const int*, const int*,
                     const int*, const double*, double*, const int*,
-                    const int*, const int*, int*);
+                    const int*, const int*);
+extern void pdgetrf_(const int*, const int*, double*, const int*,
+                     const int*, const int*, int*, int*);
+extern void pdgetrs_(const char*, const int*, const int*, double*,
+                     const int*, const int*, const int*, int*, double*,
+                     const int*, const int*, const int*, int*);
+extern void pdpotrs_(const char*, const int*, const int*, double*,
+                     const int*, const int*, const int*, double*,
+                     const int*, const int*, const int*, int*);
+extern void pdtrsm_(const char*, const char*, const char*, const char*,
+                    const int*, const int*, const double*, double*,
+                    const int*, const int*, const int*, double*,
+                    const int*, const int*, const int*);
+extern double pdlange_(const char*, const int*, const int*, double*,
+                       const int*, const int*, const int*, double*);
+extern void pdsyev_(const char*, const char*, const int*, double*,
+                    const int*, const int*, const int*, double*, double*,
+                    const int*, const int*, const int*, double*,
+                    const int*, int*);
 extern int slate_c_init(void);
 extern void slate_c_finalize(void);
 
@@ -188,9 +207,7 @@ int main(void) {
                   &lld[r], &info);
         pdgemm_("T", "N", &n, &n, &n, &alpha, loc[r], &ione, &ione, desc,
                 loc[r], &ione, &ione, desc, &beta, cloc[r], &ione, &ione,
-                desc, &info);
-        if (info != 0) { fprintf(stderr, "pdgemm info=%d\n", info);
-                         return 6; }
+                desc);
     }
     for (int r = 0; r < P * Q; ++r)
         gather(Cres, cloc[r], n, n, nb, nb, r % P, r / P, lld[r]);
@@ -207,7 +224,312 @@ int main(void) {
     printf("pdgemm 2x2 scaled residual: %.3f\n", scaled);
     if (scaled > 10) { fprintf(stderr, "pdgemm FAILED\n"); return 7; }
 
+    /* ---- pdgetrf + pdgetrs round-trip on the same grid ---- */
+    static double XLU[N * NRHS];
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P, pcc = r / P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        scatter(B, bloc[r], n, nrhs, nb, nb, prr, pcc, mloc);
+    }
+    for (int r = 0; r < P * Q; ++r) {
+        descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt,
+                  &lld[r], &info);
+        pdgetrf_(&n, &n, loc[r], &ione, &ione, desc, iploc[r], &info);
+        if (info != 0) { fprintf(stderr, "pdgetrf info=%d\n", info);
+                         return 8; }
+    }
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        int lldb = mloc > 1 ? mloc : 1;
+        descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt,
+                  &lld[r], &info);
+        descinit_(descb, &n, &nrhs, &nb, &nb, &izero, &izero, &ctxt,
+                  &lldb, &info);
+        pdgetrs_("N", &n, &nrhs, loc[r], &ione, &ione, desc, iploc[r],
+                 bloc[r], &ione, &ione, descb, &info);
+        if (info != 0) { fprintf(stderr, "pdgetrs info=%d\n", info);
+                         return 9; }
+    }
+    for (int r = 0; r < P * Q; ++r) {
+        int prr = r % P;
+        int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+        gather(XLU, bloc[r], n, nrhs, nb, nb, prr, r / P, mloc);
+    }
+    maxe = 0;
+    for (int j = 0; j < nrhs; ++j)
+        for (int i = 0; i < N; ++i) {
+            double s = 0;
+            for (int k = 0; k < N; ++k) s += A[k * N + i] * XLU[j * N + k];
+            double e = fabs(s - B[j * N + i]);
+            if (e > maxe) maxe = e;
+        }
+    scaled = maxe / (amax * N * 2.22e-16);
+    printf("pdgetrf+pdgetrs 2x2 scaled residual: %.3f\n", scaled);
+    if (scaled > 100) { fprintf(stderr, "pdgetrf/s FAILED\n"); return 10; }
+
+    /* ---- windowed pdgemm: ia/ja != 1 submatrices ---- */
+    /* global (N x N) arrays; multiply the 16x16 windows A(9:24, 5:20)
+     * and A(17:32, 9:24) into C0's window at (3, 7) */
+    {
+        const int wm = 16, ia = 9, ja = 5, ib2 = 17, jb2 = 9,
+                  ic = 3, jc = 7;
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P, pcc = r / P;
+            scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+            scatter(C0, cloc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        }
+        const double al2 = 1.0, be2 = 0.0;
+        for (int r = 0; r < P * Q; ++r) {
+            descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt,
+                      &lld[r], &info);
+            pdgemm_("N", "N", &wm, &wm, &wm, &al2,
+                    loc[r], &ia, &ja, desc,
+                    loc[r], &ib2, &jb2, desc, &be2,
+                    cloc[r], &ic, &jc, desc);
+        }
+        for (int r = 0; r < P * Q; ++r)
+            gather(Cres, cloc[r], n, n, nb, nb, r % P, r / P, lld[r]);
+        maxe = 0;
+        for (int j = 0; j < wm; ++j)
+            for (int i = 0; i < wm; ++i) {
+                double s = 0;
+                for (int k = 0; k < wm; ++k)
+                    s += A[(ja - 1 + k) * N + (ia - 1 + i)]
+                       * A[(jb2 - 1 + j) * N + (ib2 - 1 + k)];
+                double e = fabs(s - Cres[(jc - 1 + j) * N + (ic - 1 + i)]);
+                if (e > maxe) maxe = e;
+            }
+        /* untouched entries outside the C window must be preserved */
+        double keep = fabs(Cres[0] - C0[0])
+                    + fabs(Cres[(N - 1) * N + N - 1] - C0[(N - 1) * N + N - 1]);
+        scaled = maxe / (amax * amax * wm * 2.22e-16);
+        printf("pdgemm windowed (ia/ja!=1) scaled residual: %.3f\n", scaled);
+        if (scaled > 10 || keep != 0.0) {
+            fprintf(stderr, "windowed pdgemm FAILED (keep=%g)\n", keep);
+            return 11;
+        }
+    }
     Cblacs_gridexit(ctxt);
+
+    /* ---- row-major grid order: pdpotrf on a "Row" grid ---- */
+    {
+        int ctxt2;
+        Cblacs_gridinit(&ctxt2, "Row", P, Q);
+        /* rank r -> (r / Q, r % Q) under row-major order */
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r / Q, pcc = r % Q;
+            scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        }
+        for (int r = 0; r < P * Q; ++r) {
+            Cblacs_gridinfo(ctxt2, &p, &q, &pr, &pc);
+            if (pr != r / Q || pc != r % Q) {
+                fprintf(stderr, "row-order gridinfo mismatch r=%d\n", r);
+                return 12;
+            }
+            descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt2,
+                      &lld[r], &info);
+            pdpotrf_("L", &n, loc[r], &ione, &ione, desc, &info);
+            if (info != 0) { fprintf(stderr, "row pdpotrf info=%d\n", info);
+                             return 13; }
+            Cblacs_barrier(ctxt2, "All");
+        }
+        for (int r = 0; r < P * Q; ++r)
+            gather(Afac, loc[r], n, n, nb, nb, r / Q, r % Q, lld[r]);
+        memset(L, 0, sizeof(L));
+        for (int j = 0; j < N; ++j)
+            for (int i = j; i < N; ++i) L[j * N + i] = Afac[j * N + i];
+        maxe = 0;
+        for (int j = 0; j < N; ++j)
+            for (int i = j; i < N; ++i) {
+                double s = 0;
+                for (int k = 0; k < N; ++k) s += L[k * N + i] * L[k * N + j];
+                double e = fabs(s - A[j * N + i]);
+                if (e > maxe) maxe = e;
+            }
+        scaled = maxe / (amax * N * 2.22e-16);
+        printf("pdpotrf row-order scaled residual: %.3f\n", scaled);
+        if (scaled > 10) { fprintf(stderr, "row pdpotrf FAILED\n"); return 14; }
+        Cblacs_gridexit(ctxt2);
+    }
+
+    /* ---- pdpotrs / pdtrsm / pdlange / pdsyev on a fresh Col grid ---- */
+    {
+        int ctxt3;
+        Cblacs_gridinit(&ctxt3, "Col", P, Q);
+        /* potrs: solve with the factor computed earlier (Afac holds L) */
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P, pcc = r / P;
+            int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+            scatter(Afac, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+            scatter(B, bloc[r], n, nrhs, nb, nb, prr, pcc, mloc);
+        }
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P;
+            int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+            int lldb = mloc > 1 ? mloc : 1;
+            descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lld[r], &info);
+            descinit_(descb, &n, &nrhs, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lldb, &info);
+            pdpotrs_("L", &n, &nrhs, loc[r], &ione, &ione, desc,
+                     bloc[r], &ione, &ione, descb, &info);
+            if (info != 0) { fprintf(stderr, "pdpotrs info=%d\n", info);
+                             return 15; }
+        }
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P;
+            int mloc = numroc_(&n, &nb, &prr, &izero, (const int[]){P});
+            gather(X, bloc[r], n, nrhs, nb, nb, prr, r / P, mloc);
+        }
+        maxe = 0;
+        for (int j = 0; j < nrhs; ++j)
+            for (int i = 0; i < N; ++i) {
+                double s = 0;
+                for (int k2 = 0; k2 < N; ++k2)
+                    s += A[k2 * N + i] * X[j * N + k2];
+                double e = fabs(s - B[j * N + i]);
+                if (e > maxe) maxe = e;
+            }
+        scaled = maxe / (amax * N * 2.22e-16);
+        printf("pdpotrs scaled residual: %.3f\n", scaled);
+        if (scaled > 100) { fprintf(stderr, "pdpotrs FAILED\n"); return 16; }
+
+        /* trsm, side=Right trans=T unit-diag: X L1^T = alpha B with L1
+         * unit-lower from Afac; check X L1^T recovers alpha B */
+        const double al3 = 2.0;
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P, pcc = r / P;
+            int nloc_r = numroc_(&nrhs, &nb, &prr, &izero, (const int[]){P});
+            (void)nloc_r;
+            scatter(Afac, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        }
+        /* B2 is nrhs x n (rows = nrhs) so side=R dims differ from m */
+        static double B2[NRHS * N], X2[NRHS * N];
+        for (int i = 0; i < NRHS * N; ++i)
+            B2[i] = (double)rand() / RAND_MAX - 0.5;
+        double* b2loc[P * Q];
+        int descb2[9];
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P, pcc = r / P;
+            int mloc = numroc_((const int[]){NRHS}, &nb, &prr, &izero,
+                               (const int[]){P});
+            int nloc = numroc_(&n, &nb, &pcc, &izero, (const int[]){Q});
+            (void)nloc;
+            b2loc[r] = (double*)malloc(sizeof(double) * (size_t)NRHS * N);
+            scatter(B2, b2loc[r], NRHS, n, nb, nb, prr, pcc,
+                    mloc > 1 ? mloc : 1);
+        }
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P;
+            int mloc = numroc_((const int[]){NRHS}, &nb, &prr, &izero,
+                               (const int[]){P});
+            int lldb2 = mloc > 1 ? mloc : 1;
+            const int nr = NRHS;
+            descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lld[r], &info);
+            descinit_(descb2, &nr, &n, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lldb2, &info);
+            pdtrsm_("R", "L", "T", "U", &nr, &n, &al3,
+                    loc[r], &ione, &ione, desc,
+                    b2loc[r], &ione, &ione, descb2);
+        }
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P;
+            int mloc = numroc_((const int[]){NRHS}, &nb, &prr, &izero,
+                               (const int[]){P});
+            gather(X2, b2loc[r], NRHS, n, nb, nb, prr, r / P,
+                   mloc > 1 ? mloc : 1);
+        }
+        /* check X2 * L1^T == al3 * B2 where L1 = unit-lower(Afac):
+         * (X L1^T)[i,j] = sum_{k<=j} X[i,k] * L1[j,k] */
+        maxe = 0;
+        for (int j = 0; j < N; ++j)
+            for (int i = 0; i < NRHS; ++i) {
+                double s = 0;
+                for (int k2 = 0; k2 <= j; ++k2) {
+                    double ljk = (k2 == j) ? 1.0 : Afac[k2 * N + j];
+                    s += X2[k2 * NRHS + i] * ljk;
+                }
+                double e = fabs(s - al3 * B2[j * NRHS + i]);
+                if (e > maxe) maxe = e;
+            }
+        scaled = maxe / (amax * N * 2.22e-16);
+        printf("pdtrsm R/T/U scaled residual: %.3f\n", scaled);
+        if (scaled > 100) { fprintf(stderr, "pdtrsm FAILED\n"); return 17; }
+        for (int r = 0; r < P * Q; ++r) free(b2loc[r]);
+
+        /* pdlange: Frobenius norm of A (value on the completing call) */
+        double fro = 0;
+        for (int i = 0; i < N * N; ++i) fro += A[i] * A[i];
+        fro = sqrt(fro);
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P, pcc = r / P;
+            scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+        }
+        double got = 0;
+        for (int r = 0; r < P * Q; ++r) {
+            descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lld[r], &info);
+            double v = pdlange_("F", &n, &n, loc[r], &ione, &ione, desc, 0);
+            if (v != 0.0) got = v;
+        }
+        printf("pdlange F: got %.6f want %.6f\n", got, fro);
+        if (fabs(got - fro) > 1e-8 * fro) {
+            fprintf(stderr, "pdlange FAILED\n"); return 18;
+        }
+
+        /* pdsyev: eigenvalues/vectors of symmetric A */
+        static double W[N], Z[N * N];
+        double* zloc[P * Q];
+        int descz[9];
+        for (int r = 0; r < P * Q; ++r) {
+            int prr = r % P, pcc = r / P;
+            scatter(A, loc[r], n, n, nb, nb, prr, pcc, lld[r]);
+            zloc[r] = (double*)malloc(sizeof(double) * (size_t)N * N);
+            memset(zloc[r], 0, sizeof(double) * (size_t)N * N);
+        }
+        static double Wr[P * Q][N];
+        for (int r = 0; r < P * Q; ++r) {
+            const int lwork_q = 4 * N;
+            static double wk[4 * N];
+            descinit_(desc, &n, &n, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lld[r], &info);
+            descinit_(descz, &n, &n, &nb, &nb, &izero, &izero, &ctxt3,
+                      &lld[r], &info);
+            pdsyev_("V", "L", &n, loc[r], &ione, &ione, desc, Wr[r],
+                    zloc[r], &ione, &ione, descz, wk,
+                    (const int[]){lwork_q}, &info);
+            if (info != 0) { fprintf(stderr, "pdsyev info=%d\n", info);
+                             return 19; }
+        }
+        memcpy(W, Wr[P * Q - 1], sizeof(double) * N);
+        for (int r = 0; r < P * Q; ++r)
+            gather(Z, zloc[r], n, n, nb, nb, r % P, r / P, lld[r]);
+        /* residual |A z - w z| and w replication across ranks */
+        maxe = 0;
+        for (int j = 0; j < N; ++j)
+            for (int i = 0; i < N; ++i) {
+                double s = 0;
+                for (int k2 = 0; k2 < N; ++k2)
+                    s += A[k2 * N + i] * Z[j * N + k2];
+                double e = fabs(s - W[j] * Z[j * N + i]);
+                if (e > maxe) maxe = e;
+            }
+        scaled = maxe / (amax * N * 2.22e-16);
+        printf("pdsyev scaled residual: %.3f\n", scaled);
+        if (scaled > 100) { fprintf(stderr, "pdsyev FAILED\n"); return 20; }
+        for (int r = 0; r < P * Q; ++r) {
+            if (memcmp(Wr[r], W, sizeof(double) * N)) {
+                fprintf(stderr, "pdsyev w not replicated\n"); return 21;
+            }
+            free(zloc[r]); free(cloc[r]); free(loc[r]); free(bloc[r]);
+            free(iploc[r]);
+        }
+        Cblacs_gridexit(ctxt3);
+    }
+
     printf("ok: ScaLAPACK API smoke (2x2 grid round-trip)\n");
     slate_c_finalize();
     return 0;

@@ -63,6 +63,13 @@ def call(op: str, a, b=None, uplo: str = "L", trans: str = "N"):
     if op == "getrf":
         lu, piv = L.getrf(_j(a))
         return (_np(getattr(lu, "data", lu)).T, _np(piv).astype(np.int64))
+    if op == "getrf_ipiv":
+        # LAPACK 1-based swap sequence (ScaLAPACK's distributed-ipiv
+        # convention) instead of the library's permutation vector
+        from ..linalg.lu import perm_to_ipiv
+        lu, perm = L.getrf(_j(a))
+        return (_np(getattr(lu, "data", lu)).T,
+                _np(perm_to_ipiv(perm)).astype(np.int64))
     if op == "getri":
         lu, piv = L.getrf(_j(a))
         inv = L.getri(getattr(lu, "data", lu), piv)

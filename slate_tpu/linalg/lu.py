@@ -505,8 +505,10 @@ def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
     elif method is MethodLU.PartialPiv:
         if _use_scattered(av, 512):
             # TPU f32 fast path: scattered-row partial pivoting (no
-            # swap traffic, Pallas masked panel) — same pivots as
-            # LAPACK, same (lu, perm) contract
+            # swap traffic, Pallas masked panel) — LAPACK pivots up to
+            # magnitude ties (on an exact tie the kernel takes the
+            # lowest still-active physical row, LAPACK the first max in
+            # swapped order), same (lu, perm) contract
             lu, perm = getrf_scattered(av, 512)
         elif av.ndim == 2 and av.shape[0] > _MAX_LU_PANEL_ROWS:
             # tall panels exceed XLA's scoped-VMEM fused-LU limit; under

@@ -263,44 +263,59 @@ def gen_fortran():
 
 
 # ---------------------------------------------------------------------------
-# Drop-in ScaLAPACK API (reference scalapack_api/: p?potrf/p?gesv/p?gemm
-# with BLACS descriptors, 3 Fortran manglings each).
+# Drop-in ScaLAPACK API (reference scalapack_api/: 15 routine families with
+# BLACS descriptors, 3 Fortran manglings each, submatrix ia/ja windows).
 # ---------------------------------------------------------------------------
 
 SCALAPACK_CORE = r"""/* slate_tpu ScaLAPACK compatibility API — GENERATED by
  * tools/generate_c_api.py; do not edit.
  *
- * Drop-in desc-based symbols (p?potrf / p?gesv / p?gemm, three Fortran
- * manglings each) over the embedded-CPython driver core, mirroring the
- * reference's scalapack_api/ (scalapack_potrf.cc:27-80 etc.).
+ * Drop-in desc-based symbols over the embedded-CPython driver core,
+ * mirroring the reference's scalapack_api/ (scalapack_potrf.cc:27-80,
+ * scalapack_getrf.cc, ... — 15 families here: potrf potrs posv getrf
+ * getrs gesv getri potri geqrf gels syev/heev gemm trsm trmm lange).
  *
  * SINGLE-CONTROLLER BLACS EMULATION.  The reference runs one MPI rank
  * per grid cell; a JAX/TPU program is a single controller that owns
  * every device.  These stubs therefore implement the BLACS surface for
  * ONE process that plays all p*q ranks in sequence:
  *
- *   - Cblacs_gridinit(&ctxt, order, p, q) creates a virtual p x q grid.
+ *   - Cblacs_gridinit(&ctxt, order, p, q) creates a virtual p x q grid
+ *     (row- OR column-major rank order, honoured everywhere).
  *   - Cblacs_gridinfo(ctxt, ...) reports the coordinates of the grid's
- *     CURRENT virtual rank (initially (0,0)).
+ *     CURRENT virtual rank; Cblacs_barrier advances the rank cursor, so
+ *     a loop body may invoke several routines per rank turn.
  *   - Each p? routine call registers the current virtual rank's local
- *     buffer and advances the rank cursor; when the LAST rank of the
- *     grid has called (the SPMD program unrolled sequentially), the
- *     routine assembles the global matrix from the block-cyclic local
- *     pieces (numroc layout), runs the driver on the accelerator,
- *     scatters results back into every registered local buffer, and
- *     returns the real info.  Earlier (pending) registration calls
- *     return info = 0; their output buffers are valid once the final
- *     rank's call returns — the sequential-emulation analog of the
- *     collective completing.
+ *     buffer; the FIRST registration captures the full call signature
+ *     (descriptors + scalar args) and every later registration is
+ *     checked against it — a mismatch (interleaved collectives,
+ *     different descs) sets *info = -904 instead of computing garbage.
+ *   - When the LAST rank of the grid has called, the routine assembles
+ *     the global matrix from the block-cyclic local pieces (numroc
+ *     layout), extracts the (ia, ja, m, n) submatrix window, runs the
+ *     driver on the accelerator, writes results back into the window
+ *     (only the parts the routine contractually writes — e.g. p?potrf
+ *     preserves the opposite triangle), scatters every registered
+ *     local buffer, and returns the real info.  Earlier (pending)
+ *     registration calls return info = 0; their output buffers are
+ *     valid once the final rank's call returns.
  *   - On a 1 x 1 grid every call computes immediately: a true drop-in
  *     for serial ScaLAPACK usage.
  *
- * Submatrix offsets ia/ja must be 1 (whole-matrix operation), matching
- * the dominant ScaLAPACK usage; other values set *info = -900.
+ * ABI notes: PBLAS routines (p?gemm/p?trsm/p?trmm) have NO info
+ * argument, matching the real PBLAS — errors go to stderr and leave
+ * outputs untouched.  p?lange returns its double on the call that
+ * completes the collective (earlier virtual-rank calls return 0.0).
+ * Workspace queries (lwork = -1) answer minimal sizes without
+ * registering.  Limits: irsrc/icsrc must be 0; pivoted routines
+ * (p?getrf/getrs/gesv/getri) require ia = ja = 1 (the distributed-ipiv
+ * layout is defined relative to whole-matrix rows); other routines
+ * accept arbitrary in-range ia/ja windows.
  */
 
 #include "slate_tpu_driver.h"
 #include <complex.h>
+#include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 
@@ -309,16 +324,22 @@ SCALAPACK_CORE = r"""/* slate_tpu ScaLAPACK compatibility API — GENERATED by
 #define SLATE_MAX_CTXT 64
 #define SLATE_MAX_RANKS 256
 
-typedef struct { int p, q, cur, used; } blacs_ctx;
+typedef struct { int p, q, cur, used; char order; } blacs_ctx;
 static blacs_ctx g_ctx[SLATE_MAX_CTXT];
 
-/* forward decl: pending-collective table (defined below) */
 typedef struct pending_s pending_t;
 static void pend_abandon_ctxt(int ctxt);
 
 static blacs_ctx* ctx_of(int ic) {
     if (ic < 0 || ic >= SLATE_MAX_CTXT || !g_ctx[ic].used) return 0;
     return &g_ctx[ic];
+}
+
+static int rank_row(const blacs_ctx* c, int r) {
+    return (c->order == 'R') ? r / c->q : r % c->p;
+}
+static int rank_col(const blacs_ctx* c, int r) {
+    return (c->order == 'R') ? r % c->q : r / c->p;
 }
 
 void Cblacs_pinfo(int* mypnum, int* nprocs) {
@@ -332,11 +353,12 @@ void Cblacs_get(int ctxt, int what, int* val) {
 }
 
 void Cblacs_gridinit(int* ctxt, const char* order, int p, int q) {
-    (void)order;   /* column-major rank order assumed, BLACS default */
     for (int i = 0; i < SLATE_MAX_CTXT; ++i) {
         if (!g_ctx[i].used) {
             g_ctx[i].used = 1; g_ctx[i].p = p; g_ctx[i].q = q;
             g_ctx[i].cur = 0;
+            g_ctx[i].order =
+                (order && (order[0] == 'R' || order[0] == 'r')) ? 'R' : 'C';
             *ctxt = i;
             return;
         }
@@ -350,21 +372,18 @@ void Cblacs_gridinfo(int ctxt, int* np_row, int* np_col,
     if (!c) { if (np_row) *np_row = -1; return; }
     if (np_row) *np_row = c->p;
     if (np_col) *np_col = c->q;
-    /* column-major rank order: rank r -> (r % p, r / p).  The cursor
-     * marks WHICH virtual rank the sequential program is currently
-     * simulating; it advances on Cblacs_barrier (the natural "end of
-     * this rank's turn" marker when an SPMD loop is unrolled), NOT on
-     * p? calls — so a loop body may invoke several routines per rank. */
-    if (my_row) *my_row = c->cur % c->p;
-    if (my_col) *my_col = c->cur / c->p;
+    /* the cursor marks WHICH virtual rank the sequential program is
+     * currently simulating; it advances on Cblacs_barrier (the natural
+     * "end of this rank's turn" marker when an SPMD loop is unrolled),
+     * NOT on p? calls — so a loop body may invoke several routines per
+     * rank. */
+    if (my_row) *my_row = rank_row(c, c->cur);
+    if (my_col) *my_col = rank_col(c, c->cur);
 }
 
 void Cblacs_gridexit(int ctxt) {
     blacs_ctx* c = ctx_of(ctxt);
     if (c) c->used = 0;
-    /* abandon any half-registered collectives on this context so the
-     * pending slots cannot leak (pend_get would otherwise return NULL
-     * after 8 abandoned collectives) */
     pend_abandon_ctxt(ctxt);
 }
 
@@ -433,6 +452,8 @@ void DESCINIT(int* desc, const int* m, const int* n, const int* mb,
 #define D_N(d)    ((d)[3])
 #define D_MB(d)   ((d)[4])
 #define D_NB(d)   ((d)[5])
+#define D_RSRC(d) ((d)[6])
+#define D_CSRC(d) ((d)[7])
 #define D_LLD(d)  ((d)[8])
 
 /* copy between global (col-major, ld = M) and the (pr, pc) rank's local
@@ -458,37 +479,54 @@ static void cyclic_copy(void* glob, void* loc, const int* desc, int lld,
 
 /* ---------------- collective registration ---------------- */
 
+/* full call signature, captured on the FIRST registration of a
+ * collective and verified on every later one (ADVICE r4: keyed-only-by
+ * -routine pending slots silently mixed distinct calls) */
+typedef struct {
+    int i[10];          /* routine ints: m n k nrhs ia ja ib jb ic jc */
+    char ch[6];         /* uplo / trans / side / diag / jobz / norm */
+    double s[8];        /* alpha, beta (re, im each) */
+    int desc[3][9];
+} call_sig;
+
 struct pending_s {
     int tag;                       /* routine id, 0 = slot free */
     int ctxt;
     int nreg;                      /* registrations so far (rank order) */
-    void* locals[SLATE_MAX_RANKS];     /* A local buffers, rank order */
-    void* locals2[SLATE_MAX_RANKS];    /* B local buffers (solvers) */
-    void* locals3[SLATE_MAX_RANKS];    /* C local buffers (gemm) */
+    call_sig sig;
+    void* bufs[3][SLATE_MAX_RANKS];    /* A / B / C local buffers */
+    int   llds[3][SLATE_MAX_RANKS];
     int*  ipivs[SLATE_MAX_RANKS];
-    /* lld is the one per-rank descriptor field — captured per call */
-    int llds[SLATE_MAX_RANKS];
-    int llds2[SLATE_MAX_RANKS];
-    int llds3[SLATE_MAX_RANKS];
+    void* wbufs[SLATE_MAX_RANKS];      /* replicated vector outs (w, tau) */
 };
 
-static pending_t g_pend[8];
+static pending_t g_pend[16];
 
 static void pend_abandon_ctxt(int ctxt) {
-    for (int i = 0; i < 8; ++i)
+    for (int i = 0; i < 16; ++i)
         if (g_pend[i].ctxt == ctxt) g_pend[i].tag = 0;
 }
 
-static pending_t* pend_get(int tag, int ctxt) {
-    for (int i = 0; i < 8; ++i)
-        if (g_pend[i].tag == tag && g_pend[i].ctxt == ctxt)
+static pending_t* pend_get(int tag, int ctxt, const call_sig* sig,
+                           int* info) {
+    for (int i = 0; i < 16; ++i)
+        if (g_pend[i].tag == tag && g_pend[i].ctxt == ctxt) {
+            if (sig && memcmp(&g_pend[i].sig, sig, sizeof(call_sig))) {
+                /* interleaved/mismatched collective: refuse loudly */
+                g_pend[i].tag = 0;
+                if (info) *info = -904;
+                return 0;
+            }
             return &g_pend[i];
-    for (int i = 0; i < 8; ++i)
+        }
+    for (int i = 0; i < 16; ++i)
         if (g_pend[i].tag == 0) {
             memset(&g_pend[i], 0, sizeof(pending_t));
             g_pend[i].tag = tag; g_pend[i].ctxt = ctxt;
+            if (sig) g_pend[i].sig = *sig;
             return &g_pend[i];
         }
+    if (info) *info = -903;
     return 0;
 }
 
@@ -498,110 +536,634 @@ static int elem_of(char dt) {
     return 0;
 }
 
-/* register this rank's buffers under the routine's OWN registration
- * counter (virtual ranks register in column-major rank order, the
- * natural unrolled-SPMD loop order); returns 1 when the grid is
- * complete — time to compute */
+/* register this rank's buffers; returns 1 when the grid is complete */
 static int pend_step(pending_t* pe, blacs_ctx* c,
                      void* a, int lda, void* b, int ldb,
-                     void* cc, int ldc, int* ipiv) {
+                     void* cc, int ldc, int* ipiv, void* w) {
     int r = pe->nreg;
-    pe->locals[r] = a; pe->locals2[r] = b; pe->locals3[r] = cc;
+    pe->bufs[0][r] = a; pe->bufs[1][r] = b; pe->bufs[2][r] = cc;
     pe->ipivs[r] = ipiv;
-    pe->llds[r] = lda; pe->llds2[r] = ldb; pe->llds3[r] = ldc;
+    pe->wbufs[r] = w;
+    pe->llds[0][r] = lda; pe->llds[1][r] = ldb; pe->llds[2][r] = ldc;
     pe->nreg += 1;
     return pe->nreg == c->p * c->q;
 }
 
-/* ---------------- generic p? implementations ---------------- */
+/* ---------------- checked allocation ---------------- */
 
-static int check_sub(int ia, int ja, int* info) {
-    if (ia != 1 || ja != 1) { if (info) *info = -900; return 1; }
+static void* xm(size_t n, int* ok) {
+    void* p = malloc(n ? n : 1);
+    if (!p) *ok = 0;
+    return p;
+}
+
+/* ---------------- gather / scatter over all ranks ---------------- */
+
+static char* gather_all(pending_t* pe, int which, const int* desc,
+                        blacs_ctx* c, int elem, int* ok) {
+    char* g = (char*)xm((size_t)D_M(desc) * D_N(desc) * elem, ok);
+    if (!g) return 0;
+    for (int r = 0; r < c->p * c->q; ++r)
+        cyclic_copy(g, pe->bufs[which][r], desc, pe->llds[which][r],
+                    rank_row(c, r), rank_col(c, r), c->p, c->q, elem, 0);
+    return g;
+}
+
+static void scatter_all(pending_t* pe, int which, const int* desc,
+                        blacs_ctx* c, char* g, int elem) {
+    for (int r = 0; r < c->p * c->q; ++r)
+        cyclic_copy(g, pe->bufs[which][r], desc, pe->llds[which][r],
+                    rank_row(c, r), rank_col(c, r), c->p, c->q, elem, 1);
+}
+
+/* ---------------- submatrix windows ---------------- */
+
+static int win_check(const int* desc, int ia, int ja, int m, int n,
+                     int* info) {
+    if (D_RSRC(desc) != 0 || D_CSRC(desc) != 0) {
+        if (info) *info = -906;
+        return 1;
+    }
+    if (ia < 1 || ja < 1 || ia - 1 + m > D_M(desc)
+        || ja - 1 + n > D_N(desc)) {
+        if (info) *info = -900;
+        return 1;
+    }
     return 0;
+}
+
+static char* win_get(const char* g, const int* desc, int ia, int ja,
+                     int m, int n, int elem, int* ok) {
+    char* s = (char*)xm((size_t)m * n * elem, ok);
+    if (!s) return 0;
+    int Mg = D_M(desc);
+    for (int j = 0; j < n; ++j)
+        memcpy(s + (size_t)j * m * elem,
+               g + (((size_t)(ja - 1 + j)) * Mg + (ia - 1)) * elem,
+               (size_t)m * elem);
+    return s;
+}
+
+static void win_put(char* g, const int* desc, int ia, int ja,
+                    int m, int n, const char* s, int elem) {
+    int Mg = D_M(desc);
+    for (int j = 0; j < n; ++j)
+        memcpy(g + (((size_t)(ja - 1 + j)) * Mg + (ia - 1)) * elem,
+               s + (size_t)j * m * elem, (size_t)m * elem);
+}
+
+/* only the `uplo` triangle (with diagonal) of an n x n window — the
+ * opposite triangle keeps the caller's data (p?potrf contract) */
+static void win_put_tri(char* g, const int* desc, int ia, int ja,
+                        int n, char uplo, const char* s, int elem) {
+    int Mg = D_M(desc);
+    int lower = (uplo == 'L' || uplo == 'l');
+    for (int j = 0; j < n; ++j) {
+        int i0 = lower ? j : 0;
+        int i1 = lower ? n : j + 1;
+        memcpy(g + (((size_t)(ja - 1 + j)) * Mg + (ia - 1 + i0)) * elem,
+               s + ((size_t)j * n + i0) * elem, (size_t)(i1 - i0) * elem);
+    }
+}
+
+/* ---------------- distributed pivot vectors ---------------- */
+
+/* ScaLAPACK ipiv: local row il of a process row holds the global
+ * 1-based swap target of its global row, replicated across the process
+ * columns */
+static void scatter_ipiv(pending_t* pe, blacs_ctx* c, const int* desca,
+                         const int64_t* piv, int n) {
+    int MB = D_MB(desca);
+    for (int r = 0; r < c->p * c->q; ++r) {
+        if (!pe->ipivs[r]) continue;
+        int pr = rank_row(c, r);
+        int mloc = numroc_impl(n, MB, pr, 0, c->p);
+        for (int il = 0; il < mloc; ++il) {
+            int igr = ((il / MB) * c->p + pr) * MB + il % MB;
+            if (igr < n) pe->ipivs[r][il] = (int)piv[igr];
+        }
+    }
+}
+
+static void gather_ipiv(pending_t* pe, blacs_ctx* c, const int* desca,
+                        int64_t* piv, int n) {
+    int MB = D_MB(desca);
+    for (int r = 0; r < c->p * c->q; ++r) {
+        if (!pe->ipivs[r] || rank_col(c, r) != 0) continue;
+        int pr = rank_row(c, r);
+        int mloc = numroc_impl(n, MB, pr, 0, c->p);
+        for (int il = 0; il < mloc; ++il) {
+            int igr = ((il / MB) * c->p + pr) * MB + il % MB;
+            if (igr < n) piv[igr] = pe->ipivs[r][il];
+        }
+    }
+}
+
+/* LAPACK-style sequential row swaps on a col-major n x nrhs buffer */
+static void row_swaps(char* b, int n, int nrhs, const int64_t* piv,
+                      int elem, int reverse) {
+    char tmp[16];
+    for (int step = 0; step < n; ++step) {
+        int i = reverse ? n - 1 - step : step;
+        int j = (int)piv[i] - 1;
+        if (j == i || j < 0 || j >= n) continue;
+        for (int col = 0; col < nrhs; ++col) {
+            char* x = b + ((size_t)col * n + i) * elem;
+            char* y = b + ((size_t)col * n + j) * elem;
+            memcpy(tmp, x, elem); memcpy(x, y, elem); memcpy(y, tmp, elem);
+        }
+    }
+}
+"""
+
+SCALAPACK_IMPLS = r"""
+/* ---------------- generic p? implementations ----------------
+ * Shared pattern: build call_sig -> pend_get (captures/verifies) ->
+ * pend_step -> on the grid-completing call: gather, window, driver,
+ * write-back, scatter, free.  `info` may be NULL for the PBLAS
+ * routines (no info in their ABI) — errors then go to stderr. */
+
+static void set_info(int* info, int v) {
+    if (info) *info = v;
+    else if (v) fprintf(stderr, "slate_tpu pblas: error %d\n", v);
+}
+
+static void sig_desc(call_sig* sg, int which, const int* desc) {
+    memcpy(sg->desc[which], desc, 9 * sizeof(int));
+    sg->desc[which][8] = 0;   /* lld is legitimately per-rank */
 }
 
 static void ppotrf_impl(char dt, const char* uplo, int n,
                         void* a, int ia, int ja, const int* desca,
                         int* info) {
-    if (check_sub(ia, ja, info)) return;
+    set_info(info, 0);
     blacs_ctx* c = ctx_of(D_CTXT(desca));
-    if (!c) { *info = -901; return; }
-    if (D_M(desca) != n || D_N(desca) != n) { *info = -902; return; }
-    pending_t* pe = pend_get(1000 + dt, D_CTXT(desca));
-    if (!pe) { *info = -903; return; }
-    *info = 0;
-    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, 0))
-        return;   /* wait for the full grid */
-    int elem = elem_of(dt);
-    size_t gsz = (size_t)D_M(desca) * D_N(desca) * elem;
-    char* glob = (char*)malloc(gsz);
-    char* gout = (char*)malloc(gsz);
-    for (int r = 0; r < c->p * c->q; ++r)
-        cyclic_copy(glob, pe->locals[r], desca, pe->llds[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 0);
-    int rc = slate_c_call("potrf", dt, n, n, glob, n, 0, 0, 0, 0,
-                          gout, 0, 0, uplo[0]);
-    for (int r = 0; r < c->p * c->q; ++r)
-        cyclic_copy(gout, pe->locals[r], desca, pe->llds[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 1);
-    free(glob); free(gout);
+    if (!c) { set_info(info, -901); return; }
+    if (win_check(desca, ia, ja, n, n, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[4] = ia; sg.i[5] = ja; sg.ch[0] = uplo[0];
+    sig_desc(&sg, 0, desca);
+    pending_t* pe = pend_get(100 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, 0, 0)) return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* glob = gather_all(pe, 0, desca, c, elem, &ok);
+    char* win = glob ? win_get(glob, desca, ia, ja, n, n, elem, &ok) : 0;
+    char* out = win ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    if (ok && out) {
+        rc = slate_c_call("potrf", dt, n, n, win, n, 0, 0, 0, 0,
+                          out, 0, 0, uplo[0]);
+        win_put_tri(glob, desca, ia, ja, n, uplo[0], out, elem);
+        scatter_all(pe, 0, desca, c, glob, elem);
+    }
+    free(glob); free(win); free(out);
     pe->tag = 0;
-    *info = rc;
+    set_info(info, rc);
+}
+
+static void ppotrs_impl(char dt, const char* uplo, int n, int nrhs,
+                        void* a, int ia, int ja, const int* desca,
+                        void* b, int ib, int jb, const int* descb,
+                        int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (win_check(desca, ia, ja, n, n, info)
+        || win_check(descb, ib, jb, n, nrhs, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[3] = nrhs; sg.i[4] = ia; sg.i[5] = ja;
+    sg.i[6] = ib; sg.i[7] = jb; sg.ch[0] = uplo[0];
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    pending_t* pe = pend_get(200 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, 0, 0))
+        return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    char* aw = bg ? win_get(ag, desca, ia, ja, n, n, elem, &ok) : 0;
+    char* bw = aw ? win_get(bg, descb, ib, jb, n, nrhs, elem, &ok) : 0;
+    char* x = bw ? (char*)xm((size_t)n * nrhs * elem, &ok) : 0;
+    if (ok && x) {
+        rc = slate_c_call("potrs", dt, n, n, aw, n, n, nrhs, bw, n,
+                          x, 0, 0, uplo[0]);
+        win_put(bg, descb, ib, jb, n, nrhs, x, elem);
+        scatter_all(pe, 1, descb, c, bg, elem);
+    }
+    free(ag); free(bg); free(aw); free(bw); free(x);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static void pposv_impl(char dt, const char* uplo, int n, int nrhs,
+                       void* a, int ia, int ja, const int* desca,
+                       void* b, int ib, int jb, const int* descb,
+                       int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (win_check(desca, ia, ja, n, n, info)
+        || win_check(descb, ib, jb, n, nrhs, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[3] = nrhs; sg.i[4] = ia; sg.i[5] = ja;
+    sg.i[6] = ib; sg.i[7] = jb; sg.ch[0] = uplo[0];
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    pending_t* pe = pend_get(300 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, 0, 0))
+        return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    char* aw = bg ? win_get(ag, desca, ia, ja, n, n, elem, &ok) : 0;
+    char* bw = aw ? win_get(bg, descb, ib, jb, n, nrhs, elem, &ok) : 0;
+    char* fac = bw ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    char* x = fac ? (char*)xm((size_t)n * nrhs * elem, &ok) : 0;
+    if (ok && x) {
+        rc = slate_c_call("posv_full", dt, n, n, aw, n, n, nrhs, bw, n,
+                          fac, x, 0, uplo[0]);
+        win_put_tri(ag, desca, ia, ja, n, uplo[0], fac, elem);
+        win_put(bg, descb, ib, jb, n, nrhs, x, elem);
+        scatter_all(pe, 0, desca, c, ag, elem);
+        scatter_all(pe, 1, descb, c, bg, elem);
+    }
+    free(ag); free(bg); free(aw); free(bw); free(fac); free(x);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+/* pivoted routines require ia = ja = 1: the distributed-ipiv layout is
+ * defined relative to whole-matrix rows */
+static int check_sub1(int ia, int ja, int* info) {
+    if (ia != 1 || ja != 1) { set_info(info, -900); return 1; }
+    return 0;
+}
+
+static void pgetrf_impl(char dt, int m, int n,
+                        void* a, int ia, int ja, const int* desca,
+                        int* ipiv, int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (check_sub1(ia, ja, info)
+        || win_check(desca, ia, ja, m, n, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = m; sg.i[1] = n;
+    sig_desc(&sg, 0, desca);
+    pending_t* pe = pend_get(400 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, ipiv, 0)) return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    int mn = m < n ? m : n;
+    char* glob = gather_all(pe, 0, desca, c, elem, &ok);
+    char* aw = glob ? win_get(glob, desca, 1, 1, m, n, elem, &ok) : 0;
+    char* f = aw ? (char*)xm((size_t)m * n * elem, &ok) : 0;
+    /* the bridge returns an m-length swap vector (perm_to_ipiv of the
+     * full row permutation) even when m > n */
+    int64_t* piv = f ? (int64_t*)xm(sizeof(int64_t) * (size_t)m, &ok) : 0;
+    if (ok && piv) {
+        rc = slate_c_call("getrf_ipiv", dt, m, n, aw, m, 0, 0, 0, 0,
+                          f, piv, 0, 'L');
+        win_put(glob, desca, 1, 1, m, n, f, elem);
+        scatter_all(pe, 0, desca, c, glob, elem);
+        scatter_ipiv(pe, c, desca, piv, mn);
+    }
+    free(glob); free(aw); free(f); free(piv);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static void pgetrs_impl(char dt, const char* trans, int n, int nrhs,
+                        void* a, int ia, int ja, const int* desca,
+                        int* ipiv, void* b, int ib, int jb,
+                        const int* descb, int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (check_sub1(ia, ja, info) || check_sub1(ib, jb, info)
+        || win_check(desca, ia, ja, n, n, info)
+        || win_check(descb, ib, jb, n, nrhs, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[3] = nrhs; sg.ch[0] = trans[0];
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    pending_t* pe = pend_get(500 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, ipiv, 0))
+        return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    int tn = (trans[0] == 'N' || trans[0] == 'n') ? 1 : 0;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    char* aw = bg ? win_get(ag, desca, 1, 1, n, n, elem, &ok) : 0;
+    char* bw = aw ? win_get(bg, descb, 1, 1, n, nrhs, elem, &ok) : 0;
+    char* x = bw ? (char*)xm((size_t)n * nrhs * elem, &ok) : 0;
+    int64_t* piv = x ? (int64_t*)xm(sizeof(int64_t) * (size_t)n, &ok) : 0;
+    if (ok && piv) {
+        gather_ipiv(pe, c, desca, piv, n);
+        if (tn) {
+            row_swaps(bw, n, nrhs, piv, elem, 0);
+            rc = slate_c_call("lu_solve_factored", dt, n, n, aw, n,
+                              n, nrhs, bw, n, x, 0, 0, 'L');
+        } else {
+            rc = slate_c_call("lu_solve_trans", dt, n, n, aw, n,
+                              n, nrhs, bw, n, x, 0, 0,
+                              (dt == 'c' || dt == 'z') && (trans[0] == 'C'
+                               || trans[0] == 'c') ? 'C' : 'T');
+            row_swaps(x, n, nrhs, piv, elem, 1);
+        }
+        win_put(bg, descb, 1, 1, n, nrhs, x, elem);
+        scatter_all(pe, 1, descb, c, bg, elem);
+    }
+    free(ag); free(bg); free(aw); free(bw); free(x); free(piv);
+    pe->tag = 0;
+    set_info(info, rc);
 }
 
 static void pgesv_impl(char dt, int n, int nrhs,
                        void* a, int ia, int ja, const int* desca,
                        int* ipiv, void* b, int ib, int jb,
                        const int* descb, int* info) {
-    if (check_sub(ia, ja, info) || check_sub(ib, jb, info)) return;
+    set_info(info, 0);
     blacs_ctx* c = ctx_of(D_CTXT(desca));
-    if (!c) { *info = -901; return; }
-    if (D_M(desca) != n || D_N(desca) != n
-        || D_M(descb) != n || D_N(descb) != nrhs) { *info = -902; return; }
-    pending_t* pe = pend_get(2000 + dt, D_CTXT(desca));
-    if (!pe) { *info = -903; return; }
-    *info = 0;
-    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, ipiv))
+    if (!c) { set_info(info, -901); return; }
+    if (check_sub1(ia, ja, info) || check_sub1(ib, jb, info)
+        || win_check(desca, ia, ja, n, n, info)
+        || win_check(descb, ib, jb, n, nrhs, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[3] = nrhs;
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    pending_t* pe = pend_get(600 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, ipiv, 0))
         return;
-    int elem = elem_of(dt);
-    size_t asz = (size_t)D_M(desca) * D_N(desca) * elem;
-    size_t bsz = (size_t)D_M(descb) * D_N(descb) * elem;
-    char* ag = (char*)malloc(asz); char* bg = (char*)malloc(bsz);
-    char* lu = (char*)malloc(asz); char* xg = (char*)malloc(bsz);
-    int64_t* piv = (int64_t*)malloc(sizeof(int64_t) * (size_t)n);
-    for (int r = 0; r < c->p * c->q; ++r) {
-        cyclic_copy(ag, pe->locals[r], desca, pe->llds[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 0);
-        cyclic_copy(bg, pe->locals2[r], descb, pe->llds2[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    char* aw = bg ? win_get(ag, desca, 1, 1, n, n, elem, &ok) : 0;
+    char* bw = aw ? win_get(bg, descb, 1, 1, n, nrhs, elem, &ok) : 0;
+    char* lu = bw ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    char* xg = lu ? (char*)xm((size_t)n * nrhs * elem, &ok) : 0;
+    int64_t* piv = xg ? (int64_t*)xm(sizeof(int64_t) * (size_t)n, &ok) : 0;
+    if (ok && piv) {
+        rc = slate_c_call("gesv_full", dt, n, n, aw, n, n, nrhs,
+                          bw, n, lu, piv, xg, 'L');
+        win_put(ag, desca, 1, 1, n, n, lu, elem);
+        win_put(bg, descb, 1, 1, n, nrhs, xg, elem);
+        scatter_all(pe, 0, desca, c, ag, elem);
+        scatter_all(pe, 1, descb, c, bg, elem);
+        scatter_ipiv(pe, c, desca, piv, n);
     }
-    int rc = slate_c_call("gesv_full", dt, n, n, ag, n, n, nrhs,
-                          bg, n, lu, piv, xg, 'L');
-    for (int r = 0; r < c->p * c->q; ++r) {
-        int pr = r % c->p, pc_ = r / c->p;
-        cyclic_copy(lu, pe->locals[r], desca, pe->llds[r], pr, pc_,
-                    c->p, c->q, elem, 1);
-        cyclic_copy(xg, pe->locals2[r], descb, pe->llds2[r], pr, pc_,
-                    c->p, c->q, elem, 1);
-        if (pe->ipivs[r]) {
-            /* distributed ipiv: local row il of this process row holds
-             * the global 1-based swap target of its global row */
-            int MB = D_MB(desca);
-            int mloc = numroc_impl(n, MB, pr, 0, c->p);
-            for (int il = 0; il < mloc; ++il) {
-                int igr = ((il / MB) * c->p + pr) * MB + il % MB;
-                if (igr < n) pe->ipivs[r][il] = (int)piv[igr];
+    free(ag); free(bg); free(aw); free(bw); free(lu); free(xg); free(piv);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static void pgetri_impl(char dt, int n,
+                        void* a, int ia, int ja, const int* desca,
+                        int* ipiv, int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (check_sub1(ia, ja, info)
+        || win_check(desca, ia, ja, n, n, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n;
+    sig_desc(&sg, 0, desca);
+    pending_t* pe = pend_get(700 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, ipiv, 0)) return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* aw = ag ? win_get(ag, desca, 1, 1, n, n, elem, &ok) : 0;
+    char* eye = aw ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    char* x = eye ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    int64_t* piv = x ? (int64_t*)xm(sizeof(int64_t) * (size_t)n, &ok) : 0;
+    if (ok && piv) {
+        gather_ipiv(pe, c, desca, piv, n);
+        /* inv(A) = U^{-1} L^{-1} P: solve the packed LU against P*I */
+        memset(eye, 0, (size_t)n * n * elem);
+        for (int j = 0; j < n; ++j) {
+            unsigned char one_s[16] = {0};
+            if (dt == 's') { float v = 1.0f; memcpy(one_s, &v, 4); }
+            else if (dt == 'd') { double v = 1.0; memcpy(one_s, &v, 8); }
+            else if (dt == 'c') { float v[2] = {1.0f, 0.0f}; memcpy(one_s, v, 8); }
+            else { double v[2] = {1.0, 0.0}; memcpy(one_s, v, 16); }
+            memcpy(eye + ((size_t)j * n + j) * elem, one_s, elem);
+        }
+        row_swaps(eye, n, n, piv, elem, 0);
+        rc = slate_c_call("lu_solve_factored", dt, n, n, aw, n,
+                          n, n, eye, n, x, 0, 0, 'L');
+        win_put(ag, desca, 1, 1, n, n, x, elem);
+        scatter_all(pe, 0, desca, c, ag, elem);
+    }
+    free(ag); free(aw); free(eye); free(x); free(piv);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static void ppotri_impl(char dt, const char* uplo, int n,
+                        void* a, int ia, int ja, const int* desca,
+                        int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (win_check(desca, ia, ja, n, n, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[4] = ia; sg.i[5] = ja; sg.ch[0] = uplo[0];
+    sig_desc(&sg, 0, desca);
+    pending_t* pe = pend_get(800 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, 0, 0)) return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* glob = gather_all(pe, 0, desca, c, elem, &ok);
+    char* win = glob ? win_get(glob, desca, ia, ja, n, n, elem, &ok) : 0;
+    char* out = win ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    if (ok && out) {
+        rc = slate_c_call("potri_factored", dt, n, n, win, n, 0, 0, 0, 0,
+                          out, 0, 0, uplo[0]);
+        win_put_tri(glob, desca, ia, ja, n, uplo[0], out, elem);
+        scatter_all(pe, 0, desca, c, glob, elem);
+    }
+    free(glob); free(win); free(out);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static void pgeqrf_impl(char dt, int m, int n,
+                        void* a, int ia, int ja, const int* desca,
+                        void* tau, int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    if (win_check(desca, ia, ja, m, n, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = m; sg.i[1] = n; sg.i[4] = ia; sg.i[5] = ja;
+    sig_desc(&sg, 0, desca);
+    pending_t* pe = pend_get(900 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, 0, tau)) return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    int mn = m < n ? m : n;
+    char* glob = gather_all(pe, 0, desca, c, elem, &ok);
+    char* win = glob ? win_get(glob, desca, ia, ja, m, n, elem, &ok) : 0;
+    char* f = win ? (char*)xm((size_t)m * n * elem, &ok) : 0;
+    char* tg = f ? (char*)xm((size_t)mn * elem, &ok) : 0;
+    if (ok && tg) {
+        rc = slate_c_call("geqrf", dt, m, n, win, m, 0, 0, 0, 0,
+                          f, tg, 0, 'L');
+        win_put(glob, desca, ia, ja, m, n, f, elem);
+        scatter_all(pe, 0, desca, c, glob, elem);
+        /* tau: distributed over process columns in the GLOBAL column
+         * layout (ScaLAPACK LOCc(JA+...) indexing) — window column jg
+         * is global column ja-1+jg, owned by its cyclic process column
+         * at that global column's local index */
+        int NB = D_NB(desca);
+        for (int jg = 0; jg < mn; ++jg) {
+            int gcol = ja - 1 + jg;
+            int pc = (gcol / NB) % c->q;
+            int jl = (gcol / (NB * c->q)) * NB + gcol % NB;
+            for (int r = 0; r < c->p * c->q; ++r) {
+                if (!pe->wbufs[r] || rank_col(c, r) != pc) continue;
+                memcpy((char*)pe->wbufs[r] + (size_t)jl * elem,
+                       tg + (size_t)jg * elem, elem);
             }
         }
     }
-    free(ag); free(bg); free(lu); free(xg); free(piv);
+    free(glob); free(win); free(f); free(tg);
     pe->tag = 0;
-    *info = rc;
+    set_info(info, rc);
+}
+
+static void pgels_impl(char dt, const char* trans, int m, int n, int nrhs,
+                       void* a, int ia, int ja, const int* desca,
+                       void* b, int ib, int jb, const int* descb,
+                       int* info) {
+    set_info(info, 0);
+    if (!(trans[0] == 'N' || trans[0] == 'n')) { set_info(info, -907); return; }
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    int mx = m > n ? m : n;
+    if (win_check(desca, ia, ja, m, n, info)
+        || win_check(descb, ib, jb, mx, nrhs, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = m; sg.i[1] = n; sg.i[3] = nrhs; sg.ch[0] = trans[0];
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    pending_t* pe = pend_get(1000 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, 0, 0))
+        return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    char* aw = bg ? win_get(ag, desca, ia, ja, m, n, elem, &ok) : 0;
+    char* bw = aw ? win_get(bg, descb, ib, jb, m, nrhs, elem, &ok) : 0;
+    char* x = bw ? (char*)xm((size_t)n * nrhs * elem, &ok) : 0;
+    if (ok && x) {
+        rc = slate_c_call("gels", dt, m, n, aw, m, m, nrhs, bw, m,
+                          x, 0, 0, 'L');
+        /* solution occupies the leading n rows of the B window (the
+         * QR factors are NOT written back into A — documented drop-in
+         * deviation; the reference overwrites A with the factorization) */
+        win_put(bg, descb, ib, jb, n, nrhs, x, elem);
+        scatter_all(pe, 1, descb, c, bg, elem);
+    }
+    free(ag); free(bg); free(aw); free(bw); free(x);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static void pheev_impl(char dt, const char* jobz, const char* uplo, int n,
+                       void* a, int ia, int ja, const int* desca,
+                       void* w, int w_elem, void* z, int iz, int jz,
+                       const int* descz, int* info) {
+    set_info(info, 0);
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) { set_info(info, -901); return; }
+    int wantz = (jobz[0] == 'V' || jobz[0] == 'v');
+    if (win_check(desca, ia, ja, n, n, info)) return;
+    if (wantz && win_check(descz, iz, jz, n, n, info)) return;
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = n; sg.i[4] = ia; sg.i[5] = ja; sg.i[6] = iz; sg.i[7] = jz;
+    sg.ch[0] = uplo[0]; sg.ch[1] = jobz[0];
+    sig_desc(&sg, 0, desca);
+    if (wantz) sig_desc(&sg, 1, descz);
+    pending_t* pe = pend_get(1100 + dt, D_CTXT(desca), &sg, info);
+    if (!pe) return;
+    if (!pend_step(pe, c, a, D_LLD(desca), wantz ? z : 0,
+                   wantz ? D_LLD(descz) : 0, 0, 0, 0, w)) return;
+    int elem = elem_of(dt), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* aw = ag ? win_get(ag, desca, ia, ja, n, n, elem, &ok) : 0;
+    double* wd = aw ? (double*)xm(sizeof(double) * (size_t)n, &ok) : 0;
+    char* zg = (wantz && wd)
+        ? (char*)xm((size_t)n * n * elem, &ok) : 0;
+    if (ok && wd && (!wantz || zg)) {
+        rc = slate_c_call(wantz ? "heev" : "heev_vals", dt, n, n, aw, n,
+                          0, 0, 0, 0, wd, wantz ? zg : 0, 0, uplo[0]);
+        if (wantz) {
+            char* zfull = gather_all(pe, 1, descz, c, elem, &ok);
+            if (zfull) {
+                win_put(zfull, descz, iz, jz, n, n, zg, elem);
+                scatter_all(pe, 1, descz, c, zfull, elem);
+                free(zfull);
+            }
+        }
+        /* eigenvalues are replicated on every rank */
+        for (int r = 0; r < c->p * c->q; ++r) {
+            if (!pe->wbufs[r]) continue;
+            if (w_elem == 8)
+                memcpy(pe->wbufs[r], wd, sizeof(double) * (size_t)n);
+            else {
+                float* wf = (float*)pe->wbufs[r];
+                for (int i = 0; i < n; ++i) wf[i] = (float)wd[i];
+            }
+        }
+    }
+    free(ag); free(aw); free(wd); free(zg);
+    pe->tag = 0;
+    set_info(info, rc);
+}
+
+static double plange_impl(char dt, const char* norm, int m, int n,
+                          void* a, int ia, int ja, const int* desca) {
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) return 0.0;
+    int info = 0;
+    if (win_check(desca, ia, ja, m, n, &info)) {
+        fprintf(stderr, "slate_tpu p?lange: bad window (%d)\n", info);
+        return 0.0;
+    }
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = m; sg.i[1] = n; sg.i[4] = ia; sg.i[5] = ja;
+    sg.ch[0] = norm[0];
+    sig_desc(&sg, 0, desca);
+    pending_t* pe = pend_get(1200 + dt, D_CTXT(desca), &sg, &info);
+    if (!pe) return 0.0;
+    if (!pend_step(pe, c, a, D_LLD(desca), 0, 0, 0, 0, 0, 0))
+        return 0.0;   /* value is delivered by the completing call */
+    int elem = elem_of(dt), ok = 1;
+    double val = 0.0;
+    char* glob = gather_all(pe, 0, desca, c, elem, &ok);
+    char* win = glob ? win_get(glob, desca, ia, ja, m, n, elem, &ok) : 0;
+    if (ok && win) {
+        char nm = norm[0];
+        if (nm == 'O' || nm == 'o' || nm == '1') nm = '1';
+        else if (nm == 'I' || nm == 'i') nm = 'I';
+        else if (nm == 'F' || nm == 'f' || nm == 'E' || nm == 'e') nm = 'F';
+        else nm = 'M';
+        slate_c_call("lange", dt, m, n, win, m, 0, 0, 0, 0,
+                     &val, 0, 0, nm);
+    }
+    free(glob); free(win);
+    pe->tag = 0;
+    return val;
 }
 """
 
-PGEMM_IMPL = r"""
-/* typed alpha*op(A)*op(B) + beta*C combine + op() builders */
+# typed PBLAS implementations: gemm / trsm / trmm need alpha/beta and the
+# op() transforms, so they are emitted once per dtype
+PBLAS_TYPED = r"""
+/* typed op(), alpha-scale, and unit-diagonal helpers */
 static void opmat_{k}(char tr, int m, int n, const {T}* g, {T}* out) {{
     /* g is (m x n) col-major; out is op(g): N -> copy, T/C -> (n x m) */
     if (tr == 'N' || tr == 'n') {{
@@ -615,62 +1177,168 @@ static void opmat_{k}(char tr, int m, int n, const {T}* g, {T}* out) {{
         }}
 }}
 
+static void scal_{k}({T}* x, size_t cnt, {T} alpha) {{
+    if (alpha == ({T})1) return;
+    for (size_t i = 0; i < cnt; ++i) x[i] *= alpha;
+}}
+
+static void unit_diag_{k}({T}* a, int n) {{
+    for (int j = 0; j < n; ++j) a[(size_t)j * n + j] = ({T})1;
+}}
+
 static void pgemm_impl_{k}(const char* transa, const char* transb,
                            int m, int n, int k, {T} alpha,
                            {T}* a, int ia, int ja, const int* desca,
                            {T}* b, int ib, int jb, const int* descb,
                            {T} beta,
-                           {T}* cc, int ic, int jc, const int* descc,
-                           int* info) {{
-    if (check_sub(ia, ja, info) || check_sub(ib, jb, info)
-        || check_sub(ic, jc, info)) return;
+                           {T}* cc, int ic, int jc, const int* descc) {{
+    int info = 0;
     blacs_ctx* c = ctx_of(D_CTXT(descc));
-    if (!c) {{ *info = -901; return; }}
+    if (!c) {{ set_info(0, -901); return; }}
     int opa = (transa[0] == 'N' || transa[0] == 'n');
     int opb = (transb[0] == 'N' || transb[0] == 'n');
-    if (D_M(desca) != (opa ? m : k) || D_N(desca) != (opa ? k : m)
-        || D_M(descb) != (opb ? k : n) || D_N(descb) != (opb ? n : k)
-        || D_M(descc) != m || D_N(descc) != n) {{ *info = -902; return; }}
-    pending_t* pe = pend_get(3000 + (int)'{k}', D_CTXT(descc));
-    if (!pe) {{ *info = -903; return; }}
-    *info = 0;
-    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb),
-                   cc, D_LLD(descc), 0)) return;
-    int elem = (int)sizeof({T});
-    int Am = D_M(desca), An = D_N(desca);
-    int Bm = D_M(descb), Bn = D_N(descb);
-    {T}* ag = ({T}*)malloc(sizeof({T}) * (size_t)Am * An);
-    {T}* bg = ({T}*)malloc(sizeof({T}) * (size_t)Bm * Bn);
-    {T}* cg = ({T}*)malloc(sizeof({T}) * (size_t)m * n);
-    {T}* oa = ({T}*)malloc(sizeof({T}) * (size_t)m * k);
-    {T}* ob = ({T}*)malloc(sizeof({T}) * (size_t)k * n);
-    {T}* pg = ({T}*)malloc(sizeof({T}) * (size_t)m * n);
-    for (int r = 0; r < c->p * c->q; ++r) {{
-        cyclic_copy(ag, pe->locals[r], desca, pe->llds[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 0);
-        cyclic_copy(bg, pe->locals2[r], descb, pe->llds2[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 0);
-        cyclic_copy(cg, pe->locals3[r], descc, pe->llds3[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 0);
+    int Am = opa ? m : k, An = opa ? k : m;
+    int Bm = opb ? k : n, Bn = opb ? n : k;
+    if (win_check(desca, ia, ja, Am, An, &info)
+        || win_check(descb, ib, jb, Bm, Bn, &info)
+        || win_check(descc, ic, jc, m, n, &info)) {{
+        set_info(0, info); return;
     }}
-    opmat_{k}(transa[0], Am, An, ag, oa);
-    opmat_{k}(transb[0], Bm, Bn, bg, ob);
-    int rc = slate_c_call("gemm", '{k}', m, k, oa, m, k, n, ob, k,
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = m; sg.i[1] = n; sg.i[2] = k;
+    sg.i[4] = ia; sg.i[5] = ja; sg.i[6] = ib; sg.i[7] = jb;
+    sg.i[8] = ic; sg.i[9] = jc;
+    sg.ch[0] = transa[0]; sg.ch[1] = transb[0];
+    sg.s[0] = {ALPHA_RE}; sg.s[1] = {ALPHA_IM};
+    sg.s[2] = {BETA_RE};  sg.s[3] = {BETA_IM};
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    sig_desc(&sg, 2, descc);
+    pending_t* pe = pend_get(1300 + '{k}', D_CTXT(descc), &sg, &info);
+    if (!pe) {{ set_info(0, info); return; }}
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb),
+                   cc, D_LLD(descc), 0, 0)) return;
+    int elem = (int)sizeof({T}), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    char* cg = bg ? gather_all(pe, 2, descc, c, elem, &ok) : 0;
+    {T}* aw = cg ? ({T}*)win_get(ag, desca, ia, ja, Am, An, elem, &ok) : 0;
+    {T}* bw = aw ? ({T}*)win_get(bg, descb, ib, jb, Bm, Bn, elem, &ok) : 0;
+    {T}* cw = bw ? ({T}*)win_get(cg, descc, ic, jc, m, n, elem, &ok) : 0;
+    {T}* oa = cw ? ({T}*)xm(sizeof({T}) * (size_t)m * k, &ok) : 0;
+    {T}* ob = oa ? ({T}*)xm(sizeof({T}) * (size_t)k * n, &ok) : 0;
+    {T}* pg = ob ? ({T}*)xm(sizeof({T}) * (size_t)m * n, &ok) : 0;
+    if (ok && pg) {{
+        opmat_{k}(transa[0], Am, An, aw, oa);
+        opmat_{k}(transb[0], Bm, Bn, bw, ob);
+        rc = slate_c_call("gemm", '{k}', m, k, oa, m, k, n, ob, k,
                           pg, 0, 0, 'L');
-    for (size_t i = 0; i < (size_t)m * n; ++i)
-        cg[i] = alpha * pg[i] + beta * cg[i];
-    for (int r = 0; r < c->p * c->q; ++r)
-        cyclic_copy(cg, pe->locals3[r], descc, pe->llds3[r],
-                    r % c->p, r / c->p, c->p, c->q, elem, 1);
-    free(ag); free(bg); free(cg); free(oa); free(ob); free(pg);
+        for (size_t i = 0; i < (size_t)m * n; ++i)
+            cw[i] = alpha * pg[i] + beta * cw[i];
+        win_put(cg, descc, ic, jc, m, n, (char*)cw, elem);
+        scatter_all(pe, 2, descc, c, cg, elem);
+    }}
+    free(ag); free(bg); free(cg); free(aw); free(bw); free(cw);
+    free(oa); free(ob); free(pg);
     pe->tag = 0;
-    *info = rc;
+    set_info(0, rc);
+}}
+
+/* ptrsm/ptrmm: reduce side/trans/diag to the driver's Left/NonUnit
+ * solve by explicit transposes — side=R becomes op(A)^T on the left of
+ * B^T, transa folds into the materialised operand, diag=U overwrites
+ * the stored diagonal with ones. */
+static void ptrXm_impl_{k}(int is_trsm, const char* side, const char* uplo,
+                           const char* transa, const char* diag,
+                           int m, int n, {T} alpha,
+                           {T}* a, int ia, int ja, const int* desca,
+                           {T}* b, int ib, int jb, const int* descb) {{
+    int info = 0;
+    blacs_ctx* c = ctx_of(D_CTXT(desca));
+    if (!c) {{ set_info(0, -901); return; }}
+    int left = (side[0] == 'L' || side[0] == 'l');
+    int kd = left ? m : n;
+    if (win_check(desca, ia, ja, kd, kd, &info)
+        || win_check(descb, ib, jb, m, n, &info)) {{
+        set_info(0, info); return;
+    }}
+    call_sig sg; memset(&sg, 0, sizeof sg);
+    sg.i[0] = m; sg.i[1] = n; sg.i[4] = ia; sg.i[5] = ja;
+    sg.i[6] = ib; sg.i[7] = jb;
+    sg.ch[0] = side[0]; sg.ch[1] = uplo[0]; sg.ch[2] = transa[0];
+    sg.ch[3] = diag[0]; sg.ch[4] = is_trsm ? 's' : 'm';
+    sg.s[0] = {ALPHA_RE}; sg.s[1] = {ALPHA_IM};
+    sig_desc(&sg, 0, desca); sig_desc(&sg, 1, descb);
+    pending_t* pe = pend_get((is_trsm ? 1400 : 1500) + '{k}',
+                             D_CTXT(desca), &sg, &info);
+    if (!pe) {{ set_info(0, info); return; }}
+    if (!pend_step(pe, c, a, D_LLD(desca), b, D_LLD(descb), 0, 0, 0, 0))
+        return;
+    int elem = (int)sizeof({T}), ok = 1, rc = -905;
+    char* ag = gather_all(pe, 0, desca, c, elem, &ok);
+    char* bg = ag ? gather_all(pe, 1, descb, c, elem, &ok) : 0;
+    {T}* aw = bg ? ({T}*)win_get(ag, desca, ia, ja, kd, kd, elem, &ok) : 0;
+    {T}* bw = aw ? ({T}*)win_get(bg, descb, ib, jb, m, n, elem, &ok) : 0;
+    {T}* aeff = bw ? ({T}*)xm(sizeof({T}) * (size_t)kd * kd, &ok) : 0;
+    int rows = left ? m : n, cols = left ? n : m;
+    {T}* beff = aeff ? ({T}*)xm(sizeof({T}) * (size_t)m * n, &ok) : 0;
+    {T}* x = beff ? ({T}*)xm(sizeof({T}) * (size_t)m * n, &ok) : 0;
+    {T}* atmp = x ? ({T}*)xm(sizeof({T}) * (size_t)kd * kd, &ok) : 0;
+    if (ok && atmp) {{
+        char u = uplo[0];
+        /* fold transa into the materialised operand */
+        opmat_{k}(transa[0], kd, kd, aw, aeff);
+        if (!(transa[0] == 'N' || transa[0] == 'n'))
+            u = (u == 'L' || u == 'l') ? 'U' : 'L';
+        if (diag[0] == 'U' || diag[0] == 'u') unit_diag_{k}(aeff, kd);
+        if (!left) {{
+            /* X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T */
+            opmat_{k}('T', kd, kd, aeff, atmp);
+            memcpy(aeff, atmp, sizeof({T}) * (size_t)kd * kd);
+            u = (u == 'L' || u == 'l') ? 'U' : 'L';
+            opmat_{k}('T', m, n, bw, beff);    /* B^T (n x m) */
+        }} else {{
+            memcpy(beff, bw, sizeof({T}) * (size_t)m * n);
+        }}
+        scal_{k}(beff, (size_t)m * n, alpha);
+        rc = slate_c_call(is_trsm ? "trsm" : "trmm", '{k}',
+                          kd, kd, aeff, kd, rows, cols, beff, rows,
+                          x, 0, 0, u);
+        if (!left) {{
+            opmat_{k}('T', rows, cols, x, beff);
+            memcpy(x, beff, sizeof({T}) * (size_t)m * n);
+        }}
+        win_put(bg, descb, ib, jb, m, n, (char*)x, elem);
+        scatter_all(pe, 1, descb, c, bg, elem);
+    }}
+    free(ag); free(bg); free(aw); free(bw); free(aeff); free(beff);
+    free(x); free(atmp);
+    pe->tag = 0;
+    set_info(0, rc);
 }}
 """
 
 
+def _sc_alpha_exprs(k):
+    if k == "s":
+        return ("(double)alpha", "0.0", "(double)beta", "0.0")
+    if k == "d":
+        return ("alpha", "0.0", "beta", "0.0")
+    if k == "c":
+        return ("(double)crealf(alpha)", "(double)cimagf(alpha)",
+                "(double)crealf(beta)", "(double)cimagf(beta)")
+    return ("creal(alpha)", "cimag(alpha)", "creal(beta)", "cimag(beta)")
+
+
+def _sc_one(k):
+    return {"s": "1.0f", "d": "1.0", "c": "1.0f", "z": "1.0"}[k]
+
+
+def _manglings(name):
+    return (name.upper(), name, name + "_")
+
+
 def gen_scalapack():
-    lines = [SCALAPACK_CORE]
+    parts = [SCALAPACK_CORE, SCALAPACK_IMPLS]
     for k in "sdcz":
         T = CTYPES[k]
         if k == "c":
@@ -679,41 +1347,145 @@ def gen_scalapack():
             conj = "((tr == 'C' || tr == 'c') ? conj(v) : v)"
         else:
             conj = "v"
-        lines.append(PGEMM_IMPL.format(k=k, T=T, CONJ=conj))
-    # the 3-mangled typed wrappers
+        are, aim, bre, bim = _sc_alpha_exprs(k)
+        parts.append(PBLAS_TYPED.format(
+            k=k, T=T, CONJ=conj, ALPHA_RE=are, ALPHA_IM=aim,
+            BETA_RE=bre, BETA_IM=bim))
+
+    w = parts.append
     for k in "sdcz":
         T = CTYPES[k]
-        for name in (f"p{k}potrf",):
-            for mang in (name.upper(), name, name + "_"):
-                lines.append(
-                    f"void {mang}(const char* uplo, const int* n, {T}* a, "
-                    f"const int* ia, const int* ja, const int* desca, "
-                    f"int* info)\n"
-                    f"{{ ppotrf_impl('{k}', uplo, *n, a, *ia, *ja, desca, "
-                    f"info); }}\n")
-        for name in (f"p{k}gesv",):
-            for mang in (name.upper(), name, name + "_"):
-                lines.append(
-                    f"void {mang}(const int* n, const int* nrhs, {T}* a, "
-                    f"const int* ia, const int* ja, const int* desca, "
-                    f"int* ipiv, {T}* b, const int* ib, const int* jb, "
-                    f"const int* descb, int* info)\n"
-                    f"{{ pgesv_impl('{k}', *n, *nrhs, a, *ia, *ja, desca, "
-                    f"ipiv, b, *ib, *jb, descb, info); }}\n")
-        for name in (f"p{k}gemm",):
-            for mang in (name.upper(), name, name + "_"):
-                lines.append(
-                    f"void {mang}(const char* transa, const char* transb, "
-                    f"const int* m, const int* n, const int* k, "
-                    f"const {T}* alpha, {T}* a, const int* ia, "
-                    f"const int* ja, const int* desca, {T}* b, "
-                    f"const int* ib, const int* jb, const int* descb, "
-                    f"const {T}* beta, {T}* c, const int* ic, "
-                    f"const int* jc, const int* descc, int* info)\n"
-                    f"{{ pgemm_impl_{k}(transa, transb, *m, *n, *k, *alpha, "
-                    f"a, *ia, *ja, desca, b, *ib, *jb, descb, *beta, "
-                    f"c, *ic, *jc, descc, info); }}\n")
-    return "\n".join(lines)
+        WT = "float" if k in "sc" else "double"      # eigenvalue width
+        WE = 4 if k in "sc" else 8
+        sy = "syev" if k in "sd" else "heev"
+        one = _sc_one(k)
+
+        for mang in _manglings(f"p{k}potrf"):
+            w(f"void {mang}(const char* uplo, const int* n, {T}* a, "
+              f"const int* ia, const int* ja, const int* desca, int* info)\n"
+              f"{{ ppotrf_impl('{k}', uplo, *n, a, *ia, *ja, desca, info); }}\n")
+        for mang in _manglings(f"p{k}potrs"):
+            w(f"void {mang}(const char* uplo, const int* n, const int* nrhs, "
+              f"{T}* a, const int* ia, const int* ja, const int* desca, "
+              f"{T}* b, const int* ib, const int* jb, const int* descb, "
+              f"int* info)\n"
+              f"{{ ppotrs_impl('{k}', uplo, *n, *nrhs, a, *ia, *ja, desca, "
+              f"b, *ib, *jb, descb, info); }}\n")
+        for mang in _manglings(f"p{k}posv"):
+            w(f"void {mang}(const char* uplo, const int* n, const int* nrhs, "
+              f"{T}* a, const int* ia, const int* ja, const int* desca, "
+              f"{T}* b, const int* ib, const int* jb, const int* descb, "
+              f"int* info)\n"
+              f"{{ pposv_impl('{k}', uplo, *n, *nrhs, a, *ia, *ja, desca, "
+              f"b, *ib, *jb, descb, info); }}\n")
+        for mang in _manglings(f"p{k}getrf"):
+            w(f"void {mang}(const int* m, const int* n, {T}* a, "
+              f"const int* ia, const int* ja, const int* desca, int* ipiv, "
+              f"int* info)\n"
+              f"{{ pgetrf_impl('{k}', *m, *n, a, *ia, *ja, desca, ipiv, "
+              f"info); }}\n")
+        for mang in _manglings(f"p{k}getrs"):
+            w(f"void {mang}(const char* trans, const int* n, "
+              f"const int* nrhs, {T}* a, const int* ia, const int* ja, "
+              f"const int* desca, int* ipiv, {T}* b, const int* ib, "
+              f"const int* jb, const int* descb, int* info)\n"
+              f"{{ pgetrs_impl('{k}', trans, *n, *nrhs, a, *ia, *ja, desca, "
+              f"ipiv, b, *ib, *jb, descb, info); }}\n")
+        for mang in _manglings(f"p{k}gesv"):
+            w(f"void {mang}(const int* n, const int* nrhs, {T}* a, "
+              f"const int* ia, const int* ja, const int* desca, int* ipiv, "
+              f"{T}* b, const int* ib, const int* jb, const int* descb, "
+              f"int* info)\n"
+              f"{{ pgesv_impl('{k}', *n, *nrhs, a, *ia, *ja, desca, ipiv, "
+              f"b, *ib, *jb, descb, info); }}\n")
+        for mang in _manglings(f"p{k}getri"):
+            w(f"void {mang}(const int* n, {T}* a, const int* ia, "
+              f"const int* ja, const int* desca, int* ipiv, {T}* work, "
+              f"const int* lwork, int* iwork, const int* liwork, int* info)\n"
+              f"{{ if ((lwork && *lwork == -1) || (liwork && *liwork == -1)) "
+              f"{{ if (work) work[0] = {one}; if (iwork) iwork[0] = 1; "
+              f"if (info) *info = 0; return; }}\n"
+              f"  pgetri_impl('{k}', *n, a, *ia, *ja, desca, ipiv, info); }}\n")
+        for mang in _manglings(f"p{k}potri"):
+            w(f"void {mang}(const char* uplo, const int* n, {T}* a, "
+              f"const int* ia, const int* ja, const int* desca, int* info)\n"
+              f"{{ ppotri_impl('{k}', uplo, *n, a, *ia, *ja, desca, info); }}\n")
+        for mang in _manglings(f"p{k}geqrf"):
+            w(f"void {mang}(const int* m, const int* n, {T}* a, "
+              f"const int* ia, const int* ja, const int* desca, {T}* tau, "
+              f"{T}* work, const int* lwork, int* info)\n"
+              f"{{ if (lwork && *lwork == -1) {{ if (work) work[0] = {one}; "
+              f"if (info) *info = 0; return; }}\n"
+              f"  pgeqrf_impl('{k}', *m, *n, a, *ia, *ja, desca, tau, "
+              f"info); }}\n")
+        for mang in _manglings(f"p{k}gels"):
+            w(f"void {mang}(const char* trans, const int* m, const int* n, "
+              f"const int* nrhs, {T}* a, const int* ia, const int* ja, "
+              f"const int* desca, {T}* b, const int* ib, const int* jb, "
+              f"const int* descb, {T}* work, const int* lwork, int* info)\n"
+              f"{{ if (lwork && *lwork == -1) {{ if (work) work[0] = {one}; "
+              f"if (info) *info = 0; return; }}\n"
+              f"  pgels_impl('{k}', trans, *m, *n, *nrhs, a, *ia, *ja, "
+              f"desca, b, *ib, *jb, descb, info); }}\n")
+        # eigen drivers: real -> p?syev, complex -> p?heev (extra rwork)
+        if k in "sd":
+            for mang in _manglings(f"p{k}{sy}"):
+                w(f"void {mang}(const char* jobz, const char* uplo, "
+                  f"const int* n, {T}* a, const int* ia, const int* ja, "
+                  f"const int* desca, {WT}* w, {T}* z, const int* iz, "
+                  f"const int* jz, const int* descz, {T}* work, "
+                  f"const int* lwork, int* info)\n"
+                  f"{{ if (lwork && *lwork == -1) {{ if (work) work[0] = "
+                  f"{one}; if (info) *info = 0; return; }}\n"
+                  f"  pheev_impl('{k}', jobz, uplo, *n, a, *ia, *ja, desca, "
+                  f"w, {WE}, z, *iz, *jz, descz, info); }}\n")
+        else:
+            for mang in _manglings(f"p{k}{sy}"):
+                w(f"void {mang}(const char* jobz, const char* uplo, "
+                  f"const int* n, {T}* a, const int* ia, const int* ja, "
+                  f"const int* desca, {WT}* w, {T}* z, const int* iz, "
+                  f"const int* jz, const int* descz, {T}* work, "
+                  f"const int* lwork, {WT}* rwork, const int* lrwork, "
+                  f"int* info)\n"
+                  f"{{ if ((lwork && *lwork == -1) || (lrwork && *lrwork == "
+                  f"-1)) {{ if (work) work[0] = {one}; if (rwork) rwork[0] "
+                  f"= 1; if (info) *info = 0; return; }}\n"
+                  f"  pheev_impl('{k}', jobz, uplo, *n, a, *ia, *ja, desca, "
+                  f"w, {WE}, z, *iz, *jz, descz, info); }}\n")
+        # PBLAS (no info argument, matching the real ABI)
+        for mang in _manglings(f"p{k}gemm"):
+            w(f"void {mang}(const char* transa, const char* transb, "
+              f"const int* m, const int* n, const int* k, const {T}* alpha, "
+              f"{T}* a, const int* ia, const int* ja, const int* desca, "
+              f"{T}* b, const int* ib, const int* jb, const int* descb, "
+              f"const {T}* beta, {T}* c, const int* ic, const int* jc, "
+              f"const int* descc)\n"
+              f"{{ pgemm_impl_{k}(transa, transb, *m, *n, *k, *alpha, "
+              f"a, *ia, *ja, desca, b, *ib, *jb, descb, *beta, "
+              f"c, *ic, *jc, descc); }}\n")
+        for mang in _manglings(f"p{k}trsm"):
+            w(f"void {mang}(const char* side, const char* uplo, "
+              f"const char* transa, const char* diag, const int* m, "
+              f"const int* n, const {T}* alpha, {T}* a, const int* ia, "
+              f"const int* ja, const int* desca, {T}* b, const int* ib, "
+              f"const int* jb, const int* descb)\n"
+              f"{{ ptrXm_impl_{k}(1, side, uplo, transa, diag, *m, *n, "
+              f"*alpha, a, *ia, *ja, desca, b, *ib, *jb, descb); }}\n")
+        for mang in _manglings(f"p{k}trmm"):
+            w(f"void {mang}(const char* side, const char* uplo, "
+              f"const char* transa, const char* diag, const int* m, "
+              f"const int* n, const {T}* alpha, {T}* a, const int* ia, "
+              f"const int* ja, const int* desca, {T}* b, const int* ib, "
+              f"const int* jb, const int* descb)\n"
+              f"{{ ptrXm_impl_{k}(0, side, uplo, transa, diag, *m, *n, "
+              f"*alpha, a, *ia, *ja, desca, b, *ib, *jb, descb); }}\n")
+        for mang in _manglings(f"p{k}lange"):
+            w(f"{WT} {mang}(const char* norm, const int* m, const int* n, "
+              f"{T}* a, const int* ia, const int* ja, const int* desca, "
+              f"{WT}* work)\n"
+              f"{{ (void)work; return ({WT})plange_impl('{k}', norm, *m, "
+              f"*n, a, *ia, *ja, desca); }}\n")
+    return "\n".join(parts)
 
 
 def main():
@@ -728,7 +1500,8 @@ def main():
               "w") as f:
         f.write(gen_scalapack())
     n = sum(len(k) for _, k, _, _ in DRIVERS)
-    print(f"generated {len(DRIVERS)} drivers, {n} typed entry points")
+    print(f"generated {len(DRIVERS)} drivers, {n} typed entry points, "
+          f"15 ScaLAPACK families x4 types x3 manglings")
 
 
 if __name__ == "__main__":
