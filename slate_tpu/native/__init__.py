@@ -122,6 +122,7 @@ def _load():
         lib.slate_host_gesv_f64.restype = c.c_int
         lib.slate_host_gesv_f64.argtypes = [p, i64, p, i64, p]
         lib.slate_host_num_threads.restype = c.c_int
+        lib.slate_set_num_threads.argtypes = [c.c_int]
         for name in ("slate_hb2st_f64", "slate_hb2st_c128"):
             fn = getattr(lib, name)
             fn.restype = i64
@@ -336,6 +337,14 @@ def host_gesv(a: np.ndarray, b: np.ndarray):
 def num_threads() -> int:
     lib = _load()
     return lib.slate_host_num_threads() if lib else 1
+
+
+def set_num_threads(n: int) -> None:
+    """Cap the host OpenMP thread pool (test hook: the wavefront-chase
+    identity test sweeps 1/2/4 threads inside one process)."""
+    lib = _load()
+    if lib:
+        lib.slate_set_num_threads(int(n))
 
 
 # ---------------------------------------------------------------------------

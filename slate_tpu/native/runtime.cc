@@ -434,6 +434,10 @@ void slate_host_gemm_f32(int64_t m, int64_t n, int64_t k, float alpha,
 
 int slate_host_num_threads() { return omp_get_max_threads(); }
 
+// test hook: the wavefront-chase identity test sweeps thread counts in
+// one process (OMP_NUM_THREADS is read once at startup)
+void slate_set_num_threads(int n) { omp_set_num_threads(n > 0 ? n : 1); }
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -636,15 +640,21 @@ struct HhLog {
     int64_t count = 0;
 
     void push(int64_t r0, int64_t L, const double* vv, double tv) {
-        if (v) {
-            double* dst = v + count * kd;
-            for (int64_t i = 0; i < L; ++i) dst[i] = vv[i];
-            for (int64_t i = L; i < kd; ++i) dst[i] = 0.0;
-            tau[count] = tv;
-            row0[count] = (int32_t)r0;
-            len[count] = (int32_t)L;
-        }
+        put(count, r0, L, vv, tv);
         ++count;
+    }
+
+    // positional write (wavefront scheduling: per-sweep bases keep the
+    // serial log layout while tasks complete out of sweep order)
+    void put(int64_t idx, int64_t r0, int64_t L, const double* vv,
+             double tv) {
+        if (!v) return;
+        double* dst = v + idx * kd;
+        for (int64_t i = 0; i < L; ++i) dst[i] = vv[i];
+        for (int64_t i = L; i < kd; ++i) dst[i] = 0.0;
+        tau[idx] = tv;
+        row0[idx] = (int32_t)r0;
+        len[idx] = (int32_t)L;
     }
 };
 
@@ -739,9 +749,159 @@ static int64_t hb2st_hh_impl_range(double* ab, int64_t n, int64_t kd,
     return log.count;
 }
 
+// ---------------------------------------------------------------------
+// OpenMP wavefront for the Householder chase (reference: the task-DAG
+// wavefront of src/hb2st.cc:23-90).  Decomposition recorded in STATUS
+// r4: task (sweep j, window w) touches band rows
+// [j+1+(w-1)kd, j+1+(w+1)kd) (+1 row for the trailing length-1
+// coupling apply, which still leaves a >= kd-2 row gap); with stagger
+// t = 3j + w, same-t tasks are disjoint and every conflicting pair is
+// ordered — deps (j, w-1) at t-1, (j-1, w+2) at t-1, (j-1, w+1) at
+// t-2 — so a per-t `omp parallel for` over j is BITWISE-identical to
+// the serial chase (each task's arithmetic is unchanged; only disjoint
+// tasks reorder).  Log slots are written positionally at per-sweep
+// bases, reproducing the serial log layout exactly.
+// ---------------------------------------------------------------------
+
+static int64_t hb_sweep_nwin(int64_t n, int64_t kd, int64_t j) {
+    int64_t L = std::min(kd, n - 1 - j);
+    if (L < 2) return 0;
+    int64_t cnt = 1, r0 = j + 1;
+    for (;;) {
+        int64_t r1 = r0 + L;
+        int64_t Lt = std::min(kd, n - r1);
+        if (Lt < 2) break;
+        ++cnt; r0 = r1; L = Lt;
+    }
+    return cnt;
+}
+
+struct HbSweep {
+    std::vector<double> v;
+    double tau = 0.0;
+    int64_t r0 = 0, L = 0, base = 0, nwin = 0;
+};
+
+// trailing coupling apply for a finished window when the next block is
+// a single row (the serial loop's Lt==1 right-apply-then-break)
+static void hb_sweep_tail(double* ab, int64_t n, int64_t kd, int64_t ldab,
+                          HbSweep& st) {
+    auto BA = [&](int64_t i, int64_t c) -> double& {
+        return ab[c * ldab + (i - c)];
+    };
+    int64_t r1 = st.r0 + st.L;
+    int64_t Lt = std::min(kd, n - r1);
+    if (Lt != 1) return;
+    double acc = 0.0;
+    for (int64_t c = 0; c < st.L; ++c) acc += BA(r1, st.r0 + c) * st.v[c];
+    acc *= st.tau;
+    for (int64_t c = 0; c < st.L; ++c) BA(r1, st.r0 + c) -= acc * st.v[c];
+}
+
+static void hb_sweep_start(double* ab, int64_t n, int64_t kd, int64_t ldab,
+                           HhLog& log, int64_t j, HbSweep& st,
+                           double* wbuf) {
+    auto BA = [&](int64_t i, int64_t c) -> double& {
+        return ab[c * ldab + (i - c)];
+    };
+    int64_t L = std::min(kd, n - 1 - j);
+    int64_t r0 = j + 1;
+    for (int64_t i = 0; i < L; ++i) st.v[i] = BA(r0 + i, j);
+    larfg_d(L, st.v.data(), st.tau);
+    BA(r0, j) = st.v[0];
+    for (int64_t i = 1; i < L; ++i) BA(r0 + i, j) = 0.0;
+    st.v[0] = 1.0;
+    hh_two_sided(ab, ldab, r0, L, st.v.data(), st.tau, wbuf);
+    log.put(st.base, r0, L, st.v.data(), st.tau);
+    st.r0 = r0; st.L = L;
+    if (st.nwin == 1) hb_sweep_tail(ab, n, kd, ldab, st);
+}
+
+static void hb_sweep_step(double* ab, int64_t n, int64_t kd, int64_t ldab,
+                          HhLog& log, int64_t w, HbSweep& st,
+                          double* wbuf, double* colbuf) {
+    auto BA = [&](int64_t i, int64_t c) -> double& {
+        return ab[c * ldab + (i - c)];
+    };
+    int64_t r0 = st.r0, L = st.L;
+    int64_t r1 = r0 + L;
+    int64_t Lt = std::min(kd, n - r1);   // >= 2 by nwin scheduling
+    for (int64_t i = 0; i < Lt; ++i) {
+        double acc = 0.0;
+        for (int64_t c = 0; c < L; ++c) acc += BA(r1 + i, r0 + c) * st.v[c];
+        acc *= st.tau;
+        for (int64_t c = 0; c < L; ++c) BA(r1 + i, r0 + c) -= acc * st.v[c];
+    }
+    for (int64_t i = 0; i < Lt; ++i) colbuf[i] = BA(r1 + i, r0);
+    double tau2;
+    larfg_d(Lt, colbuf, tau2);
+    BA(r1, r0) = colbuf[0];
+    for (int64_t i = 1; i < Lt; ++i) BA(r1 + i, r0) = 0.0;
+    colbuf[0] = 1.0;
+    for (int64_t c = 1; c < L; ++c) {
+        double acc = 0.0;
+        for (int64_t i = 0; i < Lt; ++i) acc += colbuf[i] * BA(r1 + i, r0 + c);
+        acc *= tau2;
+        for (int64_t i = 0; i < Lt; ++i) BA(r1 + i, r0 + c) -= acc * colbuf[i];
+    }
+    hh_two_sided(ab, ldab, r1, Lt, colbuf, tau2, wbuf);
+    log.put(st.base + w, r1, Lt, colbuf, tau2);
+    for (int64_t i = 0; i < Lt; ++i) st.v[i] = colbuf[i];
+    st.tau = tau2; st.r0 = r1; st.L = Lt;
+    if (w == st.nwin - 1) hb_sweep_tail(ab, n, kd, ldab, st);
+}
+
+static int64_t hb2st_hh_wave(double* ab, int64_t n, int64_t kd,
+                             int64_t ldab, HhLog& log,
+                             int64_t j0, int64_t j1) {
+    if (j1 > n - 2) j1 = n - 2;
+    if (j0 >= j1) return 0;
+    const int64_t nsweep = j1 - j0;
+    std::vector<HbSweep> st((size_t)nsweep);
+    int64_t total = 0, nwin_max = 0, tmax = -1;
+    for (int64_t js = 0; js < nsweep; ++js) {
+        auto& s = st[(size_t)js];
+        s.base = total;
+        s.nwin = hb_sweep_nwin(n, kd, j0 + js);
+        s.v.assign((size_t)kd, 0.0);
+        total += s.nwin;
+        nwin_max = std::max(nwin_max, s.nwin);
+        if (s.nwin) tmax = std::max(tmax, 3 * js + s.nwin - 1);
+    }
+    const int nthr = omp_get_max_threads();
+    std::vector<double> scratch((size_t)nthr * 2 * (size_t)kd);
+    for (int64_t t = 0; t <= tmax; ++t) {
+        const int64_t js_hi = std::min(nsweep - 1, t / 3);
+        const int64_t js_lo = std::max<int64_t>(
+            0, (t - nwin_max + 1 + 2) / 3);
+        #pragma omp parallel for schedule(static)
+        for (int64_t js = js_lo; js <= js_hi; ++js) {
+            const int64_t w = t - 3 * js;
+            auto& s = st[(size_t)js];
+            if (w < 0 || w >= s.nwin) continue;
+            double* wbuf = scratch.data()
+                + (size_t)omp_get_thread_num() * 2 * (size_t)kd;
+            double* colbuf = wbuf + kd;
+            if (w == 0)
+                hb_sweep_start(ab, n, kd, ldab, log, j0 + js, s, wbuf);
+            else
+                hb_sweep_step(ab, n, kd, ldab, log, w, s, wbuf, colbuf);
+        }
+    }
+    log.count = total;
+    return total;
+}
+
+static bool chase_serial() {
+    const char* e = getenv("SLATE_TPU_CHASE_SERIAL");
+    return e && e[0] && e[0] != '0';
+}
+
 static int64_t hb2st_hh_impl(double* ab, int64_t n, int64_t kd,
                              int64_t ldab, HhLog& log) {
-    return hb2st_hh_impl_range(ab, n, kd, ldab, log, 0, n - 2);
+    if (chase_serial())
+        return hb2st_hh_impl_range(ab, n, kd, ldab, log, 0, n - 2);
+    return hb2st_hh_wave(ab, n, kd, ldab, log, 0, n - 2);
 }
 
 // Householder band→bidiagonal chase (SLATE's gebr1/2/3 task partition,
@@ -855,6 +1015,149 @@ static int64_t tb2bd_hh_impl(double* st, int64_t n, int64_t kd,
         }
     }
     return ulog.count;
+}
+
+// Wavefront for the bidiagonal chase — identical stagger/disjointness
+// structure to hb2st_hh_wave (task (s, b) touches rows/cols
+// [s+1+(b-1)kd, s+1+(b+1)kd); t = 3s + b), with two positional logs.
+static int64_t tb_sweep_nblk(int64_t n, int64_t kd, int64_t s) {
+    int64_t c_lo = s + 1, c_hi = std::min(s + kd, n - 1);
+    int64_t r_hi = std::min(s + kd, n - 1);
+    if (c_hi <= c_lo && r_hi <= s + 1) return 0;
+    int64_t cnt = 1;
+    for (int64_t b = 1; b * kd + 1 + s <= n - 1; ++b) ++cnt;
+    return cnt;
+}
+
+struct TbSweep {
+    std::vector<double> u;
+    double tauu = 0.0;
+    int64_t base = 0, nblk = 0;
+};
+
+static void tb_sweep_start(double* stm, int64_t n, int64_t kd, int64_t ldw,
+                           HhLog& ulog, HhLog& vlog, int64_t s,
+                           TbSweep& sw, double* xbuf) {
+    auto A = [&](int64_t r, int64_t c) -> double& {
+        return stm[r * ldw + (c - r + kd)];
+    };
+    int64_t c_lo = s + 1, c_hi = std::min(s + kd, n - 1);
+    int64_t r_hi = std::min(s + kd, n - 1);
+    int64_t Lv = c_hi - c_lo + 1;
+    double tauv = 0.0;
+    for (int64_t c = 0; c < Lv; ++c) xbuf[c] = A(s, c_lo + c);
+    larfg_d(Lv, xbuf, tauv);
+    A(s, c_lo) = xbuf[0];
+    for (int64_t c = 1; c < Lv; ++c) A(s, c_lo + c) = 0.0;
+    xbuf[0] = 1.0;
+    for (int64_t r = s + 1; r <= r_hi; ++r) {
+        double acc = 0.0;
+        for (int64_t c = 0; c < Lv; ++c) acc += A(r, c_lo + c) * xbuf[c];
+        acc *= tauv;
+        for (int64_t c = 0; c < Lv; ++c) A(r, c_lo + c) -= acc * xbuf[c];
+    }
+    vlog.put(sw.base, c_lo, Lv, xbuf, tauv);
+    int64_t Lu = r_hi - s;
+    for (int64_t r = 0; r < Lu; ++r) sw.u[(size_t)r] = A(s + 1 + r, c_lo);
+    larfg_d(Lu, sw.u.data(), sw.tauu);
+    A(s + 1, c_lo) = sw.u[0];
+    for (int64_t r = 1; r < Lu; ++r) A(s + 1 + r, c_lo) = 0.0;
+    sw.u[0] = 1.0;
+    for (int64_t c = c_lo + 1; c <= c_hi; ++c) {
+        double acc = 0.0;
+        for (int64_t r = 0; r < Lu; ++r) acc += sw.u[(size_t)r] * A(s + 1 + r, c);
+        acc *= sw.tauu;
+        for (int64_t r = 0; r < Lu; ++r) A(s + 1 + r, c) -= acc * sw.u[(size_t)r];
+    }
+    ulog.put(sw.base, s + 1, Lu, sw.u.data(), sw.tauu);
+}
+
+static void tb_sweep_block(double* stm, int64_t n, int64_t kd, int64_t ldw,
+                           HhLog& ulog, HhLog& vlog, int64_t s, int64_t b,
+                           TbSweep& sw, double* xbuf) {
+    auto A = [&](int64_t r, int64_t c) -> double& {
+        return stm[r * ldw + (c - r + kd)];
+    };
+    int64_t i_lo = (b - 1) * kd + 1 + s;
+    int64_t i_hi = std::min(i_lo + kd - 1, n - 1);
+    int64_t j_lo = b * kd + 1 + s;
+    int64_t j_hi = std::min(j_lo + kd - 1, n - 1);
+    int64_t Li = i_hi - i_lo + 1, Lj = j_hi - j_lo + 1;
+    double tauv = 0.0;
+    for (int64_t c = j_lo; c <= j_hi; ++c) {
+        double acc = 0.0;
+        for (int64_t r = 0; r < Li; ++r) acc += sw.u[(size_t)r] * A(i_lo + r, c);
+        acc *= sw.tauu;
+        for (int64_t r = 0; r < Li; ++r) A(i_lo + r, c) -= acc * sw.u[(size_t)r];
+    }
+    for (int64_t c = 0; c < Lj; ++c) xbuf[c] = A(i_lo, j_lo + c);
+    larfg_d(Lj, xbuf, tauv);
+    A(i_lo, j_lo) = xbuf[0];
+    for (int64_t c = 1; c < Lj; ++c) A(i_lo, j_lo + c) = 0.0;
+    xbuf[0] = 1.0;
+    for (int64_t r = i_lo + 1; r <= i_hi; ++r) {
+        double acc = 0.0;
+        for (int64_t c = 0; c < Lj; ++c) acc += A(r, j_lo + c) * xbuf[c];
+        acc *= tauv;
+        for (int64_t c = 0; c < Lj; ++c) A(r, j_lo + c) -= acc * xbuf[c];
+    }
+    vlog.put(sw.base + b, j_lo, Lj, xbuf, tauv);
+    for (int64_t r = j_lo; r <= j_hi; ++r) {
+        double acc = 0.0;
+        for (int64_t c = 0; c < Lj; ++c) acc += A(r, j_lo + c) * xbuf[c];
+        acc *= tauv;
+        for (int64_t c = 0; c < Lj; ++c) A(r, j_lo + c) -= acc * xbuf[c];
+    }
+    for (int64_t r = 0; r < Lj; ++r) sw.u[(size_t)r] = A(j_lo + r, j_lo);
+    larfg_d(Lj, sw.u.data(), sw.tauu);
+    A(j_lo, j_lo) = sw.u[0];
+    for (int64_t r = 1; r < Lj; ++r) A(j_lo + r, j_lo) = 0.0;
+    sw.u[0] = 1.0;
+    for (int64_t c = j_lo + 1; c <= j_hi; ++c) {
+        double acc = 0.0;
+        for (int64_t r = 0; r < Lj; ++r) acc += sw.u[(size_t)r] * A(j_lo + r, c);
+        acc *= sw.tauu;
+        for (int64_t r = 0; r < Lj; ++r) A(j_lo + r, c) -= acc * sw.u[(size_t)r];
+    }
+    ulog.put(sw.base + b, j_lo, Lj, sw.u.data(), sw.tauu);
+}
+
+static int64_t tb2bd_hh_wave(double* stm, int64_t n, int64_t kd,
+                             int64_t ldw, HhLog& ulog, HhLog& vlog) {
+    const int64_t smax = n - 1;   // sweeps s in [0, n-2]
+    if (smax < 1) return 0;
+    std::vector<TbSweep> sw((size_t)smax);
+    int64_t total = 0, nblk_max = 0, tmax = -1;
+    for (int64_t s = 0; s < smax; ++s) {
+        auto& w = sw[(size_t)s];
+        w.base = total;
+        w.nblk = tb_sweep_nblk(n, kd, s);
+        w.u.assign((size_t)kd, 0.0);
+        total += w.nblk;
+        nblk_max = std::max(nblk_max, w.nblk);
+        if (w.nblk) tmax = std::max(tmax, 3 * s + w.nblk - 1);
+    }
+    const int nthr = omp_get_max_threads();
+    std::vector<double> scratch((size_t)nthr * (size_t)kd);
+    for (int64_t t = 0; t <= tmax; ++t) {
+        const int64_t s_hi = std::min(smax - 1, t / 3);
+        const int64_t s_lo = std::max<int64_t>(0, (t - nblk_max + 1 + 2) / 3);
+        #pragma omp parallel for schedule(static)
+        for (int64_t s = s_lo; s <= s_hi; ++s) {
+            const int64_t b = t - 3 * s;
+            auto& w = sw[(size_t)s];
+            if (b < 0 || b >= w.nblk) continue;
+            double* xbuf = scratch.data()
+                + (size_t)omp_get_thread_num() * (size_t)kd;
+            if (b == 0)
+                tb_sweep_start(stm, n, kd, ldw, ulog, vlog, s, w, xbuf);
+            else
+                tb_sweep_block(stm, n, kd, ldw, ulog, vlog, s, b, w, xbuf);
+        }
+    }
+    ulog.count = total;
+    vlog.count = total;
+    return total;
 }
 
 // Upper-band two-sided rotations for tb2bd (see layout above).
@@ -1067,7 +1370,9 @@ int64_t slate_hb2st_hh_range_f64(double* ab, int64_t n, int64_t kd,
                                  int32_t* row0, int32_t* length,
                                  int64_t j0, int64_t j1) {
     HhLog log{v, tau, row0, length, kd};
-    return hb2st_hh_impl_range(ab, n, kd, ldab, log, j0, j1);
+    if (chase_serial())
+        return hb2st_hh_impl_range(ab, n, kd, ldab, log, j0, j1);
+    return hb2st_hh_wave(ab, n, kd, ldab, log, j0, j1);
 }
 
 int64_t slate_hb2st_hh_f64(double* ab, int64_t n, int64_t kd, int64_t ldab,
@@ -1083,7 +1388,9 @@ int64_t slate_tb2bd_hh_f64(double* st, int64_t n, int64_t kd, int64_t ldw,
                            int32_t* vrow0, int32_t* vlen) {
     HhLog ulog{uv, utau, urow0, ulen, kd};
     HhLog vlog{vv, vtau, vrow0, vlen, kd};
-    return tb2bd_hh_impl(st, n, kd, ldw, ulog, vlog);
+    if (chase_serial())
+        return tb2bd_hh_impl(st, n, kd, ldw, ulog, vlog);
+    return tb2bd_hh_wave(st, n, kd, ldw, ulog, vlog);
 }
 
 int64_t slate_hb2st_c128(void* ab, int64_t n, int64_t kd, int64_t ldab,

@@ -1,0 +1,123 @@
+"""OpenMP wavefront chase (native/runtime.cc hb2st_hh_wave /
+tb2bd_hh_wave) vs the serial chase: BITWISE identity.
+
+The wavefront schedules task (sweep j, window w) at stagger t = 3j + w;
+same-t tasks touch disjoint band rows and every dependence crosses a t
+boundary (reference: the task-DAG of ``src/hb2st.cc:23-90``), so the
+parallel schedule must reproduce the serial chase exactly — band, logs,
+and counts — at every thread count.  Correctness of the SCHEDULE is
+verifiable on a 1-core host (the tasks execute in a different order
+than serial even with one thread); true-concurrency races need a
+multicore host, which is why the identity is pinned for 1/2/4 threads.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from slate_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime unavailable")
+
+
+def _restore_env(prev):
+    if prev is None:
+        os.environ.pop("SLATE_TPU_CHASE_SERIAL", None)
+    else:
+        os.environ["SLATE_TPU_CHASE_SERIAL"] = prev
+
+
+def _band_wide(n, kd, seed):
+    rng = np.random.default_rng(seed)
+    abw = np.zeros((n, 2 * kd + 2), dtype=np.float64)
+    for d in range(kd + 1):
+        abw[:n - d, d] = rng.standard_normal(n - d)
+    return abw
+
+
+def _tb_band(n, kd, seed):
+    rng = np.random.default_rng(seed)
+    ldw = 3 * kd + 2
+    st = np.zeros((n, ldw), dtype=np.float64)
+    for r in range(n):
+        for c in range(r, min(r + kd + 1, n)):
+            st[r, c - r + kd] = rng.standard_normal()
+    return st
+
+
+@pytest.mark.parametrize("nthreads", [1, 2, 4])
+def test_hb2st_wavefront_bitwise_identity(nthreads):
+    n, kd = 2048, 64
+    ab_ser = _band_wide(n, kd, 0)
+    ab_par = ab_ser.copy()
+
+    prev = os.environ.get("SLATE_TPU_CHASE_SERIAL")
+    os.environ["SLATE_TPU_CHASE_SERIAL"] = "1"
+    try:
+        vs, ts, rs, ls = native.hb2st_hh_banded(ab_ser, n, kd)
+    finally:
+        _restore_env(prev)
+
+    native.set_num_threads(nthreads)
+    try:
+        vp, tp, rp, lp = native.hb2st_hh_banded(ab_par, n, kd)
+    finally:
+        native.set_num_threads(1)
+
+    np.testing.assert_array_equal(ab_par, ab_ser)
+    np.testing.assert_array_equal(vp, vs)
+    np.testing.assert_array_equal(tp, ts)
+    np.testing.assert_array_equal(rp, rs)
+    np.testing.assert_array_equal(lp, ls)
+
+
+def test_hb2st_wavefront_range_identity():
+    """The checkpointed sweep-range path uses the wavefront too."""
+    n, kd = 512, 32
+    ab_ser = _band_wide(n, kd, 1)
+    ab_par = ab_ser.copy()
+    chunks = [(0, 100), (100, 317), (317, n - 2)]
+
+    prev = os.environ.get("SLATE_TPU_CHASE_SERIAL")
+    os.environ["SLATE_TPU_CHASE_SERIAL"] = "1"
+    try:
+        ser = [native.hb2st_hh_banded_range(ab_ser, n, kd, j0, j1)
+               for j0, j1 in chunks]
+    finally:
+        _restore_env(prev)
+    native.set_num_threads(2)
+    try:
+        par = [native.hb2st_hh_banded_range(ab_par, n, kd, j0, j1)
+               for j0, j1 in chunks]
+    finally:
+        native.set_num_threads(1)
+    np.testing.assert_array_equal(ab_par, ab_ser)
+    for s, p in zip(ser, par):
+        for a, b in zip(s, p):
+            np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("nthreads", [1, 2, 4])
+def test_tb2bd_wavefront_bitwise_identity(nthreads):
+    n, kd = 1024, 48
+    st_ser = _tb_band(n, kd, 2)
+    st_par = st_ser.copy()
+
+    prev = os.environ.get("SLATE_TPU_CHASE_SERIAL")
+    os.environ["SLATE_TPU_CHASE_SERIAL"] = "1"
+    try:
+        ser = native.tb2bd_hh_banded(st_ser, n, kd)
+    finally:
+        _restore_env(prev)
+    native.set_num_threads(nthreads)
+    try:
+        par = native.tb2bd_hh_banded(st_par, n, kd)
+    finally:
+        native.set_num_threads(1)
+
+    np.testing.assert_array_equal(st_par, st_ser)
+    for log_s, log_p in zip(ser, par):
+        for a, b in zip(log_s, log_p):
+            np.testing.assert_array_equal(a, b)

@@ -353,6 +353,7 @@ void Cblacs_get(int ctxt, int what, int* val) {
 }
 
 void Cblacs_gridinit(int* ctxt, const char* order, int p, int q) {
+    if (p <= 0 || q <= 0 || p * q > SLATE_MAX_RANKS) { *ctxt = -1; return; }
     for (int i = 0; i < SLATE_MAX_CTXT; ++i) {
         if (!g_ctx[i].used) {
             g_ctx[i].used = 1; g_ctx[i].p = p; g_ctx[i].q = q;
@@ -493,6 +494,7 @@ struct pending_s {
     int tag;                       /* routine id, 0 = slot free */
     int ctxt;
     int nreg;                      /* registrations so far (rank order) */
+    int poisoned;                  /* sig mismatch seen: drain, never compute */
     call_sig sig;
     void* bufs[3][SLATE_MAX_RANKS];    /* A / B / C local buffers */
     int   llds[3][SLATE_MAX_RANKS];
@@ -511,13 +513,23 @@ static pending_t* pend_get(int tag, int ctxt, const call_sig* sig,
                            int* info) {
     for (int i = 0; i < 16; ++i)
         if (g_pend[i].tag == tag && g_pend[i].ctxt == ctxt) {
-            if (sig && memcmp(&g_pend[i].sig, sig, sizeof(call_sig))) {
-                /* interleaved/mismatched collective: refuse loudly */
-                g_pend[i].tag = 0;
+            pending_t* pe = &g_pend[i];
+            int bad = pe->poisoned
+                || (sig && memcmp(&pe->sig, sig, sizeof(call_sig)));
+            if (bad) {
+                /* interleaved/mismatched collective: poison the slot
+                 * and DRAIN the remaining registrations — freeing it
+                 * here would let the leftover ranks re-form a slot
+                 * with shifted rank indexing and complete a later
+                 * same-signature call with garbage */
+                blacs_ctx* c = ctx_of(ctxt);
+                pe->poisoned = 1;
+                pe->nreg += 1;
+                if (!c || pe->nreg >= c->p * c->q) pe->tag = 0;
                 if (info) *info = -904;
                 return 0;
             }
-            return &g_pend[i];
+            return pe;
         }
     for (int i = 0; i < 16; ++i)
         if (g_pend[i].tag == 0) {
