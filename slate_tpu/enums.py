@@ -144,6 +144,9 @@ class Option(enum.Enum):
     #: route pheev's tridiagonal stage through the distributed D&C
     #: (parallel.dist_stedc.pstedc) — default on for n >= 2048
     StedcDist = "stedc_dist"
+    #: route psvd's bidiagonal stage through the checkpointed tb2bd +
+    #: Golub–Kahan pstedc middle — default on for n >= 2048
+    SvdDist = "svd_dist"
 
 
 class MethodGemm(enum.Enum):

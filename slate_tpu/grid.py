@@ -97,6 +97,37 @@ def cyclic_permutation(nt: int, q: int) -> np.ndarray:
     return perm
 
 
+def map_permutation(nt: int, p: int, block_map) -> np.ndarray:
+    """Storage permutation for a USER tile map (reference ``tileRank``
+    lambda, ``BaseMatrix.hh:765-771``, separable per axis): ``block_map``
+    takes a global block index in ``[0, nt)`` and returns its owning
+    mesh coordinate in ``[0, p)``.  Storage groups blocks by owner (in
+    ascending global order within each owner), so a plain blocked
+    NamedSharding realises the map — the same trick
+    :func:`cyclic_permutation` plays for the block-cyclic default.
+
+    Every owner must receive exactly ``nt // p`` blocks (the padded
+    block count is a multiple of p; maps that unbalance raise).
+    """
+
+    groups = [[] for _ in range(p)]
+    for i in range(nt):
+        r = int(block_map(i))
+        if not (0 <= r < p):
+            raise ValueError(f"tile map sent block {i} to {r} "
+                             f"outside [0, {p})")
+        groups[r].append(i)
+    want = nt // p
+    for r, g in enumerate(groups):
+        if len(g) != want:
+            raise ValueError(
+                f"tile map unbalanced: mesh coord {r} owns {len(g)} of "
+                f"{nt} blocks, need exactly {want}; pad or rebalance "
+                f"the map (the reference's block-cyclic maps satisfy "
+                f"this after padding)")
+    return np.asarray([i for g in groups for i in g], dtype=np.int64)
+
+
 def inverse_permutation(perm: np.ndarray) -> np.ndarray:
     inv = np.empty_like(perm)
     inv[perm] = np.arange(len(perm))

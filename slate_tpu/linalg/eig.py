@@ -343,6 +343,9 @@ def unmtr_hb2st_hh(v3, t2, s0, z, kd: int):
     z = jnp.asarray(z)
     if v3.shape[0] == 0:
         return z
+    # complex reflectors (the zhbtrd-style chase) promote a real Z
+    zdt = jnp.promote_types(z.dtype, v3.dtype)
+    z = z.astype(zdt)
     nsweeps, tmax, _ = v3.shape
     n, ncols = z.shape
     win = tmax * kd
@@ -353,7 +356,7 @@ def unmtr_hb2st_hh(v3, t2, s0, z, kd: int):
         zw = _lax.dynamic_slice(zc, (start, jnp.zeros((), start.dtype)),
                                 (win, ncols))
         zw = zw.reshape(tmax, kd, ncols)
-        u = jnp.einsum("tk,tkc->tc", vj, zw,
+        u = jnp.einsum("tk,tkc->tc", jnp.conj(vj), zw,
                        precision=_lax.Precision.HIGHEST)
         zw = zw - vj[:, :, None] * (tj[:, None] * u)[:, None, :]
         zc = _lax.dynamic_update_slice(zc, zw.reshape(win, ncols),

@@ -416,11 +416,14 @@ def _stage3_svd(d, e, rots, want_u, want_vt, method, auto):
     return s, u_b, vh_b
 
 
-def _bd_sweep_counts(n, kd):
+def _bd_sweep_counts(n, kd, s0: int = 0, s1=None):
     """Per-sweep reflector counts of the bidiagonal Householder chase
-    (mirrors ``native.bd_step_count``'s window logic per sweep)."""
+    for sweeps ``[s0, s1)`` (mirrors ``native.bd_step_count``'s window
+    logic per sweep; the range serves the checkpointed log packer)."""
+    if s1 is None:
+        s1 = max(n - 1, 0)
     counts = []
-    for s in range(max(n - 1, 0)):
+    for s in range(s0, min(s1, max(n - 1, 0))):
         hi = min(s + kd, n - 1)
         if hi <= s + 1:
             continue

@@ -132,8 +132,14 @@ def _load():
         lib.slate_hb2st_hh_range_f64.restype = i64
         lib.slate_hb2st_hh_range_f64.argtypes = [p, i64, i64, i64,
                                                  p, p, p, p, i64, i64]
+        lib.slate_hb2st_hh_range_c128.restype = i64
+        lib.slate_hb2st_hh_range_c128.argtypes = [p, i64, i64, i64,
+                                                  p, p, p, p, i64, i64]
         lib.slate_tb2bd_hh_f64.restype = i64
         lib.slate_tb2bd_hh_f64.argtypes = [p, i64, i64, i64] + [p] * 8
+        lib.slate_tb2bd_hh_range_f64.restype = i64
+        lib.slate_tb2bd_hh_range_f64.argtypes = \
+            [p, i64, i64, i64] + [p] * 8 + [i64, i64]
         for name in ("slate_tb2bd_f64", "slate_tb2bd_c128"):
             fn = getattr(lib, name)
             fn.restype = i64
@@ -460,23 +466,28 @@ def hb2st_hh_banded_range(abw: np.ndarray, n: int, kd: int,
     if lib is None:
         raise RuntimeError(f"native runtime unavailable: {_build_error}")
     assert abw.shape == (n, 2 * kd + 2) and abw.flags.c_contiguous
-    assert abw.dtype == np.float64
+    assert abw.dtype in (np.float64, np.complex128)
     cap = hh_step_count(n, kd, j0, j1)
-    v = np.zeros((cap, kd), dtype=np.float64)
-    tau = np.zeros(cap, dtype=np.float64)
+    v = np.zeros((cap, kd), dtype=abw.dtype)
+    tau = np.zeros(cap, dtype=abw.dtype)
     row0 = np.zeros(cap, dtype=np.int32)
     length = np.zeros(cap, dtype=np.int32)
-    nstep = lib.slate_hb2st_hh_range_f64(
+    fn = (lib.slate_hb2st_hh_range_c128 if abw.dtype == np.complex128
+          else lib.slate_hb2st_hh_range_f64)
+    nstep = fn(
         _c_ptr(abw), n, kd, 2 * kd + 2, _c_ptr(v), _c_ptr(tau),
         _c_ptr(row0), _c_ptr(length), j0, j1)
     assert nstep == cap, (nstep, cap)
     return v, tau, row0, length
 
 
-def bd_step_count(n: int, kd: int) -> int:
-    """Reflector count per log of the bidiagonal Householder chase."""
+def bd_step_count(n: int, kd: int, s0: int = 0, s1=None) -> int:
+    """Reflector count per log of the bidiagonal Householder chase
+    (sweeps ``[s0, s1)``)."""
+    if s1 is None:
+        s1 = max(n - 1, 0)
     total = 0
-    for s in range(max(n - 1, 0)):
+    for s in range(s0, min(s1, max(n - 1, 0))):
         c_hi = min(s + kd, n - 1)
         r_hi = min(s + kd, n - 1)
         if c_hi <= s + 1 and r_hi <= s + 1:
@@ -511,6 +522,31 @@ def tb2bd_hh_banded(st: np.ndarray, n: int, kd: int):
         _c_ptr(st), n, kd, 3 * kd + 2, _c_ptr(uv), _c_ptr(utau),
         _c_ptr(urow0), _c_ptr(ulen), _c_ptr(vv), _c_ptr(vtau),
         _c_ptr(vrow0), _c_ptr(vlen))
+    assert nstep == cap, (nstep, cap)
+    return (uv, utau, urow0, ulen), (vv, vtau, vrow0, vlen)
+
+
+def tb2bd_hh_banded_range(st: np.ndarray, n: int, kd: int,
+                          s0: int, s1: int):
+    """Sweeps ``[s0, s1)`` of :func:`tb2bd_hh_banded` — the band is the
+    complete state between calls, so a caller can checkpoint it and
+    regenerate any chunk's two reflector logs later (psvd's streaming
+    middle; mirror of :func:`hb2st_hh_banded_range`)."""
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    assert st.shape == (n, 3 * kd + 2) and st.flags.c_contiguous
+    assert st.dtype == np.float64
+    cap = bd_step_count(n, kd, s0, s1)
+    mk = lambda: (np.zeros((cap, kd)), np.zeros(cap),
+                  np.zeros(cap, np.int32), np.zeros(cap, np.int32))
+    uv, utau, urow0, ulen = mk()
+    vv, vtau, vrow0, vlen = mk()
+    nstep = lib.slate_tb2bd_hh_range_f64(
+        _c_ptr(st), n, kd, 3 * kd + 2, _c_ptr(uv), _c_ptr(utau),
+        _c_ptr(urow0), _c_ptr(ulen), _c_ptr(vv), _c_ptr(vtau),
+        _c_ptr(vrow0), _c_ptr(vlen), s0, s1)
     assert nstep == cap, (nstep, cap)
     return (uv, utau, urow0, ulen), (vv, vtau, vrow0, vlen)
 

@@ -33,3 +33,41 @@ from .dist_qr import pgelqf, punmlq  # noqa: F401
 from .dist_band import (pgbsv, ppbsv, pgbmm, phbmm, ptbsm  # noqa: F401
                         )
 from .dist_hesv import phetrf, phetrs, phesv  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# User-tile-map ingestion: wrap every public driver so a DistMatrix
+# distributed with custom row_map/col_map re-grids to the canonical
+# block-cyclic layout on entry (see dist.canonical_args).  Rebinding in
+# the defining modules keeps direct submodule imports covered too.
+# ---------------------------------------------------------------------------
+from . import (dist_aux as _m_aux, dist_band as _m_band,  # noqa: E402
+               dist_blas3 as _m_blas3, dist_factor as _m_factor,
+               dist_hesv as _m_hesv, dist_lu as _m_lu, dist_qr as _m_qr,
+               dist_twostage as _m_two, dist_util as _m_util)
+from .dist import canonical_args as _canonical_args  # noqa: E402
+
+_DRIVER_NAMES = {
+    _m_blas3: ["pgemm", "pgemm_a"],
+    _m_factor: ["ppotrf", "ppotrs", "pposv", "pposv_mixed",
+                "pposv_mixed_gmres"],
+    _m_lu: ["pgetrf", "pgetrs", "pgesv", "pgesv_mixed", "pgetri",
+            "pgecondest"],
+    _m_qr: ["pgeqrf", "pgels", "pgelqf", "punmqr_conj", "punmlq"],
+    _m_aux: ["pcolnorms", "phemm", "pher2k", "pherk", "pnorm", "psymm",
+             "psyr2k", "psyrk", "ptri_mask", "ptrmm", "ptrsm"],
+    _m_band: ["pgbsv", "ppbsv", "pgbmm", "phbmm", "ptbsm", "ppbtrf",
+              "pgbtrf"],
+    _m_hesv: ["phetrf", "phetrs", "phesv"],
+    _m_two: ["phe2hb", "pge2tb", "pheev", "psvd", "punmbr_ge2tb_p",
+             "punmbr_ge2tb_q", "punmtr_he2hb"],
+    _m_util: ["predistribute", "ptranspose", "phermitize"],
+}
+for _mod, _names in _DRIVER_NAMES.items():
+    for _nm in _names:
+        _f = getattr(_mod, _nm)
+        if not hasattr(_f, "__wrapped_driver__"):
+            _wrapped = _canonical_args(_f)
+            setattr(_mod, _nm, _wrapped)
+            if _nm in globals():
+                globals()[_nm] = _wrapped
+del _mod, _names, _nm, _f

@@ -62,6 +62,12 @@ class DistMatrix:
     nb: int
     mesh: jax.sharding.Mesh
     mb: Optional[int] = None
+    #: user tile maps (reference ``tileRank`` lambda, separable per
+    #: axis): block-row index → mesh row / block-col index → mesh col.
+    #: None means the block-cyclic default.  Drivers canonicalize to
+    #: cyclic via :func:`canonicalize` (one sharded re-shuffle).
+    row_map: Optional[object] = None
+    col_map: Optional[object] = None
 
     @property
     def row_nb(self) -> int:
@@ -98,10 +104,18 @@ def padded_tiles(m: int, nb: int, p: int) -> int:
     return ceildiv(mt, p) * p
 
 
+def _storage_perm(ntp: int, p: int, block_map) -> np.ndarray:
+    from ..grid import map_permutation
+    if block_map is None:
+        return cyclic_permutation(ntp, p)
+    return map_permutation(ntp, p, block_map)
+
+
 def distribute(a, mesh: jax.sharding.Mesh, nb: int = 256,
                diag_pad: float = 0.0, row_mult: Optional[int] = None,
                col_mult: Optional[int] = None,
-               mb: Optional[int] = None) -> DistMatrix:
+               mb: Optional[int] = None,
+               row_map=None, col_map=None) -> DistMatrix:
     """Scatter a dense (m, n) array block-cyclically over ``mesh``.
 
     Analog of ``Matrix::fromLAPACK`` + ``redistribute`` (``Matrix.hh:290``,
@@ -124,10 +138,11 @@ def distribute(a, mesh: jax.sharding.Mesh, nb: int = 256,
         k = min(mp - m, np_ - n)
         pad = pad.at[m:m + k, n:n + k].set(
             diag_pad * jnp.eye(k, dtype=a.dtype))
-    pad = _permute_blocks(pad, cyclic_permutation(mtp, p), 0, rb)
-    pad = _permute_blocks(pad, cyclic_permutation(ntp, q), 1, nb)
+    pad = _permute_blocks(pad, _storage_perm(mtp, p, row_map), 0, rb)
+    pad = _permute_blocks(pad, _storage_perm(ntp, q, col_map), 1, nb)
     sharding = NamedSharding(mesh, P(AXIS_P, AXIS_Q))
-    return DistMatrix(jax.device_put(pad, sharding), m, n, nb, mesh, mb=mb)
+    return DistMatrix(jax.device_put(pad, sharding), m, n, nb, mesh,
+                      mb=mb, row_map=row_map, col_map=col_map)
 
 
 def undistribute(dm: DistMatrix) -> jax.Array:
@@ -136,12 +151,64 @@ def undistribute(dm: DistMatrix) -> jax.Array:
 
     p, q = dm.grid_shape
     a = dm.data
-    a = _permute_blocks(a, inverse_permutation(cyclic_permutation(dm.mtp, p)), 0, dm.row_nb)
-    a = _permute_blocks(a, inverse_permutation(cyclic_permutation(dm.ntp, q)), 1, dm.nb)
+    a = _permute_blocks(a, inverse_permutation(
+        _storage_perm(dm.mtp, p, dm.row_map)), 0, dm.row_nb)
+    a = _permute_blocks(a, inverse_permutation(
+        _storage_perm(dm.ntp, q, dm.col_map)), 1, dm.nb)
     return a[:dm.m, :dm.n]
+
+
+def canonicalize(dm: DistMatrix) -> DistMatrix:
+    """Re-grid a user-mapped DistMatrix into the canonical block-cyclic
+    layout (the layout every distributed driver's affine local↔global
+    index math assumes) — ONE sharded block permutation per axis, the
+    analog of the reference calling ``redistribute`` before a driver
+    whose layout assumptions a custom ``tileRank`` breaks."""
+
+    if dm.row_map is None and dm.col_map is None:
+        return dm
+    p, q = dm.grid_shape
+    rperm = jnp.asarray(inverse_permutation(
+        _storage_perm(dm.mtp, p, dm.row_map))[cyclic_permutation(dm.mtp, p)])
+    cperm = jnp.asarray(inverse_permutation(
+        _storage_perm(dm.ntp, q, dm.col_map))[cyclic_permutation(dm.ntp, q)])
+    sharding = NamedSharding(dm.mesh, P(AXIS_P, AXIS_Q))
+    from functools import partial as _partial
+
+    @_partial(jax.jit, out_shardings=sharding)
+    def reshuffle(x):
+        x = _permute_blocks(x, rperm, 0, dm.row_nb)
+        return _permute_blocks(x, cperm, 1, dm.nb)
+
+    return DistMatrix(reshuffle(dm.data), dm.m, dm.n, dm.nb, dm.mesh,
+                      mb=dm.mb)
+
+
+def canonical_args(fn):
+    """Driver-ingestion wrapper: re-grid every user-tile-mapped
+    DistMatrix operand to the canonical block-cyclic layout before the
+    driver's affine local↔global index math sees it (the reference's
+    redistribute-before-driver practice for layouts a custom
+    ``tileRank`` breaks).  Applied to every public ``p*`` driver at
+    package import (``parallel/__init__.py``); a no-op for canonical
+    operands."""
+
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        args = tuple(canonicalize(x) if isinstance(x, DistMatrix) else x
+                     for x in args)
+        kwargs = {k: (canonicalize(v) if isinstance(v, DistMatrix) else v)
+                  for k, v in kwargs.items()}
+        return fn(*args, **kwargs)
+
+    wrapper.__wrapped_driver__ = fn
+    return wrapper
 
 
 def like(dm: DistMatrix, data: jax.Array, m: Optional[int] = None,
          n: Optional[int] = None) -> DistMatrix:
     return DistMatrix(data, dm.m if m is None else m,
-                      dm.n if n is None else n, dm.nb, dm.mesh, mb=dm.mb)
+                      dm.n if n is None else n, dm.nb, dm.mesh, mb=dm.mb,
+                      row_map=dm.row_map, col_map=dm.col_map)

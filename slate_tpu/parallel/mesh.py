@@ -21,11 +21,18 @@ AXIS_Q = "q"
 
 
 def make_grid_mesh(p: Optional[int] = None, q: Optional[int] = None,
-                   devices=None) -> jax.sharding.Mesh:
+                   devices=None,
+                   grid_order: str = "row") -> jax.sharding.Mesh:
     """Build a p×q mesh over ``devices`` (default: all available).
 
     Analog of ``Cblacs_gridinit``; defaults to the squarest factorisation
-    like the reference tester's grid setup.
+    like the reference tester's grid setup.  ``grid_order`` assigns the
+    flat device list to grid coordinates row-major ("row", BLACS 'R',
+    the default) or column-major ("col", BLACS 'C') — the reference's
+    ``GridOrder`` (``enums.hh:127``).  Every distributed driver indexes
+    the mesh by named axes, so both orders run the same SPMD programs;
+    the order only fixes which physical device owns which coordinate
+    (on real hardware: how grid traffic maps onto ICI rings).
     """
 
     devices = np.asarray(devices if devices is not None else jax.devices())
@@ -38,7 +45,12 @@ def make_grid_mesh(p: Optional[int] = None, q: Optional[int] = None,
         q = n // p
     if p * q != n:
         raise ValueError(f"grid {p}x{q} does not match {n} devices")
-    return jax.sharding.Mesh(devices.reshape(p, q), (AXIS_P, AXIS_Q))
+    if grid_order not in ("row", "col"):
+        raise ValueError(f"grid_order must be 'row' or 'col', "
+                         f"got {grid_order!r}")
+    grid = (devices.reshape(p, q) if grid_order == "row"
+            else devices.reshape(q, p).T)
+    return jax.sharding.Mesh(grid, (AXIS_P, AXIS_Q))
 
 
 def default_mesh() -> jax.sharding.Mesh:

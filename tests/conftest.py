@@ -63,6 +63,8 @@ _SLOW_TESTS = frozenset({
     "tests/test_dist_gaps.py::test_phesv_n1024[mesh11]",
     "tests/test_dist_gaps.py::test_phesv_n1024[mesh24]",
     "tests/test_dist_twostage.py::TestDistStedc::test_dist_band_eig_no_replicated_host_array",
+    "tests/test_dist_twostage.py::TestDistStedc::test_dist_band_svd_no_replicated_host_array",
+    "tests/test_dist_twostage.py::TestDistStedc::test_dist_band_eig_complex_no_replicated_host_array",
     "tests/test_dist_twostage.py::TestDistStedc::test_pheev_dist_stedc_numerics",
     "tests/test_dist_twostage.py::TestDistStedc::test_pstedc_clustered_deflation",
     "tests/test_dist_twostage.py::TestDistStedc::test_pstedc_matches_scipy",

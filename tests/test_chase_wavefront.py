@@ -60,11 +60,12 @@ def test_hb2st_wavefront_bitwise_identity(nthreads):
     finally:
         _restore_env(prev)
 
+    prev_thr = native.num_threads()
     native.set_num_threads(nthreads)
     try:
         vp, tp, rp, lp = native.hb2st_hh_banded(ab_par, n, kd)
     finally:
-        native.set_num_threads(1)
+        native.set_num_threads(prev_thr)
 
     np.testing.assert_array_equal(ab_par, ab_ser)
     np.testing.assert_array_equal(vp, vs)
@@ -87,12 +88,13 @@ def test_hb2st_wavefront_range_identity():
                for j0, j1 in chunks]
     finally:
         _restore_env(prev)
+    prev_thr = native.num_threads()
     native.set_num_threads(2)
     try:
         par = [native.hb2st_hh_banded_range(ab_par, n, kd, j0, j1)
                for j0, j1 in chunks]
     finally:
-        native.set_num_threads(1)
+        native.set_num_threads(prev_thr)
     np.testing.assert_array_equal(ab_par, ab_ser)
     for s, p in zip(ser, par):
         for a, b in zip(s, p):
@@ -111,11 +113,12 @@ def test_tb2bd_wavefront_bitwise_identity(nthreads):
         ser = native.tb2bd_hh_banded(st_ser, n, kd)
     finally:
         _restore_env(prev)
+    prev_thr = native.num_threads()
     native.set_num_threads(nthreads)
     try:
         par = native.tb2bd_hh_banded(st_par, n, kd)
     finally:
-        native.set_num_threads(1)
+        native.set_num_threads(prev_thr)
 
     np.testing.assert_array_equal(st_par, st_ser)
     for log_s, log_p in zip(ser, par):

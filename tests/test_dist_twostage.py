@@ -153,6 +153,28 @@ def test_psvd_square_odd(mesh24):
     assert np.linalg.norm(a - rec) / np.linalg.norm(a) < 1e-10
 
 
+def test_psvd_dist_middle_numerics(mesh24):
+    """The scale-safe middle (checkpointed tb2bd + Golub–Kahan pstedc +
+    sharded WY back-transforms) must reproduce the SVD at small n when
+    forced on (``svd_dist``)."""
+    native = pytest.importorskip("slate_tpu.native")
+    if not native.available():
+        pytest.skip(native.build_error())
+    m, n, nb = 128, 96, 16
+    rng = np.random.default_rng(23)
+    a = rng.standard_normal((m, n))
+    s, ud, vd = psvd(a, mesh24, nb, opts={"svd_dist": True})
+    s = np.asarray(s)
+    u = np.asarray(undistribute(ud))
+    v = np.asarray(undistribute(vd))
+    assert np.allclose(s, np.linalg.svd(a, compute_uv=False),
+                       atol=1e-9 * s[0])
+    rec = u[:, :n] @ np.diag(s) @ v.conj().T
+    assert np.linalg.norm(a - rec) / np.linalg.norm(a) < 1e-10
+    assert np.linalg.norm(u[:, :n].conj().T @ u[:, :n] - np.eye(n)) < 1e-9
+    assert np.linalg.norm(v.conj().T @ v - np.eye(n)) < 1e-9
+
+
 class TestDistStedc:
     def test_pstedc_matches_scipy(self, mesh8):
         from slate_tpu.parallel.dist_stedc import pstedc
@@ -213,6 +235,55 @@ class TestDistStedc:
         orth = np.linalg.norm(zg.T @ zg - np.eye(n)) / (n * eps)
         assert res < 50 and orth < 50, (res, orth)
 
+    def test_pheev_dist_stedc_complex(self, mesh24):
+        """Complex-Hermitian input through the scale-safe middle: the
+        zhbtrd-style c128 chase + phase fold + real pstedc + complex WY
+        back-transform (VERDICT r4 Next #6b) must match eigh at small n
+        when forced on."""
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        n, nb = 192, 16
+        a = _rand_herm(n, np.complex128, seed=31)
+        w, zd = pheev(a, mesh24, nb, opts={"stedc_dist": True})
+        z = np.asarray(undistribute(zd))[:n, :n]
+        w = np.asarray(w)
+        assert np.allclose(w, np.linalg.eigvalsh(a),
+                           atol=1e-9 * max(1.0, np.abs(w).max()))
+        eps = np.finfo(np.float64).eps
+        res = (np.linalg.norm(a @ z - z * w[None, :])
+               / (np.linalg.norm(a) * n * eps))
+        orth = np.linalg.norm(z.conj().T @ z - np.eye(n)) / (n * eps)
+        assert res < 50 and orth < 50, (res, orth)
+
+    def test_dist_band_eig_complex_band(self, mesh8):
+        """dist_band_eig on a complex Hermitian band: residual +
+        orthogonality + unitarity of the sharded Q."""
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from slate_tpu.parallel.dist_twostage import dist_band_eig
+        n, kd = 384, 12
+        rng = np.random.default_rng(11)
+        ab = np.zeros((n, kd + 2), dtype=np.complex128)
+        ab[:, 0] = rng.standard_normal(n)          # real diagonal
+        for dd in range(1, kd + 1):
+            ab[:n - dd, dd] = (rng.standard_normal(n - dd)
+                               + 1j * rng.standard_normal(n - dd)) / (1 + dd)
+        w, q_dev = dist_band_eig(ab, kd, mesh8)
+        dense = np.zeros((n, n), dtype=np.complex128)
+        idx = np.arange(n)
+        for dd in range(kd + 1):
+            dense[idx[:n - dd] + dd, idx[:n - dd]] = ab[:n - dd, dd]
+        dense = dense + np.tril(dense, -1).conj().T
+        q = np.asarray(q_dev)
+        w = np.asarray(w)
+        eps = np.finfo(np.float64).eps
+        res = (np.linalg.norm(dense @ q - q * w[None, :])
+               / (max(np.linalg.norm(dense), 1) * n * eps))
+        orth = np.linalg.norm(q.conj().T @ q - np.eye(n)) / (n * eps)
+        assert res < 50 and orth < 50, (res, orth)
+
     def test_dist_band_eig_no_replicated_host_array(self, mesh8):
         """The distributed middle section (checkpointed chase + mesh
         stedc + device WY back-transform) must never hold an O(n²) host
@@ -256,3 +327,84 @@ class TestDistStedc:
                / (max(np.linalg.norm(dense), 1) * n * eps))
         orth = np.linalg.norm(q.T @ q - np.eye(n)) / (n * eps)
         assert res < 50 and orth < 50, (res, orth)
+
+    def test_dist_band_eig_complex_no_replicated_host_array(self, mesh8):
+        """Complex-Hermitian band through the scale-safe middle under
+        the tracemalloc gate (VERDICT r4 Next #6b done-criterion): the
+        c128 chase + phase fold + pstedc + complex WY applies must keep
+        host memory O(n·kd), never O(n²)."""
+        import tracemalloc
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from slate_tpu.parallel.dist_twostage import dist_band_eig
+        n, kd = 2048, 48
+        rng = np.random.default_rng(12)
+        ab = np.zeros((n, kd + 2), dtype=np.complex128)
+        ab[:, 0] = rng.standard_normal(n)
+        for dd in range(1, kd + 1):
+            ab[:n - dd, dd] = (rng.standard_normal(n - dd)
+                               + 1j * rng.standard_normal(n - dd)) / (1 + dd)
+        tracemalloc.start()
+        w, q_dev = dist_band_eig(ab, kd, mesh8)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # complex beats the f64 gate class by 2× element size: the
+        # replicated alternative holds ≥ 2·n² c128 (32·n² bytes); gate
+        # at 1.6·n²·8 bytes = 0.1× that, generous for the O(n·kd)
+        # snapshot/log constants at this small n
+        assert peak < 1.6 * n * n * 8, \
+            f"host peak {peak/1e6:.0f} MB suggests a replicated n^2 array"
+        dense = np.zeros((n, n), dtype=np.complex128)
+        idx = np.arange(n)
+        for dd in range(kd + 1):
+            dense[idx[:n - dd] + dd, idx[:n - dd]] = ab[:n - dd, dd]
+        dense = dense + np.tril(dense, -1).conj().T
+        q = np.asarray(q_dev)
+        w = np.asarray(w)
+        eps = np.finfo(np.float64).eps
+        res = (np.linalg.norm(dense @ q - q * w[None, :])
+               / (max(np.linalg.norm(dense), 1) * n * eps))
+        orth = np.linalg.norm(q.conj().T @ q - np.eye(n)) / (n * eps)
+        assert res < 50 and orth < 50, (res, orth)
+
+    def test_dist_band_svd_no_replicated_host_array(self, mesh8):
+        """psvd's scale-safe middle under the same tracemalloc gate as
+        the eig path: checkpointed tb2bd + Golub–Kahan pstedc + device
+        WY applies must never hold an O(n²) host array (VERDICT r4
+        Next #6 done-criterion)."""
+        import tracemalloc
+        native = pytest.importorskip("slate_tpu.native")
+        if not native.available():
+            pytest.skip(native.build_error())
+        from slate_tpu.parallel.dist_svd import dist_band_svd
+        n, kd = 4096, 64
+        rng = np.random.default_rng(8)
+        # random upper-band storage ab[c, d+1] = A[c-d, c]
+        ab = np.zeros((n, kd + 3))
+        for dd in range(kd + 1):
+            ab[dd:, dd + 1] = rng.standard_normal(n - dd) / (1 + dd)
+        tracemalloc.start()
+        s, u_dev, v_dev = dist_band_svd(ab, kd, mesh8, True, True)
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        # the GK solve runs pstedc at 2n, so the host-control constants
+        # are ~2× the eig path's; the replicated alternative is ≥ 3·n²
+        # (u_b + vh_b + LAPACK bdsdc workspace = 3·n²+ here).  Gate at
+        # 1.2·n² doubles.
+        assert peak < 1.2 * n * n * 8, \
+            f"host peak {peak/1e6:.0f} MB suggests a replicated n^2 array"
+        dense = np.zeros((n, n))
+        idx = np.arange(n)
+        for dd in range(kd + 1):
+            dense[idx[:n - dd], idx[:n - dd] + dd] = ab[dd:, dd + 1]
+        u = np.asarray(u_dev)
+        v = np.asarray(v_dev)
+        s = np.asarray(s)
+        eps = np.finfo(np.float64).eps
+        res = (np.linalg.norm(dense - (u * s[None, :]) @ v.T)
+               / (max(np.linalg.norm(dense), 1) * n * eps))
+        orth_u = np.linalg.norm(u.T @ u - np.eye(n)) / (n * eps)
+        orth_v = np.linalg.norm(v.T @ v - np.eye(n)) / (n * eps)
+        assert res < 50 and orth_u < 50 and orth_v < 50, \
+            (res, orth_u, orth_v)

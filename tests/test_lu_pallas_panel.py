@@ -1,0 +1,79 @@
+"""The r5 Pallas LU panel leaf (getrf_panel_linv) and the inverse-based
+u12 composition, exercised in interpret mode on CPU so the TPU default
+path has CI parity coverage (review finding: zero coverage otherwise)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from slate_tpu.ops.pallas_kernels import getrf_panel_linv
+from slate_tpu.linalg.lu import getrf_rec, _panel_lu_pallas
+
+
+def test_panel_linv_kernel_interpret():
+    """Kernel contract on CPU interpret: a[perm]=L·U, exact one-hot
+    pivots, linv inverts the unit-lower pivot block."""
+    rng = np.random.default_rng(0)
+    bb, m = 64, 256
+    slab = rng.standard_normal((bb, m)).astype(np.float32)
+    act = np.ones((1, m), np.float32)
+    out, piv, act_out, linv = jax.jit(
+        lambda s, a: getrf_panel_linv(s, a, ib=32))(
+        jnp.asarray(slab), jnp.asarray(act))
+    out, piv, act_out, linv = map(np.asarray, (out, piv, act_out, linv))
+    assert len(set(piv.tolist())) == bb, "pivots must be distinct"
+    rem = np.argsort(act_out[0] < 0.5, kind="stable")[: m - bb]
+    perm = np.concatenate([piv, rem])
+    lu = out[:, perm].T                      # (m, bb) packed
+    L = np.tril(lu, -1) + np.vstack([np.eye(bb, dtype=np.float32),
+                                     np.zeros((m - bb, bb), np.float32)])
+    U = np.triu(lu[:bb])
+    a_np = slab.T
+    res = np.linalg.norm(L @ U - a_np[perm]) / (
+        np.linalg.norm(a_np) * np.finfo(np.float32).eps * m)
+    assert res < 60, res
+    l11 = np.tril(lu[:bb], -1) + np.eye(bb, dtype=np.float32)
+    assert np.linalg.norm(l11 @ linv - np.eye(bb)) < 1e-3
+    # pivots are true partial pivots: each pivot is the max |.| of the
+    # updated column over the still-active rows (check column 0 exactly)
+    assert piv[0] == np.argmax(np.abs(slab[0]))
+
+
+def test_panel_lu_pallas_wrapper_interpret(monkeypatch):
+    """The lu.py wrapper (pad-to-bucket + perm assembly + linv) matches
+    scipy on CPU interpret mode."""
+    import scipy.linalg as sla
+    rng = np.random.default_rng(1)
+    m, w = 200, 64                            # forces padding to 512
+    a_np = rng.standard_normal((m, w)).astype(np.float32)
+    lu, perm, linv = _panel_lu_pallas(jnp.asarray(a_np))
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    assert sorted(perm.tolist()) == list(range(m))
+    L = np.tril(lu, -1) + np.vstack([np.eye(w, dtype=np.float32),
+                                     np.zeros((m - w, w), np.float32)])
+    U = np.triu(lu[:w])
+    res = np.linalg.norm(L @ U - a_np[perm]) / (
+        np.linalg.norm(a_np) * np.finfo(np.float32).eps * m)
+    assert res < 60, res
+
+
+def test_getrf_rec_linv_u12_path(monkeypatch):
+    """Force the TPU dispatch gate open on CPU so the full getrf_rec
+    composition (pallas leaf + inverse-based u12) runs in interpret
+    mode and matches the plain path."""
+    from slate_tpu.linalg import lu as lu_mod
+    monkeypatch.setattr(lu_mod, "_use_pallas_panel",
+                        lambda m, w, dtype: dtype == jnp.float32
+                        and w % 32 == 0 and m >= w)
+    n, nb = 192, 64
+    rng = np.random.default_rng(2)
+    a_np = (rng.standard_normal((n, n)).astype(np.float32)
+            + n * np.eye(n, dtype=np.float32))
+    lu, perm = lu_mod.getrf_rec(jnp.asarray(a_np), nb)
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    L = np.tril(lu, -1) + np.eye(n, dtype=np.float32)
+    U = np.triu(lu)
+    res = np.linalg.norm(L @ U - a_np[perm]) / (
+        np.linalg.norm(a_np) * np.finfo(np.float32).eps * n)
+    assert res < 3, res

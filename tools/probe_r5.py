@@ -1,0 +1,64 @@
+"""r5 component probe, v3: ~16 ms fixed dispatch latency per jit call
+through the axon relay — amortize with an in-jit fori_loop chain of K
+dependent applications; report (t_total - t_overhead)/K."""
+import time, sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+
+def P(*a): print(*a, flush=True)
+
+def chain(fbody, x0, *extra, K=16, N=4):
+    @jax.jit
+    def g(x, *e):
+        def body(i, xx):
+            return fbody(xx, *e) * jnp.float32(0.9999)
+        return lax.fori_loop(0, K, body, x)
+    t0 = time.perf_counter()
+    x = g(x0, *extra); float(jnp.asarray(x).ravel()[-1])
+    tc = time.perf_counter() - t0
+    ts = []
+    for _ in range(N):
+        t0 = time.perf_counter()
+        x = g(x0, *extra); float(jnp.asarray(x).ravel()[-1])
+        ts.append(time.perf_counter() - t0)
+    return (min(ts) - 0.016) / K, tc
+
+n = 8192
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (n, n), jnp.float32)
+b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+
+t, tc = chain(lambda x, y: x @ y * jnp.float32(1e-4), a, b)
+P("gemm n=8192               %7.2f ms  %6.1f TF/s (c %.0fs)" % (t*1e3, 2*n**3/t/1e12, tc))
+
+for m in (8192, 2048):
+    pan0 = a[:m, :512] + 0
+    def panf(x):
+        lu, _, _ = lax.linalg.lu(x)
+        return lu
+    t, tc = chain(panf, pan0)
+    P("lax.linalg.lu (%5d,512)   %7.2f ms (c %.0fs)" % (m, t*1e3, tc))
+
+def updf(x):
+    return x.at[:, 512:].add(-(x[:, :512] @ x[:512, 512:]) * jnp.float32(1e-6))
+t, tc = chain(updf, a)
+P("trailing k=512 8192x7680   %7.2f ms  %5.1f TF/s (c %.0fs)" % (t*1e3, 2*8192*512*7680/t/1e12, tc))
+
+def bigk2(x):
+    upd = x[:, :4096] @ x[:4096, :512]
+    return x.at[:, :512].add(upd * jnp.float32(1e-8))
+t, tc = chain(bigk2, a)
+P("panel upd k=4096 8192x512  %7.2f ms  %5.1f TF/s (c %.0fs)" % (t*1e3, 2*8192*4096*512/t/1e12, tc))
+
+perm0 = jax.random.permutation(jax.random.PRNGKey(2), n)
+t, tc = chain(lambda x, p: x[p], a, perm0)
+P("full row gather 8192x8192  %7.2f ms (c %.0fs)" % (t*1e3, tc))
+
+def trsmf(x):
+    y = lax.linalg.triangular_solve(x[:512, :512], x[:512, 512:],
+        left_side=True, lower=True, unit_diagonal=True)
+    return x.at[:512, 512:].add(y * jnp.float32(1e-30))
+t, tc = chain(trsmf, a)
+P("trsm 512x(512,7680)        %7.2f ms (c %.0fs)" % (t*1e3, tc))
+P("---")

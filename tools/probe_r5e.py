@@ -1,0 +1,38 @@
+"""getrf_rec with pallas panels: end-to-end slope timing."""
+import time, sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from slate_tpu.linalg.lu import getrf_rec, _panel_lu
+
+def P(*a): print(*a, flush=True)
+
+def slope(fbody, x0, K1=2, K2=10, N=4):
+    def mk(K):
+        @jax.jit
+        def g(x):
+            def body(i, xx):
+                return fbody(xx)
+            return lax.fori_loop(0, K, body, x)
+        return g
+    res = []
+    for K in (K1, K2):
+        g = mk(K)
+        x = g(x0); float(jnp.asarray(x).ravel()[-1])
+        ts = []
+        for _ in range(N):
+            t0 = time.perf_counter()
+            x = g(x0); float(jnp.asarray(x).ravel()[-1])
+            ts.append(time.perf_counter() - t0)
+        res.append(min(ts))
+    return (res[1] - res[0]) / (K2 - K1)
+
+n = 8192
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (n, n), jnp.float32) + n * jnp.eye(n, dtype=jnp.float32)
+
+for nb in (512,):
+    f = lambda x: x + getrf_rec(x, nb)[0] * jnp.float32(1e-30)
+    t = slope(f, a)
+    P("getrf_rec nb=%-4d pallas-leaf  %7.1f ms  %5.1f TF/s (%4.1f%% of 53.4)"
+      % (nb, t*1e3, 2*n**3/3/t/1e12, 100*2*n**3/3/t/53.4e12))
