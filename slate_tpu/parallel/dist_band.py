@@ -402,16 +402,36 @@ def ptbsm(side, uplo, op, diag, a: DistMatrix, kd: int, b: DistMatrix,
     as the reference does: row-permute B before the forward solve."""
 
     from .dist_aux import ptrsm
-    from .dist import distribute, like, undistribute
+    from .dist import like
     from ..enums import Uplo
 
     lower = uplo is Uplo.Lower
     masked = _pband_mask(a, kd if lower else 0, 0 if lower else kd)
     bb = b
     if pivots is not None:
+        # row-permute B ON DEVICE, sharding preserved: un-shuffle the
+        # cyclic block order → one global row gather → re-shuffle
+        # (r4 Weak #7: this was the band layer's one host round-trip)
         import jax
+        from functools import partial as _partial
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .dist import _permute_blocks
+        from ..grid import cyclic_permutation, inverse_permutation
+        from .mesh import AXIS_P, AXIS_Q
         p, q = b.grid_shape
-        bh = np.asarray(jax.device_get(undistribute(b)))
-        bb = distribute(jnp.asarray(bh[np.asarray(pivots)], dtype=b.dtype),
-                        b.mesh, b.nb, row_mult=q)
+        rb = b.row_nb
+        cyc = cyclic_permutation(b.mtp, p)
+        pv = jnp.asarray(pivots)
+        sharding = NamedSharding(b.mesh, P(AXIS_P, AXIS_Q))
+
+        @_partial(jax.jit, out_shardings=sharding)
+        def apply_perm(x, pv):
+            x = _permute_blocks(x, jnp.asarray(inverse_permutation(cyc)),
+                                0, rb)
+            full = jnp.concatenate(
+                [pv, jnp.arange(pv.shape[0], x.shape[0])])
+            x = x[full]
+            return _permute_blocks(x, jnp.asarray(cyc), 0, rb)
+
+        bb = like(b, apply_perm(b.data, pv))
     return ptrsm(side, uplo, op, diag, masked, bb)

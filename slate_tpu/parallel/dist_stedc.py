@@ -169,10 +169,17 @@ def _build_r(vs, keep_idx, defl_idx, ga, gb, gc, gs, inv_order,
     """Combine matrix R = P·G·M (see :func:`_merge_device`): M scatters
     the secular columns to the kept poles' permuted rows and identity
     columns to the deflated ones; the deflation Givens act on M's rows
-    (row_a' = c·row_a + s·row_b, row_b' = −s·row_a + c·row_b, applied
-    last-recorded-first — chained pairs sharing an index do not
-    commute); P un-permutes rows; order2 applies the final eigenvalue
-    sort to columns."""
+    (row_a' = c·row_a + s·row_b, row_b' = −s·row_a + c·row_b); P
+    un-permutes rows; order2 applies the final eigenvalue sort to
+    columns.
+
+    The Givens arrive grouped into WAVES of pairwise-disjoint pairs
+    (host greedy longest-chain grouping, see the caller): one batched
+    two-row gather/scatter applies a whole wave, so the sequential
+    depth is the maximum conflict-chain length (typically 1-2), not the
+    rotation count — r4 Weak #8's per-rotation cross-device exchange
+    pattern collapses to O(depth) exchanges.  ``ga/gb/gc/gs`` are
+    (nwaves, wave_len) with identity padding (a==b, c=1, s=0)."""
     k = vs.shape[1]
     m = jnp.zeros((n, n), jnp.float64)
     if k:
@@ -180,15 +187,17 @@ def _build_r(vs, keep_idx, defl_idx, ga, gb, gc, gs, inv_order,
     if defl_idx.shape[0]:
         m = m.at[defl_idx, jnp.arange(k, n)].set(1.0)
 
-    def rot(i, m):
+    def wave(i, m):
         a, b = ga[i], gb[i]
-        c, s_ = gc[i], gs[i]
+        c, s_ = gc[i][:, None], gs[i][:, None]
         ra, rb = m[a, :], m[b, :]
-        m = m.at[a, :].set(c * ra + s_ * rb)
-        m = m.at[b, :].set(-s_ * ra + c * rb)
-        return m
+        # delta form: identity padding (a==b, c=1, s=0) adds zero, so
+        # scatter-add stays correct when pad lanes share row 0 with a
+        # real rotation (duplicate-index .set would race)
+        m = m.at[a, :].add((c - 1.0) * ra + s_ * rb)
+        return m.at[b, :].add(-s_ * ra + (c - 1.0) * rb)
 
-    m = lax.fori_loop(0, ga.shape[0], rot, m)
+    m = lax.fori_loop(0, ga.shape[0], wave, m)
     return m[inv_order, :][:, order2]
 
 
@@ -267,19 +276,33 @@ def _merge_device(d1, q1, d2, q2, e_mid, mesh):
     # R = P·G·M, so Q_new = diag(Q1,Q2)·R = [Q1·R_top; Q2·R_bot].
     keep_idx = np.flatnonzero(keep)
     defl_idx = np.flatnonzero(~keep)
-    # givens as padded arrays so the module-level jitted builder's cache
-    # keys on (n, k, padded-count) instead of retracing every merge
-    ng = len(givens)
-    ng_pad = 1
-    while ng_pad < max(ng, 1):
-        ng_pad *= 2
-    ga = np.zeros(ng_pad, np.int32)
-    gb = np.zeros(ng_pad, np.int32)
-    gc = np.ones(ng_pad)
-    gs = np.zeros(ng_pad)
-    # reversed: the rightmost (last-recorded) rotation must hit M first
-    for i, (a, b, c, s_) in enumerate(reversed(givens)):
-        ga[i], gb[i], gc[i], gs[i] = a, b, c, s_
+    # group the rotations into waves of pairwise-disjoint index pairs
+    # (greedy longest-chain: a rotation lands one wave after the last
+    # conflicting one), applied last-recorded-first; padded to
+    # power-of-two (nwaves, wave_len) so the jitted builder's cache
+    # keys on the padded shape instead of retracing every merge
+    waves = []
+    last_wave = {}
+    for (a, b, c, s_) in reversed(givens):
+        wv = max(last_wave.get(a, -1), last_wave.get(b, -1)) + 1
+        if wv == len(waves):
+            waves.append([])
+        waves[wv].append((a, b, c, s_))
+        last_wave[a] = wv
+        last_wave[b] = wv
+    nw_pad = 1
+    while nw_pad < max(len(waves), 1):
+        nw_pad *= 2
+    lw_pad = 1
+    while lw_pad < max((len(w) for w in waves), default=1):
+        lw_pad *= 2
+    ga = np.zeros((nw_pad, lw_pad), np.int32)
+    gb = np.zeros((nw_pad, lw_pad), np.int32)
+    gc = np.ones((nw_pad, lw_pad))
+    gs = np.zeros((nw_pad, lw_pad))
+    for wv, rots in enumerate(waves):
+        for i, (a, b, c, s_) in enumerate(rots):
+            ga[wv, i], gb[wv, i], gc[wv, i], gs[wv, i] = a, b, c, s_
     vs_pad = vs if k else jnp.zeros((n, 0), jnp.float64)
     r = _build_r(vs_pad, jnp.asarray(keep_idx),
                  jnp.asarray(defl_idx), jnp.asarray(ga),

@@ -1,0 +1,63 @@
+"""Single-session A/B: getrf_rec pallas-leaf vs XLA panels; geqrf at
+the bench config (r4 regression check)."""
+import time, sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from slate_tpu.linalg.lu import getrf_rec, _panel_lu
+from slate_tpu.linalg.qr import geqrf_panels
+
+def P(*a): print(*a, flush=True)
+
+def slope(fbody, x0, *extra, K1=2, K2=10, N=4):
+    def mk(K):
+        @jax.jit
+        def g(x, *e):
+            def body(i, xx):
+                return fbody(xx, *e)
+            return lax.fori_loop(0, K, body, x)
+        return g
+    res = []
+    for K in (K1, K2):
+        g = mk(K)
+        x = g(x0, *extra); float(jnp.asarray(x).ravel()[-1])
+        ts = []
+        for _ in range(N):
+            t0 = time.perf_counter()
+            x = g(x0, *extra); float(jnp.asarray(x).ravel()[-1])
+            ts.append(time.perf_counter() - t0)
+        res.append(min(ts))
+    return (res[1] - res[0]) / (K2 - K1)
+
+n = 8192
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (n, n), jnp.float32) + n * jnp.eye(n, dtype=jnp.float32)
+
+# gemm anchor same-session (bench's blocks.matmul HIGH)
+from slate_tpu.ops import blocks
+b2 = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.float32)
+def gch(x, bb):
+    return blocks.matmul(x, bb) * jnp.float32(1e-4)
+t = slope(gch, a, b2)
+gemm_tf = 2*n**3/t/1e12
+P("gemm HIGH anchor            %7.1f ms  %5.1f TF/s" % (t*1e3, gemm_tf))
+
+f = lambda x, *_: x + getrf_rec(x, 512)[0] * jnp.float32(1e-30)
+t = slope(f, a)
+P("getrf_rec DEFAULT (pallas)  %7.1f ms  %5.1f TF/s (%4.1f%% of anchor)"
+  % (t*1e3, 2*n**3/3/t/1e12, 100*(2*n**3/3/t/1e12)/gemm_tf))
+
+f2 = lambda x, *_: x + getrf_rec(x, 512, panel=_panel_lu)[0] * jnp.float32(1e-30)
+t = slope(f2, a)
+P("getrf_rec XLA panels        %7.1f ms  %5.1f TF/s (%4.1f%% of anchor)"
+  % (t*1e3, 2*n**3/3/t/1e12, 100*(2*n**3/3/t/1e12)/gemm_tf))
+
+m2, n2 = 32768, 4096
+tall = jax.random.normal(jax.random.PRNGKey(2), (m2, n2), jnp.float32)
+def qf(x, *_):
+    f3, taus = geqrf_panels(x, 512)
+    return x + f3 * jnp.float32(1e-30)
+t = slope(qf, tall, K1=2, K2=8)
+qr_fl = 2.0*m2*n2**2 - 2.0*n2**3/3.0
+P("geqrf m=32768 n=4096        %7.1f ms  %5.1f TF/s (r3: 23.5, r4: 18.9)"
+  % (t*1e3, qr_fl/t/1e12))
