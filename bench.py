@@ -42,7 +42,15 @@ list but never kills the suite and never sets a nonzero exit code.  Only a
 *residual-gate* failure — numerically wrong answers — exits nonzero, and
 even then the JSON line with everything that passed is printed first.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Incremental output (the round-5 lesson: BENCH_r05.json came back empty
+because the driver timed out before the suite's single final print —
+rc=124, parsed=null): every routine flushes its own JSON line to stdout
+the moment it completes (``{"routine": ..., "label": ..., "gflops":
+...}``; failures flush ``{"routine": ..., "error": ...}``), so a SIGTERM
+or timeout mid-suite keeps every number already measured.  The final
+aggregate line — {"metric", "value", "unit", "vs_baseline", ...} — is
+unchanged and remains the LAST line, so existing parsers that read only
+the tail still work.
 """
 
 import json
@@ -79,10 +87,22 @@ def _run_routine(name, fn, sub, fails, infra):
             label, gf, resid = out[0], out[1], out[2]
             if resid > 3.0:
                 fails.append(f"{name}: scaled_resid={resid:.3e} > 3")
+                print(json.dumps({"routine": name, "label": label,
+                                  "error": "residual_gate",
+                                  "scaled_resid": float(resid)}),
+                      flush=True)
                 return None
             if len(out) > 3:   # auxiliary submetrics, gated like the rest
                 sub.update(out[3])
             sub[label] = round(gf, 1)
+            # flush this routine's line NOW: a later timeout/SIGTERM must
+            # never lose a number already measured (BENCH_r05 lesson) —
+            # aux submetrics ride along for the same reason
+            line = {"routine": name, "label": label,
+                    "gflops": round(gf, 1), "scaled_resid": float(resid)}
+            if len(out) > 3:
+                line.update(out[3])
+            print(json.dumps(line), flush=True)
             return gf
         except Exception as e:  # infra: tunnel RPC, OOM, compile, ...
             last_err = e
@@ -90,6 +110,9 @@ def _run_routine(name, fn, sub, fails, infra):
             print(f"# retry {name} after infra error (attempt {attempt})",
                   file=sys.stderr)
     infra.append(f"{name}: {type(last_err).__name__}: {last_err}")
+    print(json.dumps({"routine": name,
+                      "error": f"infra: {type(last_err).__name__}"}),
+          flush=True)
     return None
 
 
@@ -488,7 +511,7 @@ def main():
         out["skipped_for_time"] = skipped
     if fails or infra:
         out["failed"] = fails + [f"infra: {s}" for s in infra]
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)   # aggregate stays the LAST line
     for f in fails:
         print(f"# FAILED residual gate: {f}", file=sys.stderr)
     for s in infra:

@@ -1,0 +1,63 @@
+"""Version-compat shims for the jax APIs the distributed stack uses.
+
+The SPMD kernels target current jax (public ``jax.shard_map`` and the
+vma "varying" type system with ``lax.pcast``/``jax.typeof``).  Older
+jax (< 0.5) ships shard_map under ``jax.experimental`` and has no
+varying-axes bookkeeping at all; there the shims degrade gracefully:
+
+* :data:`shard_map` resolves to whichever implementation exists.  On
+  the experimental version ``check_rep=False`` is forced — the old
+  replication checker predates the psum/pmax-derived replication
+  patterns several kernels rely on (e.g. the pgetrf pivot vector) and
+  rejects valid programs.
+* :func:`pvary` is ``lax.pcast(..., to="varying")`` where the vma
+  system exists and the identity otherwise (with no varying types
+  there is nothing to satisfy).
+* :func:`varying_axes` reports a value's varying-axes set (always
+  empty on old jax), for carries that must match a loop input's type.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+try:                                    # jax >= 0.6: public API
+    from jax import shard_map           # type: ignore[attr-defined]
+except ImportError:                     # jax 0.4.x: experimental module
+    from functools import partial as _partial
+
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f=None, /, **kw):
+        kw.setdefault("check_rep", False)
+        if f is None:
+            return _partial(_shard_map_exp, **kw)
+        return _shard_map_exp(f, **kw)
+
+
+def enable_x64(enabled: bool):
+    """Context manager forcing the x64 mode flag: ``jax.enable_x64``
+    where it exists (jax >= 0.5), the ``jax.experimental``
+    enable/disable pair on older jax."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(enabled)
+    if enabled:
+        from jax.experimental import enable_x64 as _ctx
+    else:
+        from jax.experimental import disable_x64 as _ctx
+    return _ctx()
+
+
+def pvary(x, axes):
+    """``lax.pcast(x, axes, to="varying")`` on jax with the vma type
+    system; identity on older jax."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, tuple(axes), to="varying")
+    return x
+
+
+def varying_axes(x):
+    """The value's varying-axes set (empty tuple on older jax)."""
+    t = jax.typeof(x) if hasattr(jax, "typeof") else None
+    return tuple(getattr(t, "vma", ()) or ())

@@ -195,7 +195,7 @@ def _diag_pad_data(dm, value: float):
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax import shard_map
+    from .._jax_compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import AXIS_P, AXIS_Q, mesh_grid_shape

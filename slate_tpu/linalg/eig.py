@@ -456,10 +456,21 @@ def _tridiag_solve(d, e, want_z, driver):
     e = np.asarray(e, dtype=np.float64)
     if d.size == 1:
         return (d, np.ones((1, 1))) if want_z else d
+    def call(fn, drv):
+        try:
+            return fn(d, e, lapack_driver=drv)
+        except ValueError as err:
+            # scipy >= 1.14 dropped stevd/stevr from the accepted driver
+            # set; 'auto' (stemr/stebz) is always valid and numerically
+            # interchangeable here
+            if "lapack_driver" not in str(err) or drv == "auto":
+                raise
+            return fn(d, e, lapack_driver="auto")
+
     if not want_z:
         vdriver = driver if driver in ("stev", "stevd", "stebz") else "auto"
-        return eigvalsh_tridiagonal(d, e, lapack_driver=vdriver)
-    return eigh_tridiagonal(d, e, lapack_driver=driver)
+        return call(eigvalsh_tridiagonal, vdriver)
+    return call(eigh_tridiagonal, driver)
 
 
 _EIG_DRIVERS = {

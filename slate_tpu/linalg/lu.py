@@ -142,11 +142,27 @@ def _panel_lu_pallas(a):
     return out[:, perm].T, perm, linv
 
 
+#: VMEM the one-call panel kernel may budget (its pallas_call pins a
+#: 110 MB vmem_limit; leave headroom for Mosaic's own spills)
+_PALLAS_PANEL_VMEM_BUDGET = 100 * 1024 * 1024
+
+
 def _use_pallas_panel(m: int, w: int, dtype) -> bool:
     import jax as _jax
-    return (dtype == jnp.float32 and w % 32 == 0 and m % 8 == 0
+    if not (dtype == jnp.float32 and w % 32 == 0 and m % 8 == 0
             and w >= 64 and m >= w and m <= _PALLAS_PANEL_MAX_M
-            and m >= 3072 and _jax.default_backend() == "tpu")
+            and m >= 3072 and _jax.default_backend() == "tpu"):
+        return False
+    # VMEM budget on panel WIDTH, not just height: the kernel holds the
+    # (w, m_pad) transposed slab plus its output copy (2·w·m_pad·4 B)
+    # and the (ib, m_pad) + (w, w) + linv/act scratch; at nb=1024 the
+    # slab pair alone is ~134 MB at m_pad=16384 and Mosaic fails to
+    # compile — fall back to the XLA panel instead
+    if w <= 512:
+        return True
+    m_pad = max(512, 1 << (m - 1).bit_length())
+    scratch = (32 * m_pad + 2 * w * w + 2 * m_pad) * 4
+    return 2 * w * m_pad * 4 + scratch < _PALLAS_PANEL_VMEM_BUDGET
 
 
 def _panel_lu_auto(a):
@@ -293,8 +309,23 @@ def getrf_rec(a, nb: int, panel=_panel_lu_auto):
         # 41 ms at n=8192
         c = right[:n1]
         l11 = jnp.tril(lu1[:n1], -1) + jnp.eye(n1, dtype=a.dtype)
-        u12 = matmul(linv.astype(a.dtype), c)
-        u12 = u12 + matmul(linv.astype(a.dtype), c - matmul(l11, u12))
+        li = linv.astype(a.dtype)
+        u12 = matmul(li, c)
+        r1 = c - matmul(l11, u12)
+        # guard the inverse path (mirrors the geqrf CholQR² devmax
+        # guard): ‖r₁‖∞/‖c‖∞ = ‖(I − L11·L11⁻¹)·c‖∞/‖c‖∞ reuses the
+        # correction residual already computed; one Newton step squares
+        # a small departure but cannot rescue a wrong inverse — past
+        # the threshold the exact trsm takes over
+        dev = jnp.max(jnp.abs(r1)) / jnp.maximum(
+            jnp.max(jnp.abs(c)), jnp.finfo(a.dtype).tiny)
+        u12 = lax.cond(
+            dev < 1e-2,
+            lambda _: u12 + matmul(li, r1),
+            lambda _: lax.linalg.triangular_solve(
+                lu1[:n1], c, left_side=True, lower=True,
+                unit_diagonal=True),
+            operand=None)
     else:
         u12 = lax.linalg.triangular_solve(
             lu1[:n1], right[:n1], left_side=True, lower=True,

@@ -168,9 +168,10 @@ def _panel_geqrf(a):
     taus0 = jnp.zeros((k,), dt)
     # under shard_map the panel input is device-varying; the taus carry
     # must carry the same varying-axes type or the fori_loop rejects it
-    vma = getattr(jax.typeof(a), "vma", ())
+    from .._jax_compat import pvary, varying_axes
+    vma = varying_axes(a)
     if vma:
-        taus0 = lax.pcast(taus0, tuple(vma), to="varying")
+        taus0 = pvary(taus0, vma)
     return lax.fori_loop(0, k, body, (a, taus0))
 
 

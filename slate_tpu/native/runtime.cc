@@ -107,7 +107,12 @@ void* slate_pool_alloc(void* pool) {
         return b;
     }
     ++p->allocated;
-    return std::aligned_alloc(64, p->block_bytes);
+    // posix_memalign, not std::aligned_alloc: old glibc builds ship a
+    // libstdc++ whose <cstdlib> has no aligned_alloc member
+    void* b = nullptr;
+    if (posix_memalign(&b, 64, p->block_bytes) != 0)
+        return nullptr;
+    return b;
 }
 
 void slate_pool_free(void* pool, void* block) {
@@ -699,75 +704,11 @@ static void hh_two_sided(T* ab, int64_t ldab, int64_t r, int64_t L,
                 v[i] * conj_s(w[c]) + w[i] * conj_s(v[c]);
 }
 
-// Sweep-range variant: factors sweeps j in [j0, j1) only.  The band is
-// the complete state between calls, so a caller can checkpoint it and
-// regenerate any chunk's reflector log later — the streaming that keeps
-// the O(n^2/2) chase log off the host (pheev's distributed middle).
-template <typename T>
-static int64_t hb2st_hh_impl_range(T* ab, int64_t n, int64_t kd,
-                                   int64_t ldab, HhLogT<T>& log,
-                                   int64_t j0, int64_t j1) {
-    std::vector<T> vbuf((size_t)kd), wbuf((size_t)kd),
-        colbuf((size_t)kd);
-    auto BA = [&](int64_t i, int64_t c) -> T& {
-        return ab[c * ldab + (i - c)];   // i >= c
-    };
-    if (j1 > n - 2) j1 = n - 2;
-    for (int64_t j = j0; j < j1; ++j) {
-        int64_t L = std::min(kd, n - 1 - j);
-        if (L < 2) continue;
-        int64_t r0 = j + 1;
-        // reflector 0 from column j's sub-band (keep A[j+1, j])
-        for (int64_t i = 0; i < L; ++i) vbuf[i] = BA(r0 + i, j);
-        T tau;
-        larfg_t(L, vbuf.data(), tau);
-        BA(r0, j) = vbuf[0];             // β (real by larfg)
-        for (int64_t i = 1; i < L; ++i) BA(r0 + i, j) = T(0);
-        vbuf[0] = T(1);
-        hh_two_sided(ab, ldab, r0, L, vbuf.data(), tau, wbuf.data());
-        log.push(r0, L, vbuf.data(), tau);
-        for (;;) {
-            int64_t r1 = r0 + L;
-            int64_t Lt = std::min(kd, n - r1);
-            if (Lt < 1) break;
-            // right-apply the previous reflector to the coupling block
-            // B = A[r1:r1+Lt, r0:r0+L)  (creates the bulge): B ← B·H
-            for (int64_t i = 0; i < Lt; ++i) {
-                T acc = T(0);
-                for (int64_t c = 0; c < L; ++c)
-                    acc += BA(r1 + i, r0 + c) * vbuf[c];
-                acc *= tau;
-                for (int64_t c = 0; c < L; ++c)
-                    BA(r1 + i, r0 + c) -= acc * conj_s(vbuf[c]);
-            }
-            if (Lt < 2) break;
-            // new reflector from B's first column
-            for (int64_t i = 0; i < Lt; ++i) colbuf[i] = BA(r1 + i, r0);
-            T tau2;
-            larfg_t(Lt, colbuf.data(), tau2);
-            BA(r1, r0) = colbuf[0];
-            for (int64_t i = 1; i < Lt; ++i) BA(r1 + i, r0) = T(0);
-            colbuf[0] = T(1);
-            // left-apply it to the remaining columns of B: B ← H₂ᴴ·B
-            for (int64_t c = 1; c < L; ++c) {
-                T acc = T(0);
-                for (int64_t i = 0; i < Lt; ++i)
-                    acc += conj_s(colbuf[i]) * BA(r1 + i, r0 + c);
-                acc *= conj_s(tau2);
-                for (int64_t i = 0; i < Lt; ++i)
-                    BA(r1 + i, r0 + c) -= acc * colbuf[i];
-            }
-            hh_two_sided(ab, ldab, r1, Lt, colbuf.data(), tau2,
-                         wbuf.data());
-            log.push(r1, Lt, colbuf.data(), tau2);
-            std::swap(vbuf, colbuf);
-            tau = tau2;
-            r0 = r1;
-            L = Lt;
-        }
-    }
-    return log.count;
-}
+// Sweep-range serial chase: see hb2st_hh_impl_range below the shared
+// per-window task bodies (it drives the SAME hb_sweep_start/step code
+// the wavefront runs — a separate textual copy of those loops lets the
+// compiler contract complex multiply-adds into FMAs differently per
+// copy, which broke the serial-vs-wavefront BITWISE identity for c128).
 
 // ---------------------------------------------------------------------
 // OpenMP wavefront for the Householder chase (reference: the task-DAG
@@ -877,6 +818,38 @@ static void hb_sweep_step(T* ab, int64_t n, int64_t kd, int64_t ldab,
     for (int64_t i = 0; i < Lt; ++i) st.v[i] = colbuf[i];
     st.tau = tau2; st.r0 = r1; st.L = Lt;
     if (w == st.nwin - 1) hb_sweep_tail(ab, n, kd, ldab, st);
+}
+
+// Sweep-range variant: factors sweeps j in [j0, j1) only.  The band is
+// the complete state between calls, so a caller can checkpoint it and
+// regenerate any chunk's reflector log later — the streaming that keeps
+// the O(n^2/2) chase log off the host (pheev's distributed middle).
+// Runs the wavefront's task bodies in serial (sweep-major) order: one
+// compiled copy of the window arithmetic, so the wavefront's bitwise
+// identity to this path cannot be broken by per-copy FMA contraction.
+template <typename T>
+static int64_t hb2st_hh_impl_range(T* ab, int64_t n, int64_t kd,
+                                   int64_t ldab, HhLogT<T>& log,
+                                   int64_t j0, int64_t j1) {
+    if (j1 > n - 2) j1 = n - 2;
+    std::vector<T> scratch((size_t)(2 * kd));
+    T* wbuf = scratch.data();
+    T* colbuf = wbuf + kd;
+    HbSweepT<T> st;
+    int64_t total = 0;
+    for (int64_t j = j0; j < j1; ++j) {
+        int64_t nwin = hb_sweep_nwin(n, kd, j);
+        if (nwin == 0) continue;
+        st.base = total;
+        st.nwin = nwin;
+        st.v.assign((size_t)kd, T(0));
+        hb_sweep_start(ab, n, kd, ldab, log, j, st, wbuf);
+        for (int64_t w = 1; w < nwin; ++w)
+            hb_sweep_step(ab, n, kd, ldab, log, w, st, wbuf, colbuf);
+        total += nwin;
+    }
+    log.count = total;
+    return total;
 }
 
 template <typename T>

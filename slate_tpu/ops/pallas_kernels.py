@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 names the Mosaic params class TPUCompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 from .. import config
 
 
@@ -44,12 +48,18 @@ def _x32_trace(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         leaves = jax.tree_util.tree_leaves((args, kwargs))
-        if any(getattr(getattr(x, "dtype", None), "itemsize", 0) > 4
-               for x in leaves):
+        if _interpret() or \
+                any(getattr(getattr(x, "dtype", None), "itemsize", 0) > 4
+                    for x in leaves):
             # 64-bit operands: only legal in interpret mode (CPU CI);
-            # the x32 context would silently truncate them
+            # the x32 context would silently truncate them.  Interpret
+            # mode never needs the x32 trace at all (the bitwidth_<=32
+            # Mosaic layout check is TPU-only), and flipping the x64
+            # flag mid-trace under an x64 outer jit emits mixed
+            # i32/i64 loop counters the MLIR verifier rejects
             return fn(*args, **kwargs)
-        with jax.enable_x64(False):
+        from .._jax_compat import enable_x64
+        with enable_x64(False):
             return fn(*args, **kwargs)
 
     return wrapper
@@ -835,7 +845,7 @@ def getrf_block_inplace(at_full, active_row, r0, bb: int = 128,
                         pltpu.VMEM((ib, m), f32),
                         pltpu.SemaphoreType.DMA(())],
         input_output_aliases={0: 0},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
     )(at_full, active_row, jnp.asarray(r0, jnp.int32).reshape(1))
@@ -865,7 +875,7 @@ def getrf_panel_linv(slab_t, active_row, ib: int = 32):
         out_specs=tuple([pl.BlockSpec(memory_space=pltpu.VMEM)] * 4),
         scratch_shapes=[pltpu.VMEM((ib, m), f32),
                         pltpu.VMEM((bb, bb), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=110 * 1024 * 1024),
         interpret=_interpret(),
     )(slab_t, active_row)
@@ -896,7 +906,7 @@ def getrf_block_panel(slab_t, active_row, ib: int = 16):
                    pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM)),
         scratch_shapes=[pltpu.VMEM((ib, m), f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
     )(slab_t, active_row)

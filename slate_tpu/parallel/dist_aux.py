@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..enums import Diag, Norm, Op, Side, Uplo

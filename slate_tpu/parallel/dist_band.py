@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
@@ -336,8 +336,10 @@ def _pband_mask(a: DistMatrix, kl: int, ku: int) -> DistMatrix:
     row/col indices recovered from the cyclic layout)."""
 
     import jax
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    from .._jax_compat import shard_map
 
     from .dist import like
     from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape

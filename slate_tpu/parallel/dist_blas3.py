@@ -19,7 +19,7 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .._jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv

@@ -112,7 +112,7 @@ def _phetrf_impl(mesh, n, M, nb, maps_, dtype_name):
         lmat = jnp.zeros_like(a)
         ipiv = jnp.zeros((max(n - 2, 1),), jnp.int32)
         rows_l = jnp.arange(M)
-        steps_cache = {}
+        win_next = None     # lookahead: next panel's double-buffered window
 
         for j0 in range(0, max(n - 2, 0), nb):
             w = min(nb, n - 2 - j0)
@@ -120,8 +120,12 @@ def _phetrf_impl(mesh, n, M, nb, maps_, dtype_name):
                 break
             wide = min(w + 1, n - j0)
             wcols = np.arange(j0, j0 + wide)
-            win = jnp.take(jnp.take(a, c_g2s_h[wcols], axis=1),
-                           r_g2s, axis=0)
+            # lookahead carry: the previous panel produced this window
+            # with narrow gemms, off the critical path of its own wide
+            # trailing update (identical arithmetic — see below)
+            win = win_next if win_next is not None else \
+                jnp.take(jnp.take(a, c_g2s_h[wcols], axis=1),
+                         r_g2s, axis=0)
             V0 = jnp.zeros((M, w), dt)
             U0 = jnp.zeros((M, w), dt)
             C0 = jnp.zeros((M, w), dt)
@@ -222,6 +226,37 @@ def _phetrf_impl(mesh, n, M, nb, maps_, dtype_name):
             trail = (rows_l >= j0 + wide).astype(dt)
             Uc = jnp.conj(U) * sel * trail[:, None]
             Vc = jnp.conj(V) * sel * trail[:, None]
+            # ---- lookahead (the OpenMP-task pipeline of the reference
+            # hetrf): produce the NEXT panel's window now, via narrow
+            # (M × w)·(w × wide₂) gemms — its share of the deferred
+            # update plus its share of the re-hermitization below —
+            # instead of fetching it after the full-size trailing
+            # contraction.  The values are identical (the narrow gemms
+            # are exactly the window columns/rows of the wide ones), but
+            # the window no longer data-depends on the wide update, so
+            # XLA's scheduler can overlap that contraction with the
+            # next panel's latency-bound column eliminations.
+            j0n = j0 + nb
+            wn = min(nb, n - 2 - j0n)
+            win_next = None
+            if wn > 0:
+                widen = min(wn + 1, n - j0n)
+                wcols2 = np.arange(j0n, j0n + widen)
+                win2 = jnp.take(jnp.take(a, c_g2s_h[wcols2], axis=1),
+                                r_g2s, axis=0)
+                win2 = win2 - _mm(V, jnp.swapaxes(Uc[wcols2], 0, 1)) \
+                    - _mm(C, jnp.swapaxes(Vc[wcols2], 0, 1))
+                # the window's share of the trailing re-hermitization:
+                # mirror rows (logical rows wcols2, full width), updated
+                # by the same narrow contraction
+                rows2 = jnp.take(jnp.take(a, r_g2s_h[wcols2], axis=0),
+                                 c_g2s, axis=1)
+                rows2 = rows2 - _mm(V[wcols2], jnp.swapaxes(Uc, 0, 1)) \
+                    - _mm(C[wcols2], jnp.swapaxes(Vc, 0, 1))
+                both2 = ((rows_l >= j0 + wide)[:, None]
+                         & jnp.asarray(wcols2 >= j0 + wide)[None, :])
+                win_next = jnp.where(
+                    both2, 0.5 * (win2 + jnp.conj(rows2).T), win2)
             upd = _mm(jnp.take(V, r_s2g, axis=0),
                       jnp.swapaxes(jnp.take(Uc, c_s2g, axis=0), 0, 1)) \
                 + _mm(jnp.take(C, r_s2g, axis=0),

@@ -5,23 +5,38 @@ TPU-native re-design of the reference's ``getrf`` driver
 (``internal_getrf.cc:75-92``, ``Tile_getrf.hh:154-320``):
 
 * the reference's thread team + ``MPI_Allreduce(MAXLOC)`` per panel
-  column becomes a *redundant panel factorization*: the block column is
-  assembled on every device with one masked ``psum`` (along 'q') + one
-  ``all_gather`` (along 'p'), then every device runs the same fused
-  ``lax.linalg.lu`` on it.  nb³·(m/nb) flops of redundancy buys zero
-  per-column latency hops — the TPU trade (MXU flops are cheap, ICI
-  round-trips per column are not);
+  column becomes a *redundant panel factorization*: the global block
+  column is replicated with ONE fused collective
+  (:func:`~.dist_util.bcast_block_col` — the owner column scatters its
+  rows to global offsets and a single ``psum`` over both mesh axes
+  assembles the panel; the old masked-psum-along-'q' + all_gather pair
+  paid two serialized collective latencies), then every device runs the
+  same fused ``lax.linalg.lu`` on it.  nb³·(m/nb) flops of redundancy
+  buys zero per-column latency hops — the TPU trade (MXU flops are
+  cheap, ICI round-trips per column are not);
 * the reference's cross-rank row swaps (``internal::permuteRows``,
   ``internal_swap.cc:500-750``) become one vectorized fetch/scatter:
   a product of nb transpositions moves at most 2·nb rows, so the swap
   set has the *static* shape (2nb,) = [destinations ‖ pivot targets];
   sources are fetched with a masked ``psum`` along 'p' and written with
   a single ``scatter`` in drop mode (rows a device does not own fall
-  out of range and are dropped);
-* trailing update = one local MXU matmul per device per step, exactly as
-  in :mod:`.dist_factor` (the group-batched ``blas::batch::gemm`` of
-  ``internal_gemm.cc:614-689`` collapses to a dense contraction over the
-  cyclic-shuffled local block).
+  out of range and are dropped).  The first nb fetched rows ARE the
+  post-swap pivot block row k, so the U12 trsm reads them directly —
+  the old separate block-row psum is gone;
+* OpenMP-task lookahead (``src/getrf.cc`` ``priority 1``) → the panel
+  is DOUBLE-BUFFERED in the loop carry: step k's body updates only
+  block column k+1 with a narrow rank-nb gemm and issues its broadcast
+  immediately, so the collective for step k+1 depends on the swap fetch
+  and the panel — never on the trailing update — and XLA's scheduler
+  overlaps it with the trailing MXU contraction;
+* trailing update = one local MXU matmul per device per step over the
+  STATIC live window (the group-batched ``blas::batch::gemm`` of
+  ``internal_gemm.cc:614-689`` collapses to a dense contraction over
+  the cyclic-shuffled local block): the step loop is split into a few
+  unrolled stages with shrinking local window shapes
+  (:func:`~.dist_util.stage_bounds`), cutting the masked-flop waste of
+  a fixed full-size body (~3× the ideal shrinking count) to ≤ ~1.4×
+  while keeping one jit per driver.
 
 Pivots are tracked as a replicated global permutation ``gperm`` with
 ``A[gperm] = L·U`` (the reference's ``Pivots`` list, ``types.hh:64-97``).
@@ -35,12 +50,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from .._jax_compat import pvary, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
 from ..ops.blocks import matmul as _mm
 from .dist import DistMatrix, distribute, like, undistribute
+from .dist_util import bcast_block_col, local_grows, stage_bounds, staged_fori
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
@@ -63,16 +79,13 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
     M = mtp * nb
-    pos = jnp.asarray(_gather_positions(mtp, p))
+    bounds = stage_bounds(nt)
 
     def kernel(a_loc):
         r = lax.axis_index(AXIS_P)
         c = lax.axis_index(AXIS_Q)
         dt = a_loc.dtype
-        j_idx = jnp.arange(nl) * q + c           # my global col blocks
-        lrows = jnp.arange(ml * nb)
-        # global row of each of my local rows
-        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        grows = local_grows(ml, nb, p, r)   # global row of my local rows
         rows_g = jnp.arange(M)
 
         def owned_lrow(g):
@@ -81,67 +94,104 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
             own = (blk % p) == r
             return own, (blk // p) * nb + g % nb
 
-        def body(k, carry):
-            a_loc, gperm = carry
-            kq, kp = k // q, k // p
-            # ---- assemble panel column k on every device (tileBcast +
-            # hypercube listBcast, src/getrf.cc:103-117 → psum + all_gather)
-            colk = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
-            ploc = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
-            pg = lax.all_gather(ploc, AXIS_P, axis=0, tiled=True)
-            panel = jnp.take(pg.reshape(mtp, nb, nb), pos, axis=0)
-            panel = panel.reshape(M, nb)
-            # shift so the diagonal block leads; zero the wrapped-around
-            # (already factored) rows so they never win a pivot
-            shifted = _roll_rows(panel, k * nb)
-            valid = (rows_g < M - k * nb)[:, None].astype(dt)
-            # ---- redundant panel LU (internal::getrf_panel analog)
-            lu_p, piv, perm = lax.linalg.lu(shifted * valid)
-            # ---- vectorized cross-mesh row swaps (internal::permuteRows):
-            # destinations = top nb positions ∪ pivot targets (static 2nb)
-            drel = jnp.concatenate([jnp.arange(nb), piv.astype(jnp.int32)])
-            srel = jnp.take(perm, drel).astype(jnp.int32)
-            dg = k * nb + drel
-            sg = k * nb + srel
-            own_s, lr_s = owned_lrow(sg)
-            fetched = jnp.take(a_loc, jnp.where(own_s, lr_s, 0), axis=0)
-            fetched = lax.psum(fetched * own_s[:, None].astype(dt), AXIS_P)
-            own_d, lr_d = owned_lrow(dg)
-            a_loc = a_loc.at[jnp.where(own_d, lr_d, ml * nb)].set(
-                fetched, mode="drop")
-            # ---- write the factored panel column back (L21 + L11\U11)
-            rel = grows - k * nb
-            myrows = jnp.take(lu_p, jnp.clip(rel, 0, M - 1), axis=0)
-            colk2 = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
-            newcol = jnp.where((rel >= 0)[:, None], myrows, colk2)
-            written = lax.dynamic_update_slice(a_loc, newcol, (0, kq * nb))
-            a_loc = jnp.where(k % q == c, written, a_loc)
-            # ---- trsm on block row k: U12 = L11^{-1} A12 (src/getrf.cc:121+)
-            rowblk = lax.dynamic_slice(a_loc, (kp * nb, 0), (nb, nl * nb))
-            rowblk = lax.psum(rowblk * (k % p == r).astype(dt), AXIS_P)
-            l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=dt)
-            u12 = lax.linalg.triangular_solve(
-                l11, rowblk, left_side=True, lower=True, unit_diagonal=True)
-            cmask = jnp.repeat(j_idx > k, nb).astype(dt)[None, :]
-            newrow = cmask * u12 + (1 - cmask) * rowblk
-            upd = lax.dynamic_update_slice(a_loc, newrow, (kp * nb, 0))
-            a_loc = jnp.where(k % p == r, upd, a_loc)
-            # ---- trailing update: one local MXU matmul (hot loop)
-            lmask = (rel >= nb)[:, None].astype(dt)
-            myl = jnp.take(lu_p, jnp.clip(rel, 0, M - 1), axis=0) * lmask
-            a_loc = a_loc - _mm(myl, newrow * cmask)
-            # ---- fold this panel's permutation into the global one
-            gp_shift = _roll_rows(gperm[:, None], k * nb)[:, 0]
-            gp_perm = jnp.take(gp_shift, perm)
-            gp_back = _roll_rows(gp_perm[:, None], -(k * nb))[:, 0]
-            gperm = jnp.where(rows_g < k * nb, gperm, gp_back)
-            return a_loc, gperm
+        def getcol(a_loc, k):
+            return lax.dynamic_slice(a_loc, (0, (k // q) * nb),
+                                     (ml * nb, nb))
+
+        def make_body(row0, col0):
+            # this stage's live window is the STATIC slice
+            # a_loc[row0:, col0:]; global col index of its local cols
+            wcols = jnp.arange(col0, nl * nb)
+            gcblk_w = (wcols // nb) * q + c
+
+            def body(k, carry):
+                a_loc, gperm, panel = carry     # panel: bcast column k
+                # shift so the diagonal block leads; zero the wrapped
+                # (already factored) rows so they never win a pivot
+                shifted = _roll_rows(panel, k * nb)
+                valid = (rows_g < M - k * nb)[:, None].astype(dt)
+                # ---- redundant panel LU (internal::getrf_panel analog)
+                lu_p, piv, perm = lax.linalg.lu(shifted * valid)
+                # ---- vectorized cross-mesh row swaps (permuteRows):
+                # destinations = top nb positions ∪ pivot targets (2nb)
+                drel = jnp.concatenate([jnp.arange(nb),
+                                        piv.astype(jnp.int32)])
+                srel = jnp.take(perm, drel).astype(jnp.int32)
+                dg = k * nb + drel
+                sg = k * nb + srel
+                own_s, lr_s = owned_lrow(sg)
+                fetched = jnp.take(a_loc, jnp.where(own_s, lr_s, 0),
+                                   axis=0)
+                fetched = lax.psum(fetched * own_s[:, None].astype(dt),
+                                   AXIS_P)
+                own_d, lr_d = owned_lrow(dg)
+                a_loc = a_loc.at[jnp.where(own_d, lr_d, ml * nb)].set(
+                    fetched, mode="drop")
+                # ---- write the factored panel column back (L21+L11\U11)
+                rel = grows - k * nb
+                myrows = jnp.take(lu_p, jnp.clip(rel, 0, M - 1), axis=0)
+                newcol = jnp.where((rel >= 0)[:, None], myrows,
+                                   getcol(a_loc, k))
+                written = lax.dynamic_update_slice(a_loc, newcol,
+                                                   (0, (k // q) * nb))
+                a_loc = jnp.where(k % q == c, written, a_loc)
+                # ---- trsm on block row k: U12 = L11^{-1} A12
+                # (src/getrf.cc:121+).  The post-swap pivot block row IS
+                # the first nb fetched rows — already replicated along
+                # 'p' by the swap psum, so no second block-row collective
+                rowblk = fetched[:nb, col0:]
+                l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=dt)
+                u12 = lax.linalg.triangular_solve(
+                    l11, rowblk, left_side=True, lower=True,
+                    unit_diagonal=True)
+                cmask = (gcblk_w > k).astype(dt)[None, :]
+                # keep columns j ≤ k from a_loc, not from the fetch: the
+                # fetch predates the panel writeback, so its copy of the
+                # factored column k is stale
+                cur = lax.dynamic_slice(
+                    a_loc[:, col0:], ((k // p) * nb, 0),
+                    (nb, nl * nb - col0))
+                newrow = cmask * u12 + (1 - cmask) * cur
+                upd = lax.dynamic_update_slice(
+                    a_loc[:, col0:], newrow, ((k // p) * nb, 0))
+                a_loc = jnp.where(k % p == r,
+                                  a_loc.at[:, col0:].set(upd), a_loc)
+                # ---- lookahead: update ONLY block column k+1 (narrow
+                # rank-nb gemm) and issue its broadcast — it depends on
+                # the swap fetch and the panel, never on the trailing
+                # update below, so the collective overlaps the trailing
+                # MXU contraction
+                myl = myrows * (rel >= nb)[:, None].astype(dt)
+                u_next = lax.dynamic_slice(
+                    newrow, (0, ((k + 1) // q) * nb - col0), (nb, nb))
+                # rows above the window are factored (zero in myl and
+                # masked off when the next step rolls the panel), so the
+                # narrow gemm and the broadcast ride the window only
+                coln = getcol(a_loc, k + 1)[row0:] - _mm(myl[row0:],
+                                                         u_next)
+                panel_next = bcast_block_col(
+                    coln, grows[row0:], (k + 1) % q == c, M)
+                # ---- trailing update on the live window only (the
+                # O(n³) hot loop, src/getrf.cc:142+)
+                win = a_loc[row0:, col0:]
+                win = win - _mm(myl[row0:], newrow * cmask)
+                a_loc = a_loc.at[row0:, col0:].set(win)
+                # ---- fold this panel's permutation into the global one
+                gp_shift = _roll_rows(gperm[:, None], k * nb)[:, 0]
+                gp_perm = jnp.take(gp_shift, perm)
+                gp_back = _roll_rows(gp_perm[:, None], -(k * nb))[:, 0]
+                gperm = jnp.where(rows_g < k * nb, gperm, gp_back)
+                return a_loc, gperm, panel_next
+
+            return body
 
         gperm0 = jnp.arange(M, dtype=jnp.int32)
-        # the loop body derives gperm from 'p'-gathered data, making it
+        # the loop body derives gperm from cross-mesh data, making it
         # device-varying in shard_map's type system; match the carry type
-        gperm0 = lax.pcast(gperm0, (AXIS_P, AXIS_Q), to="varying")
-        a_loc, gperm = lax.fori_loop(0, nt, body, (a_loc, gperm0))
+        gperm0 = pvary(gperm0, (AXIS_P, AXIS_Q))
+        carry = (a_loc, gperm0,
+                 bcast_block_col(getcol(a_loc, 0), grows, 0 % q == c, M))
+        a_loc, gperm, _ = staged_fori(bounds, p, q, nb, make_body, carry)
         # every device holds the same permutation; pmax makes that
         # replication visible to the type system for the P() out-spec
         gperm = lax.pmax(lax.pmax(gperm, AXIS_P), AXIS_Q)

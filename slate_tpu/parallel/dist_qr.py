@@ -5,9 +5,12 @@ TPU-native re-design of the reference's CAQR driver
 triangle-triangle tree across ranks, applied with ``unmqr``/``ttmqr``):
 
 * the rank-local panel + cross-rank reduction tree becomes a *redundant
-  panel factorization*: the block column is assembled on every device
-  with one masked ``psum`` (along 'q') + one ``all_gather`` (along 'p'),
-  then every device runs the same fused Householder panel
+  panel factorization*: the global block column is replicated with ONE
+  fused collective (:func:`~.dist_util.bcast_block_col` — the owner
+  column scatters its rows to global offsets and a single ``psum`` over
+  both mesh axes assembles the panel; the old masked-psum + all_gather
+  pair paid two serialized collective latencies), then every device
+  runs the same fused Householder panel
   (:func:`slate_tpu.linalg.qr._panel_geqrf`) and builds the compact-WY
   ``T`` (:func:`slate_tpu.linalg.qr.larft_rec`).  The tournament tree's
   purpose — avoiding per-column latency — is served by trading nb²·m
@@ -17,7 +20,15 @@ triangle-triangle tree across ranks, applied with ``unmqr``/``ttmqr``):
   reference's ``unmqr`` fan-out (``src/geqrf.cc:277``): each device
   forms its rows' contribution Vᴴ·C, one ``psum`` along 'p' makes the
   nb×n_loc inner product W, and the rank-k update V·(TᴴW) is one local
-  MXU matmul;
+  MXU matmul over the STATIC live window — the step loop is split into
+  a few unrolled stages with shrinking local trailing shapes
+  (:func:`~.dist_util.stage_bounds`), cutting masked-flop waste to
+  ≤ ~1.4× of the ideal shrinking count while keeping one jit;
+* OpenMP-task lookahead → the panel is DOUBLE-BUFFERED in the loop
+  carry: step k's body updates only block column k+1 with a narrow
+  rank-nb gemm off the replicated W slice and issues its broadcast
+  before the wide trailing contraction, so the collective for step k+1
+  overlaps the trailing MXU work in XLA's schedule;
 * ``pgels`` = forward sweep of Qᴴ over B + the distributed upper
   triangular solve from :mod:`.dist_lu` (reference ``gels_qr``,
   ``src/gels_qr.cc``).
@@ -34,14 +45,15 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .._jax_compat import pvary, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
 from ..linalg.qr import _panel_geqrf, larft_rec
 from ..ops.blocks import _ct, matmul as _mm
 from .dist import DistMatrix, distribute, like
-from .dist_lu import _build_plu_trsm, _gather_positions, _roll_rows
+from .dist_lu import _build_plu_trsm, _roll_rows
+from .dist_util import bcast_block_col, local_grows, stage_bounds, staged_fori
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
@@ -50,60 +62,86 @@ def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
     M = mtp * nb
-    pos = jnp.asarray(_gather_positions(mtp, p))
+    bounds = stage_bounds(nt)
 
     def kernel(a_loc):
         r = lax.axis_index(AXIS_P)
         c = lax.axis_index(AXIS_Q)
         dt = a_loc.dtype
-        j_idx = jnp.arange(nl) * q + c
-        lrows = jnp.arange(ml * nb)
-        grows = ((lrows // nb) * p + r) * nb + lrows % nb
+        grows = local_grows(ml, nb, p, r)
         rows_g = jnp.arange(M)
         rr = rows_g[:, None]
         cc = jnp.arange(nb)[None, :]
 
-        def body(k, carry):
-            a_loc, tmats, taus_all = carry
-            kq = k // q
-            # ---- assemble panel column k on every device
-            colk = lax.dynamic_slice(a_loc, (0, kq * nb), (ml * nb, nb))
-            ploc = lax.psum(colk * (k % q == c).astype(dt), AXIS_Q)
-            pg = lax.all_gather(ploc, AXIS_P, axis=0, tiled=True)
-            panel = jnp.take(pg.reshape(mtp, nb, nb), pos, axis=0)
-            panel = panel.reshape(M, nb)
-            shifted = _roll_rows(panel, k * nb)
-            valid = (rows_g < M - k * nb)[:, None].astype(dt)
-            # ---- redundant Householder panel + compact-WY T
-            packed, taus = _panel_geqrf(shifted * valid)
-            v_full = jnp.where(rr > cc, packed,
-                               jnp.where(rr == cc, 1, 0).astype(dt))
-            tmat = larft_rec(v_full, taus)
-            # ---- write the packed factor back into column k
-            rel = grows - k * nb
-            myrows = jnp.take(packed, jnp.clip(rel, 0, M - 1), axis=0)
-            newcol = jnp.where((rel >= 0)[:, None], myrows, colk)
-            written = lax.dynamic_update_slice(a_loc, newcol, (0, kq * nb))
-            a_loc = jnp.where(k % q == c, written, a_loc)
-            # ---- trailing update C ← (I − V·Tᴴ·Vᴴ)·C on columns j > k
-            v_loc = jnp.take(v_full, jnp.clip(rel, 0, M - 1), axis=0)
-            v_loc = v_loc * (rel >= 0)[:, None].astype(dt)
-            cmask = jnp.repeat(j_idx > k, nb).astype(dt)[None, :]
-            w = lax.psum(_mm(_ct(v_loc), a_loc * cmask), AXIS_P)
-            upd = _mm(v_loc, _mm(_ct(tmat), w))
-            a_loc = a_loc - upd * cmask
-            tmats = lax.dynamic_update_slice(
-                tmats, tmat[None], (k, 0, 0))
-            taus_all = lax.dynamic_update_slice(
-                taus_all, taus[None], (k, 0))
-            return a_loc, tmats, taus_all
+        def getcol(a_loc, k):
+            return lax.dynamic_slice(a_loc, (0, (k // q) * nb),
+                                     (ml * nb, nb))
 
-        tmats0 = lax.pcast(jnp.zeros((nt, nb, nb), a_loc.dtype),
-                           (AXIS_P, AXIS_Q), to="varying")
-        taus0 = lax.pcast(jnp.zeros((nt, nb), a_loc.dtype),
-                          (AXIS_P, AXIS_Q), to="varying")
-        a_loc, tmats, taus = lax.fori_loop(
-            0, nt, body, (a_loc, tmats0, taus0))
+        def make_body(row0, col0):
+            # this stage's live window is the STATIC slice
+            # a_loc[row0:, col0:]; global col block of its local cols
+            wcols = jnp.arange(col0, nl * nb)
+            gcblk_w = (wcols // nb) * q + c
+
+            def body(k, carry):
+                a_loc, tmats, taus_all, panel = carry
+                shifted = _roll_rows(panel, k * nb)
+                valid = (rows_g < M - k * nb)[:, None].astype(dt)
+                # ---- redundant Householder panel + compact-WY T
+                packed, taus = _panel_geqrf(shifted * valid)
+                v_full = jnp.where(rr > cc, packed,
+                                   jnp.where(rr == cc, 1, 0).astype(dt))
+                tmat = larft_rec(v_full, taus)
+                # ---- write the packed factor back into column k
+                rel = grows - k * nb
+                myrows = jnp.take(packed, jnp.clip(rel, 0, M - 1), axis=0)
+                newcol = jnp.where((rel >= 0)[:, None], myrows,
+                                   getcol(a_loc, k))
+                written = lax.dynamic_update_slice(a_loc, newcol,
+                                                   (0, (k // q) * nb))
+                a_loc = jnp.where(k % q == c, written, a_loc)
+                # ---- trailing update C ← (I − V·Tᴴ·Vᴴ)·C on cols j > k
+                # of the live window: one 'p'-axis psum makes the inner
+                # product W; rows above row0 have rel < 0 ⇒ V zero there
+                v_loc = jnp.take(v_full, jnp.clip(rel, 0, M - 1), axis=0)
+                v_loc = v_loc * (rel >= 0)[:, None].astype(dt)
+                cmask = (gcblk_w > k).astype(dt)[None, :]
+                cwin = a_loc[row0:, col0:] * cmask
+                w = lax.psum(_mm(_ct(v_loc[row0:]), cwin), AXIS_P)
+                tw = _mm(_ct(tmat), w)
+                # ---- lookahead: update ONLY block column k+1 (narrow
+                # rank-nb gemm off the replicated W slice) and issue its
+                # broadcast — no data dependence on the wide trailing
+                # contraction below, so XLA overlaps the collective with
+                # the trailing MXU work
+                u_next = lax.dynamic_slice(
+                    tw, (0, ((k + 1) // q) * nb - col0), (nb, nb))
+                # rows above the window are factored (zero in v_loc and
+                # masked off when the next step rolls the panel), so the
+                # narrow gemm and the broadcast ride the window only
+                coln = getcol(a_loc, k + 1)[row0:] - _mm(v_loc[row0:],
+                                                         u_next)
+                panel_next = bcast_block_col(
+                    coln, grows[row0:], (k + 1) % q == c, M)
+                # ---- wide trailing update on the live window
+                win = a_loc[row0:, col0:] - _mm(v_loc[row0:], tw) * cmask
+                a_loc = a_loc.at[row0:, col0:].set(win)
+                tmats = lax.dynamic_update_slice(
+                    tmats, tmat[None], (k, 0, 0))
+                taus_all = lax.dynamic_update_slice(
+                    taus_all, taus[None], (k, 0))
+                return a_loc, tmats, taus_all, panel_next
+
+            return body
+
+        tmats0 = pvary(jnp.zeros((nt, nb, nb), a_loc.dtype),
+                       (AXIS_P, AXIS_Q))
+        taus0 = pvary(jnp.zeros((nt, nb), a_loc.dtype),
+                      (AXIS_P, AXIS_Q))
+        carry = (a_loc, tmats0, taus0,
+                 bcast_block_col(getcol(a_loc, 0), grows, 0 % q == c, M))
+        a_loc, tmats, taus, _ = staged_fori(bounds, p, q, nb, make_body,
+                                            carry)
         # replicated values → invariant type for the P() out-specs
         if jnp.issubdtype(a_loc.dtype, jnp.complexfloating):
             unrep = lambda x: (lax.pmax(lax.pmax(x.real, AXIS_P), AXIS_Q)

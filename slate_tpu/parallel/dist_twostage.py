@@ -44,7 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax import shard_map
+from .._jax_compat import pvary, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
@@ -65,7 +65,7 @@ def _unrep(x):
 
 
 def _varying(x):
-    return lax.pcast(x, (AXIS_P, AXIS_Q), to="varying")
+    return pvary(x, (AXIS_P, AXIS_Q))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,10 @@
+"""Performance analysis helpers (lowered-HLO collective/flop profiling)."""
+
+from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
+                          ModuleProfile, profile_fn, profile_hlo_text,
+                          stablehlo_collective_shapes)
+
+__all__ = [
+    "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
+    "profile_fn", "profile_hlo_text", "stablehlo_collective_shapes",
+]

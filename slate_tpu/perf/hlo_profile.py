@@ -1,0 +1,288 @@
+"""Lowered-HLO collective / flop profiler for the distributed drivers.
+
+The reference project reads its comm behavior off MPI traces; here the
+whole communication schedule is a *compile-time artifact*, so regressions
+are visible without running anything: parse the compiled HLO of a driver
+and count, per while-loop body (= per factorization step),
+
+* collective ops (all-reduce / all-gather / reduce-scatter /
+  collective-permute / all-to-all), with element counts and bytes;
+* ``dot`` flops (2·M·N·K per contraction), the trailing-update currency.
+
+``tests/test_collective_profile.py`` pins per-driver budgets on these so
+a silent "one extra collective per step" or "full-size masked trailing
+gemm" regression fails CI instead of eating the ICI at scale — round 5's
+empty bench artifact proved runtime-only accounting is too fragile.
+
+XLA's ``cost_analysis()`` counts a while body ONCE, not per trip, so the
+per-body tallies here must be combined with externally-known trip counts
+(:func:`~slate_tpu.parallel.dist_util.stage_bounds` for the staged
+factorization loops); :meth:`ModuleProfile.stepped_totals` does exactly
+that.  The raw ``cost_analysis()`` flops are surfaced too
+(:attr:`ModuleProfile.cost_flops`) for one-shot (loop-free) programs.
+
+Works on the CPU-mesh simulation (conftest's 8 virtual devices) and on
+real TPU meshes alike — only the HLO text is inspected.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from math import prod
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = r"([a-z]+\d+|pred)\[([0-9,]*)\]"
+_COLLECTIVE_RE = re.compile(
+    r"= " + _SHAPE_RE + r"\S* (" + "|".join(COLLECTIVE_KINDS) + r")\(")
+_DOT_RE = re.compile(
+    r"= " + _SHAPE_RE + r"\S* dot\((.*)\), lhs_contracting_dims=\{([0-9,]*)\}")
+_CALL_RE = re.compile(r"(?:calls|to_apply|body|condition)=(%[\w.\-]+)")
+_WHILE_RE = re.compile(r"\bwhile\(.*body=(%[\w.\-]+)")
+_COMP_HEAD_RE = re.compile(r"^(ENTRY )?(%[\w.\-]+)\s*(?:\([^)]*\))?.*\{\s*$")
+
+
+def _dims(txt: str):
+    return tuple(int(d) for d in txt.split(",")) if txt else ()
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective instruction: kind, result dtype and shape."""
+
+    kind: str
+    dtype: str
+    shape: tuple
+
+    @property
+    def elems(self) -> int:
+        return prod(self.shape) if self.shape else 1
+
+    @property
+    def bytes(self) -> int:
+        return self.elems * _DTYPE_BYTES.get(self.dtype, 8)
+
+
+@dataclass(frozen=True)
+class DotOp:
+    """One ``dot`` instruction; ``flops`` uses the 2·M·N·K convention
+    (operation count — complex dots are counted as one op per MAC)."""
+
+    dtype: str
+    out_shape: tuple
+    contract: int
+
+    @property
+    def flops(self) -> int:
+        return 2 * (prod(self.out_shape) if self.out_shape else 1) \
+            * self.contract
+
+
+@dataclass
+class ComputationProfile:
+    """Tallies for one HLO computation, with kLoop/kOutput fusions (and
+    reduce appliers) flattened in.  Nested while loops are NOT folded in
+    — their bodies run an unknown number of trips; they are listed in
+    ``nested_whiles`` for the caller to resolve."""
+
+    name: str
+    collectives: list = field(default_factory=list)
+    dots: list = field(default_factory=list)
+    nested_whiles: list = field(default_factory=list)
+
+    @property
+    def collective_count(self) -> int:
+        return len(self.collectives)
+
+    @property
+    def collective_bytes(self) -> int:
+        return sum(op.bytes for op in self.collectives)
+
+    @property
+    def dot_flops(self) -> int:
+        return sum(op.flops for op in self.dots)
+
+
+@dataclass
+class ModuleProfile:
+    """Whole-module view: the entry tallies plus the entry's while-loop
+    bodies in program order (the staged factorization loops appear here
+    one per stage)."""
+
+    entry: ComputationProfile
+    loops: list                      # [ComputationProfile], program order
+    cost_flops: float | None = None  # cost_analysis(); while bodies ×1
+
+    @property
+    def step_loops(self):
+        """The communicating while bodies, program order — the staged
+        factorization loops.  (XLA's ScatterExpander also rewrites
+        scatters into entry-level while loops on CPU; those carry no
+        collectives and are filtered out here.)"""
+        return [b for b in self.loops if b.collective_count > 0]
+
+    def stepped_totals(self, trip_counts, bodies=None):
+        """Combine per-body tallies with trip counts (e.g. from
+        ``stage_bounds``): returns ``(collective_count, collective_bytes,
+        dot_flops)`` over the whole run, entry included.  ``bodies``
+        defaults to :attr:`step_loops`."""
+
+        bodies = self.step_loops if bodies is None else bodies
+        if len(trip_counts) != len(bodies):
+            raise ValueError(
+                f"{len(bodies)} loop bodies but {len(trip_counts)} "
+                "trip counts")
+        count = self.entry.collective_count
+        nbytes = self.entry.collective_bytes
+        flops = self.entry.dot_flops
+        for trips, body in zip(trip_counts, bodies):
+            count += trips * body.collective_count
+            nbytes += trips * body.collective_bytes
+            flops += trips * body.dot_flops
+        return count, nbytes, flops
+
+    @property
+    def all_collectives(self):
+        """Every collective in the module — entry plus each loop body
+        (each body counted once; combine with trip counts yourself)."""
+        ops = list(self.entry.collectives)
+        for body in self.loops:
+            ops += body.collectives
+        return ops
+
+    @property
+    def max_collective_elems(self) -> int:
+        """Largest collective result anywhere (the gather-everything
+        smell test: must stay well below the full matrix)."""
+        return max((op.elems for op in self.all_collectives), default=0)
+
+
+def _split_computations(hlo_text: str):
+    """``{name: [instruction lines]}`` plus the entry computation name."""
+
+    comps, entry = {}, None
+    cur, lines = None, None
+    for raw in hlo_text.splitlines():
+        if cur is None:
+            m = _COMP_HEAD_RE.match(raw)
+            if m:
+                cur, lines = m.group(2), []
+                if m.group(1):
+                    entry = cur
+        elif raw.startswith("}"):
+            comps[cur] = lines
+            cur, lines = None, None
+        else:
+            lines.append(raw.strip())
+    if entry is None and comps:
+        # post-optimization dumps mark entry with "ENTRY"; fall back to
+        # the last computation (HLO prints callees first)
+        entry = list(comps)[-1]
+    return comps, entry
+
+
+def _tally(name, comps, cache):
+    """ComputationProfile for ``name``, flattening fusion/apply calls
+    but keeping nested whiles symbolic."""
+
+    if name in cache:
+        return cache[name]
+    prof = ComputationProfile(name)
+    cache[name] = prof
+    for ln in comps.get(name, ()):
+        wm = _WHILE_RE.search(ln)
+        if wm:
+            prof.nested_whiles.append(wm.group(1))
+            continue
+        cm = _COLLECTIVE_RE.search(ln)
+        if cm:
+            prof.collectives.append(CollectiveOp(
+                kind=cm.group(3), dtype=cm.group(1),
+                shape=_dims(cm.group(2))))
+            continue    # a collective's to_apply region is scalar math
+        dm = _DOT_RE.search(ln)
+        if dm:
+            ops = re.findall(_SHAPE_RE + r"\S* %", dm.group(3))
+            contract = 1
+            if ops:
+                lhs_dims = _dims(ops[0][1])
+                cdims = _dims(dm.group(4))
+                contract = prod(lhs_dims[i] for i in cdims) if cdims else 1
+            prof.dots.append(DotOp(dtype=dm.group(1),
+                                   out_shape=_dims(dm.group(2)),
+                                   contract=contract))
+        for callee in _CALL_RE.findall(ln):
+            if callee == name:
+                continue
+            sub = _tally(callee, comps, cache)
+            prof.collectives += sub.collectives
+            prof.dots += sub.dots
+            prof.nested_whiles += sub.nested_whiles
+    return prof
+
+
+def profile_hlo_text(hlo_text: str) -> ModuleProfile:
+    """Parse compiled (post-optimization) HLO text into a
+    :class:`ModuleProfile`."""
+
+    comps, entry_name = _split_computations(hlo_text)
+    cache = {}
+    # entry tallied WITHOUT following while bodies (nested_whiles keeps
+    # them); loop bodies tallied independently, in program order
+    entry = _tally(entry_name, comps, cache)
+    loops = [_tally(b, comps, dict()) for b in entry.nested_whiles]
+    return ModuleProfile(entry=entry, loops=loops)
+
+
+def profile_fn(fn, *args, static_argnums=None) -> ModuleProfile:
+    """Lower + compile ``fn(*args)`` and profile the optimized HLO.
+    ``fn`` may be jitted or plain (it is jitted here); the
+    ``cost_analysis()`` flop figure rides along when available."""
+
+    import jax
+
+    jfn = fn if hasattr(fn, "lower") else \
+        jax.jit(fn, static_argnums=static_argnums)
+    compiled = jfn.lower(*args).compile()
+    prof = profile_hlo_text(compiled.as_text())
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        prof.cost_flops = float(cost.get("flops", 0.0))
+    except Exception:
+        prof.cost_flops = None
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# StableHLO (pre-compile lowering) support — shard_map programs keep
+# their collectives explicit at this level, but ops with reduction
+# regions (all_reduce) print across several lines, so a line-based scan
+# misses them; this scans the whole text.
+# ---------------------------------------------------------------------------
+
+_STABLE_RE = re.compile(
+    r'"?stablehlo\.(all_reduce|all_gather|reduce_scatter|'
+    r'collective_permute|all_to_all)"?.*?-> tensor<((?:[0-9]+x)*)'
+    r'([a-z]+\d+|complex<f\d+>)>',
+    re.S)
+
+
+def stablehlo_collective_shapes(lowered_text: str):
+    """``[(kind, elems)]`` for every collective in a StableHLO module,
+    robust to the multi-line region form of ``all_reduce``."""
+
+    out = []
+    for m in _STABLE_RE.finditer(lowered_text):
+        dims = [int(d) for d in m.group(2).split("x") if d]
+        out.append((m.group(1), prod(dims) if dims else 1))
+    return out

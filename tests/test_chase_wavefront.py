@@ -29,12 +29,22 @@ def _restore_env(prev):
         os.environ["SLATE_TPU_CHASE_SERIAL"] = prev
 
 
-def _band_wide(n, kd, seed):
+def _band_wide(n, kd, seed, dtype=np.float64):
     rng = np.random.default_rng(seed)
-    abw = np.zeros((n, 2 * kd + 2), dtype=np.float64)
+    abw = np.zeros((n, 2 * kd + 2), dtype=dtype)
     for d in range(kd + 1):
-        abw[:n - d, d] = rng.standard_normal(n - d)
+        v = rng.standard_normal(n - d)
+        if np.issubdtype(dtype, np.complexfloating) and d > 0:
+            v = v + 1j * rng.standard_normal(n - d)
+        abw[:n - d, d] = v      # Hermitian band: real diagonal
     return abw
+
+
+def _hb2st_full(abw, n, kd):
+    """Full chase via the dtype-generic range entry (the f64-only
+    ``hb2st_hh_banded`` fast path has no c128 twin; sweeping the whole
+    range runs the identical wavefront schedule)."""
+    return native.hb2st_hh_banded_range(abw, n, kd, 0, max(n - 2, 0))
 
 
 def _tb_band(n, kd, seed):
@@ -47,23 +57,28 @@ def _tb_band(n, kd, seed):
     return st
 
 
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128],
+                         ids=["f64", "c128"])
 @pytest.mark.parametrize("nthreads", [1, 2, 4])
-def test_hb2st_wavefront_bitwise_identity(nthreads):
+def test_hb2st_wavefront_bitwise_identity(nthreads, dtype):
+    """Both dtypes: a complex-only scheduling bug (the c128 chase is a
+    separate template instantiation) must not hide behind the loose
+    end-to-end pheev residual gates."""
     n, kd = 2048, 64
-    ab_ser = _band_wide(n, kd, 0)
+    ab_ser = _band_wide(n, kd, 0, dtype)
     ab_par = ab_ser.copy()
 
     prev = os.environ.get("SLATE_TPU_CHASE_SERIAL")
     os.environ["SLATE_TPU_CHASE_SERIAL"] = "1"
     try:
-        vs, ts, rs, ls = native.hb2st_hh_banded(ab_ser, n, kd)
+        vs, ts, rs, ls = _hb2st_full(ab_ser, n, kd)
     finally:
         _restore_env(prev)
 
     prev_thr = native.num_threads()
     native.set_num_threads(nthreads)
     try:
-        vp, tp, rp, lp = native.hb2st_hh_banded(ab_par, n, kd)
+        vp, tp, rp, lp = _hb2st_full(ab_par, n, kd)
     finally:
         native.set_num_threads(prev_thr)
 
@@ -74,10 +89,25 @@ def test_hb2st_wavefront_bitwise_identity(nthreads):
     np.testing.assert_array_equal(lp, ls)
 
 
-def test_hb2st_wavefront_range_identity():
+def test_hb2st_full_entry_matches_range_entry():
+    """The f64-only fast entry and the range entry over [0, n-2) must
+    produce the same chase (guards the shared schedule staying shared)."""
+    n, kd = 512, 32
+    ab_a = _band_wide(n, kd, 3)
+    ab_b = ab_a.copy()
+    out_a = native.hb2st_hh_banded(ab_a, n, kd)
+    out_b = _hb2st_full(ab_b, n, kd)
+    np.testing.assert_array_equal(ab_a, ab_b)
+    for a, b in zip(out_a, out_b):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128],
+                         ids=["f64", "c128"])
+def test_hb2st_wavefront_range_identity(dtype):
     """The checkpointed sweep-range path uses the wavefront too."""
     n, kd = 512, 32
-    ab_ser = _band_wide(n, kd, 1)
+    ab_ser = _band_wide(n, kd, 1, dtype)
     ab_par = ab_ser.copy()
     chunks = [(0, 100), (100, 317), (317, n - 2)]
 

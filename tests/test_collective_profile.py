@@ -1,0 +1,205 @@
+"""Per-driver collective/flop budgets for the lookahead-pipelined
+distributed factorizations, read off the COMPILED HLO.
+
+The reference reads its comm behavior off MPI traces; here the whole
+communication schedule is a compile-time artifact, so regressions are
+pinned without running anything (round 5 proved runtime-only accounting
+is too fragile — BENCH_r05.json came back empty):
+
+* one fused panel broadcast per factorization step — the single (M, nb)
+  ``psum`` of :func:`~slate_tpu.parallel.dist_util.bcast_block_col`,
+  down from the masked-psum + all_gather pair that paid two serialized
+  collective latencies;
+* a pinned TOTAL collective count per step body (pgetrf adds the swap
+  fetch, pgeqrf the Vᴴ·C inner-product reduce — and nothing else);
+* trailing-update flops within 1.5× of the ideal shrinking-trailing
+  count (down from ~3× for the old fixed full-size loop body), via the
+  staged windows of :func:`~slate_tpu.parallel.dist_util.stage_bounds`;
+* no collective anywhere materializes more than a panel;
+* residual gates for the rewritten drivers unchanged at ≤ 3·eps·n.
+
+All on the 2×4 CPU mesh — only HLO text is inspected, so the same
+numbers hold for the TPU lowering of the same program.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from slate_tpu.parallel import (distribute, make_grid_mesh, pgeqrf, pgesv,
+                                pposv, undistribute)
+from slate_tpu.parallel.dist_util import stage_bounds
+from slate_tpu.perf.hlo_profile import profile_fn
+
+# profile dims: nt = 32 steps keeps every stage boundary aligned to both
+# mesh axes (row0 multiples of p·nb, col0 of q·nb), so the staged
+# windows shrink on schedule instead of snapping wide
+P, Q = 2, 4
+N, NB = 512, 16
+NT = N // NB
+ML, NL = NT // P, NT // Q
+
+#: total collectives per step body: the fused panel broadcast, plus
+#: pgetrf's pivot-row swap fetch / pgeqrf's Vᴴ·C inner-product psum
+_STEP_COLLECTIVES = {"ppotrf": 1, "pgetrf": 2, "pgeqrf": 2}
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    return make_grid_mesh(P, Q)
+
+
+@pytest.fixture(scope="module")
+def profiles(mesh24):
+    """Compile each driver's shard_map kernel once; profile the HLO."""
+    from slate_tpu.parallel.dist_factor import _build_ppotrf
+    from slate_tpu.parallel.dist_lu import _build_pgetrf
+    from slate_tpu.parallel.dist_qr import _build_pgeqrf
+    data = jnp.zeros((N, N), jnp.float64)
+    out = {}
+    for name, build in (("ppotrf", _build_ppotrf),
+                        ("pgetrf", _build_pgetrf),
+                        ("pgeqrf", _build_pgeqrf)):
+        out[name] = profile_fn(build(mesh24, NB, NT, ML, NL, "float64"),
+                               data)
+    return out
+
+
+def _trips_and_windows():
+    """Per-stage trip counts and static local trailing-window shapes."""
+    bounds = stage_bounds(NT)
+    trips, wins = [], []
+    for s in range(len(bounds) - 1):
+        ks, ke = bounds[s], bounds[s + 1]
+        trips.append(ke - ks)
+        row0 = (ks // P) * NB
+        col0 = (ks // Q) * NB
+        wins.append((ML * NB - row0, NL * NB - col0))
+    return trips, wins
+
+
+def _ideal_trailing_flops():
+    """Global flops of an exactly-shrinking trailing update: step k
+    contracts the (n − (k+1)·nb)² remainder against the nb panel."""
+    return sum(2.0 * ((NT - 1 - k) * NB) ** 2 * NB for k in range(NT))
+
+
+@pytest.mark.parametrize("driver", sorted(_STEP_COLLECTIVES))
+def test_one_fused_panel_collective_per_step(profiles, driver):
+    """(a) of the PR-1 acceptance: the panel path costs exactly ONE
+    collective per factorization step — a single (M, nb) all-reduce
+    (bcast_block_col), not the old psum + all_gather pair — and the
+    step body's TOTAL collective count is pinned so a second hop cannot
+    sneak back in."""
+    prof = profiles[driver]
+    bodies = prof.step_loops
+    trips, _ = _trips_and_windows()
+    assert len(bodies) == len(trips), \
+        f"{driver}: expected {len(trips)} staged step loops, " \
+        f"got {len(bodies)}"
+    for body in bodies:
+        panel = [c for c in body.collectives
+                 if c.kind == "all-reduce" and c.shape == (N, NB)]
+        assert len(panel) == 1, \
+            f"{driver}: {len(panel)} (M, nb) panel broadcasts in " \
+            f"{body.name} (want exactly 1 — the fused bcast_block_col)"
+        assert body.collective_count == _STEP_COLLECTIVES[driver], \
+            f"{driver}: {body.collective_count} collectives per step " \
+            f"in {body.name} (budget {_STEP_COLLECTIVES[driver]}); " \
+            f"kinds: {[(c.kind, c.shape) for c in body.collectives]}"
+
+
+@pytest.mark.parametrize("driver", sorted(_STEP_COLLECTIVES))
+def test_trailing_flops_within_1p5x_of_shrinking_ideal(profiles, driver):
+    """(b) of the PR-1 acceptance: each stage's trailing contraction has
+    the stage's STATIC shrunken window shape, and the whole run's
+    trailing flops stay within 1.5× of the ideal shrinking-trailing
+    count (the old fixed full-size masked body paid ~3×)."""
+    prof = profiles[driver]
+    trips, wins = _trips_and_windows()
+    total = 0.0
+    for body, trip, (rows, cols) in zip(prof.step_loops, trips, wins):
+        trailing = [d for d in body.dots
+                    if d.out_shape == (rows, cols) and d.contract == NB]
+        assert trailing, \
+            f"{driver}: no ({rows}, {cols})×{NB} trailing dot in " \
+            f"{body.name}; dots: {[(d.out_shape, d.contract) for d in body.dots]}"
+        total += trip * max(d.flops for d in trailing)
+    ratio = total * (P * Q) / _ideal_trailing_flops()
+    assert ratio <= 1.5, \
+        f"{driver}: trailing flops {ratio:.2f}× the shrinking ideal " \
+        "(budget 1.5×)"
+
+
+@pytest.mark.parametrize("driver", sorted(_STEP_COLLECTIVES))
+def test_no_collective_larger_than_a_panel(profiles, driver):
+    """Gather-everything smell test, now on COMPILED HLO: the largest
+    collective anywhere (entry included) is the (M, nb) panel."""
+    prof = profiles[driver]
+    assert prof.step_loops, f"{driver}: no communicating step loops"
+    assert prof.max_collective_elems <= N * NB, \
+        f"{driver}: a collective moves {prof.max_collective_elems} " \
+        f"elements (> panel = {N * NB})"
+
+
+# ---------------------------------------------------------------------------
+# Residual gates: the rewrite must not move the numerics (≤ 3·eps·n,
+# the reference's criterion test/test_gemm.cc:260).
+# ---------------------------------------------------------------------------
+
+def _scaled_res(a, x, b):
+    return np.linalg.norm(a @ x - b) / (
+        np.linalg.norm(a) * np.linalg.norm(x) + np.linalg.norm(b))
+
+
+def test_pposv_residual_gate(mesh24):
+    """ppotrf + both ptrsm sweeps (L then Lᴴ)."""
+    n, nb = 192, 16
+    g = _rng(40).standard_normal((n, n))
+    a = g @ g.T + n * np.eye(n)
+    b = _rng(41).standard_normal((n, 5))
+    _, x = pposv(a, b, mesh24, nb=nb)
+    xh = np.asarray(undistribute(x))
+    assert _scaled_res(a, xh, b) < 3 * np.finfo(np.float64).eps * n
+
+
+def test_pgesv_residual_gate(mesh24):
+    """pgetrf + the pivoted triangular solves."""
+    n, nb = 192, 16
+    a = _rng(42).standard_normal((n, n))
+    b = _rng(43).standard_normal((n, 5))
+    _, _, x = pgesv(a, b, mesh24, nb=nb)
+    xh = np.asarray(undistribute(x))
+    assert _scaled_res(a, xh, b) < 3 * np.finfo(np.float64).eps * n
+
+
+def test_pgeqrf_residual_gate(mesh24):
+    """pgeqrf factorization residual via the Gram identity
+    AᵀA = RᵀR (rank-revealing enough for a 3·eps·n gate, and needs no
+    explicit Q)."""
+    m, n, nb = 192, 96, 16
+    a = _rng(44).standard_normal((m, n))
+    da = distribute(a, mesh24, nb=nb, diag_pad=1.0,
+                    row_mult=Q, col_mult=P)
+    qr, _, _ = pgeqrf(da)
+    r = np.triu(np.asarray(undistribute(qr)))[:n, :n]
+    res = np.linalg.norm(a.T @ a - r.T @ r) / (
+        np.linalg.norm(a) ** 2)
+    assert res < 3 * np.finfo(np.float64).eps * m
+
+
+def test_phesv_residual_gate(mesh24):
+    """phetrf (lookahead-double-buffered Aasen window) + solve."""
+    from slate_tpu.parallel.dist_hesv import phesv
+    n, nb = 256, 32
+    g = _rng(45).standard_normal((n, n))
+    a = (g + g.T) / 2 + 0.1 * np.eye(n)
+    b = _rng(46).standard_normal((n, 3))
+    _, x = phesv(jnp.asarray(a), jnp.asarray(b), mesh24, nb=nb)
+    xh = np.asarray(jax.device_get(x))[:n, :3]
+    assert _scaled_res(a, xh, b) < 3 * np.finfo(np.float64).eps * n

@@ -10,12 +10,14 @@ from slate_tpu.parallel import (dist_aux, dist_band, dist_blas3,
                                 dist_factor, dist_hesv, dist_lu, dist_qr,
                                 dist_twostage, dist_util)
 
-#: names that look like drivers but take no DistMatrix (or are helpers)
+#: names that look like drivers but take no DistMatrix (or are helpers).
+#: predistribute and punmqr_conj ARE wrapped and must stay registered —
+#: exempting them here would mask an accidental registry removal.
 _EXEMPT = {
     "pstedc",            # takes (d, e, mesh) host vectors
-    "padded_tiles", "predistribute", "ptranspose", "peye",
+    "padded_tiles", "ptranspose", "peye",
     "pgemm_auto",        # distributes its own operands
-    "punmqr_conj",
+    "pvary",             # _jax_compat shim imported into the modules
 }
 
 

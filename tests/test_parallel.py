@@ -519,25 +519,19 @@ class TestCollectiveProfiles:
 
     def _collective_shapes(self, lowered: str):
         """Collective result sizes from StableHLO (shard_map programs)
-        or post-SPMD HLO (jit-with-shardings programs)."""
+        or post-SPMD HLO (jit-with-shardings programs).  StableHLO
+        parsing rides :mod:`slate_tpu.perf.hlo_profile` — all_reduce
+        prints as a multi-line region, which a line-based scan misses."""
         import re
-        shapes = []
-        for ln in lowered.splitlines():
-            if re.search(r"stablehlo\.(all_reduce|all_gather|"
-                         r"collective_permute|reduce_scatter|"
-                         r"all_to_all)", ln):
-                for dims in re.findall(r"tensor<([0-9x]+)xf(?:32|64)>",
-                                       ln):
-                    shapes.append(
-                        int(np.prod([int(d) for d in dims.split("x")])))
-            elif re.search(r"= f(?:32|64)\[[0-9,]*\][^=]*"
-                           r"(all-reduce|all-gather|collective-permute|"
-                           r"reduce-scatter|all-to-all)", ln):
-                m = re.search(r"= f(?:32|64)\[([0-9,]*)\]", ln)
-                if m and m.group(1):
-                    shapes.append(int(np.prod(
-                        [int(d) for d in m.group(1).split(",")])))
-        return shapes
+
+        from slate_tpu.perf.hlo_profile import (profile_hlo_text,
+                                                stablehlo_collective_shapes)
+        shapes = [elems for _, elems
+                  in stablehlo_collective_shapes(lowered)]
+        if shapes or "stablehlo" in lowered:
+            return shapes
+        prof = profile_hlo_text(lowered)
+        return [op.elems for op in prof.all_collectives]
 
     def _assert_no_full_gather(self, lowered, full_elems, label):
         shapes = self._collective_shapes(lowered)
@@ -592,5 +586,15 @@ class TestCollectiveProfiles:
             lambda a, b, c: _shard_rows(_combine(a, b, c), mesh8)
         ).lower(q1, q2, r).compile().as_text()
         # row-sharded gemms against a row-sharded R need column-space
-        # collectives but must never all-gather the n x n result
-        self._assert_no_full_gather(lowered, n * n, "pstedc merge")
+        # collectives but must never all-GATHER an n x n operand.  The
+        # contraction dim is sharded, so an all-REDUCE of the product is
+        # inherent (GSPMD may emit it at the concatenated (n, n) shape —
+        # same bytes as two (n/2, n) reduces); only a gather at full
+        # size would mean gather-everything-and-compute-locally.
+        from slate_tpu.perf.hlo_profile import profile_hlo_text
+        prof = profile_hlo_text(lowered)
+        ops = prof.all_collectives
+        assert ops, "pstedc merge: expected collectives in the program"
+        gathers = [op.elems for op in ops if op.kind == "all-gather"]
+        assert max(gathers, default=0) < n * n, \
+            "pstedc merge: an all-gather materializes the full matrix"
