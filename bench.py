@@ -51,9 +51,21 @@ or timeout mid-suite keeps every number already measured.  The final
 aggregate line — {"metric", "value", "unit", "vs_baseline", ...} — is
 unchanged and remains the LAST line, so existing parsers that read only
 the tail still work.
+
+Watchdog (the rest of the round-5 root cause: one hung potrf_fp64 ate
+the GLOBAL timeout): each routine runs under its own SIGALRM deadline
+(``SLATE_TPU_BENCH_ROUTINE_TIMEOUT_S``, default 900 s) with a bounded
+infra-retry count (one retry; deadline hits never retry), so a single
+hung kernel costs at most its own deadline and the suite keeps going.
+Each JSON line carries an ``"autotune"`` map of the backend decisions
+(:mod:`slate_tpu.perf.autotune`) made while that routine ran, and the
+aggregate line carries the full decision table — the measured numbers
+are attributable to the kernels that produced them.
 """
 
 import json
+import os
+import signal
 import sys
 import time
 import traceback
@@ -61,6 +73,105 @@ import traceback
 import numpy as np
 
 BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
+
+#: per-routine wall-clock deadline (seconds).  Each routine runs under
+#: its own SIGALRM watchdog so ONE hung kernel (the round-5 lesson:
+#: potrf_fp64 hung, consumed the driver's global timeout and zeroed the
+#: whole artifact) can never starve the routines after it — it times
+#: out alone, is recorded as an infra failure, and the suite moves on.
+ROUTINE_TIMEOUT_S = float(os.environ.get("SLATE_TPU_BENCH_ROUTINE_TIMEOUT_S",
+                                         "900"))
+
+
+class _RoutineTimeout(Exception):
+    pass
+
+
+def _partial_aggregate(sub, fails, infra):
+    """The aggregate line's load-bearing fields from whatever completed
+    so far — emitted by the hard watchdog so a hard hang still ends the
+    artifact with a parseable LAST-line aggregate (the tail-reader
+    contract) instead of a bare per-routine error line."""
+    headline_keys = [k for k in sub
+                     if k.startswith(("gemm_fp32", "potrf_fp32",
+                                      "getrf_fp32", "geqrf_fp32",
+                                      "gels_fp32"))]
+    vals = [sub[k] for k in headline_keys
+            if isinstance(sub[k], (int, float)) and sub[k] > 0]
+    geomean = float(np.exp(np.mean(np.log(vals)))) if vals else 0.0
+    return {
+        "metric": "factor_suite_fp32_geomean",
+        "value": round(geomean, 1),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(geomean / BASELINE_GFLOPS, 2),
+        "submetrics": dict(sub),
+        "partial": True,
+        "failed": list(fails) + [f"infra: {s}" for s in infra],
+        "autotune": _autotune_tags(set()),
+    }
+
+
+def _run_with_deadline(fn, seconds, name="", on_hard_hang=None):
+    """Run ``fn()`` under a SIGALRM deadline (main thread, POSIX).
+    Falls back to an unguarded call where SIGALRM is unavailable.
+
+    SIGALRM only interrupts Python bytecode: a hang INSIDE one blocking
+    C call (a libtpu RPC that never returns — the r5 potrf_fp64 mode)
+    never re-enters the interpreter, so the handler can't raise.  A
+    daemon-thread hard watchdog backstops that case at 1.5×deadline+60s:
+    it flushes this routine's infra line plus a partial AGGREGATE line
+    (``on_hard_hang``) and ``os._exit(0)``s — the artifact keeps every
+    number already measured AND ends in a parseable aggregate, and the
+    exit code stays 0 per the suite's infra-failures-never-fail
+    contract."""
+    if not hasattr(signal, "SIGALRM") or seconds <= 0:
+        return fn()
+
+    def _on_alarm(signum, frame):
+        raise _RoutineTimeout(f"exceeded {seconds:.0f}s routine deadline")
+
+    def _hard_exit():
+        try:
+            if on_hard_hang is not None:
+                on_hard_hang()
+        finally:
+            print(f"# {name}: hard-hung (uninterruptible C call); exiting "
+                  "to preserve the artifact", file=sys.stderr, flush=True)
+            os._exit(0)
+
+    import threading
+    hard = threading.Timer(1.5 * seconds + 60.0, _hard_exit)
+    hard.daemon = True
+    prev = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    hard.start()
+    try:
+        return fn()
+    finally:
+        hard.cancel()
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _autotune_tags(keys_before):
+    """Autotune decisions made since ``keys_before`` — the backends this
+    routine actually ran on (tagged into its JSON line)."""
+    try:
+        from slate_tpu.perf import autotune
+
+        dec = autotune.decisions()
+        return {k: v for k, v in dec.items() if k not in keys_before}
+    except Exception:
+        return {}
+
+
+def _autotune_keys():
+    try:
+        from slate_tpu.perf import autotune
+
+        return set(autotune.decisions())
+    except Exception:
+        return set()
 
 
 def _timeit(fn, args, iters):
@@ -74,22 +185,38 @@ def _timeit(fn, args, iters):
 
 
 def _run_routine(name, fn, sub, fails, infra):
-    """Run one routine with one retry; classify failures.
+    """Run one routine under its own watchdog with a bounded infra-error
+    retry count; classify failures.
 
     ``fn`` returns (label, gflops, scaled_resid [, extra_sub]).  Residual
     failures go to ``fails`` (the only thing that makes the suite exit
-    nonzero); infrastructure exceptions go to ``infra``.
+    nonzero); infrastructure exceptions go to ``infra``.  A routine that
+    hits its SIGALRM deadline is recorded as infra WITHOUT retry (a hung
+    kernel would just hang again and eat a second deadline).
     """
     last_err = None
+    keys_before = _autotune_keys()
+
+    def _on_hard_hang():
+        print(json.dumps({"routine": name,
+                          "error": "infra: hard-hung in a blocking C "
+                                   "call past the SIGALRM deadline",
+                          "autotune": _autotune_tags(keys_before)}),
+              flush=True)
+        print(json.dumps(_partial_aggregate(
+            sub, fails, infra + [f"{name}: hard-hung"])), flush=True)
+
     for attempt in range(2):
         try:
-            out = fn()
+            out = _run_with_deadline(fn, ROUTINE_TIMEOUT_S, name=name,
+                                     on_hard_hang=_on_hard_hang)
             label, gf, resid = out[0], out[1], out[2]
             if resid > 3.0:
                 fails.append(f"{name}: scaled_resid={resid:.3e} > 3")
                 print(json.dumps({"routine": name, "label": label,
                                   "error": "residual_gate",
-                                  "scaled_resid": float(resid)}),
+                                  "scaled_resid": float(resid),
+                                  "autotune": _autotune_tags(keys_before)}),
                       flush=True)
                 return None
             if len(out) > 3:   # auxiliary submetrics, gated like the rest
@@ -97,13 +224,19 @@ def _run_routine(name, fn, sub, fails, infra):
             sub[label] = round(gf, 1)
             # flush this routine's line NOW: a later timeout/SIGTERM must
             # never lose a number already measured (BENCH_r05 lesson) —
-            # aux submetrics ride along for the same reason
+            # aux submetrics and the autotuner's chosen backends ride
+            # along for the same reason
             line = {"routine": name, "label": label,
-                    "gflops": round(gf, 1), "scaled_resid": float(resid)}
+                    "gflops": round(gf, 1), "scaled_resid": float(resid),
+                    "autotune": _autotune_tags(keys_before)}
             if len(out) > 3:
                 line.update(out[3])
             print(json.dumps(line), flush=True)
             return gf
+        except _RoutineTimeout as e:  # hung kernel: no retry, move on
+            last_err = e
+            print(f"# {name} hit its routine deadline: {e}", file=sys.stderr)
+            break
         except Exception as e:  # infra: tunnel RPC, OOM, compile, ...
             last_err = e
             traceback.print_exc(file=sys.stderr)
@@ -111,7 +244,8 @@ def _run_routine(name, fn, sub, fails, infra):
                   file=sys.stderr)
     infra.append(f"{name}: {type(last_err).__name__}: {last_err}")
     print(json.dumps({"routine": name,
-                      "error": f"infra: {type(last_err).__name__}"}),
+                      "error": f"infra: {type(last_err).__name__}: {last_err}",
+                      "autotune": _autotune_tags(keys_before)}),
           flush=True)
     return None
 
@@ -481,6 +615,7 @@ def main():
         "vs_baseline": round(geomean / BASELINE_GFLOPS, 2),
         "submetrics": sub,
         "fraction_of_measured_gemm": peak,
+        "autotune": _autotune_tags(set()),   # full decision table
     }
     # regression tripwire (r4 lesson: geqrf silently lost 20% between
     # rounds): compare every submetric against the newest BENCH_r*.json

@@ -21,7 +21,11 @@ that knob is moot.  The knobs that matter on TPU instead:
   keep every tile op MXU-shaped.
 
 Env vars: ``SLATE_TPU_PRECISION`` ∈ {highest, high, default},
-``SLATE_TPU_NB`` (int).
+``SLATE_TPU_NB`` (int), and the tri-state backend knobs
+``SLATE_TPU_USE_PALLAS`` / ``SLATE_TPU_F64_MXU`` ∈ {auto, 1, 0}
+consumed by the autotuned dispatch layer
+(:mod:`slate_tpu.perf.autotune`; see also ``SLATE_TPU_AUTOTUNE``,
+``SLATE_TPU_AUTOTUNE_CACHE``, ``SLATE_TPU_AUTOTUNE_FORCE`` there).
 """
 
 from __future__ import annotations
@@ -52,17 +56,47 @@ def get_matmul_precision():
     return matmul_precision
 
 
+def _tri_state(env: str):
+    """Parse a force-off / force-on / auto knob: returns False, True or
+    the string ``"auto"`` (the default when the variable is unset or
+    unrecognised)."""
+    raw = os.environ.get(env, "auto").strip().lower()
+    if raw in ("1", "true", "on", "yes"):
+        return True
+    if raw in ("0", "false", "off", "no", ""):
+        return False
+    return "auto"
+
+
 #: Route hot tile batches through the hand-written Pallas kernels
 #: (:mod:`slate_tpu.ops.pallas_kernels`) instead of stock XLA ops.
-#: Default off: XLA's fusion covers the dense drivers well; flip on (or
-#: ``SLATE_TPU_USE_PALLAS=1``) to use the hand-tuned VMEM kernels.
-use_pallas = (os.environ.get("SLATE_TPU_USE_PALLAS", "0").lower()
-              not in ("0", "", "false", "off", "no"))
+#: Tri-state (``SLATE_TPU_USE_PALLAS``): ``auto`` (default) lets the
+#: autotuner (:mod:`slate_tpu.perf.autotune`) time Pallas against XLA
+#: per (op, shape, dtype) on TPU and cache the winner; ``1`` forces the
+#: Pallas kernels wherever they are shape-eligible (no timing); ``0``
+#: forces them off everywhere.
+use_pallas = _tri_state("SLATE_TPU_USE_PALLAS")
 
 #: Route real-fp64 2-D matmuls on TPU through the Ozaki-split MXU
 #: kernel (:mod:`slate_tpu.ops.ozaki`) instead of XLA's software fp64
 #: emulation (~3.5x faster at fp64-grade accuracy).  Off on CPU
-#: backends automatically (native fp64 there).  ``SLATE_TPU_F64_MXU=0``
-#: restores the emulated path.
-f64_mxu = (os.environ.get("SLATE_TPU_F64_MXU", "1").lower()
-           not in ("0", "", "false", "off", "no"))
+#: backends automatically (native fp64 there).  Tri-state
+#: (``SLATE_TPU_F64_MXU``): ``auto`` (default) lets the autotuner time
+#: Ozaki against the emulated dot per shape; ``1`` forces Ozaki on TPU;
+#: ``0`` restores the emulated path everywhere.
+f64_mxu = _tri_state("SLATE_TPU_F64_MXU")
+
+
+def use_pallas_mode() -> str:
+    """Resolve the tri-state :data:`use_pallas` knob to one of
+    ``"auto" | "on" | "off"`` (reading the module global so tests that
+    monkeypatch ``config.use_pallas = True/False`` keep working)."""
+    v = use_pallas
+    return "auto" if v == "auto" else ("on" if v else "off")
+
+
+def f64_mxu_mode() -> str:
+    """Resolve the tri-state :data:`f64_mxu` knob to
+    ``"auto" | "on" | "off"``."""
+    v = f64_mxu
+    return "auto" if v == "auto" else ("on" if v else "off")

@@ -51,22 +51,26 @@ def potrf(a, opts: Optional[Options] = None):
         from ..exceptions import SlateError
         raise SlateError(f"potrf requires a square matrix, got {full.shape}")
     # Method dispatch (reference method.hh / internal_potrf.cc:53-72:
-    # the diagonal factor goes to the vendor library): on TPU, Auto
-    # routes f32 through the fused Pallas panel path — the unrolled
-    # chol+inv diagonal kernel (~290 µs/512² vs ~1190 µs for XLA's
-    # cholesky) plus triangular-strip herk beats XLA's own blocked
-    # cholesky ~3× at n=8192.  Elsewhere (CPU mesh tests, f64/complex)
-    # Auto hands the factorization to XLA; "recursive" keeps the
-    # explicit nb recursion.
-    import jax as _jax
-    from .. import config
+    # the diagonal factor goes to the vendor library): under Auto the
+    # backend comes from the autotune table (method.select_backend →
+    # perf.autotune): f32 times the fused Pallas panel path (the
+    # unrolled chol+inv diagonal kernel, ~290 µs/512² vs ~1190 µs for
+    # XLA's cholesky at n=8192) against XLA's fused cholesky per
+    # (n, nb, dtype) key; fp64 times the f32-panel+Newton+Ozaki path
+    # against XLA's emulated-fp64 cholesky.  Off-TPU (CPU mesh tests,
+    # complex) Auto resolves to XLA with zero timing; "recursive"
+    # keeps the explicit nb recursion.
+    from ..method import select_backend
     from ..options import get_option
     method = get_option(opts, "method_factor", "auto")
+    nbsel = 512 if nb <= 256 else nb
     if method == "auto" and full.dtype == jnp.float32 and full.ndim == 2 \
-            and (config.use_pallas or _jax.default_backend() == "tpu"):
-        l = blocks.potrf_panels(full, 512 if nb <= 256 else nb)
+            and select_backend("potrf_panel", n=int(full.shape[-1]),
+                               nb=nbsel, dtype=full.dtype) == "pallas":
+        l = blocks.potrf_panels(full, nbsel)
     elif method == "auto" and full.dtype == jnp.float64 and full.ndim == 2 \
-            and config.f64_mxu and _jax.default_backend() == "tpu":
+            and select_backend("potrf_panel_f64", n=int(full.shape[-1]),
+                               nb=nbsel) == "ozaki_newton":
         # fp64 on TPU: f32 Pallas panel + fp64 Newton refinement, Ozaki
         # MXU trailing updates — replaces XLA's emulated-fp64 cholesky.
         # A panel whose f32 seed breaks down (SPD but cond ≳ 1/ε₃₂)
@@ -74,7 +78,7 @@ def potrf(a, opts: Optional[Options] = None):
         # every fp64-factorizable matrix still factors (genuinely
         # non-SPD input stays NaN there too — the info signal).
         from jax import lax as _lax
-        fast = blocks.potrf_panels_f64(full, 512 if nb <= 256 else nb)
+        fast = blocks.potrf_panels_f64(full, nbsel)
         l = _lax.cond(
             jnp.all(jnp.isfinite(fast)),
             lambda ops: ops[0],
@@ -123,25 +127,27 @@ def posv(a, b, opts: Optional[Options] = None):
     return fac, x
 
 
-def trtri(a, opts: Optional[Options] = None):
-    """Triangular inverse — reference ``slate::trtri`` (``src/trtri.cc``)."""
+def trtri(a, opts: Optional[Options] = None, hi: bool = False):
+    """Triangular inverse — reference ``slate::trtri`` (``src/trtri.cc``).
+    ``hi`` pins the assembly products to ``Precision.HIGHEST`` for
+    accuracy-critical callers (potri)."""
 
     uplo = _uplo_of(a)
     diag = _diag_of(a)
     nb = _nb(a, opts)
-    inv = blocks.trtri_rec(uplo, diag, _arr(a), nb)
+    inv = blocks.trtri_rec(uplo, diag, _arr(a), nb, hi=hi)
     inv = jnp.tril(inv) if uplo is Uplo.Lower else jnp.triu(inv)
     return _wrap_like(a, inv)
 
 
-def trtrm(a, opts: Optional[Options] = None):
+def trtrm(a, opts: Optional[Options] = None, hi: bool = False):
     """Triangular × triangular product Lᴴ·L / U·Uᴴ — reference
     ``slate::trtrm`` (``src/trtrm.cc``, LAPACK ``lauum``)."""
 
     uplo = _uplo_of(a)
     nb = _nb(a, opts)
     av = _arr(a)
-    out = blocks.lauum_rec(uplo, av, nb, conj=jnp.iscomplexobj(av))
+    out = blocks.lauum_rec(uplo, av, nb, conj=jnp.iscomplexobj(av), hi=hi)
     return _wrap_like(a, out)
 
 
@@ -149,11 +155,22 @@ def potri(a_factor, opts: Optional[Options] = None):
     """Hermitian-positive-definite inverse from the Cholesky factor —
     reference ``slate::potri`` (``src/potri.cc``): ``trtri`` then
     ``trtrm`` (A⁻¹ = L⁻ᴴ·L⁻¹).  Returns a HermitianMatrix (stored
-    triangle valid)."""
+    triangle valid).
+
+    Both stages run with products pinned to ``Precision.HIGHEST``: the
+    composition squares the per-stage forward error, and at the library
+    default (3-pass bf16 ``high``, ~1.3e-5 ≈ 110·ε₃₂ on the MXU) the
+    on-chip scaled residual measured past the reference tester's ≤ 3
+    gate while the same algorithm at true-f32 precision (CPU x32,
+    tester ``potri`` = 8.7e-2) sits three orders inside it — a
+    precision-threshold failure, not an algorithmic one.  potri is not
+    a throughput driver, so vendor-grade accuracy wins here (the same
+    trade :func:`slate_tpu.ops.blocks.matmul_hi` makes for the
+    refinement residuals)."""
 
     uplo = _uplo_of(a_factor)
-    inv_t = trtri(a_factor, opts)
-    prod = trtrm(inv_t, opts)
+    inv_t = trtri(a_factor, opts, hi=True)
+    prod = trtrm(inv_t, opts, hi=True)
     data = prod.data if isinstance(prod, BaseMatrix) else prod
     return HermitianMatrix(data, uplo=uplo,
                            mb=getattr(a_factor, "mb", 256),

@@ -121,7 +121,8 @@ def _panel_lu_pallas(a):
     """
 
     m, w = a.shape
-    from ..ops.pallas_kernels import getrf_panel_linv
+    from ..perf.autotune import kernel
+    getrf_panel_linv = kernel("getrf_panel_linv")
     # bucket the lane dimension to the next power of two: the recursion
     # produces ~n/nb distinct panel heights, and each distinct slab
     # shape is a separate Mosaic kernel compile (~40 s each); buckets
@@ -149,6 +150,9 @@ _PALLAS_PANEL_VMEM_BUDGET = 100 * 1024 * 1024
 
 def _use_pallas_panel(m: int, w: int, dtype) -> bool:
     import jax as _jax
+    from .. import config
+    if config.use_pallas_mode() == "off":
+        return False
     if not (dtype == jnp.float32 and w % 32 == 0 and m % 8 == 0
             and w >= 64 and m >= w and m <= _PALLAS_PANEL_MAX_M
             and m >= 3072 and _jax.default_backend() == "tpu"):
@@ -166,13 +170,18 @@ def _use_pallas_panel(m: int, w: int, dtype) -> bool:
 
 
 def _panel_lu_auto(a):
-    """Panel dispatch: the Pallas one-call leaf where it wins (TPU,
-    f32, tall panels — its per-step cost is flat in m, XLA's scales
-    with m, so short panels keep XLA's fused kernel).  Returns
-    ``(lu, perm)`` or ``(lu, perm, linv)`` — the recursion uses the
-    panel inverse to turn the u12 triangular solve into MXU gemms."""
+    """Panel dispatch through the autotune table
+    (:func:`slate_tpu.method.select_backend`): the Pallas one-call leaf
+    is timed against XLA's fused ``lax.linalg.lu`` per (m, w, dtype)
+    key wherever :func:`_use_pallas_panel` admits it (TPU, f32, tall
+    panels — its per-step cost is flat in m, XLA's scales with m, so
+    short panels keep XLA's fused kernel).  Returns ``(lu, perm)`` or
+    ``(lu, perm, linv)`` — the recursion uses the panel inverse to
+    turn the u12 triangular solve into MXU gemms."""
     m, w = a.shape
-    if _use_pallas_panel(m, w, a.dtype):
+    from ..method import select_backend
+    if select_backend("lu_panel", m=m, w=w, dtype=a.dtype,
+                      eligible=_use_pallas_panel(m, w, a.dtype)) == "pallas":
         return _panel_lu_pallas(a)
     return _panel_lu(a)
 
@@ -526,7 +535,9 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128):
     :func:`getrf_rec` contract.  Requires f32, min(m,n) % nb == 0.
     """
 
-    from ..ops.pallas_kernels import getrf_block_inplace, trtri_panel
+    from ..perf.autotune import kernel
+    getrf_block_inplace = kernel("getrf_block_inplace")
+    trtri_panel = kernel("trtri_panel")
 
     m, n = a.shape
     k = min(m, n)
@@ -588,9 +599,12 @@ def _use_scattered(av, nb: int) -> bool:
     from .. import config
     if os.environ.get("SLATE_TPU_SCATTERED_LU", "0") in ("0", "", "no"):
         return False
+    if config.use_pallas_mode() == "off":
+        return False      # the documented force-off escape hatch wins
     m, n = av.shape
     return (av.ndim == 2 and av.dtype == jnp.float32
-            and (config.use_pallas or _jax.default_backend() == "tpu")
+            and (config.use_pallas_mode() == "on"
+                 or _jax.default_backend() == "tpu")
             and min(m, n) % nb == 0 and m <= 16384 and m >= nb
             and m % min(m, 4096) == 0)   # kernel row-tile divisibility
 

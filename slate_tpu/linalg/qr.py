@@ -96,13 +96,15 @@ def larft_rec(v, tau):
     return jnp.where(zero[None, :], jnp.zeros((), dt), t)
 
 
-def _apply_block_reflector(v, t, c, *, forward: bool):
+def _apply_block_reflector(v, t, c, *, forward: bool, hi: bool = False):
     """C ← (I − V·T·Vᴴ)·C if forward else (I − V·Tᴴ·Vᴴ)·C — LAPACK
     ``larfb`` (Left; the Right side is handled by the callers via
-    transposition identities)."""
+    transposition identities).  ``hi`` pins the three products to
+    ``Precision.HIGHEST`` for the eig/svd back-transforms."""
 
+    mm = matmul_hi if hi else matmul
     tt = t if forward else _ct(t)
-    return c - matmul(v, matmul(tt, matmul(_ct(v), c)))
+    return c - mm(v, mm(tt, mm(_ct(v), c)))
 
 
 @partial(jax.jit, static_argnums=2)
@@ -111,13 +113,25 @@ def apply_reflector_chain(vts, cv, forward: bool):
     device dispatch for the whole chain): each (V, T) panel spans the
     last ``V.shape[0]`` rows of C.  ``forward`` applies Q (panels
     last-to-first), else Qᴴ.  Shared by ``unmqr``-style back-transforms
-    in the two-stage eig (``unmtr_he2hb``) and SVD (``unmbr_ge2tb``)."""
+    in the two-stage eig (``unmtr_he2hb``) and SVD (``unmbr_ge2tb``).
+
+    Products are pinned to ``Precision.HIGHEST``: the back-transform's
+    forward error lands on the eigen/singular vectors at full scale, and
+    the chain applies n/nb panels in sequence, so at the library default
+    (3-pass bf16 ``high``, ~1.3e-5 ≈ 110·ε₃₂ per product) the
+    accumulated error crosses the reference tester's ≤ 3·ε·n residual
+    gate on-chip once n/nb panels stack up — the round-5 ``heev``
+    quick-run failure (the same algorithm at true-f32 precision passes
+    with tester error 4.5e-2).  Cost: one HIGHEST-grade GEMM chain of
+    ~2n³ flops total, small next to stage 1's 4n³/3 and paid only by
+    eig/svd drivers — ``geqrf``/``unmqr`` keep the library precision."""
 
     n = cv.shape[0]
     seq = vts[::-1] if forward else vts
     for v, t in seq:
         r0 = n - v.shape[0]
-        tail = _apply_block_reflector(v, t, cv[r0:], forward=forward)
+        tail = _apply_block_reflector(v, t, cv[r0:], forward=forward,
+                                      hi=True)
         cv = jnp.concatenate([cv[:r0], tail], axis=0)
     return cv
 
@@ -229,7 +243,9 @@ def _cholqr2_panel(pan):
     (3-pass bf16) default would put a ~1e-5 floor under it.
     """
 
-    from ..ops.pallas_kernels import chol_inv_panel, lu_inv_panel
+    from ..perf.autotune import kernel
+    chol_inv_panel = kernel("chol_inv_panel")
+    lu_inv_panel = kernel("lu_inv_panel")
 
     mk, w = pan.shape
     gram = matmul_hi(_ct(pan), pan)
@@ -258,7 +274,7 @@ def _cholqr2_panel(pan):
     tau = -s * jnp.diag(lu)
     rprime = s[:, None] * r
     tinv = jnp.triu(matmul(_ct(y), y), 1) + jnp.diag(1.0 / tau)
-    from ..ops.pallas_kernels import trtri_panel
+    trtri_panel = kernel("trtri_panel")
     tmat = jnp.triu(trtri_panel(tinv[::-1, ::-1])[::-1, ::-1])
     return y, rprime, tau, tmat, dev
 
@@ -339,24 +355,29 @@ def geqrf(a, opts: Optional[Options] = None):
     Returns ``(packed, taus)`` with R on/above the diagonal and the
     Householder V below (unit lower).
 
-    Method dispatch (reference ``method.hh``): on TPU, Auto routes f32
-    through :func:`geqrf_panels` (shifted-CholQR² panels + Householder
-    reconstruction — all-MXU, no sequential panel); elsewhere Auto
-    hands the factorization to XLA's blocked geqrf (the vendor library
-    slot); "recursive" keeps the explicit-nb blocked recursion.
+    Method dispatch (reference ``method.hh``): under Auto the f32
+    backend comes from the autotune table
+    (:func:`slate_tpu.method.select_backend`): ``cholqr2`` =
+    :func:`geqrf_panels` (shifted-CholQR² panels + Householder
+    reconstruction — all-MXU, no sequential panel) timed against XLA's
+    blocked geqrf (the vendor library slot) per (m, n, nb, dtype) key;
+    off-TPU Auto resolves to XLA with zero timing.  "recursive" keeps
+    the explicit-nb blocked recursion.
     """
 
     from ..options import get_option
 
-    import jax as _jax
-    from .. import config
+    from ..method import select_backend
 
     av = as_array(a)
     method = get_option(opts, "method_factor", "auto")
+    nb = _nb(a, opts)
+    nbsel = 512 if nb <= 256 else nb
     if method == "auto" and av.dtype == jnp.float32 and av.ndim == 2 \
-            and (config.use_pallas or _jax.default_backend() == "tpu"):
-        nb = _nb(a, opts)
-        packed, taus = geqrf_panels(av, 512 if nb <= 256 else nb)
+            and select_backend("geqrf_panel", m=int(av.shape[0]),
+                               n=int(av.shape[1]), nb=nbsel,
+                               dtype=av.dtype) == "cholqr2":
+        packed, taus = geqrf_panels(av, nbsel)
     elif method == "auto":
         h, taus = jnp.linalg.qr(av, mode="raw")
         # numpy/LAPACK raw mode returns the F-order factor transposed

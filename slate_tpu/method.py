@@ -97,3 +97,28 @@ def select_svd(method: MethodSVD, m: int, n: int, want_vectors: bool) -> MethodS
     if method is not MethodSVD.Auto:
         return method
     return MethodSVD.DC if want_vectors else MethodSVD.QR
+
+
+def select_backend(op: str, **key) -> str:
+    """Measured backend selection for a multi-backend op site — the
+    autotuned sibling of the ``select_*`` shape heuristics above.
+
+    Where ``MethodGemm``/``MethodTrsm`` pick an *algorithm variant* from
+    problem shape (the reference's ``select_algo``), this picks the
+    *implementation* (XLA op vs Pallas VMEM kernel vs Ozaki fp64 split)
+    by timing the candidates once per (op, shape, dtype, precision) key
+    and caching the winner on disk — see
+    :mod:`slate_tpu.perf.autotune` for keys, candidates and env knobs.
+    Drivers call this instead of touching kernel modules directly, so
+    every dispatch is visible in one table.
+
+    Examples::
+
+        select_backend("potrf_panel", n=8192, nb=512, dtype=jnp.float32)
+        select_backend("lu_panel", m=8192, w=512, dtype=jnp.float32,
+                       eligible=True)
+    """
+
+    from .perf.autotune import select
+
+    return select(op, **key)

@@ -47,38 +47,38 @@ def _on_tpu() -> bool:
 
 
 def matmul(a, b):
-    """Dot with the configured precision (see :mod:`slate_tpu.config`).
+    """Dot with the configured precision (see :mod:`slate_tpu.config`),
+    backend-dispatched through the autotune table
+    (:mod:`slate_tpu.perf.autotune`).
 
-    Real-fp64 2-D products on TPU route through the Ozaki-split MXU
-    kernel (:mod:`slate_tpu.ops.ozaki`) unless ``config.f64_mxu`` is
-    off; XLA's software-emulated fp64 dot is the fallback (and the only
-    path for complex128 / batched operands).
-
-    With ``config.use_pallas`` on, plain 2-D tile-grid-aligned products
-    route through the hand-tuned VMEM kernel
-    (:func:`slate_tpu.ops.pallas_kernels.matmul`); everything else (and
-    the default) uses stock XLA dot, whose fusion already covers the
-    dense drivers well.
+    2-D real same-dtype products — the tile products and every blocked
+    driver's trailing update — ask :func:`~slate_tpu.perf.autotune.
+    choose_matmul` for the measured winner among stock XLA dot, the
+    hand-tuned Pallas VMEM kernel
+    (:func:`slate_tpu.ops.pallas_kernels.matmul`, f32-class tile-grid-
+    aligned shapes) and the Ozaki int8-slice fp64 MXU kernel
+    (:mod:`slate_tpu.ops.ozaki`, real fp64 on TPU).  The tri-state
+    ``config.use_pallas`` / ``config.f64_mxu`` knobs force a backend on
+    or off; complex and batched operands always take the XLA path.
     """
-    if (config.f64_mxu and a.ndim == 2 and b.ndim == 2
-            and a.dtype == jnp.float64 and b.dtype == jnp.float64
-            and _on_tpu()):
-        from .ozaki import matmul_f64
+    if (a.ndim == 2 and b.ndim == 2 and a.dtype == b.dtype
+            and jnp.issubdtype(a.dtype, jnp.floating)):
+        from ..perf.autotune import choose_matmul
 
-        return matmul_f64(a, b)
-    if (config.use_pallas and a.ndim == 2 and b.ndim == 2
-            and a.dtype == b.dtype
-            and jnp.issubdtype(a.dtype, jnp.floating)
-            and a.shape[0] % 128 == 0 and b.shape[1] % 128 == 0
-            and a.shape[1] % 128 == 0):
-        from .pallas_kernels import matmul as pallas_matmul
+        backend = choose_matmul(a.shape, b.shape, a.dtype)
+        if backend == "ozaki":
+            from .ozaki import matmul_f64
 
-        def blk(dim, pref):
-            return pref if dim % pref == 0 else 128
+            return matmul_f64(a, b)
+        if backend == "pallas":
+            from .pallas_kernels import matmul as pallas_matmul
 
-        return pallas_matmul(a, b, bm=blk(a.shape[0], 256),
-                             bn=blk(b.shape[1], 256),
-                             bk=blk(a.shape[1], 512))
+            def blk(dim, pref):
+                return pref if dim % pref == 0 else 128
+
+            return pallas_matmul(a, b, bm=blk(a.shape[0], 256),
+                                 bn=blk(b.shape[1], 256),
+                                 bk=blk(a.shape[1], 512))
     return jnp.matmul(a, b, precision=config.matmul_precision)
 
 
@@ -290,16 +290,35 @@ def her2k_rec(uplo: Uplo, alpha, a, b, beta, c, nb: int, conj: bool = True):
 # Triangular inverse and L^H·L / U·U^H products (potri ingredients)
 # ---------------------------------------------------------------------------
 
-def trtri_rec(uplo: Uplo, diag: Diag, a, nb: int):
+def trtri_rec(uplo: Uplo, diag: Diag, a, nb: int, hi: bool = False):
     """Blocked triangular inverse (ref driver ``src/trtri.cc``).
 
-    Base case solves T·X = I with the tile-level triangular solver, the
-    analog of the reference's lapack::trtri on a diagonal tile.
+    Base case: a lower non-unit f32 power-of-two tile dispatches through
+    the autotune table between the fused Pallas recursive-doubling
+    inverse (``pallas_kernels.trtri_panel``) and the XLA tile solver
+    (T·X = I with ``triangular_solve``) — the analog of the reference's
+    lapack::trtri on a diagonal tile.
+
+    ``hi=True`` pins the off-diagonal assembly products to
+    ``Precision.HIGHEST`` for accuracy-critical compositions (potri):
+    the inverse's forward error feeds those residuals at full scale, so
+    the library-default 3-pass-bf16 ``high`` (~1.3e-5) would put a
+    ~110·ε₃₂ floor under them.
     """
 
     n = a.shape[-1]
     unit = diag is Diag.Unit
+    mm = matmul_hi if hi else matmul
     if n <= nb:
+        if (a.ndim == 2 and uplo is Uplo.Lower and not unit
+                and a.dtype == jnp.float32 and n >= 32
+                and (n & (n - 1)) == 0):
+            from ..perf.autotune import choose_trtri_panel
+
+            if choose_trtri_panel(n, a.dtype) == "pallas":
+                from .pallas_kernels import trtri_panel
+
+                return trtri_panel(a)
         eye = jnp.eye(n, dtype=a.dtype)
         if a.ndim > 2:
             eye = jnp.broadcast_to(eye, a.shape)
@@ -309,50 +328,54 @@ def trtri_rec(uplo: Uplo, diag: Diag, a, nb: int):
     n1 = _split(n, nb)
     a11 = a[..., :n1, :n1]
     a22 = a[..., n1:, n1:]
-    x11 = trtri_rec(uplo, diag, a11, nb)
-    x22 = trtri_rec(uplo, diag, a22, nb)
+    x11 = trtri_rec(uplo, diag, a11, nb, hi)
+    x22 = trtri_rec(uplo, diag, a22, nb, hi)
     if uplo is Uplo.Lower:
         a21 = a[..., n1:, :n1]
-        x21 = -matmul(x22, matmul(a21, x11))
+        x21 = -mm(x22, mm(a21, x11))
         top = jnp.concatenate([x11, jnp.zeros_like(jnp.swapaxes(a21, -1, -2))], axis=-1)
         bot = jnp.concatenate([x21, x22], axis=-1)
     else:
         a12 = a[..., :n1, n1:]
-        x12 = -matmul(x11, matmul(a12, x22))
+        x12 = -mm(x11, mm(a12, x22))
         top = jnp.concatenate([x11, x12], axis=-1)
         bot = jnp.concatenate([jnp.zeros_like(jnp.swapaxes(a12, -1, -2)), x22], axis=-1)
     return jnp.concatenate([top, bot], axis=-2)
 
 
-def lauum_rec(uplo: Uplo, a, nb: int, conj: bool = True):
+def lauum_rec(uplo: Uplo, a, nb: int, conj: bool = True, hi: bool = False):
     """Triangular in-place product (LAPACK ``lauum``, reference
     ``internal::trtrm`` / ``src/trtrm.cc``): Lower → L^H·L, Upper → U·U^H.
     Result is Hermitian; the ``uplo`` triangle of the result is valid.
+    ``hi`` pins the products to ``Precision.HIGHEST`` (see
+    :func:`trtri_rec` — potri composes both stages, so their errors
+    multiply into its residual gate).
     """
 
     n = a.shape[-1]
+    mm = matmul_hi if hi else matmul
     if n <= nb:
         t = jnp.tril(a) if uplo is Uplo.Lower else jnp.triu(a)
-        return matmul(_t(t, conj), t) if uplo is Uplo.Lower else matmul(t, _t(t, conj))
+        return mm(_t(t, conj), t) if uplo is Uplo.Lower else mm(t, _t(t, conj))
     n1 = _split(n, nb)
     a11 = a[..., :n1, :n1]
     a22 = a[..., n1:, n1:]
-    r11 = lauum_rec(uplo, a11, nb, conj)
-    r22 = lauum_rec(uplo, a22, nb, conj)
+    r11 = lauum_rec(uplo, a11, nb, conj, hi)
+    r22 = lauum_rec(uplo, a22, nb, conj, hi)
     if uplo is Uplo.Lower:
         l21 = a[..., n1:, :n1]
         l22 = jnp.tril(a22)
         # (L^H L)_11 = L11^H L11 + L21^H L21 ; _21 = L22^H L21
-        r11 = r11 + matmul(_t(l21, conj), l21)
-        r21 = matmul(_t(l22, conj), l21)
+        r11 = r11 + mm(_t(l21, conj), l21)
+        r21 = mm(_t(l22, conj), l21)
         top = jnp.concatenate([r11, _t(r21, conj)], axis=-1)
         bot = jnp.concatenate([r21, r22], axis=-1)
     else:
         u12 = a[..., :n1, n1:]
         u22 = jnp.triu(a22)
         # (U U^H)_11 = U11 U11^H + U12 U12^H ; _12 = U12 U22^H
-        r11 = r11 + matmul(u12, _t(u12, conj))
-        r12 = matmul(u12, _t(u22, conj))
+        r11 = r11 + mm(u12, _t(u12, conj))
+        r12 = mm(u12, _t(u22, conj))
         top = jnp.concatenate([r11, r12], axis=-1)
         bot = jnp.concatenate([_t(r12, conj), r22], axis=-1)
     return jnp.concatenate([top, bot], axis=-2)

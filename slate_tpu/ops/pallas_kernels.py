@@ -6,10 +6,11 @@ the vendor batched GEMM behind ``internal_gemm.cc:383-689``).
 
 One backend replaces CUDA/HIP/omptarget: each kernel is a
 ``pl.pallas_call`` tiled to the MXU/VPU geometry (128-lane minor dim).
-Kernels run in interpret mode on CPU (CI) and compiled on TPU; the
-dense drivers use XLA ops by default (XLA's fusion already covers most
-of this), with these kernels as the hand-tuned path for the hot loops
-where staying in VMEM beats XLA's schedule (``config.use_pallas``).
+Kernels run in interpret mode on CPU (CI) and compiled on TPU.  On TPU
+they are first-class DEFAULT candidates: the autotune table
+(:mod:`slate_tpu.perf.autotune`) times each against its XLA sibling per
+(op, shape, dtype) key and dispatches to the measured winner, with the
+tri-state ``config.use_pallas`` knob forcing them on/off.
 
 All kernels assume shapes padded to the tile grid (the dense drivers
 pad; SLATE's cleanup-tile groups — ``internal_gemm.cc:448-689`` — become
@@ -562,8 +563,8 @@ def _trtri_panel_kernel(l_in_ref, inv_ref, *, nb, ib):
 def trtri_panel(l):
     """Inverse of an (nb, nb) f32 lower-triangular panel in one fused
     VMEM kernel — the companion of :func:`chol_inv_panel` for factor
-    layouts where L arrives pre-computed (config.use_pallas path).
-    nb must be a power of two ≥ 32."""
+    layouts where L arrives pre-computed (the autotuned
+    ``trtri_panel`` backend).  nb must be a power of two ≥ 32."""
 
     nb = l.shape[-1]
     ib = min(32, nb)

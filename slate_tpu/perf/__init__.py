@@ -1,4 +1,6 @@
-"""Performance analysis helpers (lowered-HLO collective/flop profiling)."""
+"""Performance helpers: lowered-HLO collective/flop profiling
+(:mod:`.hlo_profile`) and the autotuned backend dispatch table
+(:mod:`.autotune`)."""
 
 from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
                           ModuleProfile, profile_fn, profile_hlo_text,
@@ -6,5 +8,15 @@ from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
 
 __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
-    "profile_fn", "profile_hlo_text", "stablehlo_collective_shapes",
+    "autotune", "profile_fn", "profile_hlo_text",
+    "stablehlo_collective_shapes",
 ]
+
+
+def __getattr__(name):
+    # lazy: autotune pulls in jax.random/pallas bits only when used
+    if name == "autotune":
+        import importlib
+
+        return importlib.import_module(".autotune", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,799 @@
+"""Autotuned kernel dispatch: measured per-(op, shape, dtype) backend
+selection with a persistent decision cache.
+
+The library carries more than one implementation of its hot op sites —
+stock XLA ops, the hand-written Pallas VMEM kernels
+(:mod:`slate_tpu.ops.pallas_kernels`) and the Ozaki int8-slice fp64
+matmul (:mod:`slate_tpu.ops.ozaki`).  SLATE itself auto-selects among
+algorithm variants per problem (``method.hh`` → :mod:`slate_tpu.method`),
+and the tile-granularity literature ("Design in Tiles", BLASX) shows
+that backend selection — searched once, cached, then reused — is what
+turns hand-tuned kernels into delivered throughput.  This module is that
+search:
+
+* Every multi-backend op site asks :func:`select` (usually through
+  :func:`slate_tpu.method.select_backend`) for a backend name keyed by
+  ``(op, shape, dtype, precision)``.
+* On first use of a key the candidate implementations are **pruned**
+  (a candidate that fails to compile — e.g. a Mosaic VMEM overflow — or
+  that exceeds the library's scaled-residual accuracy guard is dropped
+  before any clock starts), then **timed** on synthetic operands of the
+  concrete shape, and the winner is recorded.
+* Decisions land in an in-process table AND an on-disk JSON cache keyed
+  by (jax version, jaxlib version, backend platform, platform/libtpu
+  version), so subsequent processes compile straight to the winning
+  backend with **zero timing repetitions**.  A version-key mismatch
+  invalidates the whole cache.
+
+Environment knobs:
+
+* ``SLATE_TPU_AUTOTUNE_CACHE`` — cache file path (default
+  ``$XDG_CACHE_HOME/slate_tpu/autotune.json``).
+* ``SLATE_TPU_AUTOTUNE`` — ``0`` disables timing: every decision falls
+  back to the first (heuristically preferred) eligible candidate.
+* ``SLATE_TPU_AUTOTUNE_FORCE`` — comma list of ``op=backend`` pairs
+  pinning decisions (e.g. ``matmul=pallas,potrf_panel=xla``).
+* ``SLATE_TPU_USE_PALLAS`` / ``SLATE_TPU_F64_MXU`` — tri-state
+  (``auto``/``1``/``0``) eligibility of the Pallas / Ozaki candidate
+  sets (:mod:`slate_tpu.config`).
+
+Timing never runs on non-TPU backends: there the candidate set collapses
+to the single heuristic default (Pallas kernels run in interpret mode on
+CPU and are only selected when forced), so CI and CPU users pay nothing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, NamedTuple, Optional
+
+__all__ = [
+    "AutotuneTable", "Candidate", "table", "reset_table", "select",
+    "decide", "decisions", "timing_reps", "kernel",
+    "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
+    "choose_lu_panel", "choose_trtri_panel", "choose_geqrf_panel",
+]
+
+#: timed repetitions per surviving candidate (after the compile/warm rep)
+_REPS = 2
+
+
+def _on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _enabled() -> bool:
+    return os.environ.get("SLATE_TPU_AUTOTUNE", "1").strip().lower() \
+        not in ("0", "false", "off", "no")
+
+
+def _forced(op: str) -> Optional[str]:
+    raw = os.environ.get("SLATE_TPU_AUTOTUNE_FORCE", "")
+    for part in raw.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            if k.strip() == op:
+                return v.strip()
+    return None
+
+
+_warned_forces: set = set()
+
+
+def _warn_bad_force(op: str, forced: str, names) -> None:
+    """A pin naming a backend this key doesn't offer (typo, or e.g.
+    ``matmul=ozaki`` on an f32 key) must not fail silently — the user
+    believes the pin is active.  Warn once per (op, value)."""
+    if (op, forced) not in _warned_forces:
+        _warned_forces.add((op, forced))
+        import warnings
+
+        warnings.warn(
+            f"SLATE_TPU_AUTOTUNE_FORCE pins {op}={forced!r} but this "
+            f"key's candidates are {names}; the pin is ignored here")
+
+
+def _version_key() -> dict:
+    """The cache validity key: any component changing (new jax, new
+    libtpu, different platform) invalidates every stored decision."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jl = "?"
+    platform, platform_version = "unknown", "unknown"
+    try:
+        dev = jax.devices()[0]
+        platform = dev.platform
+        client = getattr(dev, "client", None)
+        platform_version = getattr(client, "platform_version", "unknown")
+    except Exception:
+        pass
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jl,
+        "platform": platform,
+        "platform_version": str(platform_version),
+    }
+
+
+def _cache_path() -> str:
+    env = os.environ.get("SLATE_TPU_AUTOTUNE_CACHE")
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "slate_tpu", "autotune.json")
+
+
+def _key_str(op: str, key_parts) -> str:
+    return op + "|" + ",".join(str(p) for p in key_parts)
+
+
+class Candidate(NamedTuple):
+    """One backend candidate for a decision.
+
+    ``setup()`` builds probe operands and returns a zero-arg ``run()``
+    that executes one blocked repetition; a raised exception during
+    setup or the warm run prunes the candidate (compile failures).
+    ``check(out)``, when given, receives the warm run's output and
+    prunes the candidate when it returns False (accuracy guards).
+    """
+
+    name: str
+    setup: Callable[[], Callable[[], Any]]
+    check: Optional[Callable[[Any], bool]] = None
+
+
+class AutotuneTable:
+    """In-process decision table + on-disk persistence."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or _cache_path()
+        self.decisions: dict = {}       # key -> {"backend", "source", ...}
+        self.timing_reps = 0            # timed reps performed THIS process
+        self._persist: dict = {}        # subset of decisions worth saving
+        self._lock = threading.RLock()
+        self._load()
+
+    # -- persistence ------------------------------------------------------
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            return
+        if blob.get("version") != _version_key():
+            return                      # stale: different jax/libtpu/platform
+        stored = blob.get("decisions", {})
+        if not isinstance(stored, dict):
+            return
+        for k, v in stored.items():
+            if isinstance(v, dict) and "backend" in v:
+                self.decisions[k] = dict(v, source="cache")
+                self._persist[k] = v
+
+    def _save(self) -> None:
+        blob = {"version": _version_key(), "decisions": self._persist}
+        try:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            tmp = self.path + ".tmp.%d" % os.getpid()
+            with open(tmp, "w") as f:
+                json.dump(blob, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass                        # read-only FS: stay in-process only
+
+    # -- recording --------------------------------------------------------
+
+    def _record(self, op: str, key: str, backend: str, source: str,
+                times: Optional[dict] = None, persist: bool = False) -> str:
+        info = {"backend": backend, "source": source, "op": op}
+        if times:
+            info["times"] = times
+        self.decisions[key] = info
+        if persist:
+            self._persist[key] = {"backend": backend, "times": times or {}}
+            self._save()
+        return backend
+
+    # -- the decision engine ----------------------------------------------
+
+    def decide(self, op: str, key_parts, candidates, reps: int = _REPS) -> str:
+        """Resolve one decision.  ``candidates`` is an ordered list of
+        :class:`Candidate` — the first entry is the heuristic default
+        used when timing is disabled; when EVERY candidate fails the
+        ``"xla"`` entry (the stock-library backend) is preferred.
+        Returns the chosen backend name."""
+
+        key = _key_str(op, key_parts)
+        with self._lock:
+            hit = self.decisions.get(key)
+            names = [c.name for c in candidates]
+            forced = _forced(op)
+            if forced is not None:
+                if forced in names:
+                    if hit is None or hit.get("backend") != forced:
+                        self._record(op, key, forced, "forced")
+                    return forced
+                _warn_bad_force(op, forced, names)
+            # Only settled results pin a key: knob-derived records
+            # ("forced-config", "forced", "default") must not outlive
+            # the knob that produced them, so they re-resolve cheaply on
+            # the next call.  "all-pruned"/"only" stay sticky for the
+            # process — re-running failed probes on every trace-time
+            # call would stall the caller far worse than a conservative
+            # xla fallback does.
+            if hit is not None and hit["backend"] in names \
+                    and hit.get("source") in ("timed", "cache",
+                                              "all-pruned", "only"):
+                return hit["backend"]
+            if len(candidates) == 1:
+                return self._record(op, key, names[0], "only")
+            if not _enabled() or not _on_tpu():
+                # no measurement possible/wanted: heuristic default.
+                # (Interpret-mode Pallas timings on CPU are meaningless.)
+                return self._record(op, key, names[0], "default")
+            times: dict = {}
+            failures: dict = {}
+            for cand in candidates:
+                try:
+                    run = cand.setup()
+                    out = run()                       # compile + warm
+                    if cand.check is not None and not cand.check(out):
+                        failures[cand.name] = "accuracy-guard"
+                        continue
+                    ts = []
+                    for _ in range(reps):
+                        t0 = time.perf_counter()
+                        run()
+                        ts.append(time.perf_counter() - t0)
+                    self.timing_reps += reps
+                    times[cand.name] = min(ts)
+                except Exception as e:  # compile failure / OOM / ...
+                    failures[cand.name] = f"{type(e).__name__}: {e}"
+            if not times:
+                # every candidate pruned (probe OOM, compile outage):
+                # fall back to the stock-XLA backend when one is listed
+                # — it is the only candidate whose failure mode is
+                # shared with the non-autotuned library — else the
+                # heuristic first entry
+                safe = "xla" if "xla" in names else names[0]
+                return self._record(op, key, safe, "all-pruned",
+                                    times=failures or None)
+            winner = min(times, key=times.get)
+            rounded = {k: round(v, 6) for k, v in times.items()}
+            rounded.update({k: f"pruned: {v}" for k, v in failures.items()})
+            return self._record(op, key, winner, "timed", times=rounded,
+                                persist=True)
+
+
+_table: Optional[AutotuneTable] = None
+_table_lock = threading.Lock()
+
+
+def table() -> AutotuneTable:
+    global _table
+    with _table_lock:
+        if _table is None:
+            _table = AutotuneTable()
+        return _table
+
+
+def reset_table() -> None:
+    """Drop the in-process table (tests; the next :func:`table` call
+    re-reads the on-disk cache)."""
+    global _table
+    with _table_lock:
+        _table = None
+
+
+def decide(op: str, key_parts, candidates, reps: int = _REPS) -> str:
+    return table().decide(op, key_parts, candidates, reps)
+
+
+def decisions() -> dict:
+    """``{key: backend}`` snapshot of every decision made so far."""
+    return {k: v["backend"] for k, v in table().decisions.items()}
+
+
+def timing_reps() -> int:
+    return table().timing_reps
+
+
+def kernel(name: str):
+    """Registered accessor for Pallas leaf kernels used by backend
+    implementations that live outside :mod:`slate_tpu.ops` (e.g. the
+    CholQR² panel in ``linalg/qr.py``).  Routing those call sites here
+    keeps them enumerable: the registry-guard test asserts no module
+    outside ``ops/`` imports ``pallas_kernels``/``ozaki`` directly, so
+    every multi-backend site provably dispatches through this table."""
+    from ..ops import pallas_kernels as pk
+
+    return getattr(pk, name)
+
+
+# ---------------------------------------------------------------------------
+# Probe helpers
+# ---------------------------------------------------------------------------
+
+def _randn(shape, dtype, seed: int = 0):
+    import jax
+
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def _memo(cache: dict, name: str, mk):
+    """Per-decision probe memo: every candidate's setup() and check()
+    shares ONE set of probe operands instead of regenerating an O(n³)
+    input per use — halves-to-thirds first-use tuning cost and peak
+    probe memory.  ``_randn`` is seed-deterministic, so sharing changes
+    nothing numerically."""
+    if name not in cache:
+        cache[name] = mk()
+    return cache[name]
+
+
+def _bucket_dim(d: int) -> int:
+    """Next power of two ≥ d (floor 8) — the matmul decision-key
+    granularity.  The blocked recursions emit many distinct trailing-
+    update shapes; exact (m, k, n) keys would compile and probe both
+    candidates per shape on a cold cache (minutes of first-run stall on
+    TPU), while one decision per power-of-two bucket covers them with
+    log-many searches — the same bucketing ``linalg.lu``'s Pallas panel
+    applies to its lane dimension."""
+    return max(8, 1 << (int(d) - 1).bit_length())
+
+
+def _timed_call(fn, *args):
+    """Wrap a jitted fn + concrete args into a blocking zero-arg run()."""
+    import jax
+
+    jfn = jax.jit(fn)
+
+    def run():
+        return jax.block_until_ready(jfn(*args))
+
+    return run
+
+
+def _rel_fro(x, ref) -> float:
+    import jax.numpy as jnp
+
+    num = float(jnp.linalg.norm((x - ref).astype(jnp.float32)))
+    den = float(jnp.linalg.norm(ref.astype(jnp.float32))) or 1.0
+    return num / den
+
+
+def _precision_name() -> str:
+    from .. import config
+
+    return getattr(config.matmul_precision, "name",
+                   str(config.matmul_precision))
+
+
+
+def _static(op: str, key_parts, backend: str, source: str) -> str:
+    """Record a decision resolved without timing (heuristic default,
+    config-forced, ineligible shape) so every dispatch — not just the
+    timed ones — is visible in the table."""
+    tab = table()
+    key = _key_str(op, key_parts)
+    if key not in tab.decisions or tab.decisions[key]["backend"] != backend:
+        tab._record(op, key, backend, source)
+    return backend
+
+
+# ---------------------------------------------------------------------------
+# Op-site choosers.  Each returns a backend NAME; the call site maps the
+# name to its implementation.  Candidate order = heuristic preference
+# (what today's defaults pick), used when timing is off.
+# ---------------------------------------------------------------------------
+
+def choose_matmul(shape_a, shape_b, dtype) -> str:
+    """Backend for a 2-D real tile/trailing-update product:
+    ``"xla"`` | ``"pallas"`` (VMEM K-loop kernel) | ``"ozaki"``
+    (int8-slice fp64).  Also covers every recursive trailing update —
+    the blocked drivers' hot GEMMs all flow through
+    :func:`slate_tpu.ops.blocks.matmul`."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    # decide (and probe) at power-of-two-BUCKETED dims: one search
+    # covers every trailing-update shape in the bucket (see
+    # :func:`_bucket_dim`); eligibility still checks the ACTUAL dims
+    am, ak = int(shape_a[0]), int(shape_a[1])
+    an = int(shape_b[1])
+    m, k, n = _bucket_dim(am), _bucket_dim(ak), _bucket_dim(an)
+    dt = jnp.dtype(dtype)
+    key = (m, k, n, dt.name, _precision_name())
+    probes: dict = {}
+
+    def _ab():
+        return _memo(probes, "ab", lambda: (_randn((m, k), dt, 0),
+                                            _randn((k, n), dt, 1)))
+
+    if dt == jnp.float64:
+        mode = config.f64_mxu_mode()
+        if mode == "off":
+            return _static("matmul", key, "xla", "forced-config")
+        if not _on_tpu():
+            return _static("matmul", key, "xla", "default")
+        if mode == "on":
+            return _static("matmul", key, "ozaki", "forced-config")
+
+        def setup_ozaki():
+            from ..ops.ozaki import matmul_f64
+
+            return _timed_call(matmul_f64, *_ab())
+
+        def setup_xla():
+            return _timed_call(
+                lambda x, y: jnp.matmul(x, y,
+                                        precision=config.matmul_precision),
+                *_ab())
+
+        def check_ozaki(out):
+            import jax
+
+            ref = jax.jit(jnp.matmul)(*_ab())
+            # dropped-tail bound ~k·2⁻⁴⁸ relative; 1e-9 is ~30x slack
+            return _rel_fro(out, ref) < 1e-9
+
+        return decide("matmul", key, [
+            Candidate("ozaki", setup_ozaki, check_ozaki),
+            Candidate("xla", setup_xla),
+        ])
+
+    mode = config.use_pallas_mode()
+    eligible = (jnp.issubdtype(dt, jnp.floating)
+                and am % 128 == 0 and an % 128 == 0 and ak % 128 == 0)
+    if not eligible:
+        return "xla"
+    if mode == "off":
+        return _static("matmul", key, "xla", "forced-config")
+    if mode == "on":
+        return _static("matmul", key, "pallas", "forced-config")
+    if not _on_tpu():
+        return _static("matmul", key, "xla", "default")
+
+    def setup_pallas():
+        from ..ops.pallas_kernels import matmul as pallas_matmul
+
+        def blk(dim, pref):
+            return pref if dim % pref == 0 else 128
+
+        return _timed_call(
+            lambda x, y: pallas_matmul(x, y, bm=blk(m, 256), bn=blk(n, 256),
+                                       bk=blk(k, 512)), *_ab())
+
+    def setup_xla32():
+        return _timed_call(
+            lambda x, y: jnp.matmul(x, y, precision=config.matmul_precision),
+            *_ab())
+
+    def check_pallas(out):
+        import jax
+        from jax import lax
+
+        ref = jax.jit(lambda x, y: jnp.matmul(
+            x, y, precision=lax.Precision.HIGHEST))(*_ab())
+        # the kernel accumulates at HIGHEST in VMEM: agreement with the
+        # 6-pass XLA dot should be ~eps-grade; 1e-4 is the library gate
+        return _rel_fro(out, ref) < 1e-4
+
+    return decide("matmul", key, [
+        Candidate("xla", setup_xla32),
+        Candidate("pallas", setup_pallas, check_pallas),
+    ])
+
+
+def _spd_probe(n, dtype, seed=2):
+    import jax.numpy as jnp
+
+    g = _randn((n, n), dtype, seed)
+    return jnp.matmul(g, g.T) + n * jnp.eye(n, dtype=dtype)
+
+
+def _potrf_guard(spd, l, gate: float) -> bool:
+    """The reference tester's criterion on matvec probes:
+    ‖L(Lᵀx) − Ax‖ / (‖A‖·‖x‖·eps·n) ≤ gate."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not bool(jnp.all(jnp.isfinite(l))):
+        return False
+    n = spd.shape[-1]
+    eps = float(np.finfo(np.dtype(spd.dtype).name).eps)
+    x = _randn((n,), spd.dtype, 3)
+    lt = jnp.tril(l)
+    r = float(jnp.linalg.norm(lt @ (lt.T @ x) - spd @ x))
+    den = float(jnp.linalg.norm(spd)) * float(jnp.linalg.norm(x)) * eps * n
+    return r / max(den, 1e-300) <= gate
+
+
+def choose_potrf_panel(n: int, nb: int, dtype) -> str:
+    """f32 Cholesky driver backend: ``"pallas"`` (fused VMEM chol+inv
+    panel + triangular-strip trailing, :func:`ops.blocks.potrf_panels`)
+    vs ``"xla"`` (fused ``lax.linalg.cholesky``)."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (n, nb, dt.name, _precision_name())
+    mode = config.use_pallas_mode()
+    if mode == "off":
+        return _static("potrf_panel", key, "xla", "forced-config")
+    if mode == "on":
+        return _static("potrf_panel", key, "pallas", "forced-config")
+    if not _on_tpu():
+        return _static("potrf_panel", key, "xla", "default")
+
+    probes: dict = {}
+
+    def _spd():
+        return _memo(probes, "spd", lambda: _spd_probe(n, dt))
+
+    def setup_pallas():
+        from ..ops import blocks
+
+        return _timed_call(lambda x: blocks.potrf_panels(x, nb), _spd())
+
+    def setup_xla():
+        from jax import lax
+
+        return _timed_call(lambda x: jnp.tril(lax.linalg.cholesky(x)),
+                           _spd())
+
+    def check(out):
+        return _potrf_guard(_spd(), out, 3.0)
+
+    return decide("potrf_panel", key, [
+        Candidate("pallas", setup_pallas, check),
+        Candidate("xla", setup_xla),
+    ])
+
+
+def choose_potrf_panel_f64(n: int, nb: int) -> str:
+    """fp64 Cholesky driver backend on TPU: ``"ozaki_newton"`` (f32
+    Pallas panel + fp64 Newton refinement + Ozaki trailing gemms) vs
+    ``"xla"`` (software-emulated fp64 cholesky)."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    key = (n, nb, "float64", _precision_name())
+    mode = config.f64_mxu_mode()
+    if mode == "off":
+        return _static("potrf_panel_f64", key, "xla", "forced-config")
+    if not _on_tpu():
+        return _static("potrf_panel_f64", key, "xla", "default")
+    if mode == "on":
+        return _static("potrf_panel_f64", key, "ozaki_newton", "forced-config")
+
+    probes: dict = {}
+
+    def _spd():
+        return _memo(probes, "spd", lambda: _spd_probe(n, jnp.float64))
+
+    def setup_fast():
+        from ..ops import blocks
+
+        return _timed_call(lambda x: blocks.potrf_panels_f64(x, nb), _spd())
+
+    def setup_xla():
+        from jax import lax
+
+        return _timed_call(lambda x: jnp.tril(lax.linalg.cholesky(x)),
+                           _spd())
+
+    def check(out):
+        # 10·eps64 gate units (the bench's emulated-fp64 allowance)
+        return _potrf_guard(_spd(), out, 30.0)
+
+    return decide("potrf_panel_f64", key, [
+        Candidate("ozaki_newton", setup_fast, check),
+        Candidate("xla", setup_xla),
+    ])
+
+
+def choose_lu_panel(m: int, w: int, dtype, eligible: bool) -> str:
+    """LU panel backend: ``"pallas"`` (one-call masked lane-major panel
+    with TRUE partial pivoting + L11⁻¹, ``getrf_panel_linv``) vs
+    ``"xla"`` (fused ``lax.linalg.lu``).  ``eligible`` is the call
+    site's shape/VMEM gate (``linalg.lu._use_pallas_panel``); when it
+    holds off-TPU the caller forced the gate open (tests/interpret
+    mode), so the Pallas leaf is honoured without timing."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (m, w, dt.name, _precision_name())
+    if not eligible:
+        return _static("lu_panel", key, "xla", "ineligible")
+    if config.use_pallas_mode() == "on":
+        return _static("lu_panel", key, "pallas", "forced-config")
+    if not _on_tpu():
+        return _static("lu_panel", key, "pallas", "gate-forced")
+
+    probes: dict = {}
+
+    def _a():
+        return _memo(probes, "a", lambda: _randn((m, w), dt, 4))
+
+    def setup_pallas():
+        from ..linalg.lu import _panel_lu_pallas
+
+        return _timed_call(lambda x: _panel_lu_pallas(x)[:2], _a())
+
+    def setup_xla():
+        from jax import lax
+
+        return _timed_call(lambda x: lax.linalg.lu(x)[::2], _a())
+
+    def check(out):
+        import numpy as np
+
+        lu, perm = map(np.asarray, out)
+        a = np.asarray(_a())
+        lmat = np.tril(lu, -1)[:, :w] + np.eye(m, w, dtype=lu.dtype)
+        res = np.linalg.norm(lmat @ np.triu(lu[:w]) - a[perm])
+        eps = float(np.finfo(np.dtype(dt.name)).eps)
+        return res / (np.linalg.norm(a) * eps * m + 1e-300) < 100.0
+
+    return decide("lu_panel", key, [
+        Candidate("pallas", setup_pallas, check),
+        Candidate("xla", setup_xla, check),
+    ])
+
+
+def choose_trtri_panel(n: int, dtype) -> str:
+    """Lower non-unit triangular-inverse tile backend: ``"pallas"``
+    (fused recursive-doubling VMEM ``trtri_panel``) vs ``"xla"``
+    (``triangular_solve`` against the identity).  Eligibility (f32,
+    power-of-two n ≥ 32, 2-D) is enforced by the call site
+    (:func:`slate_tpu.ops.blocks.trtri_rec`)."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (n, dt.name, _precision_name())
+    mode = config.use_pallas_mode()
+    if mode == "off":
+        return _static("trtri_panel", key, "xla", "forced-config")
+    if mode == "on":
+        return _static("trtri_panel", key, "pallas", "forced-config")
+    if not _on_tpu():
+        return _static("trtri_panel", key, "xla", "default")
+
+    probes: dict = {}
+
+    def _probe_l():
+        return _memo(probes, "l", lambda: jnp.tril(_randn((n, n), dt, 5))
+                     + 2 * n * jnp.eye(n, dtype=dt))
+
+    def setup_pallas():
+        from ..ops.pallas_kernels import trtri_panel
+
+        return _timed_call(trtri_panel, _probe_l())
+
+    def setup_xla():
+        from jax import lax
+
+        eye = jnp.eye(n, dtype=dt)
+        return _timed_call(
+            lambda t: lax.linalg.triangular_solve(
+                t, eye, left_side=True, lower=True), _probe_l())
+
+    def check(out):
+        import numpy as np
+
+        l = np.asarray(_probe_l())
+        x = np.tril(np.asarray(out))
+        eps = float(np.finfo(np.dtype(dt.name)).eps)
+        res = np.linalg.norm(x @ l - np.eye(n)) / (eps * n)
+        return res < 100.0          # well-conditioned probe: tight gate
+
+    return decide("trtri_panel", key, [
+        Candidate("xla", setup_xla),
+        Candidate("pallas", setup_pallas, check),
+    ])
+
+
+def choose_geqrf_panel(m: int, n: int, nb: int, dtype) -> str:
+    """f32 QR driver backend: ``"cholqr2"`` (shifted-CholQR² panels +
+    Householder reconstruction, :func:`linalg.qr.geqrf_panels`) vs
+    ``"xla"`` (fused blocked geqrf)."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (m, n, nb, dt.name, _precision_name())
+    mode = config.use_pallas_mode()
+    if mode == "off":
+        return _static("geqrf_panel", key, "xla", "forced-config")
+    if mode == "on":
+        return _static("geqrf_panel", key, "cholqr2", "forced-config")
+    if not _on_tpu():
+        return _static("geqrf_panel", key, "xla", "default")
+
+    probes: dict = {}
+
+    def _a():
+        return _memo(probes, "a", lambda: _randn((m, n), dt, 6))
+
+    def setup_cholqr2():
+        from ..linalg.qr import geqrf_panels
+
+        return _timed_call(lambda x: geqrf_panels(x, nb)[0], _a())
+
+    def setup_xla():
+        return _timed_call(
+            lambda x: jnp.swapaxes(jnp.linalg.qr(x, mode="raw")[0],
+                                   -1, -2), _a())
+
+    def check(out):
+        import numpy as np
+
+        a = np.asarray(_a())
+        r = np.triu(np.asarray(out)[:n])
+        x = np.asarray(_randn((n,), dt, 7))
+        eps = float(np.finfo(np.dtype(dt.name)).eps)
+        num = np.linalg.norm(a.T @ (a @ x) - r.T @ (r @ x))
+        den = (np.linalg.norm(a) ** 2 * np.linalg.norm(x)
+               * eps * np.sqrt(m)) + 1e-300
+        return num / den < 10.0
+
+    return decide("geqrf_panel", key, [
+        Candidate("cholqr2", setup_cholqr2, check),
+        Candidate("xla", setup_xla, check),
+    ])
+
+
+#: op name → chooser, the :func:`select` registry.  ``method.select_backend``
+#: is the driver-facing façade over this table.
+_CHOOSERS = {
+    "matmul": lambda **kw: choose_matmul(kw["shape_a"], kw["shape_b"],
+                                         kw["dtype"]),
+    "potrf_panel": lambda **kw: choose_potrf_panel(kw["n"], kw["nb"],
+                                                   kw["dtype"]),
+    "potrf_panel_f64": lambda **kw: choose_potrf_panel_f64(kw["n"], kw["nb"]),
+    "lu_panel": lambda **kw: choose_lu_panel(kw["m"], kw["w"], kw["dtype"],
+                                             kw["eligible"]),
+    "trtri_panel": lambda **kw: choose_trtri_panel(kw["n"], kw["dtype"]),
+    "geqrf_panel": lambda **kw: choose_geqrf_panel(kw["m"], kw["n"],
+                                                   kw["nb"], kw["dtype"]),
+}
+
+
+def select(op: str, **key) -> str:
+    """Resolve the backend for a named op site (see ``_CHOOSERS``)."""
+    try:
+        chooser = _CHOOSERS[op]
+    except KeyError:
+        raise KeyError(f"unknown autotune op {op!r}; "
+                       f"known: {sorted(_CHOOSERS)}") from None
+    return chooser(**key)

@@ -1,0 +1,296 @@
+"""Autotuned backend dispatch (slate_tpu/perf/autotune.py): decision
+engine, cache round-trip (a fresh importlib-reloaded module must reuse
+the on-disk winner with ZERO timing repetitions), stale-cache
+invalidation on version-key change, forced-choice env overrides, and
+default-config (``auto``) driver correctness."""
+
+import importlib
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import slate_tpu as st
+from slate_tpu.perf import autotune
+from slate_tpu.perf.autotune import Candidate
+
+
+@pytest.fixture
+def atab(tmp_path, monkeypatch):
+    """A fresh table bound to a tmp cache file; restored after."""
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    autotune.reset_table()
+    yield autotune
+    autotune.reset_table()
+
+
+def _toy(name, delay, result="out"):
+    def setup():
+        def run():
+            time.sleep(delay)
+            return result
+        return run
+    return Candidate(name, setup)
+
+
+class TestEngine:
+    def test_times_picks_winner_and_persists(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        got = atab.decide("toyop", (1, 2), [_toy("slow", 0.02),
+                                            _toy("fast", 0.0)])
+        assert got == "fast"
+        assert atab.timing_reps() > 0
+        blob = json.load(open(atab.table().path))
+        assert blob["version"] == atab._version_key()
+        assert blob["decisions"]["toyop|1,2"]["backend"] == "fast"
+        assert "slow" in blob["decisions"]["toyop|1,2"]["times"]
+
+    def test_cache_roundtrip_zero_timing_reps(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        atab.decide("toyop", (1, 2), [_toy("slow", 0.02), _toy("fast", 0.0)])
+        # "second process": drop the in-memory table, re-read the disk
+        # cache, and re-resolve the same key — no clock may start
+        atab.reset_table()
+        got = atab.decide("toyop", (1, 2),
+                          [_toy("slow", 0.02), _toy("fast", 0.0)])
+        assert got == "fast"
+        assert atab.timing_reps() == 0
+        assert atab.table().decisions["toyop|1,2"]["source"] == "cache"
+
+    def test_importlib_reloaded_module_reuses_cache(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        atab.decide("toyop", (3, 4), [_toy("slow", 0.02), _toy("fast", 0.0)])
+        # fresh module state, same env: the closest in-process stand-in
+        # for a new interpreter
+        mod = importlib.reload(importlib.import_module(
+            "slate_tpu.perf.autotune"))
+        try:
+            got = mod.decide("toyop", (3, 4),
+                             [_toy("slow", 0.02), _toy("fast", 0.0)])
+            assert got == "fast"
+            assert mod.timing_reps() == 0
+        finally:
+            mod.reset_table()
+
+    def test_stale_version_invalidates(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        atab.decide("toyop", (1, 2), [_toy("slow", 0.02), _toy("fast", 0.0)])
+        path = atab.table().path
+        blob = json.load(open(path))
+        blob["version"]["jax"] = "0.0.older"
+        json.dump(blob, open(path, "w"))
+        atab.reset_table()
+        atab.decide("toyop", (1, 2), [_toy("slow", 0.02), _toy("fast", 0.0)])
+        assert atab.timing_reps() > 0, \
+            "a version-key mismatch must retime, not reuse"
+
+    def test_forced_choice_env_override(self, atab, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "toyop=slow")
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        got = atab.decide("toyop", (1, 2),
+                          [_toy("slow", 0.02), _toy("fast", 0.0)])
+        assert got == "slow"
+        assert atab.timing_reps() == 0
+
+    def test_disabled_falls_back_to_heuristic_default(self, atab,
+                                                      monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE", "0")
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        got = atab.decide("toyop", (1, 2),
+                          [_toy("preferred", 0.02), _toy("fast", 0.0)])
+        assert got == "preferred"
+        assert atab.timing_reps() == 0
+
+    def test_accuracy_guard_prunes(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        bad = Candidate("bad", _toy("bad", 0.0).setup, lambda out: False)
+        good = Candidate("good", _toy("good", 0.01).setup, lambda out: True)
+        assert atab.decide("toyop2", (1,), [bad, good]) == "good"
+        info = atab.table().decisions["toyop2|1"]
+        assert "accuracy-guard" in str(info.get("times", {}))
+
+    def test_compile_failure_prunes(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+
+        def boom():
+            raise RuntimeError("Mosaic: VMEM overflow")
+
+        assert atab.decide("toyop3", (1,),
+                           [Candidate("broken", boom),
+                            _toy("good", 0.0)]) == "good"
+
+    def test_all_pruned_prefers_stock_xla(self, atab, monkeypatch):
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+
+        def boom():
+            raise RuntimeError("no")
+
+        got = atab.decide("toyop4", (1,), [Candidate("a", boom),
+                                           Candidate("xla", boom)])
+        assert got == "xla"
+        # xla-first ordering (matmul/trtri shape) must ALSO fall back to
+        # xla, not the pruned pallas candidate
+        got = atab.decide("toyop5", (1,), [Candidate("xla", boom),
+                                           Candidate("pallas", boom)])
+        assert got == "xla"
+
+    def test_lu_panel_force_on_skips_timing(self, atab, monkeypatch):
+        from slate_tpu import config as cfg
+        monkeypatch.setattr(cfg, "use_pallas", True)
+        monkeypatch.setattr(atab, "_on_tpu", lambda: True)
+        got = atab.choose_lu_panel(4096, 512, jnp.float32, eligible=True)
+        assert got == "pallas"
+        assert atab.timing_reps() == 0
+
+
+class TestBenchWatchdog:
+    def test_deadline_fires_and_passthrough(self):
+        bench = pytest.importorskip("bench")
+        assert bench._run_with_deadline(lambda: 42, 5) == 42
+
+        def hang():
+            time.sleep(3)
+            return "never"
+
+        t0 = time.perf_counter()
+        with pytest.raises(bench._RoutineTimeout):
+            bench._run_with_deadline(hang, 0.2)
+        assert time.perf_counter() - t0 < 2.5, \
+            "the watchdog must interrupt, not wait the routine out"
+
+    def test_partial_aggregate_is_parseable_last_line(self):
+        bench = pytest.importorskip("bench")
+        agg = bench._partial_aggregate(
+            {"gemm_fp32_n1024": 100.0, "potrf_fp32_n1024": 50.0,
+             "gemm_fp64_n512": 7.0}, [], ["potrf_fp64: hard-hung"])
+        assert agg["metric"] == "factor_suite_fp32_geomean"
+        assert agg["partial"] is True
+        # fp32 headline geomean only, like the full aggregate
+        assert agg["value"] == round(float(np.sqrt(100.0 * 50.0)), 1)
+        assert any("hard-hung" in f for f in agg["failed"])
+        json.dumps(agg)          # a tail-reading parser must accept it
+
+    def test_timeout_is_infra_not_residual_and_no_retry(self, capsys):
+        bench = pytest.importorskip("bench")
+        calls = []
+
+        def routine():
+            calls.append(1)
+            raise bench._RoutineTimeout("deadline")
+
+        sub, fails, infra = {}, [], []
+        got = bench._run_routine("hung", routine, sub, fails, infra)
+        assert got is None and not fails and len(infra) == 1
+        assert len(calls) == 1, "a deadline hit must not retry"
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["routine"] == "hung" and "infra" in line["error"]
+        assert "autotune" in line
+
+
+class TestConfigTriState:
+    def test_env_parse(self, monkeypatch):
+        from slate_tpu import config as cfg
+        for raw, want in (("auto", "auto"), ("1", True), ("on", True),
+                          ("0", False), ("off", False), ("", False)):
+            monkeypatch.setenv("SLATE_TPU_USE_PALLAS", raw)
+            mod = importlib.reload(cfg)
+            assert mod.use_pallas == want, raw
+        monkeypatch.delenv("SLATE_TPU_USE_PALLAS")
+        mod = importlib.reload(cfg)
+        assert mod.use_pallas == "auto"
+        assert mod.use_pallas_mode() == "auto"
+        assert mod.f64_mxu_mode() in ("auto", "on", "off")
+
+    def test_monkeypatched_bool_still_works(self, monkeypatch):
+        from slate_tpu import config as cfg
+        monkeypatch.setattr(cfg, "use_pallas", True)
+        assert cfg.use_pallas_mode() == "on"
+        monkeypatch.setattr(cfg, "use_pallas", False)
+        assert cfg.use_pallas_mode() == "off"
+
+
+class TestDispatchSites:
+    def test_matmul_force_on_routes_pallas(self, atab, monkeypatch):
+        from slate_tpu import config as cfg
+        from slate_tpu.ops import blocks
+        monkeypatch.setattr(cfg, "use_pallas", True)
+        rng = np.random.default_rng(0)
+        a = jnp.asarray(rng.standard_normal((128, 128)).astype(np.float32))
+        b = jnp.asarray(rng.standard_normal((128, 128)).astype(np.float32))
+        c = np.asarray(blocks.matmul(a, b))
+        ref = np.asarray(a) @ np.asarray(b)
+        assert np.abs(c - ref).max() / np.abs(ref).max() < 1e-5
+        key = "matmul|128,128,128,float32,HIGH"
+        assert atab.decisions().get(key) == "pallas"
+
+    def test_matmul_force_off_routes_xla(self, atab, monkeypatch):
+        from slate_tpu import config as cfg
+        from slate_tpu.ops import blocks
+        monkeypatch.setattr(cfg, "use_pallas", False)
+        a = jnp.zeros((128, 128), jnp.float32)
+        blocks.matmul(a, a)
+        key = "matmul|128,128,128,float32,HIGH"
+        assert atab.decisions().get(key) == "xla"
+
+    def test_auto_default_drivers_correct_and_zero_timing(self, atab):
+        """Tier-1-style proof: with the default config (tri-state
+        ``auto`` everywhere) the drivers stay correct and, off-TPU, the
+        autotuner performs ZERO timing repetitions — the acceptance
+        criterion for a cache-warm second process holds vacuously on
+        every non-TPU host."""
+        rng = np.random.default_rng(1)
+        n = 96
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+        eps = np.finfo(np.float32).eps
+
+        fac = st.potrf(st.HermitianMatrix(jnp.asarray(spd),
+                                          uplo=st.Uplo.Lower))
+        l = np.tril(np.asarray(fac.data))
+        r = np.linalg.norm(l @ l.T - spd) / (np.linalg.norm(spd) * eps * n)
+        assert r < 3
+
+        a = (rng.standard_normal((n, n)).astype(np.float32)
+             + n * np.eye(n, dtype=np.float32))
+        lu, perm = st.getrf(jnp.asarray(a))
+        luv = np.asarray(getattr(lu, 'array', lu))
+        lmat = np.tril(luv, -1) + np.eye(n, dtype=np.float32)
+        r = (np.linalg.norm(lmat @ np.triu(luv) - a[np.asarray(perm)])
+             / (np.linalg.norm(a) * eps * n))
+        assert r < 3
+
+        t = rng.standard_normal((2 * n, n)).astype(np.float32)
+        packed, taus = st.geqrf(jnp.asarray(t))
+        rmat = np.triu(np.asarray(getattr(packed, 'array', packed))[:n])
+        r = (np.linalg.norm(t.T @ t - rmat.T @ rmat)
+             / (np.linalg.norm(t) ** 2 * eps * np.sqrt(2 * n)))
+        assert r < 3
+
+        dec = atab.decisions()
+        assert any(k.startswith("potrf_panel|") for k in dec)
+        assert any(k.startswith("geqrf_panel|") for k in dec)
+        assert any(k.startswith("lu_panel|") for k in dec)
+        assert atab.timing_reps() == 0
+
+    def test_potri_highest_precision_gate(self, atab):
+        """The potri precision fix: both stages pinned to HIGHEST keep
+        the scaled residual inside the reference gate (the on-chip
+        failure was the 3-pass-bf16 library default leaking into the
+        inverse composition; on CPU this asserts the plumbing holds the
+        true-f32 grade)."""
+        rng = np.random.default_rng(2)
+        n = 64
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+        fac = st.potrf(st.HermitianMatrix(jnp.asarray(spd),
+                                          uplo=st.Uplo.Lower))
+        inv = st.potri(fac)
+        iv = np.asarray(inv.array)
+        iv = np.tril(iv) + np.tril(iv, -1).T
+        eps = np.finfo(np.float32).eps
+        r = (np.linalg.norm(iv @ spd - np.eye(n))
+             / (eps * n * np.linalg.cond(spd)))
+        assert r < 3

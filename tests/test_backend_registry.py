@@ -1,0 +1,88 @@
+"""Every multi-backend op site must dispatch through the autotune table
+(pattern of test_driver_wrapping.py: the kernel registry is easy to
+bypass by accident; this test catches a new call site that imports
+``pallas_kernels``/``ozaki`` directly instead of going through
+``slate_tpu.perf.autotune`` / ``method.select_backend``)."""
+
+import pathlib
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+import slate_tpu as st
+
+_PKG = pathlib.Path(st.__file__).resolve().parent
+
+#: modules allowed to name the kernel modules in import statements:
+#: the op layer itself (the kernels live there and ops/blocks.py IS the
+#: dispatch call site) and the autotune table (it times the kernels and
+#: serves them to registered backends via ``autotune.kernel``).
+_ALLOWED = {"ops", "perf/autotune.py"}
+
+_IMPORT_RE = re.compile(
+    r"^\s*(?:from\s+[\w.]*\s+import\s+.*\b(pallas_kernels|ozaki)\b"
+    r"|from\s+[\w.]*(pallas_kernels|ozaki)\s+import"
+    r"|import\s+[\w.]*(pallas_kernels|ozaki)\b)")
+
+
+def _is_allowed(rel: str) -> bool:
+    return rel.startswith("ops/") or rel in _ALLOWED
+
+
+def test_no_kernel_imports_outside_dispatch_layer():
+    offenders = []
+    for path in sorted(_PKG.rglob("*.py")):
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        if _is_allowed(rel):
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _IMPORT_RE.match(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "kernel modules imported outside the autotune dispatch layer "
+        "(route the site through perf.autotune / method.select_backend, "
+        "or fetch the leaf via autotune.kernel()):\n" + "\n".join(offenders))
+
+
+def test_multi_backend_sites_populate_autotune_table():
+    """Exercising each tunable op site must leave a decision entry —
+    proof the site consults the table rather than hard-coding a
+    backend.  On CPU every decision resolves heuristically (zero timing
+    reps), so this is cheap enough for the fast tier."""
+    from slate_tpu.perf import autotune
+    from slate_tpu.ops import blocks
+    from slate_tpu.enums import Diag, Uplo
+
+    autotune.reset_table()
+    rng = np.random.default_rng(0)
+
+    # tile/trailing-update matmul (f32, tile-grid aligned)
+    a32 = jnp.asarray(rng.standard_normal((128, 128)).astype(np.float32))
+    blocks.matmul(a32, a32)
+    # fp64 matmul (Ozaki vs emulated dot site)
+    a64 = jnp.asarray(rng.standard_normal((8, 8)), jnp.float64)
+    blocks.matmul(a64, a64)
+
+    n = 64
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+    fac = st.potrf(st.HermitianMatrix(jnp.asarray(spd), uplo=st.Uplo.Lower))
+
+    # trtri panel site (lower non-unit f32 power-of-two tile)
+    st.trtri(st.TriangularMatrix(jnp.asarray(np.tril(g) + 2 * n * np.eye(
+        n, dtype=np.float32)), uplo=Uplo.Lower, diag=Diag.NonUnit))
+
+    # LU panel site
+    st.getrf(jnp.asarray(g + n * np.eye(n, dtype=np.float32)))
+
+    # QR panel site
+    st.geqrf(jnp.asarray(rng.standard_normal((2 * n, n)).astype(np.float32)))
+
+    dec = autotune.decisions()
+    for op in ("matmul|128,128,128,float32",
+               "matmul|8,8,8,float64",
+               "potrf_panel|", "trtri_panel|", "lu_panel|", "geqrf_panel|"):
+        assert any(k.startswith(op) for k in dec), \
+            f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"
+    autotune.reset_table()
