@@ -86,6 +86,18 @@ use_pallas = _tri_state("SLATE_TPU_USE_PALLAS")
 #: ``0`` restores the emulated path everywhere.
 f64_mxu = _tri_state("SLATE_TPU_F64_MXU")
 
+#: Route eligible f32 partial-pivot LU factorizations through the
+#: scattered-row fused-panel driver (``linalg.lu.getrf_scattered`` —
+#: one Pallas invocation per panel step) instead of the blocked
+#: recursion.  Tri-state (``SLATE_TPU_SCATTERED_LU``): ``auto``
+#: (default) lets the autotuner time the two drivers per (m, n, nb,
+#: dtype) key on TPU and cache the winner; ``1`` forces the scattered
+#: driver wherever it is shape-eligible; ``0`` forces it off.  (Until
+#: round 6 this was a raw opt-in env read inside ``linalg/lu.py``;
+#: it now resolves through the ``lu_driver`` autotune decision like
+#: every other multi-backend site.)
+scattered_lu = _tri_state("SLATE_TPU_SCATTERED_LU")
+
 
 def use_pallas_mode() -> str:
     """Resolve the tri-state :data:`use_pallas` knob to one of
@@ -99,4 +111,11 @@ def f64_mxu_mode() -> str:
     """Resolve the tri-state :data:`f64_mxu` knob to
     ``"auto" | "on" | "off"``."""
     v = f64_mxu
+    return "auto" if v == "auto" else ("on" if v else "off")
+
+
+def scattered_lu_mode() -> str:
+    """Resolve the tri-state :data:`scattered_lu` knob to
+    ``"auto" | "on" | "off"``."""
+    v = scattered_lu
     return "auto" if v == "auto" else ("on" if v else "off")

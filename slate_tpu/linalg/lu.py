@@ -120,9 +120,28 @@ def _panel_lu_pallas(a):
     column gather at the end re-packs.
     """
 
+    return _panel_lu_lane_major(a, "getrf_panel_linv")
+
+
+def _panel_lu_fused(a):
+    """Partial-pivot panel factor through the fused mega-kernel
+    (:func:`~slate_tpu.ops.pallas_kernels.getrf_panel_fused` at k0=0):
+    the same contract as :func:`_panel_lu_pallas`, but the kernel's
+    grid iterates the panel's bb-wide column-block steps instead of
+    unrolling the whole width — one compilation per (w, m) bucket at a
+    fraction of the monolithic kernel's Mosaic compile time, and a
+    single-copy VMEM working set (the slab is held once, not
+    in+out)."""
+
+    return _panel_lu_lane_major(a, "getrf_panel_fused")
+
+
+def _panel_lu_lane_major(a, kernel_name: str):
+    """Shared pad-to-bucket / call / perm-assembly wrapper for the
+    lane-major scattered panel kernels."""
+
     m, w = a.shape
     from ..perf.autotune import kernel
-    getrf_panel_linv = kernel("getrf_panel_linv")
     # bucket the lane dimension to the next power of two: the recursion
     # produces ~n/nb distinct panel heights, and each distinct slab
     # shape is a separate Mosaic kernel compile (~40 s each); buckets
@@ -133,7 +152,11 @@ def _panel_lu_pallas(a):
     if m_pad != m:
         at = jnp.pad(at, ((0, 0), (0, m_pad - m)))
     act = (jnp.arange(m_pad) < m).astype(jnp.float32).reshape(1, m_pad)
-    out, piv, act_out, linv = getrf_panel_linv(at, act, ib=32)
+    if kernel_name == "getrf_panel_fused":
+        out, piv, act_out, linv = kernel(kernel_name)(
+            at, act, 0, nb=w, bb=min(128, w), ib=32)
+    else:
+        out, piv, act_out, linv = kernel(kernel_name)(at, act, ib=32)
     if m > w:
         # active (non-pivot) rows follow in original order
         rem = jnp.argsort(act_out[0, :m] < 0.5, stable=True)[: m - w]
@@ -169,20 +192,49 @@ def _use_pallas_panel(m: int, w: int, dtype) -> bool:
     return 2 * w * m_pad * 4 + scratch < _PALLAS_PANEL_VMEM_BUDGET
 
 
+def _use_fused_panel(m: int, w: int, dtype) -> bool:
+    """VMEM-budget eligibility of the fused mega-kernel as an
+    ``lu_panel`` candidate (:func:`_panel_lu_fused`): the shape gate of
+    :func:`_use_pallas_panel` plus the kernel's own grid divisibility
+    (bb=min(128, w) column-block steps), but the VMEM term differs —
+    the kernel holds the (w, m_pad) slab ONCE (aliased HBM carry, no
+    output copy) plus two (bb, m_pad) block scratches and the (w, w)
+    inverse pair, so wider panels fit."""
+    import jax as _jax
+    from .. import config
+    if config.use_pallas_mode() == "off":
+        return False
+    if not (dtype == jnp.float32 and w % 32 == 0 and m % 8 == 0
+            and w >= 64 and m >= w and m <= _PALLAS_PANEL_MAX_M
+            and m >= 3072 and (w <= 128 or w % 128 == 0)
+            and _jax.default_backend() == "tpu"):
+        return False
+    m_pad = max(512, 1 << (m - 1).bit_length())
+    bb = min(128, w)
+    scratch = (2 * bb * m_pad + 3 * w * w + 2 * bb * bb + 2 * m_pad) * 4
+    return w * m_pad * 4 + scratch < _PALLAS_PANEL_VMEM_BUDGET
+
+
 def _panel_lu_auto(a):
     """Panel dispatch through the autotune table
-    (:func:`slate_tpu.method.select_backend`): the Pallas one-call leaf
-    is timed against XLA's fused ``lax.linalg.lu`` per (m, w, dtype)
-    key wherever :func:`_use_pallas_panel` admits it (TPU, f32, tall
-    panels — its per-step cost is flat in m, XLA's scales with m, so
-    short panels keep XLA's fused kernel).  Returns ``(lu, perm)`` or
-    ``(lu, perm, linv)`` — the recursion uses the panel inverse to
-    turn the u12 triangular solve into MXU gemms."""
+    (:func:`slate_tpu.method.select_backend`): the Pallas one-call
+    leaves — the monolithic unrolled kernel (``pallas``) and the fused
+    grid-stepped mega-kernel (``pallas_fused``) — are timed against
+    XLA's fused ``lax.linalg.lu`` per (m, w, dtype) key wherever
+    :func:`_use_pallas_panel` / :func:`_use_fused_panel` admit them
+    (TPU, f32, tall panels — their per-step cost is flat in m, XLA's
+    scales with m, so short panels keep XLA's fused kernel).  Returns
+    ``(lu, perm)`` or ``(lu, perm, linv)`` — the recursion uses the
+    panel inverse to turn the u12 triangular solve into MXU gemms."""
     m, w = a.shape
     from ..method import select_backend
-    if select_backend("lu_panel", m=m, w=w, dtype=a.dtype,
-                      eligible=_use_pallas_panel(m, w, a.dtype)) == "pallas":
+    choice = select_backend("lu_panel", m=m, w=w, dtype=a.dtype,
+                            eligible=_use_pallas_panel(m, w, a.dtype),
+                            eligible_fused=_use_fused_panel(m, w, a.dtype))
+    if choice == "pallas":
         return _panel_lu_pallas(a)
+    if choice == "pallas_fused":
+        return _panel_lu_fused(a)
     return _panel_lu(a)
 
 
@@ -280,6 +332,31 @@ def _panel_lu_tntpiv(a, nb: int):
 # Blocked factorization
 # ---------------------------------------------------------------------------
 
+def _u12_with_linv(lu_top, linv, c):
+    """U₁₂ from the panel's unit-lower inverse: one MXU gemm plus one
+    residual-correction gemm pair at the library (HIGH) precision —
+    solve-grade accuracy (measured: XLA's trsm costs ~0.4 ms per
+    panel, 6.5 of getrf's 41 ms at n=8192).  Guarded (mirrors the
+    geqrf CholQR² devmax guard): ‖r₁‖∞/‖c‖∞ = ‖(I − L11·L11⁻¹)·c‖∞ /
+    ‖c‖∞ reuses the correction residual already computed; one Newton
+    step squares a small departure but cannot rescue a wrong inverse —
+    past the threshold the exact trsm takes over."""
+
+    n1 = lu_top.shape[0]
+    l11 = jnp.tril(lu_top, -1) + jnp.eye(n1, dtype=lu_top.dtype)
+    li = linv.astype(lu_top.dtype)
+    u12 = matmul(li, c)
+    r1 = c - matmul(l11, u12)
+    dev = jnp.max(jnp.abs(r1)) / jnp.maximum(
+        jnp.max(jnp.abs(c)), jnp.finfo(lu_top.dtype).tiny)
+    return lax.cond(
+        dev < 1e-2,
+        lambda _: u12 + matmul(li, r1),
+        lambda _: lax.linalg.triangular_solve(
+            lu_top, c, left_side=True, lower=True, unit_diagonal=True),
+        operand=None)
+
+
 def getrf_rec(a, nb: int, panel=_panel_lu_auto):
     """Blocked right-looking LU with row pivoting: a[perm] = L·U packed
     LAPACK-style (unit L strictly below, U on/above the diagonal).
@@ -311,30 +388,7 @@ def getrf_rec(a, nb: int, panel=_panel_lu_auto):
         linv = None
     right = a[perm1][:, n1:]           # permuteRows of the trailing block
     if linv is not None:
-        # panel kernel handed back L11⁻¹: the u12 triangular solve
-        # becomes one MXU gemm plus one residual-correction gemm pair
-        # at the library (HIGH) precision — solve-grade accuracy;
-        # measured: XLA's trsm costs ~0.4 ms per panel, 6.5 of getrf's
-        # 41 ms at n=8192
-        c = right[:n1]
-        l11 = jnp.tril(lu1[:n1], -1) + jnp.eye(n1, dtype=a.dtype)
-        li = linv.astype(a.dtype)
-        u12 = matmul(li, c)
-        r1 = c - matmul(l11, u12)
-        # guard the inverse path (mirrors the geqrf CholQR² devmax
-        # guard): ‖r₁‖∞/‖c‖∞ = ‖(I − L11·L11⁻¹)·c‖∞/‖c‖∞ reuses the
-        # correction residual already computed; one Newton step squares
-        # a small departure but cannot rescue a wrong inverse — past
-        # the threshold the exact trsm takes over
-        dev = jnp.max(jnp.abs(r1)) / jnp.maximum(
-            jnp.max(jnp.abs(c)), jnp.finfo(a.dtype).tiny)
-        u12 = lax.cond(
-            dev < 1e-2,
-            lambda _: u12 + matmul(li, r1),
-            lambda _: lax.linalg.triangular_solve(
-                lu1[:n1], c, left_side=True, lower=True,
-                unit_diagonal=True),
-            operand=None)
+        u12 = _u12_with_linv(lu1[:n1], linv, right[:n1])
     else:
         u12 = lax.linalg.triangular_solve(
             lu1[:n1], right[:n1], left_side=True, lower=True,
@@ -459,13 +513,17 @@ def _tall_panel_lu_pp(pan, ib: int = 64):
 
 def getrf_panels(a, nb: int = 512, tall_panel: str = "tournament"):
     """Right-looking blocked partial-pivot LU (loop form): per panel,
-    XLA's fused panel kernel (``lax.linalg.lu`` — the vendor ``getrf``
-    slot, ``internal_getrf.cc:75-92``) or, for panels taller than the
-    kernel's VMEM limit, either the CALU tournament (``tall_panel=
-    "tournament"``, the Auto default — stronger MXU utilisation, weaker
-    growth bound) or the true partial-pivot loop (``"pp"`` — what an
-    explicit ``MethodLU.PartialPiv`` request gets), then ONE permutation
-    gather of the sub-matrix rows and one big trailing gemm.  Returns
+    the autotuned panel leaf (:func:`_panel_lu_auto` — XLA's fused
+    ``lax.linalg.lu``, the vendor ``getrf`` slot
+    ``internal_getrf.cc:75-92``, vs the Pallas one-call leaves) or, for
+    panels taller than the kernel's VMEM limit, either the CALU
+    tournament (``tall_panel="tournament"``, the Auto default —
+    stronger MXU utilisation, weaker growth bound) or the true
+    partial-pivot loop (``"pp"`` — what an explicit
+    ``MethodLU.PartialPiv`` request gets), then ONE permutation gather
+    of the sub-matrix rows and one big trailing gemm.  When the Pallas
+    leaf wins it hands back the panel's L11⁻¹ and the u12 triangular
+    solve becomes MXU gemms (:func:`_u12_with_linv`).  Returns
     ``(lu, perm)`` with ``a[perm] = L·U``.
 
     The per-panel gather reads/rewrites the (m-k0)×n trailing slab —
@@ -480,13 +538,16 @@ def getrf_panels(a, nb: int = 512, tall_panel: str = "tournament"):
     for k0 in range(0, k, nb):
         w = min(nb, k - k0)
         pan = a[k0:, k0:k0 + w]
+        linv = None
         if pan.shape[0] > _MAX_LU_PANEL_ROWS:
             if tall_panel == "pp":
                 lu_p, pl = _tall_panel_lu_pp(pan)
             else:
                 lu_p, pl = _tall_panel_lu(pan)
         else:
-            lu_p, _, pl = lax.linalg.lu(pan)
+            out = _panel_lu_auto(pan)
+            lu_p, pl = out[0], out[1]
+            linv = out[2] if len(out) > 2 else None
         # one permutation gather of the sub-matrix rows (left L-blocks +
         # trailing); sequential transposition loops measured 5× worse
         # under jit (32k tiny device steps of pure latency)
@@ -494,9 +555,12 @@ def getrf_panels(a, nb: int = 512, tall_panel: str = "tournament"):
         body = body.at[:, k0:k0 + w].set(lu_p)
         gperm = gperm.at[k0:].set(gperm[k0:][pl])
         if k0 + w < n:
-            u12 = lax.linalg.triangular_solve(
-                lu_p[:w], body[:w, k0 + w:], left_side=True,
-                lower=True, unit_diagonal=True)
+            if linv is not None:
+                u12 = _u12_with_linv(lu_p[:w], linv, body[:w, k0 + w:])
+            else:
+                u12 = lax.linalg.triangular_solve(
+                    lu_p[:w], body[:w, k0 + w:], left_side=True,
+                    lower=True, unit_diagonal=True)
             body = body.at[:w, k0 + w:].set(u12)
             if w < body.shape[0]:
                 body = body.at[w:, k0 + w:].add(-matmul(lu_p[w:], u12))
@@ -510,68 +574,53 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128):
     (``src/getrf.cc:94-215``) that eliminates its per-panel row-swap
     traffic (``internal_swap.cc``):
 
-    * pivoting is LOGICAL: the Pallas block kernel
-      (:func:`~slate_tpu.ops.pallas_kernels.getrf_block_inplace`) picks
-      each pivot by masked argmax over the still-active rows and
-      retires it from the mask — no row ever moves (XLA's fused LU
-      panel and jax-level loop panels both cost ~30 µs per column step
-      in HBM round trips; the VMEM-resident masked step costs ~2 µs);
-    * the WHOLE matrix lives TRANSPOSED for the factorization and the
-      kernel factors its block row in place through an aliased HBM
-      buffer — the two lessons of the r4 perf campaign: per-block
-      transposes cost ~2 ms each, and an unaliased custom call makes
-      XLA copy the full carried matrix (~26 ms per call at n=8192);
-    * every triangular solve is a gemm against a fused explicit inverse
-      (``trtri_panel``) plus one residual-correction step (solve-grade
-      accuracy, all-MXU), with the trailing permutation applied inside
-      the U₁₂ operand gather;
+    * pivoting is LOGICAL: each pivot is the masked argmax over the
+      still-active rows and retires the row from the mask — no row
+      ever moves (XLA's fused LU panel and jax-level loop panels both
+      cost ~30 µs per column step in HBM round trips; the
+      VMEM-resident masked step costs ~2 µs);
+    * ONE Pallas invocation owns each panel's whole column-block loop
+      (:func:`~slate_tpu.ops.pallas_kernels.getrf_panel_fused`): the
+      grid iterates the bb-wide block steps over the VMEM-resident
+      panel, the HBM carry is aliased, and ``k0`` is a scalar operand
+      — one compilation and two DMAs per panel, replacing the r4/r5
+      per-block call chain (64 invocations at n=8192/nb=512) whose
+      glue — unaliased carry copies (~26 ms/block), per-block
+      transposes (~2 ms) — cost ~30 µs/step against the kernel's
+      measured 2.2 µs/step;
+    * the WHOLE matrix lives TRANSPOSED for the factorization (one
+      transpose in, one column gather + transpose out);
+    * the panel's unit-lower inverse rides out of the kernel, so every
+      trailing triangular solve is a gemm plus one residual-correction
+      step (solve-grade accuracy, all-MXU), with the trailing
+      permutation applied inside the U₁₂ operand gather;
     * the trailing update runs over ALL m rows with retired rows'
       multipliers zeroed (static-slice writes — no scatter of the big
-      trailing slab);
-    * ONE transpose in, and one column gather + transpose out
-      materialize the packed-LAPACK factor.
+      trailing slab).
 
     Returns ``(lu, perm)`` with ``a[perm] = L·U`` — the
-    :func:`getrf_rec` contract.  Requires f32, min(m,n) % nb == 0.
+    :func:`getrf_rec` contract.  Requires min(m,n) % nb == 0; f32 on
+    TPU (f32/f64 in interpret mode).
     """
 
     from ..perf.autotune import kernel
-    getrf_block_inplace = kernel("getrf_block_inplace")
-    trtri_panel = kernel("trtri_panel")
+    getrf_panel_fused = kernel("getrf_panel_fused")
 
     m, n = a.shape
     k = min(m, n)
     bb = min(bb, nb)
     assert nb % bb == 0, (nb, bb)   # blocks must tile the panel exactly
     at = a.T
-    act = jnp.ones((1, m), jnp.float32)
+    act = jnp.ones((1, m), a.dtype)
     pivs = []
     for k0 in range(0, k, nb):
-        panel_pivs = []
-        for b0 in range(0, nb, bb):
-            r0 = k0 + b0
-            at, piv_b, act = getrf_block_inplace(at, act, r0, bb=bb)
-            blk_t = at[r0:r0 + bb, :]
-            panel_pivs.append(piv_b)
-            if b0 + bb < nb:
-                l11 = (jnp.tril(blk_t[:, piv_b].T, -1)
-                       + jnp.eye(bb, dtype=a.dtype))
-                linv = trtri_panel(l11)
-                c1t = at[r0 + bb:k0 + nb, :][:, piv_b]
-                u12t = matmul_hi(c1t, linv.T)
-                u12t = u12t + matmul_hi(
-                    c1t - matmul_hi(u12t, l11.T), linv.T)
-                lmt = blk_t * act
-                at = at.at[r0 + bb:k0 + nb, :].add(-matmul(u12t, lmt))
-                at = at.at[r0 + bb:k0 + nb, piv_b].set(u12t)
-        piv = (jnp.concatenate(panel_pivs) if len(panel_pivs) > 1
-               else panel_pivs[0])
+        at, piv, act, linv = getrf_panel_fused(at, act, k0, nb=nb, bb=bb)
         pivs.append(piv)
         if k0 + nb < n:
             slab_t = at[k0:k0 + nb, :]
             l11 = (jnp.tril(slab_t[:, piv].T, -1)
                    + jnp.eye(nb, dtype=a.dtype))
-            linv = trtri_panel(l11)
+            linv = linv.astype(a.dtype)
             c1t = at[k0 + nb:, :][:, piv]
             u12t = matmul_hi(c1t, linv.T)
             u12t = u12t + matmul_hi(c1t - matmul_hi(u12t, l11.T),
@@ -588,25 +637,59 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128):
     return at[:, perm].T, perm
 
 
+#: panel width of the scattered driver (the fused kernel's nb)
+_SCATTERED_NB = 512
+
+
 def _use_scattered(av, nb: int) -> bool:
-    """The scattered-row driver handles f32 panels whose streaming
-    kernel fits VMEM (m ≤ 16384) and whose tile grid is uniform.
-    Opt-in for now (SLATE_TPU_SCATTERED_LU=1): the panel kernel's
-    Mosaic compile time is still being tuned, so the default TPU path
-    stays on :func:`getrf_rec` until the kernel is the proven win."""
-    import os
-    import jax as _jax
+    """Shape/VMEM ELIGIBILITY of the scattered-row fused-panel driver:
+    f32 matrices whose (nb, m) panel fits the kernel's VMEM budget
+    (m ≤ 16384) on a uniform tile grid.  Whether an eligible shape
+    actually takes the driver is the autotune table's decision
+    (``lu_driver`` op site, :func:`slate_tpu.perf.autotune.
+    choose_lu_driver`): timed against :func:`getrf_rec` on TPU, forced
+    with ``SLATE_TPU_SCATTERED_LU=1/0`` or
+    ``SLATE_TPU_AUTOTUNE_FORCE=lu_driver=scattered`` — no raw env read
+    lives here."""
     from .. import config
-    if os.environ.get("SLATE_TPU_SCATTERED_LU", "0") in ("0", "", "no"):
-        return False
     if config.use_pallas_mode() == "off":
         return False      # the documented force-off escape hatch wins
+    if av.ndim != 2:
+        return False
     m, n = av.shape
-    return (av.ndim == 2 and av.dtype == jnp.float32
-            and (config.use_pallas_mode() == "on"
-                 or _jax.default_backend() == "tpu")
+    return (av.dtype == jnp.float32
             and min(m, n) % nb == 0 and m <= 16384 and m >= nb
-            and m % min(m, 4096) == 0)   # kernel row-tile divisibility
+            and m % 8 == 0)              # kernel lane-tile divisibility
+
+
+def _getrf_partial(av, nb: int, raw_method=MethodLU.Auto):
+    """The PartialPiv driver dispatch: the scattered fused-panel driver
+    where the autotune table picks it (``lu_driver`` site), else the
+    tall-panel loop or the blocked recursion.  Shared by
+    :func:`getrf` and the bench harness so the measured path IS the
+    shipped path."""
+
+    from ..method import select_backend
+    m, n = (av.shape[0], av.shape[1]) if av.ndim == 2 else (0, 0)
+    driver = select_backend(
+        "lu_driver", m=m, n=n, nb=_SCATTERED_NB, dtype=av.dtype,
+        eligible=_use_scattered(av, _SCATTERED_NB))
+    if driver == "scattered":
+        # TPU f32 fast path: scattered-row partial pivoting (no swap
+        # traffic, one fused Pallas panel invocation per step) — LAPACK
+        # pivots up to magnitude ties (on an exact tie the kernel takes
+        # the lowest still-active physical row, LAPACK the first max in
+        # swapped order), same (lu, perm) contract
+        return getrf_scattered(av, _SCATTERED_NB)
+    if av.ndim == 2 and av.shape[0] > _MAX_LU_PANEL_ROWS:
+        # tall panels exceed XLA's scoped-VMEM fused-LU limit; under
+        # Auto the tournament (CALU) panel substitutes — documented,
+        # like the reference exposing tntpiv as a variant — while an
+        # EXPLICIT PartialPiv request keeps true partial pivoting via
+        # the inner-blocked loop panel
+        tall = "pp" if raw_method is MethodLU.PartialPiv else "tournament"
+        return getrf_panels(av, max(nb, 512), tall_panel=tall)
+    return getrf_rec(av, nb)
 
 
 def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
@@ -630,23 +713,7 @@ def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
     elif method is MethodLU.CALU:
         lu, perm = getrf_rec(av, nb, panel=lambda p: _panel_lu_tntpiv(p, nb))
     elif method is MethodLU.PartialPiv:
-        if _use_scattered(av, 512):
-            # TPU f32 fast path: scattered-row partial pivoting (no
-            # swap traffic, Pallas masked panel) — LAPACK pivots up to
-            # magnitude ties (on an exact tie the kernel takes the
-            # lowest still-active physical row, LAPACK the first max in
-            # swapped order), same (lu, perm) contract
-            lu, perm = getrf_scattered(av, 512)
-        elif av.ndim == 2 and av.shape[0] > _MAX_LU_PANEL_ROWS:
-            # tall panels exceed XLA's scoped-VMEM fused-LU limit; under
-            # Auto the tournament (CALU) panel substitutes — documented,
-            # like the reference exposing tntpiv as a variant — while an
-            # EXPLICIT PartialPiv request keeps true partial pivoting
-            # via the inner-blocked loop panel
-            tall = "pp" if raw_method is MethodLU.PartialPiv else "tournament"
-            lu, perm = getrf_panels(av, max(nb, 512), tall_panel=tall)
-        else:
-            lu, perm = getrf_rec(av, nb)
+        lu, perm = _getrf_partial(av, nb, raw_method)
     else:
         raise NotImplementedError(f"MethodLU.{method.name} is not implemented "
                                   "(supported: PartialPiv, CALU, NoPiv)")

@@ -116,7 +116,9 @@ def select_backend(op: str, **key) -> str:
 
         select_backend("potrf_panel", n=8192, nb=512, dtype=jnp.float32)
         select_backend("lu_panel", m=8192, w=512, dtype=jnp.float32,
-                       eligible=True)
+                       eligible=True, eligible_fused=True)
+        select_backend("lu_driver", m=8192, n=8192, nb=512,
+                       dtype=jnp.float32, eligible=True)
     """
 
     from .perf.autotune import select

@@ -342,9 +342,10 @@ def _block_inv_doubling(l_ref, inv_ref, nb, ib):
 
     log₂(nb/ib) levels, two (s,s) MXU products per combined pair — far
     fewer, larger products than row-block forward substitution.  Shared
-    by the fused chol+inv and trtri panel kernels."""
+    by the fused chol+inv, trtri and LU panel kernels (dtype follows
+    the refs: f32 on TPU, f32/f64 in interpret mode)."""
 
-    f32 = jnp.float32
+    f32 = jnp.promote_types(inv_ref.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
     s = ib
     while s < nb:
@@ -594,26 +595,37 @@ def trtri_panel(l):
 
 
 def _factor_block_lane_major(out_ref, act_out, piv_ref, ohsub,
-                             *, m, bb, ib):
+                             *, m, bb, ib, piv0=0):
     """Shared core: TRUE partial-pivot elimination of the (bb, m)
     lane-major block held in ``out_ref``, active mask in ``act_out``
-    (both updated in place); see :func:`_getrf_block_kernel`."""
+    (both updated in place); see :func:`_getrf_panel_fused_kernel`.
 
-    f32 = jnp.float32
+    ``piv0`` (static or traced) offsets the pivot writes into a wider
+    ``piv_ref`` — the fused panel kernel records all nb pivots of a
+    panel through one ref while each grid step eliminates one bb
+    block.  ``ohsub`` is a (bb, m) scratch: the one-hot pivot rows of
+    sub-block s land at rows [s·ib, (s+1)·ib), so the whole block's
+    one-hot matrix survives the call (the fused kernel's cross-block
+    trailing update needs it).  Dtype follows the refs (f32 on TPU;
+    f32/f64 in interpret mode)."""
+
+    dt = jnp.promote_types(out_ref.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
     iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
     iota_sub = jax.lax.broadcasted_iota(jnp.int32, (ib, 1), 0)
-    piv_cols = jax.lax.broadcasted_iota(jnp.int32, (1, bb), 1)
+    piv_cols = jax.lax.broadcasted_iota(
+        jnp.int32, (1, piv_ref.shape[-1]), 1)
     eye_ib = (jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 0)
               == jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 1)
-              ).astype(f32)
+              ).astype(dt)
     tril_ib = (jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 0)
                > jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 1))
 
     for s in range(bb // ib):
         s0 = s * ib
+        oh_lo = s0
 
-        def col_step(j, _, s0=s0):
+        def col_step(j, _, s0=s0, oh_lo=oh_lo):
             sub = out_ref[s0:s0 + ib, :]
             col = out_ref[pl.ds(s0 + j, 1), :]   # dynamic row read
             act = act_out[:]
@@ -621,8 +633,9 @@ def _factor_block_lane_major(out_ref, act_out, piv_ref, ohsub,
             mx = jnp.max(mag)
             cand = jnp.where((mag >= mx) & (act > 0), iota_lane, m)
             p = jnp.min(cand).astype(jnp.int32)
-            piv_ref[:] = jnp.where(piv_cols == s0 + j, p, piv_ref[:])
-            oh = (iota_lane == p).astype(f32)
+            piv_ref[:] = jnp.where(piv_cols == piv0 + s0 + j, p,
+                                   piv_ref[:])
+            oh = (iota_lane == p).astype(dt)
             pval = jnp.sum(col * oh)
             safe = jnp.where(pval == 0, 1.0, pval)
             live = (act > 0) & (oh == 0)
@@ -632,34 +645,36 @@ def _factor_block_lane_major(out_ref, act_out, piv_ref, ohsub,
             out_ref[s0:s0 + ib, :] = jnp.where(
                 iota_sub == j, newcol,
                 sub - jnp.where(iota_sub > j, pcol, 0.0) * lrow)
-            ohsub[:] = jnp.where(iota_sub == j, oh, ohsub[:])
+            ohsub[oh_lo:oh_lo + ib, :] = jnp.where(
+                iota_sub == j, oh, ohsub[oh_lo:oh_lo + ib, :])
             act_out[:] = act * (1.0 - oh)
             return 0
 
-        ohsub[:] = jnp.zeros((ib, m), f32)
+        ohsub[oh_lo:oh_lo + ib, :] = jnp.zeros((ib, m), dt)
         jax.lax.fori_loop(0, ib, col_step, 0)
         if s0 + ib < bb:
+            ohs = ohsub[oh_lo:oh_lo + ib, :]
             sub = out_ref[s0:s0 + ib, :]
             l11 = jax.lax.dot_general(
-                ohsub[:], sub,
+                ohs, sub,
                 dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=f32, precision=hi)
+                preferred_element_type=dt, precision=hi)
             l11u = jnp.where(tril_ib, l11, 0.0) + eye_ib
             l11inv = _trtri_unblocked(l11u, ib)
             rest = out_ref[s0 + ib:bb, :]
             ut = jax.lax.dot_general(
-                rest, ohsub[:],
+                rest, ohs,
                 dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=f32, precision=hi)
+                preferred_element_type=dt, precision=hi)
             u12t = jnp.dot(ut, l11inv.T,
-                           preferred_element_type=f32, precision=hi)
-            pivm = jnp.sum(ohsub[:], axis=0, keepdims=True)
+                           preferred_element_type=dt, precision=hi)
+            pivm = jnp.sum(ohs, axis=0, keepdims=True)
             lsubt = sub * act_out[:]
             out_ref[s0 + ib:bb, :] = (
                 rest * (1.0 - pivm)
-                - jnp.dot(u12t, lsubt, preferred_element_type=f32,
+                - jnp.dot(u12t, lsubt, preferred_element_type=dt,
                           precision=hi)
-                + jnp.dot(u12t, ohsub[:], preferred_element_type=f32,
+                + jnp.dot(u12t, ohs, preferred_element_type=dt,
                           precision=hi))
 
 
@@ -761,98 +776,6 @@ def _factor_panel_linv_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
         _block_inv_doubling(lfull_ref, linv_ref, bb, ib)
 
 
-def _getrf_block_kernel(slab_in, act_in, out_ref, piv_ref, act_out,
-                        ohsub, *, m, bb, ib):
-    """Single column-block core of the scattered-row LU panel, in
-    TRANSPOSED layout: the (bb, m) slab keeps every per-column vector
-    (the column itself, the active mask, the pivot one-hot) LANE-major
-    (1, m) — fully vectorized across the VPU's 128 lanes — and every
-    per-step update confined to the (ib, m) sub-slab.  (The first,
-    untransposed version kept vectors as (m, 1): 8 useful sublanes per
-    op, measured 65 µs per column step; lane-major brings the step to
-    VPU speed.)
-
-    TRUE partial pivoting over the rows flagged active, no row
-    movement (see the module comment above).  Shared elimination core:
-    :func:`_factor_block_lane_major`.
-    """
-
-    out_ref[:] = slab_in[:]
-    act_out[:] = act_in[:]
-    piv_ref[:] = jnp.zeros((1, bb), jnp.int32)
-    _factor_block_lane_major(out_ref, act_out, piv_ref, ohsub,
-                             m=m, bb=bb, ib=ib)
-
-
-def _getrf_block_inplace_kernel(at_in, act_in, r0_ref, out_ref,
-                                piv_ref, act_out, cur, ohsub, sem,
-                                *, m, n_rows, bb, ib):
-    """In-place variant of :func:`_getrf_block_kernel`: the WHOLE
-    transposed matrix stays in HBM (aliased input/output, so XLA
-    threads ONE buffer through every per-block call instead of copying
-    the full carry around each custom call — measured: the copy-per-
-    call pattern costs ~26 ms per block at n=8192, 40x the kernel);
-    the r0 scalar selects the (bb, m) block row, DMA'd through VMEM.
-    """
-
-    # the dynamic block offset is always a multiple of bb (>= 8);
-    # Mosaic needs the divisibility hint to slice the (8,128)-tiled
-    # HBM memref at a runtime offset
-    r0 = pl.multiple_of(r0_ref[0], bb)
-    dma_in = pltpu.make_async_copy(
-        at_in.at[pl.ds(r0, bb), :], cur, sem)
-    dma_in.start()
-    dma_in.wait()
-    act_out[:] = act_in[:]
-    piv_ref[:] = jnp.zeros((1, bb), jnp.int32)
-    _factor_block_lane_major(cur, act_out, piv_ref, ohsub,
-                             m=m, bb=bb, ib=ib)
-    dma_out = pltpu.make_async_copy(
-        cur, out_ref.at[pl.ds(r0, bb), :], sem)
-    dma_out.start()
-    dma_out.wait()
-
-
-@_x32_trace
-def getrf_block_inplace(at_full, active_row, r0, bb: int = 128,
-                        ib: int = 16):
-    """Factor block rows [r0, r0+bb) of the TRANSPOSED matrix in place
-    (aliased HBM buffer — no full-matrix copy per call).  ``r0`` is a
-    scalar operand, so ONE compilation serves every block of every
-    panel.  Returns ``(at_full', piv, active_out)``."""
-
-    n_rows, m = at_full.shape
-    ib = min(ib, bb)
-    assert bb % ib == 0 and m % 8 == 0, (m, bb, ib)
-    # the kernel's pl.multiple_of(r0, bb) hint and the (8,128)-tiled HBM
-    # slice require 8 | bb and bb | r0
-    assert bb % 8 == 0, bb
-    if isinstance(r0, int):
-        assert r0 % bb == 0, (r0, bb)
-    f32 = jnp.float32
-    out, piv, act_out = pl.pallas_call(
-        functools.partial(_getrf_block_inplace_kernel, m=m,
-                          n_rows=n_rows, bb=bb, ib=ib),
-        out_shape=(jax.ShapeDtypeStruct((n_rows, m), f32),
-                   jax.ShapeDtypeStruct((1, bb), jnp.int32),
-                   jax.ShapeDtypeStruct((1, m), f32)),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM)),
-        scratch_shapes=[pltpu.VMEM((bb, m), f32),
-                        pltpu.VMEM((ib, m), f32),
-                        pltpu.SemaphoreType.DMA(())],
-        input_output_aliases={0: 0},
-        compiler_params=_CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=_interpret(),
-    )(at_full, active_row, jnp.asarray(r0, jnp.int32).reshape(1))
-    return out, piv[0], act_out
-
-
 @_x32_trace
 def getrf_panel_linv(slab_t, active_row, ib: int = 32):
     """TRUE partial-pivot LU of a TRANSPOSED (bb, m) f32 panel in ONE
@@ -883,32 +806,187 @@ def getrf_panel_linv(slab_t, active_row, ib: int = 32):
     return out, piv[0], act_out, linv
 
 
-@_x32_trace
-def getrf_block_panel(slab_t, active_row, ib: int = 16):
-    """TRUE partial-pivot LU of a TRANSPOSED (bb, m) f32 column block
-    over the active rows, scattered-row form — the per-block core that
-    ``linalg.lu.getrf_scattered`` composes into full panels.  Takes and
-    returns the block transposed (columns as lane-major rows) and the
-    active mask as a (1, m) row; returns ``(block_t, piv, active_out)``
-    with ``piv[i]`` the physical row index chosen as pivot i."""
+# ---------------------------------------------------------------------------
+# Fused single-invocation LU panel mega-kernel — ONE pallas_call owns the
+# whole panel loop.  The r4/r5 scattered driver composed the panel from a
+# chain of per-block kernel calls (64 invocations at n=8192/nb=512) whose
+# glue — per-block HBM round trips, unaliased carry copies XLA inserts
+# around custom calls (~26 ms/block), transposes (~2 ms each) — cost
+# ~30 µs/step against the kernel's measured 2.2 µs/step.  Here the grid
+# iterates the panel's bb-wide column-block steps inside a single
+# invocation: the (nb, m) panel is DMA'd HBM→VMEM once at step 0, stays
+# resident across grid steps (grid iterations are sequential on TPU and
+# scratch persists), every per-step update runs on the VMEM copy, and
+# ONE DMA at the last step writes the factored panel back into the
+# aliased HBM carry (input_output_aliases: no copy, no round trip).
+# Pivoting stays TRUE partial + scattered (argmax of the fully-updated
+# column over all still-active rows; rows never move) and the (nb, nb)
+# unit-lower inverse of the pivot block rides along so the driver's u12
+# solve is one MXU gemm.
+# ---------------------------------------------------------------------------
 
-    bb, m = slab_t.shape
+
+def _getrf_panel_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
+                              act_out, linv_ref, panel, cur, ohblk, lfull,
+                              l11s, l11i, sem, *, m, nb, bb, ib):
+    """One grid step = one bb-wide column block of the (nb, m) panel:
+
+    * step 0 DMAs panel rows [k0, k0+nb) of the transposed matrix into
+      the resident ``panel`` scratch and seeds the carried state;
+    * every step s eliminates block rows [s·bb, (s+1)·bb) of the
+      resident panel with the shared TRUE-partial-pivot core
+      (:func:`_factor_block_lane_major`), then applies the masked
+      right-looking trailing update to the panel rows after the block
+      (the proven ``rest·(1−pivm) + u12ᵗ·(oh − lᵗ)`` composition of
+      :func:`_factor_panel_linv_kernel`, here at bb granularity with an
+      in-kernel residual-correction pass on u12ᵗ);
+    * the last step assembles the (nb, nb) unit-lower pivot-block
+      inverse (per-ib diagonal inverses + recursive doubling, exactly
+      :func:`_trtri_panel_kernel`'s scheme) and DMAs the factored panel
+      back into the aliased HBM carry.
+    """
+
+    dt = jnp.promote_types(panel.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    s = pl.program_id(0)
+    nsteps = pl.num_programs(0)
+    k0 = pl.multiple_of(k0_ref[0], bb)
+
+    @pl.when(s == 0)
+    def _init():
+        dma = pltpu.make_async_copy(
+            at_hbm.at[pl.ds(k0, nb), :], panel, sem)
+        dma.start()
+        dma.wait()
+        act_out[:] = act_in[:]
+        piv_ref[:] = jnp.zeros((1, nb), jnp.int32)
+        linv_ref[:] = jnp.zeros((nb, nb), dt)
+        lfull[:] = jnp.zeros((nb, nb), dt)
+
+    r0 = pl.multiple_of(s * bb, bb)
+    cur[:] = panel[pl.ds(r0, bb), :]
+    _factor_block_lane_major(cur, act_out, piv_ref, ohblk,
+                             m=m, bb=bb, ib=ib, piv0=r0)
+    panel[pl.ds(r0, bb), :] = cur[:]
+    # packed rows of this block across every panel column, gathered by
+    # the one-hot pivot matrix (an MXU dot, not a scatter): final for
+    # columns ≤ the block end; later columns are masked off in the
+    # final unit-lower assembly
+    lpart = jax.lax.dot_general(
+        ohblk[:], panel[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=dt, precision=hi)
+    lfull[pl.ds(r0, bb), :] = lpart
+
+    @pl.when(s < nsteps - 1)
+    def _panel_trailing():
+        # diagonal pivot block of this step, unit-lower, and its
+        # inverse (ib-diagonal inverses + recursive doubling — the
+        # trtri_panel scheme on in-step scratch)
+        eye_bb = (jax.lax.broadcasted_iota(jnp.int32, (bb, bb), 0)
+                  == jax.lax.broadcasted_iota(jnp.int32, (bb, bb), 1)
+                  ).astype(dt)
+        tril_bb = (jax.lax.broadcasted_iota(jnp.int32, (bb, bb), 0)
+                   > jax.lax.broadcasted_iota(jnp.int32, (bb, bb), 1))
+        l11 = jax.lax.dot_general(
+            ohblk[:], cur[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=dt, precision=hi)
+        l11s[:] = jnp.where(tril_bb, l11, 0.0) + eye_bb
+        l11i[:] = jnp.zeros((bb, bb), dt)
+        for bi in range(bb // ib):
+            q0 = bi * ib
+            l11i[q0:q0 + ib, q0:q0 + ib] = _trtri_unblocked(
+                l11s[q0:q0 + ib, q0:q0 + ib], ib)
+        _block_inv_doubling(l11s, l11i, bb, ib)
+        # masked right-looking update of the panel rows after the block
+        # (fixed-shape ops; the row mask stands in for a shrinking
+        # dynamic slice, which Mosaic cannot shape)
+        ut_all = jax.lax.dot_general(
+            panel[:], ohblk[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=dt, precision=hi)
+        u12t = jnp.dot(ut_all, l11i[:].T,
+                       preferred_element_type=dt, precision=hi)
+        # one in-kernel residual-correction pass (k=bb dots — cheap)
+        # keeps the inverse-based solve at trsm-grade accuracy
+        r1 = ut_all - jnp.dot(u12t, l11s[:].T,
+                              preferred_element_type=dt, precision=hi)
+        u12t = u12t + jnp.dot(r1, l11i[:].T,
+                              preferred_element_type=dt, precision=hi)
+        pivm = jnp.sum(ohblk[:], axis=0, keepdims=True)
+        lsubt = cur[:] * act_out[:]
+        upd = jnp.dot(u12t, ohblk[:] - lsubt,
+                      preferred_element_type=dt, precision=hi)
+        after = jax.lax.broadcasted_iota(jnp.int32, (nb, 1), 0) >= r0 + bb
+        panel[:] = jnp.where(after, panel[:] * (1.0 - pivm) + upd,
+                             panel[:])
+
+    @pl.when(s == nsteps - 1)
+    def _finish():
+        rows_nb = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
+        cols_nb = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
+        lfull[:] = jnp.where(rows_nb > cols_nb, lfull[:], 0.0) + \
+            (rows_nb == cols_nb).astype(dt)
+        for bi in range(nb // ib):
+            q0 = bi * ib
+            linv_ref[q0:q0 + ib, q0:q0 + ib] = _trtri_unblocked(
+                lfull[q0:q0 + ib, q0:q0 + ib], ib)
+        _block_inv_doubling(lfull, linv_ref, nb, ib)
+        dma = pltpu.make_async_copy(
+            panel, out_hbm.at[pl.ds(k0, nb), :], sem)
+        dma.start()
+        dma.wait()
+
+
+@_x32_trace
+def getrf_panel_fused(at_full, active_row, k0, nb: int = 512,
+                      bb: int = 128, ib: int = 16):
+    """TRUE partial-pivot LU of panel rows [k0, k0+nb) of the TRANSPOSED
+    matrix in ONE pallas invocation whose grid iterates the panel's
+    bb-wide column-block steps (see :func:`_getrf_panel_fused_kernel`).
+    The HBM carry is aliased (no copy per call) and ``k0`` is a scalar
+    operand, so ONE Mosaic compilation serves every panel of the
+    factorization.  Returns ``(at_full', piv, active_out, linv)`` with
+    ``piv`` the nb physical pivot rows in order and ``linv`` the
+    (nb, nb) inverse of the panel's unit-lower pivot block."""
+
+    n_rows, m = at_full.shape
+    bb = min(bb, nb)
     ib = min(ib, bb)
-    assert bb % ib == 0 and m % 8 == 0, (m, bb, ib)
-    f32 = jnp.float32
-    out, piv, act_out = pl.pallas_call(
-        functools.partial(_getrf_block_kernel, m=m, bb=bb, ib=ib),
-        out_shape=(jax.ShapeDtypeStruct((bb, m), f32),
-                   jax.ShapeDtypeStruct((1, bb), jnp.int32),
-                   jax.ShapeDtypeStruct((1, m), f32)),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+    assert nb % bb == 0 and bb % ib == 0 and m % 8 == 0, (m, nb, bb, ib)
+    # the in-kernel pl.multiple_of hints and the (8,128)-tiled HBM
+    # slices need 8 | bb and bb | k0
+    assert bb % 8 == 0, bb
+    if isinstance(k0, int):
+        assert k0 % bb == 0, (k0, bb)
+    dt = jnp.promote_types(at_full.dtype, jnp.float32)
+    out, piv, act_out, linv = pl.pallas_call(
+        functools.partial(_getrf_panel_fused_kernel, m=m, nb=nb, bb=bb,
+                          ib=ib),
+        grid=(nb // bb,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=(jax.ShapeDtypeStruct((n_rows, m), dt),
+                   jax.ShapeDtypeStruct((1, nb), jnp.int32),
+                   jax.ShapeDtypeStruct((1, m), dt),
+                   jax.ShapeDtypeStruct((nb, nb), dt)),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM)),
-        scratch_shapes=[pltpu.VMEM((ib, m), f32)],
+        scratch_shapes=[pltpu.VMEM((nb, m), dt),     # resident panel
+                        pltpu.VMEM((bb, m), dt),     # current block
+                        pltpu.VMEM((bb, m), dt),     # one-hot pivot rows
+                        pltpu.VMEM((nb, nb), dt),    # packed L rows
+                        pltpu.VMEM((bb, bb), dt),    # step L11
+                        pltpu.VMEM((bb, bb), dt),    # step L11⁻¹
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={0: 0},
         compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
-    )(slab_t, active_row)
-    return out, piv[0], act_out
+    )(at_full.astype(dt), active_row.astype(dt),
+      jnp.asarray(k0, jnp.int32).reshape(1))
+    return out, piv[0], act_out, linv

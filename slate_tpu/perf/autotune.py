@@ -54,7 +54,8 @@ __all__ = [
     "AutotuneTable", "Candidate", "table", "reset_table", "select",
     "decide", "decisions", "timing_reps", "kernel",
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
-    "choose_lu_panel", "choose_trtri_panel", "choose_geqrf_panel",
+    "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
+    "choose_geqrf_panel",
 ]
 
 #: timed repetitions per surviving candidate (after the compile/warm rep)
@@ -614,13 +615,18 @@ def choose_potrf_panel_f64(n: int, nb: int) -> str:
     ])
 
 
-def choose_lu_panel(m: int, w: int, dtype, eligible: bool) -> str:
+def choose_lu_panel(m: int, w: int, dtype, eligible: bool,
+                    eligible_fused: bool = False) -> str:
     """LU panel backend: ``"pallas"`` (one-call masked lane-major panel
     with TRUE partial pivoting + L11⁻¹, ``getrf_panel_linv``) vs
-    ``"xla"`` (fused ``lax.linalg.lu``).  ``eligible`` is the call
-    site's shape/VMEM gate (``linalg.lu._use_pallas_panel``); when it
-    holds off-TPU the caller forced the gate open (tests/interpret
-    mode), so the Pallas leaf is honoured without timing."""
+    ``"pallas_fused"`` (the grid-stepped fused mega-kernel,
+    ``getrf_panel_fused`` at k0=0 — same contract, one compilation per
+    bucket and a single-copy VMEM slab) vs ``"xla"`` (fused
+    ``lax.linalg.lu``).  ``eligible``/``eligible_fused`` are the call
+    site's shape/VMEM gates (``linalg.lu._use_pallas_panel`` /
+    ``_use_fused_panel``); when one holds off-TPU the caller forced
+    the gate open (tests/interpret mode), so the Pallas leaf is
+    honoured without timing."""
 
     import jax.numpy as jnp
 
@@ -628,12 +634,16 @@ def choose_lu_panel(m: int, w: int, dtype, eligible: bool) -> str:
 
     dt = jnp.dtype(dtype)
     key = (m, w, dt.name, _precision_name())
-    if not eligible:
+    if not (eligible or eligible_fused):
         return _static("lu_panel", key, "xla", "ineligible")
     if config.use_pallas_mode() == "on":
-        return _static("lu_panel", key, "pallas", "forced-config")
+        return _static("lu_panel", key,
+                       "pallas" if eligible else "pallas_fused",
+                       "forced-config")
     if not _on_tpu():
-        return _static("lu_panel", key, "pallas", "gate-forced")
+        return _static("lu_panel", key,
+                       "pallas" if eligible else "pallas_fused",
+                       "gate-forced")
 
     probes: dict = {}
 
@@ -645,10 +655,10 @@ def choose_lu_panel(m: int, w: int, dtype, eligible: bool) -> str:
 
         return _timed_call(lambda x: _panel_lu_pallas(x)[:2], _a())
 
-    def setup_xla():
-        from jax import lax
+    def setup_fused():
+        from ..linalg.lu import _panel_lu_fused
 
-        return _timed_call(lambda x: lax.linalg.lu(x)[::2], _a())
+        return _timed_call(lambda x: _panel_lu_fused(x)[:2], _a())
 
     def check(out):
         import numpy as np
@@ -660,9 +670,87 @@ def choose_lu_panel(m: int, w: int, dtype, eligible: bool) -> str:
         eps = float(np.finfo(np.dtype(dt.name)).eps)
         return res / (np.linalg.norm(a) * eps * m + 1e-300) < 100.0
 
-    return decide("lu_panel", key, [
-        Candidate("pallas", setup_pallas, check),
-        Candidate("xla", setup_xla, check),
+    def setup_xla():
+        from jax import lax
+
+        return _timed_call(lambda x: lax.linalg.lu(x)[::2], _a())
+
+    cands = []
+    if eligible:
+        cands.append(Candidate("pallas", setup_pallas, check))
+    if eligible_fused:
+        cands.append(Candidate("pallas_fused", setup_fused, check))
+    cands.append(Candidate("xla", setup_xla, check))
+    return decide("lu_panel", key, cands)
+
+
+def choose_lu_driver(m: int, n: int, nb: int, dtype,
+                     eligible: bool) -> str:
+    """Whole-factorization driver for partial-pivot getrf:
+    ``"scattered"`` (transposed in-place scattered-row driver whose
+    panel loop is ONE fused Pallas invocation per step,
+    ``linalg.lu.getrf_scattered``) vs ``"rec"`` (the blocked recursion
+    ``getrf_rec``, the stock path).  ``eligible`` is the call site's
+    shape gate (``linalg.lu._use_scattered``); the tri-state
+    ``SLATE_TPU_SCATTERED_LU`` knob (:func:`slate_tpu.config.
+    scattered_lu_mode`) forces the decision, replacing the raw env
+    read the driver used to hide."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (m, n, nb, dt.name, _precision_name())
+    if not eligible:
+        return _static("lu_driver", key, "rec", "ineligible")
+    mode = config.scattered_lu_mode()
+    if mode == "off":
+        return _static("lu_driver", key, "rec", "forced-config")
+    if mode == "on":
+        return _static("lu_driver", key, "scattered", "forced-config")
+    if not _on_tpu():
+        return _static("lu_driver", key, "rec", "default")
+
+    probes: dict = {}
+
+    def _a():
+        return _memo(probes, "a", lambda: _randn((m, n), dt, 8))
+
+    def setup_scattered():
+        from ..linalg.lu import getrf_scattered
+
+        return _timed_call(lambda x: getrf_scattered(x, nb), _a())
+
+    def setup_rec():
+        from ..linalg.lu import getrf_rec
+
+        return _timed_call(lambda x: getrf_rec(x, nb), _a())
+
+    def check(out):
+        # O(n²) matvec probe of the factor identity L·(U·x) = A[perm]·x
+        # (the reference tester's criterion, kept on device — n=8192
+        # operands never land on the host)
+        import jax.numpy as jnp
+        import numpy as np
+
+        lu, perm = out
+        if not bool(jnp.all(jnp.isfinite(lu))):
+            return False
+        a = _a()
+        x = _randn((n,), dt, 9)
+        y = jnp.triu(lu[: min(m, n)]) @ x
+        k = min(m, n)
+        z = jnp.tril(lu[:, :k], -1) @ y + jnp.pad(y, (0, m - k))
+        r = float(jnp.linalg.norm(z - a[perm] @ x))
+        eps = float(np.finfo(np.dtype(dt.name)).eps)
+        den = (float(jnp.linalg.norm(a)) * float(jnp.linalg.norm(x))
+               * eps * max(m, n))
+        return r / max(den, 1e-300) < 100.0
+
+    return decide("lu_driver", key, [
+        Candidate("rec", setup_rec, check),
+        Candidate("scattered", setup_scattered, check),
     ])
 
 
@@ -782,7 +870,11 @@ _CHOOSERS = {
                                                    kw["dtype"]),
     "potrf_panel_f64": lambda **kw: choose_potrf_panel_f64(kw["n"], kw["nb"]),
     "lu_panel": lambda **kw: choose_lu_panel(kw["m"], kw["w"], kw["dtype"],
-                                             kw["eligible"]),
+                                             kw["eligible"],
+                                             kw.get("eligible_fused",
+                                                    False)),
+    "lu_driver": lambda **kw: choose_lu_driver(kw["m"], kw["n"], kw["nb"],
+                                               kw["dtype"], kw["eligible"]),
     "trtri_panel": lambda **kw: choose_trtri_panel(kw["n"], kw["dtype"]),
     "geqrf_panel": lambda **kw: choose_geqrf_panel(kw["m"], kw["n"],
                                                    kw["nb"], kw["dtype"]),

@@ -43,6 +43,8 @@ _DTYPE_BYTES = {
 _SHAPE_RE = r"([a-z]+\d+|pred)\[([0-9,]*)\]"
 _COLLECTIVE_RE = re.compile(
     r"= " + _SHAPE_RE + r"\S* (" + "|".join(COLLECTIVE_KINDS) + r")\(")
+_CUSTOM_CALL_RE = re.compile(r"=\s*\S+\s+custom-call\(")
+_CC_TARGET_RE = re.compile(r'custom_call_target="([^"]+)"')
 _DOT_RE = re.compile(
     r"= " + _SHAPE_RE + r"\S* dot\((.*)\), lhs_contracting_dims=\{([0-9,]*)\}")
 _CALL_RE = re.compile(r"(?:calls|to_apply|body|condition)=(%[\w.\-]+)")
@@ -97,6 +99,7 @@ class ComputationProfile:
     collectives: list = field(default_factory=list)
     dots: list = field(default_factory=list)
     nested_whiles: list = field(default_factory=list)
+    custom_calls: list = field(default_factory=list)  # target names
 
     @property
     def collective_count(self) -> int:
@@ -164,6 +167,24 @@ class ModuleProfile:
         smell test: must stay well below the full matrix)."""
         return max((op.elems for op in self.all_collectives), default=0)
 
+    @property
+    def custom_call_targets(self):
+        """Every custom-call target in the module — entry plus each
+        loop body (each body counted once)."""
+        targets = list(self.entry.custom_calls)
+        for body in self.loops:
+            targets += body.custom_calls
+        return targets
+
+    def count_custom_calls(self, substr: str = "tpu_custom_call") -> int:
+        """Custom-call census: how many custom-call instructions whose
+        target contains ``substr`` the compiled module carries.  Pallas
+        kernels lower to ``custom_call_target="tpu_custom_call"`` on
+        TPU, so this pins "≤ N Pallas invocations" budgets on compiled
+        HLO (see :func:`count_pallas_calls` for the interpret-mode /
+        CPU equivalent at the jaxpr level)."""
+        return sum(substr in t for t in self.custom_call_targets)
+
 
 def _split_computations(hlo_text: str):
     """``{name: [instruction lines]}`` plus the entry computation name."""
@@ -208,6 +229,11 @@ def _tally(name, comps, cache):
                 kind=cm.group(3), dtype=cm.group(1),
                 shape=_dims(cm.group(2))))
             continue    # a collective's to_apply region is scalar math
+        ccm = _CUSTOM_CALL_RE.search(ln)
+        if ccm:
+            tm = _CC_TARGET_RE.search(ln)
+            prof.custom_calls.append(tm.group(1) if tm else "?")
+            continue
         dm = _DOT_RE.search(ln)
         if dm:
             ops = re.findall(_SHAPE_RE + r"\S* %", dm.group(3))
@@ -226,6 +252,7 @@ def _tally(name, comps, cache):
             prof.collectives += sub.collectives
             prof.dots += sub.dots
             prof.nested_whiles += sub.nested_whiles
+            prof.custom_calls += sub.custom_calls
     return prof
 
 
@@ -286,3 +313,61 @@ def stablehlo_collective_shapes(lowered_text: str):
         dims = [int(d) for d in m.group(2).split("x") if d]
         out.append((m.group(1), prod(dims) if dims else 1))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Pallas-invocation census — the kernel-launch sibling of the collective
+# budgets above.  On TPU a pallas_call compiles to ONE
+# custom_call_target="tpu_custom_call" instruction, so
+# ModuleProfile.count_custom_calls pins launch budgets off compiled HLO;
+# in interpret mode (CPU CI) the kernel body is inlined at lowering and
+# no custom call survives, so the same budget is pinned one level up, on
+# the jaxpr, where the ``pallas_call`` primitive is present either way.
+# tests/test_collective_profile.py uses this to guard the fused LU panel
+# against regressing back into the r4 per-block call chain (64 kernel
+# launches per factorization at n=8192/nb=512 vs one per panel step).
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(params):
+    """Every Jaxpr hiding in an eqn's params (call/branch/scan bodies),
+    one level deep — `_count_primitive` recurses from there."""
+    out = []
+
+    def visit(v):
+        if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+            out.append(v.jaxpr)          # ClosedJaxpr
+        elif hasattr(v, "eqns"):
+            out.append(v)                # raw Jaxpr
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                visit(x)
+
+    for v in params.values():
+        visit(v)
+    return out
+
+
+def _count_primitive(jaxpr, name: str) -> int:
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            total += 1
+        for sub in _sub_jaxprs(eqn.params):
+            total += _count_primitive(sub, name)
+    return total
+
+
+def count_pallas_calls(fn, *args, static_argnums=None,
+                       primitive: str = "pallas_call") -> int:
+    """How many ``pallas_call`` invocations ``fn(*args)`` traces to,
+    counted on the jaxpr (recursing through control-flow and call
+    sub-jaxprs).  Platform-independent: the count is identical whether
+    the kernels compile (TPU) or interpret (CPU CI), unlike the
+    compiled-HLO custom-call census which only exists on TPU."""
+    import jax
+
+    closed = jax.make_jaxpr(
+        fn, static_argnums=() if static_argnums is None
+        else static_argnums)(*args)
+    return _count_primitive(closed.jaxpr, primitive)

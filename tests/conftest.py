@@ -90,6 +90,18 @@ _SLOW_TESTS = frozenset({
     "tests/test_hesv_band.py::test_hetrs_under_jit_matches_eager",
     "tests/test_hesv_band.py::test_pbsv[1]",
     "tests/test_lu.py::TestScatteredLU::test_wide_f32_residual_gate",
+    # fused-panel sweep: representatives kept fast are
+    # test_shapes_f32[256-256], test_many_tied_pivots, the kernel-level
+    # contract tests and the gesv end-to-end
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_shapes_f32[384-128]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_shapes_f32[128-256]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_shapes_f64[256-256]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_shapes_f64[384-128]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_shapes_f64[128-256]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_nb_sweep[128]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_nb_sweep[256]",
+    "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_nb_sweep[512]",
+    "tests/test_lu_fused_panel.py::TestEndToEndThroughFusedPath::test_getrf",
     "tests/test_lu.py::test_gesv_mixed_converges",
     "tests/test_lu.py::test_gesv_mixed_gmres_complex",
     "tests/test_lu.py::test_getrf_nopiv_dominant",

@@ -82,7 +82,8 @@ def test_multi_backend_sites_populate_autotune_table():
     dec = autotune.decisions()
     for op in ("matmul|128,128,128,float32",
                "matmul|8,8,8,float64",
-               "potrf_panel|", "trtri_panel|", "lu_panel|", "geqrf_panel|"):
+               "potrf_panel|", "trtri_panel|", "lu_panel|", "lu_driver|",
+               "geqrf_panel|"):
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"
     autotune.reset_table()

@@ -247,16 +247,63 @@ class TestScatteredLU:
         assert res < 3, f"scaled residual {res}"
 
     def test_use_scattered_gating(self, monkeypatch):
+        """_use_scattered is shape/VMEM ELIGIBILITY only; whether the
+        driver runs is the autotune table's lu_driver decision, forced
+        through the tri-state config.scattered_lu knob (the raw
+        SLATE_TPU_SCATTERED_LU env read is gone from lu.py)."""
         from slate_tpu.linalg.lu import _use_scattered
+        from slate_tpu.perf import autotune
         z = jnp.zeros((1024, 1024), jnp.float32)
-        # off by default (opt-in env)
-        assert not _use_scattered(z, 512)
-        monkeypatch.setenv("SLATE_TPU_SCATTERED_LU", "1")
-        monkeypatch.setattr("slate_tpu.config.use_pallas", True)
         assert _use_scattered(z, 512)
-        # shapes the kernel cannot take must fall back
-        assert not _use_scattered(jnp.zeros((4608, 4608), jnp.float32),
-                                  512)
+        # shapes the kernel cannot take are ineligible
         assert not _use_scattered(jnp.zeros((1000, 1000), jnp.float32),
                                   512)
+        assert not _use_scattered(          # too tall for VMEM (shape only)
+            jax.ShapeDtypeStruct((17408, 17408), jnp.float32), 512)
         assert not _use_scattered(z.astype(jnp.float64), 512)
+        # force-off escape hatch wins over everything
+        monkeypatch.setattr("slate_tpu.config.use_pallas", False)
+        assert not _use_scattered(z, 512)
+        monkeypatch.undo()
+
+        # the decision: off-TPU auto default is the recursion; the
+        # tri-state knob forces the scattered driver on/off
+        autotune.reset_table()
+        try:
+            assert autotune.choose_lu_driver(
+                1024, 1024, 512, jnp.float32, eligible=True) == "rec"
+            monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+            assert autotune.choose_lu_driver(
+                1024, 1024, 512, jnp.float32, eligible=True) == "scattered"
+            monkeypatch.setattr("slate_tpu.config.scattered_lu", False)
+            assert autotune.choose_lu_driver(
+                1024, 1024, 512, jnp.float32, eligible=True) == "rec"
+            # ineligible shapes never take the driver, even forced on
+            monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+            assert autotune.choose_lu_driver(
+                1000, 1000, 512, jnp.float32, eligible=False) == "rec"
+            assert autotune.timing_reps() == 0   # all knob-resolved
+        finally:
+            autotune.reset_table()
+
+    def test_getrf_dispatches_scattered_when_forced(self, monkeypatch):
+        """End-to-end: with the knob forced on, st.getrf routes an
+        eligible f32 matrix through the fused scattered driver and the
+        decision lands in the autotune table."""
+        from slate_tpu.linalg import lu as lu_mod
+        from slate_tpu.perf import autotune
+        monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+        monkeypatch.setattr(lu_mod, "_SCATTERED_NB", 64)
+        autotune.reset_table()
+        try:
+            rng = np.random.default_rng(11)
+            n = 128
+            a = (rng.standard_normal((n, n)).astype(np.float32)
+                 + n * np.eye(n, dtype=np.float32))
+            lu, perm = getrf(st.Matrix.from_array(a, nb=64))
+            _check_factor(a, lu.array, perm)
+            dec = autotune.decisions()
+            hit = [k for k in dec if k.startswith("lu_driver|")]
+            assert hit and dec[hit[0]] == "scattered", dec
+        finally:
+            autotune.reset_table()

@@ -1,0 +1,199 @@
+"""The round-6 fused LU panel mega-kernel (getrf_panel_fused: ONE Pallas
+invocation owns the panel's column-block loop) and the scattered driver
+it powers, exercised in interpret mode on CPU — the same program the TPU
+compiles, so pivot parity and residuals here certify the default-capable
+path (ISSUE 3 acceptance: off-chip, interpret-mode pivot parity is
+scipy-exact).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import scipy.linalg as sla
+
+import slate_tpu as st
+from slate_tpu.linalg.lu import getrf_scattered, _panel_lu_fused
+from slate_tpu.ops.pallas_kernels import getrf_panel_fused
+
+
+def _scipy_perm(a):
+    """Replay scipy's swap sequence into a permutation vector."""
+    _, piv = sla.lu_factor(np.asarray(a, np.float64)
+                           if a.dtype == np.float64 else np.asarray(a),
+                           check_finite=False)
+    want = np.arange(a.shape[0])
+    for k, p in enumerate(piv):
+        want[k], want[p] = want[p], want[k]
+    return want
+
+
+def _check_scattered(a, nb, pivot_parity=True, tol=3.0):
+    """Residual gate + (optionally) scipy-exact pivots for the fused
+    scattered driver."""
+    m, n = a.shape
+    lu, perm = jax.jit(lambda x: getrf_scattered(x, nb))(jnp.asarray(a))
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    k = min(m, n)
+    assert sorted(perm.tolist()) == list(range(m)), "perm not a permutation"
+    lmat = np.tril(lu[:, :k], -1) + np.eye(m, k, dtype=a.dtype)
+    umat = np.triu(lu[:k])
+    eps = np.finfo(a.dtype).eps
+    res = (np.abs(a[perm] - lmat @ umat).max()
+           / (np.abs(a).max() * max(m, n) * eps))
+    assert res < tol, f"scaled residual {res}"
+    # TRUE partial pivoting: |L| ≤ 1 up to roundoff
+    assert np.abs(np.tril(lu[:, :k], -1)).max() <= 1.0 + 100 * eps
+    if pivot_parity:
+        want = _scipy_perm(a)
+        np.testing.assert_array_equal(perm[:k], want[:k])
+    return lu, perm
+
+
+class TestFusedPanelKernel:
+    """Kernel-level contract of the single-invocation panel."""
+
+    def test_panel_contract_and_linv(self):
+        rng = np.random.default_rng(0)
+        nb, bb, m = 64, 32, 256
+        a = rng.standard_normal((m, m)).astype(np.float32)
+        at = jnp.asarray(a.T.copy())
+        act = jnp.ones((1, m), jnp.float32)
+        out, piv, act_out, linv = jax.jit(
+            lambda t, c: getrf_panel_fused(t, c, 0, nb=nb, bb=bb, ib=16))(
+            at, act)
+        out, piv, act_out, linv = map(np.asarray,
+                                      (out, piv, act_out, linv))
+        assert len(set(piv.tolist())) == nb, "pivots must be distinct"
+        # rows outside the panel pass through the aliased carry untouched
+        np.testing.assert_array_equal(out[nb:], a.T[nb:])
+        rem = np.argsort(act_out[0] < 0.5, kind="stable")[: m - nb]
+        perm = np.concatenate([piv, rem])
+        lu = out[:nb, perm].T                       # (m, nb) packed
+        L = np.tril(lu, -1) + np.vstack(
+            [np.eye(nb, dtype=np.float32),
+             np.zeros((m - nb, nb), np.float32)])
+        U = np.triu(lu[:nb])
+        pan = a[:, :nb]
+        res = np.linalg.norm(L @ U - pan[perm]) / (
+            np.linalg.norm(pan) * np.finfo(np.float32).eps * m)
+        assert res < 60, res
+        # linv inverts the unit-lower pivot block (pivot-gathered form)
+        l11 = np.tril(lu[:nb], -1) + np.eye(nb, dtype=np.float32)
+        assert np.linalg.norm(l11 @ linv - np.eye(nb)) < 1e-3
+        # scipy-exact pivots for the panel
+        np.testing.assert_array_equal(piv, _scipy_perm(pan)[:nb])
+
+    def test_k0_offset_factors_in_place(self):
+        """k0 is a scalar operand: the second panel factors at its
+        offset through the SAME kernel, leaving earlier rows alone."""
+        rng = np.random.default_rng(1)
+        m = 128
+        a = rng.standard_normal((m, m)).astype(np.float32)
+        at = jnp.asarray(a.T.copy())
+        act = jnp.ones((1, m), jnp.float32)
+        out1, piv0, act1, _ = getrf_panel_fused(at, act, 0,
+                                                nb=64, bb=32, ib=16)
+        out2, piv1, act2, _ = getrf_panel_fused(out1, act1, 64,
+                                                nb=64, bb=32, ib=16)
+        np.testing.assert_array_equal(np.asarray(out2)[:64],
+                                      np.asarray(out1)[:64])
+        both = (set(np.asarray(piv0).tolist())
+                | set(np.asarray(piv1).tolist()))
+        assert len(both) == m, "panel pivots must be disjoint"
+
+    def test_panel_lu_fused_wrapper_matches_scipy(self):
+        """The lu.py lu_panel-candidate wrapper (pad-to-bucket + perm
+        assembly + linv) on a tall panel."""
+        rng = np.random.default_rng(2)
+        m, w = 200, 64                       # forces padding to 512
+        a_np = rng.standard_normal((m, w)).astype(np.float32)
+        lu, perm, linv = _panel_lu_fused(jnp.asarray(a_np))
+        lu, perm = np.asarray(lu), np.asarray(perm)
+        assert sorted(perm.tolist()) == list(range(m))
+        L = np.tril(lu, -1) + np.vstack(
+            [np.eye(w, dtype=np.float32),
+             np.zeros((m - w, w), np.float32)])
+        U = np.triu(lu[:w])
+        res = np.linalg.norm(L @ U - a_np[perm]) / (
+            np.linalg.norm(a_np) * np.finfo(np.float32).eps * m)
+        assert res < 60, res
+        np.testing.assert_array_equal(perm[:w], _scipy_perm(a_np)[:w])
+
+
+class TestScatteredFusedParity:
+    """Driver-level pivot parity vs scipy.linalg.lu_factor across
+    square/tall/wide shapes, f32/f64, and the nb sweep the ISSUE names."""
+
+    @pytest.mark.parametrize("m,n", [(256, 256), (384, 128), (128, 256)])
+    def test_shapes_f32(self, m, n):
+        a = np.random.default_rng(m + n).standard_normal(
+            (m, n)).astype(np.float32)
+        _check_scattered(a, 128)
+
+    @pytest.mark.parametrize("m,n", [(256, 256), (384, 128), (128, 256)])
+    def test_shapes_f64(self, m, n):
+        a = np.random.default_rng(2 * m + n + 7).standard_normal((m, n))
+        _check_scattered(a, 128)
+
+    @pytest.mark.parametrize("nb", [128, 256, 512])
+    def test_nb_sweep(self, nb):
+        n = max(256, nb)
+        a = np.random.default_rng(nb).standard_normal(
+            (n, n)).astype(np.float32)
+        _check_scattered(a, nb)
+
+    def test_many_tied_pivots(self):
+        """Adversarial ±1 matrix: every column's pivot search hits an
+        m-way exact magnitude tie.  On a tie the scattered kernel takes
+        the lowest still-active PHYSICAL row while LAPACK takes the
+        first max in swapped order, so pivot equality is not defined —
+        the factor must still be a valid partial-pivot LU (distinct
+        pivots, |L| ≤ 1, residual-gated)."""
+        rng = np.random.default_rng(13)
+        a = np.sign(rng.standard_normal((256, 256))).astype(np.float32)
+        a += np.eye(256, dtype=np.float32) * 0.0   # keep exact ±1 ties
+        _check_scattered(a, 128, pivot_parity=False)
+
+
+class TestEndToEndThroughFusedPath:
+    """getrf/gesv routed through the fused scattered driver by the
+    autotune table (knob forced on), residual-gated end to end."""
+
+    @pytest.fixture(autouse=True)
+    def _force_scattered(self, monkeypatch):
+        from slate_tpu.linalg import lu as lu_mod
+        from slate_tpu.perf import autotune
+        monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+        monkeypatch.setattr(lu_mod, "_SCATTERED_NB", 128)
+        autotune.reset_table()
+        yield
+        autotune.reset_table()
+
+    def test_getrf(self):
+        rng = np.random.default_rng(3)
+        n = 256
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        lu, perm = st.getrf(st.Matrix.from_array(a, nb=128))
+        lu, perm = np.asarray(lu.array), np.asarray(perm)
+        L = np.tril(lu, -1) + np.eye(n, dtype=np.float32)
+        U = np.triu(lu)
+        eps = np.finfo(np.float32).eps
+        res = np.linalg.norm(a[perm] - L @ U) / (
+            np.linalg.norm(a) * n * eps)
+        assert res < 30, res
+        np.testing.assert_array_equal(perm, _scipy_perm(a))
+
+    def test_gesv(self):
+        rng = np.random.default_rng(4)
+        n, nrhs = 256, 3
+        a = (rng.standard_normal((n, n)).astype(np.float32)
+             + n * np.eye(n, dtype=np.float32))
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=128),
+                              jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, f"solve residual {res}"
