@@ -61,6 +61,19 @@ Each JSON line carries an ``"autotune"`` map of the backend decisions
 (:mod:`slate_tpu.perf.autotune`) made while that routine ran, and the
 aggregate line carries the full decision table — the measured numbers
 are attributable to the kernels that produced them.
+
+Global deadline budgeting (closes the r5 hole for good): set
+``SLATE_TPU_BENCH_DEADLINE_S`` to one wall-clock budget and every
+routine's SIGALRM deadline is derived from it — remaining budget split
+evenly over remaining routines — so the whole suite provably finishes
+inside the budget and the aggregate LAST line always flushes.  A
+SIGTERM from an outer ``timeout`` triggers the same flush.  Every JSON
+line (and the aggregate) additionally embeds a ``"metrics"`` snapshot
+from the runtime registry (:mod:`slate_tpu.perf.metrics`): autotune
+cache traffic, driver call counts and wall time, jit compiles, Pallas
+dispatch counts.  Compare artifacts with ``python tools/bench_diff.py
+BENCH_r03.json BENCH_r04.json`` — the regression sentinel that exits
+nonzero on throughput drops and on infra-shaped artifacts.
 """
 
 import json
@@ -81,6 +94,34 @@ BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
 #: out alone, is recorded as an infra failure, and the suite moves on.
 ROUTINE_TIMEOUT_S = float(os.environ.get("SLATE_TPU_BENCH_ROUTINE_TIMEOUT_S",
                                          "900"))
+
+#: ONE global wall-clock budget (seconds) from which every routine's
+#: SIGALRM deadline is DERIVED: before routine i runs, its deadline is
+#: the remaining budget split evenly over the remaining routines (still
+#: capped by ROUTINE_TIMEOUT_S).  Set this to comfortably less than the
+#: outer driver timeout and the suite mathematically cannot be killed
+#: from outside mid-flight: every routine either finishes or times out
+#: inside the budget, and the aggregate LAST line flushes with whatever
+#: completed — the BENCH_r05 failure shape (rc=124, parsed empty)
+#: becomes unreachable.  0 (default) keeps the flat per-routine
+#: deadline only.
+DEADLINE_S = float(os.environ.get("SLATE_TPU_BENCH_DEADLINE_S", "0"))
+
+#: routines get at least this much even when the budget is nearly spent
+#: (enough to flush an infra line; a full compile won't fit, and that is
+#: the point — fail fast, keep the artifact).
+MIN_DEADLINE_S = 20.0
+
+
+def _metrics_snapshot():
+    """The metrics registry's JSON view, embedded in every bench line —
+    never allowed to kill the artifact."""
+    try:
+        from slate_tpu.perf import metrics
+
+        return metrics.snapshot()
+    except Exception:
+        return {}
 
 
 class _RoutineTimeout(Exception):
@@ -108,6 +149,7 @@ def _partial_aggregate(sub, fails, infra):
         "partial": True,
         "failed": list(fails) + [f"infra: {s}" for s in infra],
         "autotune": _autotune_tags(set()),
+        "metrics": _metrics_snapshot(),
     }
 
 
@@ -184,7 +226,7 @@ def _timeit(fn, args, iters):
     return min(times) / iters
 
 
-def _run_routine(name, fn, sub, fails, infra):
+def _run_routine(name, fn, sub, fails, infra, deadline=None):
     """Run one routine under its own watchdog with a bounded infra-error
     retry count; classify failures.
 
@@ -193,22 +235,29 @@ def _run_routine(name, fn, sub, fails, infra):
     nonzero); infrastructure exceptions go to ``infra``.  A routine that
     hits its SIGALRM deadline is recorded as infra WITHOUT retry (a hung
     kernel would just hang again and eat a second deadline).
+
+    ``deadline`` overrides the flat ROUTINE_TIMEOUT_S — the global
+    budgeting in :func:`main` derives it from SLATE_TPU_BENCH_DEADLINE_S
+    (remaining budget / remaining routines).
     """
     last_err = None
     keys_before = _autotune_keys()
+    if deadline is None:
+        deadline = ROUTINE_TIMEOUT_S
 
     def _on_hard_hang():
         print(json.dumps({"routine": name,
                           "error": "infra: hard-hung in a blocking C "
                                    "call past the SIGALRM deadline",
-                          "autotune": _autotune_tags(keys_before)}),
+                          "autotune": _autotune_tags(keys_before),
+                          "metrics": _metrics_snapshot()}),
               flush=True)
         print(json.dumps(_partial_aggregate(
             sub, fails, infra + [f"{name}: hard-hung"])), flush=True)
 
     for attempt in range(2):
         try:
-            out = _run_with_deadline(fn, ROUTINE_TIMEOUT_S, name=name,
+            out = _run_with_deadline(fn, deadline, name=name,
                                      on_hard_hang=_on_hard_hang)
             label, gf, resid = out[0], out[1], out[2]
             if resid > 3.0:
@@ -216,7 +265,8 @@ def _run_routine(name, fn, sub, fails, infra):
                 print(json.dumps({"routine": name, "label": label,
                                   "error": "residual_gate",
                                   "scaled_resid": float(resid),
-                                  "autotune": _autotune_tags(keys_before)}),
+                                  "autotune": _autotune_tags(keys_before),
+                                  "metrics": _metrics_snapshot()}),
                       flush=True)
                 return None
             if len(out) > 3:   # auxiliary submetrics, gated like the rest
@@ -224,11 +274,12 @@ def _run_routine(name, fn, sub, fails, infra):
             sub[label] = round(gf, 1)
             # flush this routine's line NOW: a later timeout/SIGTERM must
             # never lose a number already measured (BENCH_r05 lesson) —
-            # aux submetrics and the autotuner's chosen backends ride
-            # along for the same reason
+            # aux submetrics, the autotuner's chosen backends and the
+            # metrics snapshot ride along for the same reason
             line = {"routine": name, "label": label,
                     "gflops": round(gf, 1), "scaled_resid": float(resid),
-                    "autotune": _autotune_tags(keys_before)}
+                    "autotune": _autotune_tags(keys_before),
+                    "metrics": _metrics_snapshot()}
             if len(out) > 3:
                 line.update(out[3])
             print(json.dumps(line), flush=True)
@@ -245,7 +296,8 @@ def _run_routine(name, fn, sub, fails, infra):
     infra.append(f"{name}: {type(last_err).__name__}: {last_err}")
     print(json.dumps({"routine": name,
                       "error": f"infra: {type(last_err).__name__}: {last_err}",
-                      "autotune": _autotune_tags(keys_before)}),
+                      "autotune": _autotune_tags(keys_before),
+                      "metrics": _metrics_snapshot()}),
           flush=True)
     return None
 
@@ -281,6 +333,34 @@ def main():
     sub = {}
     fails = []   # residual-gate failures → exit 1 (after printing JSON)
     infra = []   # infrastructure failures → recorded, exit stays 0
+
+    # the bench run is an observability harness: turn the metrics
+    # registry on (host-side counters only — it never changes the
+    # compiled programs) so every JSON line carries the snapshot;
+    # SLATE_TPU_METRICS=0 opts out
+    try:
+        from slate_tpu.perf import metrics as _metrics_mod
+
+        if os.environ.get("SLATE_TPU_METRICS", "").strip().lower() \
+                not in ("0", "false", "off", "no"):
+            _metrics_mod.on()
+    except Exception:
+        pass
+
+    # an outer `timeout` sends SIGTERM before SIGKILL: flush the
+    # aggregate LAST line with whatever completed so the artifact stays
+    # parseable (the other half of the BENCH_r05 root cause — the suite
+    # died with every number buffered behind one final print)
+    def _on_sigterm(signum, frame):
+        print(json.dumps({"routine": "_suite",
+                          "error": "infra: SIGTERM before completion"}),
+              flush=True)
+        print(json.dumps(_partial_aggregate(
+            sub, fails, infra + ["suite: SIGTERM"])), flush=True)
+        os._exit(0)
+
+    if hasattr(signal, "SIGTERM"):
+        signal.signal(signal.SIGTERM, _on_sigterm)
 
     def mv(mat, x):
         return mat @ x
@@ -324,7 +404,6 @@ def main():
                     * eps * n))
         return "gemm_fp32_n%d" % n, gf, resid, extra
 
-    gemm_gf = _run_routine("gemm", bench_gemm, sub, fails, infra)
 
     # ---- gemm fp64 (config 2 anchor, right after its fp32 sibling) --
     # TPU matrix units are fp32/bf16; fp64 rides the Ozaki int8-slice
@@ -362,7 +441,6 @@ def main():
                     * e64 * n64))
         return "gemm_fp64_n%d" % n64, gf, resid
 
-    gemm64_gf = _run_routine("gemm_fp64", bench_gemm64, sub, fails, infra)
 
     # ---- potrf -------------------------------------------------------
     def bench_potrf():
@@ -392,7 +470,6 @@ def main():
                  / (np.linalg.norm(spd_np) * np.linalg.norm(x) * eps * n))
         return "potrf_fp32_n%d" % n, gf, resid
 
-    _run_routine("potrf", bench_potrf, sub, fails, infra)
 
     # ---- potrf fp64 (config 2, right after its fp32 sibling) --------
     # f32 Pallas panel + two fp64 Newton steps + Ozaki trailing gemms
@@ -426,7 +503,6 @@ def main():
                     * e64 * n64))
         return "potrf_fp64_n%d" % n64, gf, resid
 
-    _run_routine("potrf_fp64", bench_potrf64, sub, fails, infra)
 
     # ---- getrf (partial-pivot LU, nb=512) ----------------------------
     # runs the SHIPPED PartialPiv dispatch (_getrf_partial): on TPU the
@@ -464,7 +540,6 @@ def main():
                  / (np.linalg.norm(am_np) * np.linalg.norm(x) * eps * n))
         return "getrf_fp32_n%d_nb%d" % (n, nb_lu), gf, resid
 
-    _run_routine("getrf", bench_getrf, sub, fails, infra)
 
     # ---- geqrf (tall QR, vendor dispatch) ----------------------------
     def bench_geqrf():
@@ -504,7 +579,6 @@ def main():
                     * eps * np.sqrt(m2)))
         return "geqrf_fp32_m%d_n%d" % (m2, n2), gf, resid
 
-    _run_routine("geqrf", bench_geqrf, sub, fails, infra)
 
     # ---- gels (config 4: least squares, m=32768 n=4096) -------------
     def bench_gels():
@@ -538,7 +612,6 @@ def main():
                     * eps * np.sqrt(m2)))
         return "gels_fp32_m%d_n%d" % (m2, n2), gf, resid
 
-    _run_routine("gels", bench_gels, sub, fails, infra)
 
     # ---- heev / svd fp32 (BASELINE config 5, n ≥ 8192 on chip) -------
     # the two-stage eig/svd pipelines at the library's native MXU
@@ -570,8 +643,6 @@ def main():
                  / (np.linalg.norm(herm_np) * nev32 * e32))
         return "heev_fp32_n%d" % nev32, gf, resid
 
-    if not over_budget("heev_fp32"):
-        _run_routine("heev_fp32", bench_heev32, sub, fails, infra)
 
     def bench_svd32():
         rng = np.random.default_rng(10)
@@ -588,8 +659,6 @@ def main():
                  / (np.linalg.norm(a_np) * nev32 * e32))
         return "svd_fp32_n%d" % nev32, gf, resid
 
-    if not over_budget("svd_fp32"):
-        _run_routine("svd_fp32", bench_svd32, sub, fails, infra)
 
     # ---- heev / svd fp64 (config 5 scaled to one chip) ---------------
     # the two-stage eig/svd pipeline through the fp64 MXU path; n=1024
@@ -617,8 +686,6 @@ def main():
                  / (np.linalg.norm(herm) * nev * e64))
         return "heev_fp64_n%d" % nev, gf, resid
 
-    if not over_budget("heev_fp64"):
-        _run_routine("heev_fp64", bench_heev64, sub, fails, infra)
 
     def bench_svd64():
         import jax
@@ -638,8 +705,43 @@ def main():
                  / (np.linalg.norm(a_np) * nev * e64))
         return "svd_fp64_n%d" % nev, gf, resid
 
-    if not over_budget("svd_fp64"):
-        _run_routine("svd_fp64", bench_svd64, sub, fails, infra)
+    # ---- the runner loop: global deadline budgeting ------------------
+    # The routine list is known up front, so each routine's SIGALRM
+    # deadline can be derived from ONE global budget
+    # (SLATE_TPU_BENCH_DEADLINE_S): remaining time split evenly over the
+    # remaining routines.  The required set runs unconditionally; the
+    # optional tail (heev/svd extras) still yields to the soft
+    # SLATE_TPU_BENCH_BUDGET_S wall like before.
+    routines = [
+        ("gemm", bench_gemm, False),
+        ("gemm_fp64", bench_gemm64, False),
+        ("potrf", bench_potrf, False),
+        ("potrf_fp64", bench_potrf64, False),
+        ("getrf", bench_getrf, False),
+        ("geqrf", bench_geqrf, False),
+        ("gels", bench_gels, False),
+        ("heev_fp32", bench_heev32, True),
+        ("svd_fp32", bench_svd32, True),
+        ("heev_fp64", bench_heev64, True),
+        ("svd_fp64", bench_svd64, True),
+    ]
+    results = {}
+    for i, (name, fn, optional) in enumerate(routines):
+        if optional and over_budget(name):
+            continue
+        deadline = ROUTINE_TIMEOUT_S
+        if DEADLINE_S > 0:
+            remaining = DEADLINE_S - (time.perf_counter() - t_start)
+            if remaining <= MIN_DEADLINE_S and optional:
+                # no room left for extras: record and move on — the
+                # aggregate still flushes inside the budget
+                skipped.append(name)
+                continue
+            per = remaining / max(1, len(routines) - i)
+            deadline = max(MIN_DEADLINE_S, min(ROUTINE_TIMEOUT_S, per))
+        results[name] = _run_routine(name, fn, sub, fails, infra,
+                                     deadline=deadline)
+    gemm_gf = results.get("gemm")
 
     # headline geomean: fp32 factor suite ONLY (the metric BENCH_r01-r03
     # track); fp64/eig/svd submetrics are reported but kept out so the
@@ -674,10 +776,12 @@ def main():
         "submetrics": sub,
         "fraction_of_measured_gemm": peak,
         "autotune": _autotune_tags(set()),   # full decision table
+        "metrics": _metrics_snapshot(),      # full registry snapshot
     }
     # regression tripwire (r4 lesson: geqrf silently lost 20% between
     # rounds): compare every submetric against the newest BENCH_r*.json
-    # in the repo root and flag drops > 5%
+    # in the repo root and flag drops > 5%.  The offline/multi-artifact
+    # sibling with verdicts and a nonzero exit is tools/bench_diff.py.
     regressions = {}
     try:
         import glob
@@ -686,6 +790,8 @@ def main():
         if prevs:
             with open(prevs[-1]) as f:
                 prev = json.load(f)
+            if isinstance(prev.get("parsed"), dict):
+                prev = prev["parsed"]   # driver wrapper: {rc, tail, parsed}
             prev_sub = prev.get("submetrics", {})
             for k, v in sub.items():
                 pv = prev_sub.get(k)

@@ -29,6 +29,7 @@ from ..matrix import (BaseMatrix, BaseTrapezoidMatrix, HermitianMatrix,
 from ..options import Options, get_option
 from ..ops import blocks
 from ..ops.blocks import matmul
+from ..perf.metrics import instrument_driver
 
 
 def _arr(x):
@@ -61,6 +62,7 @@ def _nb(a, opts):
     return int(nb)
 
 
+@instrument_driver("gemm")
 def gemm(alpha, a, b, beta, c, opts: Optional[Options] = None):
     """C ← α·op(A)·op(B) + β·C — reference ``slate::gemm`` (``src/gemm.cc``).
 
@@ -180,6 +182,7 @@ def trmm(side: Side, alpha, a, b, opts: Optional[Options] = None):
     return _wrap_like(b, out)
 
 
+@instrument_driver("trsm")
 def trsm(side: Side, alpha, a, b, opts: Optional[Options] = None):
     """Solve op(A)·X = α·B or X·op(A) = α·B — reference ``src/trsm.cc``
     (work loop ``src/work/work_trsm.cc:395``; the trsmA data-placement

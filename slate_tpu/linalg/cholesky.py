@@ -23,6 +23,7 @@ from ..enums import Diag, Op, Side, Uplo
 from ..matrix import BaseMatrix, BaseTrapezoidMatrix, HermitianMatrix, TriangularMatrix
 from ..options import Options, get_option
 from ..ops import blocks
+from ..perf.metrics import instrument_driver
 from ..ops.tile_ops import hermitize
 from .blas3 import _arr, _diag_of, _nb, _uplo_of, _wrap_like
 
@@ -34,6 +35,7 @@ def _hermitian_full(a):
     return jnp.asarray(a)  # raw array: assume full Hermitian given
 
 
+@instrument_driver("potrf")
 def potrf(a, opts: Optional[Options] = None):
     """Cholesky factorization A = L·Lᴴ (or UᴴU) — reference ``slate::potrf``
     (``src/potrf.cc:369``).
@@ -97,6 +99,7 @@ def potrf(a, opts: Optional[Options] = None):
     return out
 
 
+@instrument_driver("potrs")
 def potrs(a_factor, b, opts: Optional[Options] = None):
     """Solve A·X = B given the Cholesky factor — reference ``src/potrs.cc``:
     two triangular solves."""
@@ -118,6 +121,7 @@ def potrs(a_factor, b, opts: Optional[Options] = None):
     return _wrap_like(b, x)
 
 
+@instrument_driver("posv")
 def posv(a, b, opts: Optional[Options] = None):
     """Factor + solve — reference ``slate::posv`` (``src/posv.cc``).
     Returns ``(factor, x)``."""
@@ -127,6 +131,7 @@ def posv(a, b, opts: Optional[Options] = None):
     return fac, x
 
 
+@instrument_driver("trtri")
 def trtri(a, opts: Optional[Options] = None, hi: bool = False):
     """Triangular inverse — reference ``slate::trtri`` (``src/trtri.cc``).
     ``hi`` pins the assembly products to ``Precision.HIGHEST`` for
@@ -140,6 +145,7 @@ def trtri(a, opts: Optional[Options] = None, hi: bool = False):
     return _wrap_like(a, inv)
 
 
+@instrument_driver("trtrm")
 def trtrm(a, opts: Optional[Options] = None, hi: bool = False):
     """Triangular × triangular product Lᴴ·L / U·Uᴴ — reference
     ``slate::trtrm`` (``src/trtrm.cc``, LAPACK ``lauum``)."""
@@ -151,6 +157,7 @@ def trtrm(a, opts: Optional[Options] = None, hi: bool = False):
     return _wrap_like(a, out)
 
 
+@instrument_driver("potri")
 def potri(a_factor, opts: Optional[Options] = None):
     """Hermitian-positive-definite inverse from the Cholesky factor —
     reference ``slate::potri`` (``src/potri.cc``): ``trtri`` then

@@ -42,6 +42,7 @@ from ..enums import Diag, MethodEig, Op, Side, Uplo
 from ..exceptions import SlateError
 from ..matrix import BaseTrapezoidMatrix, as_array
 from ..options import Options, get_option
+from ..perf.metrics import instrument_driver
 from ..ops import blocks
 from ..ops.blocks import _ct, matmul
 from ..ops.tile_ops import hermitize
@@ -606,6 +607,7 @@ def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
     return _stage3_eig(d, e, rots, jobz, method, auto)
 
 
+@instrument_driver("heev")
 def heev(a, jobz: bool = True, opts: Optional[Options] = None):
     """Hermitian eigensolver — reference ``slate::heev``
     (``src/heev.cc``; two-stage chain ``:104-176``).

@@ -46,6 +46,8 @@ from ..matrix import Matrix, as_array
 from ..options import Options, get_option
 from ..ops import blocks
 from ..ops.blocks import matmul, matmul_hi
+from ..perf import metrics
+from ..perf.metrics import instrument_driver
 from .blas3 import _nb, _wrap_like
 from .norms import norm as _norm
 
@@ -349,6 +351,14 @@ def _u12_with_linv(lu_top, linv, c):
     r1 = c - matmul(l11, u12)
     dev = jnp.max(jnp.abs(r1)) / jnp.maximum(
         jnp.max(jnp.abs(c)), jnp.finfo(lu_top.dtype).tiny)
+    if metrics.enabled():
+        metrics.inc("lu.u12_linv.sites")      # trace-time: guarded sites
+    if metrics.device_metrics_wanted():
+        # runtime outcome of the guard (which branch the cond takes)
+        # needs a device→host callback, so it rides its OWN opt-in knob:
+        # with SLATE_TPU_METRICS_DEVICE unset no callback is traced and
+        # the compiled program is bit-identical to the uninstrumented one
+        jax.debug.callback(metrics.record_fallback_outcome, dev >= 1e-2)
     return lax.cond(
         dev < 1e-2,
         lambda _: u12 + matmul(li, r1),
@@ -692,6 +702,7 @@ def _getrf_partial(av, nb: int, raw_method=MethodLU.Auto):
     return getrf_rec(av, nb)
 
 
+@instrument_driver("getrf")
 def getrf(a, opts: Optional[Options] = None) -> Tuple[Matrix, jnp.ndarray]:
     """LU factorization with partial pivoting — reference ``slate::getrf``
     (``src/getrf.cc``).  Returns ``(LU, perm)`` with ``A[perm] = L·U``;
@@ -768,6 +779,7 @@ def _lu_solve(luv, perm, bv, nb: int):
     return blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit, luv, y, nb)
 
 
+@instrument_driver("getrs")
 def getrs(lu, perm, b, op: Op = Op.NoTrans, opts: Optional[Options] = None):
     """Solve op(A)·X = B from the LU factor — reference ``slate::getrs``
     (``src/getrs.cc``: permuteRows(Forward) → trsm(L) → trsm(U))."""
@@ -785,6 +797,7 @@ def getrs(lu, perm, b, op: Op = Op.NoTrans, opts: Optional[Options] = None):
     return _wrap_like(b, x)
 
 
+@instrument_driver("gesv")
 def gesv(a, b, opts: Optional[Options] = None):
     """Factor + solve — reference ``slate::gesv`` (``src/gesv.cc``).
     Returns ``(lu, perm, x)``."""
@@ -794,6 +807,7 @@ def gesv(a, b, opts: Optional[Options] = None):
     return lu, perm, x
 
 
+@instrument_driver("getri")
 def getri(lu, perm, opts: Optional[Options] = None):
     """Matrix inverse from the LU factor — reference ``slate::getri``
     (``src/getri.cc``: trtri(U) then solve; out-of-place variant

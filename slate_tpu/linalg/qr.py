@@ -46,6 +46,7 @@ from ..options import Options, get_option
 from ..ops import blocks
 from ..ops.blocks import _ct, matmul, matmul_hi
 from .blas3 import _nb, _wrap_like
+from ..perf.metrics import instrument_driver
 
 
 def _reject_complex_trans(a, op: Op):
@@ -350,6 +351,7 @@ def geqrf_panels(a, nb: int = 512):
     return lax.cond(devmax < 0.25, _keep, _hh_rerun, operand=None)
 
 
+@instrument_driver("geqrf")
 def geqrf(a, opts: Optional[Options] = None):
     """QR factorization — reference ``slate::geqrf`` (``src/geqrf.cc``).
     Returns ``(packed, taus)`` with R on/above the diagonal and the
@@ -548,6 +550,7 @@ def gels_cholqr(a, b, opts: Optional[Options] = None):
     return _wrap_like(b, x)
 
 
+@instrument_driver("gels")
 def gels(a, b, opts: Optional[Options] = None):
     """Least squares driver with method auto-selection — reference
     ``slate::gels`` (``src/gels.cc``; QR vs CholQR per ``method.hh:236``)."""

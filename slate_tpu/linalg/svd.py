@@ -25,6 +25,7 @@ from ..enums import MethodSVD, Op, Side
 from ..exceptions import SlateError
 from ..matrix import as_array
 from ..options import Options, get_option
+from ..perf.metrics import instrument_driver
 from ..ops.blocks import _ct, matmul
 from .blas3 import _nb
 from .eig import _givens, sterf
@@ -498,6 +499,7 @@ def svd_vals(a, opts: Optional[Options] = None):
     return svd(a, jobu=False, jobvt=False, opts=opts)[0]
 
 
+@instrument_driver("svd")
 def svd(a, jobu: bool = True, jobvt: bool = True,
         opts: Optional[Options] = None):
     """Two-stage SVD — reference ``slate::svd`` (``src/svd.cc:207-372``).

@@ -22,6 +22,7 @@ from .._jax_compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..grid import ceildiv, cyclic_permutation, inverse_permutation
+from ..perf import metrics
 from .dist import DistMatrix, _permute_blocks, like
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
@@ -57,6 +58,12 @@ def bcast_block_col(col_loc, grows, own, M: int):
     """
 
     dt = col_loc.dtype
+    if metrics.enabled():
+        # trace-time census: one count per bcast in each compiled step
+        # body (multiply by stage_bounds trip counts for per-run totals)
+        metrics.inc("collective.bcast_col.count")
+        metrics.inc("collective.bcast_col.bytes",
+                    float(M * col_loc.shape[1] * jnp.dtype(dt).itemsize))
     buf = jnp.zeros((M, col_loc.shape[1]), dt)
     buf = buf.at[grows].set(col_loc * own.astype(dt))
     return lax.psum(buf, (AXIS_P, AXIS_Q))
@@ -68,6 +75,10 @@ def bcast_block_row(row_loc, gcols, own, N: int):
     factor's block ROW k)."""
 
     dt = row_loc.dtype
+    if metrics.enabled():
+        metrics.inc("collective.bcast_row.count")
+        metrics.inc("collective.bcast_row.bytes",
+                    float(row_loc.shape[0] * N * jnp.dtype(dt).itemsize))
     buf = jnp.zeros((row_loc.shape[0], N), dt)
     buf = buf.at[:, gcols].set(row_loc * own.astype(dt))
     return lax.psum(buf, (AXIS_P, AXIS_Q))

@@ -1,6 +1,7 @@
 """Performance helpers: lowered-HLO collective/flop profiling
-(:mod:`.hlo_profile`) and the autotuned backend dispatch table
-(:mod:`.autotune`)."""
+(:mod:`.hlo_profile`), the autotuned backend dispatch table
+(:mod:`.autotune`), the runtime metrics registry (:mod:`.metrics`) and
+the bench regression sentinel (:mod:`.regress`)."""
 
 from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
                           ModuleProfile, profile_fn, profile_hlo_text,
@@ -8,15 +9,16 @@ from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
 
 __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
-    "autotune", "profile_fn", "profile_hlo_text",
+    "autotune", "metrics", "profile_fn", "profile_hlo_text", "regress",
     "stablehlo_collective_shapes",
 ]
 
 
 def __getattr__(name):
-    # lazy: autotune pulls in jax.random/pallas bits only when used
-    if name == "autotune":
+    # lazy: autotune pulls in jax.random/pallas bits only when used;
+    # metrics/regress stay stdlib-light and import on demand
+    if name in ("autotune", "metrics", "regress"):
         import importlib
 
-        return importlib.import_module(".autotune", __name__)
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(name)

@@ -50,6 +50,8 @@ import threading
 import time
 from typing import Any, Callable, NamedTuple, Optional
 
+from . import metrics
+
 __all__ = [
     "AutotuneTable", "Candidate", "table", "reset_table", "select",
     "decide", "decisions", "timing_reps", "kernel",
@@ -177,6 +179,7 @@ class AutotuneTable:
         except (OSError, ValueError):
             return
         if blob.get("version") != _version_key():
+            metrics.inc("autotune.cache.stale")
             return                      # stale: different jax/libtpu/platform
         stored = blob.get("decisions", {})
         if not isinstance(stored, dict):
@@ -185,6 +188,7 @@ class AutotuneTable:
             if isinstance(v, dict) and "backend" in v:
                 self.decisions[k] = dict(v, source="cache")
                 self._persist[k] = v
+        metrics.inc("autotune.cache.loaded", float(len(self._persist)))
 
     def _save(self) -> None:
         blob = {"version": _version_key(), "decisions": self._persist}
@@ -205,6 +209,9 @@ class AutotuneTable:
         if times:
             info["times"] = times
         self.decisions[key] = info
+        metrics.inc("dispatch.%s.%s" % (op, backend))
+        if source == "timed":
+            metrics.inc("autotune.win.%s.%s" % (op, backend))
         if persist:
             self._persist[key] = {"backend": backend, "times": times or {}}
             self._save()
@@ -226,8 +233,11 @@ class AutotuneTable:
             forced = _forced(op)
             if forced is not None:
                 if forced in names:
+                    metrics.inc("autotune.forced")
                     if hit is None or hit.get("backend") != forced:
                         self._record(op, key, forced, "forced")
+                    else:
+                        metrics.inc("dispatch.%s.%s" % (op, forced))
                     return forced
                 _warn_bad_force(op, forced, names)
             # Only settled results pin a key: knob-derived records
@@ -240,7 +250,12 @@ class AutotuneTable:
             if hit is not None and hit["backend"] in names \
                     and hit.get("source") in ("timed", "cache",
                                               "all-pruned", "only"):
+                metrics.inc("autotune.cache.hit"
+                            if hit.get("source") == "cache"
+                            else "autotune.table.hit")
+                metrics.inc("dispatch.%s.%s" % (op, hit["backend"]))
                 return hit["backend"]
+            metrics.inc("autotune.miss")
             if len(candidates) == 1:
                 return self._record(op, key, names[0], "only")
             if not _enabled() or not _on_tpu():
@@ -255,6 +270,7 @@ class AutotuneTable:
                     out = run()                       # compile + warm
                     if cand.check is not None and not cand.check(out):
                         failures[cand.name] = "accuracy-guard"
+                        metrics.inc("autotune.pruned.accuracy-guard")
                         continue
                     ts = []
                     for _ in range(reps):
@@ -262,10 +278,13 @@ class AutotuneTable:
                         run()
                         ts.append(time.perf_counter() - t0)
                     self.timing_reps += reps
+                    metrics.inc("autotune.probe_reps", float(reps))
                     times[cand.name] = min(ts)
                 except Exception as e:  # compile failure / OOM / ...
                     failures[cand.name] = f"{type(e).__name__}: {e}"
+                    metrics.inc("autotune.pruned.compile")
             if not times:
+                metrics.inc("autotune.all_pruned")
                 # every candidate pruned (probe OOM, compile outage):
                 # fall back to the stock-XLA backend when one is listed
                 # — it is the only candidate whose failure mode is
@@ -393,7 +412,9 @@ def _static(op: str, key_parts, backend: str, source: str) -> str:
     tab = table()
     key = _key_str(op, key_parts)
     if key not in tab.decisions or tab.decisions[key]["backend"] != backend:
-        tab._record(op, key, backend, source)
+        tab._record(op, key, backend, source)     # counts the dispatch too
+    else:
+        metrics.inc("dispatch.%s.%s" % (op, backend))
     return backend
 
 

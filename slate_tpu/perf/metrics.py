@@ -1,0 +1,406 @@
+"""Process-wide runtime metrics registry: counters, gauges, timers and
+histograms behind one env-gated, thread-safe facade.
+
+The reference ships first-class observability (``trace::Block`` RAII
+events + SVG gantt, ``Debug`` invariant checks); this module is the
+*quantitative* sibling the port was missing: every layer that makes a
+silent decision — the autotune table (cache hit/miss/stale, candidates
+pruned and why, probe reps, winning backend per site), the driver
+facades (calls, wall time, jit compiles, post-condition outcomes,
+fallback activations such as the LU ``triangular_solve`` path), Pallas
+dispatch, and the ``parallel/dist_util`` collectives — now increments a
+named counter here, and two exporters make the numbers travel:
+
+* :func:`snapshot` → a JSON-safe dict embedded in every ``bench.py``
+  line and aggregate, so each ``BENCH_r*.json`` artifact carries the
+  decisions that produced its numbers;
+* :func:`slate_tpu.trace.finish_perfetto` → Chrome-trace/Perfetto JSON
+  merging ``trace.Block`` spans with this registry's counter tracks.
+
+Design rules (the BLASX lesson — scheduler behavior is only tunable
+once it is measured — balanced against the library's perf contract):
+
+* **Near-zero overhead when off.**  Every recording entry point checks
+  one attribute (``_registry.enabled``) and returns; no locks, no
+  allocation.  The registry is OFF unless ``SLATE_TPU_METRICS=1`` (or a
+  harness calls :func:`on`, as ``bench.py`` does).
+* **Host-side only by default.**  Instrumentation runs in Python at
+  dispatch/trace time; it never changes the compiled program.  The one
+  exception — the LU ``_u12_with_linv`` fallback counter, which needs a
+  runtime ``jax.debug.callback`` — is gated by its own knob
+  (``SLATE_TPU_METRICS_DEVICE=1``) precisely because inserting the
+  callback changes the traced program.
+* **One facade.**  Non-``perf`` modules reach the registry ONLY through
+  the public functions here (``tests/test_backend_registry.py`` guards
+  against private ``_registry`` imports), keeping the instrumentation
+  seams enumerable.
+
+Env knobs:
+
+* ``SLATE_TPU_METRICS`` — ``1`` enables the registry at import.
+* ``SLATE_TPU_CHECK_FINITE`` — ``1`` makes every instrumented driver
+  facade validate its outputs with :func:`slate_tpu.debug.check_finite`
+  and increment ``checks.nonfinite`` (a warning, not an exception)
+  instead of letting NaNs fail silently downstream.
+* ``SLATE_TPU_METRICS_DEVICE`` — ``1`` adds runtime callbacks for
+  data-dependent counters (LU u12 fallback activations).  Perturbs
+  timing; off by default.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+import threading
+import time
+import warnings
+
+__all__ = [
+    "enabled", "on", "off", "reset", "inc", "set_gauge", "observe",
+    "timer", "observe_time", "snapshot", "counter_series",
+    "drain_samples", "instrument_driver", "check_finite_wanted",
+    "device_metrics_wanted", "record_fallback_outcome", "pallas_census",
+    "install_compile_watch",
+]
+
+_ENV = "SLATE_TPU_METRICS"
+
+#: cap on stored (ts, name, value) counter samples (the Perfetto counter
+#: tracks); past the cap counters keep counting but stop sampling.
+_MAX_SAMPLES = 65536
+
+
+def _env_on(name: str, default: str = "") -> bool:
+    return os.environ.get(name, default).strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+class _Registry:
+    """The process-wide store.  Private — use the module facade."""
+
+    def __init__(self):
+        self.enabled = _env_on(_ENV)
+        self.lock = threading.RLock()
+        self.counters: dict = {}
+        self.gauges: dict = {}
+        self.timers: dict = {}      # name -> [count, total, min, max]
+        self.hists: dict = {}       # name -> {count, total, buckets{}}
+        self.samples: list = []     # (perf_counter ts, name, value)
+
+
+_registry = _Registry()
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _registry.enabled
+
+
+def on() -> None:
+    """Enable recording (also installs the jit compile-watch hook)."""
+    _registry.enabled = True
+    install_compile_watch()
+
+
+def off() -> None:
+    _registry.enabled = False
+
+
+def reset() -> None:
+    """Drop every recorded value (the enabled flag is left as is)."""
+    reg = _registry
+    with reg.lock:
+        reg.counters.clear()
+        reg.gauges.clear()
+        reg.timers.clear()
+        reg.hists.clear()
+        reg.samples.clear()
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+def inc(name: str, value: float = 1.0, force: bool = False) -> None:
+    """Add ``value`` to counter ``name``.  ``force`` records even while
+    the registry is off — reserved for counters whose OWN opt-in knob is
+    set (``checks.*``, device callbacks), so enabling that knob alone is
+    enough to see its numbers."""
+    reg = _registry
+    if not (reg.enabled or force):
+        return
+    with reg.lock:
+        v = reg.counters.get(name, 0.0) + value
+        reg.counters[name] = v
+        if len(reg.samples) < _MAX_SAMPLES:
+            reg.samples.append((time.perf_counter(), name, v))
+
+
+def set_gauge(name: str, value: float) -> None:
+    reg = _registry
+    if not reg.enabled:
+        return
+    with reg.lock:
+        reg.gauges[name] = float(value)
+        if len(reg.samples) < _MAX_SAMPLES:
+            reg.samples.append((time.perf_counter(), name, float(value)))
+
+
+def observe_time(name: str, seconds: float) -> None:
+    """Record one duration into timer ``name`` (count/total/min/max)."""
+    reg = _registry
+    if not reg.enabled:
+        return
+    with reg.lock:
+        t = reg.timers.get(name)
+        if t is None:
+            reg.timers[name] = [1, seconds, seconds, seconds]
+        else:
+            t[0] += 1
+            t[1] += seconds
+            t[2] = min(t[2], seconds)
+            t[3] = max(t[3], seconds)
+
+
+class _Timer:
+    """Context manager recording its wall time into a named timer."""
+
+    __slots__ = ("name", "_t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if _registry.enabled:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _registry.enabled and self._t0:
+            observe_time(self.name, time.perf_counter() - self._t0)
+        return False
+
+
+def timer(name: str) -> _Timer:
+    return _Timer(name)
+
+
+def _bucket(value: float) -> str:
+    if value <= 0:
+        return "le_0"
+    return "le_2^%d" % math.ceil(math.log2(value))
+
+
+def observe(name: str, value: float) -> None:
+    """Record one sample into histogram ``name`` (power-of-two buckets —
+    the same granularity the autotune matmul keys use)."""
+    reg = _registry
+    if not reg.enabled:
+        return
+    with reg.lock:
+        h = reg.hists.get(name)
+        if h is None:
+            h = reg.hists[name] = {"count": 0, "total": 0.0, "buckets": {}}
+        h["count"] += 1
+        h["total"] += value
+        b = _bucket(value)
+        h["buckets"][b] = h["buckets"].get(b, 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# Exporters
+# ---------------------------------------------------------------------------
+
+def snapshot() -> dict:
+    """JSON-safe view of everything recorded so far — the dict embedded
+    in every ``bench.py`` JSON line and aggregate."""
+    reg = _registry
+    with reg.lock:
+        return {
+            "enabled": reg.enabled,
+            "counters": dict(reg.counters),
+            "gauges": dict(reg.gauges),
+            "timers": {k: {"count": t[0], "total_s": t[1],
+                           "min_s": t[2], "max_s": t[3]}
+                       for k, t in reg.timers.items()},
+            "hists": {k: {"count": h["count"], "total": h["total"],
+                          "buckets": dict(h["buckets"])}
+                      for k, h in reg.hists.items()},
+        }
+
+
+def counter_series() -> list:
+    """``[(perf_counter_ts, name, value)]`` counter samples, oldest
+    first — the Perfetto counter tracks."""
+    with _registry.lock:
+        return list(_registry.samples)
+
+
+def drain_samples() -> list:
+    """Pop and return every counter sample (used by
+    :func:`slate_tpu.trace.finish_perfetto` so a second export starts
+    clean)."""
+    with _registry.lock:
+        out = list(_registry.samples)
+        _registry.samples.clear()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# jit compile watch — the "how many times did this routine recompile"
+# counter.  jax.monitoring publishes per-compile durations
+# (/jax/core/compile/backend_compile_duration); one process-wide
+# listener forwards them into the registry while it is enabled.
+# ---------------------------------------------------------------------------
+
+_compile_watch_installed = [False]
+
+
+def _on_jax_event(event: str, duration, **kw) -> None:
+    # jax.monitoring's documented listener contract is
+    # callback(event, duration, **kwargs) — swallow the kwargs or a
+    # future jax that passes them raises from inside its compile path
+    if not _registry.enabled:
+        return
+    if event.endswith("backend_compile_duration"):
+        inc("jit.backend_compiles")
+        inc("jit.backend_compile_secs", float(duration))
+    elif "compile" in event:
+        inc("jit.compile_events")
+
+
+def install_compile_watch() -> None:
+    """Register the jax.monitoring listener once per process.  The
+    listener itself is a no-op while the registry is off, so installing
+    it costs nothing for untraced runs."""
+    if _compile_watch_installed[0]:
+        return
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(_on_jax_event)
+        _compile_watch_installed[0] = True
+    except Exception:       # pragma: no cover - jax without monitoring
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Driver facade instrumentation
+# ---------------------------------------------------------------------------
+
+def check_finite_wanted() -> bool:
+    """The ``SLATE_TPU_CHECK_FINITE=1`` opt-in: instrumented drivers
+    validate their outputs post-call (read per call so tests can
+    monkeypatch the environment)."""
+    return _env_on("SLATE_TPU_CHECK_FINITE")
+
+
+def device_metrics_wanted() -> bool:
+    """The ``SLATE_TPU_METRICS_DEVICE=1`` opt-in for runtime-callback
+    counters (changes the traced program — never on by default)."""
+    return _env_on("SLATE_TPU_METRICS_DEVICE")
+
+
+def _leaves(x, out=None) -> list:
+    """Array leaves of a driver result: raw arrays, matrix wrappers
+    (``.array`` resolves the stored op view) and (named) tuples."""
+    if out is None:
+        out = []
+    if x is None or isinstance(x, (bool, int, float, complex, str)):
+        return out
+    if isinstance(x, (list, tuple)):
+        for e in x:
+            _leaves(e, out)
+        return out
+    arr = getattr(x, "array", x)
+    if hasattr(arr, "shape") and hasattr(arr, "dtype"):
+        out.append(arr)
+    return out
+
+
+def _check_outputs(name: str, out) -> None:
+    """The opt-in post-condition: per-tile NaN/Inf census on every array
+    leaf via :func:`slate_tpu.debug.check_finite`; a hit increments
+    ``checks.nonfinite`` and warns instead of raising (counting beats
+    failing silently downstream, and beats killing a pipeline whose
+    caller may handle the NaN)."""
+    try:
+        import jax
+
+        tracer_t = jax.core.Tracer
+    except Exception:           # pragma: no cover
+        tracer_t = ()
+    import slate_tpu.debug as _debug
+    from slate_tpu.exceptions import SlateError
+
+    inc("checks.runs", force=True)
+    for arr in _leaves(out):
+        if tracer_t and isinstance(arr, tracer_t):
+            continue            # inside a jit trace: nothing to check yet
+        try:
+            _debug.check_finite(arr, name="%s output" % name)
+        except SlateError as e:
+            inc("checks.nonfinite", force=True)
+            warnings.warn(str(e), RuntimeWarning, stacklevel=3)
+        except Exception:
+            continue            # unconvertible leaf (weak types, etc.)
+
+
+def instrument_driver(name: str):
+    """Decorator for a public driver facade: counts calls and wall time
+    (``driver.<name>.calls`` / timer ``driver.<name>``) and runs the
+    opt-in finite check.  When every observability knob is off the
+    wrapper is two attribute reads and a call — the wrapped driver runs
+    the identical backend path."""
+
+    label = "driver.%s" % name
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            reg = _registry
+            checks = check_finite_wanted()
+            if not (reg.enabled or checks):
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if reg.enabled:
+                inc(label + ".calls")
+                observe_time(label, time.perf_counter() - t0)
+            if checks:
+                _check_outputs(name, out)
+            return out
+
+        wrapper.__metrics_driver__ = name
+        return wrapper
+
+    return deco
+
+
+def record_fallback_outcome(took_fallback) -> None:
+    """Runtime-callback sink for the LU ``_u12_with_linv`` guard
+    (``SLATE_TPU_METRICS_DEVICE=1``): counts which branch the traced
+    ``lax.cond`` actually took."""
+    inc("lu.u12_linv.fallback" if bool(took_fallback)
+        else "lu.u12_linv.fast", force=True)
+
+
+# ---------------------------------------------------------------------------
+# Pallas launch census bridge
+# ---------------------------------------------------------------------------
+
+def pallas_census(op: str, fn, *args, **kwargs) -> int:
+    """Count ``fn(*args)``'s ``pallas_call`` invocations with the
+    existing jaxpr census (:func:`slate_tpu.perf.hlo_profile.
+    count_pallas_calls` — platform-independent) and record the result as
+    gauge ``pallas.launches.<op>``.  Returns the count."""
+    from slate_tpu.perf.hlo_profile import count_pallas_calls
+
+    n = count_pallas_calls(fn, *args, **kwargs)
+    set_gauge("pallas.launches.%s" % op, float(n))
+    return n

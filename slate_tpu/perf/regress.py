@@ -1,0 +1,296 @@
+"""Bench regression sentinel: diff ``BENCH_r*.json`` artifacts.
+
+The performance trajectory of this library is a sequence of bench
+artifacts — either the driver wrapper shape (``{"n", "cmd", "rc",
+"tail", "parsed"}``) checked in as ``BENCH_r*.json``, or raw
+``bench.py`` stdout (JSON lines ending in the aggregate).  Two failure
+modes have already cost real rounds:
+
+* **silent throughput regressions** — geqrf dropped 23.5 → 18.9 TF/s
+  between r3 and r4 (a per-panel ``lax.cond`` guard) and was only found
+  by a human reading numbers side by side;
+* **infra-shaped artifacts** — BENCH_r05 landed as ``rc=124`` with
+  ``parsed: null`` (outer timeout beat the suite's single final print)
+  and looked like "no data" instead of "broken run".
+
+This module machine-checks both: load two or more artifacts, align
+routines by their submetric identity (routine name, dtype, dims —
+parsed from labels like ``geqrf_fp32_m32768_n4096``), emit a verdict
+table, and exit nonzero on any regression past the threshold or any
+infra-shaped artifact.  The CLI lives in ``tools/bench_diff.py``
+(stdlib-only — it never imports jax, so it runs anywhere in
+milliseconds).
+
+Backend attribution: when artifacts carry the ``autotune`` decision
+table (r6+) the sentinel reports a per-routine backend tag and NOTES a
+tag change next to the verdict rather than splitting the alignment key
+— older artifacts carry no tags, and a tag-keyed alignment would
+silently stop comparing the moment tagging was introduced.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = [
+    "Artifact", "Report", "Row", "load_artifact", "diff", "format_table",
+    "DEFAULT_THRESHOLD_PCT",
+]
+
+#: flag a drop bigger than this (percent) between consecutive artifacts
+DEFAULT_THRESHOLD_PCT = 5.0
+
+_LABEL_RE = re.compile(
+    r"^(?P<routine>[a-z0-9]+?)_(?P<dtype>fp32|fp64|bf16|c64|c128)_"
+    r"(?P<dims>.+)$")
+
+#: submetric-label prefix → the autotune op sites that produce it (for
+#: the backend tag; see module docstring on why tags don't key alignment)
+_OPS_FOR_ROUTINE = {
+    "gemm": ("matmul",),
+    "mxu": (),
+    "potrf": ("potrf_panel", "potrf_panel_f64"),
+    "getrf": ("lu_driver", "lu_panel"),
+    "geqrf": ("geqrf_panel",),
+    "gels": ("geqrf_panel",),
+    "trtri": ("trtri_panel",),
+}
+
+
+def parse_label(label: str):
+    """``geqrf_fp32_m32768_n4096`` → ("geqrf", "fp32", "m32768_n4096");
+    labels that don't match keep their full text as the routine."""
+    m = _LABEL_RE.match(label)
+    if not m:
+        return (label, "", "")
+    return (m.group("routine"), m.group("dtype"), m.group("dims"))
+
+
+@dataclass
+class Artifact:
+    """One loaded bench artifact."""
+
+    path: str
+    name: str
+    rc: int = 0
+    aggregate: Optional[dict] = None
+    submetrics: dict = field(default_factory=dict)
+    autotune: dict = field(default_factory=dict)
+    infra: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.infra
+
+    def backend_tag(self, label: str) -> str:
+        """Comma-joined backends of the autotune decisions feeding this
+        routine ('' when the artifact carries no decision table)."""
+        routine = parse_label(label)[0]
+        ops = _OPS_FOR_ROUTINE.get(routine, ())
+        hits = sorted({v for k, v in self.autotune.items()
+                       if isinstance(v, str)
+                       and any(k.startswith(op + "|") for op in ops)})
+        return ",".join(hits)
+
+
+def _aggregate_from_lines(text: str):
+    """Raw bench stdout: per-routine JSON lines with the aggregate LAST.
+    Returns (aggregate|None) — scans from the end, tolerating trailing
+    non-JSON noise (log lines)."""
+    agg = None
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            agg = d                      # keep the LAST aggregate seen
+    return agg
+
+
+def load_artifact(path: str) -> "Artifact":
+    """Load one artifact: driver wrapper dict, bare aggregate dict, or
+    raw bench JSON-lines output.  Never raises on malformed content —
+    a file the sentinel cannot parse IS an infra finding."""
+    name = path.rsplit("/", 1)[-1]
+    art = Artifact(path=path, name=name)
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        art.infra.append(f"unreadable: {e}")
+        return art
+    blob = None
+    try:
+        blob = json.loads(text)
+    except ValueError:
+        pass
+    if isinstance(blob, dict) and ("parsed" in blob or "rc" in blob):
+        # driver wrapper: {"n", "cmd", "rc", "tail", "parsed"}
+        try:
+            art.rc = int(blob.get("rc", 0))
+        except (TypeError, ValueError):
+            art.rc = -1
+        agg = blob.get("parsed")
+        if not isinstance(agg, dict):
+            # rc!=0 runs may still have flushed per-routine lines +
+            # a partial aggregate into the captured tail
+            agg = _aggregate_from_lines(str(blob.get("tail", "")))
+    elif isinstance(blob, dict) and "metric" in blob:
+        agg = blob                       # bare aggregate
+    elif blob is None:
+        agg = _aggregate_from_lines(text)  # raw bench stdout
+    else:
+        agg = None
+    if art.rc != 0:
+        art.infra.append(f"rc={art.rc}")
+    if not isinstance(agg, dict):
+        art.infra.append("missing aggregate")
+        return art
+    art.aggregate = agg
+    subs = agg.get("submetrics")
+    art.submetrics = dict(subs) if isinstance(subs, dict) else {}
+    at = agg.get("autotune")
+    art.autotune = dict(at) if isinstance(at, dict) else {}
+    if not art.submetrics:
+        art.infra.append("no parsed routines")
+    if agg.get("partial"):
+        art.infra.append("partial aggregate (suite truncated)")
+    return art
+
+
+@dataclass
+class Row:
+    """One aligned routine across the artifact sequence."""
+
+    label: str
+    values: List[Optional[float]]
+    verdict: str                 # REGRESS | IMPROVE | OK | NEW | GONE | n/a
+    delta_pct: Optional[float]   # first present → last present
+    note: str = ""
+
+
+@dataclass
+class Report:
+    rows: List[Row]
+    artifacts: List[Artifact]
+    threshold_pct: float
+
+    @property
+    def regressions(self) -> List[Row]:
+        return [r for r in self.rows if r.verdict == "REGRESS"]
+
+    @property
+    def infra(self):
+        return [(a.name, a.infra) for a in self.artifacts if a.infra]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.regressions or self.infra) else 0
+
+
+def _num(v) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) and v > 0 else None
+
+
+def diff(artifacts: List[Artifact],
+         threshold_pct: float = DEFAULT_THRESHOLD_PCT) -> Report:
+    """Align every submetric across the artifact sequence and judge it.
+
+    The verdict looks at CONSECUTIVE present values (a regression in the
+    middle of a three-artifact chain is still a regression even if a
+    later round wins it back); ``delta_pct`` summarizes first → last.
+    """
+    labels: List[str] = []
+    for a in artifacts:
+        for k in a.submetrics:
+            if k not in labels:
+                labels.append(k)
+    rows = []
+    for label in labels:
+        vals = [_num(a.submetrics.get(label)) for a in artifacts]
+        present = [v for v in vals if v is not None]
+        note = ""
+        tags = [a.backend_tag(label) for a in artifacts
+                if a.submetrics.get(label) is not None]
+        tags = [t for t in tags if t]
+        if len(set(tags)) > 1:
+            note = "backend changed: " + " -> ".join(
+                dict.fromkeys(tags))     # ordered unique
+        if len(present) < 2:
+            verdict = "n/a"
+            if vals and vals[-1] is not None and len(present) == 1 \
+                    and all(v is None for v in vals[:-1]):
+                verdict = "NEW"
+            elif present and vals and vals[-1] is None:
+                verdict = "GONE"
+            rows.append(Row(label, vals, verdict, None, note))
+            continue
+        worst_drop = 0.0
+        best_gain = 0.0
+        prev = None
+        for v in vals:
+            if v is None:
+                continue
+            if prev is not None:
+                change = (v / prev - 1.0) * 100.0
+                worst_drop = min(worst_drop, change)
+                best_gain = max(best_gain, change)
+            prev = v
+        if -worst_drop > threshold_pct:
+            verdict = "REGRESS"
+        elif vals[-1] is None:
+            # present history but missing from the NEWEST artifact: the
+            # silent-dropout mode the sentinel exists to catch must not
+            # read as OK (REGRESS above still wins — it is more severe)
+            verdict = "GONE"
+        elif best_gain > threshold_pct:
+            verdict = "IMPROVE"
+        else:
+            verdict = "OK"
+        delta = (present[-1] / present[0] - 1.0) * 100.0
+        rows.append(Row(label, vals, verdict, delta, note))
+    order = {"REGRESS": 0, "GONE": 1, "NEW": 2, "IMPROVE": 3, "OK": 4,
+             "n/a": 5}
+    rows.sort(key=lambda r: (order.get(r.verdict, 9), r.label))
+    return Report(rows=rows, artifacts=list(artifacts),
+                  threshold_pct=threshold_pct)
+
+
+def _fmt_val(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return ("%.1f" % v) if v < 10000 else ("%.0f" % v)
+
+
+def format_table(report: Report) -> str:
+    """Human-readable verdict table + infra findings."""
+    heads = ["routine"] + [a.name for a in report.artifacts] \
+        + ["Δ%", "verdict"]
+    body = []
+    for r in report.rows:
+        delta = "%+.1f%%" % r.delta_pct if r.delta_pct is not None else "-"
+        line = [r.label] + [_fmt_val(v) for v in r.values] \
+            + [delta, r.verdict + ((" (%s)" % r.note) if r.note else "")]
+        body.append(line)
+    widths = [max(len(str(row[i])) for row in [heads] + body)
+              for i in range(len(heads))]
+    out = []
+    for row in [heads] + body:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(row, widths))
+                   .rstrip())
+    out.append("")
+    n_reg = len(report.regressions)
+    out.append("threshold: %.1f%%  regressions: %d"
+               % (report.threshold_pct, n_reg))
+    for name, reasons in report.infra:
+        out.append("INFRA %s: %s" % (name, "; ".join(reasons)))
+    out.append("verdict: %s"
+               % ("FAIL" if report.exit_code else "PASS"))
+    return "\n".join(out)

@@ -74,6 +74,7 @@ class Block:
 
     def __init__(self, name: str, lane: Optional[str] = None):
         self.name = name[:30]          # reference caps names at 30 chars
+        self._lane_arg = lane          # None = resolve at entry/call time
         self.lane = lane or threading.current_thread().name
         self._ann = None
         self._t0 = 0.0
@@ -97,9 +98,14 @@ class Block:
         return False
 
     def __call__(self, fn):
+        # pass the ORIGINAL lane argument, not the resolved self.lane:
+        # a decorator is built once (on the decorating thread), but the
+        # wrapped function may run on any worker thread — when no lane
+        # was given explicitly it must resolve at CALL time, or every
+        # worker-thread call lands in the decorating thread's lane
         @functools.wraps(fn)
         def wrapper(*a, **kw):
-            with Block(self.name, self.lane):
+            with Block(self.name, self._lane_arg):
                 return fn(*a, **kw)
         return wrapper
 
@@ -166,4 +172,72 @@ def finish(path: Optional[str] = None) -> Optional[str]:
     parts.append("</svg>")
     with open(path, "w") as f:
         f.write("\n".join(parts))
+    return path
+
+
+def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
+    """Export the collected events as Chrome-trace/Perfetto JSON and
+    reset — the machine-readable sibling of :func:`finish` (the SVG
+    stays the quick-look artifact).  Load the file at
+    https://ui.perfetto.dev or ``chrome://tracing``.
+
+    The export merges two sources on one clock:
+
+    * every :class:`Block` span as a complete event (``"ph": "X"``),
+      one Perfetto track per lane (thread-name metadata rides along);
+    * the metrics registry's counter samples
+      (:func:`slate_tpu.perf.metrics.counter_series`) as counter tracks
+      (``"ph": "C"``) — autotune decisions, driver calls, collective
+      bytes line up under the spans that caused them.
+
+    Returns the file path (``trace_<epoch>.perfetto.json`` by default)
+    or None when there is nothing to export.  Consumes both the event
+    buffer and the registry's sample buffer (counter VALUES keep
+    accumulating — only the time series is drained).
+    """
+
+    origin = _origin
+    evts = events()
+    clear()
+    try:
+        from .perf import metrics as _metrics
+
+        samples = _metrics.drain_samples()
+    except Exception:       # pragma: no cover - metrics must never block
+        samples = []
+    if not evts and not samples:
+        return None
+    # one clock: events store times relative to the trace origin;
+    # samples carry absolute perf_counter stamps.  Samples recorded
+    # BEFORE trace.on() set the origin (metrics enabled first) must not
+    # go negative — the earliest of (origin, first sample) anchors t=0,
+    # with block-event timestamps shifted by the same amount.
+    shift = 0.0
+    if samples:
+        first = min(ts for ts, _, _ in samples)
+        if not origin:
+            origin = first
+        elif first < origin:
+            shift = origin - first      # added to every block event
+            origin = first
+    lanes = sorted({e.lane for e in evts})
+    tids = {lane: i for i, lane in enumerate(lanes)}
+    out = []
+    for lane, tid in tids.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": 0,
+                    "tid": tid, "args": {"name": lane}})
+    for e in evts:
+        out.append({"name": e.name, "cat": "block", "ph": "X",
+                    "ts": round((e.start + shift) * 1e6, 3),
+                    "dur": round(max(e.stop - e.start, 0.0) * 1e6, 3),
+                    "pid": 0, "tid": tids[e.lane]})
+    for ts, name, value in samples:
+        out.append({"name": name, "cat": "metrics", "ph": "C",
+                    "ts": round((ts - origin) * 1e6, 3),
+                    "pid": 0, "args": {"value": value}})
+    path = path or f"trace_{int(time.time())}.perfetto.json"
+    import json
+
+    with open(path, "w") as f:
+        json.dump({"traceEvents": out, "displayTimeUnit": "ms"}, f)
     return path

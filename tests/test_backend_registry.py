@@ -45,6 +45,32 @@ def test_no_kernel_imports_outside_dispatch_layer():
         "or fetch the leaf via autotune.kernel()):\n" + "\n".join(offenders))
 
 
+#: private-surface access patterns for the metrics registry: importing
+#: or touching ``_registry`` (the singleton), or any ``metrics._x``
+#: attribute — non-perf modules must go through the public facade
+#: functions of ``slate_tpu.perf.metrics`` only, so the instrumentation
+#: seams stay enumerable (and swappable) behind one API.
+_METRICS_PRIVATE_RE = re.compile(
+    r"(\b_registry\b"
+    r"|from\s+[\w.]*\bmetrics\b\s+import\s+[^#\n]*\b_\w+"
+    r"|\bmetrics\._\w+)")
+
+
+def test_no_private_metrics_registry_access_outside_perf():
+    offenders = []
+    for path in sorted(_PKG.rglob("*.py")):
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        if rel.startswith("perf/"):
+            continue                    # the registry lives there
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _METRICS_PRIVATE_RE.search(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "metrics registry reached outside the public perf.metrics facade "
+        "(use metrics.inc/snapshot/instrument_driver/... instead):\n"
+        + "\n".join(offenders))
+
+
 def test_multi_backend_sites_populate_autotune_table():
     """Exercising each tunable op site must leave a decision entry —
     proof the site consults the table rather than hard-coding a

@@ -1,0 +1,220 @@
+"""The bench regression sentinel (perf/regress.py + tools/bench_diff.py)
+over synthetic BENCH fixtures — a regression, an improvement, and an
+infra failure — plus the checked-in r3→r4 geqrf regression.
+
+The CLI is driven via subprocess (it must run WITHOUT importing jax —
+that property is part of the contract) and the library directly."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from slate_tpu.perf import regress
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CLI = os.path.join(_REPO, "tools", "bench_diff.py")
+
+
+def _wrapper(tmp_path, name, submetrics, rc=0, parsed=True, autotune=None):
+    agg = None
+    if parsed:
+        agg = {"metric": "factor_suite_fp32_geomean", "value": 1.0,
+               "unit": "GFLOP/s", "vs_baseline": 1.0,
+               "submetrics": submetrics}
+        if autotune is not None:
+            agg["autotune"] = autotune
+    blob = {"n": 1, "cmd": "bench", "rc": rc, "tail": "", "parsed": agg}
+    p = tmp_path / name
+    p.write_text(json.dumps(blob))
+    return str(p)
+
+
+_BASE = {"gemm_fp32_n8192": 50000.0, "geqrf_fp32_m32768_n4096": 23525.9}
+
+
+def _run_cli(*args):
+    return subprocess.run([sys.executable, _CLI, *args],
+                          capture_output=True, text=True)
+
+
+# ---------------------------------------------------------------------------
+# CLI over synthetic fixtures
+# ---------------------------------------------------------------------------
+
+def test_cli_flags_regression_nonzero_exit(tmp_path):
+    old = _wrapper(tmp_path, "r1.json", _BASE)
+    new = _wrapper(tmp_path, "r2.json",
+                   {"gemm_fp32_n8192": 50100.0,
+                    "geqrf_fp32_m32768_n4096": 18905.2})
+    r = _run_cli(old, new)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESS" in r.stdout
+    assert "geqrf_fp32_m32768_n4096" in r.stdout
+    assert "FAIL" in r.stdout
+
+
+def test_cli_improvement_exits_zero(tmp_path):
+    old = _wrapper(tmp_path, "r1.json", _BASE)
+    new = _wrapper(tmp_path, "r2.json",
+                   {"gemm_fp32_n8192": 50100.0,
+                    "geqrf_fp32_m32768_n4096": 30000.0})
+    r = _run_cli(old, new)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "IMPROVE" in r.stdout
+    assert "PASS" in r.stdout
+
+
+def test_cli_infra_artifact_nonzero_exit(tmp_path):
+    old = _wrapper(tmp_path, "r1.json", _BASE)
+    bad = _wrapper(tmp_path, "r2.json", {}, rc=124, parsed=False)
+    r = _run_cli(old, bad)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "INFRA" in r.stdout and "rc=124" in r.stdout
+
+
+def test_cli_threshold_knob(tmp_path):
+    old = _wrapper(tmp_path, "r1.json", {"gemm_fp32_n8192": 100.0})
+    new = _wrapper(tmp_path, "r2.json", {"gemm_fp32_n8192": 92.0})
+    assert _run_cli(old, new).returncode == 1            # -8% > 5%
+    assert _run_cli(old, new, "--threshold", "10").returncode == 0
+
+
+def test_cli_json_output(tmp_path):
+    old = _wrapper(tmp_path, "r1.json", _BASE)
+    new = _wrapper(tmp_path, "r2.json",
+                   {"geqrf_fp32_m32768_n4096": 18905.2})
+    r = _run_cli(old, new, "--json")
+    blob = json.loads(r.stdout)
+    verdicts = {row["label"]: row["verdict"] for row in blob["rows"]}
+    assert verdicts["geqrf_fp32_m32768_n4096"] == "REGRESS"
+    assert verdicts["gemm_fp32_n8192"] == "GONE"
+    assert blob["exit_code"] == 1
+
+
+def test_cli_does_not_import_jax(tmp_path):
+    """The sentinel must stay runnable on jax-free machines: poison the
+    path so any jax import explodes."""
+    old = _wrapper(tmp_path, "r1.json", _BASE)
+    new = _wrapper(tmp_path, "r2.json", _BASE)
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax").mkdir()
+    (poison / "jax" / "__init__.py").write_text(
+        "raise ImportError('sentinel must not import jax')")
+    env = dict(os.environ,
+               PYTHONPATH=str(poison) + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([sys.executable, _CLI, old, new],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# Library-level semantics
+# ---------------------------------------------------------------------------
+
+def test_checked_in_r03_r04_geqrf_regression():
+    """Acceptance: the sentinel flags the real r3→r4 geqrf 23.5→18.9
+    TF/s drop on the checked-in artifacts."""
+    arts = [regress.load_artifact(os.path.join(_REPO, f))
+            for f in ("BENCH_r03.json", "BENCH_r04.json")]
+    report = regress.diff(arts)
+    assert report.exit_code != 0
+    reg = {r.label for r in report.regressions}
+    assert reg == {"geqrf_fp32_m32768_n4096"}
+    row = report.regressions[0]
+    assert row.values == [23525.9, 18905.2]
+    assert row.delta_pct == pytest.approx(-19.6, abs=0.1)
+
+
+def test_checked_in_r05_is_infra():
+    art = regress.load_artifact(os.path.join(_REPO, "BENCH_r05.json"))
+    assert not art.ok
+    assert any("rc=124" in r for r in art.infra)
+    report = regress.diff([art])
+    assert report.exit_code != 0
+
+
+def test_partial_aggregate_is_infra(tmp_path):
+    p = tmp_path / "p.json"
+    p.write_text(json.dumps({
+        "rc": 0,
+        "parsed": {"metric": "m", "partial": True,
+                   "submetrics": {"gemm_fp32_n8192": 1.0}}}))
+    art = regress.load_artifact(str(p))
+    assert any("partial" in r for r in art.infra)
+
+
+def test_raw_bench_stdout_loads(tmp_path):
+    """Raw bench.py output (JSON lines, aggregate LAST) parses too."""
+    p = tmp_path / "raw.json"
+    p.write_text("\n".join([
+        json.dumps({"routine": "gemm", "label": "gemm_fp32_n8192",
+                    "gflops": 123.0}),
+        "# a stray log line",
+        json.dumps({"metric": "factor_suite_fp32_geomean",
+                    "submetrics": {"gemm_fp32_n8192": 123.0}}),
+    ]))
+    art = regress.load_artifact(str(p))
+    assert art.ok and art.submetrics == {"gemm_fp32_n8192": 123.0}
+
+
+def test_label_parsing_and_alignment():
+    assert regress.parse_label("geqrf_fp32_m32768_n4096") == \
+        ("geqrf", "fp32", "m32768_n4096")
+    assert regress.parse_label("getrf_fp32_n8192_nb512") == \
+        ("getrf", "fp32", "n8192_nb512")
+    assert regress.parse_label("mxu_bf16_n8192") == \
+        ("mxu", "bf16", "n8192")
+
+
+def test_backend_tag_change_noted(tmp_path):
+    a1 = _wrapper(tmp_path, "a1.json",
+                  {"getrf_fp32_n8192_nb512": 7000.0},
+                  autotune={"lu_driver|8192,8192,512,float32,HIGH": "rec"})
+    a2 = _wrapper(tmp_path, "a2.json",
+                  {"getrf_fp32_n8192_nb512": 7100.0},
+                  autotune={"lu_driver|8192,8192,512,float32,HIGH":
+                            "scattered"})
+    report = regress.diff([regress.load_artifact(a1),
+                           regress.load_artifact(a2)])
+    row = [r for r in report.rows
+           if r.label == "getrf_fp32_n8192_nb512"][0]
+    assert "backend changed" in row.note
+    assert "rec" in row.note and "scattered" in row.note
+
+
+def test_dropout_with_history_reads_gone_not_ok(tmp_path):
+    """A routine with ≥2 prior values that vanishes from the NEWEST
+    artifact must read GONE (silent dropout), never OK."""
+    files = [
+        _wrapper(tmp_path, "g1.json", {"heev_fp32_n8192": 100.0,
+                                       "gemm_fp32_n8192": 1.0}),
+        _wrapper(tmp_path, "g2.json", {"heev_fp32_n8192": 100.0,
+                                       "gemm_fp32_n8192": 1.0}),
+        _wrapper(tmp_path, "g3.json", {"gemm_fp32_n8192": 1.0}),
+    ]
+    report = regress.diff([regress.load_artifact(f) for f in files])
+    verdicts = {r.label: r.verdict for r in report.rows}
+    assert verdicts["heev_fp32_n8192"] == "GONE"
+    # ... but a drop past threshold stays the more severe verdict
+    files[1] = _wrapper(tmp_path, "g2.json", {"heev_fp32_n8192": 50.0,
+                                              "gemm_fp32_n8192": 1.0})
+    report = regress.diff([regress.load_artifact(f) for f in files])
+    verdicts = {r.label: r.verdict for r in report.rows}
+    assert verdicts["heev_fp32_n8192"] == "REGRESS"
+
+
+def test_consecutive_regression_not_masked_by_recovery(tmp_path):
+    """A mid-chain drop is a regression even if a later round wins it
+    back (first→last delta alone would hide it)."""
+    files = [
+        _wrapper(tmp_path, "c1.json", {"gemm_fp32_n8192": 100.0}),
+        _wrapper(tmp_path, "c2.json", {"gemm_fp32_n8192": 80.0}),
+        _wrapper(tmp_path, "c3.json", {"gemm_fp32_n8192": 101.0}),
+    ]
+    report = regress.diff([regress.load_artifact(f) for f in files])
+    assert [r.verdict for r in report.rows] == ["REGRESS"]

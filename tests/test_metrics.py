@@ -1,0 +1,372 @@
+"""Runtime metrics registry (slate_tpu/perf/metrics.py): registry
+semantics, off-by-default zero recording, snapshot round-trip through a
+bench JSON line, driver-facade instrumentation, the opt-in finite
+check, autotune counters, and the Perfetto counter-track export."""
+
+import importlib.util
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as st
+from slate_tpu import trace
+from slate_tpu.perf import metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    metrics.off()
+    metrics.reset()
+    yield
+    metrics.off()
+    metrics.reset()
+
+
+def _load_bench():
+    path = os.path.join(_REPO, "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counters_gauges_timers_hists():
+    metrics.on()
+    metrics.inc("c")
+    metrics.inc("c", 2.5)
+    metrics.set_gauge("g", 7.0)
+    with metrics.timer("t"):
+        pass
+    metrics.observe_time("t", 0.5)
+    metrics.observe("h", 3.0)
+    metrics.observe("h", 100.0)
+    snap = metrics.snapshot()
+    assert snap["enabled"] is True
+    assert snap["counters"]["c"] == 3.5
+    assert snap["gauges"]["g"] == 7.0
+    t = snap["timers"]["t"]
+    assert t["count"] == 2 and t["max_s"] >= 0.5 >= t["min_s"]
+    h = snap["hists"]["h"]
+    assert h["count"] == 2 and h["total"] == 103.0
+    assert sum(h["buckets"].values()) == 2
+
+
+def test_off_by_default_records_nothing():
+    assert not metrics.enabled()
+    metrics.inc("c")
+    metrics.set_gauge("g", 1.0)
+    with metrics.timer("t"):
+        pass
+    metrics.observe("h", 1.0)
+    snap = metrics.snapshot()
+    assert snap["counters"] == {} and snap["gauges"] == {}
+    assert snap["timers"] == {} and snap["hists"] == {}
+    assert metrics.counter_series() == []
+
+
+def test_env_gate_enables_at_import(monkeypatch):
+    """SLATE_TPU_METRICS=1 turns the registry on at import (checked on a
+    standalone spec-load of the module so the shared singleton is
+    untouched)."""
+    path = os.path.join(_REPO, "slate_tpu", "perf", "metrics.py")
+    for val, want in (("1", True), ("", False)):
+        monkeypatch.setenv("SLATE_TPU_METRICS", val)
+        spec = importlib.util.spec_from_file_location(
+            "_metrics_env_probe_%s" % (val or "unset"), path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        assert mod.enabled() is want, (val, want)
+
+
+def test_reset_keeps_enabled_flag():
+    metrics.on()
+    metrics.inc("x")
+    metrics.reset()
+    assert metrics.enabled()
+    assert metrics.snapshot()["counters"] == {}
+
+
+def test_thread_safety_under_contention():
+    metrics.on()
+    n, reps = 8, 500
+
+    def worker():
+        for _ in range(reps):
+            metrics.inc("contended")
+
+    threads = [threading.Thread(target=worker) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.snapshot()["counters"]["contended"] == n * reps
+
+
+def test_snapshot_is_json_round_trippable():
+    metrics.on()
+    metrics.inc("a.b.c")
+    metrics.observe("h", 0.25)
+    with metrics.timer("t"):
+        pass
+    blob = json.dumps(metrics.snapshot())
+    back = json.loads(blob)
+    assert back["counters"]["a.b.c"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# The bench JSON line carries the snapshot
+# ---------------------------------------------------------------------------
+
+def test_snapshot_rides_every_bench_line(capsys):
+    bench = _load_bench()
+    metrics.on()
+    metrics.inc("marker")
+    sub, fails, infra = {}, [], []
+    bench._run_routine("probe", lambda: ("probe_fp32_n1", 12.0, 0.0),
+                       sub, fails, infra)
+    bench._run_routine("boom", lambda: (_ for _ in ()).throw(OSError("x")),
+                       sub, fails, infra)
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.strip()]
+    ok = [l for l in lines if l.get("routine") == "probe"][0]
+    err = [l for l in lines if l.get("routine") == "boom"][0]
+    assert ok["metrics"]["counters"]["marker"] == 1.0
+    assert "metrics" in err and err["error"].startswith("infra:")
+    agg = bench._partial_aggregate(sub, fails, infra)
+    assert agg["metrics"]["counters"]["marker"] == 1.0
+    json.loads(json.dumps(agg))          # aggregate stays JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# Driver-facade instrumentation
+# ---------------------------------------------------------------------------
+
+def _spd(n=16):
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    return g @ g.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_driver_calls_and_wall_time_counted():
+    metrics.on()
+    st.potrf(st.HermitianMatrix(jnp.asarray(_spd()), uplo=st.Uplo.Lower))
+    snap = metrics.snapshot()
+    assert snap["counters"]["driver.potrf.calls"] == 1.0
+    assert snap["timers"]["driver.potrf"]["count"] == 1
+    assert snap["timers"]["driver.potrf"]["total_s"] > 0
+
+
+def test_instrumentation_off_means_empty_registry():
+    st.potrf(st.HermitianMatrix(jnp.asarray(_spd()), uplo=st.Uplo.Lower))
+    assert metrics.snapshot()["counters"] == {}
+
+
+def test_composed_drivers_count_each_facade():
+    metrics.on()
+    n = 16
+    b = np.ones((n, 2), np.float32)
+    st.posv(st.HermitianMatrix(jnp.asarray(_spd(n)), uplo=st.Uplo.Lower),
+            jnp.asarray(b))
+    snap = metrics.snapshot()["counters"]
+    # posv = potrf + potrs, all three facades instrumented
+    assert snap["driver.posv.calls"] == 1.0
+    assert snap["driver.potrf.calls"] == 1.0
+    assert snap["driver.potrs.calls"] == 1.0
+
+
+def test_check_finite_counts_instead_of_raising(monkeypatch):
+    monkeypatch.setenv("SLATE_TPU_CHECK_FINITE", "1")
+    n = 8
+    bad = jnp.asarray(np.full((n, n), np.nan, np.float32))
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        out = st.potrf(st.HermitianMatrix(bad, uplo=st.Uplo.Lower))
+    assert out is not None               # counted, not raised
+    snap = metrics.snapshot()
+    assert snap["counters"]["checks.nonfinite"] >= 1.0
+    assert snap["counters"]["checks.runs"] >= 1.0
+
+
+def test_check_finite_quiet_on_finite_outputs(monkeypatch):
+    monkeypatch.setenv("SLATE_TPU_CHECK_FINITE", "1")
+    st.potrf(st.HermitianMatrix(jnp.asarray(_spd()), uplo=st.Uplo.Lower))
+    snap = metrics.snapshot()
+    assert "checks.nonfinite" not in snap["counters"]
+    assert snap["counters"]["checks.runs"] >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Autotune + dispatch counters
+# ---------------------------------------------------------------------------
+
+def test_autotune_miss_then_hit_counters():
+    from slate_tpu.perf import autotune
+
+    autotune.reset_table()
+    metrics.on()
+    cand = [autotune.Candidate("xla", lambda: (lambda: None))]
+    autotune.decide("probeop", (1, 2), cand)
+    first = metrics.snapshot()["counters"]
+    assert first.get("autotune.miss", 0) >= 1
+    assert first.get("dispatch.probeop.xla", 0) >= 1
+    autotune.decide("probeop", (1, 2), cand)   # sticky "only" → table hit
+    second = metrics.snapshot()["counters"]
+    assert second.get("autotune.table.hit", 0) >= 1
+    assert second.get("dispatch.probeop.xla", 0) >= 2
+    autotune.reset_table()
+
+
+def test_matmul_dispatch_counter():
+    from slate_tpu.perf import autotune
+    from slate_tpu.ops import blocks
+
+    autotune.reset_table()
+    metrics.on()
+    a = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((128, 128)).astype(np.float32))
+    blocks.matmul(a, a)
+    snap = metrics.snapshot()["counters"]
+    assert any(k.startswith("dispatch.matmul.") for k in snap)
+    autotune.reset_table()
+
+
+def test_lu_fallback_device_counter(monkeypatch):
+    """SLATE_TPU_METRICS_DEVICE=1 traces a debug callback into the
+    _u12_with_linv guard; the fast branch increments lu.u12_linv.fast."""
+    monkeypatch.setenv("SLATE_TPU_METRICS_DEVICE", "1")
+    metrics.on()
+    from slate_tpu.linalg import lu as lu_mod
+
+    n1, nc = 4, 3
+    rng = np.random.default_rng(1)
+    lo = np.tril(rng.standard_normal((n1, n1)), -1).astype(np.float64) \
+        + np.eye(n1)
+    lu_top = jnp.asarray(lo + np.triu(np.ones((n1, n1))))
+    linv = jnp.asarray(np.linalg.inv(lo))
+    c = jnp.asarray(rng.standard_normal((n1, nc)))
+    out = lu_mod._u12_with_linv(lu_top, linv, c)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.linalg.solve(lo, np.asarray(c)), rtol=1e-10)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("lu.u12_linv.sites", 0) >= 1
+    assert snap.get("lu.u12_linv.fast", 0) >= 1
+
+
+def test_pallas_census_records_gauge():
+    metrics.on()
+    n = metrics.pallas_census("identity", lambda x: x + 1, jnp.ones(4))
+    assert n == 0
+    assert metrics.snapshot()["gauges"]["pallas.launches.identity"] == 0.0
+
+
+def test_collective_bcast_counters(mesh8):
+    """The dist_util panel broadcasts count calls and bytes at trace
+    time when the registry is on."""
+    import jax
+    from slate_tpu._jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from slate_tpu.parallel import dist_util
+    from slate_tpu.parallel.mesh import AXIS_P, AXIS_Q
+
+    metrics.on()
+    p, q = 2, 4
+    nb, mlb = 2, 2
+    M = mlb * nb * p
+
+    def kernel(col):
+        r = jax.lax.axis_index(AXIS_P)
+        grows = dist_util.local_grows(mlb, nb, p, r)
+        own = jnp.ones((mlb * nb, 1), jnp.float32)
+        return dist_util.bcast_block_col(col, grows, own, M)
+
+    fn = shard_map(kernel, mesh=mesh8,
+                   in_specs=(P(AXIS_P, None),), out_specs=P(None, None))
+    col = jnp.ones((mlb * nb * p, 3), jnp.float32)
+    np.asarray(jax.jit(fn)(col))
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("collective.bcast_col.count", 0) >= 1
+    assert snap.get("collective.bcast_col.bytes", 0) >= M * 3 * 4
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export (trace spans + metrics counter tracks)
+# ---------------------------------------------------------------------------
+
+def test_finish_perfetto_valid_chrome_trace(tmp_path):
+    trace.clear()
+    trace.on()
+    metrics.on()
+    with trace.Block("gemm"):
+        metrics.inc("probe.counter")
+    with trace.Block("potrf", lane="device0"):
+        pass
+    trace.off()
+    path = str(tmp_path / "t.perfetto.json")
+    out = trace.finish_perfetto(path)
+    assert out == path
+    blob = json.loads(open(path).read())
+    evts = blob["traceEvents"]
+    for e in evts:                       # required Chrome-trace keys
+        assert "ph" in e and "pid" in e
+        assert "ts" in e or e["ph"] == "M"
+    spans = [e for e in evts if e["ph"] == "X"]
+    assert {s["name"] for s in spans} == {"gemm", "potrf"}
+    counters = [e for e in evts if e["ph"] == "C"]
+    assert any(c["name"] == "probe.counter" for c in counters)
+    assert all("value" in c["args"] for c in counters)
+    lanes = [e for e in evts if e["ph"] == "M"]
+    assert any(m["args"]["name"] == "device0" for m in lanes)
+    # export consumed both buffers
+    assert trace.events() == []
+    assert metrics.counter_series() == []
+
+
+def test_finish_perfetto_empty_returns_none(tmp_path):
+    trace.clear()
+    metrics.reset()
+    assert trace.finish_perfetto(str(tmp_path / "x.json")) is None
+
+
+def test_finish_perfetto_no_negative_timestamps(tmp_path):
+    """Samples recorded BEFORE trace.on() set the origin must not
+    export with negative ts (Perfetto clips them); the earliest sample
+    re-anchors t=0 and block events shift with it."""
+    trace.clear()
+    metrics.on()
+    metrics.inc("early.counter")         # before tracing starts
+    trace.on()
+    with trace.Block("late-span"):
+        metrics.inc("late.counter")
+    trace.off()
+    path = trace.finish_perfetto(str(tmp_path / "n.json"))
+    blob = json.loads(open(path).read())
+    tss = [e["ts"] for e in blob["traceEvents"] if "ts" in e]
+    assert tss and min(tss) >= 0.0
+    span = [e for e in blob["traceEvents"] if e["ph"] == "X"][0]
+    early = [e for e in blob["traceEvents"]
+             if e["ph"] == "C" and e["name"] == "early.counter"][0]
+    assert early["ts"] <= span["ts"]     # ordering survives the shift
+
+
+def test_finish_perfetto_counters_only(tmp_path):
+    """Counter samples alone (tracing never enabled) still export."""
+    trace.clear()
+    metrics.on()
+    metrics.inc("lonely")
+    path = trace.finish_perfetto(str(tmp_path / "c.json"))
+    blob = json.loads(open(path).read())
+    counters = [e for e in blob["traceEvents"] if e["ph"] == "C"]
+    assert counters and counters[0]["ts"] >= 0

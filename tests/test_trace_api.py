@@ -55,6 +55,47 @@ def test_trace_decorator():
     assert trace.events()[0].name == "decorated"
 
 
+def test_trace_decorator_lane_resolved_at_call_time():
+    """Regression: the decorator used to pin self.lane at decoration
+    time, so a decorated function invoked from a worker thread recorded
+    the DECORATING thread's lane.  With no explicit lane, the lane must
+    be the calling thread's name."""
+    import threading
+
+    trace.clear()
+    trace.on()
+
+    @trace.Block("work")
+    def f():
+        return 1
+
+    t = threading.Thread(target=f, name="worker-lane-7")
+    t.start()
+    t.join()
+    trace.off()
+    evts = trace.events()
+    assert [e.name for e in evts] == ["work"]
+    assert evts[0].lane == "worker-lane-7"
+
+
+def test_trace_decorator_explicit_lane_sticks():
+    """An explicitly-given lane keeps overriding the calling thread."""
+    import threading
+
+    trace.clear()
+    trace.on()
+
+    @trace.Block("pinned", lane="lane-X")
+    def f():
+        return 1
+
+    t = threading.Thread(target=f, name="worker-lane-8")
+    t.start()
+    t.join()
+    trace.off()
+    assert trace.events()[0].lane == "lane-X"
+
+
 def test_simplified_multiply_and_solves():
     rng = np.random.default_rng(0)
     n = 24

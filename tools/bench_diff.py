@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Bench regression sentinel CLI — diff BENCH_r*.json artifacts.
+
+Usage::
+
+    python tools/bench_diff.py BENCH_r03.json BENCH_r04.json
+    python tools/bench_diff.py BENCH_r0*.json --threshold 10
+    python tools/bench_diff.py BENCH_r05.json            # infra check only
+
+Aligns routines across the artifacts (by routine name, dtype and dims
+parsed from the submetric labels), prints a verdict table, and exits
+nonzero when any routine regressed more than the threshold between
+consecutive artifacts OR when any artifact is infra-shaped (``rc != 0``,
+missing/empty/partial aggregate) — the checks that would have flagged
+the r3→r4 geqrf drop (23.5 → 18.9 TF/s) and the empty BENCH_r05
+(rc=124, parsed null) automatically.
+
+Stdlib-only: the implementation (``slate_tpu/perf/regress.py``) is
+loaded directly by file path so this tool never imports jax and runs in
+milliseconds on any machine.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+
+def _load_regress():
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.normpath(os.path.join(
+        here, os.pardir, "slate_tpu", "perf", "regress.py"))
+    spec = importlib.util.spec_from_file_location("_slate_tpu_regress", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod     # dataclasses resolve __module__ here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    regress = _load_regress()
+    ap = argparse.ArgumentParser(
+        prog="bench_diff.py",
+        description="Diff bench artifacts; exit nonzero on regressions "
+                    "or infra-shaped artifacts.")
+    ap.add_argument("artifacts", nargs="+",
+                    help="BENCH_r*.json files (driver wrapper, bare "
+                         "aggregate, or raw bench stdout), oldest first")
+    ap.add_argument("--threshold", type=float,
+                    default=regress.DEFAULT_THRESHOLD_PCT,
+                    help="flag drops bigger than this percent between "
+                         "consecutive artifacts (default %(default)s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of a table")
+    args = ap.parse_args(argv)
+
+    arts = [regress.load_artifact(p) for p in args.artifacts]
+    report = regress.diff(arts, threshold_pct=args.threshold)
+    if args.json:
+        import json
+
+        print(json.dumps({
+            "threshold_pct": report.threshold_pct,
+            "rows": [{"label": r.label, "values": r.values,
+                      "delta_pct": r.delta_pct, "verdict": r.verdict,
+                      "note": r.note} for r in report.rows],
+            "infra": [{"artifact": n, "reasons": rs}
+                      for n, rs in report.infra],
+            "exit_code": report.exit_code,
+        }, indent=1))
+    else:
+        print(regress.format_table(report))
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())
