@@ -128,6 +128,36 @@ class _RoutineTimeout(Exception):
     pass
 
 
+#: per-stage wall-time attribution for the two-stage eig/SVD pipelines:
+#: metric-timer keys (recorded by the drivers / the chase dispatch) →
+#: the submetric suffix each lands under in the routine's JSON line, so
+#: a BENCH_r* diff can attribute a heev/svd move to the stage that
+#: caused it (stage 2's bulge chase specifically has its own key — the
+#: autotuned `chase` site's hot section).
+_HEEV_STAGES = {"stage1_s": "stage.heev.stage1",
+                "stage2_s": "stage.heev.stage2",
+                "stage2_chase_s": "chase.hb2st",
+                "stage3_s": "stage.heev.stage3"}
+_SVD_STAGES = {"stage1_s": "stage.svd.stage1",
+               "stage2_s": "stage.svd.stage2",
+               "stage2_chase_s": "chase.tb2bd",
+               "stage3_s": "stage.svd.stage3"}
+
+
+def _stage_totals(stage_map):
+    timers = _metrics_snapshot().get("timers", {})
+    return {k: float(timers.get(v, {}).get("total_s", 0.0))
+            for k, v in stage_map.items()}
+
+
+def _stage_delta(label, stage_map, before):
+    """Submetric dict of per-stage wall seconds accumulated since
+    ``before`` (one timed driver call), keyed ``<label>_<stage>``."""
+    after = _stage_totals(stage_map)
+    return {"%s_%s" % (label, k): round(after[k] - before[k], 4)
+            for k in stage_map}
+
+
 def _partial_aggregate(sub, fails, infra):
     """The aggregate line's load-bearing fields from whatever completed
     so far — emitted by the hard watchdog so a hard hang still ends the
@@ -631,6 +661,7 @@ def main():
         # warm the jit cache AND sync: dispatch is async, so an
         # unsynced warm run would bleed into the timed region
         jax.block_until_ready(st.heev(hm, jobz=True))
+        stages0 = _stage_totals(_HEEV_STAGES)
         t0 = time.perf_counter()
         w, z = st.heev(hm, jobz=True)
         w = np.asarray(w); z = np.asarray(z)
@@ -641,7 +672,8 @@ def main():
         e32 = 10.0 * eps
         resid = (np.linalg.norm(herm_np @ z - z * w[None, :])
                  / (np.linalg.norm(herm_np) * nev32 * e32))
-        return "heev_fp32_n%d" % nev32, gf, resid
+        label = "heev_fp32_n%d" % nev32
+        return label, gf, resid, _stage_delta(label, _HEEV_STAGES, stages0)
 
 
     def bench_svd32():
@@ -649,6 +681,7 @@ def main():
         a_np = rng.standard_normal((nev32, nev32)).astype(np.float32)
         import slate_tpu as st
         jax.block_until_ready(st.svd(jnp.asarray(a_np)))  # warm + sync
+        stages0 = _stage_totals(_SVD_STAGES)
         t0 = time.perf_counter()
         sv, u, vt = st.svd(jnp.asarray(a_np))
         sv = np.asarray(sv); u = np.asarray(u); vt = np.asarray(vt)
@@ -657,7 +690,8 @@ def main():
         e32 = 10.0 * eps
         resid = (np.linalg.norm(a_np - (u * sv[None, :]) @ vt)
                  / (np.linalg.norm(a_np) * nev32 * e32))
-        return "svd_fp32_n%d" % nev32, gf, resid
+        label = "svd_fp32_n%d" % nev32
+        return label, gf, resid, _stage_delta(label, _SVD_STAGES, stages0)
 
 
     # ---- heev / svd fp64 (config 5 scaled to one chip) ---------------
@@ -676,6 +710,7 @@ def main():
         hm = st.HermitianMatrix(jnp.asarray(herm, jnp.float64),
                                 uplo=Uplo.Lower)
         jax.block_until_ready(st.heev(hm, jobz=True))  # warm + sync
+        stages0 = _stage_totals(_HEEV_STAGES)
         t0 = time.perf_counter()
         w, z = st.heev(hm, jobz=True)
         w = np.asarray(w); z = np.asarray(z)
@@ -684,7 +719,8 @@ def main():
         e64 = 10.0 * float(np.finfo(np.float64).eps)   # emulated fp64
         resid = (np.linalg.norm(herm @ z - z * w[None, :])
                  / (np.linalg.norm(herm) * nev * e64))
-        return "heev_fp64_n%d" % nev, gf, resid
+        label = "heev_fp64_n%d" % nev
+        return label, gf, resid, _stage_delta(label, _HEEV_STAGES, stages0)
 
 
     def bench_svd64():
@@ -695,6 +731,7 @@ def main():
         import slate_tpu as st
         jax.block_until_ready(
             st.svd(jnp.asarray(a_np, jnp.float64)))      # warm + sync
+        stages0 = _stage_totals(_SVD_STAGES)
         t0 = time.perf_counter()
         sv, u, vt = st.svd(jnp.asarray(a_np, jnp.float64))
         sv = np.asarray(sv); u = np.asarray(u); vt = np.asarray(vt)
@@ -703,7 +740,8 @@ def main():
         e64 = 10.0 * float(np.finfo(np.float64).eps)   # emulated fp64
         resid = (np.linalg.norm(a_np - (u * sv[None, :]) @ vt)
                  / (np.linalg.norm(a_np) * nev * e64))
-        return "svd_fp64_n%d" % nev, gf, resid
+        label = "svd_fp64_n%d" % nev
+        return label, gf, resid, _stage_delta(label, _SVD_STAGES, stages0)
 
     # ---- the runner loop: global deadline budgeting ------------------
     # The routine list is known up front, so each routine's SIGALRM

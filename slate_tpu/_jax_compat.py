@@ -49,6 +49,40 @@ def enable_x64(enabled: bool):
     return _ctx()
 
 
+_pallas_interpret_patched = [False]
+
+
+def ensure_pallas_complex_interpret() -> None:
+    """jax 0.4.x's Pallas interpret mode cannot initialize COMPLEX
+    scratch buffers: ``primitives.uninitialized_value`` has no complex
+    branch and then dereferences a ``semaphore_dtype`` attribute its
+    own core module no longer defines (AttributeError).  The c128
+    wavefront-chase parity path (CPU CI) allocates complex VMEM
+    scratch, so wrap the function once with a complex-aware shim; on
+    jax versions whose implementation already handles complex the shim
+    never reaches the fallback."""
+    if _pallas_interpret_patched[0]:
+        return
+    _pallas_interpret_patched[0] = True
+    try:
+        import jax.numpy as jnp
+        from jax._src.pallas import primitives as _pl_primitives
+
+        _orig = _pl_primitives.uninitialized_value
+
+        def _uninitialized_value(shape, dtype):
+            try:
+                return _orig(shape, dtype)
+            except (AttributeError, NotImplementedError):
+                if jnp.issubdtype(dtype, jnp.complexfloating):
+                    return jnp.full(shape, jnp.nan * (1 + 1j), dtype)
+                raise
+
+        _pl_primitives.uninitialized_value = _uninitialized_value
+    except Exception:       # pragma: no cover - private-API drift
+        pass
+
+
 def pvary(x, axes):
     """``lax.pcast(x, axes, to="varying")`` on jax with the vma type
     system; identity on older jax."""

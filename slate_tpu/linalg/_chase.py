@@ -1,0 +1,272 @@
+"""Autotuned stage-2 bulge-chase dispatch shared by the eig and SVD
+middles (single-chip and distributed).
+
+The two-stage eig/SVD drivers historically pulled the band to host,
+ran the bulge chase single-core in ``native/runtime.cc`` and shipped
+the packed reflector log back to the device for the batched WY
+back-transform (``unmtr_hb2st_hh``) — a host↔device tunnel on the
+hottest sequential section.  This module is the one seam where that
+choice is made:
+
+* :func:`backend` resolves the autotuned ``chase`` site
+  (:func:`slate_tpu.perf.autotune.choose_chase`) — candidates
+  ``host_native`` (today's path) and ``pallas_wavefront`` (ONE Pallas
+  invocation per chase chunk, aliased HBM band carry, log written
+  directly into the padded device layout) — timed/persisted/forceable
+  like ``lu_driver``.
+* The ``*_device`` helpers run the device-resident chase and hand back
+  ``(d, e, log)`` with the log STAYING on device — zero host repacking,
+  zero tunnel.
+* Every transfer of band/log state across the host↔device boundary
+  performed by either path is counted into ``chase.host_bytes``
+  (``metrics``), so the "zero tunnel on the device path" claim is
+  observable in every bench JSON line; operand ingestion that the
+  caller would do anyway (the O(n·kd) band upload of the distributed
+  drivers) is counted under ``chase.ingest_bytes`` instead.
+
+The Pallas kernels are fetched through :func:`autotune.kernel` — the
+backend-registry guard keeps ``linalg/`` free of direct
+``pallas_kernels`` imports.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..perf import metrics
+from ..perf.autotune import kernel as _kernel, select as _select
+
+#: below this window width the patch/shear machinery of the wavefront
+#: kernels has no room to work (and the chase is host-trivial anyway)
+_MIN_KD = 4
+
+#: HBM budget for the distributed drivers' checkpoint snapshots (the
+#: chunk count of ``chase_chunk_bounds`` was tuned for HOST RAM, so at
+#: the 65k north star ~11 live O(n·kd) device snapshots could crowd a
+#: 16 GB chip): past the budget the snapshots spill to host — an
+#: O(n·kd·nchunks) transfer counted into ``chase.host_bytes``, still
+#: far below the O(n²) log tunnel this path deletes.
+_SNAP_BUDGET_BYTES = float(os.environ.get(
+    "SLATE_TPU_CHASE_SNAPSHOT_BUDGET_MB", "2048")) * 1e6
+
+
+def snapshots_fit_device(nbytes_one: int, nchunks: int) -> bool:
+    """True when every checkpoint snapshot of one chase can stay in
+    device memory simultaneously (pass 1 holds all of them live until
+    pass 2 consumes them in reverse)."""
+    return float(nbytes_one) * max(nchunks, 1) <= _SNAP_BUDGET_BYTES
+
+
+def snapshot_store(dev):
+    """Spill one checkpoint snapshot to host (counted as tunnel)."""
+    arr = np.array(dev)
+    _count_tunnel(arr.nbytes)
+    return arr
+
+
+def snapshot_restore(arr: np.ndarray):
+    """Re-upload one spilled snapshot for pass-2 log regeneration."""
+    import jax.numpy as jnp
+
+    _count_tunnel(arr.nbytes)
+    return jnp.asarray(arr)
+
+
+def eligible(n: int, kd: int, want_vectors: bool) -> bool:
+    """Shape gate for the device chase: vectors wanted (values-only
+    callers skip the log and the host chase is already O(n·kd)-cheap),
+    a wide-enough band, and enough rows for at least one sweep."""
+    return bool(want_vectors) and kd >= _MIN_KD and n > kd + 2
+
+
+def backend(kind: str, n: int, kd: int, dtype, want_vectors: bool) -> str:
+    """Resolve (and record) the chase decision for one problem."""
+    return _select("chase", kind=kind, n=n, kd=kd, dtype=dtype,
+                   eligible=eligible(n, kd, want_vectors))
+
+
+def _count_tunnel(nbytes: int) -> None:
+    metrics.inc("chase.host_bytes", float(nbytes), force=False)
+
+
+def _mark_device_path() -> None:
+    """The device path's observability contract: the dispatch counter
+    ticks and ``chase.host_bytes`` materializes at 0 so
+    ``metrics.snapshot()`` reports the zero explicitly."""
+    metrics.inc("chase.dispatch.pallas_wavefront")
+    metrics.inc("chase.host_bytes", 0.0)
+
+
+def mark_host_path(kind: str, log_arrays=()) -> None:
+    """Count a host-native chase dispatch: the packed reflector log is
+    about to cross to the device for the WY back-transform (the tunnel
+    this module exists to delete)."""
+    metrics.inc("chase.dispatch.host_native")
+    total = 0
+    for a in log_arrays:
+        arr = np.asarray(a) if a is not None else None
+        if arr is not None:
+            total += arr.nbytes
+    _count_tunnel(total)
+
+
+def split_hh_log(vt, kd: int, s0: np.ndarray):
+    """Split a wavefront-kernel log ``(nsweeps, tmax, kd+1)`` into the
+    ``(v3, t2, s0)`` triple :func:`slate_tpu.linalg.eig.unmtr_hb2st_hh`
+    consumes — two device-side slices, no host repacking."""
+    return vt[:, :, 1:], vt[:, :, 0], s0
+
+
+def _log_s0(n: int, lo: int, hi: int) -> np.ndarray:
+    """First-reflector row per sweep of a ``[lo, hi)`` range — the s0
+    column of the padded log layout, shared by both chase kinds (each
+    sweep's first window starts at sweep+1)."""
+    hi = min(hi, max(n - 2, 0))
+    return np.arange(lo + 1, hi + 1, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# hb2st (Hermitian band → tridiagonal)
+# ---------------------------------------------------------------------------
+
+def hb2st_abw_from_dense(band, kd_eff: int):
+    """WIDE lower-band storage ``(n, 2·kd+2)`` from a dense Hermitian
+    band, built ON DEVICE (one gather) — the device-resident entry of
+    the single-chip drivers; the dense band never visits the host."""
+    import jax
+    import jax.numpy as jnp
+
+    band = jnp.asarray(band)
+    n = band.shape[0]
+    w = 2 * kd_eff + 2
+
+    @jax.jit
+    def pack(b):
+        c = jnp.arange(n)[:, None]
+        d = jnp.arange(w)[None, :]
+        r = c + d
+        valid = (d <= kd_eff) & (r < n)
+        vals = b[jnp.clip(r, 0, n - 1), jnp.broadcast_to(c, r.shape)]
+        if jnp.issubdtype(b.dtype, jnp.complexfloating):
+            vals = jnp.where(d == 0, jnp.real(vals).astype(b.dtype), vals)
+        return jnp.where(valid, vals, 0)
+
+    return pack(band)
+
+
+def hb2st_abw_from_ab(ab: np.ndarray, kd_eff: int):
+    """WIDE device band storage from the distributed drivers' host
+    ``(n, kd+2)`` lower storage — ONE O(n·kd) operand upload, counted
+    as ingestion (the caller assembled the band on host regardless)."""
+    import jax.numpy as jnp
+
+    n = ab.shape[0]
+    abw = np.zeros((n, 2 * kd_eff + 2), dtype=ab.dtype)
+    w = min(ab.shape[1], kd_eff + 1)
+    abw[:, :w] = ab[:, :w]
+    metrics.inc("chase.ingest_bytes", float(abw.nbytes))
+    return jnp.asarray(abw)
+
+
+def tb2bd_st_from_ab(ab: np.ndarray, kd_eff: int):
+    """Row-major general-band device storage from the distributed
+    drivers' host ``(n, kd+3)`` upper storage (``ab[c, (c−r)+1]`` =
+    A[r, c]) — ONE O(n·kd) operand upload, counted as ingestion."""
+    import jax.numpy as jnp
+
+    n = ab.shape[0]
+    st = np.zeros((n, 3 * kd_eff + 2), dtype=np.float64)
+    for dd in range(kd_eff + 1):
+        st[:n - dd, dd + kd_eff] = ab[dd:, dd + 1]
+    metrics.inc("chase.ingest_bytes", float(st.nbytes))
+    return jnp.asarray(st)
+
+
+def hb2st_device(abw_dev, kd_eff: int, j0: int = 0, j1=None,
+                 want_log: bool = True):
+    """One device-resident chase chunk over sweeps ``[j0, j1)``:
+    returns ``(abw_dev', log)`` with ``log = (v3, t2, s0)`` device
+    arrays (None when not ``want_log``) — ONE Pallas invocation."""
+    import jax
+
+    n = abw_dev.shape[0]
+    if j1 is None:
+        j1 = max(n - 2, 0)
+    with metrics.timer("chase.hb2st"):
+        abw_dev, vt = _kernel("hb2st_wavefront")(abw_dev, kd_eff, j0, j1)
+        if metrics.enabled():
+            # the kernel call is async: sync inside the timer so the
+            # *_stage2_chase_s bench submetric measures the chase, not
+            # its dispatch (off by default — zero sync points added)
+            jax.block_until_ready((abw_dev, vt))
+    _mark_device_path()
+    if not want_log:
+        return abw_dev, None
+    return abw_dev, split_hh_log(vt, kd_eff, _log_s0(n, j0, j1))
+
+
+def hb2st_d_e(abw_dev, n: int):
+    """Pull the chased tridiagonal (d, e) to host — the O(n) handoff to
+    the LAPACK tridiagonal solve, NOT part of the band/log tunnel."""
+    import jax.numpy as jnp
+
+    d = np.array(jnp.real(abw_dev[:, 0]))
+    e_c = np.array(abw_dev[:n - 1, 1])
+    return d, e_c
+
+
+# ---------------------------------------------------------------------------
+# tb2bd (triangular band → bidiagonal)
+# ---------------------------------------------------------------------------
+
+def tb2bd_st_from_dense(band_sq, kd_eff: int):
+    """Row-major general-band storage ``(n, 3·kd+2)`` from the dense
+    upper-triangular band middle factor, built ON DEVICE."""
+    import jax
+    import jax.numpy as jnp
+
+    band_sq = jnp.asarray(band_sq)
+    n = band_sq.shape[0]
+    w = 3 * kd_eff + 2
+
+    @jax.jit
+    def pack(b):
+        r = jnp.arange(n)[:, None]
+        d = jnp.arange(w)[None, :]
+        c = r + d - kd_eff
+        valid = (c >= r) & (c <= r + kd_eff) & (c >= 0) & (c < n)
+        vals = b[jnp.broadcast_to(r, c.shape), jnp.clip(c, 0, n - 1)]
+        return jnp.where(valid, vals, 0)
+
+    return pack(band_sq)
+
+
+def tb2bd_device(st_dev, kd_eff: int, s0: int = 0, s1=None,
+                 want_log: bool = True):
+    """One device-resident bidiagonal chase chunk over sweeps
+    ``[s0, s1)``: returns ``(st_dev', ulog, vlog)`` with each log a
+    ``(v3, t2, s0)`` device triple (None when not ``want_log``)."""
+    import jax
+
+    n = st_dev.shape[0]
+    if s1 is None:
+        s1 = max(n - 1, 0)
+    with metrics.timer("chase.tb2bd"):
+        st_dev, ut, vt = _kernel("tb2bd_wavefront")(st_dev, kd_eff, s0, s1)
+        if metrics.enabled():
+            jax.block_until_ready((st_dev, ut, vt))
+    _mark_device_path()
+    if not want_log:
+        return st_dev, None, None
+    rows = _log_s0(n, s0, s1)
+    return (st_dev, split_hh_log(ut, kd_eff, rows),
+            split_hh_log(vt, kd_eff, rows))
+
+
+def tb2bd_d_e(st_dev, kd_eff: int, n: int):
+    """(d, e) of the chased bidiagonal — the O(n) stage-3 handoff."""
+    d = np.array(st_dev[:, kd_eff])
+    e = np.array(st_dev[:n - 1, kd_eff + 1])
+    return d, e

@@ -42,6 +42,7 @@ from ..enums import Diag, MethodEig, Op, Side, Uplo
 from ..exceptions import SlateError
 from ..matrix import BaseTrapezoidMatrix, as_array
 from ..options import Options, get_option
+from ..perf import metrics as _metrics
 from ..perf.metrics import instrument_driver
 from ..ops import blocks
 from ..ops.blocks import _ct, matmul
@@ -204,7 +205,8 @@ def _hb2st_ab(ab: np.ndarray, kd_eff: int, want_rots: bool = True):
     from .. import native
 
     n = ab.shape[0]
-    planes, cs, ss = native.hb2st_banded(ab, n, kd_eff, want_rots)
+    with _metrics.timer("chase.hb2st"):
+        planes, cs, ss = native.hb2st_banded(ab, n, kd_eff, want_rots)
     d = np.real(ab[:, 0]).copy()
     e_c = ab[:n - 1, 1].copy()
     phase = _phase_tridiag(e_c, n, ab.dtype)
@@ -375,12 +377,16 @@ def _hb2st_hh_ab(abw: np.ndarray, kd_eff: int):
     ``(d, e, (v3, t2, s0))``."""
 
     from .. import native
+    from . import _chase
 
     n = abw.shape[0]
-    v, tau, row0, length = native.hb2st_hh_banded(abw, n, kd_eff)
+    with _metrics.timer("chase.hb2st"):
+        v, tau, row0, length = native.hb2st_hh_banded(abw, n, kd_eff)
     d = abw[:, 0].copy()
     e = abw[:n - 1, 1].copy()
-    return d, e, _pack_hh_log(v, tau, row0, length, n, kd_eff)
+    log = _pack_hh_log(v, tau, row0, length, n, kd_eff)
+    _chase.mark_host_path("hb2st", log)
+    return d, e, log
 
 
 def unmtr_hb2st(rots: Hb2stRotations, z: np.ndarray) -> np.ndarray:
@@ -492,24 +498,40 @@ _EIG_DRIVERS = {
 _BAND_SOLVER_MIN_N = 512
 
 
-def _band_eig(band_np, kd: int, jobz: bool, method, auto: bool):
-    """Stage 2+3 on the host band matrix, shared by single-chip
-    :func:`heev` and the distributed ``pheev``: band → tridiag → solve →
+def _band_eig(band, kd: int, jobz: bool, method, auto: bool):
+    """Stage 2+3 on the band matrix, shared by single-chip :func:`heev`
+    and the distributed ``pheev``: band → tridiag → solve →
     back-transform through the bulge-chase.  Returns ``(w, z_band)``
-    (numpy; ``z_band`` None when not ``jobz``).
+    (``z_band`` None when not ``jobz``; a device array on the
+    device-resident chase path, numpy otherwise).
 
-    Large-n Auto fast path: one host-LAPACK hbevd call (scipy
-    eig_banded).  The staged hb2st → tridiag → unmtr_hb2st chain stays
-    the explicit-method path; the reference likewise treats stage 2 as a
-    single-node host computation (``src/heev.cc:113``), and its rotation
-    sweeps are C++ where ours are Python — at n ≳ 512 the interpreter
-    cost of O(n²·kd) Givens steps dominates everything.
+    The autotuned ``chase`` site decides the stage-2 backend first:
+    ``pallas_wavefront`` keeps the band ON DEVICE end to end (packed on
+    device, chased by one Pallas invocation, reflector log consumed by
+    the WY back-transform with zero host repacking — only the O(n)
+    tridiagonal visits the host); ``host_native`` is the historical
+    single-node path below (the reference's stance,
+    ``src/heev.cc:113``).
+
+    Large-n Auto fast path (host route only): one host-LAPACK hbevd
+    call (scipy eig_banded) where the compiled stage 2 is unavailable —
+    the Python Givens sweeps cost O(n²·kd) interpreter steps.
     """
 
     from .. import native
+    from . import _chase
 
-    band_np = np.asarray(band_np)
-    n = band_np.shape[0]
+    n = int(band.shape[0])
+    kd_dev = min(kd, n - 1)
+    real = not np.issubdtype(np.dtype(band.dtype), np.complexfloating)
+    if n > 2 and kd_dev >= 2 and _chase.backend(
+            "hb2st", n, kd_dev, band.dtype,
+            jobz and real) == "pallas_wavefront":
+        abw = _chase.hb2st_abw_from_dense(band, kd_dev)
+        abw, log = _chase.hb2st_device(abw, kd_dev)
+        d, e = _chase.hb2st_d_e(abw, n)
+        return _stage3_eig_hh(d, e, log, kd_dev, method, auto)
+    band_np = np.asarray(band)
     # The scipy hbevd bypass survives only where the compiled stage 2 is
     # unavailable (no toolchain); with the native runtime the staged
     # chain is both the default and the faster path.
@@ -560,6 +582,20 @@ def _stage3_eig(d, e, rots, jobz, method, auto):
     return np.asarray(w), z_band
 
 
+def _stage3_eig_hh(d, e, log, kd_eff: int, method, auto: bool):
+    """Tridiagonal solve + batched-WY back-transform for the
+    Householder-chase paths; ``log`` is the ``(v3, t2, s0)`` triple —
+    host numpy (native chase) or device arrays (wavefront kernel), the
+    applier consumes either without repacking."""
+
+    if auto or method not in _EIG_DRIVERS:
+        w, z_tri = _tridiag_solve(d, e, True, "stevd")
+    else:
+        w, z_tri = _EIG_DRIVERS[method](d, e)
+    z_band = unmtr_hb2st_hh(*log, z_tri, kd_eff)
+    return np.asarray(w), z_band
+
+
 def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
     """Stage 2+3 from O(n·kd) band storage directly (the distributed
     drivers\' path — no dense n×n host operand is ever built when the
@@ -573,6 +609,7 @@ def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
     """
 
     from .. import native
+    from . import _chase
 
     n = ab.shape[0]
     if not (native.available() and n > 2 and kd_eff >= 2):
@@ -585,6 +622,14 @@ def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
         dense = dense + np.tril(dense, -1).conj().T
         return _band_eig(dense, kd_eff, jobz, method, auto)
     import jax as _jax
+    if jobz and ab.dtype == np.float64 and _chase.backend(
+            "hb2st", n, kd_eff, ab.dtype, True) == "pallas_wavefront":
+        # device-resident wavefront chase: one O(n·kd) operand upload,
+        # then the band, log and back-transform never leave the device
+        abw_dev, log = _chase.hb2st_device(
+            _chase.hb2st_abw_from_ab(ab, kd_eff), kd_eff)
+        d, e = _chase.hb2st_d_e(abw_dev, n)
+        return _stage3_eig_hh(d, e, log, kd_eff, method, auto)
     if jobz and ab.dtype == np.float64 \
             and _jax.default_backend() != "cpu":
         # Householder chase + device WY back-transform: a win only when
@@ -594,15 +639,7 @@ def _band_eig_ab(ab, kd_eff: int, jobz: bool, method, auto: bool):
         abw[:, :min(ab.shape[1], kd_eff + 1)] = \
             ab[:, :min(ab.shape[1], kd_eff + 1)]
         d, e, log = _hb2st_hh_ab(abw, kd_eff)
-        if auto:
-            w, z_tri = _tridiag_solve(d, e, True, "stevd")
-        elif method in (MethodEig.QR, MethodEig.DC, MethodEig.MRRR,
-                        MethodEig.Bisection):
-            w, z_tri = _EIG_DRIVERS[method](d, e)
-        else:
-            w, z_tri = _tridiag_solve(d, e, True, "stevd")
-        z_band = np.asarray(unmtr_hb2st_hh(*log, z_tri, kd_eff))
-        return np.asarray(w), z_band
+        return _stage3_eig_hh(d, e, log, kd_eff, method, auto)
     d, e, rots = _hb2st_ab(ab, kd_eff, want_rots=jobz)
     return _stage3_eig(d, e, rots, jobz, method, auto)
 
@@ -622,13 +659,20 @@ def heev(a, jobz: bool = True, opts: Optional[Options] = None):
     auto = method is MethodEig.Auto
     if auto:
         method = MethodEig.DC
-    factors = he2hb(a, opts)
-    w, z_band = _band_eig(factors.band, factors.kd, jobz, method, auto)
+    with _metrics.timer("stage.heev.stage1"):
+        factors = he2hb(a, opts)
+        if _metrics.enabled():
+            jax.block_until_ready(factors.band)
+    with _metrics.timer("stage.heev.stage2"):
+        w, z_band = _band_eig(factors.band, factors.kd, jobz, method, auto)
     if not jobz:
         return jnp.asarray(w), None
     dtype = factors.band.dtype
-    z = unmtr_he2hb(Side.Left, Op.NoTrans, factors,
-                    jnp.asarray(z_band, dtype=dtype), opts)
+    with _metrics.timer("stage.heev.stage3"):
+        z = unmtr_he2hb(Side.Left, Op.NoTrans, factors,
+                        jnp.asarray(z_band, dtype=dtype), opts)
+        if _metrics.enabled():
+            jax.block_until_ready(z)
     return jnp.asarray(w), z
 
 

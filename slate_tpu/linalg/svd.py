@@ -25,6 +25,7 @@ from ..enums import MethodSVD, Op, Side
 from ..exceptions import SlateError
 from ..matrix import as_array
 from ..options import Options, get_option
+from ..perf import metrics as _metrics
 from ..perf.metrics import instrument_driver
 from ..ops.blocks import _ct, matmul
 from .blas3 import _nb
@@ -185,7 +186,8 @@ def _tb2bd_ab(ab: np.ndarray, kd_eff: int, want_rots: bool = True):
     from .. import native
 
     n = ab.shape[0]
-    lrot, rrot = native.tb2bd_banded(ab, n, kd_eff, want_rots)
+    with _metrics.timer("chase.tb2bd"):
+        lrot, rrot = native.tb2bd_banded(ab, n, kd_eff, want_rots)
     d_c = ab[:, 1].copy()
     e_c = ab[1:, 2].copy()
     uphase, vphase = _phase_bidiag(d_c, e_c, n, ab.dtype)
@@ -354,22 +356,38 @@ _BAND_SOLVER_MIN_N = 512
 
 def _band_svd(band_sq, kd: int, want_u: bool, want_vt: bool, method,
               auto: bool):
-    """Stage 2+3 on the host n×n upper-band middle factor, shared by
+    """Stage 2+3 on the n×n upper-band middle factor, shared by
     single-chip :func:`svd` and the distributed ``psvd``: band →
     bidiagonal → bdsqr → back-transform through the chase.  Returns
-    ``(s, u_b, vh_b)`` (numpy; None where not requested).
+    ``(s, u_b, vh_b)`` (None where not requested; device arrays on the
+    device-resident chase path, numpy otherwise).
 
-    Large-n Auto fast path: one host-LAPACK gesdd call on the n×n band
-    instead of the staged tb2bd chain, whose Python Givens sweeps cost
-    O(n²·kd) interpreter steps; the reference likewise runs stage 2 on a
-    single node (``src/svd.cc:207-372``).
+    The autotuned ``chase`` site decides the stage-2 backend first:
+    ``pallas_wavefront`` keeps the band ON DEVICE (packed on device,
+    chased by one Pallas invocation, both reflector logs consumed by
+    the WY back-transforms with zero host repacking); ``host_native``
+    is the historical single-node path below.
+
+    Large-n Auto fast path (host route only): one host-LAPACK gesdd
+    call on the n×n band where the compiled stage 2 is unavailable.
     """
 
     from .. import native
+    from . import _chase
 
-    band_sq = np.asarray(band_sq)
-    n = band_sq.shape[0]
+    n = int(band_sq.shape[0])
     want_uv = want_u or want_vt
+    kd_dev = min(kd, n - 1)
+    real = not np.issubdtype(np.dtype(band_sq.dtype), np.complexfloating)
+    if n > 2 and kd_dev >= 2 and _chase.backend(
+            "tb2bd", n, kd_dev, band_sq.dtype,
+            want_uv and real) == "pallas_wavefront":
+        st_dev = _chase.tb2bd_st_from_dense(band_sq, kd_dev)
+        st_dev, ulog, vlog = _chase.tb2bd_device(st_dev, kd_dev)
+        d, e = _chase.tb2bd_d_e(st_dev, kd_dev, n)
+        return _stage3_svd_hh(d, e, ulog, vlog, kd_dev, want_u, want_vt,
+                              method, auto)
+    band_sq = np.asarray(band_sq)
     # The dense-gesdd bypass survives only where the compiled stage 2 is
     # unavailable (no toolchain); with the native runtime the staged
     # chain is both the default and the faster path.
@@ -436,34 +454,52 @@ def _bd_sweep_counts(n, kd, s0: int = 0, s1=None):
     return counts
 
 
-def _band_svd_hh_ab(st: np.ndarray, kd_eff: int, want_u: bool,
-                    want_vt: bool, method, auto: bool):
-    """Real-f64 stage 2+3 via the Householder bidiagonal chase: the U
-    and V reflector logs back-transform ON DEVICE as batched WY gemms
-    (reference ``unmbr_tb2bd`` applies its V blocks the same way)."""
+def _stage3_svd_hh(d, e, ulog, vlog, kd_eff: int, want_u: bool,
+                   want_vt: bool, method, auto: bool):
+    """Bidiagonal solve + batched-WY back-transforms for the
+    Householder-chase paths; each log is a ``(v3, t2, s0)`` triple —
+    host numpy (native chase) or device arrays (wavefront kernel)."""
 
     from .. import native
-    from .eig import _pack_hh_log, unmtr_hb2st_hh
+    from .eig import unmtr_hb2st_hh
 
-    n = st.shape[0]
-    ulog, vlog = native.tb2bd_hh_banded(st, n, kd_eff)
-    d = st[:, kd_eff].copy()
-    e = st[:n - 1, kd_eff + 1].copy()
+    n = np.asarray(d).shape[0]
     if auto and native.available() and n > 1:
         u_bd, s, vh_bd = native.bdsdc(d, e)
         u_bd = np.ascontiguousarray(u_bd)
         vh_bd = np.ascontiguousarray(vh_bd)
     else:
         u_bd, s, vh_bd = bdsqr(d, e, want_uv=True, method=method)
-    counts = _bd_sweep_counts(n, kd_eff)
     u_b = vh_b = None
     if want_u:
-        pu = _pack_hh_log(*ulog, n, kd_eff, counts=counts)
-        u_b = np.asarray(unmtr_hb2st_hh(*pu, u_bd, kd_eff))
+        u_b = unmtr_hb2st_hh(*ulog, u_bd, kd_eff)
     if want_vt:
-        pv = _pack_hh_log(*vlog, n, kd_eff, counts=counts)
-        vh_b = np.asarray(unmtr_hb2st_hh(*pv, vh_bd.T, kd_eff)).T
+        vh_b = unmtr_hb2st_hh(*vlog, vh_bd.T, kd_eff).T
     return s, u_b, vh_b
+
+
+def _band_svd_hh_ab(st: np.ndarray, kd_eff: int, want_u: bool,
+                    want_vt: bool, method, auto: bool):
+    """Real-f64 stage 2+3 via the HOST Householder bidiagonal chase:
+    the U and V reflector logs back-transform ON DEVICE as batched WY
+    gemms (reference ``unmbr_tb2bd`` applies its V blocks the same
+    way) — the ``host_native`` backend of the ``chase`` site."""
+
+    from .. import native
+    from . import _chase
+    from .eig import _pack_hh_log
+
+    n = st.shape[0]
+    with _metrics.timer("chase.tb2bd"):
+        ulog, vlog = native.tb2bd_hh_banded(st, n, kd_eff)
+    d = st[:, kd_eff].copy()
+    e = st[:n - 1, kd_eff + 1].copy()
+    counts = _bd_sweep_counts(n, kd_eff)
+    pu = _pack_hh_log(*ulog, n, kd_eff, counts=counts)
+    pv = _pack_hh_log(*vlog, n, kd_eff, counts=counts)
+    _chase.mark_host_path("tb2bd", pu + pv)
+    return _stage3_svd_hh(d, e, pu, pv, kd_eff, want_u, want_vt,
+                          method, auto)
 
 
 def _band_svd_ab(ab, kd_eff: int, want_u: bool, want_vt: bool, method,
@@ -474,6 +510,7 @@ def _band_svd_ab(ab, kd_eff: int, want_u: bool, want_vt: bool, method,
     values-only) keeps the Givens chase."""
 
     from .. import native
+    from . import _chase
 
     n = ab.shape[0]
     if not (native.available() and n > 2 and kd_eff >= 2):
@@ -483,6 +520,15 @@ def _band_svd_ab(ab, kd_eff: int, want_u: bool, want_vt: bool, method,
             dense[idx[:n - dd], idx[:n - dd] + dd] = ab[dd:, dd + 1]
         return _band_svd(dense, kd_eff, want_u, want_vt, method, auto)
     import jax as _jax
+    if (want_u or want_vt) and ab.dtype == np.float64 and _chase.backend(
+            "tb2bd", n, kd_eff, ab.dtype, True) == "pallas_wavefront":
+        # device-resident wavefront chase: one O(n·kd) operand upload,
+        # then the band, both logs and the back-transforms stay device
+        st_dev, ulog, vlog = _chase.tb2bd_device(
+            _chase.tb2bd_st_from_ab(ab, kd_eff), kd_eff)
+        d, e = _chase.tb2bd_d_e(st_dev, kd_eff, n)
+        return _stage3_svd_hh(d, e, ulog, vlog, kd_eff, want_u, want_vt,
+                              method, auto)
     if (want_u or want_vt) and ab.dtype == np.float64 \
             and _jax.default_backend() != "cpu":
         # device WY back-transform only pays off off-host (see eig.py)
@@ -515,14 +561,19 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
         s, u, vh = svd(_ct(av), jobu=jobvt, jobvt=jobu, opts=opts)
         return s, (None if vh is None else _ct(vh)), \
             (None if u is None else _ct(u))
-    factors = ge2tb(a, opts)
-    band_np = np.asarray(factors.band)
+    with _metrics.timer("stage.svd.stage1"):
+        factors = ge2tb(a, opts)
+        if _metrics.enabled():
+            jax.block_until_ready(factors.band)
     method = get_option(opts, "method_svd", MethodSVD.Auto)
     auto = method is MethodSVD.Auto
     # ge2tb leaves the middle factor upper-triangular-banded: only its
-    # top n rows are nonzero, so stage 2 operates on the n×n head
-    s, u_b, vh_b = _band_svd(band_np[:n], factors.kd, jobu, jobvt,
-                             method, auto)
+    # top n rows are nonzero, so stage 2 operates on the n×n head —
+    # passed as the DEVICE array so the wavefront-chase backend never
+    # pulls it to host (the host backends np.asarray it themselves)
+    with _metrics.timer("stage.svd.stage2"):
+        s, u_b, vh_b = _band_svd(factors.band[:n], factors.kd, jobu,
+                                 jobvt, method, auto)
     dtype = factors.band.dtype
     # stage 2/3 may run in float64 internally (the HH fast path); the
     # dtype contract is LAPACK's: sigma in the real precision of A
@@ -530,18 +581,21 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
     if not (jobu or jobvt):
         return jnp.asarray(s, dtype=real_dt), None, None
     u = vh = None
-    if jobu:
-        u2 = np.asarray(u_b)
-        if m > n:
-            u2 = np.concatenate(
-                [u2, np.zeros((m - n, u2.shape[1]), dtype=u2.dtype)],
-                axis=0)
-        u = unmbr_ge2tb(Side.Left, Op.NoTrans, factors,
-                        jnp.asarray(u2, dtype=dtype))
-    if jobvt:
-        v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
-                        jnp.asarray(_ct(vh_b), dtype=dtype))
-        vh = _ct(v)
+    with _metrics.timer("stage.svd.stage3"):
+        if jobu:
+            u2 = jnp.asarray(u_b)
+            if m > n:
+                u2 = jnp.concatenate(
+                    [u2, jnp.zeros((m - n, u2.shape[1]), dtype=u2.dtype)],
+                    axis=0)
+            u = unmbr_ge2tb(Side.Left, Op.NoTrans, factors,
+                            u2.astype(dtype))
+        if jobvt:
+            v = unmbr_ge2tb(Side.Right, Op.NoTrans, factors,
+                            jnp.asarray(_ct(vh_b)).astype(dtype))
+            vh = _ct(v)
+        if _metrics.enabled():
+            jax.block_until_ready([x for x in (u, vh) if x is not None])
     return jnp.asarray(s, dtype=real_dt), u, vh
 
 

@@ -51,28 +51,48 @@ def dist_band_svd(ab, kd_eff: int, mesh, want_u: bool, want_vt: bool):
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     from .. import native as _native
+    from ..linalg import _chase
     from ..linalg.eig import _pack_hh_log, unmtr_hb2st_hh
     from .dist_stedc import pstedc
     from .mesh import AXIS_P, AXIS_Q
 
     n = ab.shape[0]
-    # row-major general-band storage st[r, c-r+kd] = A[r, c]
-    st = np.zeros((n, 3 * kd_eff + 2), dtype=np.float64)
-    for dd in range(min(kd_eff, max(n - 1, 1)) + 1):
-        st[:n - dd, dd + kd_eff] = ab[dd:, dd + 1]
 
     # chunk boundaries equalize reflector counts (the two logs have
     # identical counts); shared boundary logic with dist_band_eig
     from .dist_twostage import chase_chunk_bounds
     bnds = chase_chunk_bounds(_bd_sweep_counts_range(n, kd_eff),
                               max(n - 1, 0), n, kd_eff)
-    snapshots = []
-    for s0, s1 in zip(bnds[:-1], bnds[1:]):
-        snapshots.append(st.copy())
-        logs = _native.tb2bd_hh_banded_range(st, n, kd_eff, s0, s1)
-        del logs                               # pass 1 wants only d, e
-    d = st[:, kd_eff].copy()
-    e = st[:n - 1, kd_eff + 1].copy()
+    # the checkpointed chunks resolve the same autotuned `chase`
+    # decision as single-chip svd: pallas_wavefront keeps the band,
+    # snapshots and both regenerated logs device-resident
+    device_chase = _chase.backend(
+        "tb2bd", n, kd_eff, np.float64, True) == "pallas_wavefront"
+    if device_chase:
+        st_dev = _chase.tb2bd_st_from_ab(ab, kd_eff)
+        # all snapshots stay live until pass 2 frees them in reverse —
+        # spill to host past the HBM budget (counted as tunnel bytes)
+        spill = not _chase.snapshots_fit_device(
+            n * (3 * kd_eff + 2) * 8, len(bnds) - 1)
+        dev_snaps = []
+        for s0, s1 in zip(bnds[:-1], bnds[1:]):
+            dev_snaps.append(_chase.snapshot_store(st_dev) if spill
+                             else st_dev)
+            st_dev, _, _ = _chase.tb2bd_device(st_dev, kd_eff, s0, s1,
+                                               want_log=False)
+        d, e = _chase.tb2bd_d_e(st_dev, kd_eff, n)
+    else:
+        # row-major general-band storage st[r, c-r+kd] = A[r, c]
+        st = np.zeros((n, 3 * kd_eff + 2), dtype=np.float64)
+        for dd in range(min(kd_eff, max(n - 1, 1)) + 1):
+            st[:n - dd, dd + kd_eff] = ab[dd:, dd + 1]
+        snapshots = []
+        for s0, s1 in zip(bnds[:-1], bnds[1:]):
+            snapshots.append(st.copy())
+            logs = _native.tb2bd_hh_banded_range(st, n, kd_eff, s0, s1)
+            del logs                           # pass 1 wants only d, e
+        d = st[:, kd_eff].copy()
+        e = st[:n - 1, kd_eff + 1].copy()
 
     # Golub–Kahan tridiagonal of order 2n: off-diagonals interleave
     # d and e; its positive-eigenvalue eigenvectors carry v (even rows)
@@ -152,6 +172,22 @@ def dist_band_svd(ab, kd_eff: int, mesh, want_u: bool, want_vt: bool):
 
     # pass 2: regenerate each chunk's logs from its snapshot in reverse
     # order; batched WY applies on the sharded factors
+    if device_chase:
+        for c in range(len(dev_snaps) - 1, -1, -1):
+            s0, s1 = bnds[c], bnds[c + 1]
+            st_c = dev_snaps[c]
+            if isinstance(st_c, np.ndarray):
+                st_c = _chase.snapshot_restore(st_c)
+            dev_snaps[c] = None
+            _, dlu, dlv = _chase.tb2bd_device(st_c, kd_eff, s0, s1)
+            del st_c
+            if want_u and dlu[0].shape[0]:
+                u_dev = unmtr_hb2st_hh(*dlu, u_dev, kd_eff)
+            if want_vt and dlv[0].shape[0]:
+                v_dev = unmtr_hb2st_hh(*dlv, v_dev, kd_eff)
+            del dlu, dlv
+        return s, (u_dev if want_u else None), \
+            (v_dev if want_vt else None)
     for c in range(len(snapshots) - 1, -1, -1):
         s0, s1 = bnds[c], bnds[c + 1]
         st_c = snapshots[c]
@@ -161,9 +197,11 @@ def dist_band_svd(ab, kd_eff: int, mesh, want_u: bool, want_vt: bool):
         counts = _bd_sweep_counts_range(n, kd_eff, s0, s1)
         if want_u and len(ulog[2]):
             pu = _pack_hh_log(*ulog, n, kd_eff, counts=counts)
+            _chase.mark_host_path("tb2bd", pu)
             u_dev = unmtr_hb2st_hh(*pu, u_dev, kd_eff)
         if want_vt and len(vlog[2]):
             pv = _pack_hh_log(*vlog, n, kd_eff, counts=counts)
+            _chase.mark_host_path("tb2bd", pv)
             v_dev = unmtr_hb2st_hh(*pv, v_dev, kd_eff)
         del ulog, vlog
     return s, (u_dev if want_u else None), (v_dev if want_vt else None)

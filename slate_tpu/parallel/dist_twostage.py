@@ -628,6 +628,7 @@ def dist_band_eig(ab, kd_eff: int, mesh):
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     from .. import native as _native
+    from ..linalg import _chase
     from ..linalg.eig import (_hb_sweep_counts, _pack_hh_log,
                               _phase_tridiag, unmtr_hb2st_hh)
     from .dist_stedc import pstedc
@@ -636,21 +637,44 @@ def dist_band_eig(ab, kd_eff: int, mesh):
     n = ab.shape[0]
     cplx = np.iscomplexobj(ab)
     dt = np.complex128 if cplx else np.float64
-    abw = np.zeros((n, 2 * kd_eff + 2), dtype=dt)
-    abw[:, :min(ab.shape[1], kd_eff + 1)] = \
-        ab[:, :min(ab.shape[1], kd_eff + 1)]
     # chunk boundaries equalize REFLECTOR counts, not sweep counts —
     # early sweeps chase far more windows, and the peak host buffer is
     # one chunk's packed log
     bnds = chase_chunk_bounds(_hb_sweep_counts(n, kd_eff),
                               max(n - 2, 0), n, kd_eff)
-    snapshots = []
-    for j0, j1 in zip(bnds[:-1], bnds[1:]):
-        snapshots.append(abw.copy())
-        chunk_log = _native.hb2st_hh_banded_range(abw, n, kd_eff, j0, j1)
-        del chunk_log                          # pass 1 wants only d, e
-    d_t = abw[:, 0].real.copy()
-    e_c = abw[:n - 1, 1].copy()
+    # every sweep-range chunk resolves the SAME autotuned `chase`
+    # decision the single-chip drivers use: on the pallas_wavefront
+    # backend the band, the checkpoint snapshots and every regenerated
+    # chunk log stay device-resident (one O(n·kd) operand upload, zero
+    # tunnel); host_native keeps the compiled single-node chase
+    device_chase = _chase.backend(
+        "hb2st", n, kd_eff, dt, True) == "pallas_wavefront"
+    if device_chase:
+        abw_dev = _chase.hb2st_abw_from_ab(
+            np.ascontiguousarray(ab, dtype=dt), kd_eff)
+        # all snapshots stay live until pass 2 frees them in reverse —
+        # spill to host past the HBM budget (counted as tunnel bytes)
+        spill = not _chase.snapshots_fit_device(
+            n * (2 * kd_eff + 2) * np.dtype(dt).itemsize, len(bnds) - 1)
+        dev_snaps = []
+        for j0, j1 in zip(bnds[:-1], bnds[1:]):
+            dev_snaps.append(_chase.snapshot_store(abw_dev) if spill
+                             else abw_dev)
+            abw_dev, _ = _chase.hb2st_device(abw_dev, kd_eff, j0, j1,
+                                             want_log=False)
+        d_t, e_c = _chase.hb2st_d_e(abw_dev, n)
+    else:
+        abw = np.zeros((n, 2 * kd_eff + 2), dtype=dt)
+        abw[:, :min(ab.shape[1], kd_eff + 1)] = \
+            ab[:, :min(ab.shape[1], kd_eff + 1)]
+        snapshots = []
+        for j0, j1 in zip(bnds[:-1], bnds[1:]):
+            snapshots.append(abw.copy())
+            chunk_log = _native.hb2st_hh_banded_range(abw, n, kd_eff,
+                                                      j0, j1)
+            del chunk_log                      # pass 1 wants only d, e
+        d_t = abw[:, 0].real.copy()
+        e_c = abw[:n - 1, 1].copy()
     # the complex chase leaves exactly the final (never-swept) e entry
     # complex plus rounding-level phases; fold them into Q (hbtrd's
     # final diagonal phase, O(n) host)
@@ -671,6 +695,20 @@ def dist_band_eig(ab, kd_eff: int, mesh):
         q_dev = jax.jit(reshard, out_shardings=col_sh)(q_tri)
     else:
         q_dev = jax.jit(reshard)(q_tri)
+    if device_chase:
+        for c in range(len(dev_snaps) - 1, -1, -1):
+            j0, j1 = bnds[c], bnds[c + 1]
+            abw_c = dev_snaps[c]
+            if isinstance(abw_c, np.ndarray):
+                abw_c = _chase.snapshot_restore(abw_c)
+            dev_snaps[c] = None                # free as consumed
+            _, log = _chase.hb2st_device(abw_c, kd_eff, j0, j1)
+            del abw_c
+            if log[0].shape[0] == 0:
+                continue
+            q_dev = unmtr_hb2st_hh(*log, q_dev, kd_eff)
+            del log
+        return w, q_dev
     for c in range(len(snapshots) - 1, -1, -1):
         j0, j1 = bnds[c], bnds[c + 1]
         abw_c = snapshots[c]
@@ -684,6 +722,7 @@ def dist_band_eig(ab, kd_eff: int, mesh):
         v3, t2, s0 = _pack_hh_log(v, tau, row0, length, n, kd_eff,
                                   counts=counts)
         del v, tau
+        _chase.mark_host_path("hb2st", (v3, t2, s0))
         q_dev = unmtr_hb2st_hh(v3, t2, s0, q_dev, kd_eff)
         del v3, t2, s0
     return w, q_dev

@@ -57,7 +57,7 @@ __all__ = [
     "decide", "decisions", "timing_reps", "kernel",
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
     "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
-    "choose_geqrf_panel",
+    "choose_geqrf_panel", "choose_chase",
 ]
 
 #: timed repetitions per surviving candidate (after the compile/warm rep)
@@ -882,6 +882,120 @@ def choose_geqrf_panel(m: int, n: int, nb: int, dtype) -> str:
     ])
 
 
+def choose_chase(kind: str, n: int, kd: int, dtype, eligible: bool) -> str:
+    """Stage-2 bulge-chase backend for the two-stage eig/SVD middle:
+    ``"host_native"`` (the compiled single-node chase in
+    ``native/runtime.cc`` — today's path, band pulled to host and the
+    packed reflector log shipped back to the device) vs
+    ``"pallas_wavefront"`` (ONE device-resident Pallas invocation per
+    chase chunk, aliased HBM band carry, zero host↔device tunnel —
+    ``ops.pallas_kernels.hb2st_wavefront`` / ``tb2bd_wavefront``).
+    ``kind`` is ``"hb2st"`` (band→tridiag) or ``"tb2bd"``
+    (band→bidiag); both the single-chip drivers and the checkpointed
+    sweep-range chunks of ``parallel.dist_twostage`` resolve through
+    this one decision.  ``eligible`` is the call site's shape gate
+    (vectors wanted, kd ≥ 4, n > kd+2)."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (kind, n, kd, dt.name)
+    if not eligible:
+        return _static("chase", key, "host_native", "ineligible")
+    if not _on_tpu():
+        # interpret-mode timings are meaningless; the heuristic default
+        # keeps today's host path unless a force pins the device chase
+        # (tests do, via SLATE_TPU_AUTOTUNE_FORCE=chase=pallas_wavefront)
+        forced = _forced("chase")
+        if forced == "pallas_wavefront":
+            return _static("chase", key, forced, "forced")
+        return _static("chase", key, "host_native", "default")
+
+    from .. import native
+
+    probes: dict = {}
+
+    def _mk_band():
+        import numpy as np
+
+        rng = np.random.default_rng(11)
+        if kind == "hb2st":
+            abw = np.zeros((n, 2 * kd + 2))
+            for d in range(kd + 1):
+                abw[:n - d, d] = rng.standard_normal(n - d)
+        else:
+            abw = np.zeros((n, 3 * kd + 2))
+            for d in range(kd + 1):
+                abw[:n - d, d + kd] = rng.standard_normal(n - d)
+        return abw
+
+    def _band():
+        return _memo(probes, "band", _mk_band)
+
+    def setup_host():
+        if not native.available():
+            raise RuntimeError("native runtime unavailable")
+
+        def run():
+            ab = _band().copy()
+            if kind == "hb2st":
+                return native.hb2st_hh_banded_range(ab, n, kd, 0, n - 2)
+            return native.tb2bd_hh_banded(ab, n, kd)
+
+        return run
+
+    def setup_pallas():
+        import jax
+
+        if kind == "hb2st":
+            fn = kernel("hb2st_wavefront")
+        else:
+            fn = kernel("tb2bd_wavefront")
+        # probe in the KEY's dtype: an f32 key must compile (and be
+        # accuracy-checked on) the f32 kernel, so a Mosaic failure
+        # prunes here instead of crashing at real dispatch
+        op = jnp.asarray(_band()).astype(dt)
+
+        def run():
+            return jax.block_until_ready(fn(op, kd))
+
+        run()                           # compile once before timing
+        return run
+
+    def check_pallas(out):
+        # d/e of the chased band must agree with the host chase: the
+        # tridiagonal/bidiagonal spectrum is the chase's contract
+        # (reference always f64 — the native chase's only precision)
+        import numpy as np
+
+        ab = _band().copy()
+        if kind == "hb2st":
+            native.hb2st_hh_banded_range(ab, n, kd, 0, n - 2)
+            d_ref, e_ref = ab[:, 0], ab[:n - 1, 1]
+            ab_dev = np.asarray(out[0])
+            d_new, e_new = ab_dev[:, 0], ab_dev[:n - 1, 1]
+        else:
+            native.tb2bd_hh_banded(ab, n, kd)
+            d_ref, e_ref = ab[:, kd], ab[:n - 1, kd + 1]
+            ab_dev = np.asarray(out[0])
+            d_new, e_new = ab_dev[:, kd], ab_dev[:n - 1, kd + 1]
+        scale = max(np.max(np.abs(d_ref)), 1e-300)
+        eps = float(np.finfo(np.dtype(dt.name)).eps) \
+            if jnp.issubdtype(dt, jnp.floating) else 2.2e-16
+        # loose catastrophe gate (the chase accumulates ~sqrt(#windows)
+        # rounding): it prunes a wrong kernel, not honest rounding
+        tol = 1e5 * eps * scale * n
+        return (np.max(np.abs(np.abs(d_new) - np.abs(d_ref))) < tol
+                and np.max(np.abs(np.abs(e_new) - np.abs(e_ref))) < tol)
+
+    cands = []
+    if native.available():
+        cands.append(Candidate("host_native", setup_host))
+    cands.append(Candidate("pallas_wavefront", setup_pallas,
+                           check_pallas if native.available() else None))
+    return decide("chase", key, cands)
+
+
 #: op name → chooser, the :func:`select` registry.  ``method.select_backend``
 #: is the driver-facing façade over this table.
 _CHOOSERS = {
@@ -899,6 +1013,8 @@ _CHOOSERS = {
     "trtri_panel": lambda **kw: choose_trtri_panel(kw["n"], kw["dtype"]),
     "geqrf_panel": lambda **kw: choose_geqrf_panel(kw["m"], kw["n"],
                                                    kw["nb"], kw["dtype"]),
+    "chase": lambda **kw: choose_chase(kw["kind"], kw["n"], kw["kd"],
+                                       kw["dtype"], kw["eligible"]),
 }
 
 

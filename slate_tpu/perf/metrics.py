@@ -17,6 +17,15 @@ named counter here, and two exporters make the numbers travel:
 * :func:`slate_tpu.trace.finish_perfetto` → Chrome-trace/Perfetto JSON
   merging ``trace.Block`` spans with this registry's counter tracks.
 
+The stage-2 bulge-chase dispatch (``linalg._chase``) adds its own
+counter family: ``chase.dispatch.<backend>`` per chase execution,
+``chase.host_bytes`` for band/reflector-log bytes crossing the
+host↔device boundary (pinned to 0 in CI on the device-resident
+``pallas_wavefront`` path — the "zero tunnel" claim made observable),
+``chase.ingest_bytes`` for the distributed drivers' one-time operand
+upload, and timers ``chase.hb2st`` / ``chase.tb2bd`` feeding bench's
+per-stage ``*_stage2_chase_s`` submetrics.
+
 Design rules (the BLASX lesson — scheduler behavior is only tunable
 once it is measured — balanced against the library's perf contract):
 

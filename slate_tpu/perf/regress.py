@@ -26,6 +26,12 @@ table (r6+) the sentinel reports a per-routine backend tag and NOTES a
 tag change next to the verdict rather than splitting the alignment key
 — older artifacts carry no tags, and a tag-keyed alignment would
 silently stop comparing the moment tagging was introduced.
+
+Metric direction: submetrics are GFLOP/s (higher is better) except the
+per-stage wall-time keys bench emits for the two-stage eig/SVD
+pipelines (suffix ``_s``, e.g. ``heev_fp64_n1024_stage2_chase_s``) —
+those are seconds, LOWER is better, and the verdict logic inverts the
+sign so a faster stage reads IMPROVE, not REGRESS.
 """
 
 from __future__ import annotations
@@ -234,12 +240,15 @@ def diff(artifacts: List[Artifact],
             continue
         worst_drop = 0.0
         best_gain = 0.0
+        # "_s"-suffixed labels are wall SECONDS (the per-stage eig/SVD
+        # submetrics): lower is better, so the sign flips
+        sign = -1.0 if label.endswith("_s") else 1.0
         prev = None
         for v in vals:
             if v is None:
                 continue
-            if prev is not None:
-                change = (v / prev - 1.0) * 100.0
+            if prev is not None and prev > 0:
+                change = sign * (v / prev - 1.0) * 100.0
                 worst_drop = min(worst_drop, change)
                 best_gain = max(best_gain, change)
             prev = v
@@ -254,7 +263,8 @@ def diff(artifacts: List[Artifact],
             verdict = "IMPROVE"
         else:
             verdict = "OK"
-        delta = (present[-1] / present[0] - 1.0) * 100.0
+        delta = ((present[-1] / present[0] - 1.0) * 100.0
+                 if present[0] > 0 else None)
         rows.append(Row(label, vals, verdict, delta, note))
     order = {"REGRESS": 0, "GONE": 1, "NEW": 2, "IMPROVE": 3, "OK": 4,
              "n/a": 5}

@@ -105,11 +105,17 @@ def test_multi_backend_sites_populate_autotune_table():
     # QR panel site
     st.geqrf(jnp.asarray(rng.standard_normal((2 * n, n)).astype(np.float32)))
 
+    # stage-2 bulge-chase site (heev consults it before any stage-2
+    # backend runs; on CPU it resolves heuristically to host_native)
+    herm = ((g + g.T) / 2).astype(np.float64)
+    st.heev(st.HermitianMatrix(jnp.asarray(herm), uplo=st.Uplo.Lower),
+            opts={"block_size": 16})
+
     dec = autotune.decisions()
     for op in ("matmul|128,128,128,float32",
                "matmul|8,8,8,float64",
                "potrf_panel|", "trtri_panel|", "lu_panel|", "lu_driver|",
-               "geqrf_panel|"):
+               "geqrf_panel|", "chase|hb2st"):
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"
     autotune.reset_table()

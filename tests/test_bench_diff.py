@@ -218,3 +218,23 @@ def test_consecutive_regression_not_masked_by_recovery(tmp_path):
     ]
     report = regress.diff([regress.load_artifact(f) for f in files])
     assert [r.verdict for r in report.rows] == ["REGRESS"]
+
+
+def test_stage_time_submetrics_are_lower_is_better():
+    """The per-stage eig/SVD submetrics are wall SECONDS (suffix
+    ``_s``): the device bulge chase shrinking stage2_chase must read
+    IMPROVE, and a chase slowdown must read REGRESS — not the other
+    way around (every other submetric is GFLOP/s, higher-is-better)."""
+    a1 = regress.Artifact(path="r1", name="r1", submetrics={
+        "gemm_fp32_n8192": 50000.0,
+        "heev_fp64_n1024_stage2_chase_s": 4.0})
+    a2 = regress.Artifact(path="r2", name="r2", submetrics={
+        "gemm_fp32_n8192": 50000.0,
+        "heev_fp64_n1024_stage2_chase_s": 0.4})
+    rep = regress.diff([a1, a2])
+    by = {r.label: r.verdict for r in rep.rows}
+    assert by["heev_fp64_n1024_stage2_chase_s"] == "IMPROVE"
+    assert by["gemm_fp32_n8192"] == "OK"
+    rep2 = regress.diff([a2, a1])
+    by2 = {r.label: r.verdict for r in rep2.rows}
+    assert by2["heev_fp64_n1024_stage2_chase_s"] == "REGRESS"

@@ -1,0 +1,135 @@
+"""Pure-Python property test of the recorded wavefront dependence
+analysis (STATUS r4), which BOTH chase parallelizations rely on — the
+device Pallas mega-kernel (``ops.pallas_kernels.hb2st_wavefront`` /
+``tb2bd_wavefront`` batch same-stagger windows inside one grid step)
+and the still-documented OpenMP wavefront in ``native/runtime.cc``:
+
+* task (sweep j, window w) touches band rows
+  [j+1+(w−1)·kd, j+1+(w+1)·kd) (+1 row for the trailing length-1
+  coupling apply);
+* with stagger t = 3j + w, same-t tasks are pairwise ROW-DISJOINT;
+* every conflicting (row-overlapping) pair is stagger-ORDERED the same
+  way the serial sweep-major chase orders it — so executing staggers in
+  sequence with any order inside a stagger reproduces the serial chase.
+
+No jax, no native runtime: the schedule is arithmetic.
+"""
+
+import numpy as np
+import pytest
+
+from slate_tpu.linalg.eig import _hb_sweep_counts
+from slate_tpu.linalg.svd import _bd_sweep_counts
+
+
+def _hb_tasks(n, kd):
+    """(j, w, t, row_lo, row_hi) for every window task of the symmetric
+    chase; the row interval includes the coupling row."""
+    tasks = []
+    for j, nwin in zip(range(0, max(n - 2, 0)), _hb_sweep_counts(n, kd)):
+        for w in range(nwin):
+            if w == 0:
+                r0, length = j + 1, min(kd, n - 1 - j)
+            else:
+                r0 = j + 1 + w * kd
+                length = min(kd, n - r0)
+            # window rows plus the previous window's columns it
+            # updates; the trailing coupling row exists only when the
+            # next block is a single row (the serial loop's Lt == 1
+            # right-apply-then-break)
+            lo = r0 - (kd if w else 1)
+            hi = r0 + length + (1 if n - (r0 + length) == 1 else 0)
+            tasks.append((j, w, 3 * j + w, lo, min(n, hi)))
+    return tasks
+
+
+def _bd_tasks(n, kd):
+    tasks = []
+    for s, nblk in zip(range(0, max(n - 1, 0)), _bd_sweep_counts(n, kd)):
+        for b in range(nblk):
+            if b == 0:
+                lo = s
+                hi = min(n, s + kd + 1)
+            else:
+                i_lo = (b - 1) * kd + 1 + s
+                j_lo = b * kd + 1 + s
+                lo = i_lo
+                hi = min(n, j_lo + kd)
+            tasks.append((s, b, 3 * s + b, lo, hi))
+    return tasks
+
+
+def _overlap(a, b):
+    return a[3] < b[4] and b[3] < a[4]
+
+
+@pytest.mark.parametrize("n,kd", [(64, 8), (96, 8), (100, 13), (128, 48)])
+@pytest.mark.parametrize("kind", ["hb2st", "tb2bd"])
+def test_same_stagger_tasks_are_row_disjoint(kind, n, kd):
+    tasks = _hb_tasks(n, kd) if kind == "hb2st" else _bd_tasks(n, kd)
+    by_t: dict = {}
+    for task in tasks:
+        by_t.setdefault(task[2], []).append(task)
+    for t, group in by_t.items():
+        for i in range(len(group)):
+            for k in range(i + 1, len(group)):
+                assert not _overlap(group[i], group[k]), \
+                    f"stagger {t}: tasks {group[i][:2]} and " \
+                    f"{group[k][:2]} touch overlapping rows"
+
+
+@pytest.mark.parametrize("n,kd", [(64, 8), (100, 13), (128, 48)])
+@pytest.mark.parametrize("kind", ["hb2st", "tb2bd"])
+def test_conflicting_pairs_are_stagger_ordered(kind, n, kd):
+    """Any two row-overlapping tasks must execute in the serial
+    (sweep-major) order under the stagger schedule: serial-earlier ⇒
+    strictly smaller t.  This is the property that makes the per-t
+    batched execution bitwise-equivalent to the serial chase."""
+    tasks = _hb_tasks(n, kd) if kind == "hb2st" else _bd_tasks(n, kd)
+    for i in range(len(tasks)):
+        ji, wi, ti = tasks[i][:3]
+        for k in range(i + 1, len(tasks)):
+            jk, wk, tk = tasks[k][:3]
+            if not _overlap(tasks[i], tasks[k]):
+                continue
+            serial_before = (ji, wi) < (jk, wk)
+            assert (ti < tk) == serial_before and ti != tk, \
+                f"conflicting tasks {(ji, wi)}@{ti} vs {(jk, wk)}@{tk} " \
+                "not stagger-ordered"
+
+
+@pytest.mark.parametrize("n,kd", [(48, 8), (96, 8), (100, 13), (128, 48),
+                                  (10, 3)])
+def test_kernel_window_counts_match_log_packer(n, kd):
+    """The wavefront kernels' closed-form per-sweep window counts must
+    equal the packer's (`_hb_sweep_counts` / `_bd_sweep_counts`) — the
+    contract that makes the kernel's (nsweeps, tmax, kd) log layout
+    byte-compatible with what unmtr_hb2st_hh consumes."""
+    hb = [(n - 3 - j) // kd + 1 for j in range(0, max(n - 2, 0))
+          if j <= n - 3]
+    assert hb == _hb_sweep_counts(n, kd)
+    bd = [(n - 2 - s) // kd + 1 for s in range(0, max(n - 1, 0))
+          if s <= n - 3]
+    assert bd == _bd_sweep_counts(n, kd)
+
+
+def test_documented_dependence_list_is_complete():
+    """The recorded dep list of task (j, w) — (j, w−1)@t−1,
+    (j−1, w+1)@t−2, (j−1, w+2)@t−1 — covers every conflicting
+    PREDECESSOR within the previous two staggers (the window any
+    wavefront implementation must honor)."""
+    n, kd = 96, 8
+    tasks = _hb_tasks(n, kd)
+    index = {(j, w): task for (j, w, *_), task in
+             zip([(t[0], t[1]) for t in tasks], tasks)}
+    documented = lambda j, w: {(j, w - 1), (j - 1, w + 1), (j - 1, w + 2)}
+    for task in tasks:
+        j, w, t = task[:3]
+        for other in tasks:
+            jo, wo, to = other[:3]
+            if (jo, wo) == (j, w) or not _overlap(task, other):
+                continue
+            if 0 < t - to <= 2:
+                assert (jo, wo) in documented(j, w), \
+                    f"conflicting near-predecessor {(jo, wo)}@{to} of " \
+                    f"{(j, w)}@{t} missing from the documented dep list"

@@ -154,3 +154,136 @@ def test_tb2bd_wavefront_bitwise_identity(nthreads):
     for log_s, log_p in zip(ser, par):
         for a, b in zip(log_s, log_p):
             np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Device wavefront chase (ops.pallas_kernels.hb2st_wavefront /
+# tb2bd_wavefront, interpret mode on CPU) vs the native host chase: the
+# SAME schedule runs as ONE Pallas invocation with the reflector log
+# written directly into the padded (nsweeps, tmax, kd) device layout, so
+# parity here pins band, log layout AND the layout actually consumed by
+# unmtr_hb2st_hh.  f64/c128 compare against the native chase on the
+# same operand (tight); f32 runs the kernel in f32 against the f64
+# native reference (the native chase has no f32 instantiation).
+# ---------------------------------------------------------------------------
+
+import jax.numpy as jnp
+
+from slate_tpu.perf.autotune import kernel as _kernel
+
+
+def _native_packed(abw, n, kd, j0=0, j1=None):
+    from slate_tpu.linalg.eig import _hb_sweep_counts, _pack_hh_log
+    if j1 is None:
+        j1 = max(n - 2, 0)
+    log = native.hb2st_hh_banded_range(abw, n, kd, j0, j1)
+    counts = _hb_sweep_counts(n, kd, j0, j1)
+    return _pack_hh_log(*log, n, kd, counts=counts)
+
+
+@pytest.mark.parametrize("kd", [8, 48])
+@pytest.mark.parametrize("dtype", [np.float32, np.float64, np.complex128],
+                         ids=["f32", "f64", "c128"])
+def test_hb2st_pallas_wavefront_parity(dtype, kd):
+    n = 96 if kd == 8 else 128
+    ref_dt = np.complex128 if dtype == np.complex128 else np.float64
+    ab_ref = _band_wide(n, kd, 7, ref_dt)
+    ab_dev = ab_ref.astype(dtype)
+    v3, t2, s0 = _native_packed(ab_ref, n, kd)
+
+    out_ab, vt = _kernel("hb2st_wavefront")(ab_dev, kd)
+    out_ab = np.asarray(out_ab)
+    vt = np.asarray(vt)
+    # the kernel's padded log IS _pack_hh_log's layout (tau-prefixed)
+    assert vt.shape == (v3.shape[0], v3.shape[1], kd + 1)
+    tol = 5e-3 if dtype == np.float32 else 1e-8
+    scale = np.max(np.abs(ab_ref))
+    np.testing.assert_allclose(out_ab, ab_ref.astype(ref_dt),
+                               atol=tol * scale, rtol=0)
+    np.testing.assert_allclose(vt[:, :, 1:], v3, atol=tol, rtol=0)
+    np.testing.assert_allclose(vt[:, :, 0], t2, atol=tol, rtol=0)
+    # the consumed layout: back-transform a probe through both logs
+    from slate_tpu.linalg.eig import unmtr_hb2st_hh
+    rng = np.random.default_rng(8)
+    z = rng.standard_normal((n, 4))
+    z_ref = np.asarray(unmtr_hb2st_hh(v3, t2, s0, z, kd))
+    z_dev = np.asarray(unmtr_hb2st_hh(vt[:, :, 1:], vt[:, :, 0], s0,
+                                      z, kd))
+    np.testing.assert_allclose(z_dev, z_ref, atol=tol * 10, rtol=0)
+
+
+def test_hb2st_pallas_wavefront_range_chunks():
+    """The checkpointed sweep-range chunks (the distributed drivers'
+    middle) reproduce the native chunked chase: the band is the full
+    inter-chunk state."""
+    n, kd = 96, 8
+    ab_ref = _band_wide(n, kd, 9)
+    ab_dev = ab_ref.copy()
+    chunks = [(0, 30), (30, 70), (70, n - 2)]
+    hb = _kernel("hb2st_wavefront")
+    for j0, j1 in chunks:
+        v3, t2, s0 = _native_packed(ab_ref, n, kd, j0, j1)
+        ab_j, vt = hb(jnp.asarray(ab_dev), kd, j0, j1)
+        ab_dev = np.asarray(ab_j)
+        vt = np.asarray(vt)
+        np.testing.assert_allclose(ab_dev, ab_ref, atol=1e-8, rtol=0)
+        np.testing.assert_allclose(vt[:, :, 1:], v3, atol=1e-8, rtol=0)
+        np.testing.assert_allclose(vt[:, :, 0], t2, atol=1e-8, rtol=0)
+        assert list(s0) == list(range(j0 + 1, j1 + 1))
+
+
+@pytest.mark.parametrize("kd", [8, 48])
+def test_tb2bd_pallas_wavefront_parity(kd):
+    from slate_tpu.linalg.eig import _pack_hh_log
+    from slate_tpu.linalg.svd import _bd_sweep_counts
+    n = 96 if kd == 8 else 128
+    st_ref = _tb_band(n, kd, 11)
+    st_dev = st_ref.copy()
+    ulog, vlog = native.tb2bd_hh_banded(st_ref, n, kd)
+    counts = _bd_sweep_counts(n, kd)
+    pu = _pack_hh_log(*ulog, n, kd, counts=counts)
+    pv = _pack_hh_log(*vlog, n, kd, counts=counts)
+    out_st, ut, vt = map(np.asarray, _kernel("tb2bd_wavefront")(st_dev, kd))
+    assert ut.shape == (pu[0].shape[0], pu[0].shape[1], kd + 1)
+    np.testing.assert_allclose(out_st, st_ref, atol=1e-8, rtol=0)
+    np.testing.assert_allclose(ut[:, :, 1:], pu[0], atol=1e-8, rtol=0)
+    np.testing.assert_allclose(ut[:, :, 0], pu[1], atol=1e-8, rtol=0)
+    np.testing.assert_allclose(vt[:, :, 1:], pv[0], atol=1e-8, rtol=0)
+    np.testing.assert_allclose(vt[:, :, 0], pv[1], atol=1e-8, rtol=0)
+
+
+def test_device_chase_zero_host_bytes(monkeypatch):
+    """Acceptance pin: on the device-chase path metrics.snapshot()
+    reports chase.host_bytes == 0 — the band, reflector log and WY
+    back-transform never cross the host↔device boundary (only the O(n)
+    tridiagonal does, which is stage 3's handoff, not the tunnel)."""
+    import jax
+    import slate_tpu as st
+    from slate_tpu.enums import Uplo
+    from slate_tpu.perf import metrics
+
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                       "chase=pallas_wavefront")
+    was_on = metrics.enabled()
+    metrics.reset()
+    metrics.on()
+    try:
+        n = 48
+        rng = np.random.default_rng(5)
+        g = rng.standard_normal((n, n))
+        herm = (g + g.T) / 2
+        hm = st.HermitianMatrix(jnp.asarray(herm, jnp.float64),
+                                uplo=Uplo.Lower)
+        w, z = st.heev(hm, jobz=True, opts={"block_size": 8})
+        w = np.asarray(w)
+        z = np.asarray(z)
+        resid = (np.linalg.norm(herm @ z - z * w[None, :])
+                 / (np.linalg.norm(herm) * n * np.finfo(np.float64).eps))
+        assert resid < 50, resid
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("chase.dispatch.pallas_wavefront", 0) >= 1
+        assert snap.get("chase.host_bytes") == 0.0
+    finally:
+        metrics.reset()
+        if not was_on:
+            metrics.off()

@@ -238,6 +238,33 @@ def test_getrf_dispatch_pallas_budget_when_scattered_forced(monkeypatch):
         autotune.reset_table()
 
 
+def test_chase_wavefront_one_pallas_call_per_chunk():
+    """The device bulge chase owns its whole chunk in ONE Pallas
+    invocation (the getrf mega-kernel budget applied to the eig/SVD
+    stage-2 middle): a k-chunk checkpointed pass must trace to exactly
+    k pallas_calls — a per-window (or per-stagger) launch chain
+    sneaking back in fails here, not in a profile someday."""
+    from slate_tpu.perf.autotune import kernel
+    from slate_tpu.perf.hlo_profile import count_pallas_calls
+
+    n, kd = 64, 8
+    hb = kernel("hb2st_wavefront")
+    ab = jnp.zeros((n, 2 * kd + 2), jnp.float64)
+    assert count_pallas_calls(lambda x: hb(x, kd)[0], ab) == 1
+    chunks = [(0, 20), (20, 45), (45, n - 2)]
+
+    def chunked(x):
+        for j0, j1 in chunks:
+            x, _ = hb(x, kd, j0, j1)
+        return x
+
+    assert count_pallas_calls(chunked, ab) == len(chunks)
+
+    tb = kernel("tb2bd_wavefront")
+    stm = jnp.zeros((n, 3 * kd + 2), jnp.float64)
+    assert count_pallas_calls(lambda x: tb(x, kd)[0], stm) == 1
+
+
 def test_custom_call_census_parses_compiled_hlo():
     """The HLO-text census (what the on-chip artifact uses: Pallas
     lowers to custom_call_target=\"tpu_custom_call\") counts targets
