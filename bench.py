@@ -166,7 +166,8 @@ def _partial_aggregate(sub, fails, infra):
     headline_keys = [k for k in sub
                      if k.startswith(("gemm_fp32", "potrf_fp32",
                                       "getrf_fp32", "geqrf_fp32",
-                                      "gels_fp32"))]
+                                      "gels_fp32"))
+                     and not k.endswith("_frac_of_gemm")]
     vals = [sub[k] for k in headline_keys
             if isinstance(sub[k], (int, float)) and sub[k] > 0]
     geomean = float(np.exp(np.mean(np.log(vals)))) if vals else 0.0
@@ -787,7 +788,8 @@ def main():
     headline_keys = [k for k in sub
                      if k.startswith(("gemm_fp32", "potrf_fp32",
                                       "getrf_fp32", "geqrf_fp32",
-                                      "gels_fp32"))]
+                                      "gels_fp32"))
+                     and not k.endswith("_frac_of_gemm")]
     vals = [sub[k] for k in headline_keys
             if isinstance(sub[k], (int, float)) and sub[k] > 0]
     geomean = (float(np.exp(np.mean(np.log(vals)))) if vals else 0.0)
@@ -806,6 +808,22 @@ def main():
                     # two-stage eig/svd run partly on host; their
                     # fraction is informational, not flagged
                     low.append(k)
+    # frac_of_gemm as a FIRST-CLASS derived submetric per factorization
+    # routine (routine TF/s ÷ same-run gemm TF/s): the ROADMAP targets
+    # (getrf ≥ 0.4×, potrf ≥ 0.6× of measured gemm) become sentinel
+    # rows that tools/bench_diff.py aligns, thresholds and renders,
+    # instead of hand arithmetic over two GFLOP/s columns.  Wall-time
+    # (_s) stage keys carry no fraction; the geomean/anchor math above
+    # already excludes the derived keys.
+    for k in list(sub):
+        if not k.startswith(("potrf_", "getrf_", "geqrf_", "gels_",
+                             "heev_", "svd_")):
+            continue
+        if k.endswith("_s") or k.endswith("_frac_of_gemm"):
+            continue
+        anchor = sub.get(gemm64_key) if "fp64" in k else sub.get(gemm_key)
+        if anchor and isinstance(sub[k], (int, float)):
+            sub[k + "_frac_of_gemm"] = round(sub[k] / anchor, 3)
     out = {
         "metric": "factor_suite_fp32_geomean",
         "value": round(geomean, 1),

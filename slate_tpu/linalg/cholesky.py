@@ -66,7 +66,19 @@ def potrf(a, opts: Optional[Options] = None):
     from ..options import get_option
     method = get_option(opts, "method_factor", "auto")
     nbsel = 512 if nb <= 256 else nb
-    if method == "auto" and full.dtype == jnp.float32 and full.ndim == 2 \
+    # fused-step dispatch first (ISSUE 6): when the ``potrf_step`` site
+    # picks "fused", ONE pallas invocation owns each right-looking step
+    # (panel chol+inv + trsm-as-gemm + double-buffered streamed
+    # trailing update) — otherwise the composed strip/XLA paths below
+    if method == "auto" and full.ndim == 2 \
+            and jnp.issubdtype(full.dtype, jnp.floating) \
+            and select_backend(
+                "potrf_step", n=int(full.shape[-1]), nb=nbsel,
+                dtype=full.dtype,
+                eligible=blocks.use_fused_potrf_step(
+                    int(full.shape[-1]), nbsel, full.dtype)) == "fused":
+        l = blocks.potrf_steps(full, nbsel)
+    elif method == "auto" and full.dtype == jnp.float32 and full.ndim == 2 \
             and select_backend("potrf_panel", n=int(full.shape[-1]),
                                nb=nbsel, dtype=full.dtype) == "pallas":
         l = blocks.potrf_panels(full, nbsel)

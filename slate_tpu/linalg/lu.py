@@ -335,18 +335,25 @@ def _panel_lu_tntpiv(a, nb: int):
 # ---------------------------------------------------------------------------
 
 def _u12_with_linv(lu_top, linv, c):
-    """U₁₂ from the panel's unit-lower inverse: one MXU gemm plus one
-    residual-correction gemm pair at the library (HIGH) precision —
-    solve-grade accuracy (measured: XLA's trsm costs ~0.4 ms per
+    """U₁₂ from the panel's unit-lower inverse: the inverse is
+    Newton-refined ONCE at panel scale (``X₂ = X(2I − L₁₁X)`` — nb³
+    flops, HIGHEST) and then applied with ONE MXU gemm plus one
+    residual-correction gemm (measured: XLA's trsm costs ~0.4 ms per
     panel, 6.5 of getrf's 41 ms at n=8192).  Guarded (mirrors the
-    geqrf CholQR² devmax guard): ‖r₁‖∞/‖c‖∞ = ‖(I − L11·L11⁻¹)·c‖∞ /
-    ‖c‖∞ reuses the correction residual already computed; one Newton
-    step squares a small departure but cannot rescue a wrong inverse —
-    past the threshold the exact trsm takes over."""
+    geqrf CholQR² devmax guard): ‖r₁‖∞/‖c‖∞ = ‖(I − L11·X₂)·c‖∞ /
+    ‖c‖∞ reuses the correction residual already computed; Newton steps
+    square a small departure but cannot rescue a wrong inverse — past
+    the threshold the exact trsm takes over.  The refinement squares
+    the departure the guard sees, so fallback ACTIVATIONS drop
+    quadratically (countable via ``SLATE_TPU_METRICS_DEVICE=1``), and
+    the fallback branch solves against the SAME ``l11`` operand the
+    residual already materialized — the raw panel slice is no longer
+    kept live in HBM just for the cond's cold branch."""
 
     n1 = lu_top.shape[0]
     l11 = jnp.tril(lu_top, -1) + jnp.eye(n1, dtype=lu_top.dtype)
     li = linv.astype(lu_top.dtype)
+    li = 2.0 * li - matmul_hi(li, matmul_hi(l11, li))
     u12 = matmul(li, c)
     r1 = c - matmul(l11, u12)
     dev = jnp.max(jnp.abs(r1)) / jnp.maximum(
@@ -363,7 +370,7 @@ def _u12_with_linv(lu_top, linv, c):
         dev < 1e-2,
         lambda _: u12 + matmul(li, r1),
         lambda _: lax.linalg.triangular_solve(
-            lu_top, c, left_side=True, lower=True, unit_diagonal=True),
+            l11, c, left_side=True, lower=True, unit_diagonal=True),
         operand=None)
 
 
@@ -578,7 +585,52 @@ def getrf_panels(a, nb: int = 512, tall_panel: str = "tournament"):
     return a, gperm
 
 
-def getrf_scattered(a, nb: int = 512, bb: int = 128):
+#: VMEM budget of the fused LU step kernel (110 MB pinned in the
+#: pallas_call, minus headroom for Mosaic's own spills)
+_FUSED_STEP_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def _fused_step_tc(m: int, n: int, nb: int) -> int:
+    """Trailing-chunk height for the fused LU step: the largest divisor
+    of nb (floor 128) whose double-buffered (tc, m) pair fits the VMEM
+    budget next to the resident panel, Π/G and block scratches."""
+    tc = nb
+    # halve only while the result stays at/above the 128 floor (nb need
+    # only be a multiple of 128, so a blind halving chain could dip
+    # below it for nb = 384, 640, ...)
+    while tc // 2 >= 128 and _fused_step_bytes(m, nb, tc) > \
+            _FUSED_STEP_VMEM_BUDGET:
+        tc //= 2
+    return tc
+
+
+def _fused_step_bytes(m: int, nb: int, tc: int, bb: int = 128) -> int:
+    bb = min(bb, nb)
+    return 4 * (m * (2 * nb + 2 * bb + 2 * tc + 2)
+                + 2 * nb * nb + 2 * bb * bb)
+
+
+def _use_fused_step(m: int, n: int, nb: int, dtype) -> bool:
+    """Shape/VMEM ELIGIBILITY of the fused whole-step LU kernel
+    (:func:`~slate_tpu.ops.pallas_kernels.getrf_step_fused`) for the
+    scattered driver: the scattered driver's own gate already holds
+    (f32, min(m,n) % nb == 0, m % 8 == 0); on top, the trailing chunks
+    must tile the carry exactly (n % 128 == 0 keeps a tc divisor
+    available) and the resident panel + Π/G pair + double-buffered
+    chunks must fit VMEM.  Whether an eligible shape actually takes a
+    fused depth is the ``lu_step`` autotune decision."""
+    from .. import config
+    if config.use_pallas_mode() == "off":
+        return False
+    if nb % 128 != 0:
+        return False
+    tc = _fused_step_tc(m, n, nb)
+    if n % tc != 0:
+        return False
+    return _fused_step_bytes(m, nb, tc) <= _FUSED_STEP_VMEM_BUDGET
+
+
+def getrf_scattered(a, nb: int = 512, bb: int = 128, step=None):
     """Right-looking partial-pivot LU in SCATTERED-ROW form — the
     TPU-native re-design of the reference driver loop
     (``src/getrf.cc:94-215``) that eliminates its per-panel row-swap
@@ -608,36 +660,91 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128):
       multipliers zeroed (static-slice writes — no scatter of the big
       trailing slab).
 
+    The STEP composition is itself autotuned (the ``lu_step`` site,
+    fusion depth per (m, n, nb, dtype)): ``"composed"`` keeps the
+    panel kernel + XLA glue above; ``"fused_trsm"`` moves the
+    pivot-gather-fused U₁₂ solve into the panel's invocation (panel +
+    trsm depth); ``"fused"`` makes the WHOLE step one pallas_call —
+    panel, trsm and the double-buffered streamed rank-nb trailing
+    update share one VMEM residency against the aliased carry
+    (:func:`~slate_tpu.ops.pallas_kernels.getrf_step_fused`), zero
+    materialized intermediates between sub-stages
+    (``step.hbm_roundtrips == 0``, pinned in CI).  ``step`` overrides
+    the table (the autotuner's probe hook).
+
     Returns ``(lu, perm)`` with ``a[perm] = L·U`` — the
     :func:`getrf_rec` contract.  Requires min(m,n) % nb == 0; f32 on
     TPU (f32/f64 in interpret mode).
     """
 
     from ..perf.autotune import kernel
-    getrf_panel_fused = kernel("getrf_panel_fused")
 
     m, n = a.shape
     k = min(m, n)
     bb = min(bb, nb)
     assert nb % bb == 0, (nb, bb)   # blocks must tile the panel exactly
+    if step is None:
+        from ..method import select_backend
+        step = select_backend(
+            "lu_step", m=m, n=n, nb=nb, dtype=a.dtype,
+            eligible=_use_fused_step(m, n, nb, a.dtype))
+    if step in ("fused", "fused_trsm"):
+        getrf_step_fused = kernel("getrf_step_fused")
+        tc = _fused_step_tc(m, n, nb)
+    else:
+        getrf_panel_fused = kernel("getrf_panel_fused")
     at = a.T
     act = jnp.ones((1, m), a.dtype)
     pivs = []
     for k0 in range(0, k, nb):
-        at, piv, act, linv = getrf_panel_fused(at, act, k0, nb=nb, bb=bb)
+        metrics.inc("step.getrf.steps")
+        if step == "fused":
+            # the whole step — panel + pivot-gather-fused trsm + rank-nb
+            # trailing update — is ONE pallas invocation on the aliased
+            # carry: zero materialized intermediates between sub-stages
+            with metrics.step_timer("getrf", "fused"):
+                at, piv, act, _ = getrf_step_fused(
+                    at, act, k0, nb=nb, bb=bb, tc=tc)
+            pivs.append(piv)
+            continue
+        if step == "fused_trsm":
+            # panel + trsm depth: the kernel factors the panel AND
+            # scatters the solved U₁₂ into the pivot lanes; only the
+            # rank-nb trailing gemm stays in XLA (one gather to rebuild
+            # its operand — counted as the depth's single round trip)
+            with metrics.step_timer("getrf", "fused"):
+                at, piv, act, _ = getrf_step_fused(
+                    at, act, k0, nb=nb, bb=bb, tc=tc, update=False)
+            pivs.append(piv)
+            if k0 + nb < n:
+                metrics.count_hbm_roundtrips(1.0)
+                with metrics.step_timer("getrf", "update"):
+                    lmt = at[k0:k0 + nb, :] * act
+                    u12t = at[k0 + nb:, :][:, piv]
+                    at = at.at[k0 + nb:, :].add(-matmul(u12t, lmt))
+            continue
+        with metrics.step_timer("getrf", "panel"):
+            at, piv, act, linv = getrf_panel_fused(at, act, k0,
+                                                   nb=nb, bb=bb)
         pivs.append(piv)
         if k0 + nb < n:
-            slab_t = at[k0:k0 + nb, :]
-            l11 = (jnp.tril(slab_t[:, piv].T, -1)
-                   + jnp.eye(nb, dtype=a.dtype))
-            linv = linv.astype(a.dtype)
-            c1t = at[k0 + nb:, :][:, piv]
-            u12t = matmul_hi(c1t, linv.T)
-            u12t = u12t + matmul_hi(c1t - matmul_hi(u12t, l11.T),
-                                    linv.T)
-            lmt = slab_t * act
-            at = at.at[k0 + nb:, :].add(-matmul(u12t, lmt))
-            at = at.at[k0 + nb:, piv].set(u12t)
+            # composed glue: the pivot-row gather, the u12 write-back
+            # and the trailing read-modify-write each materialize an
+            # HBM intermediate the fused step does not
+            metrics.count_hbm_roundtrips(3.0)
+            with metrics.step_timer("getrf", "trsm"):
+                slab_t = at[k0:k0 + nb, :]
+                l11 = (jnp.tril(slab_t[:, piv].T, -1)
+                       + jnp.eye(nb, dtype=a.dtype))
+                linv = linv.astype(a.dtype)
+                c1t = at[k0 + nb:, :][:, piv]
+                u12t = matmul_hi(c1t, linv.T)
+                u12t = u12t + matmul_hi(c1t - matmul_hi(u12t, l11.T),
+                                        linv.T)
+            with metrics.step_timer("getrf", "update"):
+                lmt = slab_t * act
+                at = at.at[k0 + nb:, :].add(-matmul(u12t, lmt))
+                at = at.at[k0 + nb:, piv].set(u12t)
     piv_all = jnp.concatenate(pivs) if len(pivs) > 1 else pivs[0]
     if m > k:
         rem = jnp.argsort(act[0, :] < 0.5, stable=True)[: m - k]

@@ -381,6 +381,68 @@ def lauum_rec(uplo: Uplo, a, nb: int, conj: bool = True, hi: bool = False):
     return jnp.concatenate([top, bot], axis=-2)
 
 
+#: VMEM budget of the fused potrf step kernel (110 MB pinned in the
+#: pallas_call, minus headroom): the (n, nb) resident panel column, two
+#: (tc, tc) streaming tiles and three (nb, nb) diag-block scratches
+_POTRF_STEP_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def potrf_step_tc(n: int, nb: int) -> int:
+    """Trailing-tile edge for the fused potrf step: the largest divisor
+    of nb (floor 128) whose double-buffered (tc, tc) pair fits the VMEM
+    budget next to the (n, nb) panel column."""
+    tc = nb
+    while tc // 2 >= 128 and \
+            (n * nb + 2 * tc * tc + 3 * nb * nb) * 4 > _POTRF_STEP_VMEM_BUDGET:
+        tc //= 2
+    return tc
+
+
+def use_fused_potrf_step(n: int, nb: int, dtype) -> bool:
+    """Shape/VMEM ELIGIBILITY of the fused potrf step kernel
+    (:func:`potrf_steps`): f32 on a uniform nb grid (nb a power of two
+    ≥ 128 so the kernel's lane-aligned column DMA and recursive-doubling
+    inverse hold), panel column within the VMEM budget.  Whether an
+    eligible shape actually takes it is the ``potrf_step`` autotune
+    decision."""
+    if config.use_pallas_mode() == "off":
+        return False
+    if dtype != jnp.float32 or n % nb != 0 or n <= nb:
+        return False
+    if nb < 128 or (nb & (nb - 1)) != 0:
+        return False
+    tc = potrf_step_tc(n, nb)
+    return (n * nb + 2 * tc * tc + 3 * nb * nb) * 4 \
+        <= _POTRF_STEP_VMEM_BUDGET
+
+
+def potrf_steps(a, nb: int = 512, tc: int | None = None):
+    """Right-looking blocked Cholesky whose WHOLE step — diagonal
+    chol+inverse, panel trsm-as-gemm, symmetric rank-nb trailing update
+    — is ONE Pallas invocation per block column
+    (:func:`~slate_tpu.ops.pallas_kernels.potrf_step_fused`): the
+    aliased carry round-trips HBM once per step instead of once per
+    sub-stage, and the trailing tiles stream through a double-buffered
+    VMEM residency at the composed strip driver's exact flop count.
+    The ``potrf_step`` autotune site times this against
+    :func:`potrf_panels` (the composed path) per (n, nb, dtype).
+
+    Requires ``n % nb == 0`` and nb a power of two (the in-kernel
+    recursive-doubling inverse); f32 on TPU, f32/f64 in interpret mode.
+    """
+
+    from ..perf import metrics
+    from .pallas_kernels import potrf_step_fused
+
+    n = a.shape[-1]
+    tc = tc if tc is not None else potrf_step_tc(n, nb)
+    metrics.inc("step.potrf.steps", float(n // nb))
+    with metrics.step_timer("potrf", "fused"):
+        for k0 in range(0, n, nb):
+            a = potrf_step_fused(a, k0, nb=nb, tc=tc)
+    return jnp.tril(a)
+
+
 def potrf_panels(a, nb: int = 512):
     """Right-looking blocked Cholesky whose panel step is the fused
     Pallas ``chol_inv_panel`` kernel (L and L⁻¹ of the diagonal block in
@@ -422,6 +484,8 @@ def _potrf_strips(a, nb, panel):
     returns the diagonal block's (L, L⁻¹); everything else — the panel
     trsm-as-gemm and the triangular trailing update in block-column
     strips — is identical across the f32/f64 drivers."""
+    from ..perf import metrics
+
     n = a.shape[-1]
     # trailing strip width: measured optimum on v5e (tools sweep:
     # ws=2048 → 54.9 TF/s, 4096 → 39.9, full-square → 29.9 at n=8192),
@@ -433,18 +497,27 @@ def _potrf_strips(a, nb, panel):
     for k0 in range(0, n, nb):
         w = min(nb, n - k0)
         akk = a[k0:k0 + w, k0:k0 + w]
-        lkk, linv = panel(akk, w)
-        a = a.at[k0:k0 + w, k0:k0 + w].set(lkk)
+        with metrics.step_timer("potrf", "panel"):
+            lkk, linv = panel(akk, w)
+            a = a.at[k0:k0 + w, k0:k0 + w].set(lkk)
         if k0 + w < n:
-            l21 = matmul(a[k0 + w:, k0:k0 + w], _ct(linv))
-            a = a.at[k0 + w:, k0:k0 + w].set(l21)
+            with metrics.step_timer("potrf", "trsm"):
+                l21 = matmul(a[k0 + w:, k0:k0 + w], _ct(linv))
+                a = a.at[k0 + w:, k0:k0 + w].set(l21)
             # triangular trailing update in block-column strips: strip j
-            # only updates rows >= its own start
-            for j0 in range(k0 + w, n, ws):
-                jw = min(ws, n - j0)
-                lj = l21[j0 - (k0 + w):j0 - (k0 + w) + jw]
-                a = a.at[j0:, j0:j0 + jw].add(
-                    -matmul(l21[j0 - (k0 + w):], _ct(lj)))
+            # only updates rows >= its own start.  Each materialized
+            # inter-stage intermediate (the l21 write-back + one
+            # read-modify-write per strip) is an HBM round trip the
+            # fused step kernel does not pay — counted so CI can pin
+            # the fused path at zero.
+            nstrips = len(range(k0 + w, n, ws))
+            metrics.count_hbm_roundtrips(1.0 + nstrips)
+            with metrics.step_timer("potrf", "update"):
+                for j0 in range(k0 + w, n, ws):
+                    jw = min(ws, n - j0)
+                    lj = l21[j0 - (k0 + w):j0 - (k0 + w) + jw]
+                    a = a.at[j0:, j0:j0 + jw].add(
+                        -matmul(l21[j0 - (k0 + w):], _ct(lj)))
     return jnp.tril(a)
 
 

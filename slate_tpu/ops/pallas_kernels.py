@@ -363,10 +363,10 @@ def _block_inv_doubling(l_ref, inv_ref, nb, ib):
 
 
 def _chol_inv_kernel(a_ref, l_ref, inv_ref, *, nb, ib):
-    f32 = jnp.float32
+    dt = jnp.promote_types(l_ref.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
     l_ref[:] = a_ref[:]
-    inv_ref[:] = jnp.zeros((nb, nb), f32)   # doubling needs clean zeros
+    inv_ref[:] = jnp.zeros((nb, nb), dt)    # doubling needs clean zeros
     nblk = nb // ib
     for bi in range(nblk):
         k0 = bi * ib
@@ -376,12 +376,12 @@ def _chol_inv_kernel(a_ref, l_ref, inv_ref, *, nb, ib):
         if k0 + ib < nb:
             binv = inv_ref[k0:k0 + ib, k0:k0 + ib]
             a21 = l_ref[k0 + ib:nb, k0:k0 + ib]
-            l21 = jnp.dot(a21, binv.T, preferred_element_type=f32,
+            l21 = jnp.dot(a21, binv.T, preferred_element_type=dt,
                           precision=hi)
             l_ref[k0 + ib:nb, k0:k0 + ib] = l21
             tr = l_ref[k0 + ib:nb, k0 + ib:nb]
             l_ref[k0 + ib:nb, k0 + ib:nb] = \
-                tr - jnp.dot(l21, l21.T, preferred_element_type=f32,
+                tr - jnp.dot(l21, l21.T, preferred_element_type=dt,
                              precision=hi)
     rows = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (nb, nb), 1)
@@ -392,22 +392,24 @@ def _chol_inv_kernel(a_ref, l_ref, inv_ref, *, nb, ib):
 @_x32_trace
 @functools.partial(jax.jit, static_argnums=())
 def chol_inv_panel(a):
-    """Factor an (nb, nb) f32 SPD panel: returns ``(L, L⁻¹)`` (both
-    lower triangular) from one fused VMEM kernel.  nb must be a power
-    of two ≥ 32 (the inverse assembly doubles block sizes)."""
+    """Factor an (nb, nb) SPD panel: returns ``(L, L⁻¹)`` (both lower
+    triangular) from one fused VMEM kernel.  nb must be a power of two
+    ≥ 32 (the inverse assembly doubles block sizes).  f32 on TPU;
+    f32/f64 in interpret mode (the dtype follows the operand)."""
 
     nb = a.shape[-1]
     ib = min(32, nb)
     assert nb % ib == 0 and (nb & (nb - 1)) == 0, nb
+    dt = jnp.promote_types(a.dtype, jnp.float32)
     out = pl.pallas_call(
         functools.partial(_chol_inv_kernel, nb=nb, ib=ib),
-        out_shape=(jax.ShapeDtypeStruct((nb, nb), jnp.float32),
-                   jax.ShapeDtypeStruct((nb, nb), jnp.float32)),
+        out_shape=(jax.ShapeDtypeStruct((nb, nb), dt),
+                   jax.ShapeDtypeStruct((nb, nb), dt)),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM)),
         interpret=_interpret(),
-    )(a)
+    )(a.astype(dt))
     return out
 
 
@@ -555,7 +557,8 @@ def lu_inv_panel(a):
 
 
 def _trtri_panel_kernel(l_in_ref, inv_ref, *, nb, ib):
-    inv_ref[:] = jnp.zeros((nb, nb), jnp.float32)
+    inv_ref[:] = jnp.zeros((nb, nb),
+                           jnp.promote_types(inv_ref.dtype, jnp.float32))
     for bi in range(nb // ib):
         k0 = bi * ib
         inv_ref[k0:k0 + ib, k0:k0 + ib] = \
@@ -565,21 +568,23 @@ def _trtri_panel_kernel(l_in_ref, inv_ref, *, nb, ib):
 
 @_x32_trace
 def trtri_panel(l):
-    """Inverse of an (nb, nb) f32 lower-triangular panel in one fused
-    VMEM kernel — the companion of :func:`chol_inv_panel` for factor
-    layouts where L arrives pre-computed (the autotuned
-    ``trtri_panel`` backend).  nb must be a power of two ≥ 32."""
+    """Inverse of an (nb, nb) lower-triangular panel in one fused VMEM
+    kernel — the companion of :func:`chol_inv_panel` for factor layouts
+    where L arrives pre-computed (the autotuned ``trtri_panel``
+    backend).  nb must be a power of two ≥ 32.  f32 on TPU; f32/f64 in
+    interpret mode (the dtype follows the operand)."""
 
     nb = l.shape[-1]
     ib = min(32, nb)
     assert nb % ib == 0 and (nb & (nb - 1)) == 0, nb
+    dt = jnp.promote_types(l.dtype, jnp.float32)
     return pl.pallas_call(
         functools.partial(_trtri_panel_kernel, nb=nb, ib=ib),
-        out_shape=jax.ShapeDtypeStruct((nb, nb), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((nb, nb), dt),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=_interpret(),
-    )(l)
+    )(l.astype(dt))
 
 
 # ---------------------------------------------------------------------------
@@ -829,10 +834,11 @@ def getrf_panel_linv(slab_t, active_row, ib: int = 32):
 # ---------------------------------------------------------------------------
 
 
-def _getrf_panel_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
-                              act_out, linv_ref, panel, cur, ohblk, lfull,
-                              l11s, l11i, sem, *, m, nb, bb, ib):
-    """One grid step = one bb-wide column block of the (nb, m) panel:
+def _fused_panel_phase(s, nsteps, at_hbm, act_in, k0, out_hbm, piv_ref,
+                       act_out, linv_ref, panel, cur, ohblk, lfull,
+                       l11s, l11i, sem, *, m, nb, bb, ib, ohfull=None):
+    """Shared panel phase of the fused panel/step mega-kernels — one
+    grid step = one bb-wide column block of the (nb, m) panel:
 
     * step 0 DMAs panel rows [k0, k0+nb) of the transposed matrix into
       the resident ``panel`` scratch and seeds the carried state;
@@ -847,13 +853,17 @@ def _getrf_panel_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
       inverse (per-ib diagonal inverses + recursive doubling, exactly
       :func:`_trtri_panel_kernel`'s scheme) and DMAs the factored panel
       back into the aliased HBM carry.
+
+    When ``ohfull`` (an (nb, m) scratch) is given, every block's one-hot
+    pivot rows are also accumulated there — the step kernel's trailing
+    phase folds the pivot-row gather into its trsm/update gemms through
+    it.  After the call at ``s == nsteps-1``: ``panel`` holds the
+    factored panel (already written back to HBM), ``lfull`` the
+    unit-lower pivot block L₁₁ and ``linv_ref`` its inverse.
     """
 
     dt = jnp.promote_types(panel.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
-    s = pl.program_id(0)
-    nsteps = pl.num_programs(0)
-    k0 = pl.multiple_of(k0_ref[0], bb)
 
     @pl.when(s == 0)
     def _init():
@@ -871,6 +881,8 @@ def _getrf_panel_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
     _factor_block_lane_major(cur, act_out, piv_ref, ohblk,
                              m=m, bb=bb, ib=ib, piv0=r0)
     panel[pl.ds(r0, bb), :] = cur[:]
+    if ohfull is not None:
+        ohfull[pl.ds(r0, bb), :] = ohblk[:]
     # packed rows of this block across every panel column, gathered by
     # the one-hot pivot matrix (an MXU dot, not a scatter): final for
     # columns ≤ the block end; later columns are masked off in the
@@ -942,6 +954,21 @@ def _getrf_panel_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
         dma.wait()
 
 
+def _getrf_panel_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
+                              act_out, linv_ref, panel, cur, ohblk, lfull,
+                              l11s, l11i, sem, *, m, nb, bb, ib):
+    """The panel-only fused mega-kernel: exactly the shared panel phase
+    (:func:`_fused_panel_phase`); the driver composes the trailing
+    trsm/update in XLA."""
+
+    s = pl.program_id(0)
+    nsteps = pl.num_programs(0)
+    k0 = pl.multiple_of(k0_ref[0], bb)
+    _fused_panel_phase(s, nsteps, at_hbm, act_in, k0, out_hbm, piv_ref,
+                       act_out, linv_ref, panel, cur, ohblk, lfull,
+                       l11s, l11i, sem, m=m, nb=nb, bb=bb, ib=ib)
+
+
 @_x32_trace
 def getrf_panel_fused(at_full, active_row, k0, nb: int = 512,
                       bb: int = 128, ib: int = 16):
@@ -996,7 +1023,347 @@ def getrf_panel_fused(at_full, active_row, k0, nb: int = 512,
 
 
 # ---------------------------------------------------------------------------
-# Device-resident wavefront bulge chase — ONE pallas_call owns the whole
+# Fused right-looking factorization STEP mega-kernels — ONE pallas_call
+# owns panel + trsm + rank-nb trailing update of a whole block-column
+# step.  BENCH_r03/r04 put getrf at 13.6% and potrf at 30% of measured
+# gemm with the panel already fused (PR 3): the remaining cost is the
+# GLUE between the sub-stages — the pivot-row gather that materializes
+# the U12 operand in HBM, the u12 write-back, the trailing-update
+# read-modify-write, and a kernel launch per sub-stage.  Here the whole
+# step shares one VMEM residency: the panel factors in place (the
+# shared :func:`_fused_panel_phase`), the pivot gather is FOLDED into
+# the trsm/update gemms as one-hot-matrix operands prepared once per
+# step (the LP-GEMM move: layout conversion lives in the GEMM epilogue,
+# never materialized), the triangular solve is a gemm against the
+# Newton-refined pivot-block inverse, and the trailing matrix streams
+# through a double-buffered VMEM residency against the ALIASED HBM
+# carry — zero materialized intermediates between sub-stages.
+# ---------------------------------------------------------------------------
+
+
+def _stream_chunks(hbm, bufs, in_sems, out_sems, c_lo, c_hi, slicer,
+                   compute):
+    """Double-buffered read-modify-write stream over HBM chunks
+    ``c ∈ [c_lo, c_hi)`` (traced bounds; no-op when empty): chunk c is
+    DMA'd from ``hbm[slicer(c)]`` into ``bufs[(c-c_lo) % 2]``,
+    transformed in place by ``compute(buf, c)`` and DMA'd back, with
+    chunk c+1's fetch and chunk c's write-back in flight across the
+    neighbouring computes (the double-buffered VMEM residency of the
+    fused step kernels)."""
+
+    def _step(c, cur, cin, cout, nxt, nout, nin):
+        pltpu.make_async_copy(hbm.at[slicer(c)], cur, cin).wait()
+
+        @pl.when(c + 1 < c_hi)
+        def _prefetch():
+            # the next chunk lands in the OTHER buffer: drain that
+            # buffer's write-back (chunk c-1) before overwriting it
+            @pl.when(c - 1 >= c_lo)
+            def _drain():
+                pltpu.make_async_copy(nxt, hbm.at[slicer(c - 1)],
+                                      nout).wait()
+            pltpu.make_async_copy(hbm.at[slicer(c + 1)], nxt, nin).start()
+
+        compute(cur, c)
+        pltpu.make_async_copy(cur, hbm.at[slicer(c)], cout).start()
+
+    def body(c, carry):
+        rel = c - c_lo
+
+        @pl.when(rel % 2 == 0)
+        def _even():
+            _step(c, bufs[0], in_sems[0], out_sems[0],
+                  bufs[1], out_sems[1], in_sems[1])
+
+        @pl.when(rel % 2 == 1)
+        def _odd():
+            _step(c, bufs[1], in_sems[1], out_sems[1],
+                  bufs[0], out_sems[0], in_sems[0])
+
+        return carry
+
+    @pl.when(c_lo < c_hi)
+    def _prologue():
+        pltpu.make_async_copy(hbm.at[slicer(c_lo)], bufs[0],
+                              in_sems[0]).start()
+
+    jax.lax.fori_loop(c_lo, c_hi, body, 0)
+
+    # the last two chunks' write-backs are still in flight (the loop
+    # drains a buffer only when refilling it)
+    for back in (2, 1):
+        c = c_hi - back
+        if isinstance(c, int) and c < 0:
+            continue            # statically too few chunks for this slot
+
+        @pl.when(c >= c_lo)
+        def _flush(c=c):
+            @pl.when((c - c_lo) % 2 == 0)
+            def _a():
+                pltpu.make_async_copy(bufs[0], hbm.at[slicer(c)],
+                                      out_sems[0]).wait()
+
+            @pl.when((c - c_lo) % 2 == 1)
+            def _b():
+                pltpu.make_async_copy(bufs[1], hbm.at[slicer(c)],
+                                      out_sems[1]).wait()
+
+
+def _getrf_step_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
+                             act_out, linv_ref, panel, cur, ohblk, lfull,
+                             l11s, l11i, ohfull, pivm_ref, bufa, bufb,
+                             sem, ina, inb, outa, outb,
+                             *, m, n_rows, nb, bb, ib, tc, update):
+    """One grid step = one bb block of the panel phase (shared with the
+    panel-only kernel); the LAST grid step then streams the trailing
+    block rows of the aliased carry through a double-buffered VMEM
+    residency:
+
+    * the pivot-block inverse is Newton-refined once per step
+      (``X₂ = X(2I − L₁₁X)`` — algebraically the composed driver's
+      HIGHEST residual-correction pair, precomputed at (nb, nb) scale);
+    * the pivot-row gather is never materialized: the trsm operand is
+      ``G = X₂·Π`` (Π the step's one-hot pivot matrix), so
+      ``u12ᵗ = chunk·Gᵗ`` gathers AND solves in one MXU pass (2× the
+      composed path's trailing flops — the autotuned ``lu_step`` site
+      arbitrates that trade against the composed path's HBM glue);
+    * with ``update=True`` the rank-nb trailing update and the u12
+      scatter land in the same pass:
+      ``chunk ← chunk·(1−pivm) + u12ᵗ·(Π − Lᵗ)`` (the proven panel-
+      phase composition at trailing scale); with ``update=False``
+      (depth ``panel+trsm``) only the u12 scatter happens in-kernel and
+      the rank-nb gemm stays in XLA.
+    """
+
+    dt = jnp.promote_types(panel.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    hp = jax.lax.Precision.HIGH
+    s = pl.program_id(0)
+    nsteps = pl.num_programs(0)
+    k0 = pl.multiple_of(k0_ref[0], bb)
+    _fused_panel_phase(s, nsteps, at_hbm, act_in, k0, out_hbm, piv_ref,
+                       act_out, linv_ref, panel, cur, ohblk, lfull,
+                       l11s, l11i, sem, m=m, nb=nb, bb=bb, ib=ib,
+                       ohfull=ohfull)
+
+    @pl.when(s == nsteps - 1)
+    def _trailing():
+        # pivot-lane mask of THIS step's nb pivots (the scatter target)
+        pivm_ref[:] = jnp.sum(ohfull[:], axis=0, keepdims=True)
+        # Newton-refine the pivot-block inverse: X₂ = X(2I − L₁₁X).
+        # lfull holds unit-lower L₁₁ after the panel phase; reuse it
+        # for X₂ — the composed path's per-chunk HIGHEST correction
+        # pair collapses into this one (nb, nb) precompute.
+        t = jnp.dot(lfull[:], linv_ref[:], preferred_element_type=dt,
+                    precision=hi)
+        lfull[:] = 2.0 * linv_ref[:] - jnp.dot(
+            linv_ref[:], t, preferred_element_type=dt, precision=hi)
+        if update:
+            # W = Π − Lᵗ into the panel buffer (its write-back DMA was
+            # waited in the panel phase), then G = X₂·Π into ohfull
+            panel[:] = ohfull[:] - panel[:] * act_out[:]
+            ohfull[:] = jnp.dot(lfull[:], ohfull[:],
+                                preferred_element_type=dt, precision=hi)
+            gbuf, wbuf = ohfull, panel
+        else:
+            # panel+trsm depth: G goes to the (free) panel buffer and
+            # Π stays intact — the in-kernel epilogue only scatters u12
+            panel[:] = jnp.dot(lfull[:], ohfull[:],
+                               preferred_element_type=dt, precision=hi)
+            gbuf, wbuf = panel, ohfull
+
+        def compute(buf, c):
+            # gather + solve in one pass: u12ᵗ = chunk·Gᵗ (HIGH — the
+            # X₂ precompute already absorbed the inverse's departure,
+            # so the remaining error is one HIGH-gemm rounding, the
+            # same class as every library trailing product)
+            u12t = jax.lax.dot_general(
+                buf[:], gbuf[:],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=dt, precision=hp)
+            buf[:] = buf[:] * (1.0 - pivm_ref[:]) + jnp.dot(
+                u12t, wbuf[:], preferred_element_type=dt, precision=hp)
+
+        c_lo = (k0 + nb) // tc
+        _stream_chunks(out_hbm, (bufa, bufb), (ina, inb), (outa, outb),
+                       c_lo, n_rows // tc,
+                       lambda c: (pl.ds(c * tc, tc), slice(None)),
+                       compute)
+
+
+@_x32_trace
+def getrf_step_fused(at_full, active_row, k0, nb: int = 512,
+                     bb: int = 128, ib: int = 16, tc: int | None = None,
+                     update: bool = True):
+    """ONE pallas invocation owns a whole right-looking getrf step on
+    the TRANSPOSED scattered carry: TRUE partial-pivot panel
+    factorization of rows [k0, k0+nb), the pivot-gather-fused U₁₂
+    solve, and (``update=True``) the rank-nb trailing update of every
+    later block row — see :func:`_getrf_step_fused_kernel`.  The HBM
+    carry is aliased and ``k0`` is a scalar operand, so ONE Mosaic
+    compilation serves every step of the factorization.  Returns
+    ``(at_full', piv, active_out, linv)`` (the
+    :func:`getrf_panel_fused` contract; with ``update=True`` the
+    trailing rows of ``at_full'`` are already updated and scattered).
+    """
+
+    n_rows, m = at_full.shape
+    bb = min(bb, nb)
+    ib = min(ib, bb)
+    tc = tc if tc is not None else nb
+    tc = min(tc, nb)
+    assert nb % bb == 0 and bb % ib == 0 and m % 8 == 0, (m, nb, bb, ib)
+    assert bb % 8 == 0, bb
+    # trailing chunks tile the carry exactly, and every step boundary
+    # k0 + nb falls on a chunk boundary
+    assert nb % tc == 0 and n_rows % tc == 0, (n_rows, nb, tc)
+    if isinstance(k0, int):
+        assert k0 % bb == 0, (k0, bb)
+    dt = jnp.promote_types(at_full.dtype, jnp.float32)
+    out, piv, act_out, linv = pl.pallas_call(
+        functools.partial(_getrf_step_fused_kernel, m=m, n_rows=n_rows,
+                          nb=nb, bb=bb, ib=ib, tc=tc, update=update),
+        grid=(nb // bb,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=(jax.ShapeDtypeStruct((n_rows, m), dt),
+                   jax.ShapeDtypeStruct((1, nb), jnp.int32),
+                   jax.ShapeDtypeStruct((1, m), dt),
+                   jax.ShapeDtypeStruct((nb, nb), dt)),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((nb, m), dt),     # resident panel / W
+                        pltpu.VMEM((bb, m), dt),     # current block
+                        pltpu.VMEM((bb, m), dt),     # one-hot pivot rows
+                        pltpu.VMEM((nb, nb), dt),    # packed L rows / X₂
+                        pltpu.VMEM((bb, bb), dt),    # step L11
+                        pltpu.VMEM((bb, bb), dt),    # step L11⁻¹
+                        pltpu.VMEM((nb, m), dt),     # step Π / G
+                        pltpu.VMEM((1, m), dt),      # pivot-lane mask
+                        pltpu.VMEM((tc, m), dt),     # trailing buffer A
+                        pltpu.VMEM((tc, m), dt),     # trailing buffer B
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={0: 0},
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=_interpret(),
+    )(at_full.astype(dt), active_row.astype(dt),
+      jnp.asarray(k0, jnp.int32).reshape(1))
+    return out, piv[0], act_out, linv
+
+
+def _potrf_step_fused_kernel(a_in, k0_ref, a_out, linv_ref, col, akk,
+                             lkk, bufa, bufb, sem, ina, inb, outa, outb,
+                             *, n, nb, ib, tc):
+    """One pallas invocation owns a whole right-looking potrf step:
+
+    * the (n, nb) panel block-column DMAs into a resident VMEM strip;
+    * the diagonal block factors with the fused chol+inverse core
+      (:func:`_chol_inv_kernel` — per-ib unblocked Cholesky, recursive-
+      doubling inverse), so the panel trsm is an MXU gemm
+      ``L₂₁ = A₂₁·L₁₁⁻ᵀ`` over the trailing row chunks only;
+    * the symmetric rank-nb trailing update streams (tc, tc) tiles of
+      the lower-triangle pairs through a double-buffered VMEM residency
+      against the aliased carry — flop-exact with the composed strip
+      driver (no full-height masking waste; tiles above the diagonal
+      are never touched).
+    """
+
+    dt = jnp.promote_types(col.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    hp = jax.lax.Precision.HIGH
+    k0 = pl.multiple_of(k0_ref[0], nb)
+    cdma = pltpu.make_async_copy(a_in.at[:, pl.ds(k0, nb)], col, sem)
+    cdma.start()
+    cdma.wait()
+    akk[:] = col[pl.ds(k0, nb), :]
+    _chol_inv_kernel(akk, lkk, linv_ref, nb=nb, ib=ib)
+    col[pl.ds(k0, nb), :] = lkk[:]
+    c_lo = (k0 + nb) // tc
+    c_hi = n // tc
+
+    def l21_body(c, carry):
+        rows = pl.ds(c * tc, tc)
+        col[rows, :] = jax.lax.dot_general(
+            col[rows, :], linv_ref[:],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=dt, precision=hi)
+        return carry
+
+    jax.lax.fori_loop(c_lo, c_hi, l21_body, 0)
+    odma = pltpu.make_async_copy(col, a_out.at[:, pl.ds(k0, nb)], sem)
+    odma.start()
+    odma.wait()
+
+    def j_body(j, carry):
+        j0 = j * tc
+
+        def compute(buf, i):
+            buf[:] = buf[:] - jax.lax.dot_general(
+                col[pl.ds(i * tc, tc), :], col[pl.ds(j0, tc), :],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=dt, precision=hp)
+
+        _stream_chunks(a_out, (bufa, bufb), (ina, inb), (outa, outb),
+                       j, c_hi,
+                       lambda i: (pl.ds(i * tc, tc), pl.ds(j0, tc)),
+                       compute)
+        return carry
+
+    jax.lax.fori_loop(c_lo, c_hi, j_body, 0)
+
+
+@_x32_trace
+def potrf_step_fused(a, k0, nb: int = 512, tc: int = 512):
+    """ONE pallas invocation owns a whole right-looking Cholesky step
+    (panel chol+inverse + trsm-as-gemm + streamed symmetric trailing
+    update) on the aliased (n, n) carry — see
+    :func:`_potrf_step_fused_kernel`.  ``k0`` is a scalar operand, so
+    one Mosaic compilation serves every step.  nb must be a power of
+    two ≥ 64 with tc | nb | n.  Returns the updated carry (rows/cols
+    < k0 and the strict upper triangle of the trailing block pass
+    through untouched — the driver tril-cleans once at the end).  f32
+    on TPU; f32/f64 in interpret mode."""
+
+    n = a.shape[-1]
+    assert a.shape[-2] == n, a.shape
+    ib = min(32, nb)
+    tc = min(tc, nb)
+    assert nb % ib == 0 and (nb & (nb - 1)) == 0 and nb >= 64, nb
+    assert n % nb == 0 and nb % tc == 0, (n, nb, tc)
+    if isinstance(k0, int):
+        assert k0 % nb == 0, (k0, nb)
+    dt = jnp.promote_types(a.dtype, jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_potrf_step_fused_kernel, n=n, nb=nb, ib=ib,
+                          tc=tc),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_shape=jax.ShapeDtypeStruct((n, n), dt),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((nb, nb), dt),    # L₁₁⁻¹
+                        pltpu.VMEM((n, nb), dt),     # resident panel col
+                        pltpu.VMEM((nb, nb), dt),    # diag block in
+                        pltpu.VMEM((nb, nb), dt),    # diag block L
+                        pltpu.VMEM((tc, tc), dt),    # trailing tile A
+                        pltpu.VMEM((tc, tc), dt),    # trailing tile B
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={0: 0},
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=110 * 1024 * 1024),
+        interpret=_interpret(),
+    )(a.astype(dt), jnp.asarray(k0, jnp.int32).reshape(1))
 # eig/SVD stage-2 middle section (or one checkpointed sweep-range chunk
 # of it).  The host chase in native/runtime.cc streams the band through
 # a single core and ships the packed reflector log back to the device

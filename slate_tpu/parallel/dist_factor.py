@@ -51,12 +51,33 @@ def _conj(a, conj: bool):
 
 
 @lru_cache(maxsize=None)
-def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
+def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
+                  panel_backend: str = "xla"):
     p, q = mesh_grid_shape(mesh)
     conj = "complex" in dtype_name
     mtp = p * ml
     M = mtp * nb
     bounds = stage_bounds(nt)
+
+    def _panel_factor(d, panel):
+        """(L₁₁, L₂₁-below) of the replicated (M, nb) panel — the
+        redundant per-device panel solve.  ``pallas_panel`` (the
+        autotuned ``dist_panel`` site) fuses the nb×nb Cholesky and its
+        inverse into ONE kernel launch so the full-height trsm becomes
+        an MXU gemm — the single-chip fused-panel win inherited by the
+        lookahead pipeline (one launch per step per device, was a
+        cholesky + triangular_solve chain)."""
+        if panel_backend == "pallas_panel":
+            from ..perf.autotune import kernel as _kern
+
+            lkk, linv = _kern("chol_inv_panel")(d)
+            lkk = lkk.astype(d.dtype)
+            return lkk, _mm(panel, linv.astype(d.dtype).T)
+        l11 = jnp.tril(lax.linalg.cholesky(d))
+        x = lax.linalg.triangular_solve(
+            l11, panel, left_side=False, lower=True,
+            transpose_a=True, conjugate_a=conj)
+        return l11, x
 
     def kernel(a_loc):
         r = lax.axis_index(AXIS_P)
@@ -78,12 +99,11 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
             def body(k, carry):
                 a_loc, panel = carry            # panel: bcast column k
                 # ---- redundant panel factor on the replicated panel:
-                # nb×nb Cholesky + (M, nb) trsm (src/potrf.cc:221-231)
+                # nb×nb Cholesky + (M, nb) trsm (src/potrf.cc:221-231),
+                # or the fused Pallas chol+inverse panel + MXU gemm
+                # when the dist_panel site picked it
                 d = lax.dynamic_slice(panel, (k * nb, 0), (nb, nb))
-                l11 = jnp.tril(lax.linalg.cholesky(d))
-                x = lax.linalg.triangular_solve(
-                    l11, panel, left_side=False, lower=True,
-                    transpose_a=True, conjugate_a=conj)
+                l11, x = _panel_factor(d, panel)
                 w_full = x * (gblk > k)[:, None].astype(dt)     # L21
                 fac = lax.dynamic_update_slice(w_full, l11, (k * nb, 0))
                 # ---- lookahead: update ONLY block column k+1 (narrow
@@ -137,6 +157,8 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
     padding) — see :func:`pposv` for the glue.
     """
 
+    from .dist_util import dist_panel_backend
+
     p, q = a.grid_shape
     if a.m != a.n:
         raise ValueError(f"ppotrf requires a square matrix, got {a.m}x{a.n}")
@@ -145,7 +167,8 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
                          "(distribute with row_mult=q, col_mult=p)")
     ml, nl = a.mtp // p, a.ntp // q
     nt = ceildiv(a.n, a.nb)
-    fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype))
+    fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                       dist_panel_backend("potrf", a.nb, a.dtype))
     return like(a, fn(a.data))
 
 

@@ -75,11 +75,42 @@ def _roll_rows(x, shift):
 
 
 @lru_cache(maxsize=None)
-def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
+def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
+                  panel_backend: str = "xla"):
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
     M = mtp * nb
     bounds = stage_bounds(nt)
+
+    def _u12_solve(l11, rowblk):
+        """U₁₂ = L₁₁⁻¹·A₁₂ on the replicated block row.  With the
+        ``dist_panel`` site at ``pallas_panel`` the unit-lower inverse
+        comes from ONE fused trtri kernel launch and the solve is an
+        MXU gemm + one residual-correction gemm pair, guarded exactly
+        like the single-chip ``_u12_with_linv``: past a 1e-2 departure
+        ‖(I − L₁₁·X)·c‖∞/‖c‖∞ the exact trsm takes over (a correction
+        step cannot rescue a wrong inverse on a high-growth panel; the
+        cond compiles once per stage body, not per step — the r4 geqrf
+        per-panel-cond lesson).  The ``xla`` backend keeps the
+        triangular_solve chain."""
+        if panel_backend != "pallas_panel":
+            return lax.linalg.triangular_solve(
+                l11, rowblk, left_side=True, lower=True,
+                unit_diagonal=True)
+        from ..perf.autotune import kernel as _kern
+
+        linv = _kern("trtri_panel")(l11).astype(l11.dtype)
+        u12 = _mm(linv, rowblk)
+        r1 = rowblk - _mm(l11, u12)
+        dev = jnp.max(jnp.abs(r1)) / jnp.maximum(
+            jnp.max(jnp.abs(rowblk)), jnp.finfo(l11.dtype).tiny)
+        return lax.cond(
+            dev < 1e-2,
+            lambda _: u12 + _mm(linv, r1),
+            lambda _: lax.linalg.triangular_solve(
+                l11, rowblk, left_side=True, lower=True,
+                unit_diagonal=True),
+            operand=None)
 
     def kernel(a_loc):
         r = lax.axis_index(AXIS_P)
@@ -141,9 +172,7 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
                 # 'p' by the swap psum, so no second block-row collective
                 rowblk = fetched[:nb, col0:]
                 l11 = jnp.tril(lu_p[:nb], -1) + jnp.eye(nb, dtype=dt)
-                u12 = lax.linalg.triangular_solve(
-                    l11, rowblk, left_side=True, lower=True,
-                    unit_diagonal=True)
+                u12 = _u12_solve(l11, rowblk)
                 cmask = (gcblk_w > k).astype(dt)[None, :]
                 # keep columns j ≤ k from a_loc, not from the fetch: the
                 # fetch predates the panel writeback, so its copy of the
@@ -217,9 +246,12 @@ def pgetrf(a: DistMatrix):
     if a.mtp != a.ntp:
         raise ValueError("pgetrf needs square padded storage "
                          "(distribute with row_mult=q, col_mult=p)")
+    from .dist_util import dist_panel_backend
+
     ml, nl = a.mtp // p, a.ntp // q
     nt = ceildiv(a.n, a.nb)
-    fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype))
+    fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                       dist_panel_backend("getrf", a.nb, a.dtype))
     lu_data, gperm = fn(a.data)
     return like(a, lu_data), gperm
 

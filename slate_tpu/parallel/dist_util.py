@@ -44,6 +44,29 @@ def local_grows(ml: int, nb: int, p, r):
     return ((lrows // nb) * p + r) * nb + lrows % nb
 
 
+def dist_panel_backend(op: str, nb: int, dtype) -> str:
+    """Resolve the autotuned ``dist_panel`` site for a distributed
+    driver's per-step panel solve (``"xla"`` | ``"pallas_panel"`` — see
+    :func:`slate_tpu.perf.autotune.choose_dist_panel`).  Called by the
+    public drivers BEFORE the ``lru_cache``'d shard_map builders so the
+    decision is part of the build key — a forced knob change reaches a
+    fresh build instead of a stale cache entry.  Eligibility: real
+    floating dtype and a power-of-two nb the fused panel kernels'
+    recursive-doubling inverse supports; on a real TPU only f32 (the
+    Pallas panels are f32-class there — f64 would hit Mosaic's
+    bitwidth ≤ 32 layout check; off-TPU interpret mode runs any real
+    float, which the forced knob uses in CI)."""
+    from ..method import select_backend
+
+    dt = jnp.dtype(dtype)
+    on_tpu = jax.default_backend() == "tpu"
+    eligible = (dt.kind == "f" and 32 <= nb <= 1024
+                and (nb & (nb - 1)) == 0
+                and (dt == jnp.float32 or not on_tpu))
+    return select_backend("dist_panel", driver=op, nb=nb, dtype=dt,
+                          eligible=eligible)
+
+
 def bcast_block_col(col_loc, grows, own, M: int):
     """Fused panel broadcast — ONE collective per factorization step.
 

@@ -57,7 +57,8 @@ __all__ = [
     "decide", "decisions", "timing_reps", "kernel",
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
     "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
-    "choose_geqrf_panel", "choose_chase",
+    "choose_geqrf_panel", "choose_chase", "choose_lu_step",
+    "choose_potrf_step", "choose_dist_panel",
 ]
 
 #: timed repetitions per surviving candidate (after the compile/warm rep)
@@ -749,30 +750,166 @@ def choose_lu_driver(m: int, n: int, nb: int, dtype,
         return _timed_call(lambda x: getrf_rec(x, nb), _a())
 
     def check(out):
-        # O(n²) matvec probe of the factor identity L·(U·x) = A[perm]·x
-        # (the reference tester's criterion, kept on device — n=8192
-        # operands never land on the host)
-        import jax.numpy as jnp
-        import numpy as np
-
-        lu, perm = out
-        if not bool(jnp.all(jnp.isfinite(lu))):
-            return False
-        a = _a()
-        x = _randn((n,), dt, 9)
-        y = jnp.triu(lu[: min(m, n)]) @ x
-        k = min(m, n)
-        z = jnp.tril(lu[:, :k], -1) @ y + jnp.pad(y, (0, m - k))
-        r = float(jnp.linalg.norm(z - a[perm] @ x))
-        eps = float(np.finfo(np.dtype(dt.name)).eps)
-        den = (float(jnp.linalg.norm(a)) * float(jnp.linalg.norm(x))
-               * eps * max(m, n))
-        return r / max(den, 1e-300) < 100.0
+        return _lu_factor_residual_ok(out, _a(), m, n, dt)
 
     return decide("lu_driver", key, [
         Candidate("rec", setup_rec, check),
         Candidate("scattered", setup_scattered, check),
     ])
+
+
+def _lu_factor_residual_ok(out, a, m: int, n: int, dt) -> bool:
+    """O(n²) matvec probe of the factor identity L·(U·x) = A[perm]·x
+    (the reference tester's criterion, kept on device — n=8192 operands
+    never land on the host).  Shared by the ``lu_driver`` and
+    ``lu_step`` accuracy guards."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    lu, perm = out
+    if not bool(jnp.all(jnp.isfinite(lu))):
+        return False
+    x = _randn((n,), dt, 9)
+    k = min(m, n)
+    y = jnp.triu(lu[:k]) @ x
+    z = jnp.tril(lu[:, :k], -1) @ y + jnp.pad(y, (0, m - k))
+    r = float(jnp.linalg.norm(z - a[perm] @ x))
+    eps = float(np.finfo(np.dtype(dt.name)).eps)
+    den = (float(jnp.linalg.norm(a)) * float(jnp.linalg.norm(x))
+           * eps * max(m, n))
+    return r / max(den, 1e-300) < 100.0
+
+
+def choose_lu_step(m: int, n: int, nb: int, dtype, eligible: bool) -> str:
+    """Fusion DEPTH of one right-looking step of the scattered LU
+    driver: ``"composed"`` (fused panel kernel + XLA glue — pivot-row
+    gather, u12 gemm pair, rank-nb trailing update: panel-only depth),
+    ``"fused_trsm"`` (panel + pivot-gather-fused u12 scatter inside ONE
+    pallas invocation, trailing gemm in XLA) or ``"fused"`` (the whole
+    step — panel + trsm + streamed trailing update — one pallas_call on
+    the aliased carry; ~2× the composed trailing MXU flops bought back
+    by zero inter-stage HBM round trips, which is exactly the trade
+    this table exists to measure).  ``eligible`` is the call site's
+    shape/VMEM gate (``linalg.lu._use_fused_step``); off-TPU the forced
+    knob is honoured so interpret-mode CI can pin the fused depths."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (m, n, nb, dt.name, _precision_name())
+    if not eligible:
+        return _static("lu_step", key, "composed", "ineligible")
+    if config.use_pallas_mode() == "off":
+        return _static("lu_step", key, "composed", "forced-config")
+    if not _on_tpu():
+        forced = _forced("lu_step")
+        if forced in ("fused", "fused_trsm", "composed"):
+            return _static("lu_step", key, forced, "forced")
+        return _static("lu_step", key, "composed", "default")
+
+    probes: dict = {}
+
+    def _a():
+        return _memo(probes, "a", lambda: _randn((m, n), dt, 12))
+
+    def _setup(depth):
+        from ..linalg.lu import getrf_scattered
+
+        return _timed_call(
+            lambda x: getrf_scattered(x, nb, step=depth), _a())
+
+    def check(out):
+        return _lu_factor_residual_ok(out, _a(), m, n, dt)
+
+    return decide("lu_step", key, [
+        Candidate("composed", lambda: _setup("composed"), check),
+        Candidate("fused", lambda: _setup("fused"), check),
+        Candidate("fused_trsm", lambda: _setup("fused_trsm"), check),
+    ])
+
+
+def choose_potrf_step(n: int, nb: int, dtype, eligible: bool) -> str:
+    """Step composition of the f32 right-looking Cholesky driver:
+    ``"composed"`` (the strip driver :func:`ops.blocks.potrf_panels` —
+    fused chol+inv panel kernel, XLA trsm-as-gemm and strip updates)
+    vs ``"fused"`` (:func:`ops.blocks.potrf_steps` — the WHOLE step as
+    one pallas invocation with the trailing tiles streamed through a
+    double-buffered VMEM residency).  ``eligible`` is the call site's
+    gate (``ops.blocks.use_fused_potrf_step``); off-TPU the forced
+    knob is honoured for interpret-mode CI."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (n, nb, dt.name, _precision_name())
+    if not eligible:
+        return _static("potrf_step", key, "composed", "ineligible")
+    if config.use_pallas_mode() == "off":
+        return _static("potrf_step", key, "composed", "forced-config")
+    if not _on_tpu():
+        forced = _forced("potrf_step")
+        if forced in ("fused", "composed"):
+            return _static("potrf_step", key, forced, "forced")
+        return _static("potrf_step", key, "composed", "default")
+
+    probes: dict = {}
+
+    def _spd():
+        return _memo(probes, "spd", lambda: _spd_probe(n, dt))
+
+    def setup_fused():
+        from ..ops import blocks
+
+        return _timed_call(lambda x: blocks.potrf_steps(x, nb), _spd())
+
+    def setup_composed():
+        from ..ops import blocks
+
+        return _timed_call(lambda x: blocks.potrf_panels(x, nb), _spd())
+
+    def check(out):
+        return _potrf_guard(_spd(), out, 3.0)
+
+    return decide("potrf_step", key, [
+        Candidate("composed", setup_composed, check),
+        Candidate("fused", setup_fused, check),
+    ])
+
+
+def choose_dist_panel(op: str, nb: int, dtype, eligible: bool) -> str:
+    """Per-step panel solve backend inside the DISTRIBUTED drivers'
+    shard_map bodies: ``"xla"`` (lax cholesky/lu + triangular_solve
+    chain — today's path) vs ``"pallas_panel"`` (the fused VMEM
+    chol+inverse / trtri panel kernel + MXU gemms — ONE kernel launch
+    per step per device, the single-chip fused-step win inherited by
+    the lookahead pipeline).  Heuristic + forceable only: timing a
+    collective driver needs the mesh, which the autotuner does not
+    own, so on TPU the Pallas panel is the default for eligible shapes
+    and ``SLATE_TPU_AUTOTUNE_FORCE=dist_panel=...`` pins either way."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (op, nb, dt.name)
+    if not eligible:
+        return _static("dist_panel", key, "xla", "ineligible")
+    forced = _forced("dist_panel")
+    if forced in ("xla", "pallas_panel"):
+        return _static("dist_panel", key, forced, "forced")
+    mode = config.use_pallas_mode()
+    if mode == "off":
+        return _static("dist_panel", key, "xla", "forced-config")
+    if mode == "on":
+        return _static("dist_panel", key, "pallas_panel", "forced-config")
+    if _on_tpu() and dt == jnp.float32:
+        return _static("dist_panel", key, "pallas_panel", "default")
+    return _static("dist_panel", key, "xla", "default")
 
 
 def choose_trtri_panel(n: int, dtype) -> str:
@@ -1010,6 +1147,14 @@ _CHOOSERS = {
                                                     False)),
     "lu_driver": lambda **kw: choose_lu_driver(kw["m"], kw["n"], kw["nb"],
                                                kw["dtype"], kw["eligible"]),
+    "lu_step": lambda **kw: choose_lu_step(kw["m"], kw["n"], kw["nb"],
+                                           kw["dtype"], kw["eligible"]),
+    "potrf_step": lambda **kw: choose_potrf_step(kw["n"], kw["nb"],
+                                                 kw["dtype"],
+                                                 kw["eligible"]),
+    "dist_panel": lambda **kw: choose_dist_panel(kw["driver"], kw["nb"],
+                                                 kw["dtype"],
+                                                 kw["eligible"]),
     "trtri_panel": lambda **kw: choose_trtri_panel(kw["n"], kw["dtype"]),
     "geqrf_panel": lambda **kw: choose_geqrf_panel(kw["m"], kw["n"],
                                                    kw["nb"], kw["dtype"]),

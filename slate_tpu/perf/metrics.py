@@ -70,7 +70,8 @@ __all__ = [
     "timer", "observe_time", "snapshot", "counter_series",
     "drain_samples", "instrument_driver", "check_finite_wanted",
     "device_metrics_wanted", "record_fallback_outcome", "pallas_census",
-    "install_compile_watch",
+    "install_compile_watch", "step_timer", "count_hbm_roundtrips",
+    "STEP_HBM_ROUNDTRIPS",
 ]
 
 _ENV = "SLATE_TPU_METRICS"
@@ -197,6 +198,31 @@ class _Timer:
 
 def timer(name: str) -> _Timer:
     return _Timer(name)
+
+
+#: counter of materialized HBM intermediates between the sub-stages of a
+#: right-looking factorization step (pivot-row gather, u12 write-back,
+#: per-strip trailing read-modify-write).  The composed step drivers
+#: increment it per step at trace time; the fused step kernels
+#: (``getrf_step_fused`` / ``potrf_step_fused`` — one pallas_call per
+#: step, aliased carry) never do, and CI pins the fused paths at ZERO.
+STEP_HBM_ROUNDTRIPS = "step.hbm_roundtrips"
+
+
+def step_timer(op: str, stage: str) -> _Timer:
+    """Timer ``step.<op>.<stage>`` for one sub-stage of a right-looking
+    factorization step (``panel`` / ``trsm`` / ``update`` on the
+    composed paths, ``fused`` when one kernel owns the whole step).
+    Recorded at trace/dispatch time — under jit this attributes Python
+    composition cost and, on the bench's per-routine lines, lets a diff
+    say WHICH stage composition a getrf/potrf move came from."""
+    return _Timer("step.%s.%s" % (op, stage))
+
+
+def count_hbm_roundtrips(n: float = 1.0) -> None:
+    """Count ``n`` materialized inter-stage HBM intermediates (see
+    :data:`STEP_HBM_ROUNDTRIPS`)."""
+    inc(STEP_HBM_ROUNDTRIPS, n)
 
 
 def _bucket(value: float) -> str:

@@ -43,7 +43,7 @@ from typing import List, Optional
 
 __all__ = [
     "Artifact", "Report", "Row", "load_artifact", "diff", "format_table",
-    "DEFAULT_THRESHOLD_PCT",
+    "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
 ]
 
 #: flag a drop bigger than this (percent) between consecutive artifacts
@@ -276,18 +276,42 @@ def diff(artifacts: List[Artifact],
 def _fmt_val(v: Optional[float]) -> str:
     if v is None:
         return "-"
+    if v < 10:                       # fractions / per-stage seconds
+        return "%.3f" % v
     return ("%.1f" % v) if v < 10000 else ("%.0f" % v)
 
 
+def frac_of_gemm(report: Report, label: str) -> Optional[float]:
+    """The NEWEST artifact's ``<label>_frac_of_gemm`` derived submetric
+    (bench.py r6+: routine TF/s ÷ same-run gemm TF/s) for a routine row
+    — the ROADMAP fraction targets surfaced next to the verdict instead
+    of living in hand arithmetic.  Strictly the newest artifact, never
+    an older fallback: a missing fraction (artifact predates the
+    submetric, or the newest run's gemm anchor never landed — exactly
+    the infra shapes this tool flags) must read as absent, not as a
+    stale number that looks current.  None also for the derived rows
+    themselves and for wall-time keys."""
+    if label.endswith("_frac_of_gemm") or label.endswith("_s"):
+        return None
+    if not report.artifacts:
+        return None
+    v = report.artifacts[-1].submetrics.get(label + "_frac_of_gemm")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
 def format_table(report: Report) -> str:
-    """Human-readable verdict table + infra findings."""
+    """Human-readable verdict table + infra findings.  The ``frac``
+    column renders each routine's newest fraction-of-gemm (see
+    :func:`frac_of_gemm`)."""
     heads = ["routine"] + [a.name for a in report.artifacts] \
-        + ["Δ%", "verdict"]
+        + ["Δ%", "frac", "verdict"]
     body = []
     for r in report.rows:
         delta = "%+.1f%%" % r.delta_pct if r.delta_pct is not None else "-"
+        frac = frac_of_gemm(report, r.label)
         line = [r.label] + [_fmt_val(v) for v in r.values] \
-            + [delta, r.verdict + ((" (%s)" % r.note) if r.note else "")]
+            + [delta, "%.3f" % frac if frac is not None else "-",
+               r.verdict + ((" (%s)" % r.note) if r.note else "")]
         body.append(line)
     widths = [max(len(str(row[i])) for row in [heads] + body)
               for i in range(len(heads))]

@@ -102,6 +102,20 @@ _SLOW_TESTS = frozenset({
     "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_nb_sweep[256]",
     "tests/test_lu_fused_panel.py::TestScatteredFusedParity::test_nb_sweep[512]",
     "tests/test_lu_fused_panel.py::TestEndToEndThroughFusedPath::test_getrf",
+    # fused-step sweep (round 8): representatives kept fast are
+    # test_shapes[256-256-float32], test_nb_sweep[128],
+    # test_fused_trsm_depth, test_many_tied_pivots, the potrf
+    # [256-128]/[384-128-f32]/[512-256-f32] parities and both
+    # end-to-end solves
+    "tests/test_step_fused.py::TestGetrfStepFused::test_depths_agree_on_pivots",
+    "tests/test_step_fused.py::TestGetrfStepFused::test_nb_sweep[256]",
+    "tests/test_step_fused.py::TestGetrfStepFused::test_nb_sweep[512]",
+    "tests/test_step_fused.py::TestGetrfStepFused::test_shapes[256-256-float64]",
+    "tests/test_step_fused.py::TestGetrfStepFused::test_shapes[384-256-float32]",
+    "tests/test_step_fused.py::TestGetrfStepFused::test_shapes[384-256-float64]",
+    "tests/test_step_fused.py::TestPotrfStepFused::test_nb512",
+    "tests/test_step_fused.py::TestPotrfStepFused::test_factor_parity[384-128-float64]",
+    "tests/test_step_fused.py::TestPotrfStepFused::test_factor_parity[512-256-float64]",
     "tests/test_lu.py::test_gesv_mixed_converges",
     "tests/test_lu.py::test_gesv_mixed_gmres_complex",
     "tests/test_lu.py::test_getrf_nopiv_dominant",

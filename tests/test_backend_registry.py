@@ -102,6 +102,18 @@ def test_multi_backend_sites_populate_autotune_table():
     # LU panel site
     st.getrf(jnp.asarray(g + n * np.eye(n, dtype=np.float32)))
 
+    # LU step-composition site (consulted by the scattered driver; an
+    # eligible shape so the decision records as "default", not
+    # "ineligible")
+    from slate_tpu.linalg.lu import getrf_scattered
+    getrf_scattered(jnp.asarray(np.random.default_rng(1).standard_normal(
+        (256, 256)).astype(np.float32)), 128)
+
+    # distributed per-step panel site (resolved by ppotrf/pgetrf before
+    # their shard_map builders run)
+    from slate_tpu.parallel.dist_util import dist_panel_backend
+    dist_panel_backend("potrf", 64, jnp.float32)
+
     # QR panel site
     st.geqrf(jnp.asarray(rng.standard_normal((2 * n, n)).astype(np.float32)))
 
@@ -115,6 +127,7 @@ def test_multi_backend_sites_populate_autotune_table():
     for op in ("matmul|128,128,128,float32",
                "matmul|8,8,8,float64",
                "potrf_panel|", "trtri_panel|", "lu_panel|", "lu_driver|",
+               "lu_step|", "potrf_step|", "dist_panel|potrf",
                "geqrf_panel|", "chase|hb2st"):
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"

@@ -265,6 +265,56 @@ def test_chase_wavefront_one_pallas_call_per_chunk():
     assert count_pallas_calls(lambda x: tb(x, kd)[0], stm) == 1
 
 
+def test_dist_panel_pallas_launch_budget(mesh24, monkeypatch):
+    """ISSUE-6 satellite: the lookahead pipeline inherits the fused
+    panel kernels through the ``dist_panel`` site — with it forced to
+    ``pallas_panel`` every step body carries exactly ONE pallas_call
+    (the fused chol+inverse / trtri panel), replacing the per-step
+    cholesky/lu + triangular_solve chain; the xla backend carries
+    none.  Counted on the jaxpr (each staged loop body once)."""
+    from slate_tpu.parallel.dist_factor import _build_ppotrf
+    from slate_tpu.parallel.dist_lu import _build_pgetrf
+    from slate_tpu.perf.hlo_profile import count_pallas_calls
+
+    nb2 = 32                      # pow2: dist_panel-eligible, ≠ NB=16
+    nt2 = N // nb2
+    ml2, nl2 = nt2 // P, nt2 // Q
+    nstages = len(stage_bounds(nt2)) - 1
+    data = jnp.zeros((N, N), jnp.float64)
+    for build, nps in ((_build_ppotrf, 1), (_build_pgetrf, 1)):
+        fn_p = build(mesh24, nb2, nt2, ml2, nl2, "float64",
+                     "pallas_panel")
+        assert count_pallas_calls(fn_p, data) == nps * nstages
+        fn_x = build(mesh24, nb2, nt2, ml2, nl2, "float64", "xla")
+        assert count_pallas_calls(fn_x, data) == 0
+
+
+def test_dist_panel_pallas_parity(mesh24, monkeypatch):
+    """The pallas_panel dist backend must not move the numerics: pposv
+    and pgesv residual-gated end to end with the site forced (interpret
+    mode inside the CPU shard_map — the same program a TPU mesh
+    compiles)."""
+    from slate_tpu.perf import autotune
+
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                       "dist_panel=pallas_panel")
+    autotune.reset_table()
+    try:
+        n, nb = 192, 32
+        g = _rng(47).standard_normal((n, n))
+        a = g @ g.T + n * np.eye(n)
+        b = _rng(48).standard_normal((n, 4))
+        _, x = pposv(a, b, mesh24, nb=nb)
+        xh = np.asarray(undistribute(x))
+        assert _scaled_res(a, xh, b) < 3 * np.finfo(np.float64).eps * n
+        a2 = _rng(49).standard_normal((n, n))
+        _, _, x2 = pgesv(a2, b, mesh24, nb=nb)
+        x2h = np.asarray(undistribute(x2))
+        assert _scaled_res(a2, x2h, b) < 3 * np.finfo(np.float64).eps * n
+    finally:
+        autotune.reset_table()
+
+
 def test_custom_call_census_parses_compiled_hlo():
     """The HLO-text census (what the on-chip artifact uses: Pallas
     lowers to custom_call_target=\"tpu_custom_call\") counts targets

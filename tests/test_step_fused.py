@@ -1,0 +1,280 @@
+"""The ISSUE-6 fused right-looking factorization STEP mega-kernels —
+ONE pallas_call owns panel + trsm + trailing update of a whole
+block-column step (``getrf_step_fused`` / ``potrf_step_fused``) — and
+the ``lu_step`` / ``potrf_step`` autotuned step-composition sites that
+ship them, exercised in interpret mode on CPU (the same program the TPU
+compiles, so pivot/factor parity and residuals here certify the
+default-capable path).
+"""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import scipy.linalg as sla
+
+import slate_tpu as st
+from slate_tpu.linalg.lu import getrf_scattered
+from slate_tpu.ops import blocks
+from slate_tpu.perf import autotune, metrics
+from slate_tpu.perf.hlo_profile import count_pallas_calls
+
+
+def _scipy_perm(a):
+    """Replay scipy's swap sequence into a permutation vector."""
+    _, piv = sla.lu_factor(np.asarray(a, np.float64)
+                           if a.dtype == np.float64 else np.asarray(a),
+                           check_finite=False)
+    want = np.arange(a.shape[0])
+    for k, p in enumerate(piv):
+        want[k], want[p] = want[p], want[k]
+    return want
+
+
+def _check_lu(a, nb, step, pivot_parity=True, tol=3.0):
+    """Residual gate + (optionally) scipy-exact pivots for one step
+    composition of the scattered driver."""
+    m, n = a.shape
+    lu, perm = jax.jit(
+        lambda x: getrf_scattered(x, nb, step=step))(jnp.asarray(a))
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    k = min(m, n)
+    assert sorted(perm.tolist()) == list(range(m)), "perm not a permutation"
+    lmat = np.tril(lu[:, :k], -1) + np.eye(m, k, dtype=a.dtype)
+    umat = np.triu(lu[:k])
+    eps = np.finfo(a.dtype).eps
+    res = (np.abs(a[perm] - lmat @ umat).max()
+           / (np.abs(a).max() * max(m, n) * eps))
+    assert res < tol, f"scaled residual {res} ({step})"
+    # TRUE partial pivoting: |L| ≤ 1 up to roundoff
+    assert np.abs(np.tril(lu[:, :k], -1)).max() <= 1.0 + 100 * eps
+    if pivot_parity:
+        np.testing.assert_array_equal(perm[:k], _scipy_perm(a)[:k])
+    return lu, perm
+
+
+class TestGetrfStepFused:
+    """Driver-level parity of the fused step depths vs scipy across
+    square/tall × f32/f64 × the nb sweep the ISSUE names."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("m,n", [(256, 256), (384, 256)])
+    def test_shapes(self, m, n, dtype):
+        a = np.random.default_rng(m + n).standard_normal(
+            (m, n)).astype(dtype)
+        _check_lu(a, 128, "fused")
+
+    @pytest.mark.parametrize("nb", [128, 256, 512])
+    def test_nb_sweep(self, nb):
+        n = 2 * nb if nb <= 256 else nb
+        a = np.random.default_rng(nb).standard_normal(
+            (n, n)).astype(np.float32)
+        _check_lu(a, nb, "fused")
+
+    def test_fused_trsm_depth(self):
+        a = np.random.default_rng(5).standard_normal(
+            (256, 256)).astype(np.float32)
+        _check_lu(a, 128, "fused_trsm")
+
+    def test_depths_agree_on_pivots(self):
+        """All three step compositions run the SAME panel arithmetic —
+        their pivots must be identical, and the factors must agree to
+        gemm-rounding (the fused path reorders the trailing products)."""
+        a = np.random.default_rng(6).standard_normal(
+            (256, 256)).astype(np.float32)
+        outs = {s: _check_lu(a, 128, s) for s in
+                ("composed", "fused", "fused_trsm")}
+        lu0, perm0 = outs["composed"]
+        for s in ("fused", "fused_trsm"):
+            lu, perm = outs[s]
+            np.testing.assert_array_equal(perm, perm0)
+            assert np.abs(lu - lu0).max() < 1e-3 * np.abs(lu0).max()
+
+    def test_many_tied_pivots(self):
+        """Adversarial ±1 matrix: every column's pivot search hits an
+        m-way exact magnitude tie; the fused step must still produce a
+        valid partial-pivot factorization (distinct pivots, |L| ≤ 1,
+        residual-gated) even though tie ORDER differs from LAPACK."""
+        rng = np.random.default_rng(13)
+        a = np.sign(rng.standard_normal((256, 256))).astype(np.float32)
+        _check_lu(a, 128, "fused", pivot_parity=False)
+
+
+class TestPotrfStepFused:
+    """Factor parity of the whole-step Cholesky kernel vs LAPACK."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("n,nb", [(256, 128), (384, 128), (512, 256)])
+    def test_factor_parity(self, n, nb, dtype):
+        rng = np.random.default_rng(n + nb)
+        g = rng.standard_normal((n, n)).astype(dtype)
+        spd = g @ g.T + n * np.eye(n, dtype=dtype)
+        l = np.asarray(jax.jit(
+            lambda x: blocks.potrf_steps(x, nb))(jnp.asarray(spd)))
+        eps = np.finfo(dtype).eps
+        res = np.linalg.norm(l @ l.T - spd) / (
+            np.linalg.norm(spd) * eps * n)
+        assert res < 3.0, res
+        assert np.abs(np.triu(l, 1)).max() == 0.0
+        ref = np.linalg.cholesky(spd.astype(np.float64))
+        dev = np.abs(l - ref).max() / np.abs(ref).max()
+        assert dev < 300 * eps, dev
+
+    def test_nb512(self):
+        n, nb = 1024, 512
+        rng = np.random.default_rng(7)
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+        l = np.asarray(jax.jit(
+            lambda x: blocks.potrf_steps(x, nb))(jnp.asarray(spd)))
+        eps = np.finfo(np.float32).eps
+        res = np.linalg.norm(l @ l.T - spd) / (
+            np.linalg.norm(spd) * eps * n)
+        assert res < 3.0, res
+
+    def test_matches_composed_strips(self):
+        rng = np.random.default_rng(8)
+        g = rng.standard_normal((256, 256)).astype(np.float32)
+        spd = g @ g.T + 256 * np.eye(256, dtype=np.float32)
+        l_f = np.asarray(blocks.potrf_steps(jnp.asarray(spd), 128))
+        l_c = np.asarray(blocks.potrf_panels(jnp.asarray(spd), 128))
+        assert np.abs(l_f - l_c).max() < 1e-3 * np.abs(l_c).max()
+
+
+class TestLaunchAndRoundtripBudgets:
+    """The acceptance pins: exactly 1 pallas_call per fused step, and
+    the inter-stage HBM round-trip counter at its minimum (ZERO) on the
+    fused paths."""
+
+    def test_getrf_one_pallas_call_per_fused_step(self):
+        for n, nb in ((256, 128), (384, 128)):
+            a = jnp.zeros((n, n), jnp.float32)
+            for step in ("fused", "fused_trsm", "composed"):
+                calls = count_pallas_calls(
+                    lambda x, s=step: getrf_scattered(x, nb, step=s), a)
+                assert calls == n // nb, (step, calls)
+
+    def test_potrf_one_pallas_call_per_fused_step(self):
+        a = jnp.zeros((256, 256), jnp.float32)
+        calls = count_pallas_calls(
+            lambda x: blocks.potrf_steps(x, 128), a)
+        assert calls == 2, calls
+
+    def _roundtrips(self, fn, *args):
+        was = metrics.enabled()
+        metrics.reset()
+        metrics.on()
+        try:
+            jax.make_jaxpr(fn)(*args)   # trace-time counters fire here
+            snap = metrics.snapshot()["counters"]
+        finally:
+            metrics.reset()
+            if not was:
+                metrics.off()
+        return snap.get(metrics.STEP_HBM_ROUNDTRIPS, 0.0)
+
+    def test_fused_steps_pin_zero_hbm_roundtrips(self):
+        a = jnp.zeros((256, 256), jnp.float32)
+        assert self._roundtrips(
+            lambda x: getrf_scattered(x, 128, step="fused"), a) == 0.0
+        assert self._roundtrips(
+            lambda x: blocks.potrf_steps(x, 128), a) == 0.0
+        # composed paths materialize intermediates every non-final step
+        assert self._roundtrips(
+            lambda x: getrf_scattered(x, 128, step="composed"), a) == 3.0
+        assert self._roundtrips(
+            lambda x: blocks.potrf_panels(x, 128), a) > 0.0
+        # the intermediate depth pays exactly ONE (the u12 re-gather)
+        assert self._roundtrips(
+            lambda x: getrf_scattered(x, 128, step="fused_trsm"), a) == 1.0
+
+
+class TestEndToEndThroughStepSites:
+    """gesv/posv routed through the fused step kernels by the autotune
+    sites (force knobs), residual-gated end to end — proof the
+    SHIPPED dispatch (not just the raw drivers) takes the fused path."""
+
+    @pytest.fixture(autouse=True)
+    def _force(self, monkeypatch):
+        from slate_tpu.linalg import lu as lu_mod
+        monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+        monkeypatch.setattr(lu_mod, "_SCATTERED_NB", 128)
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                           "lu_step=fused,potrf_step=fused")
+        autotune.reset_table()
+        yield
+        autotune.reset_table()
+
+    def test_gesv(self):
+        rng = np.random.default_rng(4)
+        n, nrhs = 256, 3
+        a = (rng.standard_normal((n, n)).astype(np.float32)
+             + n * np.eye(n, dtype=np.float32))
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=128),
+                              jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, f"solve residual {res}"
+        dec = autotune.decisions()
+        assert any(k.startswith("lu_step|") and v == "fused"
+                   for k, v in dec.items()), dec
+
+    def test_posv(self):
+        rng = np.random.default_rng(9)
+        n, nrhs = 1024, 2
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        a = (g @ g.T / n + np.eye(n, dtype=np.float32)).astype(np.float32)
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        fac, x = st.posv(st.HermitianMatrix(jnp.asarray(a),
+                                            uplo=st.Uplo.Lower),
+                         jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, f"solve residual {res}"
+        dec = autotune.decisions()
+        assert any(k.startswith("potrf_step|") and v == "fused"
+                   for k, v in dec.items()), dec
+
+
+def test_u12_fallback_activations_drop(monkeypatch):
+    """Satellite: the Newton-refined ``_u12_with_linv`` keeps the
+    fast branch active (fallback count 0) on the panels the blocked
+    recursion produces, and the fallback branch no longer captures the
+    raw panel slice (it solves against the l11 the residual already
+    materialized)."""
+    from slate_tpu.linalg import lu as lu_mod
+
+    monkeypatch.setenv("SLATE_TPU_METRICS_DEVICE", "1")
+    monkeypatch.setattr(lu_mod, "_use_pallas_panel",
+                        lambda m, w, dtype: dtype == jnp.float32
+                        and w % 32 == 0 and m >= w)
+    was = metrics.enabled()
+    metrics.reset()
+    metrics.on()
+    try:
+        n, nb = 192, 64
+        rng = np.random.default_rng(2)
+        a_np = (rng.standard_normal((n, n)).astype(np.float32)
+                + n * np.eye(n, dtype=np.float32))
+        lu, perm = lu_mod.getrf_rec(jnp.asarray(a_np), nb)
+        jax.block_until_ready(lu)
+        L = np.tril(np.asarray(lu), -1) + np.eye(n, dtype=np.float32)
+        U = np.triu(np.asarray(lu))
+        res = np.linalg.norm(L @ U - a_np[np.asarray(perm)]) / (
+            np.linalg.norm(a_np) * np.finfo(np.float32).eps * n)
+        assert res < 3, res
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("lu.u12_linv.fast", 0) >= 1
+        assert snap.get("lu.u12_linv.fallback", 0) == 0
+    finally:
+        metrics.reset()
+        if not was:
+            metrics.off()
+        autotune.reset_table()

@@ -8,9 +8,12 @@ Usage::
     python tools/bench_diff.py BENCH_r05.json            # infra check only
 
 Aligns routines across the artifacts (by routine name, dtype and dims
-parsed from the submetric labels), prints a verdict table, and exits
-nonzero when any routine regressed more than the threshold between
-consecutive artifacts OR when any artifact is infra-shaped (``rc != 0``,
+parsed from the submetric labels), prints a verdict table — including a
+``frac`` column with each routine's newest ``frac_of_gemm`` derived
+submetric (bench.py r6+: routine TF/s ÷ same-run gemm TF/s, the unit
+the ROADMAP fraction targets are written in) — and exits nonzero when
+any routine regressed more than the threshold between consecutive
+artifacts OR when any artifact is infra-shaped (``rc != 0``,
 missing/empty/partial aggregate) — the checks that would have flagged
 the r3→r4 geqrf drop (23.5 → 18.9 TF/s) and the empty BENCH_r05
 (rc=124, parsed null) automatically.
@@ -63,7 +66,10 @@ def main(argv=None) -> int:
             "threshold_pct": report.threshold_pct,
             "rows": [{"label": r.label, "values": r.values,
                       "delta_pct": r.delta_pct, "verdict": r.verdict,
-                      "note": r.note} for r in report.rows],
+                      "note": r.note,
+                      "frac_of_gemm": regress.frac_of_gemm(report,
+                                                           r.label)}
+                     for r in report.rows],
             "infra": [{"artifact": n, "reasons": rs}
                       for n, rs in report.infra],
             "exit_code": report.exit_code,
