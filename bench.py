@@ -68,12 +68,19 @@ routine's SIGALRM deadline is derived from it — remaining budget split
 evenly over remaining routines — so the whole suite provably finishes
 inside the budget and the aggregate LAST line always flushes.  A
 SIGTERM from an outer ``timeout`` triggers the same flush.  Every JSON
-line (and the aggregate) additionally embeds a ``"metrics"`` snapshot
-from the runtime registry (:mod:`slate_tpu.perf.metrics`): autotune
-cache traffic, driver call counts and wall time, jit compiles, Pallas
-dispatch counts.  Compare artifacts with ``python tools/bench_diff.py
-BENCH_r03.json BENCH_r04.json`` — the regression sentinel that exits
-nonzero on throughput drops and on infra-shaped artifacts.
+line additionally embeds a ``"metrics"`` DELTA from the runtime
+registry (:mod:`slate_tpu.perf.metrics`, snapshot-and-diff around the
+routine so each line is self-contained — the registry accumulates
+process-wide) plus an ``"attribution"`` roofline gap report
+(:mod:`slate_tpu.perf.attr`): per-stage flops/bytes placed on the
+MXU/HBM roofline, joined with the routine's measured stage timers, with
+a ranked bottleneck list.  The aggregate keeps the CUMULATIVE snapshot
+and the full ``{label: attribution}`` map.  Compare artifacts with
+``python tools/bench_diff.py BENCH_r03.json BENCH_r04.json [--explain]``
+— the regression sentinel that exits nonzero on throughput drops and on
+infra-shaped artifacts, and with ``--explain`` names the stage a drop
+came from; render one artifact's roofline tables with ``python
+tools/gap_report.py BENCH_r04.json``.
 """
 
 import json
@@ -114,14 +121,54 @@ MIN_DEADLINE_S = 20.0
 
 
 def _metrics_snapshot():
-    """The metrics registry's JSON view, embedded in every bench line —
-    never allowed to kill the artifact."""
+    """The metrics registry's JSON view (CUMULATIVE since process
+    start) — the aggregate line's block; never allowed to kill the
+    artifact."""
     try:
         from slate_tpu.perf import metrics
 
         return metrics.snapshot()
     except Exception:
         return {}
+
+
+def _metrics_delta(before):
+    """What the registry recorded SINCE ``before`` — the self-contained
+    per-routine block.  The registry accumulates across the whole
+    process, so a raw snapshot on a late routine's line would carry
+    every earlier routine's counters/timers; snapshot-and-diff around
+    each runner iteration keeps each line's ``metrics`` (and the
+    ``attribution`` derived from it) about THAT routine only."""
+    try:
+        from slate_tpu.perf import metrics
+
+        return metrics.snapshot_delta(before or {}, metrics.snapshot())
+    except Exception:
+        return {}
+
+
+#: jax platform of device 0, set by main() — the roofline constant set
+#: the attribution engine prices stages with
+_PLATFORM = "tpu"
+
+
+def _attribution(label, gflops, metrics_delta, autotune_tags):
+    """The routine's roofline gap report (slate_tpu/perf/attr.py):
+    analytical per-stage flops/bytes joined with this routine's
+    measured timer deltas, placed on the platform roofline.  Also feeds
+    the per-stage ``roofline.*`` gauges the Perfetto export renders as
+    counter tracks.  None (and never an exception) when the label has
+    no model."""
+    try:
+        from slate_tpu.perf import attr
+
+        rep = attr.attribute(label, gflops, metrics_snapshot=metrics_delta,
+                             autotune=autotune_tags, platform=_PLATFORM)
+        if rep:
+            attr.record_rooflines(rep)
+        return rep
+    except Exception:
+        return None
 
 
 class _RoutineTimeout(Exception):
@@ -158,7 +205,7 @@ def _stage_delta(label, stage_map, before):
             for k in stage_map}
 
 
-def _partial_aggregate(sub, fails, infra):
+def _partial_aggregate(sub, fails, infra, attribution=None):
     """The aggregate line's load-bearing fields from whatever completed
     so far — emitted by the hard watchdog so a hard hang still ends the
     artifact with a parseable LAST-line aggregate (the tail-reader
@@ -171,7 +218,7 @@ def _partial_aggregate(sub, fails, infra):
     vals = [sub[k] for k in headline_keys
             if isinstance(sub[k], (int, float)) and sub[k] > 0]
     geomean = float(np.exp(np.mean(np.log(vals)))) if vals else 0.0
-    return {
+    out = {
         "metric": "factor_suite_fp32_geomean",
         "value": round(geomean, 1),
         "unit": "GFLOP/s",
@@ -182,6 +229,9 @@ def _partial_aggregate(sub, fails, infra):
         "autotune": _autotune_tags(set()),
         "metrics": _metrics_snapshot(),
     }
+    if attribution:
+        out["attribution"] = dict(attribution)
+    return out
 
 
 def _run_with_deadline(fn, seconds, name="", on_hard_hang=None):
@@ -257,7 +307,8 @@ def _timeit(fn, args, iters):
     return min(times) / iters
 
 
-def _run_routine(name, fn, sub, fails, infra, deadline=None):
+def _run_routine(name, fn, sub, fails, infra, deadline=None,
+                 attr_sink=None):
     """Run one routine under its own watchdog with a bounded infra-error
     retry count; classify failures.
 
@@ -270,34 +321,48 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None):
     ``deadline`` overrides the flat ROUTINE_TIMEOUT_S — the global
     budgeting in :func:`main` derives it from SLATE_TPU_BENCH_DEADLINE_S
     (remaining budget / remaining routines).
+
+    Every emitted JSON line carries the routine's metrics DELTA
+    (snapshot-and-diff around this iteration — self-contained per
+    routine) and, on success, the roofline ``attribution`` block
+    derived from it; ``attr_sink`` collects the blocks for the
+    aggregate line.
     """
     last_err = None
     keys_before = _autotune_keys()
     if deadline is None:
         deadline = ROUTINE_TIMEOUT_S
+    snap_before = _metrics_snapshot()
 
     def _on_hard_hang():
+        # snap_before rebinds per attempt: the hard-hang line's delta
+        # covers only the attempt that hung
         print(json.dumps({"routine": name,
                           "error": "infra: hard-hung in a blocking C "
                                    "call past the SIGALRM deadline",
                           "autotune": _autotune_tags(keys_before),
-                          "metrics": _metrics_snapshot()}),
+                          "metrics": _metrics_delta(snap_before)}),
               flush=True)
         print(json.dumps(_partial_aggregate(
-            sub, fails, infra + [f"{name}: hard-hung"])), flush=True)
+            sub, fails, infra + [f"{name}: hard-hung"],
+            attribution=attr_sink)), flush=True)
 
     for attempt in range(2):
         try:
+            if attempt:           # a retry's delta must not carry the
+                snap_before = _metrics_snapshot()   # failed attempt's
             out = _run_with_deadline(fn, deadline, name=name,
                                      on_hard_hang=_on_hard_hang)
             label, gf, resid = out[0], out[1], out[2]
+            tags = _autotune_tags(keys_before)
+            delta = _metrics_delta(snap_before)
             if resid > 3.0:
                 fails.append(f"{name}: scaled_resid={resid:.3e} > 3")
                 print(json.dumps({"routine": name, "label": label,
                                   "error": "residual_gate",
                                   "scaled_resid": float(resid),
-                                  "autotune": _autotune_tags(keys_before),
-                                  "metrics": _metrics_snapshot()}),
+                                  "autotune": tags,
+                                  "metrics": delta}),
                       flush=True)
                 return None
             if len(out) > 3:   # auxiliary submetrics, gated like the rest
@@ -305,12 +370,18 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None):
             sub[label] = round(gf, 1)
             # flush this routine's line NOW: a later timeout/SIGTERM must
             # never lose a number already measured (BENCH_r05 lesson) —
-            # aux submetrics, the autotuner's chosen backends and the
-            # metrics snapshot ride along for the same reason
+            # aux submetrics, the autotuner's chosen backends, the
+            # metrics delta and the roofline attribution ride along for
+            # the same reason
             line = {"routine": name, "label": label,
                     "gflops": round(gf, 1), "scaled_resid": float(resid),
-                    "autotune": _autotune_tags(keys_before),
-                    "metrics": _metrics_snapshot()}
+                    "autotune": tags,
+                    "metrics": delta}
+            rep = _attribution(label, gf, delta, tags)
+            if rep is not None:
+                line["attribution"] = rep
+                if attr_sink is not None:
+                    attr_sink[label] = rep
             if len(out) > 3:
                 line.update(out[3])
             print(json.dumps(line), flush=True)
@@ -328,7 +399,7 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None):
     print(json.dumps({"routine": name,
                       "error": f"infra: {type(last_err).__name__}: {last_err}",
                       "autotune": _autotune_tags(keys_before),
-                      "metrics": _metrics_snapshot()}),
+                      "metrics": _metrics_delta(snap_before)}),
           flush=True)
     return None
 
@@ -359,11 +430,14 @@ def main():
 
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    global _PLATFORM
+    _PLATFORM = "tpu" if on_tpu else "cpu"
     scale = 1 if on_tpu else 8
     eps = float(np.finfo(np.float32).eps)
     sub = {}
     fails = []   # residual-gate failures → exit 1 (after printing JSON)
     infra = []   # infrastructure failures → recorded, exit stays 0
+    attr_map = {}   # label -> roofline attribution block (aggregate)
 
     # the bench run is an observability harness: turn the metrics
     # registry on (host-side counters only — it never changes the
@@ -387,7 +461,8 @@ def main():
                           "error": "infra: SIGTERM before completion"}),
               flush=True)
         print(json.dumps(_partial_aggregate(
-            sub, fails, infra + ["suite: SIGTERM"])), flush=True)
+            sub, fails, infra + ["suite: SIGTERM"],
+            attribution=attr_map)), flush=True)
         os._exit(0)
 
     if hasattr(signal, "SIGTERM"):
@@ -779,7 +854,8 @@ def main():
             per = remaining / max(1, len(routines) - i)
             deadline = max(MIN_DEADLINE_S, min(ROUTINE_TIMEOUT_S, per))
         results[name] = _run_routine(name, fn, sub, fails, infra,
-                                     deadline=deadline)
+                                     deadline=deadline,
+                                     attr_sink=attr_map)
     gemm_gf = results.get("gemm")
 
     # headline geomean: fp32 factor suite ONLY (the metric BENCH_r01-r03
@@ -833,6 +909,7 @@ def main():
         "fraction_of_measured_gemm": peak,
         "autotune": _autotune_tags(set()),   # full decision table
         "metrics": _metrics_snapshot(),      # full registry snapshot
+        "attribution": attr_map,             # per-routine gap reports
     }
     # regression tripwire (r4 lesson: geqrf silently lost 20% between
     # rounds): compare every submetric against the newest BENCH_r*.json

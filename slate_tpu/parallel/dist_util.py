@@ -107,6 +107,66 @@ def bcast_block_row(row_loc, gcols, own, N: int):
     return lax.psum(buf, (AXIS_P, AXIS_Q))
 
 
+def overlap_summary(n_devices: Optional[int] = None,
+                    compute_s: Optional[float] = None,
+                    platform: Optional[str] = None) -> dict:
+    """Per-device exposed-vs-overlapped collective accounting from the
+    registry's ``collective.bcast_*`` counters — the block the
+    MULTICHIP artifacts carry so ROADMAP item 3's scaling curve reads
+    per-device efficiency off the artifact instead of off Perfetto.
+
+    The byte totals are what the compiled step bodies recorded at trace
+    time (multiply by trip counts upstream if you profiled one body);
+    the time model prices them at the attribution engine's ICI peak
+    (``slate_tpu/perf/attr.py``, ``SLATE_TPU_PEAK_ICI_GBS``-
+    overridable).  ``compute_s`` is the overlap budget — the MXU work
+    the lookahead pipeline can hide collectives under; when omitted it
+    is taken from the registry's ``driver.*`` / ``step.*`` / ``chase.*``
+    timer totals, and with no such signal the collectives are
+    conservatively reported fully exposed (efficiency 0, not a flattering
+    guess)."""
+    from ..perf import attr
+
+    snap = metrics.snapshot()
+    counters = snap.get("counters", {})
+    nbytes = (counters.get("collective.bcast_col.bytes", 0.0)
+              + counters.get("collective.bcast_row.bytes", 0.0))
+    count = (counters.get("collective.bcast_col.count", 0.0)
+             + counters.get("collective.bcast_row.count", 0.0))
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    if platform is None:
+        platform = "tpu" if jax.default_backend() == "tpu" else "cpu"
+    pk = attr.peaks(platform, "fp32")
+    coll_s = nbytes / (pk["ici_gbs"] * 1e9) / max(1, n_devices)
+    if compute_s is None:
+        compute_s = sum(
+            t.get("total_s", 0.0) for k, t in snap.get("timers", {}).items()
+            if k.startswith(("driver.", "step.", "chase.")))
+    overlapped = min(coll_s, float(compute_s))
+    exposed = coll_s - overlapped
+    eff = (overlapped / coll_s) if coll_s > 0 else 1.0
+    nd = max(1, int(n_devices))
+    # SPMD collectives are synchronous: every device pays the same
+    # wall seconds; only the byte share divides across the mesh
+    per_device = [{"device": i,
+                   "collective_bytes": nbytes / nd,
+                   "overlapped_collective_s": overlapped,
+                   "exposed_collective_s": exposed,
+                   "overlap_efficiency": eff}
+                  for i in range(nd)]
+    return {"n_devices": nd,
+            "platform": platform,
+            "ici_gbs": pk["ici_gbs"],
+            "collective_count": count,
+            "collective_bytes": nbytes,
+            "collective_min_s": coll_s,
+            "overlapped_collective_s": overlapped,
+            "exposed_collective_s": exposed,
+            "overlap_efficiency": eff,
+            "per_device": per_device}
+
+
 def stage_bounds(nt: int, nstages: int = 4):
     """Split the ``nt`` factorization steps into up to ``nstages``
     contiguous runs.  Each run re-jits its loop body with a STATICALLY

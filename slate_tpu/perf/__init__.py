@@ -1,23 +1,27 @@
 """Performance helpers: lowered-HLO collective/flop profiling
 (:mod:`.hlo_profile`), the autotuned backend dispatch table
-(:mod:`.autotune`), the runtime metrics registry (:mod:`.metrics`) and
-the bench regression sentinel (:mod:`.regress`)."""
+(:mod:`.autotune`), the runtime metrics registry (:mod:`.metrics`),
+the bench regression sentinel (:mod:`.regress`) and the roofline
+attribution engine (:mod:`.attr`) that joins the analytical per-stage
+cost model with the measured metrics to say where the time went."""
 
 from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
-                          ModuleProfile, profile_fn, profile_hlo_text,
+                          ModuleProfile, collective_byte_census,
+                          profile_fn, profile_hlo_text,
                           stablehlo_collective_shapes)
 
 __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
-    "autotune", "metrics", "profile_fn", "profile_hlo_text", "regress",
+    "attr", "autotune", "collective_byte_census", "metrics",
+    "profile_fn", "profile_hlo_text", "regress",
     "stablehlo_collective_shapes",
 ]
 
 
 def __getattr__(name):
     # lazy: autotune pulls in jax.random/pallas bits only when used;
-    # metrics/regress stay stdlib-light and import on demand
-    if name in ("autotune", "metrics", "regress"):
+    # attr/metrics/regress stay stdlib-light and import on demand
+    if name in ("attr", "autotune", "metrics", "regress"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -1,0 +1,630 @@
+"""Roofline attribution: an analytical per-stage cost model joined with
+measured metrics — the layer that says *where* the time went.
+
+The observability stack so far can **measure** the factorization-vs-gemm
+gap (``step.<op>.<stage>`` timers, the HLO collective/flop census, the
+bench sentinel) but not **decompose** it: nobody could answer "is getrf
+at 13.6% of gemm because of panel latency, HBM round-trips, exposed
+collectives, or relayout?" without hand-reading a Perfetto trace.  This
+module closes that: for one driver invocation it
+
+1. derives an analytical flops/bytes model per tile-level stage
+   (``panel`` / ``trsm`` / ``update`` / ``pivot`` / ``chase`` /
+   ``collective``) from the *same inputs the autotune decision table
+   keys on* — shapes, nb, dtype, and the chosen backend/fusion depth
+   (Design-in-Tiles: the model is cheap to build from shapes alone);
+2. places every stage on the MXU/HBM roofline (per-platform peaks,
+   overridable via ``SLATE_TPU_PEAK_*`` env for new TPU generations)
+   and computes its achieved fraction;
+3. joins the measured ``step.*`` / ``stage.*`` timers and the
+   collective byte counters from a metrics snapshot when one is
+   available, and apportions the measured wall time across stages
+   (timer-weighted when timers exist, model-flop-weighted otherwise);
+4. emits a **gap report**: per-stage roofline placement plus a ranked
+   bottleneck list whose gap shares sum to the observed deficit
+   (1 − model_s/measured_s — the frac_of_gemm shortfall in seconds).
+
+Consumers: ``bench.py`` embeds one report per routine JSON line (the
+``attribution`` block next to ``metrics``); ``perf/regress.py`` diffs
+the blocks of two artifacts so the sentinel names the stage/backend
+whose share moved; ``tools/gap_report.py`` renders a block as a
+human-readable roofline table; :func:`record_rooflines` feeds
+``roofline.<label>.<stage>`` gauge samples to the metrics registry so
+``trace.finish_perfetto`` exports them as counter tracks on the
+existing clock.
+
+STDLIB-ONLY, like ``regress.py``: the offline tools load this module
+directly by file path on jax-free machines, so nothing here may import
+jax (or anything outside the standard library).  The one package-aware
+entry point, :func:`record_rooflines`, degrades to a no-op when the
+module was loaded standalone.
+
+Flop normalization contract: the per-stage discrete sums are scaled so
+they total EXACTLY the driver's model flop count (the count bench.py
+divides by — 2n³/3 for getrf, n³/3 for potrf, 2mn²−2n³/3 for geqrf,
+…).  That makes every report self-reconciling: stage-flop total ÷
+measured seconds reproduces the routine's reported GFLOP/s to float
+rounding, which CI pins at 1%.
+
+Join-key namespacing: measured stage timers are consumed ONLY under
+their namespaced ``step.<op>.<stage>`` / ``stage.<op>.<name>`` keys
+(:func:`stage_timers`); a bare ``step.<stage>`` or cross-op key can
+never collide into another routine's attribution (the r7 fix —
+``metrics.step_timer`` sanitizes dots out of op/stage for the same
+reason).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+__all__ = [
+    "DEFAULT_NB", "attribute", "expected_hbm_roundtrips", "explain_pair",
+    "format_report", "fusion_from_autotune", "model_flops", "parse_label",
+    "peaks", "record_rooflines", "stage_model", "stage_timers",
+]
+
+#: panel width assumed when the submetric label carries no ``nb`` token
+#: (the drivers' TPU default).
+DEFAULT_NB = 512
+
+#: trailing-strip width of the composed potrf driver
+#: (``blocks._potrf_strips``) — the bytes/round-trip model must count
+#: the same strips the driver materializes.
+_POTRF_STRIP_W = 2048
+
+_ITEMSIZE = {"fp32": 4, "bf16": 2, "fp64": 8, "c64": 8, "c128": 16}
+
+#: per-platform roofline constants.  The TPU fp32 peak is the measured
+#: LIBRARY gemm rate (~53.5 TF/s on v5e-class chips, BENCH_r03), i.e.
+#: the practical ceiling every factorization competes against — not the
+#: marketing bf16 number (that one anchors the bf16 row).  Override any
+#: of these for a new TPU generation with the ``SLATE_TPU_PEAK_*`` env
+#: knobs (see :func:`peaks`).
+_DEF_PEAKS = {
+    "tpu": {
+        "tflops": {"fp32": 55.0, "bf16": 110.0, "fp64": 6.5,
+                   "c64": 27.0, "c128": 3.2},
+        "hbm_gbs": 819.0,
+        "ici_gbs": 45.0,
+    },
+    "cpu": {
+        "tflops": {"fp32": 0.10, "bf16": 0.10, "fp64": 0.05,
+                   "c64": 0.05, "c128": 0.025},
+        "hbm_gbs": 20.0,
+        "ici_gbs": 10.0,
+    },
+}
+
+_LABEL_RE = re.compile(
+    r"^(?P<routine>[a-z0-9]+?)_(?P<dtype>fp32|fp64|bf16|c64|c128)_"
+    r"(?P<dims>.+)$")
+_DIM_RE = re.compile(r"^([a-z]+)([0-9]+)$")
+
+#: autotune op site whose decision is the routine's fusion depth
+#: (``composed`` | ``fused_trsm`` | ``fused``).
+_FUSION_OPS = {"getrf": "lu_step", "potrf": "potrf_step"}
+
+
+def _env_float(name: str):
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+def peaks(platform: str = "tpu", dtype: str = "fp32") -> dict:
+    """Roofline constants ``{"tflops", "hbm_gbs", "ici_gbs"}`` for one
+    (platform, dtype).  Env overrides, checked in this order:
+
+    * ``SLATE_TPU_PEAK_TFLOPS_<DTYPE>`` (e.g. ``_FP32``) then
+      ``SLATE_TPU_PEAK_TFLOPS`` — compute peak in TF/s;
+    * ``SLATE_TPU_PEAK_HBM_GBS`` — HBM bandwidth in GB/s;
+    * ``SLATE_TPU_PEAK_ICI_GBS`` — per-link ICI bandwidth in GB/s.
+    """
+    base = _DEF_PEAKS.get(platform) or _DEF_PEAKS["tpu"]
+    dtype = dtype or "fp32"
+    tf = base["tflops"].get(dtype, base["tflops"]["fp32"])
+    out = {"tflops": tf, "hbm_gbs": base["hbm_gbs"],
+           "ici_gbs": base["ici_gbs"]}
+    env_tf = _env_float("SLATE_TPU_PEAK_TFLOPS_" + dtype.upper())
+    if env_tf is None:
+        env_tf = _env_float("SLATE_TPU_PEAK_TFLOPS")
+    if env_tf is not None:
+        out["tflops"] = env_tf
+    env_bw = _env_float("SLATE_TPU_PEAK_HBM_GBS")
+    if env_bw is not None:
+        out["hbm_gbs"] = env_bw
+    env_ici = _env_float("SLATE_TPU_PEAK_ICI_GBS")
+    if env_ici is not None:
+        out["ici_gbs"] = env_ici
+    return out
+
+
+def parse_label(label: str):
+    """``getrf_fp32_n8192_nb512`` → ``("getrf", "fp32", {"n": 8192,
+    "nb": 512})``.  Labels that don't match the bench convention return
+    ``(label, "", {})``."""
+    m = _LABEL_RE.match(label or "")
+    if not m:
+        return (label, "", {})
+    dims = {}
+    for tok in m.group("dims").split("_"):
+        dm = _DIM_RE.match(tok)
+        if dm:
+            dims[dm.group(1)] = int(dm.group(2))
+    return (m.group("routine"), m.group("dtype"), dims)
+
+
+# ---------------------------------------------------------------------------
+# The analytical model
+# ---------------------------------------------------------------------------
+
+def model_flops(routine: str, dims: dict):
+    """The driver's model flop count — the figure ``bench.py`` divides
+    wall time by.  None for routines without a model."""
+    n = dims.get("n")
+    m = dims.get("m", n)
+    if not n or not m:
+        return None
+    k = min(m, n)
+    if routine in ("gemm", "mxu"):
+        kk = dims.get("k", k)
+        return 2.0 * m * n * kk
+    if routine == "potrf":
+        return n ** 3 / 3.0
+    if routine == "getrf":
+        # m·n·k − (m+n)k²/2 + k³/3 MACs ×2; = 2n³/3 for square
+        return 2.0 * (m * n * k - (m + n) * k * k / 2.0 + k ** 3 / 3.0)
+    if routine in ("geqrf", "gels"):
+        fl = 2.0 * max(m, n) * k * k - 2.0 * k ** 3 / 3.0
+        if routine == "gels":
+            fl += 4.0 * m * n
+        return fl
+    if routine == "heev":
+        return 4.0 * n ** 3 / 3.0
+    if routine == "svd":
+        return 8.0 * n ** 3 / 3.0
+    return None
+
+
+def _acc(stages, name, f, b):
+    st = stages.setdefault(name, [0.0, 0.0])
+    st[0] += f
+    st[1] += b
+
+
+_RT_PER_STEP_GETRF = {"composed": 3.0, "fused_trsm": 1.0, "fused": 0.0}
+
+
+def _stages_getrf(m, n, nb, isz, fusion):
+    stages, rts = {}, 0.0
+    per_step = _RT_PER_STEP_GETRF.get(fusion, 3.0)
+    k = min(m, n)
+    for k0 in range(0, k, nb):
+        w = min(nb, k - k0)
+        rows = m - k0
+        r = n - k0 - w
+        _acc(stages, "panel", 2.0 * w * w * (rows - w / 3.0),
+             2.0 * rows * w * isz)
+        _acc(stages, "pivot", 0.0, 2.0 * w * n * isz)
+        if r > 0:
+            _acc(stages, "trsm", 2.0 * w * w * r,
+                 (2.0 * w * r + w * w) * isz)
+            _acc(stages, "update", 2.0 * (rows - w) * w * r,
+                 (2.0 * (rows - w) * r + (rows - w) * w + w * r) * isz)
+            rts += per_step
+    return stages, rts
+
+
+def _stages_potrf(n, nb, isz, fusion):
+    stages, rts = {}, 0.0
+    ws = nb * max(1, _POTRF_STRIP_W // nb)
+    for k0 in range(0, n, nb):
+        w = min(nb, n - k0)
+        r = n - k0 - w
+        # panel = diagonal chol + explicit inverse (the trsm-as-gemm
+        # enabler), each ~w³/3
+        _acc(stages, "panel", 2.0 * w ** 3 / 3.0, 2.0 * w * w * isz)
+        if r > 0:
+            _acc(stages, "trsm", 2.0 * r * w * w,
+                 (2.0 * r * w + w * w) * isz)
+            _acc(stages, "update", float(r) * (r + w) * w,
+                 (float(r) * r + r * w) * isz)
+            if fusion not in ("fused", "fused_trsm"):
+                rts += 1.0 + len(range(k0 + w, n, ws))
+    return stages, rts
+
+
+def _stages_geqrf(m, n, nb, isz, with_solve):
+    stages, rts = {}, 0.0
+    k = min(m, n)
+    for k0 in range(0, k, nb):
+        w = min(nb, k - k0)
+        rows = m - k0
+        r = n - k0 - w
+        _acc(stages, "panel", 2.0 * w * w * (rows - w / 3.0),
+             2.0 * rows * w * isz)
+        if r > 0:
+            _acc(stages, "update", 4.0 * w * rows * r,
+                 (2.0 * rows * r + rows * w) * isz)
+    if with_solve:
+        _acc(stages, "solve", 4.0 * m * n, (m * n + m + n) * isz)
+    return stages, rts
+
+
+#: coarse flop shares of the two-stage eig/SVD pipelines (band
+#: reduction / device bulge chase / back-transform).  The chase carries
+#: ~no flops but sweeps the band through HBM once per panel — its cost
+#: is the bytes term.
+_TWOSTAGE_SHARES = {"stage1": 0.55, "chase": 0.05, "stage3": 0.40}
+_TWOSTAGE_BAND = 256
+
+
+def _stages_twostage(n, isz, total):
+    stages = {}
+    sweeps = max(1, n // _TWOSTAGE_BAND)
+    _acc(stages, "stage1", _TWOSTAGE_SHARES["stage1"] * total,
+         (2.0 / 3.0) * sweeps * n * n * isz)
+    _acc(stages, "chase", _TWOSTAGE_SHARES["chase"] * total,
+         2.0 * n * n * isz)
+    _acc(stages, "stage3", _TWOSTAGE_SHARES["stage3"] * total,
+         2.0 * n * n * isz)
+    return stages, 0.0
+
+
+#: stage order for reports (model dicts are unordered)
+_STAGE_ORDER = ("panel", "pivot", "trsm", "update", "solve",
+                "stage1", "chase", "stage3", "mxu", "collective")
+
+
+def stage_model(routine: str, dims: dict, dtype: str = "fp32",
+                fusion: str = "composed"):
+    """``(stages, hbm_roundtrips)`` for one routine invocation, or None
+    when no model exists.  ``stages`` is ``[{"stage", "flops",
+    "bytes"}]`` in pipeline order with the flops NORMALIZED so they sum
+    exactly to :func:`model_flops` (the self-reconciliation contract);
+    ``hbm_roundtrips`` is the materialized inter-stage intermediate
+    count the composed drivers record on ``step.hbm_roundtrips`` (0 on
+    the fused paths — the CI pin)."""
+    total = model_flops(routine, dims)
+    if total is None or total <= 0:
+        return None
+    isz = _ITEMSIZE.get(dtype or "fp32", 4)
+    n = dims.get("n")
+    m = dims.get("m", n)
+    nb = min(dims.get("nb") or DEFAULT_NB, min(m, n))
+    if routine in ("gemm", "mxu"):
+        k = dims.get("k", min(m, n))
+        raw = {"mxu": [2.0 * m * n * k,
+                       (m * k + k * n + 2.0 * m * n) * isz]}
+        rts = 0.0
+    elif routine == "getrf":
+        raw, rts = _stages_getrf(m, n, nb, isz, fusion)
+    elif routine == "potrf":
+        raw, rts = _stages_potrf(n, nb, isz, fusion)
+    elif routine in ("geqrf", "gels"):
+        raw, rts = _stages_geqrf(m, n, nb, isz, routine == "gels")
+    elif routine in ("heev", "svd"):
+        raw, rts = _stages_twostage(n, isz, total)
+    else:
+        return None
+    raw_total = sum(f for f, _ in raw.values())
+    scale = total / raw_total if raw_total > 0 else 1.0
+    stages = [{"stage": s, "flops": raw[s][0] * scale,
+               "bytes": raw[s][1]}
+              for s in _STAGE_ORDER if s in raw]
+    return stages, rts
+
+
+def expected_hbm_roundtrips(routine: str, dims: dict,
+                            fusion: str = "composed"):
+    """The analytic ``step.hbm_roundtrips`` count for one invocation —
+    must agree with what the composed drivers record at trace time
+    (regression-tested against the live counter)."""
+    model = stage_model(routine, dims, fusion=fusion)
+    return model[1] if model else None
+
+
+def fusion_from_autotune(routine: str, autotune) -> str:
+    """The fusion depth this routine actually ran at, read off its
+    autotune decision tags (the ``lu_step`` / ``potrf_step`` sites);
+    ``"composed"`` when untagged."""
+    op = _FUSION_OPS.get(routine)
+    if op and isinstance(autotune, dict):
+        for key, val in autotune.items():
+            if isinstance(key, str) and key.startswith(op + "|") \
+                    and isinstance(val, str):
+                return val
+    return "composed"
+
+
+# ---------------------------------------------------------------------------
+# Measured-timer join
+# ---------------------------------------------------------------------------
+
+def stage_timers(metrics_snapshot, op: str) -> dict:
+    """Measured per-stage timers for ``op`` out of a metrics snapshot:
+    ``{stage: {"count", "total_s"}}``.
+
+    Joins ONLY the namespaced keys ``step.<op>.<stage>`` and
+    ``stage.<op>.<name>`` — a bare two-segment ``step.<stage>`` key or
+    another op's timers can never collide into this op's attribution,
+    so the count/total distinction of each (op, stage) pair survives
+    two ops firing the same stage name in one routine."""
+    out = {}
+    timers = (metrics_snapshot or {}).get("timers") or {}
+    for key, t in timers.items():
+        parts = key.split(".")
+        if len(parts) != 3 or parts[0] not in ("step", "stage") \
+                or parts[1] != op:
+            continue
+        if not isinstance(t, dict):
+            continue
+        out[parts[2]] = {"count": t.get("count", 0),
+                         "total_s": float(t.get("total_s", 0.0))}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The attribution engine
+# ---------------------------------------------------------------------------
+
+def _r(x, nd=9):
+    # ns resolution on the seconds fields: small-shape reports (CPU CI)
+    # must still reconcile stage flops against GFLOP/s to well under 1%
+    return round(float(x), nd)
+
+
+def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
+              platform: str = "tpu", n_devices: int = 1,
+              collective_bytes=None) -> dict | None:
+    """The gap report for one routine invocation, or None when the
+    label has no model (derived ``_s`` / ``_frac_of_gemm`` keys, zero
+    throughput, unknown routines).
+
+    Inputs are exactly what a bench JSON line carries: the submetric
+    label, its GFLOP/s, the routine's metrics snapshot (ideally the
+    per-routine DELTA — r7 bench), and its autotune tags.  On mesh runs
+    pass ``n_devices`` and either ``collective_bytes`` or a snapshot
+    carrying the ``collective.bcast_*.bytes`` counters.
+    """
+    if label.endswith("_s") or label.endswith("_frac_of_gemm"):
+        return None
+    if not isinstance(gflops, (int, float)) or gflops <= 0:
+        return None
+    routine, dtype, dims = parse_label(label)
+    fusion = fusion_from_autotune(routine, autotune)
+    model = stage_model(routine, dims, dtype, fusion)
+    if model is None:
+        return None
+    stage_fb, model_rts = model
+    pk = peaks(platform, dtype)
+    total_flops = sum(s["flops"] for s in stage_fb)
+    measured_s = total_flops / (float(gflops) * 1e9)
+
+    counters = (metrics_snapshot or {}).get("counters") or {}
+    if collective_bytes is None:
+        collective_bytes = (counters.get("collective.bcast_col.bytes", 0.0)
+                            + counters.get("collective.bcast_row.bytes",
+                                           0.0))
+
+    stages = []
+    for s in stage_fb:
+        t_mxu = s["flops"] / (pk["tflops"] * 1e12)
+        t_hbm = s["bytes"] / (pk["hbm_gbs"] * 1e9)
+        stages.append({"stage": s["stage"], "flops": s["flops"],
+                       "bytes": s["bytes"],
+                       "bound": "mxu" if t_mxu >= t_hbm else "hbm",
+                       "min_s": max(t_mxu, t_hbm)})
+
+    collective = None
+    if collective_bytes and collective_bytes > 0:
+        coll_s = (float(collective_bytes)
+                  / (pk["ici_gbs"] * 1e9) / max(1, int(n_devices)))
+        # the lookahead pipeline overlaps the panel broadcast with the
+        # trailing update: overlap budget = the update stage's roofline
+        # minimum; anything past it is exposed on the critical path
+        budget = sum(s["min_s"] for s in stages if s["stage"] == "update")
+        overlapped = min(coll_s, budget)
+        exposed = coll_s - overlapped
+        stages.append({"stage": "collective", "flops": 0.0,
+                       "bytes": float(collective_bytes), "bound": "ici",
+                       "min_s": exposed})
+        collective = {"bytes": float(collective_bytes),
+                      "min_s": _r(coll_s),
+                      "overlapped_s": _r(overlapped),
+                      "exposed_s": _r(exposed)}
+
+    model_s = sum(s["min_s"] for s in stages)
+    gap_s = measured_s - model_s
+
+    # apportion the measured wall time across stages: timer-weighted
+    # when namespaced stage timers exist, model-flop-weighted otherwise
+    timers = stage_timers(metrics_snapshot, routine)
+    if routine in ("heev", "svd") and "stage2" in timers \
+            and "chase" not in timers:
+        # the drivers record the two-stage middle as stage.<op>.stage2;
+        # the model calls that stage "chase" — without the alias the
+        # measured middle-stage time would silently redistribute onto
+        # stage1/stage3 and a chase regression would be misattributed
+        timers["chase"] = timers.pop("stage2")
+    timed = {s["stage"]: timers[s["stage"]]["total_s"] for s in stages
+             if s["stage"] in timers
+             and timers[s["stage"]]["total_s"] > 0.0}
+    if timed:
+        source = "timers"
+        untimed_min = sum(s["min_s"] for s in stages
+                          if s["stage"] not in timed)
+        leftover = max(measured_s - untimed_min, 0.0)
+        tot_t = sum(timed.values())
+        for s in stages:
+            s["measured_s"] = (leftover * timed[s["stage"]] / tot_t
+                               if s["stage"] in timed else s["min_s"])
+    else:
+        source = "model"
+        pos_gap = max(gap_s, 0.0)
+        flops_tot = sum(s["flops"] for s in stages)
+        for s in stages:
+            w = (s["flops"] / flops_tot if flops_tot > 0
+                 else 1.0 / len(stages))
+            s["measured_s"] = s["min_s"] + pos_gap * w
+
+    for s in stages:
+        g = max(s["measured_s"] - s["min_s"], 0.0)
+        s["gap_s"] = _r(g)
+        s["gap_share"] = _r(g / measured_s if measured_s > 0 else 0.0, 4)
+        s["roofline_frac"] = _r(
+            min(s["min_s"] / s["measured_s"], 1.0)
+            if s["measured_s"] > 0 else 1.0, 4)
+        s["min_s"] = _r(s["min_s"])
+        s["measured_s"] = _r(s["measured_s"])
+        s["flops"] = float(s["flops"])
+        s["bytes"] = float(s["bytes"])
+
+    bottlenecks = [{"stage": s["stage"], "gap_s": s["gap_s"],
+                    "gap_share": s["gap_share"]}
+                   for s in sorted(stages, key=lambda s: -s["gap_s"])
+                   if s["gap_s"] > 0]
+
+    report = {
+        "label": label,
+        "routine": routine,
+        "dtype": dtype,
+        "dims": dims,
+        "platform": platform,
+        "fusion": fusion,
+        "backend_source": source,
+        "peaks": {k: _r(v, 3) for k, v in pk.items()},
+        "gflops": float(gflops),
+        "total_flops": float(total_flops),
+        "measured_s": _r(measured_s),
+        "model_s": _r(model_s),
+        "gap_s": _r(gap_s),
+        "achieved_frac": _r(min(model_s / measured_s, 1.0)
+                            if measured_s > 0 else 1.0, 4),
+        "frac_of_peak": _r(total_flops / measured_s
+                           / (pk["tflops"] * 1e12)
+                           if measured_s > 0 else 0.0, 4),
+        "stages": stages,
+        "bottlenecks": bottlenecks,
+        "hbm_roundtrips": {
+            "model": float(model_rts),
+            "measured": counters.get("step.hbm_roundtrips"),
+        },
+        "n_devices": int(n_devices),
+    }
+    if collective is not None:
+        report["collective"] = collective
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Diff / rendering
+# ---------------------------------------------------------------------------
+
+def explain_pair(old: dict, new: dict, delta_pct=None,
+                 note: str = "") -> str:
+    """One sentinel line naming the stage whose share of the wall time
+    moved most between two gap reports of the same routine — e.g.
+    ``geqrf_fp32_m32768_n4096 -19.6%: update stage roofline fraction
+    0.43->0.34 (gap share 0.50->0.58)``.  ``note`` (the sentinel's
+    backend-change note) rides along when present."""
+    olds = {s["stage"]: s for s in old.get("stages", ())}
+    best, best_score = None, None
+    for s in new.get("stages", ()):
+        o = olds.get(s["stage"])
+        if o is None:
+            continue
+        score = s["gap_share"] - o["gap_share"]
+        if best_score is None or score > best_score:
+            best, best_score = (o, s), score
+    label = new.get("label", old.get("label", "?"))
+    head = label
+    if delta_pct is not None:
+        head += " %+.1f%%" % delta_pct
+    if best is None:
+        line = "%s: no comparable stages" % head
+    else:
+        o, s = best
+        line = ("%s: %s stage roofline fraction %.2f->%.2f "
+                "(gap share %.2f->%.2f)"
+                % (head, s["stage"], o["roofline_frac"],
+                   s["roofline_frac"], o["gap_share"], s["gap_share"]))
+    if note:
+        line += "; " + note
+    return line
+
+
+def _eng(x: float) -> str:
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(x) >= div:
+            return "%.2f%s" % (x / div, unit)
+    return "%.0f" % x
+
+
+def format_report(rep: dict) -> str:
+    """Human-readable roofline table for one gap report (the
+    ``tools/gap_report.py`` rendering)."""
+    pk = rep["peaks"]
+    head = [
+        "%s  [%s %s, fusion=%s, attribution=%s]"
+        % (rep["label"], rep["platform"], rep["dtype"] or "?",
+           rep["fusion"], rep["backend_source"]),
+        "  achieved %.1f GFLOP/s = %.3f of %.1f TF/s peak "
+        "(HBM %.0f GB/s); measured %.2f ms, roofline-min %.2f ms, "
+        "gap %.2f ms"
+        % (rep["gflops"], rep["frac_of_peak"], pk["tflops"],
+           pk["hbm_gbs"], rep["measured_s"] * 1e3, rep["model_s"] * 1e3,
+           rep["gap_s"] * 1e3),
+    ]
+    rows = [("stage", "flops", "bytes", "bound", "min_ms", "est_ms",
+             "frac", "gap_ms", "gap%")]
+    for s in rep["stages"]:
+        rows.append((s["stage"], _eng(s["flops"]), _eng(s["bytes"]),
+                     s["bound"], "%.3f" % (s["min_s"] * 1e3),
+                     "%.3f" % (s["measured_s"] * 1e3),
+                     "%.2f" % s["roofline_frac"],
+                     "%.3f" % (s["gap_s"] * 1e3),
+                     "%.1f" % (s["gap_share"] * 100.0)))
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    body = ["  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip()
+            for r in rows]
+    tail = []
+    if rep.get("bottlenecks"):
+        tail.append("  bottlenecks: " + ", ".join(
+            "%s (%.0f%% of time)" % (b["stage"], b["gap_share"] * 100.0)
+            for b in rep["bottlenecks"]))
+    if rep.get("collective"):
+        c = rep["collective"]
+        tail.append("  collectives: %sB, %.2f ms (%.2f overlapped, "
+                    "%.2f exposed)"
+                    % (_eng(c["bytes"]), c["min_s"] * 1e3,
+                       c["overlapped_s"] * 1e3, c["exposed_s"] * 1e3))
+    rt = rep.get("hbm_roundtrips") or {}
+    if rt.get("model") or rt.get("measured"):
+        tail.append("  hbm round-trips: model %s, measured %s"
+                    % (rt.get("model"), rt.get("measured")))
+    return "\n".join(head + body + tail)
+
+
+def record_rooflines(rep: dict) -> bool:
+    """Feed ``roofline.<label>.<stage>`` gauge samples into the metrics
+    registry so ``trace.finish_perfetto`` exports per-stage roofline
+    fractions as counter tracks on the existing clock.  No-op (returns
+    False) when this module was loaded standalone by file path — the
+    offline tools have no registry to feed."""
+    try:
+        from . import metrics
+    except ImportError:
+        return False
+    if not metrics.enabled():
+        return False
+    for s in rep.get("stages", ()):
+        metrics.set_gauge("roofline.%s.%s" % (rep["label"], s["stage"]),
+                          float(s["roofline_frac"]))
+    return True

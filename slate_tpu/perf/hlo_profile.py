@@ -256,6 +256,40 @@ def _tally(name, comps, cache):
     return prof
 
 
+def collective_byte_census(profile: ModuleProfile, trip_counts=None):
+    """``{"count", "bytes", "by_kind"}`` over a whole run — the compiled
+    module's collective traffic in the exact shape the attribution
+    engine (``perf/attr.py``) joins as its ``collective_bytes`` input.
+
+    With ``trip_counts`` (one per communicating while body, e.g. from
+    ``stage_bounds``) each loop's tallies are multiplied out the same
+    way :meth:`ModuleProfile.stepped_totals` does; without them every
+    collective is counted once (loop-free programs, or a lower bound
+    for stepped ones)."""
+    by_kind: dict = {}
+    count = 0
+
+    def add(ops, mult=1):
+        nonlocal count
+        for op in ops:
+            count += mult
+            by_kind[op.kind] = by_kind.get(op.kind, 0) + mult * op.bytes
+
+    if trip_counts is None:
+        add(profile.all_collectives)
+    else:
+        bodies = profile.step_loops
+        if len(trip_counts) != len(bodies):
+            raise ValueError(
+                f"{len(bodies)} loop bodies but {len(trip_counts)} "
+                "trip counts")
+        add(profile.entry.collectives)
+        for trips, body in zip(trip_counts, bodies):
+            add(body.collectives, trips)
+    return {"count": count, "bytes": sum(by_kind.values()),
+            "by_kind": by_kind}
+
+
 def profile_hlo_text(hlo_text: str) -> ModuleProfile:
     """Parse compiled (post-optimization) HLO text into a
     :class:`ModuleProfile`."""

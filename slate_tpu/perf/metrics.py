@@ -67,11 +67,11 @@ import warnings
 
 __all__ = [
     "enabled", "on", "off", "reset", "inc", "set_gauge", "observe",
-    "timer", "observe_time", "snapshot", "counter_series",
-    "drain_samples", "instrument_driver", "check_finite_wanted",
-    "device_metrics_wanted", "record_fallback_outcome", "pallas_census",
-    "install_compile_watch", "step_timer", "count_hbm_roundtrips",
-    "STEP_HBM_ROUNDTRIPS",
+    "timer", "observe_time", "snapshot", "snapshot_delta",
+    "counter_series", "drain_samples", "instrument_driver",
+    "check_finite_wanted", "device_metrics_wanted",
+    "record_fallback_outcome", "pallas_census", "install_compile_watch",
+    "step_timer", "count_hbm_roundtrips", "STEP_HBM_ROUNDTRIPS",
 ]
 
 _ENV = "SLATE_TPU_METRICS"
@@ -215,8 +215,18 @@ def step_timer(op: str, stage: str) -> _Timer:
     composed paths, ``fused`` when one kernel owns the whole step).
     Recorded at trace/dispatch time — under jit this attributes Python
     composition cost and, on the bench's per-routine lines, lets a diff
-    say WHICH stage composition a getrf/potrf move came from."""
-    return _Timer("step.%s.%s" % (op, stage))
+    say WHICH stage composition a getrf/potrf move came from.
+
+    The key is the JOIN KEY the attribution engine
+    (``slate_tpu/perf/attr.py``) consumes, so it must stay unambiguous
+    under splitting on ``"."``: dots inside ``op`` or ``stage`` are
+    sanitized to underscores.  Without this, an op named ``"a.b"``
+    firing stage ``"update"`` would parse as op ``a`` stage ``b`` and
+    collide its count/total into another routine's stage — two ops
+    firing the same stage name in one routine must keep distinct
+    timers (regression-tested in ``tests/test_metrics.py``)."""
+    return _Timer("step.%s.%s" % (op.replace(".", "_"),
+                                  stage.replace(".", "_")))
 
 
 def count_hbm_roundtrips(n: float = 1.0) -> None:
@@ -267,6 +277,57 @@ def snapshot() -> dict:
                           "buckets": dict(h["buckets"])}
                       for k, h in reg.hists.items()},
         }
+
+
+def snapshot_delta(before: dict, after: dict) -> dict:
+    """What happened BETWEEN two :func:`snapshot` calls — the
+    self-contained per-routine block ``bench.py`` embeds in each JSON
+    line (the registry accumulates across the whole process, so a raw
+    snapshot on a late routine's line would carry every earlier
+    routine's counters/timers).
+
+    * counters/gauges: entries whose value changed (counters as the
+      numeric difference, gauges at their new value);
+    * timers: count/total differences for timers that fired in the
+      window (``min_s``/``max_s`` are process-lifetime bounds and
+      cannot be diffed — they are carried from ``after`` and marked by
+      the window's ``"delta": true`` flag);
+    * hists: count/total/bucket differences for histograms that grew.
+    """
+    b_c = before.get("counters", {}) or {}
+    a_c = after.get("counters", {}) or {}
+    counters = {k: v - b_c.get(k, 0.0) for k, v in a_c.items()
+                if v != b_c.get(k, 0.0)}
+    b_g = before.get("gauges", {}) or {}
+    gauges = {k: v for k, v in (after.get("gauges", {}) or {}).items()
+              if k not in b_g or v != b_g[k]}
+    b_t = before.get("timers", {}) or {}
+    timers = {}
+    for k, t in (after.get("timers", {}) or {}).items():
+        prev = b_t.get(k, {})
+        dc = t.get("count", 0) - prev.get("count", 0)
+        if dc <= 0:
+            continue
+        timers[k] = {"count": dc,
+                     "total_s": t.get("total_s", 0.0)
+                     - prev.get("total_s", 0.0),
+                     "min_s": t.get("min_s"), "max_s": t.get("max_s")}
+    b_h = before.get("hists", {}) or {}
+    hists = {}
+    for k, h in (after.get("hists", {}) or {}).items():
+        prev = b_h.get(k, {})
+        dc = h.get("count", 0) - prev.get("count", 0)
+        if dc <= 0:
+            continue
+        pb = prev.get("buckets", {}) or {}
+        hists[k] = {"count": dc,
+                    "total": h.get("total", 0.0) - prev.get("total", 0.0),
+                    "buckets": {bk: bv - pb.get(bk, 0)
+                                for bk, bv in h.get("buckets", {}).items()
+                                if bv != pb.get(bk, 0)}}
+    return {"enabled": after.get("enabled", False), "delta": True,
+            "counters": counters, "gauges": gauges, "timers": timers,
+            "hists": hists}
 
 
 def counter_series() -> list:

@@ -32,6 +32,15 @@ per-stage wall-time keys bench emits for the two-stage eig/SVD
 pipelines (suffix ``_s``, e.g. ``heev_fp64_n1024_stage2_chase_s``) —
 those are seconds, LOWER is better, and the verdict logic inverts the
 sign so a faster stage reads IMPROVE, not REGRESS.
+
+Gap explanation (r7): when the sentinel flags a drop, :func:`explain`
+diffs the two artifacts' roofline attribution blocks (bench r7 embeds
+them; older artifacts get the analytical model derived on the spot from
+the submetric label + autotune tags via ``attr.py``) and names the
+stage whose share of the wall time moved — the r3→r4 geqrf
+investigation as one line of sentinel output instead of a STATUS round.
+``tools/bench_diff.py --explain`` prints these lines under the verdict
+table.
 """
 
 from __future__ import annotations
@@ -42,8 +51,8 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = [
-    "Artifact", "Report", "Row", "load_artifact", "diff", "format_table",
-    "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
+    "Artifact", "Report", "Row", "load_artifact", "diff", "explain",
+    "format_table", "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
 ]
 
 #: flag a drop bigger than this (percent) between consecutive artifacts
@@ -85,6 +94,7 @@ class Artifact:
     aggregate: Optional[dict] = None
     submetrics: dict = field(default_factory=dict)
     autotune: dict = field(default_factory=dict)
+    attribution: dict = field(default_factory=dict)
     infra: List[str] = field(default_factory=list)
 
     @property
@@ -164,6 +174,10 @@ def load_artifact(path: str) -> "Artifact":
     art.submetrics = dict(subs) if isinstance(subs, dict) else {}
     at = agg.get("autotune")
     art.autotune = dict(at) if isinstance(at, dict) else {}
+    ab = agg.get("attribution")
+    art.attribution = {k: v for k, v in ab.items()
+                       if isinstance(v, dict)} \
+        if isinstance(ab, dict) else {}
     if not art.submetrics:
         art.infra.append("no parsed routines")
     if agg.get("partial"):
@@ -328,3 +342,76 @@ def format_table(report: Report) -> str:
     out.append("verdict: %s"
                % ("FAIL" if report.exit_code else "PASS"))
     return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# Roofline attribution diff — the sentinel's gap EXPLANATION
+# ---------------------------------------------------------------------------
+
+def _attr_mod():
+    """The attribution engine (``perf/attr.py``).  This module runs in
+    two lives — imported as ``slate_tpu.perf.regress`` (tests) and
+    exec'd by file path from ``tools/bench_diff.py`` on jax-free
+    machines — so the sibling is loaded the same way when the package
+    context is absent."""
+    try:
+        from . import attr
+        return attr
+    except ImportError:
+        import importlib.util
+        import os
+        import sys
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "attr.py")
+        name = "_slate_tpu_attr"
+        if name in sys.modules:
+            return sys.modules[name]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
+def attribution_for(artifact: Artifact, label: str):
+    """The artifact's gap report for one routine: the embedded
+    ``attribution`` block when the artifact carries one (bench r7+),
+    else derived analytically from the submetric label, its GFLOP/s and
+    the autotune tags — so pre-r7 artifacts (r03/r04) explain too."""
+    blk = artifact.attribution.get(label)
+    if isinstance(blk, dict) and blk.get("stages"):
+        return blk
+    gf = artifact.submetrics.get(label)
+    try:
+        return _attr_mod().attribute(label, gf,
+                                     autotune=artifact.autotune or None)
+    except Exception:
+        return None
+
+
+def explain(report: Report) -> List[str]:
+    """One line per REGRESS row naming the stage whose share of the
+    wall time moved between the first and last artifacts that carry the
+    routine (plus the backend-change note when the autotune tag moved).
+    Empty when nothing regressed."""
+    attr = _attr_mod()
+    lines = []
+    for row in report.regressions:
+        present = [a for a, v in zip(report.artifacts, row.values)
+                   if v is not None]
+        if len(present) < 2:
+            continue
+        old = attribution_for(present[0], row.label)
+        new = attribution_for(present[-1], row.label)
+        if not old or not new:
+            lines.append("%s: no attribution model for this routine"
+                         % row.label)
+            continue
+        try:
+            lines.append(attr.explain_pair(old, new,
+                                           delta_pct=row.delta_pct,
+                                           note=row.note))
+        except Exception as e:    # an explanation must never mask the verdict
+            lines.append("%s: attribution diff failed: %s"
+                         % (row.label, e))
+    return lines

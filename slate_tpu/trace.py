@@ -188,7 +188,12 @@ def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
     * the metrics registry's counter samples
       (:func:`slate_tpu.perf.metrics.counter_series`) as counter tracks
       (``"ph": "C"``) — autotune decisions, driver calls, collective
-      bytes line up under the spans that caused them.
+      bytes line up under the spans that caused them.  Samples named
+      ``roofline.<label>.<stage>`` (the attribution engine's per-stage
+      achieved roofline fractions, fed by
+      :func:`slate_tpu.perf.attr.record_rooflines`) get their own
+      ``"roofline"`` category so Perfetto's track filter isolates the
+      gap-report view with one query.
 
     Returns the file path (``trace_<epoch>.perfetto.json`` by default)
     or None when there is nothing to export.  Consumes both the event
@@ -232,7 +237,8 @@ def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
                     "dur": round(max(e.stop - e.start, 0.0) * 1e6, 3),
                     "pid": 0, "tid": tids[e.lane]})
     for ts, name, value in samples:
-        out.append({"name": name, "cat": "metrics", "ph": "C",
+        cat = "roofline" if name.startswith("roofline.") else "metrics"
+        out.append({"name": name, "cat": cat, "ph": "C",
                     "ts": round((ts - origin) * 1e6, 3),
                     "pid": 0, "args": {"value": value}})
     path = path or f"trace_{int(time.time())}.perfetto.json"

@@ -1,0 +1,419 @@
+"""Roofline attribution engine (slate_tpu/perf/attr.py): the stage
+flop/byte model's conservation properties, the round-trip model against
+the live ``step.hbm_roundtrips`` counter, the measured-timer join (and
+its namespaced-key collision regression), the report's
+self-reconciliation with the routine's GFLOP/s, and the sentinel's
+golden canned-artifact explanation."""
+
+import json
+import math
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from slate_tpu.perf import attr, metrics, regress
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    metrics.off()
+    metrics.reset()
+    yield
+    metrics.off()
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Property: stage flops sum to the driver's model flop count
+# ---------------------------------------------------------------------------
+
+_SHAPES = [
+    ("getrf", {"m": 256, "n": 256, "nb": 32}),
+    ("getrf", {"m": 512, "n": 512, "nb": 128}),
+    ("getrf", {"m": 8192, "n": 8192, "nb": 512}),
+    ("getrf", {"m": 384, "n": 256, "nb": 64}),
+    ("potrf", {"n": 256, "nb": 64}),
+    ("potrf", {"n": 8192, "nb": 512}),
+    ("potrf", {"n": 1024, "nb": 128}),
+    ("geqrf", {"m": 32768, "n": 4096, "nb": 512}),
+    ("geqrf", {"m": 512, "n": 256, "nb": 64}),
+    ("geqrf", {"m": 256, "n": 256, "nb": 128}),
+    ("gels", {"m": 32768, "n": 4096}),
+    ("gemm", {"n": 8192}),
+    ("heev", {"n": 8192}),
+    ("svd", {"n": 1024}),
+]
+
+
+@pytest.mark.parametrize("routine,dims",
+                         _SHAPES, ids=[f"{r}-{d}" for r, d in _SHAPES])
+def test_stage_flops_sum_to_model_count(routine, dims):
+    stages, _ = attr.stage_model(routine, dims)
+    total = sum(s["flops"] for s in stages)
+    assert math.isclose(total, attr.model_flops(routine, dims),
+                        rel_tol=1e-9)
+    assert all(s["flops"] >= 0 and s["bytes"] >= 0 for s in stages)
+
+
+def test_model_flop_counts_match_bench_conventions():
+    # the counts bench.py divides wall time by
+    n = 8192
+    assert attr.model_flops("getrf", {"n": n}) == \
+        pytest.approx(2.0 * n ** 3 / 3.0)
+    assert attr.model_flops("potrf", {"n": n}) == \
+        pytest.approx(n ** 3 / 3.0)
+    m2, n2 = 32768, 4096
+    assert attr.model_flops("geqrf", {"m": m2, "n": n2}) == \
+        pytest.approx(2.0 * m2 * n2 ** 2 - 2.0 * n2 ** 3 / 3.0)
+    assert attr.model_flops("gels", {"m": m2, "n": n2}) == \
+        pytest.approx(2.0 * m2 * n2 ** 2 - 2.0 * n2 ** 3 / 3.0
+                      + 4.0 * m2 * n2)
+    assert attr.model_flops("gemm", {"n": n}) == pytest.approx(2.0 * n ** 3)
+
+
+def test_label_parsing():
+    assert attr.parse_label("getrf_fp32_n8192_nb512") == \
+        ("getrf", "fp32", {"n": 8192, "nb": 512})
+    assert attr.parse_label("geqrf_fp32_m32768_n4096") == \
+        ("geqrf", "fp32", {"m": 32768, "n": 4096})
+    assert attr.parse_label("not-a-bench-label") == \
+        ("not-a-bench-label", "", {})
+
+
+# ---------------------------------------------------------------------------
+# Bytes/round-trip model vs the live step.hbm_roundtrips counter
+# ---------------------------------------------------------------------------
+
+def _live_roundtrips(fn, *args):
+    metrics.reset()
+    metrics.on()
+    jax.make_jaxpr(fn)(*args)   # trace-time counters fire here
+    snap = metrics.snapshot()["counters"]
+    return snap.get(metrics.STEP_HBM_ROUNDTRIPS, 0.0)
+
+
+@pytest.mark.parametrize("n,nb", [(256, 128), (384, 128)])
+@pytest.mark.parametrize("fusion", ["composed", "fused_trsm", "fused"])
+def test_getrf_roundtrip_model_matches_counter(n, nb, fusion):
+    from slate_tpu.linalg.lu import getrf_scattered
+
+    a = jnp.zeros((n, n), jnp.float32)
+    live = _live_roundtrips(
+        lambda x: getrf_scattered(x, nb, step=fusion), a)
+    assert live == attr.expected_hbm_roundtrips(
+        "getrf", {"m": n, "n": n, "nb": nb}, fusion)
+
+
+@pytest.mark.parametrize("n,nb", [(256, 128), (512, 128)])
+def test_potrf_roundtrip_model_matches_counter(n, nb):
+    from slate_tpu.ops import blocks
+
+    a = jnp.zeros((n, n), jnp.float32)
+    live = _live_roundtrips(lambda x: blocks.potrf_panels(x, nb), a)
+    assert live == attr.expected_hbm_roundtrips(
+        "potrf", {"n": n, "nb": nb}, "composed")
+    fused = _live_roundtrips(lambda x: blocks.potrf_steps(x, nb), a)
+    assert fused == 0.0 == attr.expected_hbm_roundtrips(
+        "potrf", {"n": n, "nb": nb}, "fused")
+
+
+# ---------------------------------------------------------------------------
+# attribute(): reconciliation, roofline placement, bottleneck ranking
+# ---------------------------------------------------------------------------
+
+_R04_SUBMETRICS = {
+    "gemm_fp32_n8192": 53421.5,
+    "potrf_fp32_n8192": 16476.9,
+    "getrf_fp32_n8192_nb512": 7185.9,
+    "geqrf_fp32_m32768_n4096": 18905.2,
+    "gels_fp32_m32768_n4096": 28781.4,
+    "mxu_bf16_n8192": 103095.9,
+}
+
+
+@pytest.mark.parametrize("label,gf", sorted(_R04_SUBMETRICS.items()))
+def test_attribution_reconciles_with_reported_gflops(label, gf):
+    """Acceptance pin: stage-flop totals ÷ measured seconds reproduce
+    the routine's reported GFLOP/s to within 1% on every BENCH_r04
+    submetric."""
+    rep = attr.attribute(label, gf)
+    assert rep is not None
+    total = sum(s["flops"] for s in rep["stages"])
+    assert abs(total / rep["measured_s"] / 1e9 - gf) / gf < 0.01
+    # stage wall-time estimates sum back to the measured total
+    est = sum(s["measured_s"] for s in rep["stages"])
+    assert est == pytest.approx(rep["measured_s"], rel=1e-3)
+    # gap shares sum to the observed deficit (1 - model/measured)
+    deficit = sum(s["gap_share"] for s in rep["stages"])
+    assert deficit == pytest.approx(
+        1.0 - rep["model_s"] / rep["measured_s"], abs=2e-3)
+    for s in rep["stages"]:
+        assert 0.0 < s["roofline_frac"] <= 1.0
+        assert s["bound"] in ("mxu", "hbm", "ici")
+    json.loads(json.dumps(rep))     # block must be JSON-clean
+
+
+def test_attribution_skips_derived_and_invalid_labels():
+    assert attr.attribute("heev_fp64_n1024_stage2_chase_s", 0.5) is None
+    assert attr.attribute("getrf_fp32_n8192_nb512_frac_of_gemm",
+                          0.136) is None
+    assert attr.attribute("getrf_fp32_n8192_nb512", 0.0) is None
+    assert attr.attribute("unknownroutine_fp32_n64", 5.0) is None
+
+
+def test_bottlenecks_ranked_and_dominant_stage_first():
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7293.8)
+    gaps = [b["gap_s"] for b in rep["bottlenecks"]]
+    assert gaps == sorted(gaps, reverse=True)
+    # getrf at 13.6% of gemm: the trailing update dominates the gap
+    assert rep["bottlenecks"][0]["stage"] == "update"
+
+
+def test_fusion_depth_from_autotune_tags():
+    tags = {"lu_step|8192,8192,512,float32,HIGH": "fused"}
+    assert attr.fusion_from_autotune("getrf", tags) == "fused"
+    assert attr.fusion_from_autotune("getrf", {}) == "composed"
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7293.8, autotune=tags)
+    assert rep["fusion"] == "fused"
+    assert rep["hbm_roundtrips"]["model"] == 0.0
+
+
+def test_peak_env_overrides(monkeypatch):
+    base = attr.peaks("tpu", "fp32")
+    monkeypatch.setenv("SLATE_TPU_PEAK_TFLOPS_FP32", "220.0")
+    monkeypatch.setenv("SLATE_TPU_PEAK_HBM_GBS", "1600")
+    pk = attr.peaks("tpu", "fp32")
+    assert pk["tflops"] == 220.0 and pk["hbm_gbs"] == 1600.0
+    assert pk["tflops"] != base["tflops"]
+    # generic fallback applies when no per-dtype knob is set
+    monkeypatch.delenv("SLATE_TPU_PEAK_TFLOPS_FP32")
+    monkeypatch.setenv("SLATE_TPU_PEAK_TFLOPS", "42.0")
+    assert attr.peaks("tpu", "fp32")["tflops"] == 42.0
+
+
+def test_collective_stage_exposed_vs_overlapped():
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7185.9,
+                         n_devices=8, collective_bytes=8 * 2 ** 30)
+    coll = rep["collective"]
+    assert coll["bytes"] == 8 * 2 ** 30
+    assert coll["overlapped_s"] + coll["exposed_s"] == \
+        pytest.approx(coll["min_s"], rel=1e-6)
+    assert any(s["stage"] == "collective" for s in rep["stages"])
+
+
+def test_hlo_collective_census_feeds_attribution(mesh8):
+    """The compiled-HLO byte census (hlo_profile.collective_byte_census)
+    is the mesh-side ``collective_bytes`` input of the attribution
+    engine: profile a fused panel broadcast, census its collectives,
+    join the bytes into a gap report."""
+    from slate_tpu._jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from slate_tpu.perf.hlo_profile import (COLLECTIVE_KINDS,
+                                            collective_byte_census,
+                                            profile_fn)
+    from slate_tpu.parallel import dist_util
+    from slate_tpu.parallel.mesh import AXIS_P, AXIS_Q
+
+    p, nb, mlb = 2, 2, 2
+    M = mlb * nb * p
+
+    def kernel(col):
+        r = jax.lax.axis_index(AXIS_P)
+        grows = dist_util.local_grows(mlb, nb, p, r)
+        own = jnp.ones((mlb * nb, 1), jnp.float32)
+        return dist_util.bcast_block_col(col, grows, own, M)
+
+    fn = shard_map(kernel, mesh=mesh8,
+                   in_specs=(P(AXIS_P, None),), out_specs=P(None, None))
+    prof = profile_fn(fn, jnp.ones((mlb * nb * p, 3), jnp.float32))
+    census = collective_byte_census(prof)
+    assert census["count"] >= 1
+    assert census["bytes"] >= M * 3 * 4
+    assert set(census["by_kind"]) <= set(COLLECTIVE_KINDS)
+    assert census["bytes"] == sum(census["by_kind"].values())
+    # the stepped form at 1 trip per communicating body matches the
+    # flat census restricted to entry + step loops
+    stepped = collective_byte_census(
+        prof, trip_counts=[1] * len(prof.step_loops))
+    want = prof.entry.collective_count + sum(
+        b.collective_count for b in prof.step_loops)
+    assert stepped["count"] == want
+    with pytest.raises(ValueError):
+        collective_byte_census(prof, trip_counts=[1] * 99)
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7185.9, n_devices=8,
+                         collective_bytes=census["bytes"])
+    assert rep["collective"]["bytes"] == census["bytes"]
+
+
+def test_twostage_stage2_timer_joins_as_chase():
+    """The drivers record the eig/SVD middle stage as
+    ``stage.<op>.stage2``; the model names it ``chase`` — the join must
+    alias them or a chase regression gets misattributed to the outer
+    stages."""
+    snap = _fake_snapshot({"stage.heev.stage1": 0.5,
+                           "stage.heev.stage2": 9.0,
+                           "stage.heev.stage3": 0.5})
+    rep = attr.attribute("heev_fp32_n8192", 1000.0,
+                         metrics_snapshot=snap)
+    assert rep["backend_source"] == "timers"
+    by = {s["stage"]: s for s in rep["stages"]}
+    # stage2 owns 90% of the timed weight -> the chase stage owns the gap
+    assert by["chase"]["measured_s"] > by["stage1"]["measured_s"]
+    assert by["chase"]["measured_s"] > by["stage3"]["measured_s"]
+    assert rep["bottlenecks"][0]["stage"] == "chase"
+
+
+# ---------------------------------------------------------------------------
+# Measured-timer join + the namespaced-key collision regression
+# ---------------------------------------------------------------------------
+
+def _fake_snapshot(timer_totals):
+    return {"enabled": True,
+            "counters": {},
+            "timers": {k: {"count": 1, "total_s": v, "min_s": v,
+                           "max_s": v}
+                       for k, v in timer_totals.items()}}
+
+
+def test_timer_join_apportions_measured_time():
+    snap = _fake_snapshot({"step.getrf.panel": 8.0,
+                           "step.getrf.trsm": 1.0,
+                           "step.getrf.update": 1.0})
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7185.9,
+                         metrics_snapshot=snap)
+    assert rep["backend_source"] == "timers"
+    by = {s["stage"]: s for s in rep["stages"]}
+    # panel got 80% of the timed weight -> it owns the gap now
+    assert by["panel"]["measured_s"] > by["update"]["measured_s"]
+    assert rep["bottlenecks"][0]["stage"] == "panel"
+
+
+def test_two_ops_same_stage_name_do_not_collide():
+    """The r7 fix: getrf and potrf both firing an ``update`` stage in
+    one routine keep distinct namespaced timers, and the join consumes
+    ONLY the requested op's keys — a bare ``step.update`` key (the
+    pre-fix collision shape) never joins."""
+    metrics.on()
+    with metrics.step_timer("getrf", "update"):
+        pass
+    with metrics.step_timer("potrf", "update"):
+        pass
+    with metrics.step_timer("potrf", "update"):
+        pass
+    metrics.observe_time("step.update", 99.0)    # bare legacy key
+    snap = metrics.snapshot()
+    assert snap["timers"]["step.getrf.update"]["count"] == 1
+    assert snap["timers"]["step.potrf.update"]["count"] == 2
+    got = attr.stage_timers(snap, "getrf")
+    assert set(got) == {"update"} and got["update"]["count"] == 1
+    pot = attr.stage_timers(snap, "potrf")
+    assert pot["update"]["count"] == 2
+    assert pot["update"]["total_s"] < 99.0       # bare key excluded
+    assert attr.stage_timers(snap, "update") == {}
+
+
+def test_step_timer_keys_survive_dotted_names():
+    """Dots in op/stage would shift the ``step.<op>.<stage>`` split and
+    collide into another op's attribution — metrics sanitizes them."""
+    metrics.on()
+    with metrics.step_timer("ge.trf", "up.date"):
+        pass
+    snap = metrics.snapshot()
+    assert "step.ge_trf.up_date" in snap["timers"]
+    got = attr.stage_timers(snap, "ge_trf")
+    assert set(got) == {"up_date"}
+    assert attr.stage_timers(snap, "trf") == {}
+
+
+# ---------------------------------------------------------------------------
+# Golden canned-artifact: the sentinel names the injected stage
+# ---------------------------------------------------------------------------
+
+def _artifact_with_attr(tmp_path, name, label, gflops, timer_totals):
+    rep = attr.attribute(label, gflops,
+                         metrics_snapshot=_fake_snapshot(timer_totals))
+    agg = {"metric": "factor_suite_fp32_geomean", "value": gflops,
+           "unit": "GFLOP/s", "vs_baseline": 1.0,
+           "submetrics": {label: gflops},
+           "attribution": {label: rep}}
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": 1, "cmd": "bench", "rc": 0,
+                             "tail": "", "parsed": agg}))
+    return str(p)
+
+
+def test_sentinel_explanation_names_injected_regressing_stage(tmp_path):
+    """Inject a PANEL-stage blow-up (via measured timers) into an
+    otherwise update-dominated getrf: the explanation must name panel —
+    proof the diff reads the measured join, not just the flop shares."""
+    label = "getrf_fp32_n1024_nb128"
+    old = _artifact_with_attr(tmp_path, "r1.json", label, 5000.0,
+                              {"step.getrf.panel": 0.1,
+                               "step.getrf.trsm": 0.1,
+                               "step.getrf.update": 0.8})
+    new = _artifact_with_attr(tmp_path, "r2.json", label, 3000.0,
+                              {"step.getrf.panel": 5.0,
+                               "step.getrf.trsm": 0.1,
+                               "step.getrf.update": 0.8})
+    report = regress.diff([regress.load_artifact(old),
+                           regress.load_artifact(new)])
+    assert [r.label for r in report.regressions] == [label]
+    lines = regress.explain(report)
+    assert len(lines) == 1
+    assert "panel stage" in lines[0]
+    assert label in lines[0]
+
+
+def test_checked_in_r03_r04_explanation_names_update_stage():
+    """Acceptance: on the real r3→r4 artifacts (which carry NO
+    attribution blocks — the model derives from labels alone) the
+    geqrf 23.5→18.9 TF/s drop is attributed to the update stage with
+    no hand-tuned special case."""
+    arts = [regress.load_artifact(os.path.join(_REPO, f))
+            for f in ("BENCH_r03.json", "BENCH_r04.json")]
+    report = regress.diff(arts)
+    lines = regress.explain(report)
+    assert len(lines) == 1
+    assert lines[0].startswith("geqrf_fp32_m32768_n4096")
+    assert "update stage" in lines[0]
+
+
+def test_explain_empty_when_nothing_regressed(tmp_path):
+    a = regress.Artifact(path="a", name="a",
+                         submetrics={"gemm_fp32_n8192": 100.0})
+    b = regress.Artifact(path="b", name="b",
+                         submetrics={"gemm_fp32_n8192": 101.0})
+    assert regress.explain(regress.diff([a, b])) == []
+
+
+# ---------------------------------------------------------------------------
+# Roofline gauges -> Perfetto counter tracks
+# ---------------------------------------------------------------------------
+
+def test_record_rooflines_feeds_perfetto_counter_tracks(tmp_path):
+    from slate_tpu import trace
+
+    trace.clear()
+    metrics.on()
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7185.9)
+    assert attr.record_rooflines(rep) is True
+    path = trace.finish_perfetto(str(tmp_path / "r.json"))
+    blob = json.loads(open(path).read())
+    roof = [e for e in blob["traceEvents"]
+            if e["ph"] == "C" and e["name"].startswith("roofline.")]
+    assert roof and all(e["cat"] == "roofline" for e in roof)
+    names = {e["name"] for e in roof}
+    assert "roofline.getrf_fp32_n8192_nb512.update" in names
+    vals = [e["args"]["value"] for e in roof]
+    assert all(0.0 < v <= 1.0 for v in vals)
+
+
+def test_record_rooflines_noop_when_registry_off():
+    rep = attr.attribute("getrf_fp32_n8192_nb512", 7185.9)
+    assert attr.record_rooflines(rep) is False
+    assert metrics.snapshot()["gauges"] == {}

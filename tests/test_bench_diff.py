@@ -16,6 +16,7 @@ from slate_tpu.perf import regress
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CLI = os.path.join(_REPO, "tools", "bench_diff.py")
+_GAP_CLI = os.path.join(_REPO, "tools", "gap_report.py")
 
 
 def _wrapper(tmp_path, name, submetrics, rc=0, parsed=True, autotune=None):
@@ -94,22 +95,98 @@ def test_cli_json_output(tmp_path):
     assert blob["exit_code"] == 1
 
 
+def _poison_env(tmp_path):
+    poison = tmp_path / "poison"
+    poison.mkdir(exist_ok=True)
+    (poison / "jax").mkdir(exist_ok=True)
+    (poison / "jax" / "__init__.py").write_text(
+        "raise ImportError('offline tool must not import jax')")
+    return dict(os.environ,
+                PYTHONPATH=str(poison) + os.pathsep
+                + os.environ.get("PYTHONPATH", ""))
+
+
 def test_cli_does_not_import_jax(tmp_path):
     """The sentinel must stay runnable on jax-free machines: poison the
-    path so any jax import explodes."""
+    path so any jax import explodes — --explain included (it loads the
+    attribution engine by file path)."""
     old = _wrapper(tmp_path, "r1.json", _BASE)
     new = _wrapper(tmp_path, "r2.json", _BASE)
-    poison = tmp_path / "poison"
-    poison.mkdir()
-    (poison / "jax").mkdir()
-    (poison / "jax" / "__init__.py").write_text(
-        "raise ImportError('sentinel must not import jax')")
-    env = dict(os.environ,
-               PYTHONPATH=str(poison) + os.pathsep
-               + os.environ.get("PYTHONPATH", ""))
-    r = subprocess.run([sys.executable, _CLI, old, new],
+    env = _poison_env(tmp_path)
+    r = subprocess.run([sys.executable, _CLI, old, new, "--explain"],
                        capture_output=True, text=True, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# CI: the offline tools over the CHECKED-IN artifacts (subprocess,
+# stdlib interpreter) — the gap-report toolchain cannot rot unseen
+# ---------------------------------------------------------------------------
+
+def test_cli_explain_attributes_r03_r04_geqrf_to_update_stage(tmp_path):
+    """Acceptance: `bench_diff.py --explain` on the checked-in r03→r04
+    pair attributes the known geqrf 23.5→18.9 TF/s regression to the
+    update stage — no hand-tuned special case, no jax import."""
+    r = subprocess.run([sys.executable, _CLI,
+                        os.path.join(_REPO, "BENCH_r03.json"),
+                        os.path.join(_REPO, "BENCH_r04.json"),
+                        "--explain"],
+                       capture_output=True, text=True,
+                       env=_poison_env(tmp_path))
+    assert r.returncode == 1, r.stdout + r.stderr
+    explain = [l for l in r.stdout.splitlines()
+               if l.startswith("EXPLAIN ")]
+    assert len(explain) == 1, r.stdout
+    assert "geqrf_fp32_m32768_n4096" in explain[0]
+    assert "update stage" in explain[0]
+
+
+def test_cli_explain_json_carries_lines(tmp_path):
+    old = _wrapper(tmp_path, "r1.json", _BASE)
+    new = _wrapper(tmp_path, "r2.json",
+                   {"gemm_fp32_n8192": 50100.0,
+                    "geqrf_fp32_m32768_n4096": 18905.2})
+    r = _run_cli(old, new, "--explain", "--json")
+    blob = json.loads(r.stdout)
+    assert len(blob["explain"]) == 1
+    assert "update stage" in blob["explain"][0]
+
+
+def test_gap_report_cli_renders_checked_in_artifacts(tmp_path):
+    """`gap_report.py` renders the roofline table of both checked-in
+    r03/r04 artifacts (derived analytically — they predate embedded
+    attribution blocks) on a jax-poisoned path."""
+    env = _poison_env(tmp_path)
+    for name in ("BENCH_r03.json", "BENCH_r04.json"):
+        r = subprocess.run([sys.executable, _GAP_CLI,
+                            os.path.join(_REPO, name)],
+                           capture_output=True, text=True, env=env)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "gap report: " + name in r.stdout
+        assert "getrf_fp32_n8192_nb512" in r.stdout
+        assert "bottlenecks:" in r.stdout
+        assert "update" in r.stdout
+
+
+def test_gap_report_cli_json_and_routine_filter():
+    r = subprocess.run([sys.executable, _GAP_CLI,
+                        os.path.join(_REPO, "BENCH_r04.json"),
+                        "--routine", "geqrf", "--json"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    blob = json.loads(r.stdout)
+    labels = [rep["label"] for rep in blob["reports"]]
+    assert labels == ["geqrf_fp32_m32768_n4096"]
+    stages = {s["stage"] for s in blob["reports"][0]["stages"]}
+    assert stages == {"panel", "update"}
+
+
+def test_gap_report_cli_infra_artifact_nonzero():
+    r = subprocess.run([sys.executable, _GAP_CLI,
+                        os.path.join(_REPO, "BENCH_r05.json")],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "INFRA" in r.stderr
 
 
 # ---------------------------------------------------------------------------

@@ -131,23 +131,82 @@ def test_snapshot_is_json_round_trippable():
 # ---------------------------------------------------------------------------
 
 def test_snapshot_rides_every_bench_line(capsys):
+    """Per-routine lines carry the metrics DELTA for that routine only
+    (r7: the registry accumulates across the process, so a cumulative
+    snapshot on a late routine's line would drag every earlier
+    routine's counters along); the aggregate stays cumulative."""
     bench = _load_bench()
     metrics.on()
-    metrics.inc("marker")
+    metrics.inc("marker")                # recorded BEFORE the routine
+
+    def probe():
+        metrics.inc("inner.marker")      # recorded DURING the routine
+        return ("probe_fp32_n1", 12.0, 0.0)
+
     sub, fails, infra = {}, [], []
-    bench._run_routine("probe", lambda: ("probe_fp32_n1", 12.0, 0.0),
-                       sub, fails, infra)
+    bench._run_routine("probe", probe, sub, fails, infra)
     bench._run_routine("boom", lambda: (_ for _ in ()).throw(OSError("x")),
                        sub, fails, infra)
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
              if l.strip()]
     ok = [l for l in lines if l.get("routine") == "probe"][0]
     err = [l for l in lines if l.get("routine") == "boom"][0]
-    assert ok["metrics"]["counters"]["marker"] == 1.0
+    assert ok["metrics"]["delta"] is True
+    assert ok["metrics"]["counters"]["inner.marker"] == 1.0
+    assert "marker" not in ok["metrics"]["counters"]   # pre-routine noise
     assert "metrics" in err and err["error"].startswith("infra:")
     agg = bench._partial_aggregate(sub, fails, infra)
-    assert agg["metrics"]["counters"]["marker"] == 1.0
+    assert agg["metrics"]["counters"]["marker"] == 1.0   # cumulative
+    assert agg["metrics"]["counters"]["inner.marker"] == 1.0
     json.loads(json.dumps(agg))          # aggregate stays JSON-clean
+
+
+def test_attribution_block_rides_bench_line(capsys):
+    """A routine whose label has a stage model gets an ``attribution``
+    block next to ``metrics`` — and the aggregate collects it."""
+    bench = _load_bench()
+    metrics.on()
+    sub, fails, infra = {}, [], []
+    attr_map = {}
+    bench._run_routine("getrf",
+                       lambda: ("getrf_fp32_n1024_nb128", 500.0, 0.0),
+                       sub, fails, infra, attr_sink=attr_map)
+    line = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+            if l.strip()][0]
+    rep = line["attribution"]
+    assert rep["routine"] == "getrf"
+    assert {s["stage"] for s in rep["stages"]} >= {"panel", "update"}
+    total = sum(s["flops"] for s in rep["stages"])
+    assert abs(total / rep["measured_s"] / 1e9 - 500.0) / 500.0 < 0.01
+    assert attr_map["getrf_fp32_n1024_nb128"] == rep
+    agg = bench._partial_aggregate(sub, fails, infra,
+                                   attribution=attr_map)
+    assert agg["attribution"]["getrf_fp32_n1024_nb128"] == rep
+
+
+def test_snapshot_delta_semantics():
+    metrics.on()
+    metrics.inc("kept")
+    metrics.inc("grown", 2.0)
+    metrics.observe_time("t.old", 1.0)
+    metrics.observe("h", 1.0)
+    before = metrics.snapshot()
+    metrics.inc("grown", 3.0)
+    metrics.inc("fresh")
+    metrics.set_gauge("g", 7.0)
+    metrics.observe_time("t.new", 0.25)
+    metrics.observe_time("t.new", 0.75)
+    metrics.observe("h", 4.0)
+    delta = metrics.snapshot_delta(before, metrics.snapshot())
+    assert delta["delta"] is True
+    assert delta["counters"] == {"grown": 3.0, "fresh": 1.0}
+    assert "kept" not in delta["counters"]
+    assert delta["gauges"] == {"g": 7.0}
+    assert set(delta["timers"]) == {"t.new"}
+    t = delta["timers"]["t.new"]
+    assert t["count"] == 2 and t["total_s"] == pytest.approx(1.0)
+    h = delta["hists"]["h"]
+    assert h["count"] == 1 and h["total"] == pytest.approx(4.0)
 
 
 # ---------------------------------------------------------------------------

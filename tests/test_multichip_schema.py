@@ -1,0 +1,154 @@
+"""MULTICHIP artifact schema: the base dry-run wrapper fields plus the
+r7 per-device overlap/efficiency block (``MULTICHIP_ATTR`` tail line,
+produced by ``dist_util.overlap_summary``) that graduates the artifacts
+from smoke markers to the scaling-curve input of ROADMAP item 3.
+
+Old artifacts (r01–r05) predate the overlap block and must validate
+WITHOUT it; any artifact that carries one must carry it complete."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.perf import metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_BASE_KEYS = {"n_devices": int, "rc": int}
+
+_OVERLAP_KEYS = {
+    "n_devices": int,
+    "platform": str,
+    "ici_gbs": (int, float),
+    "collective_count": (int, float),
+    "collective_bytes": (int, float),
+    "collective_min_s": (int, float),
+    "overlapped_collective_s": (int, float),
+    "exposed_collective_s": (int, float),
+    "overlap_efficiency": (int, float),
+    "per_device": list,
+}
+
+_PER_DEVICE_KEYS = {
+    "device": int,
+    "collective_bytes": (int, float),
+    "overlapped_collective_s": (int, float),
+    "exposed_collective_s": (int, float),
+    "overlap_efficiency": (int, float),
+}
+
+
+def _check_overlap_block(blk):
+    for key, typ in _OVERLAP_KEYS.items():
+        assert key in blk, f"overlap block missing {key}"
+        assert isinstance(blk[key], typ), (key, blk[key])
+    assert blk["n_devices"] >= 1
+    assert len(blk["per_device"]) == blk["n_devices"]
+    assert 0.0 <= blk["overlap_efficiency"] <= 1.0
+    assert blk["overlapped_collective_s"] + blk["exposed_collective_s"] \
+        == pytest.approx(blk["collective_min_s"], rel=1e-6, abs=1e-12)
+    for i, dev in enumerate(blk["per_device"]):
+        for key, typ in _PER_DEVICE_KEYS.items():
+            assert key in dev, f"per-device entry missing {key}"
+            assert isinstance(dev[key], typ), (key, dev[key])
+        assert dev["device"] == i
+        assert 0.0 <= dev["overlap_efficiency"] <= 1.0
+
+
+def _overlap_blocks_in_tail(tail: str):
+    out = []
+    for line in tail.splitlines():
+        if line.startswith("MULTICHIP_ATTR "):
+            out.append(json.loads(line[len("MULTICHIP_ATTR "):]))
+    return out
+
+
+def test_checked_in_multichip_artifacts_validate():
+    paths = sorted(glob.glob(os.path.join(_REPO, "MULTICHIP_r*.json")))
+    assert paths, "no MULTICHIP artifacts checked in"
+    for path in paths:
+        with open(path) as f:
+            blob = json.load(f)
+        for key, typ in _BASE_KEYS.items():
+            assert key in blob, f"{path}: missing {key}"
+            assert isinstance(blob[key], typ), (path, key)
+        assert isinstance(blob.get("tail", ""), str)
+        # the overlap block is OPTIONAL (r01-r05 predate it) but must be
+        # complete wherever it appears
+        for blk in _overlap_blocks_in_tail(blob.get("tail", "")):
+            _check_overlap_block(blk)
+
+
+def test_overlap_summary_schema_from_live_counters(mesh8):
+    """Run one fused panel broadcast on the virtual mesh with the
+    registry on, then validate ``overlap_summary`` end to end — the
+    block ``dryrun_multichip`` prints as the MULTICHIP_ATTR line."""
+    from slate_tpu._jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from slate_tpu.parallel import dist_util
+    from slate_tpu.parallel.mesh import AXIS_P, AXIS_Q
+
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    try:
+        p, nb, mlb = 2, 2, 2
+        M = mlb * nb * p
+
+        def kernel(col):
+            r = jax.lax.axis_index(AXIS_P)
+            grows = dist_util.local_grows(mlb, nb, p, r)
+            own = jnp.ones((mlb * nb, 1), jnp.float32)
+            return dist_util.bcast_block_col(col, grows, own, M)
+
+        fn = shard_map(kernel, mesh=mesh8,
+                       in_specs=(P(AXIS_P, None),),
+                       out_specs=P(None, None))
+        col = jnp.ones((mlb * nb * p, 3), jnp.float32)
+        np.asarray(jax.jit(fn)(col))
+
+        # no compute signal -> conservatively fully exposed
+        blk = _check_and_return(dist_util.overlap_summary(n_devices=8))
+        assert blk["collective_bytes"] >= M * 3 * 4
+        assert blk["exposed_collective_s"] == pytest.approx(
+            blk["collective_min_s"])
+        assert blk["overlap_efficiency"] == 0.0
+
+        # with an explicit overlap budget the collectives hide under it
+        blk2 = _check_and_return(
+            dist_util.overlap_summary(n_devices=8, compute_s=10.0))
+        assert blk2["overlap_efficiency"] == 1.0
+        assert blk2["exposed_collective_s"] == 0.0
+        json.loads(json.dumps(blk2))   # the artifact line is JSON-clean
+    finally:
+        metrics.reset()
+        metrics.off()
+
+
+def _check_and_return(blk):
+    _check_overlap_block(blk)
+    return blk
+
+
+def test_overlap_summary_without_traffic_is_clean():
+    """A mesh-free process (empty registry) still emits a valid block:
+    zero bytes, efficiency 1.0 (nothing to expose)."""
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    try:
+        from slate_tpu.parallel import dist_util
+
+        blk = dist_util.overlap_summary(n_devices=4)
+        _check_overlap_block(blk)
+        assert blk["collective_bytes"] == 0.0
+        assert blk["overlap_efficiency"] == 1.0
+    finally:
+        metrics.reset()
+        metrics.off()

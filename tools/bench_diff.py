@@ -55,14 +55,21 @@ def main(argv=None) -> int:
                          "consecutive artifacts (default %(default)s)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of a table")
+    ap.add_argument("--explain", action="store_true",
+                    help="diff the roofline attribution of each "
+                         "regressed routine and name the stage whose "
+                         "share of the wall time moved (derived "
+                         "analytically for artifacts that predate "
+                         "embedded attribution blocks)")
     args = ap.parse_args(argv)
 
     arts = [regress.load_artifact(p) for p in args.artifacts]
     report = regress.diff(arts, threshold_pct=args.threshold)
+    explain = regress.explain(report) if args.explain else None
     if args.json:
         import json
 
-        print(json.dumps({
+        blob = {
             "threshold_pct": report.threshold_pct,
             "rows": [{"label": r.label, "values": r.values,
                       "delta_pct": r.delta_pct, "verdict": r.verdict,
@@ -73,9 +80,20 @@ def main(argv=None) -> int:
             "infra": [{"artifact": n, "reasons": rs}
                       for n, rs in report.infra],
             "exit_code": report.exit_code,
-        }, indent=1))
+        }
+        if explain is not None:
+            blob["explain"] = explain
+        print(json.dumps(blob, indent=1))
     else:
         print(regress.format_table(report))
+        if explain is not None:
+            print()
+            if explain:
+                for line in explain:
+                    print("EXPLAIN " + line)
+            else:
+                print("EXPLAIN nothing regressed — no attribution "
+                      "diff to report")
     return report.exit_code
 
 

@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""Gap report CLI — render a BENCH artifact's roofline attribution.
+
+Usage::
+
+    python tools/gap_report.py BENCH_r04.json
+    python tools/gap_report.py BENCH_r04.json --routine geqrf
+    python tools/gap_report.py BENCH_r04.json --json
+
+For every routine submetric in the artifact this prints the per-stage
+gap report: analytical flops/bytes per stage (panel / pivot / trsm /
+update / …), each stage's MXU-vs-HBM roofline placement and achieved
+fraction, and the ranked bottleneck list that sums to the observed
+deficit.  Artifacts from bench r7+ carry the measured-timer-joined
+``attribution`` blocks and those are rendered verbatim; older artifacts
+(r03/r04) get the analytical model derived on the spot from the
+submetric labels and autotune tags — so the historical trajectory
+explains too.
+
+Stdlib-only, like ``bench_diff.py``: the attribution engine
+(``slate_tpu/perf/attr.py``) and the artifact loader
+(``slate_tpu/perf/regress.py``) are loaded directly by file path, so
+this tool NEVER imports jax and runs anywhere in milliseconds.
+
+Roofline constants default to the measured-library peaks per platform
+and are overridable for new hardware via ``SLATE_TPU_PEAK_TFLOPS[_
+<DTYPE>]`` / ``SLATE_TPU_PEAK_HBM_GBS`` / ``SLATE_TPU_PEAK_ICI_GBS``
+(see docs/usage.md "Gap reports").
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load(modfile: str, alias: str):
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.normpath(os.path.join(
+        here, os.pardir, "slate_tpu", "perf", modfile))
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(alias, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod     # dataclasses resolve __module__ here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    regress = _load("regress.py", "_slate_tpu_regress")
+    attr = _load("attr.py", "_slate_tpu_attr")
+    ap = argparse.ArgumentParser(
+        prog="gap_report.py",
+        description="Render a bench artifact's roofline attribution "
+                    "(where the time went, per stage).")
+    ap.add_argument("artifact", help="BENCH_r*.json (driver wrapper, "
+                    "bare aggregate, or raw bench stdout)")
+    ap.add_argument("--routine", default="",
+                    help="only labels containing this substring")
+    ap.add_argument("--platform", default="tpu", choices=("tpu", "cpu"),
+                    help="roofline constant set for derived reports "
+                         "(default %(default)s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the reports as JSON instead of tables")
+    args = ap.parse_args(argv)
+
+    art = regress.load_artifact(args.artifact)
+    if art.infra and not art.submetrics:
+        print("INFRA %s: %s" % (art.name, "; ".join(art.infra)),
+              file=sys.stderr)
+        return 1
+    reports = []
+    for label in sorted(art.submetrics):
+        if args.routine and args.routine not in label:
+            continue
+        rep = art.attribution.get(label)
+        if not (isinstance(rep, dict) and rep.get("stages")):
+            rep = attr.attribute(label, art.submetrics.get(label),
+                                 autotune=art.autotune or None,
+                                 platform=args.platform)
+        if rep:
+            reports.append(rep)
+    if not reports:
+        print("no attributable routines in %s" % art.name,
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({"artifact": art.name, "reports": reports},
+                         indent=1))
+    else:
+        print("gap report: %s (%d routines)" % (art.name, len(reports)))
+        for rep in reports:
+            print()
+            print(attr.format_report(rep))
+        if art.infra:
+            print()
+            print("INFRA %s: %s" % (art.name, "; ".join(art.infra)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
