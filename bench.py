@@ -81,6 +81,18 @@ and the full ``{label: attribution}`` map.  Compare artifacts with
 infra-shaped artifacts, and with ``--explain`` names the stage a drop
 came from; render one artifact's roofline tables with ``python
 tools/gap_report.py BENCH_r04.json``.
+
+Batched serving throughput (round 8): the ``batched_posv`` /
+``batched_gesv`` routines measure the many-problem drivers
+(:mod:`slate_tpu.linalg.batched`) at (B=64, n=256) under the same
+per-routine watchdog, emitting TWO families per routine — the GFLOP/s
+label (``posv_batched_fp32_n256_b64``, roofline-attributed like any
+other submetric) and the ``throughput_solves_per_s`` family: batched
+solves/s, the Python loop-of-singles baseline
+(``posv_loop_fp32_n256_solves_per_s``) and the
+``..._speedup_vs_loop`` ratio the acceptance criterion pins (batched ≥
+5× loop on TPU).  The sentinel judges ``*_solves_per_s`` rows
+higher-is-better like GFLOP/s.
 """
 
 import json
@@ -173,6 +185,114 @@ def _attribution(label, gflops, metrics_delta, autotune_tags):
 
 class _RoutineTimeout(Exception):
     pass
+
+
+# ---------------------------------------------------------------------------
+# Batched many-problem throughput (ISSUE 8) — the serving workload: B
+# small/medium independent solves per launch (slate_tpu/linalg/batched).
+# Module-level (unlike the big-matrix routines) so tests can run one
+# routine without the whole suite.  Two submetric families per routine:
+# the GFLOP/s label (roofline-attributed like every other submetric) and
+# the throughput_solves_per_s family — batched solves/s, the Python
+# loop-of-singles baseline, and the speedup ratio the acceptance
+# criterion pins (batched ≥ 5× loop at n≤1024, B≥64 on TPU).
+# ---------------------------------------------------------------------------
+
+def _batched_suite(op_name, on_tpu, make_ops, batched_fn, single_fn,
+                   model_fl, resid_fn, nbat, bsz):
+    """Shared runner: chained-jit batched timing, loop-of-singles
+    baseline, residual gate, the solves/s family."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ops_np = make_ops()
+    ops_dev = tuple(jnp.asarray(o) for o in ops_np)
+    it = 8 if on_tpu else 2
+
+    @jax.jit
+    def chain(a, b):
+        def body(i, bb):
+            x = batched_fn(a, bb)
+            return b + x * jnp.float32(1e-30)
+        out = lax.fori_loop(0, it - 1, body, b)
+        return batched_fn(a, out)[-1, -1]
+
+    t = _timeit(chain, ops_dev, it)
+    solves_per_s = bsz / t
+    gf = model_fl * bsz / t / 1e9
+    # loop-of-singles baseline: the SAME solve through the
+    # single-problem driver facade, one dispatch per problem
+    single = jax.jit(single_fn)
+    jax.block_until_ready(single(ops_dev[0][0], ops_dev[1][0]))
+    lb = min(bsz, 16 if on_tpu else 4)
+    t0 = time.perf_counter()
+    for i in range(lb):
+        jax.block_until_ready(single(ops_dev[0][i], ops_dev[1][i]))
+    loop_sps = lb / (time.perf_counter() - t0)
+    x = np.asarray(jax.jit(batched_fn)(*ops_dev))
+    resid = resid_fn(ops_np, x)
+    label = "%s_batched_fp32_n%d_b%d" % (op_name, nbat, bsz)
+    extra = {
+        label + "_solves_per_s": round(solves_per_s, 1),
+        "%s_loop_fp32_n%d_solves_per_s" % (op_name, nbat):
+            round(loop_sps, 1),
+        label + "_speedup_vs_loop":
+            round(solves_per_s / max(loop_sps, 1e-9), 2),
+    }
+    return label, gf, resid, extra
+
+
+def _batched_resid(ops_np, x, nbat):
+    a, rhs = ops_np
+    eps32 = float(np.finfo(np.float32).eps)
+    r = np.linalg.norm(np.einsum("bij,bj->bi", a, x) - rhs, axis=-1)
+    den = (np.linalg.norm(a, axis=(-2, -1))
+           * np.linalg.norm(rhs, axis=-1) * eps32 * nbat)
+    return float(np.max(r / np.maximum(den, 1e-300)))
+
+
+def bench_batched_posv(on_tpu, nbat=None, bsz=64):
+    import slate_tpu as st
+    from slate_tpu.linalg import batched as bat
+
+    nbat = nbat or (256 if on_tpu else 64)
+
+    def make_ops():
+        rng = np.random.default_rng(11)
+        g = rng.standard_normal((bsz, nbat, nbat)).astype(np.float32)
+        spd = (np.einsum("bij,bkj->bik", g, g)
+               + nbat * np.eye(nbat, dtype=np.float32))
+        rhs = rng.standard_normal((bsz, nbat)).astype(np.float32)
+        return spd, rhs
+
+    return _batched_suite(
+        "posv", on_tpu, make_ops,
+        lambda a, b: bat.posv_batched(a, b)[1],
+        lambda a, b: st.posv(a, b)[1],
+        nbat ** 3 / 3.0 + 2.0 * nbat * nbat,
+        lambda ops_np, x: _batched_resid(ops_np, x, nbat), nbat, bsz)
+
+
+def bench_batched_gesv(on_tpu, nbat=None, bsz=64):
+    import slate_tpu as st
+    from slate_tpu.linalg import batched as bat
+
+    nbat = nbat or (256 if on_tpu else 64)
+
+    def make_ops():
+        rng = np.random.default_rng(12)
+        a = (rng.standard_normal((bsz, nbat, nbat)).astype(np.float32)
+             + nbat * np.eye(nbat, dtype=np.float32))
+        rhs = rng.standard_normal((bsz, nbat)).astype(np.float32)
+        return a, rhs
+
+    return _batched_suite(
+        "gesv", on_tpu, make_ops,
+        lambda a, b: bat.gesv_batched(a, b)[2],
+        lambda a, b: st.gesv(a, b)[2],
+        2.0 * nbat ** 3 / 3.0 + 2.0 * nbat * nbat,
+        lambda ops_np, x: _batched_resid(ops_np, x, nbat), nbat, bsz)
 
 
 #: per-stage wall-time attribution for the two-stage eig/SVD pipelines:
@@ -834,6 +954,8 @@ def main():
         ("getrf", bench_getrf, False),
         ("geqrf", bench_geqrf, False),
         ("gels", bench_gels, False),
+        ("batched_posv", lambda: bench_batched_posv(on_tpu), False),
+        ("batched_gesv", lambda: bench_batched_gesv(on_tpu), False),
         ("heev_fp32", bench_heev32, True),
         ("svd_fp32", bench_svd32, True),
         ("heev_fp64", bench_heev64, True),
@@ -875,14 +997,20 @@ def main():
     low = []
     if gemm_gf and sub.get(gemm_key):
         for k, v in sub.items():
+            if k.endswith("_s") or k.endswith("_speedup_vs_loop"):
+                # solves/s rates, stage seconds and speedup ratios are
+                # not GFLOP/s — a gemm fraction would be unit salad
+                continue
             anchor = (sub.get(gemm64_key) if "fp64" in k
                       else sub.get(gemm_key))
             if anchor:
                 peak[k] = round(v / anchor, 3)
                 if peak[k] < 0.10 and "gemm" not in k and "mxu" not in k \
-                        and "heev" not in k and "svd" not in k:
-                    # two-stage eig/svd run partly on host; their
-                    # fraction is informational, not flagged
+                        and "heev" not in k and "svd" not in k \
+                        and "batched" not in k:
+                    # two-stage eig/svd run partly on host and the
+                    # batched suite's tiny per-problem shapes cannot
+                    # reach big-matrix fractions; informational only
                     low.append(k)
     # frac_of_gemm as a FIRST-CLASS derived submetric per factorization
     # routine (routine TF/s ÷ same-run gemm TF/s): the ROADMAP targets

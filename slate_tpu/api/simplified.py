@@ -26,6 +26,9 @@ __all__ = [
     "least_squares_solve", "qr_factor", "lq_factor",
     "qr_multiply_by_q", "lq_multiply_by_q",
     "eig", "eig_vals", "svd", "svd_vals", "norm",
+    "lu_factor_batched", "lu_solve_batched", "chol_factor_batched",
+    "chol_solve_batched", "least_squares_solve_batched",
+    "qr_factor_batched",
 ]
 
 
@@ -163,6 +166,40 @@ def lq_factor(a, opts: Optional[Options] = None):
 def lq_multiply_by_q(side: Side, op: Op, factor, taus, c,
                      opts: Optional[Options] = None):
     return L.unmlq(side, op, factor, taus, c, opts)
+
+
+# -- Batched many-problem verbs (leading batch dim; ISSUE 8) ---------------
+# The simplified-API siblings of :mod:`slate_tpu.linalg.batched` — the
+# serving layer (:mod:`slate_tpu.serve`) queues exactly these solves.
+
+def lu_factor_batched(a, opts: Optional[Options] = None):
+    """Batched ``lu_factor``: (B, n, n) → (LU, perm) stacks."""
+    return L.getrf_batched(a, opts)
+
+
+def lu_solve_batched(a, b, opts: Optional[Options] = None):
+    """Batched ``lu_solve``: solve A·X = B per problem; returns X."""
+    return L.gesv_batched(a, b, opts)[2]
+
+
+def chol_factor_batched(a, opts: Optional[Options] = None):
+    """Batched ``chol_factor``: (B, n, n) SPD → lower factors."""
+    return L.potrf_batched(a, opts)
+
+
+def chol_solve_batched(a, b, opts: Optional[Options] = None):
+    """Batched ``chol_solve``: SPD A·X = B per problem; returns X."""
+    return L.posv_batched(a, b, opts)[1]
+
+
+def least_squares_solve_batched(a, b, opts: Optional[Options] = None):
+    """Batched ``least_squares_solve`` (tall problems, m ≥ n)."""
+    return L.gels_batched(a, b, opts)
+
+
+def qr_factor_batched(a, opts: Optional[Options] = None):
+    """Batched ``qr_factor``: (B, m, n) → (packed, taus) stacks."""
+    return L.geqrf_batched(a, opts)
 
 
 # -- Eigen / SVD / norms ---------------------------------------------------

@@ -32,6 +32,10 @@ from .svd import (  # noqa: F401
     bdsqr, ge2tb, gesvd, svd, svd_vals, tb2bd, unmbr_ge2tb, unmbr_tb2bd,
 )
 from .hesv import hesv, hetrf, hetrs, sysv, sytrf, sytrs  # noqa: F401
+from .batched import (  # noqa: F401
+    gels_batched, geqrf_batched, gesv_batched, getrf_batched,
+    getrs_batched, posv_batched, potrf_batched, potrs_batched,
+)
 from .band import (  # noqa: F401
     gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs, tbsm,
 )

@@ -1,0 +1,330 @@
+"""Batched many-problem drivers: ``potrf / getrf / gesv / posv / geqrf
+/ gels`` over a LEADING BATCH DIM — the serving workload (ROADMAP item
+1).
+
+The production scenario for "millions of users" is not one n=65536
+factorization — it is thousands of small/medium independent solves per
+second (per-user covariance, least-squares, whitening).  Looping the
+single-problem drivers pays per-problem dispatch latency and HBM round
+trips; these drivers own the whole batch per launch, two ways:
+
+* ``"vmapped"`` — the composed candidate: ``jax.vmap`` over the fused
+  single-problem XLA kernel (``lax.linalg.cholesky`` / ``lu`` / batched
+  Householder QR).  XLA's native batching; bitwise-identical to a loop
+  of the same composed function (regression-tested).
+* ``"grid"`` — the grid-batched Pallas candidate (BLASX: own many
+  problems per launch): ONE ``pallas_call`` whose grid iterates batch
+  blocks of ``bt`` problems, each block VMEM-resident and factored to
+  completion in-kernel (:func:`slate_tpu.ops.pallas_kernels.
+  potrf_batched` / ``getrf_batched``).  ``bt`` (problems per launch
+  step) comes from the shared VMEM budget helper
+  (:func:`slate_tpu.ops.vmem.batch_per_launch`) — the same arithmetic
+  the fused single-problem gates use, extended with B-per-launch.
+
+The two arbitrate through the new autotune sites ``batched_potrf`` /
+``batched_lu`` / ``batched_qr`` whose keys pow2-BUCKET both the batch
+size and n (one probe serves a bucket — a probe per exact shape is too
+slow when the serving layer produces many buckets; Design-in-Tiles'
+decision-table argument).  ``SLATE_TPU_AUTOTUNE_FORCE=batched_potrf=
+grid`` pins either way, including in interpret mode (CPU CI).
+
+The async serving front door over these drivers lives in
+:mod:`slate_tpu.serve`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..options import Options
+from ..perf import metrics
+from ..perf.metrics import instrument_driver
+
+__all__ = [
+    "potrf_batched", "potrs_batched", "posv_batched",
+    "getrf_batched", "getrs_batched", "gesv_batched",
+    "geqrf_batched", "gels_batched",
+]
+
+
+def _check_batched(a, name: str, square: bool = True):
+    av = jnp.asarray(a)
+    if av.ndim != 3:
+        from ..exceptions import SlateError
+        raise SlateError(f"{name} requires a (batch, m, n) operand, "
+                         f"got shape {av.shape}")
+    if square and av.shape[-1] != av.shape[-2]:
+        from ..exceptions import SlateError
+        raise SlateError(f"{name} requires square problems, "
+                         f"got shape {av.shape}")
+    return av
+
+
+def _rhs_3d(b, bsz: int):
+    """Normalize a batched right-hand side to (B, n, k); returns
+    ``(bv, squeeze)``."""
+    bv = jnp.asarray(b)
+    if bv.ndim == 2 and bv.shape[0] == bsz:
+        return bv[:, :, None], True
+    if bv.ndim != 3:
+        from ..exceptions import SlateError
+        raise SlateError(f"batched rhs must be (batch, n) or "
+                         f"(batch, n, k), got shape {bv.shape}")
+    return bv, False
+
+
+# ---------------------------------------------------------------------------
+# Backend implementations (the autotune candidates; probes call these
+# directly so a probe can never recurse into the dispatching driver)
+# ---------------------------------------------------------------------------
+
+def _potrf_single_composed(x):
+    """The single-problem composed function the vmapped backend vmaps —
+    also the loop body of the bitwise-parity regression test."""
+    return jnp.tril(lax.linalg.cholesky(x))
+
+
+def _potrf_vmapped(a):
+    return jax.vmap(_potrf_single_composed)(a)
+
+
+def _getrf_single_composed(x):
+    lu, _, perm = lax.linalg.lu(x)
+    return lu, perm
+
+
+def _getrf_vmapped(a):
+    return jax.vmap(_getrf_single_composed)(a)
+
+
+def _geqrf_single_composed(x):
+    h, tau = jnp.linalg.qr(x, mode="raw")
+    return jnp.swapaxes(h, -1, -2), tau
+
+
+def _geqrf_vmapped(a):
+    # jnp.linalg.qr batches natively; vmap keeps loop-bitwise parity
+    return jax.vmap(_geqrf_single_composed)(a)
+
+
+def _grid_bt(bsz: int, n: int, itemsize: int = 4) -> int:
+    """Problems per grid step for the batched Pallas kernels: the
+    shared VMEM budget solved for B-per-launch (in + out slabs + one
+    problem of working values per resident problem), then snapped down
+    to a divisor of the batch size (the grid must tile the batch
+    exactly)."""
+    from ..ops import vmem
+
+    per_problem = 3 * n * n * itemsize
+    bt = vmem.batch_per_launch(per_problem, cap=bsz)
+    if bt < 1:
+        return 0
+    while bsz % bt:
+        bt -= 1
+    return bt
+
+
+def _grid_eligible(bsz: int, n: int, m: int, dtype) -> bool:
+    """Shape/VMEM eligibility of the grid-batched Pallas kernels:
+    square problems on the in-kernel ib=32 block grid whose per-launch
+    working set fits the shared VMEM budget; f32 on TPU (any float in
+    interpret mode).  Whether an eligible shape actually takes the grid
+    path is the ``batched_*`` autotune decision."""
+    from .. import config
+
+    if config.use_pallas_mode() == "off":
+        return False
+    if m != n or n < 32 or n % 32 != 0 or bsz < 1:
+        return False
+    dt = jnp.dtype(dtype)
+    if not jnp.issubdtype(dt, jnp.floating):
+        return False
+    if jax.default_backend() == "tpu" and dt != jnp.float32:
+        return False
+    return _grid_bt(bsz, n, max(4, dt.itemsize)) >= 1
+
+
+def _potrf_grid(a):
+    from ..perf.autotune import kernel
+
+    bsz, n, _ = a.shape
+    bt = _grid_bt(bsz, n, max(4, a.dtype.itemsize))
+    return kernel("potrf_batched")(a, bt=bt).astype(a.dtype)
+
+
+def _getrf_grid(a):
+    from ..perf.autotune import kernel
+
+    bsz, n, _ = a.shape
+    bt = _grid_bt(bsz, n, max(4, a.dtype.itemsize))
+    at = jnp.swapaxes(a, -1, -2)
+    out, piv = kernel("getrf_batched")(at, bt=bt)
+    # packed rows live in the pivot lanes: gather each problem's pivot
+    # columns, transpose back to row-major packed LU
+    idx = jnp.broadcast_to(piv[:, None, :], out.shape)
+    lu_t = jnp.take_along_axis(out, idx, axis=2)
+    return jnp.swapaxes(lu_t, -1, -2).astype(a.dtype), piv
+
+
+# ---------------------------------------------------------------------------
+# Residual probes (shared with the autotune accuracy guards)
+# ---------------------------------------------------------------------------
+
+def _scaled(num, spd, x, n):
+    import numpy as np
+
+    eps = float(np.finfo(np.dtype(spd.dtype.name)).eps)
+    den = (jnp.linalg.norm(spd.astype(jnp.float32), axis=(-2, -1))
+           * float(jnp.linalg.norm(x.astype(jnp.float32))) * eps * n)
+    return float(jnp.max(num / jnp.maximum(den, 1e-300)))
+
+
+def batched_factor_resid_potrf(spd, l) -> float:
+    """Max scaled matvec residual ‖L(Lᵀx) − Ax‖ over the batch (the
+    reference tester's criterion, O(n²) per problem)."""
+    if not bool(jnp.all(jnp.isfinite(l))):
+        return float("inf")
+    n = spd.shape[-1]
+    x = jax.random.normal(jax.random.PRNGKey(23), (n,), spd.dtype)
+    lt = jnp.tril(l)
+    r = jnp.linalg.norm(
+        (jnp.einsum("bij,bj->bi", lt,
+                    jnp.einsum("bji,j->bi", lt, x)) -
+         jnp.einsum("bij,j->bi", spd, x)).astype(jnp.float32), axis=-1)
+    return _scaled(r, spd, x, n)
+
+
+def batched_factor_resid_lu(a, out) -> float:
+    """Max scaled matvec residual of L·(U·x) = A[perm]·x over the
+    batch."""
+    lu, perm = out
+    if not bool(jnp.all(jnp.isfinite(lu))):
+        return float("inf")
+    n = a.shape[-1]
+    x = jax.random.normal(jax.random.PRNGKey(24), (n,), a.dtype)
+    y = jnp.einsum("bij,j->bi", jnp.triu(lu), x)
+    z = jnp.einsum("bij,bj->bi", jnp.tril(lu, -1), y) + y
+    ap = jnp.take_along_axis(a, perm[:, :, None], axis=1)
+    r = jnp.linalg.norm(
+        (z - jnp.einsum("bij,j->bi", ap, x)).astype(jnp.float32), axis=-1)
+    return _scaled(r, a, x, n)
+
+
+# ---------------------------------------------------------------------------
+# Drivers
+# ---------------------------------------------------------------------------
+
+@instrument_driver("potrf_batched")
+def potrf_batched(a, opts: Optional[Options] = None):
+    """Batched Cholesky: ``a`` (B, n, n) SPD (full arrays) → the (B, n,
+    n) lower factors.  Backend per pow2-bucketed (B, n, dtype) key via
+    the ``batched_potrf`` autotune site."""
+
+    av = _check_batched(a, "potrf_batched")
+    bsz, n = av.shape[0], av.shape[-1]
+    metrics.inc("batched.problems", float(bsz))
+    from ..method import select_backend
+    choice = select_backend(
+        "batched_potrf", b=bsz, n=n, dtype=av.dtype,
+        eligible=_grid_eligible(bsz, n, av.shape[-2], av.dtype))
+    if choice == "grid":
+        return _potrf_grid(av)
+    return _potrf_vmapped(av)
+
+
+def potrs_batched(l, b):
+    """Batched triangular solve pair from the lower Cholesky factors:
+    solve A·X = B given L (B, n, n).  ``b`` is (B, n) or (B, n, k)."""
+    lv = _check_batched(l, "potrs_batched")
+    bv, squeeze = _rhs_3d(b, lv.shape[0])
+    y = lax.linalg.triangular_solve(lv, bv, left_side=True, lower=True)
+    x = lax.linalg.triangular_solve(lv, y, left_side=True, lower=True,
+                                    transpose_a=True)
+    return x[:, :, 0] if squeeze else x
+
+
+@instrument_driver("posv_batched")
+def posv_batched(a, b, opts: Optional[Options] = None):
+    """Batched factor + solve for SPD systems — returns ``(L, X)``."""
+    l = potrf_batched(a, opts)
+    return l, potrs_batched(l, b)
+
+
+@instrument_driver("getrf_batched")
+def getrf_batched(a, opts: Optional[Options] = None
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched partial-pivot LU: ``a`` (B, n, n) → ``(LU, perm)`` with
+    ``a[i][perm[i]] = L·U`` per problem, LU packed LAPACK-style —
+    :func:`slate_tpu.linalg.lu.getrf`'s contract with a leading batch
+    dim.  Backend via the ``batched_lu`` site."""
+
+    av = _check_batched(a, "getrf_batched")
+    bsz, n = av.shape[0], av.shape[-1]
+    metrics.inc("batched.problems", float(bsz))
+    from ..method import select_backend
+    choice = select_backend(
+        "batched_lu", b=bsz, n=n, dtype=av.dtype,
+        eligible=_grid_eligible(bsz, n, av.shape[-2], av.dtype))
+    if choice == "grid":
+        return _getrf_grid(av)
+    return _getrf_vmapped(av)
+
+
+def getrs_batched(lu, perm, b):
+    """Batched solve from the LU factors: permute-gather then two
+    batched triangular sweeps."""
+    luv = _check_batched(lu, "getrs_batched")
+    bv, squeeze = _rhs_3d(b, luv.shape[0])
+    bp = jnp.take_along_axis(bv, perm[:, :, None], axis=1)
+    y = lax.linalg.triangular_solve(luv, bp, left_side=True, lower=True,
+                                    unit_diagonal=True)
+    x = lax.linalg.triangular_solve(luv, y, left_side=True, lower=False)
+    return x[:, :, 0] if squeeze else x
+
+
+@instrument_driver("gesv_batched")
+def gesv_batched(a, b, opts: Optional[Options] = None):
+    """Batched factor + solve — returns ``(LU, perm, X)``."""
+    lu, perm = getrf_batched(a, opts)
+    return lu, perm, getrs_batched(lu, perm, b)
+
+
+@instrument_driver("geqrf_batched")
+def geqrf_batched(a, opts: Optional[Options] = None):
+    """Batched QR: ``a`` (B, m, n) → ``(packed, taus)`` (Householder
+    factors packed LAPACK-style per problem).  Registered through the
+    ``batched_qr`` site (single vmapped candidate today)."""
+
+    av = _check_batched(a, "geqrf_batched", square=False)
+    bsz, m, n = av.shape
+    metrics.inc("batched.problems", float(bsz))
+    from ..method import select_backend
+    select_backend("batched_qr", b=bsz, m=m, n=n, dtype=av.dtype)
+    return _geqrf_vmapped(av)
+
+
+@instrument_driver("gels_batched")
+def gels_batched(a, b, opts: Optional[Options] = None):
+    """Batched least squares min ‖A·X − B‖₂ for tall problems (m ≥ n):
+    batched Householder QR + one batched triangular solve.  ``b`` is
+    (B, m) or (B, m, k); returns X (B, n[, k])."""
+
+    av = _check_batched(a, "gels_batched", square=False)
+    bsz, m, n = av.shape
+    if m < n:
+        from ..exceptions import SlateError
+        raise SlateError("gels_batched requires m >= n per problem "
+                         f"(got {av.shape}); use gels per problem for "
+                         "minimum-norm underdetermined solves")
+    metrics.inc("batched.problems", float(bsz))
+    bv, squeeze = _rhs_3d(b, bsz)
+    from ..method import select_backend
+    select_backend("batched_qr", b=bsz, m=m, n=n, dtype=av.dtype)
+    q, r = jnp.linalg.qr(av, mode="reduced")
+    qtb = jnp.matmul(jnp.swapaxes(q, -1, -2), bv)
+    x = lax.linalg.triangular_solve(r, qtb, left_side=True, lower=False)
+    return x[:, :, 0] if squeeze else x

@@ -168,9 +168,7 @@ def _panel_lu_lane_major(a, kernel_name: str):
     return out[:, perm].T, perm, linv
 
 
-#: VMEM the one-call panel kernel may budget (its pallas_call pins a
-#: 110 MB vmem_limit; leave headroom for Mosaic's own spills)
-_PALLAS_PANEL_VMEM_BUDGET = 100 * 1024 * 1024
+from ..ops import vmem as _vmem
 
 
 def _use_pallas_panel(m: int, w: int, dtype) -> bool:
@@ -191,7 +189,7 @@ def _use_pallas_panel(m: int, w: int, dtype) -> bool:
         return True
     m_pad = max(512, 1 << (m - 1).bit_length())
     scratch = (32 * m_pad + 2 * w * w + 2 * m_pad) * 4
-    return 2 * w * m_pad * 4 + scratch < _PALLAS_PANEL_VMEM_BUDGET
+    return _vmem.fits(2 * w * m_pad * 4 + scratch)
 
 
 def _use_fused_panel(m: int, w: int, dtype) -> bool:
@@ -214,7 +212,7 @@ def _use_fused_panel(m: int, w: int, dtype) -> bool:
     m_pad = max(512, 1 << (m - 1).bit_length())
     bb = min(128, w)
     scratch = (2 * bb * m_pad + 3 * w * w + 2 * bb * bb + 2 * m_pad) * 4
-    return w * m_pad * 4 + scratch < _PALLAS_PANEL_VMEM_BUDGET
+    return _vmem.fits(w * m_pad * 4 + scratch)
 
 
 def _panel_lu_auto(a):
@@ -585,21 +583,16 @@ def getrf_panels(a, nb: int = 512, tall_panel: str = "tournament"):
     return a, gperm
 
 
-#: VMEM budget of the fused LU step kernel (110 MB pinned in the
-#: pallas_call, minus headroom for Mosaic's own spills)
-_FUSED_STEP_VMEM_BUDGET = 100 * 1024 * 1024
-
-
 def _fused_step_tc(m: int, n: int, nb: int) -> int:
     """Trailing-chunk height for the fused LU step: the largest divisor
     of nb (floor 128) whose double-buffered (tc, m) pair fits the VMEM
-    budget next to the resident panel, Π/G and block scratches."""
+    budget (:mod:`slate_tpu.ops.vmem`) next to the resident panel, Π/G
+    and block scratches."""
     tc = nb
     # halve only while the result stays at/above the 128 floor (nb need
     # only be a multiple of 128, so a blind halving chain could dip
     # below it for nb = 384, 640, ...)
-    while tc // 2 >= 128 and _fused_step_bytes(m, nb, tc) > \
-            _FUSED_STEP_VMEM_BUDGET:
+    while tc // 2 >= 128 and not _vmem.fits(_fused_step_bytes(m, nb, tc)):
         tc //= 2
     return tc
 
@@ -627,7 +620,7 @@ def _use_fused_step(m: int, n: int, nb: int, dtype) -> bool:
     tc = _fused_step_tc(m, n, nb)
     if n % tc != 0:
         return False
-    return _fused_step_bytes(m, nb, tc) <= _FUSED_STEP_VMEM_BUDGET
+    return _vmem.fits(_fused_step_bytes(m, nb, tc))
 
 
 def getrf_scattered(a, nb: int = 512, bb: int = 128, step=None):

@@ -381,19 +381,21 @@ def lauum_rec(uplo: Uplo, a, nb: int, conj: bool = True, hi: bool = False):
     return jnp.concatenate([top, bot], axis=-2)
 
 
-#: VMEM budget of the fused potrf step kernel (110 MB pinned in the
-#: pallas_call, minus headroom): the (n, nb) resident panel column, two
-#: (tc, tc) streaming tiles and three (nb, nb) diag-block scratches
-_POTRF_STEP_VMEM_BUDGET = 100 * 1024 * 1024
+def _potrf_step_bytes(n: int, nb: int, tc: int) -> int:
+    """Resident working set of the fused potrf step: the (n, nb) panel
+    column, two (tc, tc) streaming tiles and three (nb, nb) diag-block
+    scratches."""
+    return (n * nb + 2 * tc * tc + 3 * nb * nb) * 4
 
 
 def potrf_step_tc(n: int, nb: int) -> int:
     """Trailing-tile edge for the fused potrf step: the largest divisor
     of nb (floor 128) whose double-buffered (tc, tc) pair fits the VMEM
-    budget next to the (n, nb) panel column."""
+    budget (:mod:`slate_tpu.ops.vmem`) next to the (n, nb) panel
+    column."""
+    from . import vmem
     tc = nb
-    while tc // 2 >= 128 and \
-            (n * nb + 2 * tc * tc + 3 * nb * nb) * 4 > _POTRF_STEP_VMEM_BUDGET:
+    while tc // 2 >= 128 and not vmem.fits(_potrf_step_bytes(n, nb, tc)):
         tc //= 2
     return tc
 
@@ -411,9 +413,9 @@ def use_fused_potrf_step(n: int, nb: int, dtype) -> bool:
         return False
     if nb < 128 or (nb & (nb - 1)) != 0:
         return False
+    from . import vmem
     tc = potrf_step_tc(n, nb)
-    return (n * nb + 2 * tc * tc + 3 * nb * nb) * 4 \
-        <= _POTRF_STEP_VMEM_BUDGET
+    return vmem.fits(_potrf_step_bytes(n, nb, tc))
 
 
 def potrf_steps(a, nb: int = 512, tc: int | None = None):

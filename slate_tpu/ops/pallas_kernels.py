@@ -31,6 +31,7 @@ _CompilerParams = getattr(pltpu, "CompilerParams", None) \
     or pltpu.TPUCompilerParams
 
 from .. import config
+from . import vmem
 from .._jax_compat import ensure_pallas_complex_interpret
 
 ensure_pallas_complex_interpret()
@@ -808,7 +809,7 @@ def getrf_panel_linv(slab_t, active_row, ib: int = 32):
         scratch_shapes=[pltpu.VMEM((ib, m), f32),
                         pltpu.VMEM((bb, bb), f32)],
         compiler_params=_CompilerParams(
-            vmem_limit_bytes=110 * 1024 * 1024),
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
         interpret=_interpret(),
     )(slab_t, active_row)
     return out, piv[0], act_out, linv
@@ -1252,7 +1253,7 @@ def getrf_step_fused(at_full, active_row, k0, nb: int = 512,
                         pltpu.SemaphoreType.DMA(())],
         input_output_aliases={0: 0},
         compiler_params=_CompilerParams(
-            vmem_limit_bytes=110 * 1024 * 1024),
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
         interpret=_interpret(),
     )(at_full.astype(dt), active_row.astype(dt),
       jnp.asarray(k0, jnp.int32).reshape(1))
@@ -1361,7 +1362,7 @@ def potrf_step_fused(a, k0, nb: int = 512, tc: int = 512):
                         pltpu.SemaphoreType.DMA(())],
         input_output_aliases={0: 0},
         compiler_params=_CompilerParams(
-            vmem_limit_bytes=110 * 1024 * 1024),
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
         interpret=_interpret(),
     )(a.astype(dt), jnp.asarray(k0, jnp.int32).reshape(1))
 # eig/SVD stage-2 middle section (or one checkpointed sweep-range chunk
@@ -1663,7 +1664,7 @@ def hb2st_wavefront(abw, kd: int, j0: int = 0, j1: int | None = None):
                         pltpu.SemaphoreType.DMA(())],
         input_output_aliases={0: 0, 1: 1},
         compiler_params=_CompilerParams(
-            vmem_limit_bytes=110 * 1024 * 1024),
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
         interpret=_interpret(),
     )(ab_pad, vt0)
     return out_ab[:n, :w_real], out_vt
@@ -1851,7 +1852,196 @@ def tb2bd_wavefront(st, kd: int, s0: int = 0, s1: int | None = None):
                         pltpu.SemaphoreType.DMA(())],
         input_output_aliases={0: 0, 1: 1, 2: 2},
         compiler_params=_CompilerParams(
-            vmem_limit_bytes=110 * 1024 * 1024),
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
         interpret=_interpret(),
     )(st_pad, log0, log0)
     return out_st[:n, :w_real], out_ut, out_vt
+
+
+# ---------------------------------------------------------------------------
+# Grid-batched many-problem kernels (ISSUE 8) — the serving workload is
+# thousands of SMALL independent factorizations, not one giant one
+# (per-user covariance / least-squares / whitening).  Launching the
+# single-problem drivers per problem pays one dispatch + compile-cache
+# walk + HBM round trip each; here ONE pallas_call owns B problems at
+# once: the grid iterates batch BLOCKS of ``bt`` problems, each grid
+# step DMAs its (bt, n, n) slab into VMEM, factors every resident
+# problem to completion (the whole problem is the panel at these sizes),
+# and writes the slab back — the BLASX many-problems-per-launch shape.
+# ``bt`` (problems per launch step) comes from the shared VMEM budget
+# (:func:`slate_tpu.ops.vmem.batch_per_launch`), not a per-gate
+# constant.
+# ---------------------------------------------------------------------------
+
+
+def _chol_blocked_value(a, ib):
+    """Value-form right-looking blocked Cholesky of ONE (n, n) SPD
+    problem — :func:`_chol_inv_kernel`'s loop re-expressed over values
+    so the batched kernel can run it per resident problem: ib-block
+    diagonal chol (:func:`_chol_unblocked`) + block inverse
+    (:func:`_trtri_unblocked`) turn the panel trsm into an MXU gemm,
+    the trailing update is a rank-ib gemm.  Returns the lower factor
+    (upper triangle zeroed)."""
+
+    n = a.shape[-1]
+    dt = jnp.promote_types(a.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    for k0 in range(0, n, ib):
+        blk = _chol_unblocked(a[k0:k0 + ib, k0:k0 + ib], ib)
+        a = a.at[k0:k0 + ib, k0:k0 + ib].set(blk)
+        if k0 + ib < n:
+            binv = _trtri_unblocked(blk, ib)
+            a21 = a[k0 + ib:, k0:k0 + ib]
+            l21 = jnp.dot(a21, binv.T, preferred_element_type=dt,
+                          precision=hi)
+            a = a.at[k0 + ib:, k0:k0 + ib].set(l21)
+            a = a.at[k0 + ib:, k0 + ib:].add(
+                -jnp.dot(l21, l21.T, preferred_element_type=dt,
+                         precision=hi))
+    rows = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    return jnp.where(rows >= cols, a, 0.0)
+
+
+def _potrf_batched_kernel(a_ref, l_ref, *, bt, ib):
+    for b in range(bt):
+        l_ref[b] = _chol_blocked_value(a_ref[b], ib)
+
+
+@_x32_trace
+def potrf_batched(a, *, bt: int = 1, ib: int = 32):
+    """Grid-batched Cholesky: ``a`` is (B, n, n) SPD, returns the (B,
+    n, n) lower factors from ONE pallas_call whose grid iterates
+    B/bt batch blocks (``bt`` resident problems per step).  Requires
+    ``B % bt == 0`` and ``n % ib == 0``; f32 on TPU, f32/f64 in
+    interpret mode."""
+
+    bsz, n, n2 = a.shape
+    assert n == n2 and bsz % bt == 0 and n % min(ib, n) == 0, (a.shape, bt)
+    ib = min(ib, n)
+    dt = jnp.promote_types(a.dtype, jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_potrf_batched_kernel, bt=bt, ib=ib),
+        grid=(bsz // bt,),
+        in_specs=[pl.BlockSpec((bt, n, n), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((bt, n, n), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bsz, n, n), dt),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
+        interpret=_interpret(),
+    )(a.astype(dt))
+
+
+def _lu_scattered_value(at, ib):
+    """Value-form scattered-row partial-pivot LU of ONE square problem
+    held LANE-MAJOR (``at`` is Aᵀ, (n, n)) — the elimination core of
+    :func:`_factor_panel_linv_kernel` with the panel width equal to the
+    whole problem (for the batched small-problem workload the problem
+    IS the panel): TRUE partial pivoting as a masked argmax over the
+    still-active lanes, rows never move, ib-block trailing updates run
+    as MXU gemms with the pivot-row gather folded in as one-hot dots.
+    Returns ``(at_factored, piv (1, n) int32, act (1, n))`` — packed
+    factor rows live in the pivot lanes (``at[:, piv].T`` is the
+    LAPACK-packed LU)."""
+
+    n, m = at.shape
+    dt = jnp.promote_types(at.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    iota_lane = jax.lax.broadcasted_iota(jnp.int32, (1, m), 1)
+    iota_sub = jax.lax.broadcasted_iota(jnp.int32, (ib, 1), 0)
+    iota_ibrow = jax.lax.broadcasted_iota(jnp.int32, (1, ib), 1)
+    eye_ib = (jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 0)
+              == jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 1)
+              ).astype(dt)
+    tril_ib = (jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 0)
+               > jax.lax.broadcasted_iota(jnp.int32, (ib, ib), 1))
+    act = jnp.ones((1, m), dt)
+    piv = jnp.zeros((1, n), jnp.int32)
+
+    for s0 in range(0, n, ib):
+        def col_step(j, carry, s0=s0):
+            sub, act, pivb, ohsub = carry
+            col = jax.lax.dynamic_slice_in_dim(sub, j, 1, axis=0)
+            mag = jnp.abs(col) * act
+            mx = jnp.max(mag)
+            cand = jnp.where((mag >= mx) & (act > 0), iota_lane, m)
+            p = jnp.min(cand).astype(jnp.int32)
+            pivb = jnp.where(iota_ibrow == j, p, pivb)
+            oh = (iota_lane == p).astype(dt)
+            pval = jnp.sum(col * oh)
+            safe = jnp.where(pval == 0, 1.0, pval)
+            live = (act > 0) & (oh == 0)
+            lrow = jnp.where(live, col / safe, 0.0)
+            newcol = jnp.where(live, lrow, col)
+            pcol = jnp.sum(sub * oh, axis=1, keepdims=True)
+            sub = jnp.where(iota_sub == j, newcol,
+                            sub - jnp.where(iota_sub > j, pcol, 0.0) * lrow)
+            ohsub = jnp.where(iota_sub == j, oh, ohsub)
+            act = act * (1.0 - oh)
+            return sub, act, pivb, ohsub
+
+        sub, act, pivb, ohsub = jax.lax.fori_loop(
+            0, ib, col_step,
+            (at[s0:s0 + ib], act, jnp.zeros((1, ib), jnp.int32),
+             jnp.zeros((ib, m), dt)))
+        at = at.at[s0:s0 + ib].set(sub)
+        piv = jax.lax.dynamic_update_slice(piv, pivb, (0, s0))
+        if s0 + ib < n:
+            # trailing block rows: pivot-row gather as one-hot dots, the
+            # ib-block u12 solve against the block's unit-lower inverse,
+            # rank-ib MXU update with the L-part/pivot-part fused into
+            # one operand (ohsub − lsubt), as in the fused panel kernel
+            l11 = jax.lax.dot_general(
+                ohsub, sub, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=dt, precision=hi)
+            l11u = jnp.where(tril_ib, l11, 0.0) + eye_ib
+            l11inv = _trtri_unblocked(l11u, ib)
+            rest = at[s0 + ib:]
+            ut = jax.lax.dot_general(
+                rest, ohsub, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=dt, precision=hi)
+            u12t = jnp.dot(ut, l11inv.T, preferred_element_type=dt,
+                           precision=hi)
+            pivm = jnp.sum(ohsub, axis=0, keepdims=True)
+            lsubt = sub * act
+            at = at.at[s0 + ib:].set(
+                rest * (1.0 - pivm)
+                + jnp.dot(u12t, ohsub - lsubt, preferred_element_type=dt,
+                          precision=hi))
+    return at, piv, act
+
+
+def _getrf_batched_kernel(at_ref, out_ref, piv_ref, *, bt, ib):
+    for b in range(bt):
+        at, piv, _ = _lu_scattered_value(at_ref[b], ib)
+        out_ref[b] = at
+        piv_ref[b] = piv
+
+
+@_x32_trace
+def getrf_batched(at, *, bt: int = 1, ib: int = 32):
+    """Grid-batched partial-pivot LU: ``at`` is (B, n, n) holding each
+    problem TRANSPOSED (lane-major); returns ``(at_factored, piv)``
+    with ``piv`` (B, n) — per problem ``at_factored[b][:, piv[b]].T``
+    is the LAPACK-packed LU of ``at[b].T`` and ``piv[b]`` the full row
+    permutation (square problems pivot every row).  ONE pallas_call,
+    grid over B/bt batch blocks.  Requires ``B % bt == 0`` and
+    ``n % ib == 0``; f32 on TPU, f32/f64 in interpret mode."""
+
+    bsz, n, n2 = at.shape
+    assert n == n2 and bsz % bt == 0 and n % min(ib, n) == 0, (at.shape, bt)
+    ib = min(ib, n)
+    dt = jnp.promote_types(at.dtype, jnp.float32)
+    out, piv = pl.pallas_call(
+        functools.partial(_getrf_batched_kernel, bt=bt, ib=ib),
+        grid=(bsz // bt,),
+        in_specs=[pl.BlockSpec((bt, n, n), lambda i: (i, 0, 0))],
+        out_specs=(pl.BlockSpec((bt, n, n), lambda i: (i, 0, 0)),
+                   pl.BlockSpec((bt, 1, n), lambda i: (i, 0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((bsz, n, n), dt),
+                   jax.ShapeDtypeStruct((bsz, 1, n), jnp.int32)),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
+        interpret=_interpret(),
+    )(at.astype(dt))
+    return out, piv[:, 0, :]

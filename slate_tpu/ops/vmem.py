@@ -1,0 +1,82 @@
+"""Shared VMEM working-set budget arithmetic for the fused Pallas
+kernels — ONE place that knows how much scratch a ``pallas_call`` may
+pin, instead of per-gate copy-pasted constants.
+
+Every fused mega-kernel (LU panel/step, potrf step, and the grid-batched
+many-problem kernels) pins a ~110 MB ``vmem_limit_bytes`` in its
+compiler params and must leave headroom for Mosaic's own spills; until
+round 8 each eligibility gate carried its own ``100 * 1024 * 1024``
+literal and its own bytes formula.  The batched drivers make that
+untenable: their gates must additionally solve for **B-per-launch** (how
+many whole problems one grid step may hold resident), which is the same
+budget question asked one more time.  This module centralizes it:
+
+* :data:`BUDGET_BYTES` — the single shared working-set budget;
+* :func:`fits` — does a working set fit;
+* :func:`batch_per_launch` — the largest per-grid-step problem count
+  whose resident working set fits (the batched kernels' ``bt``).
+
+The budget is overridable per process with ``SLATE_TPU_VMEM_BUDGET_MB``
+(new TPU generations ship different VMEM sizes; the gates all move
+together).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["BUDGET_BYTES", "PALLAS_CALL_LIMIT_BYTES", "budget_bytes",
+           "pallas_call_limit_bytes", "fits", "batch_per_launch"]
+
+#: default ``vmem_limit_bytes`` the fused kernels pin in their
+#: pallas_call compiler params (what Mosaic is allowed to allocate).
+PALLAS_CALL_LIMIT_BYTES = 110 * 1024 * 1024
+
+#: default working-set budget the ELIGIBILITY gates plan against — the
+#: pinned limit minus headroom for Mosaic's own spills/temporaries.
+BUDGET_BYTES = 100 * 1024 * 1024
+
+#: the headroom between what the gates plan and what the kernels pin
+#: — kept as the DIFFERENCE so an env-overridden budget moves both
+#: numbers together (a raised budget with a stale 110 MB pin would
+#: admit working sets Mosaic cannot allocate).
+_HEADROOM_BYTES = PALLAS_CALL_LIMIT_BYTES - BUDGET_BYTES
+
+
+def budget_bytes() -> int:
+    """The planning budget, honouring ``SLATE_TPU_VMEM_BUDGET_MB``."""
+    raw = os.environ.get("SLATE_TPU_VMEM_BUDGET_MB", "").strip()
+    if raw:
+        try:
+            return int(float(raw) * 1024 * 1024)
+        except ValueError:
+            pass
+    return BUDGET_BYTES
+
+
+def pallas_call_limit_bytes() -> int:
+    """The ``vmem_limit_bytes`` a fused kernel should pin: the planning
+    budget plus the spill headroom — tracks the env override so the
+    gates and the compiler cap can never disagree."""
+    return budget_bytes() + _HEADROOM_BYTES
+
+
+def fits(working_set_bytes: float) -> bool:
+    """True when a kernel's resident working set fits the budget."""
+    return working_set_bytes <= budget_bytes()
+
+
+def batch_per_launch(per_problem_bytes: float, fixed_bytes: float = 0.0,
+                     cap: int = 0) -> int:
+    """How many whole problems one grid step of a batched kernel may
+    hold resident: the largest ``bt ≥ 1`` with ``fixed_bytes + bt ·
+    per_problem_bytes`` inside the budget (0 when even one problem
+    doesn't fit).  ``cap`` bounds the answer (e.g. the actual batch
+    size, or a lane-dimension tile limit)."""
+    if per_problem_bytes <= 0:
+        return max(1, cap) if cap else 1
+    avail = budget_bytes() - fixed_bytes
+    bt = int(avail // per_problem_bytes)
+    if cap:
+        bt = min(bt, cap)
+    return max(0, bt)

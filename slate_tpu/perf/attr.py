@@ -98,7 +98,8 @@ _DEF_PEAKS = {
 }
 
 _LABEL_RE = re.compile(
-    r"^(?P<routine>[a-z0-9]+?)_(?P<dtype>fp32|fp64|bf16|c64|c128)_"
+    r"^(?P<routine>[a-z0-9]+?)(?:_batched)?_"
+    r"(?P<dtype>fp32|fp64|bf16|c64|c128)_"
     r"(?P<dims>.+)$")
 _DIM_RE = re.compile(r"^([a-z]+)([0-9]+)$")
 
@@ -147,8 +148,11 @@ def peaks(platform: str = "tpu", dtype: str = "fp32") -> dict:
 
 def parse_label(label: str):
     """``getrf_fp32_n8192_nb512`` → ``("getrf", "fp32", {"n": 8192,
-    "nb": 512})``.  Labels that don't match the bench convention return
-    ``(label, "", {})``."""
+    "nb": 512})``.  Batched-driver labels carry a ``_batched`` marker
+    and a leading-batch-dim token (``posv_batched_fp32_n256_b64`` →
+    ``("posv", "fp32", {"n": 256, "b": 64})``) — the routine keeps its
+    base name and the model scales by ``b``.  Labels that don't match
+    the bench convention return ``(label, "", {})``."""
     m = _LABEL_RE.match(label or "")
     if not m:
         return (label, "", {})
@@ -166,29 +170,39 @@ def parse_label(label: str):
 
 def model_flops(routine: str, dims: dict):
     """The driver's model flop count — the figure ``bench.py`` divides
-    wall time by.  None for routines without a model."""
+    wall time by.  A leading batch dim (``dims["b"]``, the batched
+    drivers) scales the whole count; solve drivers (posv/gesv) count
+    the factor plus the triangular sweeps over ``nrhs`` (``dims["k"]``,
+    default 1).  None for routines without a model."""
     n = dims.get("n")
     m = dims.get("m", n)
     if not n or not m:
         return None
+    bfac = max(1, int(dims.get("b", 1)))
     k = min(m, n)
+    nrhs = dims.get("k", 1)
     if routine in ("gemm", "mxu"):
         kk = dims.get("k", k)
-        return 2.0 * m * n * kk
+        return bfac * 2.0 * m * n * kk
     if routine == "potrf":
-        return n ** 3 / 3.0
+        return bfac * n ** 3 / 3.0
+    if routine == "posv":
+        return bfac * (n ** 3 / 3.0 + 2.0 * n * n * nrhs)
     if routine == "getrf":
         # m·n·k − (m+n)k²/2 + k³/3 MACs ×2; = 2n³/3 for square
-        return 2.0 * (m * n * k - (m + n) * k * k / 2.0 + k ** 3 / 3.0)
+        return bfac * 2.0 * (m * n * k - (m + n) * k * k / 2.0
+                             + k ** 3 / 3.0)
+    if routine == "gesv":
+        return bfac * (2.0 * n ** 3 / 3.0 + 2.0 * n * n * nrhs)
     if routine in ("geqrf", "gels"):
         fl = 2.0 * max(m, n) * k * k - 2.0 * k ** 3 / 3.0
         if routine == "gels":
             fl += 4.0 * m * n
-        return fl
+        return bfac * fl
     if routine == "heev":
-        return 4.0 * n ** 3 / 3.0
+        return bfac * 4.0 * n ** 3 / 3.0
     if routine == "svd":
-        return 8.0 * n ** 3 / 3.0
+        return bfac * 8.0 * n ** 3 / 3.0
     return None
 
 
@@ -297,22 +311,37 @@ def stage_model(routine: str, dims: dict, dtype: str = "fp32",
     isz = _ITEMSIZE.get(dtype or "fp32", 4)
     n = dims.get("n")
     m = dims.get("m", n)
+    bfac = max(1, int(dims.get("b", 1)))
+    nrhs = dims.get("k", 1)
     nb = min(dims.get("nb") or DEFAULT_NB, min(m, n))
     if routine in ("gemm", "mxu"):
         k = dims.get("k", min(m, n))
         raw = {"mxu": [2.0 * m * n * k,
                        (m * k + k * n + 2.0 * m * n) * isz]}
         rts = 0.0
-    elif routine == "getrf":
+    elif routine in ("getrf", "gesv"):
         raw, rts = _stages_getrf(m, n, nb, isz, fusion)
-    elif routine == "potrf":
+        if routine == "gesv":
+            _acc(raw, "solve", 2.0 * n * n * nrhs,
+                 (n * n + 2.0 * n * nrhs) * isz)
+    elif routine in ("potrf", "posv"):
         raw, rts = _stages_potrf(n, nb, isz, fusion)
+        if routine == "posv":
+            _acc(raw, "solve", 2.0 * n * n * nrhs,
+                 (n * n + 2.0 * n * nrhs) * isz)
     elif routine in ("geqrf", "gels"):
         raw, rts = _stages_geqrf(m, n, nb, isz, routine == "gels")
     elif routine in ("heev", "svd"):
-        raw, rts = _stages_twostage(n, isz, total)
+        raw, rts = _stages_twostage(n, isz, total / bfac)
     else:
         return None
+    if bfac > 1:
+        # leading batch dim: per-problem stage bytes and round trips
+        # scale with the batch; flops ride the normalization below
+        # (``total`` already carries the ×b)
+        for st in raw.values():
+            st[1] *= bfac
+        rts *= bfac
     raw_total = sum(f for f, _ in raw.values())
     scale = total / raw_total if raw_total > 0 else 1.0
     stages = [{"stage": s, "flops": raw[s][0] * scale,

@@ -58,7 +58,8 @@ __all__ = [
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
     "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
     "choose_geqrf_panel", "choose_chase", "choose_lu_step",
-    "choose_potrf_step", "choose_dist_panel",
+    "choose_potrf_step", "choose_dist_panel", "choose_batched_potrf",
+    "choose_batched_lu", "choose_batched_qr",
 ]
 
 #: timed repetitions per surviving candidate (after the compile/warm rep)
@@ -1133,6 +1134,145 @@ def choose_chase(kind: str, n: int, kd: int, dtype, eligible: bool) -> str:
     return decide("chase", key, cands)
 
 
+def _batched_common(op: str, b: int, n: int, dtype, eligible: bool,
+                    grid_name: str = "grid"):
+    """Shared front half of the batched-site choosers: the pow2-BUCKETED
+    key over BOTH batch size and n (Design-in-Tiles: one probe serves a
+    bucket — a timing probe per exact (B, n) is too slow when the
+    serving layer produces many buckets), plus the knob/off-TPU
+    short-circuits.  Returns ``(key, dt, short_circuit_backend|None)``.
+    The vmapped-composed candidate is the heuristic default: off-TPU
+    grid-batched interpret timings are meaningless, and the forced knob
+    is honoured so interpret CI can pin the grid path."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (_bucket_dim(b), _bucket_dim(n), dt.name, _precision_name())
+    if not eligible:
+        return key, dt, _static(op, key, "vmapped", "ineligible")
+    if config.use_pallas_mode() == "off":
+        return key, dt, _static(op, key, "vmapped", "forced-config")
+    if config.use_pallas_mode() == "on":
+        return key, dt, _static(op, key, grid_name, "forced-config")
+    if not _on_tpu():
+        forced = _forced(op)
+        if forced in (grid_name, "vmapped"):
+            return key, dt, _static(op, key, forced, "forced")
+        return key, dt, _static(op, key, "vmapped", "default")
+    return key, dt, None
+
+
+def choose_batched_potrf(b: int, n: int, dtype, eligible: bool) -> str:
+    """Backend for the leading-batch-dim Cholesky driver
+    (:func:`slate_tpu.linalg.batched.potrf_batched`): ``"grid"`` (ONE
+    pallas_call owns B problems — grid over batch blocks, whole
+    problems VMEM-resident, :func:`ops.pallas_kernels.potrf_batched`)
+    vs ``"vmapped"`` (vmap-composed ``lax.linalg.cholesky`` — XLA's
+    batching of the fused single-problem kernel).  ``eligible`` is the
+    call site's shape/VMEM gate."""
+
+    key, dt, short = _batched_common("batched_potrf", b, n, dtype, eligible)
+    if short is not None:
+        return short
+    bb, nn = key[0], key[1]
+    probes: dict = {}
+
+    def _spd_batch():
+        def mk():
+            import jax.numpy as jnp
+
+            g = _randn((bb, nn, nn), dt, 20)
+            eye = nn * jnp.eye(nn, dtype=dt)
+            return jnp.einsum("bij,bkj->bik", g, g) + eye[None]
+        return _memo(probes, "spd", mk)
+
+    def setup_grid():
+        from ..linalg.batched import _potrf_grid
+
+        return _timed_call(_potrf_grid, _spd_batch())
+
+    def setup_vmapped():
+        from ..linalg.batched import _potrf_vmapped
+
+        return _timed_call(_potrf_vmapped, _spd_batch())
+
+    def check(out):
+        from ..linalg.batched import batched_factor_resid_potrf
+
+        return batched_factor_resid_potrf(_spd_batch(), out) < 100.0
+
+    return decide("batched_potrf", key, [
+        Candidate("vmapped", setup_vmapped),
+        Candidate("grid", setup_grid, check),
+    ])
+
+
+def choose_batched_lu(b: int, n: int, dtype, eligible: bool) -> str:
+    """Backend for the leading-batch-dim partial-pivot LU driver
+    (:func:`slate_tpu.linalg.batched.getrf_batched`): ``"grid"`` (one
+    pallas_call, scattered-row masked-argmax pivoting per resident
+    problem) vs ``"vmapped"`` (vmap-composed ``lax.linalg.lu``)."""
+
+    key, dt, short = _batched_common("batched_lu", b, n, dtype, eligible)
+    if short is not None:
+        return short
+    bb, nn = key[0], key[1]
+    probes: dict = {}
+
+    def _a_batch():
+        def mk():
+            import jax.numpy as jnp
+
+            return (_randn((bb, nn, nn), dt, 21)
+                    + nn * jnp.eye(nn, dtype=dt)[None])
+        return _memo(probes, "a", mk)
+
+    def setup_grid():
+        from ..linalg.batched import _getrf_grid
+
+        return _timed_call(_getrf_grid, _a_batch())
+
+    def setup_vmapped():
+        from ..linalg.batched import _getrf_vmapped
+
+        return _timed_call(_getrf_vmapped, _a_batch())
+
+    def check(out):
+        from ..linalg.batched import batched_factor_resid_lu
+
+        return batched_factor_resid_lu(_a_batch(), out) < 100.0
+
+    return decide("batched_lu", key, [
+        Candidate("vmapped", setup_vmapped),
+        Candidate("grid", setup_grid, check),
+    ])
+
+
+def choose_batched_qr(b: int, m: int, n: int, dtype) -> str:
+    """Backend for the leading-batch-dim QR/least-squares drivers:
+    today a single candidate (``"vmapped"`` — XLA's batched Householder
+    geqrf), registered through the table so the site is enumerable and
+    a grid-batched candidate can arbitrate here later without touching
+    the call sites."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (_bucket_dim(b), _bucket_dim(m), _bucket_dim(n), dt.name,
+           _precision_name())
+
+    def setup_vmapped():
+        from ..linalg.batched import _geqrf_vmapped
+
+        return _timed_call(lambda x: _geqrf_vmapped(x)[0],
+                           _randn((key[0], key[1], key[2]), dt, 22))
+
+    return decide("batched_qr", key, [Candidate("vmapped", setup_vmapped)])
+
+
 #: op name → chooser, the :func:`select` registry.  ``method.select_backend``
 #: is the driver-facing façade over this table.
 _CHOOSERS = {
@@ -1160,6 +1300,12 @@ _CHOOSERS = {
                                                    kw["nb"], kw["dtype"]),
     "chase": lambda **kw: choose_chase(kw["kind"], kw["n"], kw["kd"],
                                        kw["dtype"], kw["eligible"]),
+    "batched_potrf": lambda **kw: choose_batched_potrf(
+        kw["b"], kw["n"], kw["dtype"], kw["eligible"]),
+    "batched_lu": lambda **kw: choose_batched_lu(
+        kw["b"], kw["n"], kw["dtype"], kw["eligible"]),
+    "batched_qr": lambda **kw: choose_batched_qr(
+        kw["b"], kw["m"], kw["n"], kw["dtype"]),
 }
 
 

@@ -31,7 +31,11 @@ Metric direction: submetrics are GFLOP/s (higher is better) except the
 per-stage wall-time keys bench emits for the two-stage eig/SVD
 pipelines (suffix ``_s``, e.g. ``heev_fp64_n1024_stage2_chase_s``) —
 those are seconds, LOWER is better, and the verdict logic inverts the
-sign so a faster stage reads IMPROVE, not REGRESS.
+sign so a faster stage reads IMPROVE, not REGRESS.  The batched
+serving-throughput family (suffix ``_solves_per_s``, r8 bench) is a
+RATE again — higher is better — so :func:`direction` carves it back
+out of the wall-time rule; the sentinel pins serving throughput like
+any other metric.
 
 Gap explanation (r7): when the sentinel flags a drop, :func:`explain`
 diffs the two artifacts' roofline attribution blocks (bench r7 embeds
@@ -51,15 +55,16 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = [
-    "Artifact", "Report", "Row", "load_artifact", "diff", "explain",
-    "format_table", "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
+    "Artifact", "Report", "Row", "load_artifact", "diff", "direction",
+    "explain", "format_table", "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
 ]
 
 #: flag a drop bigger than this (percent) between consecutive artifacts
 DEFAULT_THRESHOLD_PCT = 5.0
 
 _LABEL_RE = re.compile(
-    r"^(?P<routine>[a-z0-9]+?)_(?P<dtype>fp32|fp64|bf16|c64|c128)_"
+    r"^(?P<routine>[a-z0-9]+?)(?P<batched>_batched)?_"
+    r"(?P<dtype>fp32|fp64|bf16|c64|c128)_"
     r"(?P<dims>.+)$")
 
 #: submetric-label prefix → the autotune op sites that produce it (for
@@ -72,16 +77,35 @@ _OPS_FOR_ROUTINE = {
     "geqrf": ("geqrf_panel",),
     "gels": ("geqrf_panel",),
     "trtri": ("trtri_panel",),
+    # batched-driver labels (<op>_batched_<dtype>_n<n>_b<B>): the
+    # backend tag is the batched site's grid-vs-vmapped decision
+    "potrf_batched": ("batched_potrf",),
+    "getrf_batched": ("batched_lu",),
+    "posv_batched": ("batched_potrf",),
+    "gesv_batched": ("batched_lu",),
+    "geqrf_batched": ("batched_qr",),
+    "gels_batched": ("batched_qr",),
 }
 
 
 def parse_label(label: str):
     """``geqrf_fp32_m32768_n4096`` → ("geqrf", "fp32", "m32768_n4096");
-    labels that don't match keep their full text as the routine."""
+    batched labels keep their ``_batched`` marker in the routine
+    (``posv_batched_fp32_n256_b64`` → ("posv_batched", ...)); labels
+    that don't match keep their full text as the routine."""
     m = _LABEL_RE.match(label)
     if not m:
         return (label, "", "")
-    return (m.group("routine"), m.group("dtype"), m.group("dims"))
+    return (m.group("routine") + (m.group("batched") or ""),
+            m.group("dtype"), m.group("dims"))
+
+
+def direction(label: str) -> float:
+    """+1 when bigger is better (GFLOP/s, ``*_solves_per_s`` rates,
+    speedup ratios), −1 for wall-second keys (``*_s`` stage timers)."""
+    if label.endswith("_per_s"):
+        return 1.0
+    return -1.0 if label.endswith("_s") else 1.0
 
 
 @dataclass
@@ -254,9 +278,10 @@ def diff(artifacts: List[Artifact],
             continue
         worst_drop = 0.0
         best_gain = 0.0
-        # "_s"-suffixed labels are wall SECONDS (the per-stage eig/SVD
-        # submetrics): lower is better, so the sign flips
-        sign = -1.0 if label.endswith("_s") else 1.0
+        # "_s"-suffixed labels are wall SECONDS (lower is better, the
+        # sign flips) — EXCEPT the "*_per_s" throughput rates, which
+        # are higher-is-better like GFLOP/s (see :func:`direction`)
+        sign = direction(label)
         prev = None
         for v in vals:
             if v is None:

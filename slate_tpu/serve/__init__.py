@@ -1,0 +1,25 @@
+"""slate_tpu.serve — the async serving front door over the batched
+drivers (:mod:`slate_tpu.linalg.batched`): request-batching queue with
+(op, dtype, shape-bucket) buckets under a max-wait/max-batch policy,
+one AOT-compiled executable per bucket, futures back to the caller,
+and a zero-compile warm start from the persisted autotune cache.  See
+:mod:`slate_tpu.serve.queue` for the full design.
+
+Quick start::
+
+    from slate_tpu import serve
+
+    serve.warm_start(specs=[{"op": "posv", "batch": 64, "dims": (256,)}])
+    fut = serve.submit("posv", spd, rhs)     # one (n, n) + (n,) problem
+    x = fut.result()
+
+Importing this package starts no threads; the dispatcher thread spawns
+on the first :func:`submit` and is a daemon (a serving process exits
+cleanly without an explicit :func:`shutdown`, but draining via
+``shutdown()`` is polite).
+"""
+
+from .queue import (  # noqa: F401
+    BatchQueue, ServeConfig, SUPPORTED_OPS, get_server, shutdown,
+    specs_from_autotune_cache, submit, warm_start,
+)

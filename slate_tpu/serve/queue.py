@@ -1,0 +1,563 @@
+"""Async request-batching queue in front of the batched drivers — the
+serving front door.
+
+A serving process receives a stream of SMALL independent problems
+(per-user covariance solves, least squares, whitening).  Dispatching
+each as its own device program wastes the accelerator on launch latency
+and compile-cache walks; this module batches them:
+
+* :meth:`BatchQueue.submit` accepts one problem (``op``, operands),
+  returns a :class:`concurrent.futures.Future`, and files the request
+  into a **bucket** keyed by ``(op, dtype, shape-bucket)`` — dims are
+  pow2-bucketed (operands are padded, results sliced back), so the
+  process compiles ONE executable per bucket instead of one per exact
+  shape — the same bucketing the ``batched_*`` autotune keys use.
+* A dispatcher thread drains buckets under a **max-wait / max-batch**
+  policy: a bucket dispatches as soon as it holds
+  :attr:`ServeConfig.max_batch` requests, or when its oldest request
+  has waited :attr:`ServeConfig.max_wait_s`.
+* Each dispatch pads the batch dim to its pow2 occupancy bucket,
+  executes the **AOT-compiled** bucket executable (one compiled
+  program per (bucket, padded-batch) key), and resolves the futures
+  with the per-problem slices.
+* :func:`warm_start` AOT-compiles bucket executables at startup —
+  from explicit specs or from the persisted autotune cache — so a
+  fresh process serves its first request with zero timing reps and
+  zero on-demand compiles (the acceptance criterion; asserted via the
+  metrics compile-watch counters in CI).
+
+Queue observability flows through the existing metrics registry
+(:mod:`slate_tpu.perf.metrics`):
+
+* ``serve.requests`` / ``serve.dispatches`` counters,
+* ``serve.queue.depth`` gauge (requests waiting across buckets),
+* ``serve.wait`` timer (submit → dispatch per request),
+* ``serve.dispatch`` timer (pad + execute + resolve per dispatch),
+* ``serve.batch.occupancy`` histogram (requests per dispatch),
+* ``serve.compile.on_demand`` / ``serve.warm_start.compiled`` counters
+  (an on-demand compile on the serving path is exactly what warm start
+  exists to eliminate — the counter makes the claim checkable).
+
+The queue deliberately knows nothing about backends: it calls ONLY the
+batched driver facades (:mod:`slate_tpu.linalg.batched`), which resolve
+through the autotune table like every other op site — the registry
+guard test pins that no ``serve/`` module reaches into ``ops/``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..perf import metrics
+
+__all__ = ["ServeConfig", "BatchQueue", "warm_start", "get_server",
+           "submit", "shutdown", "SUPPORTED_OPS"]
+
+
+def _bucket(d: int, policy: str = "pow2", floor: int = 8) -> int:
+    """Pow2 shape bucket (floor 8 for dims — the autotune keys' floor;
+    batch OCCUPANCY buckets pass floor=1 so a lone request is not padded
+    8×) — one compiled executable per bucket."""
+    if policy == "exact":
+        return int(d)
+    return max(floor, 1 << (max(1, int(d)) - 1).bit_length())
+
+
+@dataclass
+class ServeConfig:
+    """Queue policy knobs.
+
+    * ``max_batch`` — dispatch a bucket the moment it holds this many
+      requests (also the executable's largest padded batch).
+    * ``max_wait_s`` — dispatch a bucket when its oldest request has
+      waited this long, whatever its occupancy (tail-latency bound).
+    * ``bucket`` — ``"pow2"`` (default: pad dims to the next power of
+      two, one executable per bucket) or ``"exact"`` (no dim padding —
+      one executable per exact shape; for fleets with few shapes).
+    """
+
+    max_batch: int = 64
+    max_wait_s: float = 0.002
+    bucket: str = "pow2"
+
+
+@dataclass
+class _Request:
+    operands: tuple
+    shape: tuple            # original dims, for unpadding
+    future: concurrent.futures.Future = field(
+        default_factory=concurrent.futures.Future)
+    t_submit: float = field(default_factory=time.perf_counter)
+
+
+#: op name → number of operands.  Every op maps onto one batched driver
+#: facade; results are the driver's natural per-problem output.
+SUPPORTED_OPS = {"potrf": 1, "getrf": 1, "posv": 2, "gesv": 2,
+                 "geqrf": 1, "gels": 2}
+
+
+def _exec_key(op: str, dt: str, pol: str, dims: tuple,
+              nrhs: int = 1) -> tuple:
+    """The executable bucket key for RAW problem dims — ONE function
+    shared by :meth:`BatchQueue.bucket_key` (the request path) and
+    :meth:`BatchQueue.warm` so the two can never compute different keys
+    for the same problem (a warm/serve key mismatch silently defeats
+    the zero-compile guarantee).
+
+    Tall ops (geqrf/gels) bump the padded row count until
+    ``M − m ≥ N − n`` holds for the RAW (m, n): ``_pad_tall`` anchors
+    each padded column with a 1 in its own padded row, so the bump is
+    what keeps the anchors in bounds (and the padded operand full
+    column rank).  The nrhs bucket uses floor 1 — the common single-rhs
+    solve must not pay an 8-column pad."""
+    if op in ("potrf", "getrf"):
+        return (op, dt, _bucket(dims[0], pol))
+    if op in ("posv", "gesv"):
+        return (op, dt, _bucket(dims[0], pol),
+                _bucket(nrhs, pol, floor=1))
+    if op in ("geqrf", "gels"):
+        m, n = dims
+        big_m, big_n = _bucket(m, pol), _bucket(n, pol)
+        while big_m - m < big_n - n:
+            big_m *= 2
+        if op == "geqrf":
+            return (op, dt, big_m, big_n)
+        return (op, dt, big_m, big_n, _bucket(nrhs, pol, floor=1))
+    raise KeyError(f"unsupported serve op {op!r}; "
+                   f"known: {sorted(SUPPORTED_OPS)}")
+
+
+def _pad_square(a, big):
+    """Embed (n, n) into (N, N) as ``[[A, 0], [0, I]]`` — stays SPD /
+    nonsingular, and the padded block factors to the identity without
+    perturbing the leading problem."""
+    import numpy as np
+
+    n = a.shape[0]
+    if big == n:
+        return np.asarray(a)
+    out = np.zeros((big, big), a.dtype)
+    out[:n, :n] = np.asarray(a)
+    idx = np.arange(n, big)
+    out[idx, idx] = 1.0
+    return out
+
+
+def _pad_tall(a, big_m, big_n):
+    """Embed a tall (m, n) least-squares operand into (M, N): original
+    block top-left, unit columns for the padded unknowns in the padded
+    rows — full column rank, and ``x' = [x; 0]`` for ``b' = [b; 0]``.
+    Requires ``M − m ≥ N − n`` (the bucketing bumps M until it holds)."""
+    import numpy as np
+
+    m, n = a.shape
+    if (big_m, big_n) == (m, n):
+        return np.asarray(a)
+    out = np.zeros((big_m, big_n), a.dtype)
+    out[:m, :n] = np.asarray(a)
+    k = big_n - n
+    if k:
+        out[m + np.arange(k), n + np.arange(k)] = 1.0
+    return out
+
+
+def _pad_rhs(b, big_rows, big_cols):
+    import numpy as np
+
+    bv = np.asarray(b)
+    out = np.zeros((big_rows, big_cols), bv.dtype)
+    if bv.ndim == 1:
+        out[:bv.shape[0], 0] = bv
+    else:
+        out[:bv.shape[0], :bv.shape[1]] = bv
+    return out
+
+
+class BatchQueue:
+    """The serving front door: request buckets + dispatcher thread +
+    per-bucket compiled-executable cache."""
+
+    def __init__(self, config: Optional[ServeConfig] = None):
+        self.config = config or ServeConfig()
+        self._buckets: Dict[tuple, List[_Request]] = {}
+        self._compiled: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- bucketing ---------------------------------------------------------
+
+    def bucket_key(self, op: str, operands) -> tuple:
+        """``(op, dtype, padded dims...)`` — the executable identity
+        (minus the padded batch size, which the dispatch appends).
+        Delegates to :func:`_exec_key` (shared with :meth:`warm`)."""
+        a = operands[0]
+        nrhs = 1
+        if op in ("posv", "gesv", "gels"):
+            b = operands[1]
+            nrhs = 1 if getattr(b, "ndim", 1) == 1 else b.shape[1]
+        dims = tuple(a.shape) if op in ("geqrf", "gels") \
+            else (a.shape[0],)
+        return _exec_key(op, str(a.dtype), self.config.bucket, dims,
+                         nrhs)
+
+    # -- public API --------------------------------------------------------
+
+    def submit(self, op: str, *operands) -> concurrent.futures.Future:
+        """File one problem; returns the Future of its result (the
+        batched driver's per-problem output: potrf→L, getrf→(LU, perm),
+        posv/gesv/gels→x, geqrf→(packed, taus))."""
+        if op not in SUPPORTED_OPS:
+            raise KeyError(f"unsupported serve op {op!r}; "
+                           f"known: {sorted(SUPPORTED_OPS)}")
+        if len(operands) != SUPPORTED_OPS[op]:
+            raise TypeError(f"{op} takes {SUPPORTED_OPS[op]} operands, "
+                            f"got {len(operands)}")
+        key = self.bucket_key(op, operands)
+        req = _Request(operands=tuple(operands),
+                       shape=tuple(getattr(x, "shape", ())
+                                   for x in operands))
+        with self._wake:
+            if self._closed:
+                raise RuntimeError("BatchQueue is closed")
+            self._buckets.setdefault(key, []).append(req)
+            depth = sum(len(v) for v in self._buckets.values())
+            self._ensure_thread()
+            self._wake.notify_all()
+        metrics.inc("serve.requests")
+        metrics.set_gauge("serve.queue.depth", float(depth))
+        return req.future
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued request has been dispatched."""
+        deadline = None if timeout is None else time.perf_counter() + timeout
+        with self._wake:
+            while any(self._buckets.values()):
+                rem = None if deadline is None \
+                    else max(0.0, deadline - time.perf_counter())
+                if rem == 0.0:
+                    return
+                self._wake.wait(timeout=rem if rem is not None
+                                else self.config.max_wait_s)
+
+    def close(self) -> None:
+        """Drain outstanding requests, then stop the dispatcher."""
+        with self._wake:
+            self._closed = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    # -- warm start --------------------------------------------------------
+
+    def warm(self, op: str, batch: int, *dims, dtype="float32",
+             nrhs: int = 1) -> int:
+        """AOT-compile the executables serving ``(op, dims...)`` at
+        every pow2 batch occupancy up to the padded ``batch`` — after
+        this, requests of the bucket run zero on-demand compiles.
+        Pass the RAW problem dims (``(n,)`` square, ``(m, n)`` tall) —
+        the key derivation is :func:`_exec_key`, byte-identical to the
+        request path's.  Returns the number of executables newly
+        compiled (already-cached ones count zero)."""
+        key = _exec_key(op, str(dtype), self.config.bucket,
+                        tuple(dims), int(nrhs))
+        done = 0
+        bexec = 1
+        cap = _bucket(min(batch, self.config.max_batch), "pow2", floor=1)
+        while bexec <= cap:
+            _, built = self._get_executable(key, bexec, on_demand=False)
+            done += int(built)
+            bexec *= 2
+        return done
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(target=self._loop,
+                                            name="slate-serve-dispatch",
+                                            daemon=True)
+            self._thread.start()
+
+    def _loop(self) -> None:
+        cfg = self.config
+        while True:
+            with self._wake:
+                while not any(self._buckets.values()) and not self._closed:
+                    self._wake.wait()
+                if self._closed and not any(self._buckets.values()):
+                    return
+                now = time.perf_counter()
+                ready, soonest = [], None
+                for key, reqs in self._buckets.items():
+                    if not reqs:
+                        continue
+                    age = now - reqs[0].t_submit
+                    if (len(reqs) >= cfg.max_batch or self._closed
+                            or age >= cfg.max_wait_s):
+                        ready.append(key)
+                    else:
+                        due = reqs[0].t_submit + cfg.max_wait_s
+                        soonest = due if soonest is None \
+                            else min(soonest, due)
+                batches: List[Tuple[tuple, List[_Request]]] = []
+                for key in ready:
+                    reqs = self._buckets[key]
+                    batches.append((key, reqs[:cfg.max_batch]))
+                    rest = reqs[cfg.max_batch:]
+                    if rest:
+                        self._buckets[key] = rest
+                    else:
+                        del self._buckets[key]
+                if not batches and soonest is not None:
+                    self._wake.wait(timeout=max(soonest - now, 1e-4))
+            for key, reqs in batches:
+                self._dispatch(key, reqs)
+            if batches:
+                with self._wake:
+                    depth = sum(len(v) for v in self._buckets.values())
+                    self._wake.notify_all()
+                metrics.set_gauge("serve.queue.depth", float(depth))
+
+    # -- executables -------------------------------------------------------
+
+    def _driver(self, op: str):
+        from ..linalg import batched as B
+
+        return {
+            "potrf": lambda a: B.potrf_batched(a),
+            "getrf": lambda a: B.getrf_batched(a),
+            "posv": lambda a, b: B.posv_batched(a, b)[1],
+            "gesv": lambda a, b: B.gesv_batched(a, b)[2],
+            "geqrf": lambda a: B.geqrf_batched(a),
+            "gels": lambda a, b: B.gels_batched(a, b),
+        }[op]
+
+    def _avals(self, key: tuple, bexec: int):
+        import jax
+
+        op, dt = key[0], key[1]
+        if op in ("potrf", "getrf"):
+            n = key[2]
+            return (jax.ShapeDtypeStruct((bexec, n, n), dt),)
+        if op in ("posv", "gesv"):
+            n, k = key[2], key[3]
+            return (jax.ShapeDtypeStruct((bexec, n, n), dt),
+                    jax.ShapeDtypeStruct((bexec, n, k), dt))
+        if op == "geqrf":
+            m, n = key[2], key[3]
+            return (jax.ShapeDtypeStruct((bexec, m, n), dt),)
+        m, n, k = key[2], key[3], key[4]            # gels
+        return (jax.ShapeDtypeStruct((bexec, m, n), dt),
+                jax.ShapeDtypeStruct((bexec, m, k), dt))
+
+    def _get_executable(self, key: tuple, bexec: int,
+                        on_demand: bool = True):
+        """The compiled executable for (bucket, padded batch): built by
+        ``jax.jit(...).lower(...).compile()`` — tracing (and thus every
+        autotune decision) happens HERE, so a warm-started process
+        never traces on the serving path.  Returns ``(executable,
+        built)`` — ``built`` False on a cache hit."""
+        import jax
+
+        ck = key + (bexec,)
+        with self._lock:
+            ex = self._compiled.get(ck)
+        if ex is not None:
+            return ex, False
+        if on_demand:
+            metrics.inc("serve.compile.on_demand")
+        else:
+            metrics.inc("serve.warm_start.compiled")
+        fn = self._driver(key[0])
+        ex = jax.jit(fn).lower(*self._avals(key, bexec)).compile()
+        with self._lock:
+            self._compiled[ck] = ex
+        return ex, True
+
+    # -- the dispatch ------------------------------------------------------
+
+    def _dispatch(self, key: tuple, reqs: List[_Request]) -> None:
+        import numpy as np
+
+        t0 = time.perf_counter()
+        metrics.inc("serve.dispatches")
+        metrics.observe("serve.batch.occupancy", float(len(reqs)))
+        for r in reqs:
+            metrics.observe_time("serve.wait", t0 - r.t_submit)
+        try:
+            bexec = _bucket(len(reqs), "pow2", floor=1)
+            bexec = min(bexec, _bucket(self.config.max_batch, "pow2",
+                                       floor=1))
+            ex, _ = self._get_executable(key, bexec)
+            stacked = self._pad_stack(key, reqs, bexec, np)
+            with metrics.timer("serve.dispatch"):
+                out = ex(*stacked)
+                out = tuple(np.asarray(o) for o in (
+                    out if isinstance(out, (tuple, list)) else (out,)))
+            for i, r in enumerate(reqs):
+                r.future.set_result(self._unpad(key, r, out, i))
+        except Exception as e:      # one bad batch must not kill the loop
+            metrics.inc("serve.errors")
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _pad_stack(self, key: tuple, reqs: List[_Request], bexec: int,
+                   np):
+        op, dt = key[0], key[1]
+        if op in ("potrf", "getrf"):
+            n = key[2]
+            a = np.stack([_pad_square(r.operands[0], n) for r in reqs])
+            fill = np.eye(n, dtype=dt)[None]
+            pads = [np.broadcast_to(fill, (bexec - len(reqs), n, n))]
+            return (np.concatenate([a.astype(dt)] + pads)
+                    if bexec > len(reqs) else a.astype(dt),)
+        if op in ("posv", "gesv"):
+            n, k = key[2], key[3]
+            a = np.stack([_pad_square(r.operands[0], n) for r in reqs])
+            b = np.stack([_pad_rhs(r.operands[1], n, k) for r in reqs])
+            if bexec > len(reqs):
+                extra = bexec - len(reqs)
+                a = np.concatenate(
+                    [a, np.broadcast_to(np.eye(n, dtype=dt)[None],
+                                        (extra, n, n))])
+                b = np.concatenate([b, np.zeros((extra, n, k), dt)])
+            return a.astype(dt), b.astype(dt)
+        if op == "geqrf":
+            m, n = key[2], key[3]
+            a = np.stack([_pad_tall(r.operands[0], m, n) for r in reqs])
+            if bexec > len(reqs):
+                a = np.concatenate(
+                    [a, np.broadcast_to(_pad_tall(
+                        np.eye(min(m, n), n, dtype=dt), m, n)[None],
+                        (bexec - len(reqs), m, n))])
+            return (a.astype(dt),)
+        m, n, k = key[2], key[3], key[4]            # gels
+        a = np.stack([_pad_tall(r.operands[0], m, n) for r in reqs])
+        b = np.stack([_pad_rhs(r.operands[1], m, k) for r in reqs])
+        if bexec > len(reqs):
+            extra = bexec - len(reqs)
+            a = np.concatenate(
+                [a, np.broadcast_to(_pad_tall(
+                    np.eye(min(m, n), n, dtype=dt), m, n)[None],
+                    (extra, m, n))])
+            b = np.concatenate([b, np.zeros((extra, m, k), dt)])
+        return a.astype(dt), b.astype(dt)
+
+    def _unpad(self, key: tuple, req: _Request, out: tuple, i: int):
+        op = key[0]
+        a_shape = req.shape[0]
+        if op == "potrf":
+            n = a_shape[0]
+            return out[0][i, :n, :n]
+        if op == "getrf":
+            n = a_shape[0]
+            return out[0][i, :n, :n], out[1][i, :n]
+        if op in ("posv", "gesv", "gels"):
+            n = a_shape[0] if op != "gels" else a_shape[1]
+            b_shape = req.shape[1]
+            x = out[0][i, :n]
+            return x[:, 0] if len(b_shape) == 1 else x[:, :b_shape[1]]
+        if op == "geqrf":
+            m, n = a_shape
+            return out[0][i, :m, :n], out[1][i, :n]
+        raise KeyError(op)
+
+
+# ---------------------------------------------------------------------------
+# Module-level default server + warm start
+# ---------------------------------------------------------------------------
+
+_default: List[Optional[BatchQueue]] = [None]
+_default_lock = threading.Lock()
+
+
+def get_server(config: Optional[ServeConfig] = None) -> BatchQueue:
+    """The process-default :class:`BatchQueue` (created on first use;
+    ``config`` applies only to the creating call)."""
+    with _default_lock:
+        if _default[0] is None or _default[0]._closed:
+            _default[0] = BatchQueue(config)
+        return _default[0]
+
+
+def submit(op: str, *operands) -> concurrent.futures.Future:
+    """``get_server().submit(...)`` — the one-line client call."""
+    return get_server().submit(op, *operands)
+
+
+def shutdown() -> None:
+    """Drain and stop the process-default server."""
+    with _default_lock:
+        srv, _default[0] = _default[0], None
+    if srv is not None:
+        srv.close()
+
+
+#: autotune batched-site op → the serve ops its cache keys warm
+_SITE_TO_OPS = {"batched_potrf": ("potrf", "posv"),
+                "batched_lu": ("getrf", "gesv"),
+                "batched_qr": ("geqrf",)}
+
+
+def specs_from_autotune_cache() -> List[dict]:
+    """Derive warm-start specs from the PERSISTED autotune decisions:
+    every ``batched_*`` cache key names a (bucketed batch, bucketed n,
+    dtype) the process has served before — exactly the executables a
+    fresh process should compile before its first request."""
+    from ..perf import autotune
+
+    specs = []
+    for dkey in autotune.table().decisions:
+        try:
+            site, parts = dkey.split("|", 1)
+            ops = _SITE_TO_OPS.get(site)
+            if not ops:
+                continue
+            toks = parts.split(",")
+            if site == "batched_qr":
+                b, m, n, dt = (int(toks[0]), int(toks[1]), int(toks[2]),
+                               toks[3])
+                dims = (m, n)
+            else:
+                b, n, dt = int(toks[0]), int(toks[1]), toks[2]
+                dims = (n,)
+            for op in ops:
+                specs.append({"op": op, "batch": b, "dims": dims,
+                              "dtype": dt})
+        except (ValueError, IndexError):
+            continue
+    return specs
+
+
+def warm_start(server: Optional[BatchQueue] = None,
+               specs: Optional[list] = None) -> int:
+    """AOT-compile the bucket executables a serving process will need,
+    BEFORE the first request arrives.
+
+    ``specs`` is a list of ``{"op", "batch", "dims", "dtype"[, "nrhs"]}``
+    dicts (dims = (n,) for square ops, (m, n) for geqrf/gels); when
+    omitted they are derived from the persisted autotune cache
+    (:func:`specs_from_autotune_cache`) — the shapes this machine has
+    served before.  Returns the number of executables compiled.  After
+    a warm start, the first request of every warmed bucket runs with
+    zero autotune timing reps (decisions come from the persisted cache)
+    and zero on-demand compiles (``serve.compile.on_demand`` stays 0 —
+    pinned in CI)."""
+    srv = server or get_server()
+    if specs is None:
+        specs = specs_from_autotune_cache()
+    done = 0
+    with metrics.timer("serve.warm_start"):
+        for sp in specs:
+            done += srv.warm(sp["op"], int(sp.get("batch", 1)),
+                             *tuple(sp["dims"]),
+                             dtype=sp.get("dtype", "float32"),
+                             nrhs=int(sp.get("nrhs", 1)))
+    return done

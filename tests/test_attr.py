@@ -164,6 +164,59 @@ def test_attribution_skips_derived_and_invalid_labels():
                           0.136) is None
     assert attr.attribute("getrf_fp32_n8192_nb512", 0.0) is None
     assert attr.attribute("unknownroutine_fp32_n64", 5.0) is None
+    # the throughput family is a rate, not GFLOP/s — no roofline block
+    assert attr.attribute("posv_batched_fp32_n256_b64_solves_per_s",
+                          20000.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Leading-batch-dim shapes (ISSUE 8): batched labels parse, scale by b,
+# and still reconcile with model flops at 1% — the CI round-trip pin
+# ---------------------------------------------------------------------------
+
+_BATCHED_LABELS = {
+    "posv_batched_fp32_n256_b64": 1234.5,
+    "gesv_batched_fp32_n256_b64": 987.0,
+    "potrf_batched_fp32_n128_b64": 456.0,
+    "getrf_batched_fp32_n64_b8": 88.0,
+    "posv_batched_fp32_n48_b8": 0.62,    # the CPU bench shape
+}
+
+
+def test_batched_label_parsing():
+    assert attr.parse_label("posv_batched_fp32_n256_b64") == \
+        ("posv", "fp32", {"n": 256, "b": 64})
+    assert attr.parse_label("gesv_batched_fp32_n64_b7") == \
+        ("gesv", "fp32", {"n": 64, "b": 7})
+    # non-batched labels are untouched
+    assert attr.parse_label("getrf_fp32_n8192_nb512") == \
+        ("getrf", "fp32", {"n": 8192, "nb": 512})
+
+
+def test_batched_model_scales_with_batch():
+    one = attr.model_flops("posv", {"n": 256, "b": 1})
+    many = attr.model_flops("posv", {"n": 256, "b": 64})
+    assert many == pytest.approx(64 * one)
+    # and the stage bytes scale with the batch too
+    s1, _ = attr.stage_model("posv", {"n": 256, "b": 1})
+    s64, _ = attr.stage_model("posv", {"n": 256, "b": 64})
+    by1 = {s["stage"]: s["bytes"] for s in s1}
+    for s in s64:
+        assert s["bytes"] == pytest.approx(64 * by1[s["stage"]])
+
+
+@pytest.mark.parametrize("label,gf", sorted(_BATCHED_LABELS.items()))
+def test_batched_attribution_reconciles_at_1pct(label, gf):
+    """The batched CI round-trip pin: stage-flop totals ÷ measured
+    seconds reproduce the batched routine's GFLOP/s within 1%."""
+    rep = attr.attribute(label, gf)
+    assert rep is not None
+    assert rep["dims"].get("b", 1) > 1
+    total = sum(s["flops"] for s in rep["stages"])
+    assert abs(total / rep["measured_s"] / 1e9 - gf) / gf < 0.01
+    assert total == pytest.approx(
+        attr.model_flops(rep["routine"], rep["dims"]), rel=1e-9)
+    json.loads(json.dumps(rep))
 
 
 def test_bottlenecks_ranked_and_dominant_stage_first():

@@ -71,6 +71,29 @@ def test_no_private_metrics_registry_access_outside_perf():
         + "\n".join(offenders))
 
 
+#: the serving layer must stay backend-agnostic: it may call ONLY the
+#: driver facades (linalg/, api/), never the ops/ kernel layer — a
+#: serve/ module importing ops would bypass the autotune dispatch
+#: (``autotune.kernel()``) that makes every backend choice visible.
+_SERVE_OPS_IMPORT_RE = re.compile(
+    r"^\s*(?:from\s+[.\w]*\bops\b[.\w]*\s+import"    # from ..ops.x import
+    r"|from\s+[.\w]+\s+import\s+[^#\n]*\bops\b"      # from .. import ops
+    r"|import\s+[.\w]*\bops\b)")                     # import slate_tpu.ops
+
+
+def test_serve_never_imports_ops_layer():
+    offenders = []
+    for path in sorted((_PKG / "serve").rglob("*.py")):
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _SERVE_OPS_IMPORT_RE.match(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "serve/ reached into the ops/ backend layer (route through the "
+        "batched driver facades so every backend choice goes through "
+        "the autotune table):\n" + "\n".join(offenders))
+
+
 def test_multi_backend_sites_populate_autotune_table():
     """Exercising each tunable op site must leave a decision entry —
     proof the site consults the table rather than hard-coding a
@@ -123,12 +146,24 @@ def test_multi_backend_sites_populate_autotune_table():
     st.heev(st.HermitianMatrix(jnp.asarray(herm), uplo=st.Uplo.Lower),
             opts={"block_size": 16})
 
+    # batched many-problem sites (ISSUE 8): the leading-batch-dim
+    # drivers must each leave a grid-vs-vmapped (or vmapped-only)
+    # decision keyed by the pow2-bucketed (B, n)
+    from slate_tpu.linalg import batched
+    spd_b = jnp.asarray(np.stack([spd] * 3))
+    batched.potrf_batched(spd_b)
+    batched.getrf_batched(jnp.asarray(
+        np.stack([g + n * np.eye(n, dtype=np.float32)] * 3)))
+    batched.geqrf_batched(jnp.asarray(
+        rng.standard_normal((3, 2 * n, n)).astype(np.float32)))
+
     dec = autotune.decisions()
     for op in ("matmul|128,128,128,float32",
                "matmul|8,8,8,float64",
                "potrf_panel|", "trtri_panel|", "lu_panel|", "lu_driver|",
                "lu_step|", "potrf_step|", "dist_panel|potrf",
-               "geqrf_panel|", "chase|hb2st"):
+               "geqrf_panel|", "chase|hb2st",
+               "batched_potrf|", "batched_lu|", "batched_qr|"):
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"
     autotune.reset_table()

@@ -1,0 +1,308 @@
+"""Batched many-problem drivers (slate_tpu/linalg/batched.py) + the
+grid-batched Pallas kernels + the shared VMEM budget helper.
+
+Parity contract (ISSUE 8): the batched drivers must be BITWISE equal to
+a Python loop of the composed single-problem functions they vmap (vmap
+reorders nothing on CPU), and residual-gated against scipy; the
+grid-batched Pallas path (forced through SLATE_TPU_AUTOTUNE_FORCE in
+interpret mode) must match scipy pivots exactly and pass the same
+residual gates, with EXACTLY ONE pallas_call per launch (jaxpr census).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import scipy.linalg as sla
+
+from slate_tpu.linalg import batched
+from slate_tpu.perf import autotune
+
+BATCHES = (1, 7, 64)
+DTYPES = (np.float32, np.float64)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_table(tmp_path, monkeypatch):
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    autotune.reset_table()
+    yield
+    autotune.reset_table()
+
+
+def _spd_batch(b, n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((b, n, n)).astype(dtype)
+    return np.einsum("bij,bkj->bik", g, g) + n * np.eye(n, dtype=dtype)
+
+
+def _gen_batch(b, n, dtype, seed=1):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((b, n, n)).astype(dtype)
+            + n * np.eye(n, dtype=dtype))
+
+
+def _eps(dtype):
+    return float(np.finfo(dtype).eps)
+
+
+class TestVmappedLoopedParity:
+    """The vmapped-composed backend must be bitwise the loop of the
+    single-problem composed function it vmaps."""
+
+    @pytest.mark.parametrize("b", BATCHES)
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_potrf_bitwise(self, b, dtype):
+        n = 32
+        spd = jnp.asarray(_spd_batch(b, n, dtype))
+        got = np.asarray(batched.potrf_batched(spd))
+        want = np.stack([np.asarray(batched._potrf_single_composed(spd[i]))
+                         for i in range(b)])
+        assert np.array_equal(got, want)
+
+    @pytest.mark.parametrize("b", BATCHES)
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_getrf_bitwise(self, b, dtype):
+        n = 32
+        a = jnp.asarray(_gen_batch(b, n, dtype))
+        lu, perm = batched.getrf_batched(a)
+        for i in range(b):
+            lu1, perm1 = batched._getrf_single_composed(a[i])
+            assert np.array_equal(np.asarray(lu[i]), np.asarray(lu1))
+            assert np.array_equal(np.asarray(perm[i]), np.asarray(perm1))
+
+    @pytest.mark.parametrize("shape", [(48, 48), (96, 32)])
+    def test_geqrf_bitwise_square_and_tall(self, shape):
+        m, n = shape
+        rng = np.random.default_rng(3)
+        a = jnp.asarray(rng.standard_normal((7, m, n)).astype(np.float32))
+        pk, taus = batched.geqrf_batched(a)
+        for i in range(7):
+            pk1, taus1 = batched._geqrf_single_composed(a[i])
+            assert np.array_equal(np.asarray(pk[i]), np.asarray(pk1))
+            assert np.array_equal(np.asarray(taus[i]), np.asarray(taus1))
+
+
+class TestResidualVsScipy:
+    @pytest.mark.parametrize("b", BATCHES)
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_potrf(self, b, dtype):
+        n = 48
+        spd = _spd_batch(b, n, dtype)
+        l = np.asarray(batched.potrf_batched(jnp.asarray(spd)))
+        for i in range(b):
+            ref = sla.cholesky(spd[i], lower=True)
+            r = (np.linalg.norm(l[i] @ l[i].T - spd[i])
+                 / (np.linalg.norm(spd[i]) * _eps(dtype) * n))
+            assert r < 3, (i, r)
+            assert np.allclose(l[i], ref,
+                               atol=100 * _eps(dtype) * np.abs(ref).max())
+
+    @pytest.mark.parametrize("b", BATCHES)
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_gesv(self, b, dtype):
+        n = 48
+        a = _gen_batch(b, n, dtype)
+        rng = np.random.default_rng(5)
+        rhs = rng.standard_normal((b, n)).astype(dtype)
+        lu, perm, x = batched.gesv_batched(jnp.asarray(a),
+                                           jnp.asarray(rhs))
+        x = np.asarray(x)
+        for i in range(b):
+            ref = sla.solve(a[i], rhs[i])
+            r = (np.linalg.norm(a[i] @ x[i] - rhs[i])
+                 / (np.linalg.norm(a[i]) * np.linalg.norm(rhs[i])
+                    * _eps(dtype) * n))
+            assert r < 3, (i, r)
+            assert np.allclose(x[i], ref, atol=1e-2 if dtype == np.float32
+                               else 1e-8)
+
+    @pytest.mark.parametrize("shape", [(48, 48), (96, 32)])
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_gels_square_and_tall(self, shape, dtype):
+        m, n = shape
+        b = 7
+        rng = np.random.default_rng(6)
+        a = rng.standard_normal((b, m, n)).astype(dtype)
+        rhs = rng.standard_normal((b, m)).astype(dtype)
+        x = np.asarray(batched.gels_batched(jnp.asarray(a),
+                                            jnp.asarray(rhs)))
+        for i in range(b):
+            ref = sla.lstsq(a[i], rhs[i])[0]
+            # normal-equations residual, the reference tester's gate
+            r = (np.linalg.norm(a[i].T @ (a[i] @ x[i] - rhs[i]))
+                 / (np.linalg.norm(a[i]) ** 2 * np.linalg.norm(x[i])
+                    * _eps(dtype) * np.sqrt(m)))
+            assert r < 3, (i, r)
+            assert np.allclose(x[i], ref, atol=1e-2 if dtype == np.float32
+                               else 1e-7)
+
+    @pytest.mark.parametrize("b", BATCHES)
+    def test_posv_rhs_matrix(self, b):
+        n, k = 32, 3
+        spd = _spd_batch(b, n, np.float64)
+        rng = np.random.default_rng(7)
+        rhs = rng.standard_normal((b, n, k))
+        l, x = batched.posv_batched(jnp.asarray(spd), jnp.asarray(rhs))
+        x = np.asarray(x)
+        for i in range(b):
+            assert np.allclose(spd[i] @ x[i], rhs[i], atol=1e-8)
+
+
+class TestGridBatchedPallas:
+    """The grid-batched Pallas kernels, forced in interpret mode."""
+
+    @pytest.mark.parametrize("b", (1, 4))
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_potrf_grid_forced(self, b, dtype, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                           "batched_potrf=grid")
+        n = 64
+        spd = _spd_batch(b, n, dtype)
+        l = np.asarray(batched.potrf_batched(jnp.asarray(spd)))
+        key = [k for k in autotune.decisions()
+               if k.startswith("batched_potrf|")]
+        assert key and autotune.decisions()[key[0]] == "grid"
+        for i in range(b):
+            r = (np.linalg.norm(l[i] @ l[i].T - spd[i])
+                 / (np.linalg.norm(spd[i]) * _eps(np.float32) * n))
+            assert r < 3, (i, r)
+
+    @pytest.mark.parametrize("b", (1, 4))
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_getrf_grid_forced_scipy_pivot_parity(self, b, dtype,
+                                                  monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "batched_lu=grid")
+        from slate_tpu.linalg.lu import ipiv_to_perm
+        n = 64
+        a = _gen_batch(b, n, dtype)
+        lu, perm = batched.getrf_batched(jnp.asarray(a))
+        lu, perm = np.asarray(lu), np.asarray(perm)
+        for i in range(b):
+            lu_ref, piv_ref = sla.lu_factor(a[i])
+            perm_ref = np.asarray(ipiv_to_perm(piv_ref + 1, n))
+            assert np.array_equal(perm[i], perm_ref), i
+            tol = 1e-3 if dtype == np.float32 else 1e-10
+            assert np.abs(lu[i] - lu_ref).max() < tol * np.abs(
+                lu_ref).max(), i
+
+    def test_grid_launch_census_one_pallas_call(self, monkeypatch):
+        """Exactly 1 pallas_call per grid-batched launch — the
+        many-problems-per-launch claim, pinned via the jaxpr census."""
+        from slate_tpu.perf.hlo_profile import count_pallas_calls
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                           "batched_potrf=grid,batched_lu=grid")
+        spd = jnp.asarray(_spd_batch(4, 64, np.float32))
+        assert count_pallas_calls(batched.potrf_batched, spd) == 1
+        a = jnp.asarray(_gen_batch(4, 64, np.float32))
+        assert count_pallas_calls(
+            lambda x: batched.getrf_batched(x)[0], a) == 1
+
+    def test_grid_ineligible_shapes_fall_back(self):
+        # n not on the ib=32 grid → vmapped, and the decision records
+        a = jnp.asarray(_gen_batch(2, 48, np.float32))
+        lu, perm = batched.getrf_batched(a)
+        key = [k for k in autotune.decisions()
+               if k.startswith("batched_lu|")]
+        assert key and autotune.decisions()[key[0]] == "vmapped"
+
+
+class TestBucketedKeys:
+    def test_pow2_bucketing_batch_and_n(self):
+        """One decision serves the whole (B, n) bucket: 60- and 64-batch
+        calls at n 224/256 must share a key."""
+        batched.potrf_batched(jnp.asarray(_spd_batch(60, 224, np.float32)))
+        batched.potrf_batched(jnp.asarray(_spd_batch(64, 256, np.float32)))
+        keys = {k for k in autotune.decisions()
+                if k.startswith("batched_potrf|")}
+        assert len(keys) == 1, keys
+        assert keys.pop().startswith("batched_potrf|64,256,")
+
+
+class TestVmemBudgetHelper:
+    """The shared VMEM budget arithmetic (slate_tpu/ops/vmem.py) — one
+    helper, reused by the single-problem fused gates AND the batched
+    B-per-launch gates instead of copy-pasted constants."""
+
+    def test_defaults_and_fits(self):
+        from slate_tpu.ops import vmem
+        assert vmem.budget_bytes() == vmem.BUDGET_BYTES
+        assert vmem.fits(vmem.BUDGET_BYTES)
+        assert not vmem.fits(vmem.BUDGET_BYTES + 1)
+
+    def test_env_override_moves_every_gate(self, monkeypatch):
+        from slate_tpu.ops import vmem
+        monkeypatch.setenv("SLATE_TPU_VMEM_BUDGET_MB", "1")
+        assert vmem.budget_bytes() == 1024 * 1024
+        # the batched gate shrinks with the budget
+        assert vmem.batch_per_launch(3 * 256 * 256 * 4) == 1
+        assert vmem.batch_per_launch(3 * 1024 * 1024 * 4) == 0
+
+    def test_batch_per_launch(self):
+        from slate_tpu.ops import vmem
+        per = 3 * 256 * 256 * 4
+        bt = vmem.batch_per_launch(per)
+        assert bt == vmem.BUDGET_BYTES // per
+        assert vmem.batch_per_launch(per, cap=4) == 4
+        assert vmem.batch_per_launch(0, cap=9) == 9
+        # fixed overhead eats into the budget
+        assert vmem.batch_per_launch(per,
+                                     fixed_bytes=vmem.BUDGET_BYTES) == 0
+
+    def test_grid_bt_divides_batch(self):
+        assert batched._grid_bt(64, 256) >= 1
+        for b in (1, 7, 64):
+            bt = batched._grid_bt(b, 128)
+            assert bt >= 1 and b % bt == 0
+
+    def test_single_problem_gates_still_consistent(self):
+        """The refactored fused-step gates must agree with the budget
+        helper (regression for the shared-constant extraction)."""
+        from slate_tpu.linalg import lu as lumod
+        from slate_tpu.ops import blocks, vmem
+        tc = lumod._fused_step_tc(8192, 8192, 512)
+        assert tc >= 128 and 512 % tc == 0
+        assert vmem.fits(lumod._fused_step_bytes(8192, 512, tc))
+        tc2 = blocks.potrf_step_tc(8192, 512)
+        assert tc2 >= 128 and 512 % tc2 == 0
+        assert vmem.fits(blocks._potrf_step_bytes(8192, 512, tc2))
+
+
+class TestBatchedBenchRoutines:
+    def test_bench_batched_posv_families(self):
+        bench = pytest.importorskip("bench")
+        label, gf, resid, extra = bench.bench_batched_posv(
+            False, nbat=48, bsz=8)
+        assert label == "posv_batched_fp32_n48_b8"
+        assert gf > 0 and resid < 3
+        assert set(extra) == {
+            "posv_batched_fp32_n48_b8_solves_per_s",
+            "posv_loop_fp32_n48_solves_per_s",
+            "posv_batched_fp32_n48_b8_speedup_vs_loop"}
+        assert extra["posv_batched_fp32_n48_b8_solves_per_s"] > 0
+
+
+class TestSimplifiedBatchedVerbs:
+    def test_verbs_forward_to_batched_drivers(self):
+        from slate_tpu.api import simplified as S
+        rng = np.random.default_rng(8)
+        b, n = 3, 32
+        spd = jnp.asarray(_spd_batch(b, n, np.float64))
+        rhs = jnp.asarray(rng.standard_normal((b, n)))
+        x = np.asarray(S.chol_solve_batched(spd, rhs))
+        assert np.allclose(np.einsum("bij,bj->bi", np.asarray(spd), x),
+                           np.asarray(rhs), atol=1e-8)
+        a = jnp.asarray(_gen_batch(b, n, np.float64))
+        x2 = np.asarray(S.lu_solve_batched(a, rhs))
+        assert np.allclose(np.einsum("bij,bj->bi", np.asarray(a), x2),
+                           np.asarray(rhs), atol=1e-8)
+        lu, perm = S.lu_factor_batched(a)
+        assert lu.shape == (b, n, n) and perm.shape == (b, n)
+        l = S.chol_factor_batched(spd)
+        assert l.shape == (b, n, n)
+        tall = jnp.asarray(rng.standard_normal((b, 2 * n, n)))
+        assert S.least_squares_solve_batched(tall, jnp.asarray(
+            rng.standard_normal((b, 2 * n)))).shape == (b, n)
+        pk, taus = S.qr_factor_batched(tall)
+        assert pk.shape == (b, 2 * n, n) and taus.shape == (b, n)

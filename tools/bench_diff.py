@@ -11,7 +11,10 @@ Aligns routines across the artifacts (by routine name, dtype and dims
 parsed from the submetric labels), prints a verdict table — including a
 ``frac`` column with each routine's newest ``frac_of_gemm`` derived
 submetric (bench.py r6+: routine TF/s ÷ same-run gemm TF/s, the unit
-the ROADMAP fraction targets are written in) — and exits nonzero when
+the ROADMAP fraction targets are written in) and the batched serving
+throughput rows (``*_solves_per_s``, r8: higher is better, judged with
+the rate direction — the sentinel pins serving throughput like any
+other metric) — and exits nonzero when
 any routine regressed more than the threshold between consecutive
 artifacts OR when any artifact is infra-shaped (``rc != 0``,
 missing/empty/partial aggregate) — the checks that would have flagged
@@ -74,6 +77,9 @@ def main(argv=None) -> int:
             "rows": [{"label": r.label, "values": r.values,
                       "delta_pct": r.delta_pct, "verdict": r.verdict,
                       "note": r.note,
+                      "direction": ("higher_is_better"
+                                    if regress.direction(r.label) > 0
+                                    else "lower_is_better"),
                       "frac_of_gemm": regress.frac_of_gemm(report,
                                                            r.label)}
                      for r in report.rows],
