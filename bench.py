@@ -187,6 +187,49 @@ class _RoutineTimeout(Exception):
     pass
 
 
+def _init_platform():
+    """First touch of the jax backend (where the r05 worker-hostname
+    init RPC died).  The ``infra.init`` injection site lets the chaos
+    tests drive the retry without a broken TPU."""
+    from slate_tpu.resilience import inject
+
+    inject.fault_here("infra.init")
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _init_backend_with_retry():
+    """ONE classified retry-with-backoff around TPU backend init (the
+    resilience satellite: an r05-shaped transient init failure must
+    produce a degraded-but-nonempty artifact, not an empty one).
+    Returns ``(platform | None, retried_infra, error | None)`` —
+    platform None means init failed even after the retry; the caller
+    emits the degraded aggregate instead of crashing with no JSON."""
+    from slate_tpu.resilience import retry as _retry
+
+    retried = []
+
+    def classify(e):
+        # with_backoff consults the classifier only when a retry will
+        # actually run, so this records true retries — a deterministic
+        # (non-transient) first failure must NOT be tagged as one
+        ok = _retry.transient_infra(e)
+        if ok:
+            retried.append(type(e).__name__)
+        return ok
+
+    try:
+        platform, retries = _retry.with_backoff(
+            _init_platform, attempts=2,
+            base_s=float(os.environ.get("SLATE_TPU_INIT_BACKOFF_S",
+                                        "2.0")),
+            classify=classify)
+        return platform, retries > 0, None
+    except Exception as e:          # still down (or never retryable)
+        return None, bool(retried), e
+
+
 # ---------------------------------------------------------------------------
 # Batched many-problem throughput (ISSUE 8) — the serving workload: B
 # small/medium independent solves per launch (slate_tpu/linalg/batched).
@@ -471,6 +514,11 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
         try:
             if attempt:           # a retry's delta must not carry the
                 snap_before = _metrics_snapshot()   # failed attempt's
+            from slate_tpu.resilience import inject as _inj
+
+            # chaos seam: an injected routine-startup fault takes the
+            # same classified-infra retry path a real one would
+            _inj.fault_here("bench.startup")
             out = _run_with_deadline(fn, deadline, name=name,
                                      on_hard_hang=_on_hard_hang)
             label, gf, resid = out[0], out[1], out[2]
@@ -549,7 +597,28 @@ def main():
         return False
 
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    platform, retried_infra, init_err = _init_backend_with_retry()
+    if platform is None:
+        # degraded-but-nonempty artifact: a parseable per-routine error
+        # line plus an aggregate LAST line, exit 0 (infra never fails
+        # the suite) — the r05 "rc=124, parsed=null" shape is dead
+        print(json.dumps({"routine": "_suite",
+                          "error": "infra: backend init failed"
+                                   + (" after retry" if retried_infra
+                                      else "")
+                                   + f": {init_err}"}), flush=True)
+        agg = _partial_aggregate({}, [], [f"init: {init_err}"])
+        if retried_infra:
+            agg["retried_infra"] = True
+        print(json.dumps(agg), flush=True)
+        print("# backend init failed%s: %s"
+              % (" after retry" if retried_infra else "", init_err),
+              file=sys.stderr)
+        return
+    if retried_infra:
+        print("# backend init succeeded on retry (transient infra "
+              "error absorbed)", file=sys.stderr)
+    on_tpu = platform == "tpu"
     global _PLATFORM
     _PLATFORM = "tpu" if on_tpu else "cpu"
     scale = 1 if on_tpu else 8
@@ -1069,6 +1138,10 @@ def main():
         out["below_10pct_of_anchor"] = low
     if skipped:
         out["skipped_for_time"] = skipped
+    if retried_infra:
+        # the sentinel (perf/regress.py) surfaces this as a note: the
+        # numbers are real but the run absorbed a transient init flake
+        out["retried_infra"] = True
     if fails or infra:
         out["failed"] = fails + [f"infra: {s}" for s in infra]
     print(json.dumps(out), flush=True)   # aggregate stays the LAST line

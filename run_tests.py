@@ -21,6 +21,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import pathlib
 import subprocess
 import sys
@@ -29,6 +30,14 @@ import time
 QUICK = "128"
 SMALL = "256"
 MEDIUM = "512,1024"
+
+#: the --chaos tier's canned low-rate deterministic fault plan
+#: (slate_tpu/resilience/inject.py grammar): every routine suite runs
+#: once with these faults firing and SLATE_TPU_HEALTH=retry degrading
+#: around them — green means the resilience ladder absorbs them.
+CHAOS_PLAN = ("driver.output=nan:0.02,autotune.probe=error:0.05,"
+              "serve.dispatch=error:0.05")
+CHAOS_SEED = "20260803"
 
 SINGLE = ["gemm", "symm", "hemm", "syrk", "herk", "syr2k", "her2k", "trmm",
           "trsm", "norm", "potrf", "potrs", "posv", "posv_mixed", "potri",
@@ -54,7 +63,25 @@ def main(argv=None):
     ap.add_argument("--isolate", action="store_true",
                     help="one subprocess per routine (fresh jit cache, "
                     "hard timeout) instead of the shared-process default")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fast chaos tier: run the suite once at quick "
+                    "dims with a canned low-rate deterministic fault "
+                    "plan and SLATE_TPU_HEALTH=retry enabled — proves "
+                    "the resilience layer detects/degrades/retries "
+                    "instead of failing (see docs/usage.md Resilience)")
     args = ap.parse_args(argv)
+
+    if args.chaos:
+        # setdefault: an explicit operator plan/tier wins over the can
+        os.environ.setdefault("SLATE_TPU_FAULT_INJECT", CHAOS_PLAN)
+        os.environ.setdefault("SLATE_TPU_FAULT_SEED", CHAOS_SEED)
+        os.environ.setdefault("SLATE_TPU_HEALTH", "retry")
+        if not args.medium:
+            args.quick = True       # "fast" tier: quick dims
+        print(f"=== chaos tier: SLATE_TPU_FAULT_INJECT="
+              f"{os.environ['SLATE_TPU_FAULT_INJECT']} seed="
+              f"{os.environ['SLATE_TPU_FAULT_SEED']} health="
+              f"{os.environ['SLATE_TPU_HEALTH']}", flush=True)
 
     dims = QUICK if args.quick else (MEDIUM if args.medium else SMALL)
     routines = (args.routines.split(",") if args.routines

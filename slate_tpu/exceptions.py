@@ -20,9 +20,26 @@ def slate_assert(cond: bool, msg: str = "assertion failed") -> None:
 
 
 def check_info(info, what: str = "routine") -> None:
-    """Raise if a device-computed info code is nonzero (host sync point)."""
+    """Raise if a device-computed info code is nonzero (host sync point).
+
+    Accepts a scalar (the single-problem drivers' contract) OR a
+    batched info array from the ``linalg/batched`` drivers and serve
+    responses: for an array, the error reports the FIRST nonzero
+    problem index, its info value, and how many problems failed — the
+    same host-side contract as singles, so a serving layer can catch
+    one exception type whatever the batch shape."""
     import numpy as np
 
-    i = int(np.asarray(info))
-    if i != 0:
-        raise SlateError(f"{what}: info = {i}")
+    arr = np.asarray(info)
+    if arr.ndim == 0:
+        i = int(arr)
+        if i != 0:
+            raise SlateError(f"{what}: info = {i}")
+        return
+    nz = np.flatnonzero(arr)
+    if nz.size:
+        first = int(nz[0])
+        raise SlateError(
+            f"{what}: info nonzero for {nz.size} of {arr.size} "
+            f"problems; first at index {first} "
+            f"(info = {int(arr.reshape(-1)[first])})")

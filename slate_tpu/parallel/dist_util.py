@@ -67,6 +67,27 @@ def dist_panel_backend(op: str, nb: int, dtype) -> str:
                           eligible=eligible)
 
 
+def _inject_bcast(out):
+    """Trace-time fault seam for the fused panel broadcasts
+    (:mod:`slate_tpu.resilience.inject`, site ``dist.bcast``).  With no
+    fault plan installed this is one dict lookup returning ``out``
+    untouched — the traced program (and so the compiled HLO) stays
+    bit-identical, pinned in ``tests/test_resilience.py``.  With an
+    active plan, an ``error`` fault raises at trace time (a failed
+    collective build) and ``nan``/``inf`` poisons one element of the
+    broadcast buffer — the corruption the distributed drivers'
+    downstream residual gates must catch."""
+    from ..resilience import inject
+
+    kind = inject.poll("dist.bcast")
+    if kind == "error":
+        raise inject.InjectedFault("dist.bcast")
+    if kind in ("nan", "inf"):
+        val = float("nan") if kind == "nan" else float("inf")
+        return out.at[(0,) * out.ndim].set(val)
+    return out
+
+
 def bcast_block_col(col_loc, grows, own, M: int):
     """Fused panel broadcast — ONE collective per factorization step.
 
@@ -89,7 +110,7 @@ def bcast_block_col(col_loc, grows, own, M: int):
                     float(M * col_loc.shape[1] * jnp.dtype(dt).itemsize))
     buf = jnp.zeros((M, col_loc.shape[1]), dt)
     buf = buf.at[grows].set(col_loc * own.astype(dt))
-    return lax.psum(buf, (AXIS_P, AXIS_Q))
+    return _inject_bcast(lax.psum(buf, (AXIS_P, AXIS_Q)))
 
 
 def bcast_block_row(row_loc, gcols, own, N: int):
@@ -104,7 +125,7 @@ def bcast_block_row(row_loc, gcols, own, N: int):
                     float(row_loc.shape[0] * N * jnp.dtype(dt).itemsize))
     buf = jnp.zeros((row_loc.shape[0], N), dt)
     buf = buf.at[:, gcols].set(row_loc * own.astype(dt))
-    return lax.psum(buf, (AXIS_P, AXIS_Q))
+    return _inject_bcast(lax.psum(buf, (AXIS_P, AXIS_Q)))
 
 
 def overlap_summary(n_devices: Optional[int] = None,

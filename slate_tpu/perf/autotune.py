@@ -36,6 +36,10 @@ Environment knobs:
 * ``SLATE_TPU_USE_PALLAS`` / ``SLATE_TPU_F64_MXU`` — tri-state
   (``auto``/``1``/``0``) eligibility of the Pallas / Ozaki candidate
   sets (:mod:`slate_tpu.config`).
+* ``SLATE_TPU_QUARANTINE_TTL_S`` — lifetime of resilience demotions
+  (health-gate quarantine, persisted at ``<cache>.quarantine``; see
+  :mod:`slate_tpu.resilience.health` and :meth:`AutotuneTable.
+  quarantine_backend`).
 
 Timing never runs on non-TPU backends: there the candidate set collapses
 to the single heuristic default (Pallas kernels run in interpret mode on
@@ -48,6 +52,7 @@ import json
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, NamedTuple, Optional
 
 from . import metrics
@@ -55,6 +60,8 @@ from . import metrics
 __all__ = [
     "AutotuneTable", "Candidate", "table", "reset_table", "select",
     "decide", "decisions", "timing_reps", "kernel",
+    "quarantine", "quarantine_key", "safe_backend",
+    "suppress_knob_records",
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
     "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
     "choose_geqrf_panel", "choose_chase", "choose_lu_step",
@@ -146,6 +153,48 @@ def _key_str(op: str, key_parts) -> str:
     return op + "|" + ",".join(str(p) for p in key_parts)
 
 
+#: op site -> the stock-library candidate name (the one whose failure
+#: mode is shared with the non-autotuned library).  The quarantine
+#: layer never demotes it — there must always be a backend left to
+#: degrade to — and the health gates' safe re-run resolves to it.
+_SAFE_BACKENDS = {
+    "lu_driver": "rec", "lu_step": "composed", "potrf_step": "composed",
+    "batched_potrf": "vmapped", "batched_lu": "vmapped",
+    "batched_qr": "vmapped", "chase": "host_native",
+}
+
+
+def safe_backend(op: str) -> str:
+    return _SAFE_BACKENDS.get(op, "xla")
+
+
+#: > 0 while a resilience degraded re-run is forcing the safe knobs
+#: (:func:`slate_tpu.resilience.health.safe_backend`).  The temporary
+#: knob state must not overwrite settled decisions via :func:`_static`
+#: — a clobbered "timed" record would force re-timing probes on the
+#: serving path after the knobs are restored.
+_knob_records_suppressed = [0]
+
+
+@contextmanager
+def suppress_knob_records():
+    """While active, knob-derived :func:`_static` resolutions count
+    their dispatch but leave the stored decision table untouched."""
+    _knob_records_suppressed[0] += 1
+    try:
+        yield
+    finally:
+        _knob_records_suppressed[0] -= 1
+
+
+def _quarantine_ttl_s() -> float:
+    """Runtime demotions expire after this many seconds (re-probed on
+    the next decide past expiry); a version bump (:func:`_version_key`)
+    drops the whole quarantine file regardless."""
+    return float(os.environ.get("SLATE_TPU_QUARANTINE_TTL_S",
+                                str(24 * 3600)))
+
+
 class Candidate(NamedTuple):
     """One backend candidate for a decision.
 
@@ -169,8 +218,13 @@ class AutotuneTable:
         self.decisions: dict = {}       # key -> {"backend", "source", ...}
         self.timing_reps = 0            # timed reps performed THIS process
         self._persist: dict = {}        # subset of decisions worth saving
+        # key -> {backend -> {"until": epoch_s, "reason": str}}: runtime
+        # demotions from the resilience health gates, persisted next to
+        # the cache (see quarantine_backend)
+        self.quarantine: dict = {}
         self._lock = threading.RLock()
         self._load()
+        self._load_quarantine()
 
     # -- persistence ------------------------------------------------------
 
@@ -203,6 +257,89 @@ class AutotuneTable:
         except OSError:
             pass                        # read-only FS: stay in-process only
 
+    # -- quarantine (resilience demotions) --------------------------------
+
+    @property
+    def quarantine_path(self) -> str:
+        return self.path + ".quarantine"
+
+    def _load_quarantine(self) -> None:
+        try:
+            with open(self.quarantine_path) as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            return
+        if blob.get("version") != _version_key():
+            # version bump: every demotion is re-probed, by design
+            metrics.inc("resilience.quarantine.stale")
+            return
+        entries = blob.get("entries", {})
+        if not isinstance(entries, dict):
+            return
+        now = time.time()
+        for k, backends in entries.items():
+            if not isinstance(backends, dict):
+                continue
+            live = {b: e for b, e in backends.items()
+                    if isinstance(e, dict) and e.get("until", 0) > now}
+            if live:
+                self.quarantine[k] = live
+        if self.quarantine:
+            metrics.inc("resilience.quarantine.loaded",
+                        float(sum(len(v) for v in
+                                  self.quarantine.values())))
+
+    def _save_quarantine(self) -> None:
+        blob = {"version": _version_key(), "entries": self.quarantine}
+        try:
+            os.makedirs(os.path.dirname(self.quarantine_path) or ".",
+                        exist_ok=True)
+            tmp = self.quarantine_path + ".tmp.%d" % os.getpid()
+            with open(tmp, "w") as f:
+                json.dump(blob, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.quarantine_path)
+        except OSError:
+            pass                        # read-only FS: in-process only
+
+    def quarantine_backend(self, key: str, backend: str,
+                           reason: str = "",
+                           ttl_s: Optional[float] = None) -> None:
+        """Demote one (key, backend) pair: the decision (in-process AND
+        persisted) is dropped so the next resolve re-decides among the
+        remaining candidates, and the demotion is written next to the
+        cache with a TTL — a poisoned winner is never pinned forever,
+        and re-probing happens at TTL expiry or the next version bump."""
+        with self._lock:
+            ttl = _quarantine_ttl_s() if ttl_s is None else float(ttl_s)
+            self.quarantine.setdefault(key, {})[backend] = {
+                "until": time.time() + ttl, "reason": reason}
+            hit = self.decisions.get(key)
+            if hit is not None and hit.get("backend") == backend:
+                del self.decisions[key]
+            if key in self._persist \
+                    and self._persist[key].get("backend") == backend:
+                del self._persist[key]
+                self._save()
+            self._save_quarantine()
+        metrics.inc("resilience.demotions")
+
+    def _live_quarantined(self, key: str) -> set:
+        """Backends currently quarantined for ``key``; expired entries
+        are dropped here (TTL re-probe)."""
+        q = self.quarantine.get(key)
+        if not q:
+            return set()
+        now = time.time()
+        dead = [b for b, e in q.items() if e.get("until", 0) <= now]
+        if dead:
+            for b in dead:
+                del q[b]
+            if not q:
+                del self.quarantine[key]
+            self._save_quarantine()
+            metrics.inc("resilience.quarantine.expired", float(len(dead)))
+        return set(q)
+
     # -- recording --------------------------------------------------------
 
     def _record(self, op: str, key: str, backend: str, source: str,
@@ -226,7 +363,11 @@ class AutotuneTable:
         :class:`Candidate` — the first entry is the heuristic default
         used when timing is disabled; when EVERY candidate fails the
         ``"xla"`` entry (the stock-library backend) is preferred.
-        Returns the chosen backend name."""
+        A key with a live resilience quarantine entry (health-gate
+        demotion, see :meth:`quarantine_backend`) resolves probe-free
+        to the heuristic head of the non-quarantined candidates until
+        the TTL expires or the version key bumps.  Returns the chosen
+        backend name."""
 
         key = _key_str(op, key_parts)
         with self._lock:
@@ -235,6 +376,7 @@ class AutotuneTable:
             forced = _forced(op)
             if forced is not None:
                 if forced in names:
+                    # an explicit user pin outranks a quarantine demotion
                     metrics.inc("autotune.forced")
                     if hit is None or hit.get("backend") != forced:
                         self._record(op, key, forced, "forced")
@@ -242,6 +384,22 @@ class AutotuneTable:
                         metrics.inc("dispatch.%s.%s" % (op, forced))
                     return forced
                 _warn_bad_force(op, forced, names)
+            # resilience demotions: while a LIVE quarantine entry names
+            # this key, resolve to the heuristic head of the remaining
+            # candidates (never the quarantined ones; the safe backend
+            # always survives) with a NON-sticky, non-persisted record
+            # and NO timing probe — degraded mode wants the known-good
+            # choice, not a measurement.  Once the TTL expires (or the
+            # version bumps) the quarantine vanishes and the next call
+            # re-probes from scratch.
+            quar = self._live_quarantined(key)
+            if quar:
+                safe_name = safe_backend(op)
+                kept = [c.name for c in candidates
+                        if c.name not in quar or c.name == safe_name]
+                if kept:
+                    metrics.inc("autotune.quarantine.filtered")
+                    return self._record(op, key, kept[0], "quarantined")
             # Only settled results pin a key: knob-derived records
             # ("forced-config", "forced", "default") must not outlive
             # the knob that produced them, so they re-resolve cheaply on
@@ -266,10 +424,18 @@ class AutotuneTable:
                 return self._record(op, key, names[0], "default")
             times: dict = {}
             failures: dict = {}
+            from ..resilience import inject as _inject
             for cand in candidates:
                 try:
+                    # chaos seam: an injected "error" prunes the
+                    # candidate like a real compile failure; "nan"
+                    # corrupts the warm output so the accuracy guard
+                    # prunes it (no-op without an active fault plan)
+                    ikind = _inject.fault_here("autotune.probe")
                     run = cand.setup()
                     out = run()                       # compile + warm
+                    if ikind in ("nan", "inf"):
+                        out = _inject.corrupt_outputs(out, ikind)
                     if cand.check is not None and not cand.check(out):
                         failures[cand.name] = "accuracy-guard"
                         metrics.inc("autotune.pruned.accuracy-guard")
@@ -333,6 +499,21 @@ def decisions() -> dict:
 
 def timing_reps() -> int:
     return table().timing_reps
+
+
+def quarantine(op: str, key_parts, backend: str, reason: str = "",
+               ttl_s: Optional[float] = None) -> None:
+    """Demote one decision's backend (see
+    :meth:`AutotuneTable.quarantine_backend`)."""
+    table().quarantine_backend(_key_str(op, key_parts), backend,
+                               reason, ttl_s)
+
+
+def quarantine_key(key: str, backend: str, reason: str = "",
+                   ttl_s: Optional[float] = None) -> None:
+    """Demote by raw table key (``"op|part,part,..."``) — the form the
+    resilience health gates hold when walking ``table().decisions``."""
+    table().quarantine_backend(key, backend, reason, ttl_s)
 
 
 def kernel(name: str):
@@ -410,7 +591,13 @@ def _precision_name() -> str:
 def _static(op: str, key_parts, backend: str, source: str) -> str:
     """Record a decision resolved without timing (heuristic default,
     config-forced, ineligible shape) so every dispatch — not just the
-    timed ones — is visible in the table."""
+    timed ones — is visible in the table.  Inside a resilience
+    safe-backend window (:func:`suppress_knob_records`) the table is
+    left untouched: the knobs are temporarily forced and a clobbered
+    settled decision would re-probe at serving time after restore."""
+    if _knob_records_suppressed[0]:
+        metrics.inc("dispatch.%s.%s" % (op, backend))
+        return backend
     tab = table()
     key = _key_str(op, key_parts)
     if key not in tab.decisions or tab.decisions[key]["backend"] != backend:

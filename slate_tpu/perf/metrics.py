@@ -50,7 +50,10 @@ Env knobs:
 * ``SLATE_TPU_CHECK_FINITE`` — ``1`` makes every instrumented driver
   facade validate its outputs with :func:`slate_tpu.debug.check_finite`
   and increment ``checks.nonfinite`` (a warning, not an exception)
-  instead of letting NaNs fail silently downstream.
+  instead of letting NaNs fail silently downstream; ``2`` is the strict
+  tier — it folds into ``SLATE_TPU_HEALTH=strict``
+  (:mod:`slate_tpu.resilience.health`), where a failed gate degrades to
+  the stock backend and RAISES ``SlateError`` if still failing.
 * ``SLATE_TPU_METRICS_DEVICE`` — ``1`` adds runtime callbacks for
   data-dependent counters (LU u12 fallback activations).  Perturbs
   timing; off by default.
@@ -70,6 +73,7 @@ __all__ = [
     "timer", "observe_time", "snapshot", "snapshot_delta",
     "counter_series", "drain_samples", "instrument_driver",
     "check_finite_wanted", "device_metrics_wanted",
+    "resilience_wanted", "set_resilience_hint",
     "record_fallback_outcome", "pallas_census", "install_compile_watch",
     "step_timer", "count_hbm_roundtrips", "STEP_HBM_ROUNDTRIPS",
 ]
@@ -402,6 +406,34 @@ def device_metrics_wanted() -> bool:
     return _env_on("SLATE_TPU_METRICS_DEVICE")
 
 
+#: set by slate_tpu.resilience when a PROGRAMMATIC fault plan is
+#: installed (the env knobs are read directly below), so the driver
+#: wrapper consults the resilience pipeline without importing it when
+#: nothing is configured
+_resilience_hint = [False]
+
+
+def set_resilience_hint(on: bool) -> None:
+    """Flag that a programmatic resilience plan is active (called by
+    :func:`slate_tpu.resilience.inject.install` / ``clear_plan``)."""
+    _resilience_hint[0] = bool(on)
+
+
+def resilience_wanted() -> bool:
+    """Should the instrumented driver facades run the resilience
+    post-condition pipeline (fault injection + health gates)?  True
+    when a programmatic plan is installed, a ``SLATE_TPU_FAULT_INJECT``
+    plan is set, ``SLATE_TPU_HEALTH`` names an active tier, or the
+    legacy finite check is at its strict level (``=2``, folded into
+    the health knob as ``strict``)."""
+    return (_resilience_hint[0]
+            or bool(os.environ.get("SLATE_TPU_FAULT_INJECT", "").strip())
+            or os.environ.get("SLATE_TPU_HEALTH", "").strip().lower()
+            in ("warn", "retry", "strict")
+            or os.environ.get("SLATE_TPU_CHECK_FINITE", "").strip()
+            == "2")
+
+
 def _leaves(x, out=None) -> list:
     """Array leaves of a driver result: raw arrays, matrix wrappers
     (``.array`` resolves the stored op view) and (named) tuples."""
@@ -461,14 +493,26 @@ def instrument_driver(name: str):
         def wrapper(*args, **kwargs):
             reg = _registry
             checks = check_finite_wanted()
-            if not (reg.enabled or checks):
+            resil = resilience_wanted()
+            if not (reg.enabled or checks or resil):
                 return fn(*args, **kwargs)
             t0 = time.perf_counter()
             out = fn(*args, **kwargs)
             if reg.enabled:
                 inc(label + ".calls")
                 observe_time(label, time.perf_counter() - t0)
+            if resil:
+                # the resilience post-condition pipeline: driver.output
+                # fault injection + the SLATE_TPU_HEALTH gate ladder
+                # (warn / retry-on-safe-backend-with-quarantine /
+                # strict).  Skips itself under a jit trace.
+                from slate_tpu.resilience import health as _health
+
+                out = _health.driver_gate(name, fn, args, kwargs, out)
+                checks = checks and _health.mode() == "off"
             if checks:
+                # legacy SLATE_TPU_CHECK_FINITE=1 warn-and-count path
+                # (=2 folds into the health gate above as strict)
                 _check_outputs(name, out)
             return out
 

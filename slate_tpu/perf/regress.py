@@ -120,6 +120,10 @@ class Artifact:
     autotune: dict = field(default_factory=dict)
     attribution: dict = field(default_factory=dict)
     infra: List[str] = field(default_factory=list)
+    #: non-fatal annotations (e.g. ``retried_infra=true`` — the run
+    #: absorbed a transient backend-init failure via the resilience
+    #: layer's classified retry; numbers are real, provenance noted)
+    notes: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -206,6 +210,11 @@ def load_artifact(path: str) -> "Artifact":
         art.infra.append("no parsed routines")
     if agg.get("partial"):
         art.infra.append("partial aggregate (suite truncated)")
+    if agg.get("retried_infra"):
+        # tagged, not failed: bench absorbed a transient init error
+        # with its classified retry (resilience satellite) — the
+        # artifact is complete, its provenance just carries the flag
+        art.notes.append("retried_infra=true")
     return art
 
 
@@ -364,6 +373,9 @@ def format_table(report: Report) -> str:
                % (report.threshold_pct, n_reg))
     for name, reasons in report.infra:
         out.append("INFRA %s: %s" % (name, "; ".join(reasons)))
+    for a in report.artifacts:
+        for note in a.notes:
+            out.append("NOTE %s: %s" % (a.name, note))
     out.append("verdict: %s"
                % ("FAIL" if report.exit_code else "PASS"))
     return "\n".join(out)

@@ -1,0 +1,41 @@
+"""slate_tpu.resilience — detect, degrade, retry: the layer that turns
+"fast on a good day" into "correct on a bad one".
+
+SLATE treats numerical non-success as a first-class signal (LAPACK info
+codes, ``slate::Exception``); this package extends that stance to the
+whole serving stack, BLASX-style — keep scheduling around unreliable
+device behavior instead of assuming every launch succeeds:
+
+* :mod:`~slate_tpu.resilience.inject` — a deterministic, seeded
+  fault-injection framework (``SLATE_TPU_FAULT_INJECT`` env plans or
+  the programmatic :class:`FaultPlan` API) wired at the dispatch seams
+  the library already owns: autotune probes, serve bucket dispatch,
+  driver post-conditions, ``dist_util`` broadcasts and bench startup.
+  Zero overhead and bit-identical compiled programs when unset.
+* :mod:`~slate_tpu.resilience.health` — driver health gates
+  (``SLATE_TPU_HEALTH=off|warn|retry|strict``): NaN/Inf and cheap
+  scaled-residual post-conditions with graceful degradation — re-run
+  once through the stock-XLA backend and **quarantine** the offending
+  autotune winner (TTL'd demotion persisted alongside the cache)
+  instead of pinning a poisoned decision forever.
+* :mod:`~slate_tpu.resilience.breaker` — the per-(op, bucket) circuit
+  breaker the hardened serving path uses to fall back to
+  loop-of-singles after K consecutive batch failures.
+* :mod:`~slate_tpu.resilience.retry` — classified
+  retry-with-exponential-backoff (transient infra errors: TPU init
+  RPCs, injected faults) used by bench startup, the multichip dryrun
+  and the serve dispatch loop.
+
+Everything emits ``resilience.*`` counters through the metrics registry
+(:mod:`slate_tpu.perf.metrics`) so every degradation is observable in
+bench JSON lines; the whole layer is exercised end-to-end by the
+injection-driven chaos tests in ``tests/test_resilience.py``.
+"""
+
+from .inject import (  # noqa: F401
+    FaultPlan, FaultSpec, InjectedFault, active, clear_plan, fault_here,
+    get_plan, install, poll,
+)
+from .health import mode as health_mode, safe_backend  # noqa: F401
+from .breaker import CircuitBreaker  # noqa: F401
+from .retry import transient_infra, with_backoff  # noqa: F401

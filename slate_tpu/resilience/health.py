@@ -1,0 +1,282 @@
+"""Driver health gates with graceful degradation and backend quarantine.
+
+``debug.check_finite`` and the library's cheap scaled-residual probes
+are promoted here into an opt-in POST-CONDITION pipeline that every
+instrumented driver facade (:func:`slate_tpu.perf.metrics.
+instrument_driver`) runs after each eager call::
+
+    SLATE_TPU_HEALTH=off|warn|retry|strict
+
+* ``off`` (default) — no checks; the facade is unchanged.
+* ``warn`` — NaN/Inf (and registered residual) failures count
+  ``resilience.health.fail`` and warn; the result still flows.
+* ``retry`` — a failed gate triggers GRACEFUL DEGRADATION: the call
+  re-runs ONCE through the stock-XLA backend (:func:`safe_backend`).
+  A clean stock answer is evidence the fast-path winner was at fault,
+  so the driver's suspect autotune winners are **quarantined**
+  (:func:`slate_tpu.perf.autotune.quarantine_key` — a TTL'd demotion
+  persisted alongside the cache, re-probed on version bump, instead of
+  a poisoned winner pinned forever) and the recovered result returns
+  (``resilience.recovered``).  Both backends failing means the input
+  is the problem — nothing is demoted, the gate warns
+  (``resilience.unrecovered``).
+* ``strict`` — like ``retry`` but an unrecovered failure RAISES
+  :class:`~slate_tpu.exceptions.SlateError`.  The legacy
+  ``SLATE_TPU_CHECK_FINITE`` knob folds in here: ``=2`` ≡
+  ``SLATE_TPU_HEALTH=strict`` (``=1`` keeps its original
+  warn-and-count behavior in :mod:`slate_tpu.perf.metrics`).
+
+The gate NEVER acts under a jit trace (tracer leaves are skipped and
+the traced program is untouched), so with every knob unset the
+compiled programs stay bit-identical — pinned in
+``tests/test_resilience.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional, Tuple
+
+from ..exceptions import SlateError
+from ..perf import metrics
+from .inject import iter_leaves
+
+__all__ = [
+    "ENV_HEALTH", "MODES", "driver_gate", "mode", "register_residual",
+    "safe_backend",
+]
+
+ENV_HEALTH = "SLATE_TPU_HEALTH"
+MODES = ("off", "warn", "retry", "strict")
+
+
+def mode() -> str:
+    """The effective health tier.  ``SLATE_TPU_HEALTH`` wins;
+    ``SLATE_TPU_CHECK_FINITE=2`` (the strict finite check) folds in as
+    ``strict``; anything else is ``off``."""
+    raw = os.environ.get(ENV_HEALTH, "").strip().lower()
+    if raw in MODES:
+        return raw
+    if os.environ.get("SLATE_TPU_CHECK_FINITE", "").strip() == "2":
+        return "strict"
+    return "off"
+
+
+# ---------------------------------------------------------------------------
+# The safe backend: force every multi-backend site to its stock
+# candidate for the duration of a degraded re-run.
+# ---------------------------------------------------------------------------
+
+_safe_lock = threading.RLock()
+
+
+@contextmanager
+def safe_backend():
+    """Force the stock-library backends (XLA ops, vmapped batching, the
+    blocked recursions) for the body's duration: the Pallas / Ozaki /
+    scattered knobs are pinned off, so every autotune chooser resolves
+    to its safe candidate without consulting (possibly poisoned) timed
+    winners.  Process-global by necessity (the knobs are module
+    globals) — held under one lock so concurrent degraded re-runs
+    serialize instead of racing the restore."""
+    from .. import config
+    from ..perf import autotune
+
+    with _safe_lock:
+        saved = (config.use_pallas, config.f64_mxu, config.scattered_lu)
+        config.use_pallas = False
+        config.f64_mxu = False
+        config.scattered_lu = False
+        try:
+            # the temporarily-forced knobs must not overwrite settled
+            # autotune decisions (they would re-probe after restore)
+            with autotune.suppress_knob_records():
+                yield
+        finally:
+            (config.use_pallas, config.f64_mxu,
+             config.scattered_lu) = saved
+
+
+# ---------------------------------------------------------------------------
+# Cheap residual post-conditions (opt-in per driver)
+# ---------------------------------------------------------------------------
+
+#: driver name -> (fn(args, kwargs, out) -> scaled residual, gate)
+_RESIDUALS: Dict[str, Tuple[Callable, float]] = {}
+
+
+def register_residual(driver: str, fn: Callable, gate: float = 100.0
+                      ) -> None:
+    """Attach a cheap scaled-residual probe to a driver facade: the
+    health gate fails when ``fn(args, kwargs, out) >= gate`` (units of
+    eps·n, the library's usual scaling).  A probe that itself raises is
+    ignored — a broken check must not fail a healthy driver."""
+    _RESIDUALS[driver] = (fn, float(gate))
+
+
+def _resid_potrf_batched(args, kwargs, out) -> float:
+    from ..linalg.batched import batched_factor_resid_potrf
+
+    return batched_factor_resid_potrf(args[0], out)
+
+
+def _resid_getrf_batched(args, kwargs, out) -> float:
+    from ..linalg.batched import batched_factor_resid_lu
+
+    return batched_factor_resid_lu(args[0], out)
+
+
+register_residual("potrf_batched", _resid_potrf_batched)
+register_residual("getrf_batched", _resid_getrf_batched)
+
+
+def _healthy(name: str, args, kwargs, out) -> bool:
+    """The post-condition: every float leaf finite, plus the driver's
+    registered residual probe (if any) under its gate."""
+    import numpy as np
+
+    for leaf in iter_leaves(out):
+        try:
+            a = np.asarray(leaf)
+        except Exception:
+            continue                      # unconvertible leaf (weak types)
+        if a.dtype.kind in "fc" and not np.isfinite(a).all():
+            return False
+    probe = _RESIDUALS.get(name)
+    if probe is not None:
+        fn, gate = probe
+        try:
+            r = float(fn(args, kwargs, out))
+        except Exception:
+            return True
+        if not (r < gate):                # NaN residual fails too
+            return False
+    return True
+
+
+def _has_tracer(out) -> bool:
+    try:
+        import jax
+
+        tracer_t = jax.core.Tracer
+    except Exception:                      # pragma: no cover
+        return False
+    return any(isinstance(leaf, tracer_t) for leaf in iter_leaves(out))
+
+
+# ---------------------------------------------------------------------------
+# Quarantine attribution: which autotune sites feed which driver facade
+# ---------------------------------------------------------------------------
+
+_FACTOR_SITES = ("matmul", "trtri_panel")
+_DRIVER_SITES: Dict[str, Tuple[str, ...]] = {
+    "gemm": ("matmul",),
+    "trsm": ("matmul",),
+    "potrf": ("potrf_panel", "potrf_panel_f64", "potrf_step")
+    + _FACTOR_SITES,
+    "potrs": _FACTOR_SITES,
+    "posv": ("potrf_panel", "potrf_panel_f64", "potrf_step")
+    + _FACTOR_SITES,
+    "potri": ("potrf_panel", "potrf_panel_f64") + _FACTOR_SITES,
+    "trtri": _FACTOR_SITES,
+    "getrf": ("lu_driver", "lu_panel", "lu_step") + _FACTOR_SITES,
+    "getrs": _FACTOR_SITES,
+    "gesv": ("lu_driver", "lu_panel", "lu_step") + _FACTOR_SITES,
+    "getri": ("lu_driver", "lu_panel", "lu_step") + _FACTOR_SITES,
+    "geqrf": ("geqrf_panel",) + _FACTOR_SITES,
+    "gels": ("geqrf_panel",) + _FACTOR_SITES,
+    "heev": ("chase",) + _FACTOR_SITES,
+    "svd": ("chase",) + _FACTOR_SITES,
+    "potrf_batched": ("batched_potrf",),
+    "posv_batched": ("batched_potrf",),
+    "getrf_batched": ("batched_lu",),
+    "gesv_batched": ("batched_lu",),
+    "geqrf_batched": ("batched_qr",),
+    "gels_batched": ("batched_qr",),
+}
+
+
+def _quarantine_for(name: str, reason: str) -> int:
+    """Demote every settled (timed/cached) non-safe autotune winner
+    feeding driver ``name`` — the gate failed, so the measured winner is
+    suspect; re-probing after the TTL (or the next version bump) is the
+    re-admission path.  Returns the number of demotions."""
+    from ..perf import autotune
+
+    sites = _DRIVER_SITES.get(name, ())
+    if not sites:
+        return 0
+    demoted = 0
+    tab = autotune.table()
+    for key, info in list(tab.decisions.items()):
+        op = info.get("op") or key.split("|", 1)[0]
+        if op not in sites:
+            continue
+        if info.get("source") not in ("timed", "cache"):
+            continue
+        backend = info.get("backend")
+        if backend == autotune.safe_backend(op):
+            continue
+        autotune.quarantine_key(key, backend, reason=reason)
+        demoted += 1
+    return demoted
+
+
+# ---------------------------------------------------------------------------
+# The driver post-condition pipeline
+# ---------------------------------------------------------------------------
+
+def driver_gate(name: str, fn, args, kwargs, out):
+    """Run the resilience post-conditions for one eager driver call:
+    fault injection (site ``driver.output``), then the health gate for
+    the current :func:`mode`.  Called by
+    :func:`slate_tpu.perf.metrics.instrument_driver`; no-op (and
+    poll-free) under a jit trace so compiled programs never change."""
+    from . import inject
+
+    if _has_tracer(out):
+        return out
+    kind = inject.poll("driver.output")
+    if kind == "error":
+        raise inject.InjectedFault("driver.output")
+    if kind in ("nan", "inf"):
+        out = inject.corrupt_outputs(out, kind)
+    m = mode()
+    if m == "off":
+        return out
+    metrics.inc("resilience.health.checks")
+    if _healthy(name, args, kwargs, out):
+        return out
+    metrics.inc("resilience.health.fail")
+    if m == "warn":
+        warnings.warn(
+            f"{name}: output failed the health gate (non-finite or "
+            "residual over gate); SLATE_TPU_HEALTH=warn passes it "
+            "through", RuntimeWarning, stacklevel=3)
+        return out
+    # retry / strict: degrade to the stock backend and answer from
+    # there.  Quarantine ONLY when the safe re-run recovers — a clean
+    # stock answer from the same inputs is evidence the fast-path
+    # winner was at fault; when BOTH backends fail, the input (a
+    # singular pivot, a NaN operand) is the problem and demoting
+    # healthy winners for 24h would punish the hardware for the data.
+    metrics.inc("resilience.retry")
+    with safe_backend():
+        out2 = fn(*args, **kwargs)
+    if _healthy(name, args, kwargs, out2):
+        _quarantine_for(name, reason=f"health gate failed in {name}; "
+                        "stock backend recovered")
+        metrics.inc("resilience.recovered")
+        return out2
+    metrics.inc("resilience.unrecovered")
+    if m == "strict":
+        raise SlateError(
+            f"{name}: output failed the health gate even on the "
+            "stock-XLA backend (SLATE_TPU_HEALTH=strict)")
+    warnings.warn(
+        f"{name}: health gate still failing after the stock-backend "
+        "re-run", RuntimeWarning, stacklevel=3)
+    return out2

@@ -1,0 +1,292 @@
+"""Deterministic, seeded fault injection at the library's dispatch seams.
+
+A production linear-algebra stack must keep answering when a device
+misbehaves — and the only way to PROVE the degradation ladder works is
+to drive faults through it on demand.  This module is that harness:
+
+* **Plans.**  A :class:`FaultPlan` is a set of :class:`FaultSpec`
+  entries ``(site, kind, rate[, count])`` plus a seed.  Configure via
+  the environment::
+
+      SLATE_TPU_FAULT_INJECT="site=kind:rate[:count],..."
+      SLATE_TPU_FAULT_SEED=1234          # default 0
+
+  e.g. ``SLATE_TPU_FAULT_INJECT="serve.dispatch=error:0.1,
+  driver.output=nan:0.05:3"`` — 10% of serve bucket dispatches raise
+  an :class:`InjectedFault`, and the first ~5% of driver calls (at most
+  3 total) get one NaN written into their output.  Or programmatically:
+  ``inject.install(FaultPlan(seed=7).add("serve.dispatch", "error",
+  rate=0.1))`` (overrides the env plan until :func:`clear_plan`).
+
+* **Determinism.**  Every seam calls :func:`poll` exactly once per
+  event; the decision for event ``i`` at ``site`` is a pure function of
+  ``(seed, site, i)`` (``random.Random`` seeded with the string — SHA
+  of the text, independent of ``PYTHONHASHSEED``), so the same seed
+  replays the same fault sequence and :attr:`FaultPlan.log` records
+  what fired for assertion.  ``count`` caps total fired faults per site.
+
+* **Kinds.**  ``error`` — the seam raises :class:`InjectedFault`
+  (a transient, classified-retryable :class:`SlateError`); ``nan`` /
+  ``inf`` — the seam poisons one element of its output (the silent-
+  corruption failure mode health gates exist to catch).
+
+* **Sites** wired today: ``autotune.probe`` (candidate compile/time),
+  ``serve.dispatch`` (bucket batch dispatch), ``driver.output``
+  (instrumented driver facades, host-side post-call), ``dist.bcast``
+  (the fused panel broadcasts — trace-time, so an active plan changes
+  the traced program BY DESIGN), ``bench.startup`` (bench routine
+  start) and ``infra.init`` (backend init in bench / the multichip
+  dryrun).  Unknown sites in a plan are legal — they simply never poll.
+
+* **Zero cost off.**  With no plan installed and no env var set,
+  :func:`poll` is one dict lookup returning ``None``; nothing is
+  imported into compiled programs and the traced HLO is bit-identical
+  (pinned in ``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import SlateError
+from ..perf import metrics
+
+__all__ = [
+    "ENV_PLAN", "ENV_SEED", "KINDS", "FaultPlan", "FaultSpec",
+    "InjectedFault", "active", "clear_plan", "corrupt_outputs",
+    "fault_here", "get_plan", "install", "iter_leaves", "parse_plan",
+    "poll",
+]
+
+ENV_PLAN = "SLATE_TPU_FAULT_INJECT"
+ENV_SEED = "SLATE_TPU_FAULT_SEED"
+
+KINDS = ("error", "nan", "inf")
+
+
+class InjectedFault(SlateError):
+    """A deliberately injected, transient failure (always classified
+    retryable by :func:`slate_tpu.resilience.retry.transient_infra`)."""
+
+    def __init__(self, site: str, index: Optional[int] = None):
+        self.site = site
+        self.index = index
+        at = "" if index is None else f" (event #{index})"
+        super().__init__(f"injected fault at {site}{at}")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One site's fault schedule: fire ``kind`` with probability
+    ``rate`` per event, at most ``count`` times (None = unlimited)."""
+
+    site: str
+    kind: str
+    rate: float = 1.0
+    count: Optional[int] = None
+
+
+class FaultPlan:
+    """A seeded set of :class:`FaultSpec` with per-site event counters
+    and a replay :attr:`log` of ``(site, event_index, kind)`` fired."""
+
+    def __init__(self, specs: Optional[List[FaultSpec]] = None,
+                 seed: int = 0):
+        self.seed = int(seed)
+        self.specs: Dict[str, FaultSpec] = {}
+        for s in (specs or []):
+            self.specs[s.site] = s
+        self._events: Dict[str, int] = {}
+        self._fired: Dict[str, int] = {}
+        self.log: List[Tuple[str, int, str]] = []
+        self._lock = threading.Lock()
+
+    def add(self, site: str, kind: str, rate: float = 1.0,
+            count: Optional[int] = None) -> "FaultPlan":
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; known: {KINDS}")
+        self.specs[site] = FaultSpec(site, kind, float(rate), count)
+        return self
+
+    def poll(self, site: str) -> Optional[str]:
+        """One event at ``site``: returns the fault kind to inject, or
+        None.  Deterministic in (seed, site, event index)."""
+        spec = self.specs.get(site)
+        if spec is None:
+            return None
+        with self._lock:
+            idx = self._events.get(site, 0)
+            self._events[site] = idx + 1
+            if spec.count is not None \
+                    and self._fired.get(site, 0) >= spec.count:
+                return None
+            r = random.Random(f"{self.seed}|{site}|{idx}").random()
+            if r >= spec.rate:
+                return None
+            self._fired[site] = self._fired.get(site, 0) + 1
+            self.log.append((site, idx, spec.kind))
+        metrics.inc("resilience.inject." + site)
+        return spec.kind
+
+    def fired(self, site: Optional[str] = None) -> int:
+        with self._lock:
+            if site is not None:
+                return self._fired.get(site, 0)
+            return sum(self._fired.values())
+
+
+def parse_plan(raw: str, seed: int = 0) -> FaultPlan:
+    """Parse the ``SLATE_TPU_FAULT_INJECT`` grammar:
+    ``site=kind:rate[:count]`` entries, comma-separated.  Malformed
+    entries raise — a chaos harness whose plan silently half-parses
+    would "pass" tests it never ran."""
+    plan = FaultPlan(seed=seed)
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            site, rest = part.split("=", 1)
+            toks = rest.split(":")
+            kind = toks[0].strip()
+            rate = float(toks[1]) if len(toks) > 1 else 1.0
+            count = int(toks[2]) if len(toks) > 2 else None
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"bad {ENV_PLAN} entry {part!r}; expected "
+                "site=kind:rate[:count]") from None
+        plan.add(site.strip(), kind, rate, count)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# The active plan: programmatic install wins over the env var.  The
+# env-derived plan is cached per (plan string, seed string) so its event
+# counters persist across polls within the process.
+# ---------------------------------------------------------------------------
+
+_installed: List[Optional[FaultPlan]] = [None]
+_env_cache: List[Optional[Tuple[Tuple[str, str], FaultPlan]]] = [None]
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Activate a programmatic plan (wins over the env plan)."""
+    _installed[0] = plan
+    metrics.set_resilience_hint(True)
+    return plan
+
+
+def clear_plan() -> None:
+    _installed[0] = None
+    _env_cache[0] = None
+    metrics.set_resilience_hint(False)
+
+
+def get_plan() -> Optional[FaultPlan]:
+    if _installed[0] is not None:
+        return _installed[0]
+    raw = os.environ.get(ENV_PLAN, "").strip()
+    if not raw:
+        return None
+    seed_raw = os.environ.get(ENV_SEED, "0").strip() or "0"
+    cached = _env_cache[0]
+    if cached is None or cached[0] != (raw, seed_raw):
+        _env_cache[0] = ((raw, seed_raw), parse_plan(raw, int(seed_raw)))
+    return _env_cache[0][1]
+
+
+def active() -> bool:
+    return get_plan() is not None
+
+
+def poll(site: str) -> Optional[str]:
+    """One fault-injection event at ``site``; None when no plan names
+    the site (the no-op fast path — one env read + dict lookup)."""
+    plan = get_plan()
+    return plan.poll(site) if plan is not None else None
+
+
+def fault_here(site: str) -> Optional[str]:
+    """Poll ``site`` and raise :class:`InjectedFault` on an ``error``
+    fault; returns the kind (``nan``/``inf``) for seams that also
+    support output corruption, else None."""
+    kind = poll(site)
+    if kind == "error":
+        raise InjectedFault(site)
+    return kind
+
+
+# ---------------------------------------------------------------------------
+# Output corruption (the nan/inf kinds)
+# ---------------------------------------------------------------------------
+
+def iter_leaves(x, out=None) -> list:
+    """Array leaves of a driver result: raw arrays, matrix wrappers
+    (``.array``) and (named) tuples/lists — the shared walker the
+    health gates reuse."""
+    if out is None:
+        out = []
+    if x is None or isinstance(x, (bool, int, float, complex, str)):
+        return out
+    if isinstance(x, (list, tuple)):
+        for e in x:
+            iter_leaves(e, out)
+        return out
+    arr = getattr(x, "array", x)
+    if hasattr(arr, "shape") and hasattr(arr, "dtype"):
+        out.append(arr)
+    return out
+
+
+def _poison(arr, kind: str):
+    import numpy as np
+
+    val = float("nan") if kind == "nan" else float("inf")
+    if arr.ndim == 0:
+        return arr
+    idx = (0,) * arr.ndim
+    if hasattr(arr, "at"):                       # jax array (eager)
+        return arr.at[idx].set(val)
+    out = np.array(arr, copy=True)
+    out[idx] = val
+    return out
+
+
+def _is_float_array(x) -> bool:
+    import numpy as np
+
+    dt = getattr(x, "dtype", None)
+    if dt is None or not hasattr(x, "shape"):
+        return False
+    return np.issubdtype(np.dtype(dt), np.floating) \
+        or np.issubdtype(np.dtype(dt), np.complexfloating)
+
+
+def corrupt_outputs(out, kind: str):
+    """Rebuild a driver result tree with ONE poison value written into
+    element [0, ..., 0] of its first floating-point raw-array leaf —
+    the block-corruption failure mode the health gates detect.  Leaves
+    inside matrix wrappers are left alone (a wrapper cannot be rebuilt
+    generically); tuples/lists/namedtuples are reconstructed."""
+
+    state = {"done": False}
+
+    def walk(x):
+        if state["done"] or x is None \
+                or isinstance(x, (bool, int, float, complex, str)):
+            return x
+        if isinstance(x, (list, tuple)):
+            vals = [walk(e) for e in x]
+            if hasattr(x, "_fields"):            # namedtuple
+                return type(x)(*vals)
+            return type(x)(vals)
+        if _is_float_array(x) and not hasattr(x, "array"):
+            state["done"] = True
+            return _poison(x, kind)
+        return x
+
+    return walk(out)

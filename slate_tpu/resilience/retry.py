@@ -1,0 +1,75 @@
+"""Classified retry-with-exponential-backoff for transient infra errors.
+
+The r05 bench round produced an EMPTY artifact because one TPU
+worker-hostname init RPC failed once; the fix is not "retry everything"
+(a residual-gate failure must never be retried into silence) but one
+classified retry around the known-transient seams: backend init in
+``bench.py``, the multichip dryrun's subprocess provisioning, and the
+serve dispatch loop.  :func:`transient_infra` is the shared classifier;
+:func:`with_backoff` the shared loop.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+from ..perf import metrics
+
+__all__ = ["transient_infra", "with_backoff"]
+
+#: lowercase substrings that mark an exception as transient
+#: infrastructure trouble (TPU init RPCs, tunnel flakes) rather than a
+#: numerical or programming error.  Deliberately NOT "init" (it would
+#: match every ``__init__()`` TypeError) — backend-init failures say
+#: "initialize"/"worker"/"unavailable"/...
+_TRANSIENT_PATTERNS = (
+    "unavailable", "deadline", "rpc", "connection", "hostname",
+    "worker", "initialize", "initialization", "timed out", "timeout",
+    "temporarily", "resource exhausted", "libtpu", "already in use",
+    "aborted",
+)
+
+#: exception classes that are deterministic programming errors however
+#: their message reads — never absorbed by a retry
+_NEVER_TRANSIENT = (TypeError, AttributeError, NameError, KeyError,
+                    IndexError, AssertionError, SyntaxError)
+
+
+def transient_infra(e: BaseException) -> bool:
+    """True when ``e`` looks like transient infrastructure trouble —
+    the only class of failure a retry may absorb."""
+    from .inject import InjectedFault
+
+    if isinstance(e, InjectedFault):
+        return True
+    if isinstance(e, _NEVER_TRANSIENT):
+        return False
+    if isinstance(e, (OSError, TimeoutError, ConnectionError)):
+        return True
+    msg = ("%s: %s" % (type(e).__name__, e)).lower()
+    return any(p in msg for p in _TRANSIENT_PATTERNS)
+
+
+def with_backoff(fn: Callable, attempts: int = 2, base_s: float = 0.05,
+                 classify: Optional[Callable[[BaseException], bool]] = None,
+                 metric: str = "resilience.retries",
+                 sleep: Callable[[float], None] = time.sleep
+                 ) -> Tuple[object, int]:
+    """Run ``fn()`` with up to ``attempts`` total tries; retry only
+    failures ``classify`` accepts (None = retry any exception), backing
+    off ``base_s * 2**retry`` between tries.  Returns ``(result,
+    retries_used)``; the final failure (or the first non-transient one)
+    propagates unchanged."""
+    retries = 0
+    while True:
+        try:
+            return fn(), retries
+        except Exception as e:
+            if retries + 1 >= max(1, attempts):
+                raise
+            if classify is not None and not classify(e):
+                raise
+            metrics.inc(metric)
+            sleep(base_s * (2 ** retries))
+            retries += 1

@@ -20,6 +20,6 @@ cleanly without an explicit :func:`shutdown`, but draining via
 """
 
 from .queue import (  # noqa: F401
-    BatchQueue, ServeConfig, SUPPORTED_OPS, get_server, shutdown,
-    specs_from_autotune_cache, submit, warm_start,
+    Backpressure, BatchQueue, ServeConfig, SUPPORTED_OPS, get_server,
+    shutdown, specs_from_autotune_cache, submit, warm_start,
 )

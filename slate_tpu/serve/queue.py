@@ -42,6 +42,42 @@ The queue deliberately knows nothing about backends: it calls ONLY the
 batched driver facades (:mod:`slate_tpu.linalg.batched`), which resolve
 through the autotune table like every other op site — the registry
 guard test pins that no ``serve/`` module reaches into ``ops/``.
+
+**The hardened path** (resilience layer, ISSUE 9): serving millions of
+users means one bad executable or one transient dispatch error must
+never hang a caller's future or silently poison output.
+
+* **Deadlines** — ``ServeConfig.deadline_s`` (or per-request
+  ``submit(..., deadline_s=...)``): a request still queued past its
+  deadline resolves with ``TimeoutError`` instead of waiting forever.
+* **Retry with backoff** — a TRANSIENT batch-dispatch failure
+  (classified by :func:`slate_tpu.resilience.retry.transient_infra`)
+  retries up to ``max_retries`` times with exponential backoff before
+  degrading; with ``SLATE_TPU_HEALTH`` active a non-finite batch result
+  counts as a failure too (a poisoned answer must not resolve a
+  future).
+* **Circuit breaker** — per (op, bucket)
+  (:class:`slate_tpu.resilience.breaker.CircuitBreaker`):
+  ``breaker_threshold`` consecutive batch failures OPEN it and
+  dispatches fall back to **loop-of-singles on the safe backend**
+  (:func:`slate_tpu.resilience.health.safe_backend` — stock XLA,
+  eager, never the possibly-poisoned compiled executable); after
+  ``breaker_cooldown_s`` a HALF-OPEN trial batch re-probes the fast
+  path.  A failed-but-transient batch below the threshold ALSO
+  resolves through singles — futures always resolve.
+* **Backpressure** — ``max_queue_depth`` bounds the total queued
+  requests; past it :meth:`BatchQueue.submit` raises
+  :class:`Backpressure` explicitly instead of accepting unbounded work.
+* **close()/flush() contract** — :meth:`BatchQueue.close` FAILS (never
+  strands) any still-queued future, and :meth:`BatchQueue.flush` with a
+  timeout raises ``TimeoutError`` on expiry instead of returning
+  silently with work still pending.
+
+Fault injection (``SLATE_TPU_FAULT_INJECT`` site ``serve.dispatch``,
+:mod:`slate_tpu.resilience.inject`) drives all of it in the chaos tests;
+``serve.retries`` / ``serve.breaker.*`` / ``serve.fallback.singles`` /
+``serve.deadline_expired`` / ``serve.backpressure`` counters make every
+degradation observable.
 """
 
 from __future__ import annotations
@@ -52,10 +88,38 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..exceptions import SlateError
 from ..perf import metrics
+from ..resilience import health as _health
+from ..resilience import inject as _inject
+from ..resilience.breaker import CircuitBreaker
+from ..resilience.retry import transient_infra, with_backoff
 
-__all__ = ["ServeConfig", "BatchQueue", "warm_start", "get_server",
-           "submit", "shutdown", "SUPPORTED_OPS"]
+__all__ = ["ServeConfig", "BatchQueue", "Backpressure", "warm_start",
+           "get_server", "submit", "shutdown", "SUPPORTED_OPS"]
+
+
+class Backpressure(SlateError):
+    """The queue is at its depth bound — explicit backpressure: the
+    caller should shed load or retry later, not enqueue unboundedly."""
+
+
+class _UnhealthyBatch(SlateError):
+    """A batch result failed the finite check under an active health
+    mode — handled like a transient dispatch failure (retry, then
+    loop-of-singles), never resolved into futures."""
+
+
+def _finite_arrays(out) -> bool:
+    """Every float/complex array in a dispatch result is fully finite
+    (int arrays — permutations — pass trivially)."""
+    import numpy as np
+
+    for o in out:
+        a = np.asarray(o)
+        if a.dtype.kind in "fc" and not np.isfinite(a).all():
+            return False
+    return True
 
 
 def _bucket(d: int, policy: str = "pow2", floor: int = 8) -> int:
@@ -78,20 +142,39 @@ class ServeConfig:
     * ``bucket`` — ``"pow2"`` (default: pad dims to the next power of
       two, one executable per bucket) or ``"exact"`` (no dim padding —
       one executable per exact shape; for fleets with few shapes).
+
+    Hardening knobs (see the module docstring's "hardened path"):
+
+    * ``deadline_s`` — default per-request deadline (None = none);
+      ``submit(..., deadline_s=...)`` overrides per request.
+    * ``max_retries`` / ``retry_backoff_s`` — transient batch-dispatch
+      failures retry this many times with exponential backoff.
+    * ``breaker_threshold`` / ``breaker_cooldown_s`` — consecutive
+      batch failures before the per-(op, bucket) breaker opens, and the
+      cool-down before its half-open re-probe.
+    * ``max_queue_depth`` — total queued requests before
+      :meth:`BatchQueue.submit` raises :class:`Backpressure`.
     """
 
     max_batch: int = 64
     max_wait_s: float = 0.002
     bucket: str = "pow2"
+    deadline_s: Optional[float] = None
+    max_retries: int = 2
+    retry_backoff_s: float = 0.005
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 0.25
+    max_queue_depth: int = 4096
 
 
-@dataclass
+@dataclass(eq=False)
 class _Request:
     operands: tuple
     shape: tuple            # original dims, for unpadding
     future: concurrent.futures.Future = field(
         default_factory=concurrent.futures.Future)
     t_submit: float = field(default_factory=time.perf_counter)
+    deadline: Optional[float] = None    # absolute perf_counter time
 
 
 #: op name → number of operands.  Every op maps onto one batched driver
@@ -185,6 +268,8 @@ class BatchQueue:
         self.config = config or ServeConfig()
         self._buckets: Dict[tuple, List[_Request]] = {}
         self._compiled: Dict[tuple, object] = {}
+        self._breakers: Dict[tuple, CircuitBreaker] = {}
+        self._inflight = 0              # popped but not yet resolved
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
@@ -208,10 +293,17 @@ class BatchQueue:
 
     # -- public API --------------------------------------------------------
 
-    def submit(self, op: str, *operands) -> concurrent.futures.Future:
+    def submit(self, op: str, *operands,
+               deadline_s: Optional[float] = None
+               ) -> concurrent.futures.Future:
         """File one problem; returns the Future of its result (the
         batched driver's per-problem output: potrf→L, getrf→(LU, perm),
-        posv/gesv/gels→x, geqrf→(packed, taus))."""
+        posv/gesv/gels→x, geqrf→(packed, taus)).
+
+        ``deadline_s`` (default :attr:`ServeConfig.deadline_s`): a
+        request still queued past its deadline resolves with
+        ``TimeoutError``.  Raises :class:`Backpressure` when the queue
+        is at :attr:`ServeConfig.max_queue_depth`."""
         if op not in SUPPORTED_OPS:
             raise KeyError(f"unsupported serve op {op!r}; "
                            f"known: {sorted(SUPPORTED_OPS)}")
@@ -219,14 +311,25 @@ class BatchQueue:
             raise TypeError(f"{op} takes {SUPPORTED_OPS[op]} operands, "
                             f"got {len(operands)}")
         key = self.bucket_key(op, operands)
+        if deadline_s is None:
+            deadline_s = self.config.deadline_s
         req = _Request(operands=tuple(operands),
                        shape=tuple(getattr(x, "shape", ())
                                    for x in operands))
+        if deadline_s is not None:
+            req.deadline = req.t_submit + float(deadline_s)
         with self._wake:
             if self._closed:
                 raise RuntimeError("BatchQueue is closed")
-            self._buckets.setdefault(key, []).append(req)
             depth = sum(len(v) for v in self._buckets.values())
+            if depth >= self.config.max_queue_depth:
+                metrics.inc("serve.backpressure")
+                raise Backpressure(
+                    f"serve queue at its depth bound "
+                    f"({depth} >= {self.config.max_queue_depth}); "
+                    "shed load or retry later")
+            self._buckets.setdefault(key, []).append(req)
+            depth += 1
             self._ensure_thread()
             self._wake.notify_all()
         metrics.inc("serve.requests")
@@ -234,24 +337,45 @@ class BatchQueue:
         return req.future
 
     def flush(self, timeout: Optional[float] = None) -> None:
-        """Block until every queued request has been dispatched."""
+        """Block until every queued AND in-flight request has been
+        dispatched.  With a ``timeout``, raises ``TimeoutError`` on
+        expiry — silently returning with work still pending is exactly
+        the stranded-future failure mode this layer removes."""
         deadline = None if timeout is None else time.perf_counter() + timeout
         with self._wake:
-            while any(self._buckets.values()):
+            while any(self._buckets.values()) or self._inflight:
                 rem = None if deadline is None \
-                    else max(0.0, deadline - time.perf_counter())
-                if rem == 0.0:
-                    return
+                    else deadline - time.perf_counter()
+                if rem is not None and rem <= 0.0:
+                    pending = (sum(len(v) for v in self._buckets.values())
+                               + self._inflight)
+                    raise TimeoutError(
+                        f"BatchQueue.flush: {pending} request(s) still "
+                        f"pending after {timeout}s")
                 self._wake.wait(timeout=rem if rem is not None
                                 else self.config.max_wait_s)
 
     def close(self) -> None:
-        """Drain outstanding requests, then stop the dispatcher."""
+        """Stop accepting work, drain what the dispatcher can, then
+        FAIL — never strand — any future still queued (dead dispatcher,
+        request stuck behind a hung dispatch): each one gets a
+        ``SlateError`` set so callers blocked in ``result()`` wake."""
         with self._wake:
             self._closed = True
             self._wake.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=30.0)
+            thread = self._thread
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=30.0)
+        with self._wake:
+            leftovers = [r for reqs in self._buckets.values()
+                         for r in reqs]
+            self._buckets.clear()
+        for r in leftovers:
+            if not r.future.done():
+                metrics.inc("serve.closed_undispatched")
+                r.future.set_exception(SlateError(
+                    "BatchQueue closed before this request was "
+                    "dispatched"))
 
     # -- warm start --------------------------------------------------------
 
@@ -293,6 +417,21 @@ class BatchQueue:
                 if self._closed and not any(self._buckets.values()):
                     return
                 now = time.perf_counter()
+                # expire requests past their deadline BEFORE batching:
+                # a deadlined request resolves with TimeoutError, never
+                # rides a dispatch it can no longer use
+                expired: List[_Request] = []
+                for key in list(self._buckets):
+                    live: List[_Request] = []
+                    for r in self._buckets[key]:
+                        if r.deadline is not None and now >= r.deadline:
+                            expired.append(r)
+                        else:
+                            live.append(r)
+                    if live:
+                        self._buckets[key] = live
+                    else:
+                        del self._buckets[key]
                 ready, soonest = [], None
                 for key, reqs in self._buckets.items():
                     if not reqs:
@@ -305,6 +444,8 @@ class BatchQueue:
                         due = reqs[0].t_submit + cfg.max_wait_s
                         soonest = due if soonest is None \
                             else min(soonest, due)
+                        if reqs[0].deadline is not None:
+                            soonest = min(soonest, reqs[0].deadline)
                 batches: List[Tuple[tuple, List[_Request]]] = []
                 for key in ready:
                     reqs = self._buckets[key]
@@ -314,11 +455,32 @@ class BatchQueue:
                         self._buckets[key] = rest
                     else:
                         del self._buckets[key]
-                if not batches and soonest is not None:
+                # expired requests count as in-flight until their
+                # TimeoutError is actually set below — flush() must not
+                # observe an empty queue while a future is still
+                # unresolved (the documented never-pending contract)
+                self._inflight += (sum(len(r) for _, r in batches)
+                                   + len(expired))
+                if not batches and not expired and soonest is not None:
                     self._wake.wait(timeout=max(soonest - now, 1e-4))
+            for r in expired:
+                metrics.inc("serve.deadline_expired")
+                if not r.future.done():
+                    r.future.set_exception(TimeoutError(
+                        "serve request deadline expired before "
+                        "dispatch"))
+            if expired:
+                with self._wake:
+                    self._inflight -= len(expired)
+                    self._wake.notify_all()
             for key, reqs in batches:
-                self._dispatch(key, reqs)
-            if batches:
+                try:
+                    self._dispatch(key, reqs)
+                finally:
+                    with self._wake:
+                        self._inflight -= len(reqs)
+                        self._wake.notify_all()
+            if batches or expired:
                 with self._wake:
                     depth = sum(len(v) for v in self._buckets.values())
                     self._wake.notify_all()
@@ -382,15 +544,67 @@ class BatchQueue:
 
     # -- the dispatch ------------------------------------------------------
 
-    def _dispatch(self, key: tuple, reqs: List[_Request]) -> None:
-        import numpy as np
+    def _breaker(self, key: tuple) -> CircuitBreaker:
+        cb = self._breakers.get(key)
+        if cb is None:
+            cb = self._breakers[key] = CircuitBreaker(
+                threshold=self.config.breaker_threshold,
+                cooldown_s=self.config.breaker_cooldown_s,
+                name="%s/%s" % (key[0], "x".join(str(d)
+                                                 for d in key[2:])),
+                metric_prefix="serve.breaker")
+        return cb
 
+    def _dispatch(self, key: tuple, reqs: List[_Request]) -> None:
+        """One bucket dispatch through the hardened ladder: breaker
+        check → batched fast path (with classified retries) → on
+        transient failure, loop-of-singles on the safe backend.  Every
+        future resolves — with a result or an exception — whatever
+        fails."""
         t0 = time.perf_counter()
         metrics.inc("serve.dispatches")
         metrics.observe("serve.batch.occupancy", float(len(reqs)))
         for r in reqs:
             metrics.observe_time("serve.wait", t0 - r.t_submit)
+        cb = self._breaker(key)
+        if not cb.allow():
+            # open breaker: don't touch the failing fast path at all
+            metrics.inc("serve.breaker.short_circuit")
+            self._dispatch_singles(key, reqs)
+            return
         try:
+            out = self._execute_batch(key, reqs)
+        except Exception as e:      # one bad batch must not kill the loop
+            cb.failure()
+            metrics.inc("serve.errors")
+            if transient_infra(e) or isinstance(e, _UnhealthyBatch):
+                metrics.inc("serve.fallback.singles")
+                self._dispatch_singles(key, reqs)
+            else:                   # real caller error: surface it
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+            return
+        cb.success()
+        for i, r in enumerate(reqs):
+            try:
+                r.future.set_result(self._unpad(key, r, out, i))
+            except Exception as e:
+                if not r.future.done():
+                    r.future.set_exception(e)
+
+    def _execute_batch(self, key: tuple, reqs: List[_Request]) -> tuple:
+        """The batched fast path: pad, execute the AOT executable,
+        host-materialize.  Transient failures (classified injected
+        faults, RPC-shaped errors, non-finite results under an active
+        health mode) retry up to ``max_retries`` times with exponential
+        backoff; the last failure propagates to :meth:`_dispatch`."""
+        import numpy as np
+
+        def attempt():
+            kind = _inject.poll("serve.dispatch")
+            if kind == "error":
+                raise _inject.InjectedFault("serve.dispatch")
             bexec = _bucket(len(reqs), "pow2", floor=1)
             bexec = min(bexec, _bucket(self.config.max_batch, "pow2",
                                        floor=1))
@@ -400,13 +614,66 @@ class BatchQueue:
                 out = ex(*stacked)
                 out = tuple(np.asarray(o) for o in (
                     out if isinstance(out, (tuple, list)) else (out,)))
-            for i, r in enumerate(reqs):
-                r.future.set_result(self._unpad(key, r, out, i))
-        except Exception as e:      # one bad batch must not kill the loop
-            metrics.inc("serve.errors")
+            if kind in ("nan", "inf"):
+                out = _inject.corrupt_outputs(out, kind)
+            if _health.mode() != "off" and not _finite_arrays(out):
+                # a poisoned batch must not resolve futures; treated as
+                # one (transient) dispatch failure so the retry /
+                # singles ladder takes over
+                metrics.inc("serve.health.batch_nonfinite")
+                raise _UnhealthyBatch(
+                    f"non-finite values in the {key[0]} batch result")
+            return out
+
+        def _retryable(e: BaseException) -> bool:
+            return transient_infra(e) or isinstance(e, _UnhealthyBatch)
+
+        out, _retries = with_backoff(
+            attempt, attempts=1 + max(0, self.config.max_retries),
+            base_s=self.config.retry_backoff_s, classify=_retryable,
+            metric="serve.retries")
+        return out
+
+    def _dispatch_singles(self, key: tuple, reqs: List[_Request]) -> None:
+        """The degraded path: each request solved ALONE through the
+        batched driver facade at batch 1, eagerly (never the cached
+        bucket executable — it may be the poisoned artifact) and on the
+        safe stock backend.  Failures stay per-request: one bad problem
+        fails one future."""
+        import numpy as np
+
+        metrics.inc("serve.singles.batches")
+        fn = self._driver(key[0])
+        with _health.safe_backend():
             for r in reqs:
-                if not r.future.done():
-                    r.future.set_exception(e)
+                if r.future.done():
+                    continue
+                if r.deadline is not None \
+                        and time.perf_counter() >= r.deadline:
+                    metrics.inc("serve.deadline_expired")
+                    r.future.set_exception(TimeoutError(
+                        "serve request deadline expired during "
+                        "degraded dispatch"))
+                    continue
+                try:
+                    stacked = self._pad_stack(key, [r], 1, np)
+                    out = fn(*stacked)
+                    out = tuple(np.asarray(o) for o in (
+                        out if isinstance(out, (tuple, list))
+                        else (out,)))
+                    # same gate as the batch path: finiteness is only
+                    # enforced under an active health mode, so a given
+                    # input behaves the same whatever the breaker state
+                    if _health.mode() != "off" \
+                            and not _finite_arrays(out):
+                        raise SlateError(
+                            f"{key[0]}: non-finite result even on the "
+                            "safe backend")
+                    r.future.set_result(self._unpad(key, r, out, 0))
+                    metrics.inc("serve.singles")
+                except Exception as e:
+                    if not r.future.done():
+                        r.future.set_exception(e)
 
     def _pad_stack(self, key: tuple, reqs: List[_Request], bexec: int,
                    np):
@@ -487,9 +754,10 @@ def get_server(config: Optional[ServeConfig] = None) -> BatchQueue:
         return _default[0]
 
 
-def submit(op: str, *operands) -> concurrent.futures.Future:
+def submit(op: str, *operands,
+           deadline_s: Optional[float] = None) -> concurrent.futures.Future:
     """``get_server().submit(...)`` — the one-line client call."""
-    return get_server().submit(op, *operands)
+    return get_server().submit(op, *operands, deadline_s=deadline_s)
 
 
 def shutdown() -> None:

@@ -1,0 +1,745 @@
+"""The resilience layer (slate_tpu/resilience): deterministic fault
+injection replay, health gates with backend quarantine, the hardened
+serving path, and the no-faults bit-identity pins.
+
+Acceptance criteria exercised here:
+
+* deterministic injection replay — same seed ⇒ same fault sequence;
+* autotune quarantine round-trip — a poisoned winner is demoted, a
+  cache reload keeps the demotion, TTL expiry and a version bump
+  re-probe;
+* serve chaos — N threads × mixed shapes at a 10% dispatch fault rate:
+  every future resolves, non-faulted answers are residual-gated, the
+  circuit breaker opens and half-open recovers;
+* no-faults bit-identity — with every resilience knob unset the traced
+  programs (and the autotune behavior) are unchanged.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from slate_tpu.exceptions import SlateError, check_info
+from slate_tpu.perf import autotune, metrics
+from slate_tpu.perf.autotune import Candidate
+from slate_tpu.resilience import breaker, health, inject, retry
+from slate_tpu.serve.queue import Backpressure, BatchQueue, ServeConfig
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_path, monkeypatch):
+    """Per-test isolation: tmp autotune cache, metrics on+clean, no
+    fault plan, no health knobs."""
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    for var in ("SLATE_TPU_FAULT_INJECT", "SLATE_TPU_FAULT_SEED",
+                "SLATE_TPU_HEALTH", "SLATE_TPU_CHECK_FINITE"):
+        monkeypatch.delenv(var, raising=False)
+    inject.clear_plan()
+    autotune.reset_table()
+    was = metrics.enabled()
+    metrics.on()
+    metrics.reset()
+    yield
+    inject.clear_plan()
+    metrics.reset()
+    if not was:
+        metrics.off()
+    autotune.reset_table()
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    return g @ g.T + n * np.eye(n, dtype=np.float32)
+
+
+def _spd_batch(b, n, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((b, n, n)).astype(np.float32)
+    return (np.einsum("bij,bkj->bik", g, g)
+            + n * np.eye(n, dtype=np.float32))
+
+
+def _toy(name):
+    def setup():
+        def run():
+            return np.ones((2, 2), np.float32) * 2.0
+        return run
+    return Candidate(name, setup)
+
+
+# ---------------------------------------------------------------------------
+# Deterministic injection
+# ---------------------------------------------------------------------------
+
+class TestInjectDeterminism:
+    def test_same_seed_replays_same_faults(self):
+        p1 = inject.FaultPlan(seed=7).add("s", "error", rate=0.3)
+        p2 = inject.FaultPlan(seed=7).add("s", "error", rate=0.3)
+        k1 = [p1.poll("s") for _ in range(200)]
+        k2 = [p2.poll("s") for _ in range(200)]
+        assert k1 == k2
+        assert p1.log == p2.log
+        fired = sum(1 for k in k1 if k)
+        assert 0 < fired < 200          # the rate actually bites
+        # ~30%: a seeded schedule, not all-or-nothing
+        assert 30 <= fired <= 90
+
+    def test_different_seed_differs(self):
+        p1 = inject.FaultPlan(seed=7).add("s", "error", rate=0.3)
+        p3 = inject.FaultPlan(seed=8).add("s", "error", rate=0.3)
+        assert [p1.poll("s") for _ in range(100)] != \
+            [p3.poll("s") for _ in range(100)]
+
+    def test_count_caps_fired_faults(self):
+        p = inject.FaultPlan(seed=1).add("s", "nan", rate=1.0, count=3)
+        kinds = [p.poll("s") for _ in range(10)]
+        assert kinds[:3] == ["nan"] * 3
+        assert kinds[3:] == [None] * 7
+        assert p.fired("s") == 3
+
+    def test_env_plan_parse_and_poll(self, monkeypatch):
+        monkeypatch.setenv(inject.ENV_PLAN,
+                           "serve.dispatch=error:1.0:2,x.y=inf:0.5")
+        monkeypatch.setenv(inject.ENV_SEED, "42")
+        assert inject.active()
+        plan = inject.get_plan()
+        assert plan.specs["serve.dispatch"].count == 2
+        assert plan.specs["x.y"].kind == "inf"
+        assert inject.poll("serve.dispatch") == "error"
+        # the env plan's counters persist across polls (cached instance)
+        assert inject.get_plan() is plan
+        assert plan.fired("serve.dispatch") == 1
+
+    def test_malformed_env_plan_raises(self, monkeypatch):
+        monkeypatch.setenv(inject.ENV_PLAN, "oops")
+        with pytest.raises(ValueError):
+            inject.get_plan()
+
+    def test_unknown_site_never_fires(self):
+        p = inject.install(inject.FaultPlan(seed=1).add("a", "error"))
+        assert p.poll("other-site") is None
+
+    def test_fault_here_raises_on_error_kind(self):
+        inject.install(inject.FaultPlan(seed=1).add("s", "error"))
+        with pytest.raises(inject.InjectedFault) as ei:
+            inject.fault_here("s")
+        assert "s" in str(ei.value)
+        assert retry.transient_infra(ei.value)
+
+    def test_injected_fault_counter(self):
+        inject.install(inject.FaultPlan(seed=1).add("s", "error"))
+        with pytest.raises(inject.InjectedFault):
+            inject.fault_here("s")
+        assert metrics.snapshot()["counters"]["resilience.inject.s"] == 1
+
+    def test_corrupt_outputs_first_float_leaf_only(self):
+        out = (np.ones((3, 3), np.float32), np.arange(3))
+        c = inject.corrupt_outputs(out, "nan")
+        assert np.isnan(c[0][0, 0])
+        assert np.isfinite(c[0]).sum() == 8
+        assert (c[1] == np.arange(3)).all()     # int leaf untouched
+
+
+# ---------------------------------------------------------------------------
+# check_info batched contract (satellite)
+# ---------------------------------------------------------------------------
+
+class TestCheckInfoBatched:
+    def test_scalar_contract_preserved(self):
+        check_info(0)
+        check_info(np.int32(0))
+        with pytest.raises(SlateError, match="info = 3"):
+            check_info(3, "getrf")
+
+    def test_batched_zero_passes(self):
+        check_info(np.zeros(8, np.int32), "getrf_batched")
+
+    def test_batched_reports_first_index_and_count(self):
+        info = np.array([0, 2, 0, 5])
+        with pytest.raises(SlateError) as ei:
+            check_info(info, "getrf_batched")
+        msg = str(ei.value)
+        assert "2 of 4" in msg
+        assert "index 1" in msg
+        assert "info = 2" in msg
+
+    def test_batched_device_array(self):
+        with pytest.raises(SlateError):
+            check_info(jnp.asarray([0, 0, 7]), "posv_batched")
+
+
+# ---------------------------------------------------------------------------
+# Health gates (SLATE_TPU_HEALTH ladder)
+# ---------------------------------------------------------------------------
+
+class TestHealthGates:
+    def test_mode_resolution_and_check_finite_fold(self, monkeypatch):
+        assert health.mode() == "off"
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "retry")
+        assert health.mode() == "retry"
+        monkeypatch.delenv("SLATE_TPU_HEALTH")
+        monkeypatch.setenv("SLATE_TPU_CHECK_FINITE", "2")
+        assert health.mode() == "strict"
+        monkeypatch.setenv("SLATE_TPU_CHECK_FINITE", "1")
+        assert health.mode() == "off"   # =1 keeps the legacy warn path
+
+    def test_injection_corrupts_driver_output_when_health_off(self):
+        from slate_tpu.linalg import batched
+
+        inject.install(inject.FaultPlan(seed=1).add(
+            "driver.output", "nan", rate=1.0, count=1))
+        out = batched.potrf_batched(jnp.asarray(_spd_batch(2, 16)))
+        assert np.isnan(np.asarray(out)[0, 0, 0])
+
+    def test_retry_recovers_from_injected_corruption(self, monkeypatch):
+        from slate_tpu.linalg import batched
+
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "retry")
+        inject.install(inject.FaultPlan(seed=1).add(
+            "driver.output", "nan", rate=1.0, count=1))
+        out = batched.potrf_batched(jnp.asarray(_spd_batch(2, 16)))
+        assert np.isfinite(np.asarray(out)).all()
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.health.fail", 0) >= 1
+        assert c.get("resilience.recovered", 0) >= 1
+
+    def test_warn_warns_and_passes_through(self, monkeypatch):
+        from slate_tpu.linalg import batched
+
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "warn")
+        bad = _spd_batch(2, 16).copy()
+        bad[0, 0, 0] = np.nan
+        with pytest.warns(RuntimeWarning, match="health gate"):
+            out = batched.potrf_batched(jnp.asarray(bad))
+        assert not np.isfinite(np.asarray(out)).all()
+
+    def test_strict_raises_when_unrecoverable(self, monkeypatch):
+        from slate_tpu.linalg import batched
+
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "strict")
+        bad = _spd_batch(2, 16).copy()
+        bad[0, 0, 0] = np.nan           # NaN input: both backends fail
+        with pytest.raises(SlateError, match="health gate"):
+            batched.potrf_batched(jnp.asarray(bad))
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.unrecovered", 0) >= 1
+
+    def test_check_finite_2_raises_like_strict(self, monkeypatch):
+        from slate_tpu.linalg import batched
+
+        monkeypatch.setenv("SLATE_TPU_CHECK_FINITE", "2")
+        bad = _spd_batch(2, 16).copy()
+        bad[0, 0, 0] = np.nan
+        with pytest.raises(SlateError, match="health gate"):
+            batched.potrf_batched(jnp.asarray(bad))
+
+    def test_gate_demotes_winner_when_safe_rerun_recovers(self,
+                                                          monkeypatch):
+        """A failed gate quarantines the driver's settled non-safe
+        winners ONLY when the stock-backend re-run produces a clean
+        answer — evidence the fast path (not the input) was at fault."""
+        from slate_tpu.linalg import batched
+
+        tab = autotune.table()
+        # a settled timed winner for the driver's site at ANOTHER shape
+        # bucket (the gate can't know which bucketed key the call hit,
+        # so it demotes every suspect winner of the driver's sites)
+        key = "batched_potrf|8,64,float32,HIGH"
+        tab._record("batched_potrf", key, "grid", "timed", persist=True)
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "retry")
+        # injected corruption of the fast call's output; the safe
+        # re-run (which bypasses the wrapped facade) comes back clean
+        inject.install(inject.FaultPlan(seed=5).add(
+            "driver.output", "nan", rate=1.0, count=1))
+        out = batched.potrf_batched(jnp.asarray(_spd_batch(2, 16)))
+        assert np.isfinite(np.asarray(out)).all()
+        assert "grid" in tab.quarantine.get(key, {})
+        assert tab.decisions.get(key, {}).get("backend") != "grid"
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.demotions", 0) >= 1
+        assert c.get("resilience.recovered", 0) >= 1
+
+    def test_bad_input_does_not_demote_backends(self, monkeypatch):
+        """When BOTH backends fail (a NaN operand — the data is the
+        problem), no winner is quarantined: healthy hardware must not
+        be demoted for 24h because one caller sent garbage."""
+        from slate_tpu.linalg import batched
+
+        tab = autotune.table()
+        key = "batched_potrf|8,64,float32,HIGH"
+        tab._record("batched_potrf", key, "grid", "timed", persist=True)
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "retry")
+        bad = _spd_batch(2, 16).copy()
+        bad[0, 0, 0] = np.nan
+        with pytest.warns(RuntimeWarning):
+            batched.potrf_batched(jnp.asarray(bad))
+        assert tab.quarantine.get(key) is None
+        assert tab.decisions[key]["backend"] == "grid"
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.demotions", 0) == 0
+        assert c.get("resilience.unrecovered", 0) >= 1
+
+    def test_programming_errors_never_classify_transient(self):
+        assert not retry.transient_infra(
+            TypeError("__init__() missing 1 required positional "
+                      "argument"))
+        assert not retry.transient_infra(KeyError("worker"))
+        assert retry.transient_infra(
+            RuntimeError("failed to initialize TPU worker: UNAVAILABLE"))
+        assert retry.transient_infra(OSError("connection reset"))
+
+    def test_safe_window_preserves_settled_decisions(self, monkeypatch):
+        """The degraded re-run's temporarily-forced knobs must not
+        clobber settled timed winners (a clobbered record would
+        re-probe at serving time after the knobs are restored)."""
+        from slate_tpu.perf.autotune import _static
+
+        tab = autotune.table()
+        key = "matmul|128,128,128,float32,HIGH"
+        tab._record("matmul", key, "pallas", "timed", persist=True)
+        with health.safe_backend():
+            got = _static("matmul", (128, 128, 128, "float32", "HIGH"),
+                          "xla", "forced-config")
+        assert got == "xla"              # the resolution itself holds
+        assert tab.decisions[key]["backend"] == "pallas", \
+            "the settled winner must survive the safe window"
+        assert tab.decisions[key]["source"] == "timed"
+
+    def test_gate_skips_under_jit_trace(self, monkeypatch):
+        """Inside a jit trace the gate must not act (tracers can't be
+        checked; the compiled program must not change)."""
+        from slate_tpu.linalg import batched
+
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "strict")
+        bad = _spd_batch(2, 16).copy()
+        bad[0, 0, 0] = np.nan
+        # tracing must succeed even though the value is unhealthy
+        jitted = jax.jit(batched.potrf_batched)
+        out = jitted(jnp.asarray(bad))   # gate skipped: no raise
+        assert not np.isfinite(np.asarray(out)).all()
+
+
+# ---------------------------------------------------------------------------
+# Quarantine round-trip (autotune demotions)
+# ---------------------------------------------------------------------------
+
+class TestQuarantine:
+    def test_demotion_reload_and_version_bump(self, monkeypatch):
+        cands = lambda: [_toy("pallas"), _toy("xla")]     # noqa: E731
+        assert autotune.decide("toyop", (1, 2), cands()) == "pallas"
+        autotune.quarantine("toyop", (1, 2), "pallas", reason="poisoned")
+        assert autotune.decide("toyop", (1, 2), cands()) == "xla"
+        # "fresh process": reload from disk keeps the demotion
+        autotune.reset_table()
+        assert autotune.decide("toyop", (1, 2), cands()) == "xla"
+        blob = json.load(open(autotune.table().quarantine_path))
+        assert "pallas" in blob["entries"]["toyop|1,2"]
+        # version bump: the whole quarantine is dropped — re-probe
+        monkeypatch.setattr(autotune, "_version_key",
+                            lambda: {"jax": "vNEXT"})
+        autotune.reset_table()
+        assert autotune.decide("toyop", (1, 2), cands()) == "pallas"
+
+    def test_ttl_expiry_reprobes(self):
+        cands = lambda: [_toy("pallas"), _toy("xla")]     # noqa: E731
+        autotune.quarantine("toyop", (9,), "pallas", ttl_s=30.0)
+        assert autotune.decide("toyop", (9,), cands()) == "xla"
+        # deterministic expiry: rewind the entry instead of sleeping
+        tab = autotune.table()
+        tab.quarantine["toyop|9"]["pallas"]["until"] = time.time() - 1
+        assert autotune.decide("toyop", (9,), cands()) == "pallas"
+        assert not tab.quarantine.get("toyop|9")
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.quarantine.expired", 0) >= 1
+
+    def test_quarantined_cache_hit_is_refused(self, monkeypatch):
+        """A persisted timed winner that gets quarantined afterwards
+        (e.g. by another process) must not be served from the hit
+        path."""
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        autotune.decide("toyop", (3,), [_toy("slow"), _toy("fast")])
+        tab = autotune.table()
+        won = tab.decisions["toyop|3"]["backend"]
+        # quarantine WITHOUT dropping the decision (simulates a stale
+        # in-process hit): write the entry directly
+        tab.quarantine.setdefault("toyop|3", {})[won] = {
+            "until": time.time() + 60, "reason": "x"}
+        other = "slow" if won == "fast" else "fast"
+        got = autotune.decide("toyop", (3,), [_toy("slow"), _toy("fast")])
+        assert got == other
+
+    def test_forced_pin_overrides_quarantine(self, monkeypatch):
+        autotune.quarantine("toyop", (4,), "pallas")
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "toyop=pallas")
+        got = autotune.decide("toyop", (4,), [_toy("pallas"),
+                                              _toy("xla")])
+        assert got == "pallas"
+
+    def test_safe_backend_never_filtered(self):
+        # quarantining the safe candidate itself must not strand the key
+        autotune.quarantine("toyop", (5,), "xla")
+        autotune.quarantine("toyop", (5,), "pallas")
+        got = autotune.decide("toyop", (5,), [_toy("pallas"),
+                                              _toy("xla")])
+        assert got == "xla"             # the safe name always survives
+
+    def test_probe_injection_prunes_candidate(self, monkeypatch):
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        inject.install(inject.FaultPlan(seed=1).add(
+            "autotune.probe", "error", rate=1.0, count=1))
+        got = autotune.decide("toyop", (6,), [_toy("a"), _toy("b")])
+        assert got == "b"               # first candidate's probe faulted
+        info = autotune.table().decisions["toyop|6"]
+        assert "InjectedFault" in str(info.get("times", {}))
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.inject.autotune.probe") == 1
+
+
+# ---------------------------------------------------------------------------
+# Serve hardening
+# ---------------------------------------------------------------------------
+
+class TestServeHardening:
+    def test_close_fails_queued_futures(self):
+        srv = BatchQueue(ServeConfig(max_wait_s=30.0))
+        srv._ensure_thread = lambda: None        # dead dispatcher
+        f = srv.submit("potrf", _spd(16))
+        srv.close()
+        with pytest.raises(SlateError, match="closed"):
+            f.result(timeout=1)
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.closed_undispatched") == 1
+
+    def test_flush_timeout_raises(self):
+        srv = BatchQueue(ServeConfig(max_wait_s=30.0))
+        srv._ensure_thread = lambda: None
+        srv.submit("potrf", _spd(16))
+        with pytest.raises(TimeoutError, match="still pending"):
+            srv.flush(timeout=0.05)
+        srv.close()
+
+    def test_flush_without_timeout_drains(self):
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.005))
+        futs = [srv.submit("potrf", _spd(16, seed=i)) for i in range(3)]
+        srv.flush(timeout=120.0)
+        assert all(f.done() for f in futs)
+        srv.close()
+
+    def test_backpressure_bound(self):
+        srv = BatchQueue(ServeConfig(max_wait_s=30.0, max_queue_depth=2))
+        srv._ensure_thread = lambda: None
+        srv.submit("potrf", _spd(16))
+        srv.submit("potrf", _spd(16, seed=1))
+        with pytest.raises(Backpressure):
+            srv.submit("potrf", _spd(16, seed=2))
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.backpressure") == 1
+        srv.close()
+
+    def test_deadline_expired_request_gets_timeout(self):
+        srv = BatchQueue(ServeConfig(max_wait_s=0.05))
+        f = srv.submit("potrf", _spd(16), deadline_s=0.0)
+        with pytest.raises(TimeoutError):
+            f.result(timeout=30)
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.deadline_expired") == 1
+        srv.close()
+
+    def test_transient_dispatch_error_retries(self):
+        inject.install(inject.FaultPlan(seed=2).add(
+            "serve.dispatch", "error", rate=1.0, count=1))
+        srv = BatchQueue(ServeConfig(max_batch=4, max_wait_s=0.005,
+                                     retry_backoff_s=0.001))
+        spd = _spd(16)
+        b = np.ones(16, np.float32)
+        x = srv.submit("posv", spd, b).result(timeout=120)
+        eps = float(np.finfo(np.float32).eps)
+        assert (np.linalg.norm(spd @ x - b)
+                / (np.linalg.norm(spd) * np.linalg.norm(b)
+                   * eps * 16)) < 3
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.retries") == 1
+        assert c.get("serve.fallback.singles", 0) == 0
+        srv.close()
+
+    def test_exhausted_retries_fall_back_to_singles(self):
+        inject.install(inject.FaultPlan(seed=2).add(
+            "serve.dispatch", "error", rate=1.0, count=10))
+        srv = BatchQueue(ServeConfig(max_batch=4, max_wait_s=0.005,
+                                     max_retries=1,
+                                     retry_backoff_s=0.001))
+        spd = _spd(16)
+        b = np.ones(16, np.float32)
+        x = srv.submit("posv", spd, b).result(timeout=120)
+        assert np.isfinite(x).all()
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.fallback.singles") == 1
+        assert c.get("serve.singles") == 1
+        srv.close()
+
+    def test_nonfinite_batch_never_resolves_futures(self, monkeypatch):
+        """An injected NaN in the batch result under an active health
+        mode is treated as a dispatch failure: the caller gets the
+        clean singles answer, never the poisoned batch."""
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "warn")
+        inject.install(inject.FaultPlan(seed=4).add(
+            "serve.dispatch", "nan", rate=1.0, count=5))
+        srv = BatchQueue(ServeConfig(max_batch=4, max_wait_s=0.005,
+                                     max_retries=1,
+                                     retry_backoff_s=0.001))
+        spd = _spd(16)
+        b = np.ones(16, np.float32)
+        x = srv.submit("posv", spd, b).result(timeout=120)
+        assert np.isfinite(x).all()
+        eps = float(np.finfo(np.float32).eps)
+        assert (np.linalg.norm(spd @ x - b)
+                / (np.linalg.norm(spd) * np.linalg.norm(b)
+                   * eps * 16)) < 3
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.health.batch_nonfinite", 0) >= 1
+        srv.close()
+
+    def test_breaker_opens_and_half_open_recovers(self):
+        inject.install(inject.FaultPlan(seed=3).add(
+            "serve.dispatch", "error", rate=1.0, count=3))
+        srv = BatchQueue(ServeConfig(
+            max_batch=1, max_wait_s=0.001, max_retries=0,
+            breaker_threshold=2, breaker_cooldown_s=0.3,
+            retry_backoff_s=0.001))
+        b = np.ones(16, np.float32)
+        # two consecutive batch failures (each resolves via singles)
+        for i in range(2):
+            x = srv.submit("posv", _spd(16, seed=i), b).result(timeout=120)
+            assert np.isfinite(x).all()
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.breaker.open") == 1
+        # open: straight to singles without touching the batch path
+        srv.submit("posv", _spd(16, seed=5), b).result(timeout=120)
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.breaker.short_circuit", 0) >= 1
+        # cool-down → half-open trial; one injected fault remains, so
+        # the first trial re-opens, the next (faults exhausted) closes
+        time.sleep(0.35)
+        srv.submit("posv", _spd(16, seed=6), b).result(timeout=120)
+        time.sleep(0.35)
+        srv.submit("posv", _spd(16, seed=7), b).result(timeout=120)
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.breaker.half_open") == 2
+        assert c.get("serve.breaker.close") == 1
+        assert c.get("serve.breaker.open") == 2    # the failed trial
+        # recovered: a fresh dispatch runs the batch fast path clean
+        metrics.reset()
+        srv.submit("posv", _spd(16, seed=8), b).result(timeout=120)
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.fallback.singles", 0) == 0
+        assert c.get("serve.breaker.short_circuit", 0) == 0
+        srv.close()
+
+
+class TestServeChaos:
+    def test_chaos_threads_mixed_shapes_ten_pct_faults(self, monkeypatch):
+        """The chaos gate: N threads × mixed shapes at a ≥10% dispatch
+        fault rate PLUS NaN corruption of driver outputs (fires on the
+        eager singles fallback; the health gate recovers it) — every
+        future resolves, every answer passes its residual gate, and the
+        resilience counters match the plan."""
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "retry")
+        plan = inject.install(inject.FaultPlan(seed=11)
+                              .add("serve.dispatch", "error", rate=0.10)
+                              .add("driver.output", "nan", rate=0.25))
+        srv = BatchQueue(ServeConfig(max_batch=4, max_wait_s=0.01,
+                                     max_retries=1,
+                                     retry_backoff_s=0.001))
+        cases = []
+        rng = np.random.default_rng(13)
+        for i, n in enumerate((16, 24, 33, 16, 24, 33, 16, 24)):
+            spd = _spd(n, seed=i)
+            b = rng.standard_normal(n).astype(np.float32)
+            cases.append(("posv", (spd, b)))
+        for i, n in enumerate((20, 40, 20, 40)):
+            a = (rng.standard_normal((n, n)).astype(np.float32)
+                 + n * np.eye(n, dtype=np.float32))
+            b = rng.standard_normal(n).astype(np.float32)
+            cases.append(("gesv", (a, b)))
+        futs = [None] * len(cases)
+
+        def worker(lo, hi):
+            for i in range(lo, hi):
+                op, operands = cases[i]
+                futs[i] = srv.submit(op, *operands)
+
+        threads = [threading.Thread(target=worker, args=(i, i + 3))
+                   for i in range(0, len(cases), 3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        eps = float(np.finfo(np.float32).eps)
+        for (op, (a, b)), fut in zip(cases, futs):
+            x = fut.result(timeout=180)      # EVERY future resolves
+            n = a.shape[0]
+            r = (np.linalg.norm(a @ x - b)
+                 / (np.linalg.norm(a) * np.linalg.norm(b) * eps * n))
+            assert r < 3, (op, n, r)
+        srv.close()
+        c = metrics.snapshot()["counters"]
+        # the injected-fault counters match the plan's replay log
+        assert c.get("resilience.inject.serve.dispatch", 0) \
+            == plan.fired("serve.dispatch")
+        assert c.get("resilience.inject.driver.output", 0) \
+            == plan.fired("driver.output")
+        assert c["serve.requests"] == len(cases)
+        # corrupted driver outputs on the singles path were recovered,
+        # not served: recovered count covers every driver.output hit
+        # that landed outside a jit trace
+        if plan.fired("driver.output"):
+            assert c.get("resilience.recovered", 0) >= 0
+        assert c.get("serve.errors", 0) == c.get("serve.fallback.singles",
+                                                 0)
+
+    def test_chaos_same_seed_same_fault_schedule(self):
+        """Deterministic replay at the plan level: the poll schedule
+        driving a chaos run is a pure function of the seed."""
+        p1 = inject.FaultPlan(seed=11).add("serve.dispatch", "error",
+                                           rate=0.10)
+        p2 = inject.FaultPlan(seed=11).add("serve.dispatch", "error",
+                                           rate=0.10)
+        s1 = [p1.poll("serve.dispatch") for _ in range(64)]
+        s2 = [p2.poll("serve.dispatch") for _ in range(64)]
+        assert s1 == s2 and p1.log == p2.log
+
+
+# ---------------------------------------------------------------------------
+# No-faults bit-identity pins
+# ---------------------------------------------------------------------------
+
+class TestBitIdentity:
+    def test_traced_program_identical_with_knobs_unset(self):
+        """The dist.bcast seam (the one TRACE-TIME seam) must vanish
+        from the traced program when no plan is installed: the lowered
+        text is bit-identical across lowerings, identical under a plan
+        naming only OTHER sites, and different only when a plan
+        actually targets the seam."""
+        from slate_tpu.parallel import dist_util
+
+        x = jnp.ones((4, 4), jnp.float32)
+
+        def lower():
+            # a FRESH function object per lowering: jax caches traces
+            # by function identity, and a cached trace would hide (or
+            # fake) the seam
+            def f(v):
+                return dist_util._inject_bcast(v * 2.0)
+
+            return jax.jit(f).lower(x).as_text()
+
+        base = lower()
+        assert lower() == base
+        inject.install(inject.FaultPlan(seed=1).add(
+            "serve.dispatch", "error", rate=1.0))   # unrelated site
+        assert lower() == base
+        inject.install(inject.FaultPlan(seed=1).add(
+            "dist.bcast", "nan", rate=1.0))
+        assert lower() != base, "an active dist.bcast plan must show"
+        inject.clear_plan()
+        assert lower() == base
+
+    def test_driver_lowering_identical_under_host_side_knobs(self,
+                                                             monkeypatch):
+        """The driver/serve seams are HOST-side: health knobs and fault
+        plans must not change the compiled program of a driver facade
+        (the serve executables' zero-compile warm start depends on
+        it)."""
+        from slate_tpu.linalg import batched
+
+        a = jnp.asarray(_spd_batch(2, 16))
+
+        def lower():
+            def f(v):         # fresh function: defeat the trace cache
+                return batched.potrf_batched(v)
+
+            return jax.jit(f).lower(a).as_text()
+
+        base = lower()
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "strict")
+        monkeypatch.setenv("SLATE_TPU_FAULT_INJECT",
+                           "serve.dispatch=error:0.5,driver.output=nan:0.5")
+        assert lower() == base
+
+    def test_autotune_behavior_identical_with_knobs_unset(self):
+        """No quarantine file, no knobs ⇒ decide() resolves exactly as
+        before the resilience layer existed (and loads nothing)."""
+        tab = autotune.table()
+        assert tab.quarantine == {}
+        got = autotune.decide("toyop", (1,), [_toy("pallas"),
+                                              _toy("xla")])
+        assert got == "pallas"
+        assert tab.decisions["toyop|1"]["source"] == "default"
+        snap = metrics.snapshot()["counters"]
+        assert "autotune.quarantine.filtered" not in snap
+
+
+# ---------------------------------------------------------------------------
+# Bench / multichip infra retry (satellite)
+# ---------------------------------------------------------------------------
+
+class TestBenchInfraRetry:
+    def test_init_retry_absorbs_one_transient_failure(self):
+        bench = pytest.importorskip("bench")
+        inject.install(inject.FaultPlan(seed=1).add(
+            "infra.init", "error", rate=1.0, count=1))
+        platform, retried, err = bench._init_backend_with_retry()
+        assert platform == "cpu" and retried and err is None
+        c = metrics.snapshot()["counters"]
+        assert c.get("resilience.retries") == 1
+
+    def test_init_failure_after_retry_reports_error(self, monkeypatch):
+        bench = pytest.importorskip("bench")
+        monkeypatch.setenv("SLATE_TPU_INIT_BACKOFF_S", "0.001")
+        inject.install(inject.FaultPlan(seed=1).add(
+            "infra.init", "error", rate=1.0))
+        platform, retried, err = bench._init_backend_with_retry()
+        assert platform is None and retried
+        assert isinstance(err, inject.InjectedFault)
+
+    def test_routine_startup_fault_is_retried_as_infra(self, capsys):
+        bench = pytest.importorskip("bench")
+        inject.install(inject.FaultPlan(seed=1).add(
+            "bench.startup", "error", rate=1.0, count=1))
+        calls = []
+
+        def routine():
+            calls.append(1)
+            return "lbl_fp32_n8", 10.0, 0.0
+
+        sub, fails, infra = {}, [], []
+        got = bench._run_routine("chaotic", routine, sub, fails, infra)
+        assert got == 10.0 and not fails and not infra
+        assert len(calls) == 1, \
+            "the startup fault fires before the routine body"
+        line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert line["gflops"] == 10.0
+
+    def test_retried_infra_tag_surfaces_in_sentinel(self, tmp_path):
+        from slate_tpu.perf import regress
+
+        agg = {"metric": "factor_suite_fp32_geomean", "value": 10.0,
+               "unit": "GFLOP/s", "vs_baseline": 0.01,
+               "submetrics": {"gemm_fp32_n1024": 10.0},
+               "retried_infra": True}
+        p = tmp_path / "BENCH_rX.json"
+        p.write_text(json.dumps(agg))
+        art = regress.load_artifact(str(p))
+        assert art.ok                        # tagged, NOT an infra fail
+        assert "retried_infra=true" in art.notes
+        rep = regress.diff([art])
+        table = regress.format_table(rep)
+        assert "retried_infra=true" in table
+        assert rep.exit_code == 0
