@@ -338,6 +338,77 @@ def bench_batched_gesv(on_tpu, nbat=None, bsz=64):
         lambda ops_np, x: _batched_resid(ops_np, x, nbat), nbat, bsz)
 
 
+def bench_serve(on_tpu, n=None, nreq=None, max_batch=16):
+    """Serve-path latency percentiles (ISSUE 10): drive the batched
+    serving front door with 4 threaded submitters under live telemetry,
+    read p50/p99 back from the SLO histograms
+    (``serve.latency_ms.posv.*``, via the registry's stdlib quantile
+    readback over the per-routine metrics DELTA so an earlier phase's
+    samples can't leak in), and emit them as lower-is-better ``_ms``
+    submetrics next to a served-solves GFLOP/s label.  The bucket is
+    warmed with one request first so the percentiles measure SERVING,
+    not the one-time executable compile warm start exists to remove."""
+    import threading as _threading
+
+    from slate_tpu.perf import metrics as _metrics
+    from slate_tpu.perf import telemetry
+    from slate_tpu.serve.queue import BatchQueue, ServeConfig, _bucket
+
+    n = n or (256 if on_tpu else 48)
+    nreq = nreq or (192 if on_tpu else 32)
+    rng = np.random.default_rng(21)
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+    rhs = [rng.standard_normal(n).astype(np.float32) for _ in range(4)]
+    # telemetry.on() also enables the metrics registry (the histograms
+    # live there): restore BOTH afterwards, or this routine would
+    # silently override an explicit SLATE_TPU_METRICS=0 opt-out for
+    # every routine after it
+    was_on = telemetry.enabled()
+    was_metrics = _metrics.enabled()
+    telemetry.on()
+    srv = BatchQueue(ServeConfig(max_batch=max_batch, max_wait_s=0.002))
+    try:
+        srv.submit("posv", spd, rhs[0]).result(timeout=900)   # warm
+        before = _metrics.snapshot()
+        futs = [None] * nreq
+
+        def worker(base):
+            for i in range(base, nreq, 4):
+                futs[i] = srv.submit("posv", spd, rhs[i % 4])
+
+        t0 = time.perf_counter()
+        threads = [_threading.Thread(target=worker, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        xs = [np.asarray(f.result(timeout=900)) for f in futs]
+        wall = time.perf_counter() - t0
+        delta = _metrics.snapshot_delta(before, _metrics.snapshot())
+    finally:
+        srv.close()
+        if not was_on:
+            telemetry.off()
+        if not was_metrics:
+            _metrics.off()
+    hname = "serve.latency_ms.posv.fp32.n%d" % _bucket(n)
+    qs = telemetry.quantiles_from_buckets(
+        (delta.get("hists") or {}).get(hname), (0.5, 0.99))
+    x, b = xs[0], rhs[0]
+    eps = float(np.finfo(np.float32).eps)
+    resid = (np.linalg.norm(spd @ x - b)
+             / (np.linalg.norm(spd) * np.linalg.norm(b) * eps * n))
+    gf = (n ** 3 / 3.0 + 2.0 * n * n) * nreq / wall / 1e9
+    label = "serve_posv_fp32_n%d" % n
+    extra = {}
+    if qs:
+        extra[label + "_p50_ms"] = round(qs[0.5], 3)
+        extra[label + "_p99_ms"] = round(qs[0.99], 3)
+    return label, gf, resid, extra
+
+
 #: per-stage wall-time attribution for the two-stage eig/SVD pipelines:
 #: metric-timer keys (recorded by the drivers / the chase dispatch) →
 #: the submetric suffix each lands under in the routine's JSON line, so
@@ -1025,6 +1096,7 @@ def main():
         ("gels", bench_gels, False),
         ("batched_posv", lambda: bench_batched_posv(on_tpu), False),
         ("batched_gesv", lambda: bench_batched_gesv(on_tpu), False),
+        ("serve_posv", lambda: bench_serve(on_tpu), False),
         ("heev_fp32", bench_heev32, True),
         ("svd_fp32", bench_svd32, True),
         ("heev_fp64", bench_heev64, True),
@@ -1076,10 +1148,10 @@ def main():
                 peak[k] = round(v / anchor, 3)
                 if peak[k] < 0.10 and "gemm" not in k and "mxu" not in k \
                         and "heev" not in k and "svd" not in k \
-                        and "batched" not in k:
+                        and "batched" not in k and "serve" not in k:
                     # two-stage eig/svd run partly on host and the
-                    # batched suite's tiny per-problem shapes cannot
-                    # reach big-matrix fractions; informational only
+                    # batched/serve suites' tiny per-problem shapes
+                    # cannot reach big-matrix fractions; informational
                     low.append(k)
     # frac_of_gemm as a FIRST-CLASS derived submetric per factorization
     # routine (routine TF/s ÷ same-run gemm TF/s): the ROADMAP targets

@@ -50,6 +50,51 @@ SLOW = {"heev", "svd", "getri", "gesv_mixed", "hesv", "trtri",
         "potri", "posv_mixed"}
 
 
+def telemetry_smoke() -> int:
+    """The --telemetry tier: serve one request with live telemetry on,
+    scrape the Prometheus endpoint once over a real socket, and check
+    the serve counters + latency histogram made it out — the ISSUE 10
+    end-to-end path (queue → histogram → exporter) in a few seconds."""
+    import urllib.request
+
+    import numpy as np
+
+    from slate_tpu.perf import telemetry
+    from slate_tpu.serve.queue import BatchQueue, ServeConfig
+
+    telemetry.on()
+    port = telemetry.start_exporter(0)      # ephemeral: no port clashes
+    srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+    n = 16
+    rng = np.random.default_rng(0)
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+    rhs = rng.standard_normal(n).astype(np.float32)
+    x = np.asarray(srv.submit("posv", spd, rhs).result(timeout=300))
+    srv.close()
+    resid = (np.linalg.norm(spd @ x - rhs)
+             / (np.linalg.norm(spd) * np.linalg.norm(rhs)
+                * float(np.finfo(np.float32).eps) * n))
+    body = urllib.request.urlopen(
+        "http://127.0.0.1:%d/metrics" % port, timeout=30).read().decode()
+    telemetry.stop_exporter()
+    checks = {
+        "residual under gate": resid < 3,
+        "serve.requests scraped":
+            "slate_tpu_serve_requests 1" in body,
+        "latency histogram scraped":
+            "slate_tpu_serve_latency_ms_posv_fp32_n16_bucket" in body,
+        "p99 quantile scraped": 'quantile{quantile="0.99"}' in body,
+    }
+    for name, ok in checks.items():
+        print("  %s: %s" % (name, "ok" if ok else "FAIL"), flush=True)
+    if all(checks.values()):
+        print("==== telemetry smoke passed ====")
+        return 0
+    print("==== telemetry smoke FAILED ====")
+    return 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true")
@@ -69,7 +114,15 @@ def main(argv=None):
                     "plan and SLATE_TPU_HEALTH=retry enabled — proves "
                     "the resilience layer detects/degrades/retries "
                     "instead of failing (see docs/usage.md Resilience)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="live-telemetry smoke: serve one request with "
+                    "telemetry on and scrape the Prometheus endpoint "
+                    "once over a real socket (see docs/usage.md Live "
+                    "telemetry)")
     args = ap.parse_args(argv)
+
+    if args.telemetry:
+        return telemetry_smoke()
 
     if args.chaos:
         # setdefault: an explicit operator plan/tier wins over the can

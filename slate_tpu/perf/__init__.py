@@ -1,9 +1,12 @@
 """Performance helpers: lowered-HLO collective/flop profiling
 (:mod:`.hlo_profile`), the autotuned backend dispatch table
 (:mod:`.autotune`), the runtime metrics registry (:mod:`.metrics`),
-the bench regression sentinel (:mod:`.regress`) and the roofline
+the bench regression sentinel (:mod:`.regress`), the roofline
 attribution engine (:mod:`.attr`) that joins the analytical per-stage
-cost model with the measured metrics to say where the time went."""
+cost model with the measured metrics to say where the time went, and
+the live serving telemetry layer (:mod:`.telemetry`): per-request
+tracing, SLO histograms, Prometheus/JSONL streaming exporters and the
+in-process live sentinel."""
 
 from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
                           ModuleProfile, collective_byte_census,
@@ -14,14 +17,15 @@ __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
     "attr", "autotune", "collective_byte_census", "metrics",
     "profile_fn", "profile_hlo_text", "regress",
-    "stablehlo_collective_shapes",
+    "stablehlo_collective_shapes", "telemetry",
 ]
 
 
 def __getattr__(name):
     # lazy: autotune pulls in jax.random/pallas bits only when used;
-    # attr/metrics/regress stay stdlib-light and import on demand
-    if name in ("attr", "autotune", "metrics", "regress"):
+    # attr/metrics/regress/telemetry stay stdlib-light and import on
+    # demand
+    if name in ("attr", "autotune", "metrics", "regress", "telemetry"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -60,9 +60,10 @@ import os
 import re
 
 __all__ = [
-    "DEFAULT_NB", "attribute", "expected_hbm_roundtrips", "explain_pair",
-    "format_report", "fusion_from_autotune", "model_flops", "parse_label",
-    "peaks", "record_rooflines", "stage_model", "stage_timers",
+    "DEFAULT_NB", "attribute", "attribute_live",
+    "expected_hbm_roundtrips", "explain_pair", "format_report",
+    "fusion_from_autotune", "model_flops", "parse_label", "peaks",
+    "record_rooflines", "stage_model", "stage_timers",
 ]
 
 #: panel width assumed when the submetric label carries no ``nb`` token
@@ -550,6 +551,27 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     if collective is not None:
         report["collective"] = collective
     return report
+
+
+def attribute_live(op: str, n: int, dtype: str = "fp32", batch: int = 1,
+                   latency_s: float = 0.0, platform: str = "tpu"):
+    """The gap report for one LIVE serving sample — the telemetry
+    sentinel's attribution hook (ISSUE 10): build the batched-driver
+    label bench would emit for this bucket
+    (``<op>_batched_<dtype>_n<n>_b<batch>``), derive GFLOP/s from the
+    model flop count over the observed dispatch latency, and return
+    :func:`attribute`'s block.  None when the op has no model or the
+    latency is unusable — a live event must degrade to "no
+    attribution", never raise."""
+    if not n or not latency_s or latency_s <= 0:
+        return None
+    b = max(1, int(batch))
+    fl = model_flops(str(op), {"n": int(n), "b": b})
+    if not fl:
+        return None
+    label = "%s_batched_%s_n%d_b%d" % (op, dtype or "fp32", int(n), b)
+    return attribute(label, fl / float(latency_s) / 1e9,
+                     platform=platform)
 
 
 # ---------------------------------------------------------------------------

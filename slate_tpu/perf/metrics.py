@@ -76,6 +76,8 @@ __all__ = [
     "resilience_wanted", "set_resilience_hint",
     "record_fallback_outcome", "pallas_census", "install_compile_watch",
     "step_timer", "count_hbm_roundtrips", "STEP_HBM_ROUNDTRIPS",
+    "bucket_bounds", "quantiles_from_buckets", "hist_quantiles",
+    "env_flag",
 ]
 
 _ENV = "SLATE_TPU_METRICS"
@@ -85,7 +87,10 @@ _ENV = "SLATE_TPU_METRICS"
 _MAX_SAMPLES = 65536
 
 
-def _env_on(name: str, default: str = "") -> bool:
+def env_flag(name: str, default: str = "") -> bool:
+    """Truthy-env-knob parse shared by the observability modules (one
+    helper, not a private copy per module — the registry-guard test
+    forbids non-perf modules reaching ``metrics._*``)."""
     return os.environ.get(name, default).strip().lower() in (
         "1", "true", "on", "yes")
 
@@ -94,7 +99,7 @@ class _Registry:
     """The process-wide store.  Private — use the module facade."""
 
     def __init__(self):
-        self.enabled = _env_on(_ENV)
+        self.enabled = env_flag(_ENV)
         self.lock = threading.RLock()
         self.counters: dict = {}
         self.gauges: dict = {}
@@ -262,6 +267,75 @@ def observe(name: str, value: float) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Histogram quantile readback (ISSUE 10: the serve SLO histograms need
+# p50/p95/p99 without pulling in numpy — the resolution is the log2
+# bucket width, exactly the granularity an SLO judgment needs)
+# ---------------------------------------------------------------------------
+
+def bucket_bounds(bucket: str):
+    """``(lo, hi)`` of one log2 histogram bucket key (``"le_2^k"`` →
+    ``(2^(k-1), 2^k)``; ``"le_0"`` → ``(0, 0)``); None for keys this
+    registry never produces."""
+    if bucket == "le_0":
+        return (0.0, 0.0)
+    if not bucket.startswith("le_2^"):
+        return None
+    try:
+        k = int(bucket[5:])
+    except ValueError:
+        return None
+    hi = 2.0 ** k
+    return (hi / 2.0, hi)
+
+
+def quantiles_from_buckets(hist, qs=(0.5, 0.95, 0.99)) -> dict:
+    """Stdlib quantile readback from one histogram snapshot dict
+    (``{"count", "total", "buckets"}`` — a :func:`snapshot` or
+    :func:`snapshot_delta` entry): the q-quantile's bucket is found by
+    cumulative count and the value placed inside it by linear
+    interpolation, so the estimate always lies within a factor of two
+    of the exact order statistic (the bucket width).  Returns
+    ``{q: value}``; an empty histogram returns ``{}``."""
+    buckets = (hist or {}).get("buckets") or {}
+    items = []
+    for b, c in buckets.items():
+        bounds = bucket_bounds(b)
+        if bounds is not None and c > 0:
+            items.append((bounds[0], bounds[1], int(c)))
+    items.sort(key=lambda x: x[1])
+    total = sum(c for _, _, c in items)
+    if total <= 0:
+        return {}
+    out = {}
+    for q in qs:
+        rank = max(float(q), 0.0) * total
+        cum = 0.0
+        val = items[-1][1]
+        for lo, hi, c in items:
+            if cum + c >= rank - 1e-12:
+                frac = 0.0 if c <= 0 else max(0.0, min(1.0,
+                                                       (rank - cum) / c))
+                val = lo + frac * (hi - lo)
+                break
+            cum += c
+        out[q] = val
+    return out
+
+
+def hist_quantiles(name: str, qs=(0.5, 0.95, 0.99)) -> dict:
+    """p50/p95/p99 readback of registry histogram ``name`` (see
+    :func:`quantiles_from_buckets`); ``{}`` when it never recorded."""
+    reg = _registry
+    with reg.lock:
+        h = reg.hists.get(name)
+        if h is None:
+            return {}
+        h = {"count": h["count"], "total": h["total"],
+             "buckets": dict(h["buckets"])}
+    return quantiles_from_buckets(h, qs)
+
+
+# ---------------------------------------------------------------------------
 # Exporters
 # ---------------------------------------------------------------------------
 
@@ -397,13 +471,13 @@ def check_finite_wanted() -> bool:
     """The ``SLATE_TPU_CHECK_FINITE=1`` opt-in: instrumented drivers
     validate their outputs post-call (read per call so tests can
     monkeypatch the environment)."""
-    return _env_on("SLATE_TPU_CHECK_FINITE")
+    return env_flag("SLATE_TPU_CHECK_FINITE")
 
 
 def device_metrics_wanted() -> bool:
     """The ``SLATE_TPU_METRICS_DEVICE=1`` opt-in for runtime-callback
     counters (changes the traced program — never on by default)."""
-    return _env_on("SLATE_TPU_METRICS_DEVICE")
+    return env_flag("SLATE_TPU_METRICS_DEVICE")
 
 
 #: set by slate_tpu.resilience when a PROGRAMMATIC fault plan is

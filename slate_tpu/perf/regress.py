@@ -102,9 +102,15 @@ def parse_label(label: str):
 
 def direction(label: str) -> float:
     """+1 when bigger is better (GFLOP/s, ``*_solves_per_s`` rates,
-    speedup ratios), −1 for wall-second keys (``*_s`` stage timers)."""
+    speedup ratios), −1 for wall-second keys (``*_s`` stage timers) and
+    the serve-latency percentile keys (``*_ms`` — the ISSUE 10
+    ``serve_*_p50_ms``/``..._p99_ms`` family: milliseconds, lower is
+    better; spelled out explicitly even though ``_ms`` ends in ``_s``
+    so the rule survives a refactor of the wall-second suffix)."""
     if label.endswith("_per_s"):
         return 1.0
+    if label.endswith("_ms"):
+        return -1.0
     return -1.0 if label.endswith("_s") else 1.0
 
 

@@ -1,0 +1,828 @@
+"""Live serving telemetry: per-request tracing, SLO latency histograms,
+streaming exporters, and an in-process live sentinel.
+
+PR 8/9 made slate_tpu a serving system; every observability surface so
+far is post-hoc (metrics snapshots in bench artifacts, the sentinel
+running offline over ``BENCH_r*.json``).  This module is the LIVE
+half — while the process serves, it can answer:
+
+* **Where is a request's time going right now?**  Per-request tracing:
+  :meth:`slate_tpu.serve.queue.BatchQueue.submit` mints a trace id
+  (attached to the returned future as ``future.trace_id``) and the
+  dispatcher records contiguous ``queue_wait`` / ``dispatch`` /
+  ``post_check`` spans (plus a ``compile`` span when an on-demand
+  executable build happened inside the dispatch) — the spans of one
+  request sum to its future-observed latency.
+  :func:`slate_tpu.trace.finish_perfetto` exports them as Perfetto
+  flow events on the existing clock, one lane per dispatcher thread.
+* **Are we meeting latency SLOs?**  Every resolved request lands in a
+  log2-bucketed ``serve.latency_ms.<op>.<dtype>.<dims>`` histogram in
+  the metrics registry; :func:`slate_tpu.perf.metrics.hist_quantiles`
+  reads p50/p95/p99 back with stdlib math, and a
+  ``ServeConfig.slo_ms`` target (or ``SLATE_TPU_SLO_MS``) counts
+  ``serve.slo.violations``.
+* **Can an external system watch?**  Streaming exporters: a Prometheus
+  text-exposition endpoint on a stdlib ``http.server`` daemon thread
+  (``SLATE_TPU_METRICS_PORT``) and a rotating JSONL telemetry log
+  (``SLATE_TPU_TELEMETRY_LOG``), flushed on an interval and at
+  :func:`close`.  Render a log offline with
+  ``tools/telemetry_report.py`` (stdlib-only, like ``bench_diff.py``).
+* **Did performance just degrade?**  :class:`LiveSentinel` — a
+  sliding-window monitor over the streaming samples that reuses the
+  bench sentinel's thresholds (:data:`slate_tpu.perf.regress.
+  DEFAULT_THRESHOLD_PCT`) and the roofline attribution engine
+  (:func:`slate_tpu.perf.attr.attribute_live`), classifies sustained
+  latency/throughput drops (``degradation``) vs infra-shaped blips
+  (``infra``: error bursts), and emits structured events that can —
+  opt-in (``ServeConfig.sentinel_trip`` / ``SLATE_TPU_SENTINEL_TRIP``)
+  — trip the PR 9 circuit breaker and autotune-quarantine hooks.
+
+**Off-by-default, the PR 4 no-op contract**: every recording entry
+point checks one attribute (``_state.enabled``) and returns; nothing
+here ever touches a traced program, so compiled executables are
+bit-identical whatever the knobs (pinned in
+``tests/test_telemetry.py``).  Importing this module starts NO threads
+and binds NO sockets — exporters start only from :func:`maybe_start`
+(called by the serving front door's constructor) or an explicit
+:func:`start_exporter` / :func:`start_log` (guarded in
+``tests/test_backend_registry.py``).
+
+Env knobs (all unset by default):
+
+* ``SLATE_TPU_TELEMETRY=1`` — enable per-request tracing, SLO
+  histograms and the sentinel feed (implies ``SLATE_TPU_METRICS``).
+* ``SLATE_TPU_METRICS_PORT`` — start the Prometheus endpoint on this
+  port at front-door construction (``0`` = ephemeral;
+  ``SLATE_TPU_METRICS_HOST`` overrides the bind host).
+* ``SLATE_TPU_TELEMETRY_LOG`` — JSONL log path;
+  ``SLATE_TPU_TELEMETRY_FLUSH_S`` (default 5) the flush interval,
+  ``SLATE_TPU_TELEMETRY_LOG_MB`` (default 64) the rotation size (one
+  rotation is kept at ``<path>.1``).
+* ``SLATE_TPU_SLO_MS`` — default per-request latency SLO when
+  ``ServeConfig.slo_ms`` is unset.
+* ``SLATE_TPU_SENTINEL_BASELINE`` / ``_WINDOW`` / ``_THRESHOLD_PCT`` /
+  ``_COOLDOWN_S`` — default sentinel window geometry;
+  ``SLATE_TPU_SENTINEL_TRIP=1`` — let degradation events open the
+  serve breaker and quarantine the batched driver's autotune winners.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import metrics
+
+__all__ = [
+    "ENV_TELEMETRY", "ENV_PORT", "ENV_HOST", "ENV_LOG", "ENV_FLUSH_S",
+    "ENV_LOG_MB", "ENV_SLO_MS", "ENV_SENTINEL_TRIP", "LiveSentinel",
+    "add_hook", "remove_hook", "close", "configure_sentinel",
+    "default_slo_ms", "drain_spans", "enabled", "exporter_port",
+    "log_record", "maybe_start", "new_trace_id",
+    "observe_dispatch_error", "observe_request", "off", "on",
+    "percentiles", "prometheus_text", "quantiles_from_buckets",
+    "record_span", "sentinel", "short_dtype", "spans", "start_exporter",
+    "start_log", "stop_exporter", "trip_wanted",
+]
+
+ENV_TELEMETRY = "SLATE_TPU_TELEMETRY"
+ENV_PORT = "SLATE_TPU_METRICS_PORT"
+ENV_HOST = "SLATE_TPU_METRICS_HOST"
+ENV_LOG = "SLATE_TPU_TELEMETRY_LOG"
+ENV_FLUSH_S = "SLATE_TPU_TELEMETRY_FLUSH_S"
+ENV_LOG_MB = "SLATE_TPU_TELEMETRY_LOG_MB"
+ENV_SLO_MS = "SLATE_TPU_SLO_MS"
+ENV_SENTINEL_TRIP = "SLATE_TPU_SENTINEL_TRIP"
+
+#: cap on buffered request spans (same backstop as the metrics counter
+#: samples): past it requests keep serving, spans stop accumulating.
+_MAX_SPANS = 65536
+
+#: cap on queued-but-unflushed JSONL records; past it the OLDEST are
+#: dropped (``telemetry.log.dropped`` counts) — a slow disk must never
+#: grow the serving process without bound.
+_MAX_LOG_QUEUE = 65536
+
+_DTYPE_SHORT = {"float32": "fp32", "float64": "fp64", "bfloat16": "bf16",
+                "complex64": "c64", "complex128": "c128"}
+
+_SAN_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+#: the shared truthy-env parse (public on metrics so this module needs
+#: no private copy)
+_env_on = metrics.env_flag
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "").strip() or default)
+    except ValueError:
+        return default
+
+
+def short_dtype(dt) -> str:
+    """``"float32"`` → ``"fp32"`` — the bench-label dtype token."""
+    return _DTYPE_SHORT.get(str(dt), str(dt))
+
+
+class _State:
+    """Process-wide telemetry state.  Private — use the module facade
+    (the registry-guard test pins that serve/ and this module reach
+    metrics only through its public functions; the same discipline
+    applies here)."""
+
+    def __init__(self):
+        self.enabled = _env_on(ENV_TELEMETRY)
+        self.lock = threading.RLock()
+        # (trace_id, name, t0, t1, lane, args|None) — absolute
+        # perf_counter stamps, like the metrics counter samples
+        self.request_spans: List[tuple] = []
+        self.ids = itertools.count(1)
+        self.hooks: List[Callable] = []
+
+
+_state = _State()
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def on() -> None:
+    """Enable per-request tracing, SLO histograms and the sentinel feed
+    (also enables the metrics registry — the histograms live there)."""
+    metrics.on()
+    _state.enabled = True
+
+
+def off() -> None:
+    _state.enabled = False
+
+
+def default_slo_ms() -> Optional[float]:
+    """The ``SLATE_TPU_SLO_MS`` fallback SLO (None when unset)."""
+    raw = os.environ.get(ENV_SLO_MS, "").strip()
+    if not raw:
+        return None
+    try:
+        v = float(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def trip_wanted() -> bool:
+    """The ``SLATE_TPU_SENTINEL_TRIP=1`` opt-in: degradation events may
+    open serve breakers / quarantine autotune winners."""
+    return _env_on(ENV_SENTINEL_TRIP)
+
+
+# ---------------------------------------------------------------------------
+# Per-request tracing
+# ---------------------------------------------------------------------------
+
+def new_trace_id() -> int:
+    """Mint one process-unique request trace id."""
+    return next(_state.ids)
+
+
+def record_span(trace_id, name: str, t0: float, t1: float,
+                args: Optional[dict] = None) -> None:
+    """Record one request span (absolute ``perf_counter`` stamps) on
+    the CALLING thread's lane — :func:`slate_tpu.trace.finish_perfetto`
+    exports the buffer as complete events plus flow events joining each
+    trace id's spans across lanes.  One attribute read when off."""
+    st = _state
+    if not st.enabled or trace_id is None:
+        return
+    from .. import trace as _trace
+
+    lane = _trace.current_lane()
+    with st.lock:
+        if len(st.request_spans) < _MAX_SPANS:
+            st.request_spans.append((int(trace_id), str(name), float(t0),
+                                     float(t1), lane, args or None))
+
+
+def spans() -> List[tuple]:
+    """A copy of the buffered request spans (newest last)."""
+    with _state.lock:
+        return list(_state.request_spans)
+
+
+def drain_spans() -> List[tuple]:
+    """Pop and return every buffered request span (the Perfetto export
+    consumes the buffer so a second export starts clean)."""
+    with _state.lock:
+        out = list(_state.request_spans)
+        _state.request_spans.clear()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Histogram quantile readback (re-exported convenience; the math lives
+# in metrics so the registry's log2 buckets and their readback evolve
+# together)
+# ---------------------------------------------------------------------------
+
+quantiles_from_buckets = metrics.quantiles_from_buckets
+
+
+def percentiles(name: str, qs=(0.5, 0.95, 0.99)) -> Dict[float, float]:
+    """p50/p95/p99 readback of one registry histogram by name."""
+    return metrics.hist_quantiles(name, qs)
+
+
+# ---------------------------------------------------------------------------
+# The request observation fan-out: histogram + SLO + JSONL + sentinel
+# ---------------------------------------------------------------------------
+
+def observe_request(op: str, bucket: str, latency_s: float,
+                    slo_ms: Optional[float] = None, error: bool = False,
+                    batch: int = 1, key: Optional[tuple] = None,
+                    dtype: str = "fp32", n: Optional[int] = None) -> None:
+    """One served request's end-to-end outcome: records the
+    ``serve.latency_ms.<op>.<bucket>`` histogram (successes only),
+    counts SLO violations against ``slo_ms`` (falling back to
+    ``SLATE_TPU_SLO_MS``), appends a ``request`` JSONL record, and
+    feeds the live sentinel.  One attribute read when telemetry is
+    off."""
+    if not _state.enabled:
+        return
+    ms = float(latency_s) * 1e3
+    if not error:
+        metrics.observe("serve.latency_ms.%s.%s" % (op, bucket), ms)
+    slo = slo_ms if slo_ms is not None else default_slo_ms()
+    # an errored request (deadline expiry, failed resolution) never
+    # delivered a timely answer — with an SLO configured it counts as
+    # a violation whatever its wall time, or the violation counter
+    # reads green exactly under total overload
+    viol = slo is not None and (error or ms > float(slo))
+    if viol:
+        metrics.inc("serve.slo.violations")
+        metrics.inc("serve.slo.violations.%s" % op)
+    if error:
+        metrics.inc("telemetry.request.errors")
+    log_record("request", op=op, bucket=bucket,
+               latency_ms=round(ms, 3), error=bool(error),
+               slo_violation=bool(viol), batch=int(batch))
+    sentinel().observe(op, bucket, latency_s, error=error, batch=batch,
+                       key=key, dtype=dtype, n=n)
+
+
+def observe_dispatch_error(op: str, bucket: str,
+                           key: Optional[tuple] = None,
+                           dtype: str = "fp32",
+                           n: Optional[int] = None) -> None:
+    """One FAILED batch dispatch into the sentinel's error feed only —
+    no per-request JSONL record and no histogram sample.  Used on the
+    transient-failure → loop-of-singles path, where every request will
+    still get exactly one final :func:`observe_request` from the
+    singles resolution: recording a request-level error here too would
+    double-count it in the report/hist while the sentinel would miss
+    the infra-shaped signal without this."""
+    if not _state.enabled:
+        return
+    metrics.inc("telemetry.dispatch.errors")
+    sentinel().observe(op, bucket, 0.0, error=True, batch=1, key=key,
+                       dtype=dtype, n=n)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def _san(name: str) -> str:
+    return _SAN_RE.sub("_", name)
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    return "%d" % int(f) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _bucket_upper(bucket: str) -> Optional[float]:
+    bounds = metrics.bucket_bounds(bucket)
+    return None if bounds is None else bounds[1]
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a metrics snapshot in the Prometheus text exposition
+    format (version 0.0.4): counters and gauges one series each, timers
+    as ``_count``/``_seconds_total``, histograms as cumulative
+    ``_bucket{le=...}`` series with ``_sum``/``_count`` plus
+    convenience ``_quantile{quantile=...}`` gauges (p50/p95/p99 via
+    :func:`metrics.hist_quantiles` math)."""
+    snap = snap if snap is not None else metrics.snapshot()
+    lines: List[str] = []
+    for k, v in sorted((snap.get("counters") or {}).items()):
+        mn = "slate_tpu_" + _san(k)
+        lines.append("# TYPE %s counter" % mn)
+        lines.append("%s %s" % (mn, _fmt(v)))
+    for k, v in sorted((snap.get("gauges") or {}).items()):
+        mn = "slate_tpu_" + _san(k)
+        lines.append("# TYPE %s gauge" % mn)
+        lines.append("%s %s" % (mn, _fmt(v)))
+    for k, t in sorted((snap.get("timers") or {}).items()):
+        mn = "slate_tpu_" + _san(k)
+        lines.append("# TYPE %s_count counter" % mn)
+        lines.append("%s_count %s" % (mn, _fmt(t.get("count", 0))))
+        lines.append("# TYPE %s_seconds_total counter" % mn)
+        lines.append("%s_seconds_total %s"
+                     % (mn, _fmt(t.get("total_s", 0.0))))
+    for k, h in sorted((snap.get("hists") or {}).items()):
+        mn = "slate_tpu_" + _san(k)
+        buckets = []
+        for b, c in (h.get("buckets") or {}).items():
+            hi = _bucket_upper(b)
+            if hi is not None:
+                buckets.append((hi, int(c)))
+        buckets.sort()
+        lines.append("# TYPE %s histogram" % mn)
+        cum = 0
+        for hi, c in buckets:
+            cum += c
+            lines.append('%s_bucket{le="%s"} %d' % (mn, _fmt(hi), cum))
+        lines.append('%s_bucket{le="+Inf"} %d' % (mn, h.get("count", 0)))
+        lines.append("%s_sum %s" % (mn, _fmt(h.get("total", 0.0))))
+        lines.append("%s_count %d" % (mn, h.get("count", 0)))
+        qs = quantiles_from_buckets(h, (0.5, 0.95, 0.99))
+        if qs:
+            lines.append("# TYPE %s_quantile gauge" % mn)
+            for q in sorted(qs):
+                lines.append('%s_quantile{quantile="%s"} %s'
+                             % (mn, q, _fmt(qs[q])))
+    return "\n".join(lines) + "\n"
+
+
+_exporter_lock = threading.Lock()
+_exporter: Dict[str, object] = {"server": None, "thread": None,
+                                "port": None}
+
+
+def exporter_port() -> Optional[int]:
+    """The bound Prometheus port (None when the exporter is down) —
+    pass port 0 to :func:`start_exporter` and read the real port
+    here."""
+    return _exporter["port"]                                # type: ignore
+
+
+def start_exporter(port: Optional[int] = None,
+                   host: Optional[str] = None) -> int:
+    """Start the Prometheus scrape endpoint (``GET /metrics``) on a
+    daemon thread; idempotent (a second call returns the bound port).
+    ``port`` defaults to ``SLATE_TPU_METRICS_PORT``; 0 binds an
+    ephemeral port.  Enables the metrics registry — a scrape of an off
+    registry would read empty."""
+    with _exporter_lock:
+        if _exporter["server"] is not None:
+            return _exporter["port"]                        # type: ignore
+        if port is None:
+            raw = os.environ.get(ENV_PORT, "").strip()
+            if not raw:
+                raise ValueError(
+                    "start_exporter: no port given and %s unset" % ENV_PORT)
+            port = int(raw)
+        if host is None:
+            # loopback by default: setting only the PORT knob must not
+            # expose an unauthenticated metrics endpoint on every
+            # interface of a shared host — widening the bind scope is
+            # an explicit SLATE_TPU_METRICS_HOST decision
+            host = os.environ.get(ENV_HOST, "").strip() or "127.0.0.1"
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):                   # noqa: N802 (stdlib API)
+                if self.path.split("?", 1)[0].rstrip("/") not in (
+                        "", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = prometheus_text().encode("utf-8")
+                except Exception as e:      # a bad render must not 500-loop
+                    body = ("# render error: %s\n" % e).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):          # noqa: N802 — quiet
+                pass
+
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        srv.daemon_threads = True
+        th = threading.Thread(target=srv.serve_forever,
+                              name="slate-telemetry-exporter", daemon=True)
+        th.start()
+        metrics.on()
+        _exporter.update(server=srv, thread=th,
+                         port=int(srv.server_address[1]))
+        metrics.inc("telemetry.exporter.started")
+        return _exporter["port"]                            # type: ignore
+
+
+def stop_exporter() -> None:
+    with _exporter_lock:
+        srv = _exporter["server"]
+        if srv is None:
+            return
+        srv.shutdown()                                      # type: ignore
+        srv.server_close()                                  # type: ignore
+        _exporter.update(server=None, thread=None, port=None)
+
+
+# ---------------------------------------------------------------------------
+# Rotating JSONL telemetry log
+# ---------------------------------------------------------------------------
+
+_log_lock = threading.RLock()
+_log: Dict[str, object] = {"path": None, "queue": None, "thread": None,
+                           "stop": None, "flush_s": 5.0,
+                           "max_bytes": 64 * 1024 * 1024}
+_atexit_registered = [False]
+
+
+def start_log(path: Optional[str] = None,
+              flush_s: Optional[float] = None,
+              max_mb: Optional[float] = None) -> str:
+    """Start the rotating JSONL telemetry log on a daemon flusher
+    thread; idempotent.  ``path`` defaults to
+    ``SLATE_TPU_TELEMETRY_LOG``; records queue via :func:`log_record`
+    and flush every ``flush_s`` seconds (each flush also appends one
+    trimmed ``snapshot`` record) and at :func:`close`.  Past
+    ``max_mb`` the file rotates once to ``<path>.1``."""
+    with _log_lock:
+        if _log["path"] is not None:
+            return _log["path"]                             # type: ignore
+        if path is None:
+            path = os.environ.get(ENV_LOG, "").strip()
+            if not path:
+                raise ValueError(
+                    "start_log: no path given and %s unset" % ENV_LOG)
+        if flush_s is None:
+            flush_s = _env_float(ENV_FLUSH_S, 5.0)
+        if max_mb is None:
+            max_mb = _env_float(ENV_LOG_MB, 64.0)
+        stop = threading.Event()
+        _log.update(path=str(path), queue=deque(), stop=stop,
+                    flush_s=max(float(flush_s), 0.01),
+                    max_bytes=max(int(float(max_mb) * 1024 * 1024), 1024))
+        th = threading.Thread(target=_log_loop,
+                              name="slate-telemetry-log", daemon=True)
+        _log["thread"] = th
+        th.start()
+        if not _atexit_registered[0]:
+            import atexit
+
+            atexit.register(close)
+            _atexit_registered[0] = True
+        metrics.inc("telemetry.log.started")
+        return _log["path"]                                 # type: ignore
+
+
+def log_record(kind: str, **fields) -> None:
+    """Queue one JSONL record (no-op until :func:`start_log`); the
+    flusher writes it on the next interval.  The queue is bounded —
+    past :data:`_MAX_LOG_QUEUE` the oldest records are dropped and
+    ``telemetry.log.dropped`` counts them."""
+    q = _log["queue"]
+    if q is None:
+        return
+    rec = {"t": round(time.time(), 6), "kind": str(kind)}
+    rec.update(fields)
+    with _log_lock:
+        if len(q) >= _MAX_LOG_QUEUE:                        # type: ignore
+            q.popleft()                                     # type: ignore
+            metrics.inc("telemetry.log.dropped")
+        q.append(rec)                                       # type: ignore
+
+
+#: counter/gauge prefixes worth streaming into the JSONL snapshots (the
+#: full registry would dominate the log; the serving story lives here)
+_SNAP_PREFIXES = ("serve.", "telemetry.", "resilience.", "jit.")
+
+
+def _snapshot_record() -> dict:
+    snap = metrics.snapshot()
+    return {
+        "counters": {k: v for k, v in (snap.get("counters") or {}).items()
+                     if k.startswith(_SNAP_PREFIXES)},
+        "gauges": {k: v for k, v in (snap.get("gauges") or {}).items()
+                   if k.startswith(_SNAP_PREFIXES)},
+    }
+
+
+def _flush_log(with_snapshot: bool = False) -> None:
+    with _log_lock:
+        q, path = _log["queue"], _log["path"]
+        if q is None or path is None:
+            return
+        if with_snapshot and metrics.enabled():
+            rec = {"t": round(time.time(), 6), "kind": "snapshot"}
+            rec.update(_snapshot_record())
+            q.append(rec)                                   # type: ignore
+        recs = []
+        while q:                                            # type: ignore
+            recs.append(q.popleft())                        # type: ignore
+        max_bytes = _log["max_bytes"]
+    if not recs:
+        return
+    data = "".join(json.dumps(r, default=str) + "\n" for r in recs)
+    try:
+        if os.path.exists(path) \
+                and os.path.getsize(path) >= max_bytes:     # type: ignore
+            os.replace(path, "%s.1" % path)
+        with open(path, "a") as f:                          # type: ignore
+            f.write(data)
+    except OSError:
+        metrics.inc("telemetry.log.write_errors")
+
+
+def _log_loop() -> None:
+    stop = _log["stop"]
+    flush_s = _log["flush_s"]
+    while not stop.wait(flush_s):                           # type: ignore
+        if _log["stop"] is not stop:        # close()d and restarted
+            return
+        _flush_log(with_snapshot=True)
+
+
+def close() -> None:
+    """Stop the JSONL flusher after one final flush (the "at close"
+    half of the flush contract) and reset the log state so a test or a
+    new serving phase can :func:`start_log` again.  The Prometheus
+    exporter is left running (scrapes are pull — stop it explicitly
+    with :func:`stop_exporter`).  Idempotent."""
+    with _log_lock:
+        th, stop = _log["thread"], _log["stop"]
+        _log["thread"] = None
+    if stop is not None:
+        stop.set()                                          # type: ignore
+    if th is not None and th.is_alive():                    # type: ignore
+        th.join(timeout=10.0)                               # type: ignore
+    _flush_log(with_snapshot=True)
+    with _log_lock:
+        _log.update(path=None, queue=None, stop=None)
+
+
+def maybe_start() -> None:
+    """Start whatever the environment asks for — called by the serving
+    front door's constructor, NEVER at import: the Prometheus endpoint
+    when ``SLATE_TPU_METRICS_PORT`` is set, the JSONL log when
+    ``SLATE_TPU_TELEMETRY_LOG`` is set, telemetry recording when
+    ``SLATE_TPU_TELEMETRY=1``.  With no knob set this is a pure
+    no-op."""
+    if _env_on(ENV_TELEMETRY):
+        on()
+    if os.environ.get(ENV_PORT, "").strip():
+        try:
+            start_exporter()
+        except Exception:
+            metrics.inc("telemetry.exporter.start_errors")
+    if os.environ.get(ENV_LOG, "").strip():
+        try:
+            start_log()
+        except Exception:
+            metrics.inc("telemetry.log.start_errors")
+
+
+# ---------------------------------------------------------------------------
+# Event hooks (the serve layer's opt-in breaker/quarantine trip path)
+# ---------------------------------------------------------------------------
+
+def _resolve_hook(h):
+    import weakref
+
+    return h() if isinstance(h, weakref.WeakMethod) else h
+
+
+def add_hook(fn: Callable[[dict], None]) -> None:
+    """Register a callback for every sentinel event (the serving front
+    door registers one per queue; see ``BatchQueue._on_sentinel_event``).
+    Bound methods are held WEAKLY: ``close()`` is documented as polite,
+    not required, so a dropped-without-close BatchQueue must not stay
+    pinned forever through this module-global list (nor keep receiving
+    trip fan-out after it is gone)."""
+    import weakref
+
+    with _state.lock:
+        if any(_resolve_hook(h) is fn for h in _state.hooks):
+            return
+        if hasattr(fn, "__self__"):
+            _state.hooks.append(weakref.WeakMethod(fn))
+        else:
+            _state.hooks.append(fn)
+
+
+def remove_hook(fn: Callable[[dict], None]) -> None:
+    with _state.lock:
+        _state.hooks = [h for h in _state.hooks
+                        if _resolve_hook(h) not in (None, fn)]
+
+
+# ---------------------------------------------------------------------------
+# The live sentinel
+# ---------------------------------------------------------------------------
+
+class LiveSentinel:
+    """In-process sliding-window serving monitor.
+
+    Per (op, bucket) it keeps the last ``baseline + window`` dispatch
+    samples ``(latency_s, error, batch)``; once full, every new sample
+    compares the RECENT window against the BASELINE prefix:
+
+    * an error rate ≥ ``infra_error_rate`` in the recent window is an
+      **infra**-shaped blip (the r05 failure class: the fabric, not the
+      kernels) — classification ``infra``, kind ``errors``;
+    * a recent-median latency rise (or batch-throughput drop) past
+      ``threshold_pct`` — the bench sentinel's threshold
+      (:data:`slate_tpu.perf.regress.DEFAULT_THRESHOLD_PCT`) by default
+      — is a sustained **degradation**, kind ``latency`` /
+      ``throughput``, with a roofline attribution block
+      (:func:`slate_tpu.perf.attr.attribute_live`) attached when the
+      bucket's shape is known.
+
+    A single slow sample moves the median by at most one rank — blips
+    don't fire; ``cooldown_s`` bounds events to one per key per
+    window so a sustained problem produces exactly one event, not a
+    stream.  Events append to :attr:`events`, count
+    ``telemetry.sentinel.<classification>``, stream to the JSONL log,
+    and fan out to the registered hooks (the serve layer's opt-in
+    breaker-trip / quarantine path)."""
+
+    def __init__(self, baseline: Optional[int] = None,
+                 window: Optional[int] = None,
+                 threshold_pct: Optional[float] = None,
+                 infra_error_rate: float = 0.5,
+                 cooldown_s: Optional[float] = None,
+                 platform: str = "tpu",
+                 clock=time.monotonic):
+        if threshold_pct is None:
+            thr = os.environ.get("SLATE_TPU_SENTINEL_THRESHOLD_PCT",
+                                 "").strip()
+            if thr:
+                threshold_pct = float(thr)
+            else:
+                from . import regress
+
+                threshold_pct = regress.DEFAULT_THRESHOLD_PCT
+        self.baseline = int(baseline if baseline is not None
+                            else _env_float("SLATE_TPU_SENTINEL_BASELINE",
+                                            32))
+        self.window = int(window if window is not None
+                          else _env_float("SLATE_TPU_SENTINEL_WINDOW", 8))
+        self.threshold_pct = float(threshold_pct)
+        self.infra_error_rate = float(infra_error_rate)
+        self.cooldown_s = float(
+            cooldown_s if cooldown_s is not None
+            else _env_float("SLATE_TPU_SENTINEL_COOLDOWN_S", 30.0))
+        self.platform = platform
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._samples: Dict[tuple, deque] = {}
+        self._last: Dict[tuple, float] = {}
+        self.events: List[dict] = []
+
+    def observe(self, op: str, bucket: str, latency_s: float,
+                error: bool = False, batch: int = 1,
+                key: Optional[tuple] = None, dtype: str = "fp32",
+                n: Optional[int] = None) -> Optional[dict]:
+        """Feed one dispatch sample; returns the emitted event (or
+        None).  Evaluation runs under the sentinel lock; emission
+        (counters, log, hooks) outside it."""
+        k = (str(op), str(bucket))
+        ev = None
+        with self._lock:
+            dq = self._samples.get(k)
+            if dq is None:
+                dq = self._samples[k] = deque(
+                    maxlen=self.baseline + self.window)
+            dq.append((float(latency_s), bool(error), max(1, int(batch))))
+            if len(dq) == self.baseline + self.window \
+                    and (self._clock() - self._last.get(k, -1e18)
+                         >= self.cooldown_s):
+                ev = self._evaluate(op, bucket, list(dq), key=key,
+                                    dtype=dtype, n=n)
+                if ev is not None:
+                    self._last[k] = self._clock()
+                    self.events.append(ev)
+        if ev is not None:
+            self._emit(ev)
+        return ev
+
+    # -- classification ----------------------------------------------------
+
+    def _evaluate(self, op, bucket, samples, key=None, dtype="fp32",
+                  n=None) -> Optional[dict]:
+        import statistics
+
+        recent = samples[-self.window:]
+        base = samples[:-self.window]
+        errs = sum(1 for _, e, _ in recent if e)
+        err_rate = errs / float(len(recent))
+        common = {"t": round(time.time(), 3), "op": str(op),
+                  "bucket": str(bucket), "window": self.window,
+                  "key": list(key) if key else None}
+        if err_rate >= self.infra_error_rate:
+            ev = dict(common, classification="infra", kind="errors",
+                      error_rate=round(err_rate, 3),
+                      detail="infra-shaped: %d/%d recent dispatch "
+                             "samples errored" % (errs, len(recent)))
+            return ev
+        base_ok = [(l, b) for l, e, b in base if not e and l > 0]
+        rec_ok = [(l, b) for l, e, b in recent if not e and l > 0]
+        if len(base_ok) < max(2, self.baseline // 2) \
+                or len(rec_ok) < max(2, self.window // 2):
+            return None
+        med = statistics.median
+        b_lat = med([l for l, _ in base_ok])
+        r_lat = med([l for l, _ in rec_ok])
+        rise_pct = (r_lat / b_lat - 1.0) * 100.0 if b_lat > 0 else 0.0
+        b_tp = med([b / l for l, b in base_ok])
+        r_tp = med([b / l for l, b in rec_ok])
+        drop_pct = (1.0 - r_tp / b_tp) * 100.0 if b_tp > 0 else 0.0
+        if rise_pct > self.threshold_pct:
+            kind = "latency"
+        elif drop_pct > self.threshold_pct:
+            kind = "throughput"
+        else:
+            return None
+        ev = dict(common, classification="degradation", kind=kind,
+                  baseline_ms=round(b_lat * 1e3, 3),
+                  recent_ms=round(r_lat * 1e3, 3),
+                  rise_pct=round(rise_pct, 1),
+                  throughput_drop_pct=round(drop_pct, 1),
+                  threshold_pct=self.threshold_pct)
+        if n:
+            try:
+                from . import attr
+
+                bmed = int(med([b for _, b in rec_ok]))
+                rep = attr.attribute_live(str(op), n=int(n),
+                                          dtype=dtype or "fp32",
+                                          batch=bmed, latency_s=r_lat,
+                                          platform=self.platform)
+                if rep:
+                    ev["attribution"] = {
+                        "label": rep.get("label"),
+                        "gflops": rep.get("gflops"),
+                        "achieved_frac": rep.get("achieved_frac"),
+                        "bottlenecks": rep.get("bottlenecks"),
+                    }
+            except Exception:       # attribution must never mask the event
+                pass
+        return ev
+
+    def _emit(self, ev: dict) -> None:
+        metrics.inc("telemetry.sentinel.events")
+        metrics.inc("telemetry.sentinel." + ev["classification"])
+        # nested under "event": the event's own "kind" (latency/
+        # throughput/errors) must not collide with the record kind
+        log_record("sentinel", event=dict(ev))
+        with _state.lock:
+            hooks = [_resolve_hook(h) for h in _state.hooks]
+            # prune hooks whose bound receiver was garbage-collected
+            _state.hooks = [h for h, r in zip(_state.hooks, hooks)
+                            if r is not None]
+        for hook in hooks:
+            if hook is None:
+                continue
+            try:
+                hook(ev)
+            except Exception:
+                metrics.inc("telemetry.sentinel.hook_errors")
+
+
+_sentinel: List[Optional[LiveSentinel]] = [None]
+_sentinel_lock = threading.Lock()
+
+
+def sentinel() -> LiveSentinel:
+    """The process-default sentinel (lazily built from the env
+    defaults)."""
+    with _sentinel_lock:
+        if _sentinel[0] is None:
+            _sentinel[0] = LiveSentinel()
+        return _sentinel[0]
+
+
+def configure_sentinel(**kwargs) -> LiveSentinel:
+    """Replace the process-default sentinel (window geometry, threshold,
+    cooldown — the :class:`LiveSentinel` constructor's kwargs).  Used
+    by tests and by operators who want per-deployment windows."""
+    with _sentinel_lock:
+        _sentinel[0] = LiveSentinel(**kwargs)
+        return _sentinel[0]

@@ -62,6 +62,20 @@ class CircuitBreaker:
             self._state = CLOSED
             self._failures = 0
 
+    def trip(self) -> None:
+        """Force OPEN now — the live telemetry sentinel's opt-in hook
+        (ISSUE 10): a SUSTAINED degradation event stops being served by
+        the degraded fast path immediately instead of waiting for
+        ``threshold`` hard failures, and the existing cool-down /
+        HALF-OPEN ladder re-probes it like any other open."""
+        with self._lock:
+            if self._state != OPEN:
+                metrics.inc(self._prefix + ".open")
+            metrics.inc(self._prefix + ".tripped")
+            self._state = OPEN
+            self._failures = 0
+            self._opened_at = self._clock()
+
     def failure(self) -> None:
         with self._lock:
             if self._state == HALF_OPEN:

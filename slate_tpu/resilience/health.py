@@ -45,8 +45,8 @@ from ..perf import metrics
 from .inject import iter_leaves
 
 __all__ = [
-    "ENV_HEALTH", "MODES", "driver_gate", "mode", "register_residual",
-    "safe_backend",
+    "ENV_HEALTH", "MODES", "driver_gate", "mode", "quarantine_driver",
+    "register_residual", "safe_backend",
 ]
 
 ENV_HEALTH = "SLATE_TPU_HEALTH"
@@ -225,6 +225,21 @@ def _quarantine_for(name: str, reason: str) -> int:
     return demoted
 
 
+def quarantine_driver(name: str, reason: str) -> int:
+    """PUBLIC entry to the gate's quarantine attribution — the live
+    telemetry sentinel's opt-in trip path (ISSUE 10): demote driver
+    ``name``'s settled non-safe autotune winners exactly as a failed
+    health gate with a clean stock re-run would (TTL'd, re-probed, the
+    safe backend never filtered).  Returns the number of demotions —
+    zero when the driver's sites have no timed/cached winners (the
+    heuristic decisions a CPU box runs on are not demotable
+    evidence)."""
+    n = _quarantine_for(name, reason=reason)
+    if n:
+        metrics.inc("resilience.sentinel.quarantined", n)
+    return n
+
+
 # ---------------------------------------------------------------------------
 # The driver post-condition pipeline
 # ---------------------------------------------------------------------------
@@ -242,6 +257,10 @@ def driver_gate(name: str, fn, args, kwargs, out):
     kind = inject.poll("driver.output")
     if kind == "error":
         raise inject.InjectedFault("driver.output")
+    if kind == "slow":
+        import time as _time
+
+        _time.sleep(inject.slow_seconds())
     if kind in ("nan", "inf"):
         out = inject.corrupt_outputs(out, kind)
     m = mode()

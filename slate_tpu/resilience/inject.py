@@ -28,7 +28,11 @@ to drive faults through it on demand.  This module is that harness:
 * **Kinds.**  ``error`` — the seam raises :class:`InjectedFault`
   (a transient, classified-retryable :class:`SlateError`); ``nan`` /
   ``inf`` — the seam poisons one element of its output (the silent-
-  corruption failure mode health gates exist to catch).
+  corruption failure mode health gates exist to catch); ``slow`` — the
+  seam sleeps :func:`slow_seconds` (``SLATE_TPU_FAULT_SLOW_S``, default
+  50 ms) before answering: the sustained-latency degradation the live
+  telemetry sentinel (ISSUE 10) exists to classify, injectable on
+  demand.
 
 * **Sites** wired today: ``autotune.probe`` (candidate compile/time),
   ``serve.dispatch`` (bucket batch dispatch), ``driver.output``
@@ -49,6 +53,7 @@ from __future__ import annotations
 import os
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -56,16 +61,26 @@ from ..exceptions import SlateError
 from ..perf import metrics
 
 __all__ = [
-    "ENV_PLAN", "ENV_SEED", "KINDS", "FaultPlan", "FaultSpec",
-    "InjectedFault", "active", "clear_plan", "corrupt_outputs",
-    "fault_here", "get_plan", "install", "iter_leaves", "parse_plan",
-    "poll",
+    "ENV_PLAN", "ENV_SEED", "ENV_SLOW_S", "KINDS", "FaultPlan",
+    "FaultSpec", "InjectedFault", "active", "clear_plan",
+    "corrupt_outputs", "fault_here", "get_plan", "install",
+    "iter_leaves", "parse_plan", "poll", "slow_seconds",
 ]
 
 ENV_PLAN = "SLATE_TPU_FAULT_INJECT"
 ENV_SEED = "SLATE_TPU_FAULT_SEED"
+ENV_SLOW_S = "SLATE_TPU_FAULT_SLOW_S"
 
-KINDS = ("error", "nan", "inf")
+KINDS = ("error", "nan", "inf", "slow")
+
+
+def slow_seconds() -> float:
+    """Injected added latency for the ``slow`` fault kind
+    (``SLATE_TPU_FAULT_SLOW_S``, default 0.05 s)."""
+    try:
+        return float(os.environ.get(ENV_SLOW_S, "").strip() or 0.05)
+    except ValueError:
+        return 0.05
 
 
 class InjectedFault(SlateError):
@@ -212,11 +227,16 @@ def poll(site: str) -> Optional[str]:
 
 def fault_here(site: str) -> Optional[str]:
     """Poll ``site`` and raise :class:`InjectedFault` on an ``error``
-    fault; returns the kind (``nan``/``inf``) for seams that also
-    support output corruption, else None."""
+    fault; a ``slow`` fault sleeps :func:`slow_seconds` in place (and
+    returns None — the seam continues normally, just later); returns
+    the kind (``nan``/``inf``) for seams that also support output
+    corruption, else None."""
     kind = poll(site)
     if kind == "error":
         raise InjectedFault(site)
+    if kind == "slow":
+        time.sleep(slow_seconds())
+        return None
     return kind
 
 

@@ -16,7 +16,11 @@ Quick start::
 Importing this package starts no threads; the dispatcher thread spawns
 on the first :func:`submit` and is a daemon (a serving process exits
 cleanly without an explicit :func:`shutdown`, but draining via
-``shutdown()`` is polite).
+``shutdown()`` is polite).  Live observability — per-request Perfetto
+flow tracing, SLO latency histograms, the Prometheus/JSONL streaming
+exporters and the in-process live sentinel — rides along through
+:mod:`slate_tpu.perf.telemetry` (all off-by-default; see the "Live
+telemetry" section of ``docs/usage.md``).
 """
 
 from .queue import (  # noqa: F401

@@ -78,6 +78,40 @@ Fault injection (``SLATE_TPU_FAULT_INJECT`` site ``serve.dispatch``,
 ``serve.retries`` / ``serve.breaker.*`` / ``serve.fallback.singles`` /
 ``serve.deadline_expired`` / ``serve.backpressure`` counters make every
 degradation observable.
+
+**Live telemetry** (ISSUE 10, :mod:`slate_tpu.perf.telemetry`) — all
+off-by-default, one attribute read per entry point when unset:
+
+* **Per-request tracing** — with ``SLATE_TPU_TELEMETRY=1`` (or
+  ``telemetry.on()``) every :meth:`BatchQueue.submit` mints a trace id
+  (readable on the returned future as ``future.trace_id``) and the
+  dispatcher records contiguous ``queue_wait`` (submit → batch pop),
+  ``dispatch`` (pad + execute) and ``post_check`` (health gate + unpad
+  + future resolution) spans — plus a ``compile`` span when the
+  dispatch had to build its executable on demand.  The spans of one
+  request sum to its future-observed latency, and
+  :func:`slate_tpu.trace.finish_perfetto` exports them as Perfetto
+  flow events, one lane per dispatcher thread.
+* **SLO histograms** — each resolved request records into the
+  log2-bucketed ``serve.latency_ms.<op>.<dtype>.<dims>`` registry
+  histogram; :attr:`ServeConfig.slo_ms` (or ``SLATE_TPU_SLO_MS``)
+  counts ``serve.slo.violations``; p50/p95/p99 read back via
+  :func:`slate_tpu.perf.metrics.hist_quantiles` and stream out the
+  Prometheus endpoint.
+* **Streaming exporters** — constructing a :class:`BatchQueue` calls
+  :func:`telemetry.maybe_start`: with ``SLATE_TPU_METRICS_PORT`` set a
+  Prometheus scrape endpoint starts on a daemon thread, with
+  ``SLATE_TPU_TELEMETRY_LOG`` set a rotating JSONL log starts (never
+  at import — guarded in ``tests/test_backend_registry.py``).
+* **Live sentinel** — every dispatch outcome feeds the sliding-window
+  monitor; a sustained latency/throughput degradation (vs an
+  infra-shaped error blip) emits a structured event, and — opt-in via
+  :attr:`ServeConfig.sentinel_trip` / ``SLATE_TPU_SENTINEL_TRIP=1`` —
+  trips this queue's circuit breaker for the degraded bucket and
+  quarantines the batched driver's settled autotune winners
+  (:func:`slate_tpu.resilience.health.quarantine_driver`), so the
+  degradation ladder reacts to a SLOW fast path, not only a failing
+  one.
 """
 
 from __future__ import annotations
@@ -90,6 +124,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..exceptions import SlateError
 from ..perf import metrics
+from ..perf import telemetry as _telemetry
 from ..resilience import health as _health
 from ..resilience import inject as _inject
 from ..resilience.breaker import CircuitBreaker
@@ -154,6 +189,16 @@ class ServeConfig:
       cool-down before its half-open re-probe.
     * ``max_queue_depth`` — total queued requests before
       :meth:`BatchQueue.submit` raises :class:`Backpressure`.
+
+    Live-telemetry knobs (ISSUE 10; active only while telemetry is on):
+
+    * ``slo_ms`` — per-request latency SLO target in milliseconds
+      (None falls back to ``SLATE_TPU_SLO_MS``); resolved requests
+      past it count ``serve.slo.violations``.
+    * ``sentinel_trip`` — let a live-sentinel DEGRADATION event for one
+      of this queue's buckets open that bucket's circuit breaker and
+      quarantine the batched driver's settled autotune winners
+      (``SLATE_TPU_SENTINEL_TRIP=1`` is the env-side opt-in).
     """
 
     max_batch: int = 64
@@ -165,6 +210,8 @@ class ServeConfig:
     breaker_threshold: int = 3
     breaker_cooldown_s: float = 0.25
     max_queue_depth: int = 4096
+    slo_ms: Optional[float] = None
+    sentinel_trip: bool = False
 
 
 @dataclass(eq=False)
@@ -175,6 +222,7 @@ class _Request:
         default_factory=concurrent.futures.Future)
     t_submit: float = field(default_factory=time.perf_counter)
     deadline: Optional[float] = None    # absolute perf_counter time
+    trace_id: Optional[int] = None      # minted when telemetry is on
 
 
 #: op name → number of operands.  Every op maps onto one batched driver
@@ -274,6 +322,14 @@ class BatchQueue:
         self._wake = threading.Condition(self._lock)
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # streaming exporters the environment asks for start HERE (the
+        # front door's constructor), never at import; pure no-op with
+        # no telemetry env knob set
+        _telemetry.maybe_start()
+        # the live sentinel's opt-in breaker/quarantine trip path (the
+        # hook only ever fires on an emitted sentinel event)
+        self._sentinel_hook = self._on_sentinel_event
+        _telemetry.add_hook(self._sentinel_hook)
 
     # -- bucketing ---------------------------------------------------------
 
@@ -318,6 +374,13 @@ class BatchQueue:
                                    for x in operands))
         if deadline_s is not None:
             req.deadline = req.t_submit + float(deadline_s)
+        if _telemetry.enabled():
+            # the per-request trace id: propagated through bucket → pad
+            # → dispatch → resolution, exported as Perfetto flow
+            # events; readable by the caller on the future so its own
+            # timing can be joined onto the exported spans
+            req.trace_id = _telemetry.new_trace_id()
+            req.future.trace_id = req.trace_id
         with self._wake:
             if self._closed:
                 raise RuntimeError("BatchQueue is closed")
@@ -360,6 +423,9 @@ class BatchQueue:
         FAIL — never strand — any future still queued (dead dispatcher,
         request stuck behind a hung dispatch): each one gets a
         ``SlateError`` set so callers blocked in ``result()`` wake."""
+        if self._sentinel_hook is not None:
+            _telemetry.remove_hook(self._sentinel_hook)
+            self._sentinel_hook = None
         with self._wake:
             self._closed = True
             self._wake.notify_all()
@@ -420,12 +486,12 @@ class BatchQueue:
                 # expire requests past their deadline BEFORE batching:
                 # a deadlined request resolves with TimeoutError, never
                 # rides a dispatch it can no longer use
-                expired: List[_Request] = []
+                expired: List[Tuple[tuple, _Request]] = []
                 for key in list(self._buckets):
                     live: List[_Request] = []
                     for r in self._buckets[key]:
                         if r.deadline is not None and now >= r.deadline:
-                            expired.append(r)
+                            expired.append((key, r))
                         else:
                             live.append(r)
                     if live:
@@ -463,12 +529,18 @@ class BatchQueue:
                                    + len(expired))
                 if not batches and not expired and soonest is not None:
                     self._wake.wait(timeout=max(soonest - now, 1e-4))
-            for r in expired:
+            for key, r in expired:
                 metrics.inc("serve.deadline_expired")
                 if not r.future.done():
                     r.future.set_exception(TimeoutError(
                         "serve request deadline expired before "
                         "dispatch"))
+                # a timeout is the worst-possible latency: it must land
+                # in the telemetry feed as an error sample, or SLO
+                # metrics read green exactly under overload (the
+                # survivorship bias this layer exists to remove)
+                self._observe_request(key, r, time.perf_counter(),
+                                      error=True)
             if expired:
                 with self._wake:
                     self._inflight -= len(expired)
@@ -555,6 +627,64 @@ class BatchQueue:
                 metric_prefix="serve.breaker")
         return cb
 
+    # -- telemetry seams ---------------------------------------------------
+
+    def _bucket_label(self, key: tuple) -> str:
+        """``"<dtype>.<dims>"`` of one executable bucket — the SLO
+        histogram / sentinel naming tail (``serve.latency_ms.posv.
+        fp32.n64``)."""
+        op = key[0]
+        dims = ("m%d_n%d" % (key[2], key[3])
+                if op in ("geqrf", "gels") else "n%d" % key[2])
+        return "%s.%s" % (_telemetry.short_dtype(key[1]), dims)
+
+    def _observe_request(self, key: tuple, req: _Request, t_done: float,
+                         error: bool = False, batch: int = 1) -> None:
+        """One resolved (or failed) request into the telemetry fan-out:
+        SLO histogram + violation counters, JSONL record, sentinel
+        sample.  No-op while telemetry is off; a telemetry failure must
+        NEVER kill the dispatcher loop (futures already resolved —
+        observability is strictly best-effort behind them)."""
+        op = key[0]
+        try:
+            _telemetry.observe_request(
+                op, self._bucket_label(key),
+                latency_s=t_done - req.t_submit,
+                slo_ms=self.config.slo_ms, error=error, batch=batch,
+                key=key, dtype=_telemetry.short_dtype(key[1]),
+                n=key[3] if op in ("geqrf", "gels") else key[2])
+        except Exception:
+            metrics.inc("telemetry.observe_errors")
+
+    def _on_sentinel_event(self, ev: dict) -> None:
+        """The live sentinel's opt-in trip path: a DEGRADATION event
+        for one of THIS queue's buckets opens that bucket's breaker
+        (subsequent dispatches run loop-of-singles on the safe backend
+        until the half-open re-probe) and quarantines the batched
+        driver's settled autotune winners.  Off unless
+        ``ServeConfig.sentinel_trip`` or ``SLATE_TPU_SENTINEL_TRIP=1``."""
+        if ev.get("classification") != "degradation":
+            return
+        key = ev.get("key")
+        if not key:
+            return
+        key = tuple(key)
+        if key not in self._breakers:
+            return                  # another queue's bucket
+        if not (self.config.sentinel_trip or _telemetry.trip_wanted()):
+            return
+        metrics.inc("serve.sentinel.trip")
+        self._breaker(key).trip()
+        try:
+            _health.quarantine_driver(
+                "%s_batched" % key[0],
+                reason="live sentinel: %s degradation in %s"
+                       % (ev.get("kind"), ev.get("bucket")))
+        except Exception:           # the trip must never kill the loop
+            metrics.inc("serve.sentinel.trip_errors")
+
+    # -- the dispatch ladder -----------------------------------------------
+
     def _dispatch(self, key: tuple, reqs: List[_Request]) -> None:
         """One bucket dispatch through the hardened ladder: breaker
         check → batched fast path (with classified retries) → on
@@ -566,49 +696,137 @@ class BatchQueue:
         metrics.observe("serve.batch.occupancy", float(len(reqs)))
         for r in reqs:
             metrics.observe_time("serve.wait", t0 - r.t_submit)
+        tele = _telemetry.enabled()
         cb = self._breaker(key)
         if not cb.allow():
             # open breaker: don't touch the failing fast path at all
             metrics.inc("serve.breaker.short_circuit")
-            self._dispatch_singles(key, reqs)
+            self._dispatch_singles(key, reqs, t_pop=t0)
             return
         try:
-            out = self._execute_batch(key, reqs)
+            out, t_exec = self._execute_batch(key, reqs)
         except Exception as e:      # one bad batch must not kill the loop
             cb.failure()
             metrics.inc("serve.errors")
             if transient_infra(e) or isinstance(e, _UnhealthyBatch):
+                # the singles fallback below records each request's ONE
+                # final outcome — only the dispatch-level error feeds
+                # the sentinel here (a per-request error record too
+                # would double-count every request in the report/hist
+                # and break the spans-sum==latency pin with a second
+                # queue_wait span)
+                if tele:
+                    try:
+                        op = key[0]
+                        _telemetry.observe_dispatch_error(
+                            op, self._bucket_label(key), key=key,
+                            dtype=_telemetry.short_dtype(key[1]),
+                            n=key[3] if op in ("geqrf", "gels")
+                            else key[2])
+                    except Exception:
+                        metrics.inc("telemetry.observe_errors")
                 metrics.inc("serve.fallback.singles")
-                self._dispatch_singles(key, reqs)
-            else:                   # real caller error: surface it
+                self._dispatch_singles(key, reqs, t_pop=t0)
+            else:                   # real caller error: surface it —
+                # this IS each request's final outcome, so the error
+                # spans/observations land here exactly once
+                t_err = time.perf_counter()
                 for r in reqs:
                     if not r.future.done():
                         r.future.set_exception(e)
+                    if tele:
+                        try:
+                            if r.trace_id is not None:
+                                _telemetry.record_span(
+                                    r.trace_id, "queue_wait",
+                                    r.t_submit, t0,
+                                    args={"op": key[0]})
+                                _telemetry.record_span(
+                                    r.trace_id, "dispatch", t0, t_err,
+                                    args={"op": key[0],
+                                          "error": type(e).__name__})
+                        except Exception:
+                            metrics.inc("telemetry.observe_errors")
+                        self._observe_request(key, r, t_err,
+                                              error=True,
+                                              batch=len(reqs))
             return
         cb.success()
         for i, r in enumerate(reqs):
+            resolved_ok = True
             try:
                 r.future.set_result(self._unpad(key, r, out, i))
             except Exception as e:
+                # unpad failure or an already-cancelled future: this
+                # request did NOT get a result — its telemetry sample
+                # must say so, not pollute the latency baseline
+                resolved_ok = False
                 if not r.future.done():
                     r.future.set_exception(e)
+            if tele:
+                # the request's span chain: queue_wait (submit → batch
+                # pop), dispatch (pad + execute), post_check (health
+                # gate + unpad + resolution) — contiguous, so their sum
+                # IS the future-observed latency (pinned in CI).  Like
+                # _observe_request, best-effort: the next request's
+                # future must resolve whatever telemetry does.
+                t_res = time.perf_counter()
+                try:
+                    if r.trace_id is not None:
+                        _telemetry.record_span(
+                            r.trace_id, "queue_wait", r.t_submit, t0,
+                            args={"op": key[0]})
+                        _telemetry.record_span(
+                            r.trace_id, "dispatch", t0, t_exec,
+                            args={"op": key[0], "batch": len(reqs)})
+                        _telemetry.record_span(
+                            r.trace_id, "post_check", t_exec, t_res,
+                            args={"op": key[0]})
+                except Exception:
+                    metrics.inc("telemetry.observe_errors")
+                self._observe_request(key, r, t_res,
+                                      error=not resolved_ok,
+                                      batch=len(reqs))
 
     def _execute_batch(self, key: tuple, reqs: List[_Request]) -> tuple:
         """The batched fast path: pad, execute the AOT executable,
         host-materialize.  Transient failures (classified injected
         faults, RPC-shaped errors, non-finite results under an active
         health mode) retry up to ``max_retries`` times with exponential
-        backoff; the last failure propagates to :meth:`_dispatch`."""
+        backoff; the last failure propagates to :meth:`_dispatch`.
+        Returns ``(out, t_exec)`` — the stamp taken the moment the
+        executable's result is host-materialized, so the telemetry
+        ``post_check`` span covers exactly the health gate + unpad +
+        resolution tail."""
         import numpy as np
 
         def attempt():
             kind = _inject.poll("serve.dispatch")
             if kind == "error":
                 raise _inject.InjectedFault("serve.dispatch")
+            if kind == "slow":
+                # the injected sustained-latency degradation the live
+                # sentinel classifies (ISSUE 10)
+                time.sleep(_inject.slow_seconds())
             bexec = _bucket(len(reqs), "pow2", floor=1)
             bexec = min(bexec, _bucket(self.config.max_batch, "pow2",
                                        floor=1))
-            ex, _ = self._get_executable(key, bexec)
+            tc0 = time.perf_counter()
+            ex, built = self._get_executable(key, bexec)
+            if built and reqs[0].trace_id is not None:
+                # an on-demand compile on the serving path — exactly
+                # what warm start eliminates — shows up as its own span
+                # on the batch's first request flow.  Guarded like
+                # every dispatcher-side telemetry call: a bare raise
+                # here would be classified non-transient and fail the
+                # whole batch's futures.
+                try:
+                    _telemetry.record_span(
+                        reqs[0].trace_id, "compile", tc0,
+                        time.perf_counter(),
+                        args={"op": key[0], "batch": bexec})
+                except Exception:
+                    metrics.inc("telemetry.observe_errors")
             stacked = self._pad_stack(key, reqs, bexec, np)
             with metrics.timer("serve.dispatch"):
                 out = ex(*stacked)
@@ -616,6 +834,7 @@ class BatchQueue:
                     out if isinstance(out, (tuple, list)) else (out,)))
             if kind in ("nan", "inf"):
                 out = _inject.corrupt_outputs(out, kind)
+            t_exec = time.perf_counter()
             if _health.mode() != "off" and not _finite_arrays(out):
                 # a poisoned batch must not resolve futures; treated as
                 # one (transient) dispatch failure so the retry /
@@ -623,26 +842,33 @@ class BatchQueue:
                 metrics.inc("serve.health.batch_nonfinite")
                 raise _UnhealthyBatch(
                     f"non-finite values in the {key[0]} batch result")
-            return out
+            return out, t_exec
 
         def _retryable(e: BaseException) -> bool:
             return transient_infra(e) or isinstance(e, _UnhealthyBatch)
 
-        out, _retries = with_backoff(
+        (out, t_exec), _retries = with_backoff(
             attempt, attempts=1 + max(0, self.config.max_retries),
             base_s=self.config.retry_backoff_s, classify=_retryable,
             metric="serve.retries")
-        return out
+        return out, t_exec
 
-    def _dispatch_singles(self, key: tuple, reqs: List[_Request]) -> None:
+    def _dispatch_singles(self, key: tuple, reqs: List[_Request],
+                          t_pop: Optional[float] = None) -> None:
         """The degraded path: each request solved ALONE through the
         batched driver facade at batch 1, eagerly (never the cached
         bucket executable — it may be the poisoned artifact) and on the
         safe stock backend.  Failures stay per-request: one bad problem
-        fails one future."""
+        fails one future.  Telemetry records a ``queue_wait`` +
+        ``dispatch_single`` span pair and the resolved latency per
+        request — degraded latencies must show in the same SLO
+        histograms the fast path feeds."""
         import numpy as np
 
         metrics.inc("serve.singles.batches")
+        tele = _telemetry.enabled()
+        if t_pop is None:
+            t_pop = time.perf_counter()
         fn = self._driver(key[0])
         with _health.safe_backend():
             for r in reqs:
@@ -654,6 +880,8 @@ class BatchQueue:
                     r.future.set_exception(TimeoutError(
                         "serve request deadline expired during "
                         "degraded dispatch"))
+                    self._observe_request(key, r, time.perf_counter(),
+                                          error=True)
                     continue
                 try:
                     stacked = self._pad_stack(key, [r], 1, np)
@@ -671,9 +899,27 @@ class BatchQueue:
                             "safe backend")
                     r.future.set_result(self._unpad(key, r, out, 0))
                     metrics.inc("serve.singles")
+                    if tele:
+                        t_res = time.perf_counter()
+                        try:
+                            if r.trace_id is not None:
+                                _telemetry.record_span(
+                                    r.trace_id, "queue_wait",
+                                    r.t_submit, t_pop,
+                                    args={"op": key[0]})
+                                _telemetry.record_span(
+                                    r.trace_id, "dispatch_single",
+                                    t_pop, t_res, args={"op": key[0]})
+                        except Exception:
+                            metrics.inc("telemetry.observe_errors")
+                        self._observe_request(key, r, t_res, batch=1)
                 except Exception as e:
                     if not r.future.done():
                         r.future.set_exception(e)
+                    if tele:
+                        self._observe_request(key, r,
+                                              time.perf_counter(),
+                                              error=True, batch=1)
 
     def _pad_stack(self, key: tuple, reqs: List[_Request], bexec: int,
                    np):

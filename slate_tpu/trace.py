@@ -38,6 +38,36 @@ _lock = threading.Lock()
 _enabled = False
 _origin = 0.0
 
+# ---------------------------------------------------------------------------
+# Lane naming: one STABLE, DISTINCT lane per thread.  Keying lanes by
+# thread NAME alone collapses spans when names collide — exactly what
+# happens with serve dispatcher threads (every BatchQueue names its
+# dispatcher "slate-serve-dispatch") and default "Thread-N" workers
+# across pools.  The first thread seen with a name keeps the bare name
+# (existing tests and artifacts stay unchanged); each further DISTINCT
+# ident with the same name gets "name#2", "name#3", ... — stable for
+# the thread's lifetime, regression-tested in test_trace_api.py.
+# ---------------------------------------------------------------------------
+
+_lane_by_ident: dict = {}       # ident -> (thread name, lane string)
+_lane_counts: dict = {}         # thread name -> distinct idents seen
+
+
+def current_lane() -> str:
+    """The calling thread's trace lane (see the lane-naming note
+    above).  Public: the telemetry request spans record through it so
+    serve spans and ``Block`` spans land in the same Perfetto track."""
+    t = threading.current_thread()
+    with _lock:
+        hit = _lane_by_ident.get(t.ident)
+        if hit is not None and hit[0] == t.name:
+            return hit[1]
+        k = _lane_counts.get(t.name, 0) + 1
+        _lane_counts[t.name] = k
+        lane = t.name if k == 1 else "%s#%d" % (t.name, k)
+        _lane_by_ident[t.ident] = (t.name, lane)
+        return lane
+
 
 def on() -> None:
     """Enable tracing — reference ``Trace::on()``."""
@@ -81,6 +111,11 @@ class Block:
 
     def __enter__(self):
         if _enabled:
+            if self._lane_arg is None:
+                # the disambiguated per-thread lane (colliding thread
+                # names must not collapse into one Perfetto track);
+                # resolved at ENTRY so the executing thread wins
+                self.lane = current_lane()
             if _JaxAnnotation is not None:
                 self._ann = _JaxAnnotation(self.name)
                 self._ann.__enter__()
@@ -181,7 +216,7 @@ def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
     stays the quick-look artifact).  Load the file at
     https://ui.perfetto.dev or ``chrome://tracing``.
 
-    The export merges two sources on one clock:
+    The export merges three sources on one clock:
 
     * every :class:`Block` span as a complete event (``"ph": "X"``),
       one Perfetto track per lane (thread-name metadata rides along);
@@ -193,12 +228,21 @@ def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
       achieved roofline fractions, fed by
       :func:`slate_tpu.perf.attr.record_rooflines`) get their own
       ``"roofline"`` category so Perfetto's track filter isolates the
-      gap-report view with one query.
+      gap-report view with one query;
+    * the live-telemetry request spans
+      (:func:`slate_tpu.perf.telemetry.drain_spans`: ``queue_wait`` /
+      ``dispatch`` / ``compile`` / ``post_check`` per served request)
+      as complete events under category ``"serve.request"`` — one lane
+      per dispatcher thread — joined by FLOW events (``"ph": "s"`` /
+      ``"t"`` / ``"f"``, flow id = the request's trace id, the value
+      on ``future.trace_id``) so ui.perfetto.dev draws one arrowed
+      chain per request across lanes.
 
     Returns the file path (``trace_<epoch>.perfetto.json`` by default)
-    or None when there is nothing to export.  Consumes both the event
-    buffer and the registry's sample buffer (counter VALUES keep
-    accumulating — only the time series is drained).
+    or None when there is nothing to export.  Consumes the event
+    buffer, the registry's sample buffer (counter VALUES keep
+    accumulating — only the time series is drained) and the telemetry
+    span buffer.
     """
 
     origin = _origin
@@ -210,22 +254,32 @@ def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
         samples = _metrics.drain_samples()
     except Exception:       # pragma: no cover - metrics must never block
         samples = []
-    if not evts and not samples:
+    try:
+        from .perf import telemetry as _telemetry
+
+        req_spans = _telemetry.drain_spans()
+    except Exception:       # pragma: no cover - telemetry must never block
+        req_spans = []
+    if not evts and not samples and not req_spans:
         return None
     # one clock: events store times relative to the trace origin;
-    # samples carry absolute perf_counter stamps.  Samples recorded
-    # BEFORE trace.on() set the origin (metrics enabled first) must not
-    # go negative — the earliest of (origin, first sample) anchors t=0,
-    # with block-event timestamps shifted by the same amount.
+    # samples and request spans carry absolute perf_counter stamps.
+    # Stamps recorded BEFORE trace.on() set the origin (metrics enabled
+    # first) must not go negative — the earliest of (origin, first
+    # stamp) anchors t=0, with block-event timestamps shifted by the
+    # same amount.
     shift = 0.0
-    if samples:
-        first = min(ts for ts, _, _ in samples)
+    absolute = [ts for ts, _, _ in samples] \
+        + [sp[2] for sp in req_spans]
+    if absolute:
+        first = min(absolute)
         if not origin:
             origin = first
         elif first < origin:
             shift = origin - first      # added to every block event
             origin = first
-    lanes = sorted({e.lane for e in evts})
+    lanes = sorted({e.lane for e in evts}
+                   | {sp[4] for sp in req_spans})
     tids = {lane: i for i, lane in enumerate(lanes)}
     out = []
     for lane, tid in tids.items():
@@ -236,6 +290,32 @@ def finish_perfetto(path: Optional[str] = None) -> Optional[str]:
                     "ts": round((e.start + shift) * 1e6, 3),
                     "dur": round(max(e.stop - e.start, 0.0) * 1e6, 3),
                     "pid": 0, "tid": tids[e.lane]})
+    # request spans: X events + flow arrows joining each trace id's
+    # chain.  Flow binding points sit at each span's midpoint so they
+    # land strictly inside the slice they bind to.
+    flows: dict = {}
+    for trace_id, name, t0, t1, lane, args in req_spans:
+        span_args = {"trace_id": trace_id}
+        if args:
+            span_args.update(args)
+        out.append({"name": name, "cat": "serve.request", "ph": "X",
+                    "ts": round((t0 - origin) * 1e6, 3),
+                    "dur": round(max(t1 - t0, 0.0) * 1e6, 3),
+                    "pid": 0, "tid": tids[lane],
+                    "args": span_args})
+        flows.setdefault(trace_id, []).append((t0, t1, lane))
+    for trace_id, chain in flows.items():
+        if len(chain) < 2:
+            continue                    # an arrow needs two ends
+        chain.sort()
+        for i, (t0, t1, lane) in enumerate(chain):
+            ph = "s" if i == 0 else ("f" if i == len(chain) - 1 else "t")
+            fev = {"name": "request", "cat": "serve.request", "ph": ph,
+                   "id": trace_id, "pid": 0, "tid": tids[lane],
+                   "ts": round(((t0 + t1) / 2.0 - origin) * 1e6, 3)}
+            if ph == "f":
+                fev["bp"] = "e"
+            out.append(fev)
     for ts, name, value in samples:
         cat = "roofline" if name.startswith("roofline.") else "metrics"
         out.append({"name": name, "cat": cat, "ph": "C",

@@ -94,6 +94,56 @@ def test_serve_never_imports_ops_layer():
         "the autotune table):\n" + "\n".join(offenders))
 
 
+def test_telemetry_and_serve_use_public_metrics_api_only():
+    """ISSUE 10 guard: the telemetry module lives in perf/ (so the
+    general private-access scan above exempts it) but it is a CONSUMER
+    of the registry like serve/, not part of it — both must reach
+    metrics only through the public facade."""
+    offenders = []
+    paths = [_PKG / "perf" / "telemetry.py"] \
+        + sorted((_PKG / "serve").rglob("*.py"))
+    for path in paths:
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _METRICS_PRIVATE_RE.search(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "telemetry/serve reached the private metrics registry surface "
+        "(use metrics.inc/observe/hist_quantiles/... instead):\n"
+        + "\n".join(offenders))
+
+
+def test_telemetry_exporters_never_started_by_import():
+    """ISSUE 10 guard: importing the telemetry/serve modules — even
+    with every exporter env knob SET — must not bind a socket or spawn
+    exporter/log threads.  Only the front door's constructor
+    (telemetry.maybe_start) or an explicit start may.  Run in a
+    subprocess so this process's own exporters can't contaminate."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    code = (
+        "import threading\n"
+        "import slate_tpu.perf.telemetry, slate_tpu.serve\n"
+        "bad = [t.name for t in threading.enumerate()\n"
+        "       if t.name.startswith('slate-telemetry')]\n"
+        "assert not bad, bad\n"
+        "from slate_tpu.perf import telemetry\n"
+        "assert telemetry.exporter_port() is None\n"
+        "print('OK')\n")
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_METRICS_PORT="0",
+                   SLATE_TPU_TELEMETRY_LOG=os.path.join(td, "t.jsonl"),
+                   SLATE_TPU_TELEMETRY="1")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
 def test_multi_backend_sites_populate_autotune_table():
     """Exercising each tunable op site must leave a decision entry —
     proof the site consults the table rather than hard-coding a

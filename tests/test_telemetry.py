@@ -1,0 +1,698 @@
+"""Live serving telemetry (ISSUE 10): per-request trace-id propagation
+under concurrent submitters, SLO histogram percentile math vs a
+sorted-sample oracle, Prometheus scrape round-trip over a real socket,
+the rotating JSONL log, the live sentinel firing on an injected
+slowdown (with the opt-in breaker trip), Perfetto flow export, and the
+off-by-default zero-overhead / bit-identity pins."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from slate_tpu import trace
+from slate_tpu.perf import autotune, metrics, telemetry
+from slate_tpu.resilience import inject
+from slate_tpu.serve.queue import BatchQueue, ServeConfig, _bucket
+
+SPAN_NAMES = ("queue_wait", "dispatch", "post_check")
+
+
+@pytest.fixture(autouse=True)
+def _fresh(tmp_path, monkeypatch):
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    for knob in ("SLATE_TPU_TELEMETRY", "SLATE_TPU_METRICS_PORT",
+                 "SLATE_TPU_TELEMETRY_LOG", "SLATE_TPU_SLO_MS",
+                 "SLATE_TPU_SENTINEL_TRIP", "SLATE_TPU_FAULT_INJECT"):
+        monkeypatch.delenv(knob, raising=False)
+    autotune.reset_table()
+    was_m, was_t = metrics.enabled(), telemetry.enabled()
+    metrics.on()
+    metrics.reset()
+    telemetry.on()
+    telemetry.drain_spans()
+    telemetry.configure_sentinel()
+    yield
+    telemetry.close()
+    telemetry.stop_exporter()
+    telemetry.drain_spans()
+    telemetry.configure_sentinel()
+    trace.clear()
+    metrics.reset()
+    if not was_t:
+        telemetry.off()
+    if not was_m:
+        metrics.off()
+    inject.clear_plan()
+    autotune.reset_table()
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    return g @ g.T + n * np.eye(n, dtype=np.float32)
+
+
+def _spans_by_id():
+    out = {}
+    for tid, name, t0, t1, lane, args in telemetry.spans():
+        out.setdefault(tid, []).append((name, t0, t1, lane, args))
+    return out
+
+
+class TestTraceIdPropagation:
+    def test_trace_ids_under_four_concurrent_submitters(self):
+        """Each of 4 threads' requests keeps its own trace id through
+        bucket → pad → dispatch → resolution; every id carries the
+        full queue_wait/dispatch/post_check chain whose sum is the
+        future-observed latency (the acceptance tolerance)."""
+        srv = BatchQueue(ServeConfig(max_batch=4, max_wait_s=0.002))
+        n = 16
+        spd = _spd(n)
+        rhs = np.ones(n, np.float32)
+        srv.submit("posv", spd, rhs).result(timeout=300)     # warm
+        telemetry.drain_spans()
+
+        per_thread = 3
+        futs = [[None] * per_thread for _ in range(4)]
+        t_sub = [[None] * per_thread for _ in range(4)]
+        t_done = [[None] * per_thread for _ in range(4)]
+
+        def worker(k):
+            for i in range(per_thread):
+                t_sub[k][i] = time.perf_counter()
+                f = srv.submit("posv", spd, rhs)
+
+                def _cb(fut, k=k, i=i):
+                    t_done[k][i] = time.perf_counter()
+
+                f.add_done_callback(_cb)
+                futs[k][i] = f
+                f.result(timeout=300)
+
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        srv.close()
+
+        ids = [futs[k][i].trace_id for k in range(4)
+               for i in range(per_thread)]
+        assert all(isinstance(x, int) for x in ids)
+        assert len(set(ids)) == 12, "trace ids must be unique"
+        chains = _spans_by_id()
+        for k in range(4):
+            for i in range(per_thread):
+                tid = futs[k][i].trace_id
+                assert tid in chains, "no spans for trace id %s" % tid
+                names = [s[0] for s in chains[tid]]
+                for want in SPAN_NAMES:
+                    assert want in names, (tid, names)
+                span_sum = sum(t1 - t0 for name, t0, t1, _, _
+                               in chains[tid] if name in SPAN_NAMES)
+                measured = t_done[k][i] - t_sub[k][i]
+                assert abs(span_sum - measured) \
+                    <= 0.05 + 0.10 * measured, \
+                    ("per-request spans must sum to the future-"
+                     "observed latency", span_sum, measured)
+
+    def test_spans_are_contiguous_and_on_dispatcher_lane(self):
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        f = srv.submit("posv", _spd(n), np.ones(n, np.float32))
+        f.result(timeout=300)
+        srv.close()
+        chain = sorted(
+            (s for s in _spans_by_id()[f.trace_id]
+             if s[0] in SPAN_NAMES), key=lambda s: s[1])
+        assert [s[0] for s in chain] == list(SPAN_NAMES)
+        for a, b in zip(chain, chain[1:]):
+            assert abs(a[2] - b[1]) < 1e-6, "spans must be contiguous"
+        lanes = {s[3] for s in chain}
+        assert len(lanes) == 1
+        assert next(iter(lanes)).startswith("slate-serve-dispatch")
+
+    def test_no_trace_ids_when_telemetry_off(self):
+        telemetry.off()
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        f = srv.submit("posv", _spd(n), np.ones(n, np.float32))
+        f.result(timeout=300)
+        srv.close()
+        assert not hasattr(f, "trace_id")
+        assert telemetry.spans() == []
+
+
+class TestHistogramQuantiles:
+    def test_quantiles_vs_sorted_sample_oracle(self):
+        rng = np.random.default_rng(5)
+        vals = np.exp(rng.normal(2.0, 1.5, size=500)).tolist()
+        name = "test.latency_q"
+        for v in vals:
+            metrics.observe(name, v)
+        qs = metrics.hist_quantiles(name, (0.5, 0.95, 0.99))
+        s = sorted(vals)
+        for q, est in qs.items():
+            oracle = s[min(len(s) - 1, int(np.ceil(q * len(s))) - 1)]
+            # log2 buckets: the estimate lands in the oracle's bucket,
+            # i.e. within a factor of two of the exact order statistic
+            assert oracle / 2.0 <= est <= oracle * 2.0, (q, est, oracle)
+
+    def test_quantiles_monotone_and_bounded(self):
+        name = "test.latency_mono"
+        for v in (1.0, 2.0, 4.0, 80.0, 90.0, 100.0):
+            metrics.observe(name, v)
+        qs = metrics.hist_quantiles(name, (0.5, 0.95, 0.99))
+        assert qs[0.5] <= qs[0.95] <= qs[0.99] <= 128.0
+
+    def test_empty_and_unknown_hist(self):
+        assert metrics.hist_quantiles("never.recorded") == {}
+        assert metrics.quantiles_from_buckets(None) == {}
+        assert metrics.quantiles_from_buckets({"buckets": {}}) == {}
+
+    def test_bucket_bounds(self):
+        assert metrics.bucket_bounds("le_0") == (0.0, 0.0)
+        assert metrics.bucket_bounds("le_2^3") == (4.0, 8.0)
+        assert metrics.bucket_bounds("le_2^-1") == (0.25, 0.5)
+        assert metrics.bucket_bounds("nonsense") is None
+
+
+class TestSLOHistograms:
+    def test_latency_histogram_and_slo_violations(self):
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002,
+                                     slo_ms=0.0001))
+        n = 16
+        for _ in range(3):
+            srv.submit("posv", _spd(n), np.ones(n, np.float32)) \
+               .result(timeout=300)
+        srv.close()
+        snap = metrics.snapshot()
+        hname = "serve.latency_ms.posv.fp32.n%d" % _bucket(n)
+        assert snap["hists"][hname]["count"] == 3
+        # a 100 ns SLO: every CPU request violates
+        assert snap["counters"]["serve.slo.violations"] == 3
+        assert snap["counters"]["serve.slo.violations.posv"] == 3
+
+    def test_env_slo_fallback(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_SLO_MS", "0.0001")
+        assert telemetry.default_slo_ms() == 0.0001
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        srv.submit("posv", _spd(n), np.ones(n, np.float32)) \
+           .result(timeout=300)
+        srv.close()
+        assert metrics.snapshot()["counters"][
+            "serve.slo.violations"] == 1
+
+
+class TestPrometheusExporter:
+    def test_scrape_round_trip_over_real_socket(self):
+        metrics.inc("serve.requests", 5)
+        for v in (1.0, 3.0, 200.0):
+            metrics.observe("serve.latency_ms.posv.fp32.n16", v)
+        port = telemetry.start_exporter(0)
+        assert telemetry.exporter_port() == port
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % port, timeout=30) \
+            .read().decode()
+        assert "slate_tpu_serve_requests 5" in body
+        mn = "slate_tpu_serve_latency_ms_posv_fp32_n16"
+        # cumulative histogram series + count/sum + quantile gauges
+        lines = [ln for ln in body.splitlines() if ln.startswith(mn)]
+        cums = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+                if "_bucket{le=" in ln and "+Inf" not in ln]
+        assert cums == sorted(cums) and cums[-1] == 3
+        assert "%s_bucket{le=\"+Inf\"} 3" % mn in body
+        assert "%s_count 3" % mn in body
+        assert '%s_quantile{quantile="0.99"}' % mn in body
+
+    def test_404_off_path_and_idempotent_start(self):
+        port = telemetry.start_exporter(0)
+        assert telemetry.start_exporter(0) == port
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/nope" % port, timeout=30)
+
+
+class TestJsonlLog:
+    def test_records_flush_on_interval_and_at_close(self, tmp_path):
+        path = str(tmp_path / "tel.jsonl")
+        telemetry.start_log(path, flush_s=0.05)
+        telemetry.log_record("request", op="posv", latency_ms=1.5)
+        time.sleep(0.3)
+        telemetry.log_record("request", op="posv", latency_ms=2.5)
+        telemetry.close()       # final flush, no interval wait needed
+        recs = [json.loads(ln) for ln in open(path)]
+        reqs = [r for r in recs if r["kind"] == "request"]
+        assert [r["latency_ms"] for r in reqs] == [1.5, 2.5]
+        assert all("t" in r for r in recs)
+        # interval flushes append snapshot records
+        assert any(r["kind"] == "snapshot" for r in recs)
+
+    def test_rotation_keeps_one_sibling(self, tmp_path):
+        path = str(tmp_path / "rot.jsonl")
+        telemetry.start_log(path, flush_s=30.0, max_mb=0.001)  # ~1 KB
+        for i in range(40):
+            telemetry.log_record("request", op="posv", i=i,
+                                 pad="x" * 64)
+            if i % 10 == 9:
+                telemetry._flush_log()
+        telemetry.close()
+        assert (tmp_path / "rot.jsonl.1").exists()
+        # both generations parse; no record is lost across the
+        # rotation boundary (the tail lives in one of the two)
+        recs = [json.loads(ln)
+                for fp in (path + ".1", path) for ln in open(fp)]
+        reqs = [r for r in recs if r["kind"] == "request"]
+        assert reqs[-1]["i"] == 39
+
+    def test_serve_requests_stream_into_log(self, tmp_path):
+        path = str(tmp_path / "serve.jsonl")
+        telemetry.start_log(path, flush_s=30.0)
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        srv.submit("posv", _spd(n), np.ones(n, np.float32)) \
+           .result(timeout=300)
+        srv.close()
+        telemetry.close()
+        recs = [json.loads(ln) for ln in open(path)]
+        req = next(r for r in recs if r["kind"] == "request")
+        assert req["op"] == "posv" and req["latency_ms"] > 0
+        assert req["bucket"] == "fp32.n%d" % _bucket(n)
+
+
+class TestLiveSentinel:
+    def test_sustained_latency_rise_fires_exactly_once(self):
+        s = telemetry.LiveSentinel(baseline=8, window=4,
+                                   threshold_pct=50, cooldown_s=60)
+        for _ in range(8):
+            assert s.observe("posv", "fp32.n64", 0.010, batch=4,
+                             n=64) is None
+        evs = [s.observe("posv", "fp32.n64", 0.200, batch=4, n=64)
+               for _ in range(8)]
+        fired = [e for e in evs if e is not None]
+        assert len(fired) == 1, "one sustained drop → exactly one event"
+        ev = fired[0]
+        assert ev["classification"] == "degradation"
+        assert ev["kind"] == "latency"
+        assert ev["rise_pct"] > 50
+        # the attribution block rides along (attr.attribute_live)
+        att = ev.get("attribution")
+        assert att and att["label"] == "posv_batched_fp32_n64_b4"
+        assert att["bottlenecks"]
+        assert metrics.snapshot()["counters"][
+            "telemetry.sentinel.degradation"] == 1
+
+    def test_error_burst_classified_infra_not_degradation(self):
+        s = telemetry.LiveSentinel(baseline=8, window=4,
+                                   threshold_pct=50, cooldown_s=60)
+        for _ in range(8):
+            s.observe("gesv", "fp32.n32", 0.010)
+        fired = [e for e in (s.observe("gesv", "fp32.n32", 0.010,
+                                       error=True) for _ in range(4))
+                 if e]
+        assert len(fired) == 1
+        assert fired[0]["classification"] == "infra"
+        assert fired[0]["kind"] == "errors"
+
+    def test_single_blip_does_not_fire(self):
+        s = telemetry.LiveSentinel(baseline=8, window=4,
+                                   threshold_pct=50, cooldown_s=60)
+        for _ in range(8):
+            assert s.observe("posv", "fp32.n64", 0.010) is None
+        # one slow sample inside a fast window: median barely moves
+        assert s.observe("posv", "fp32.n64", 0.500) is None
+        for _ in range(4):
+            assert s.observe("posv", "fp32.n64", 0.010) is None
+        assert s.events == []
+
+    def test_throughput_drop_kind(self):
+        s = telemetry.LiveSentinel(baseline=8, window=4,
+                                   threshold_pct=50, cooldown_s=60)
+        for _ in range(8):
+            s.observe("posv", "fp32.n64", 0.010, batch=16)
+        # same latency, occupancy collapsed: solves/s fell 16×
+        fired = [e for e in (s.observe("posv", "fp32.n64", 0.010,
+                                       batch=1) for _ in range(4)) if e]
+        assert len(fired) == 1 and fired[0]["kind"] == "throughput"
+
+
+class TestSentinelServeIntegration:
+    def _run_baseline(self, srv, spd, rhs, count):
+        for _ in range(count):
+            srv.submit("posv", spd, rhs).result(timeout=300)
+
+    def test_injected_slowdown_fires_one_degradation(self, monkeypatch):
+        """The acceptance path: a threaded serve workload under a
+        SLATE_TPU_FAULT_INJECT slowdown produces exactly one live
+        degradation event with the correct classification, a Perfetto
+        trace whose flow spans join on the future's trace id, and a
+        Prometheus scrape exposing the p99 histogram."""
+        telemetry.configure_sentinel(baseline=6, window=3,
+                                     threshold_pct=50, cooldown_s=300)
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        spd, rhs = _spd(n), np.ones(n, np.float32)
+        self._run_baseline(srv, spd, rhs, 8)
+        monkeypatch.setenv("SLATE_TPU_FAULT_SLOW_S", "0.2")
+        inject.install(inject.FaultPlan(seed=3).add(
+            "serve.dispatch", "slow", rate=1.0))
+        futs = []
+        threads = [threading.Thread(
+            target=lambda: futs.append(srv.submit("posv", spd, rhs)))
+            for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in list(futs):
+            f.result(timeout=300)
+        inject.clear_plan()
+        evs = telemetry.sentinel().events
+        assert len(evs) == 1, evs
+        assert evs[0]["classification"] == "degradation"
+        assert evs[0]["kind"] == "latency"
+        assert evs[0]["op"] == "posv"
+        # Prometheus: the p99 of the degraded histogram is scrapeable
+        port = telemetry.start_exporter(0)
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % port, timeout=30) \
+            .read().decode()
+        mn = "slate_tpu_serve_latency_ms_posv_fp32_n%d" % _bucket(n)
+        assert ("%s_quantile{quantile=\"0.99\"}" % mn) in body
+        # Perfetto: flow events join the request chain on the trace id
+        sample = futs[0]
+        path = trace.finish_perfetto("/tmp/_tel_accept.perfetto.json")
+        d = json.load(open(path))
+        flows = [e for e in d["traceEvents"]
+                 if e["ph"] in ("s", "t", "f")
+                 and e["id"] == sample.trace_id]
+        assert any(e["ph"] == "s" for e in flows) \
+            and any(e["ph"] == "f" for e in flows)
+        xs = [e for e in d["traceEvents"] if e["ph"] == "X"
+              and e.get("args", {}).get("trace_id") == sample.trace_id]
+        assert {e["name"] for e in xs} >= set(SPAN_NAMES)
+        srv.close()
+
+    def test_opt_in_trip_opens_breaker_and_serves_singles(self,
+                                                          monkeypatch):
+        telemetry.configure_sentinel(baseline=6, window=3,
+                                     threshold_pct=50, cooldown_s=300)
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002,
+                                     breaker_cooldown_s=3600.0,
+                                     sentinel_trip=True))
+        n = 16
+        spd, rhs = _spd(n), np.ones(n, np.float32)
+        self._run_baseline(srv, spd, rhs, 8)
+        key = srv.bucket_key("posv", (spd, rhs))
+        monkeypatch.setenv("SLATE_TPU_FAULT_SLOW_S", "0.2")
+        inject.install(inject.FaultPlan(seed=3).add(
+            "serve.dispatch", "slow", rate=1.0))
+        self._run_baseline(srv, spd, rhs, 3)
+        inject.clear_plan()
+        assert telemetry.sentinel().events, "sentinel must have fired"
+        assert srv._breakers[key].state == "open"
+        c = metrics.snapshot()["counters"]
+        assert c.get("serve.sentinel.trip", 0) >= 1
+        assert c.get("serve.breaker.tripped", 0) >= 1
+        # the open breaker degrades the NEXT dispatch to safe singles —
+        # and the future still resolves correctly
+        x = np.asarray(srv.submit("posv", spd, rhs).result(timeout=300))
+        eps = float(np.finfo(np.float32).eps)
+        assert (np.linalg.norm(spd @ x - rhs)
+                / (np.linalg.norm(spd) * np.linalg.norm(rhs)
+                   * eps * n)) < 3
+        assert metrics.snapshot()["counters"][
+            "serve.breaker.short_circuit"] >= 1
+        srv.close()
+
+    def test_no_trip_without_opt_in(self, monkeypatch):
+        telemetry.configure_sentinel(baseline=6, window=3,
+                                     threshold_pct=50, cooldown_s=300)
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        spd, rhs = _spd(n), np.ones(n, np.float32)
+        self._run_baseline(srv, spd, rhs, 8)
+        key = srv.bucket_key("posv", (spd, rhs))
+        monkeypatch.setenv("SLATE_TPU_FAULT_SLOW_S", "0.2")
+        inject.install(inject.FaultPlan(seed=3).add(
+            "serve.dispatch", "slow", rate=1.0))
+        self._run_baseline(srv, spd, rhs, 3)
+        inject.clear_plan()
+        assert telemetry.sentinel().events
+        assert srv._breakers[key].state == "closed", \
+            "without the opt-in an event must only observe, not act"
+        srv.close()
+
+
+class TestReviewRegressions:
+    """Pins for the r10 review findings: single-count accounting on
+    the singles fallback, deadline-expiry telemetry samples, and the
+    dropped-queue hook leak."""
+
+    def test_transient_fallback_counts_each_request_once(self):
+        """A transient dispatch failure recovered by loop-of-singles
+        must record ONE final outcome per request — one histogram
+        sample, one queue_wait span — with the dispatch error feeding
+        only the sentinel's error channel."""
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002,
+                                     max_retries=0))
+        n = 16
+        inject.install(inject.FaultPlan(seed=2).add(
+            "serve.dispatch", "error", rate=1.0, count=1))
+        f = srv.submit("posv", _spd(n), np.ones(n, np.float32))
+        x = np.asarray(f.result(timeout=300))
+        inject.clear_plan()
+        srv.close()
+        assert x.shape == (n,)
+        snap = metrics.snapshot()
+        hname = "serve.latency_ms.posv.fp32.n%d" % _bucket(n)
+        assert snap["hists"][hname]["count"] == 1, \
+            "the recovered request must not be double-counted"
+        assert snap["counters"]["telemetry.dispatch.errors"] == 1
+        chain = _spans_by_id()[f.trace_id]
+        names = [s[0] for s in chain]
+        assert names.count("queue_wait") == 1, names
+        assert names.count("dispatch_single") == 1, names
+
+    def test_deadline_expiry_lands_as_error_sample_and_slo_violation(
+            self):
+        """A timed-out request is the worst-possible latency: it must
+        land in the telemetry feed as an error sample AND count as an
+        SLO violation, not vanish (survivorship bias under overload —
+        100% timeouts must not read as perfect SLO compliance)."""
+        srv = BatchQueue(ServeConfig(max_batch=8, max_wait_s=0.05,
+                                     slo_ms=1000.0))
+        n = 16
+        f = srv.submit("posv", _spd(n), np.ones(n, np.float32),
+                       deadline_s=0.0)
+        with pytest.raises(TimeoutError):
+            f.result(timeout=300)
+        srv.close()
+        c = metrics.snapshot()["counters"]
+        assert c["telemetry.request.errors"] == 1
+        assert c["serve.slo.violations"] == 1
+
+    def test_dropped_queue_without_close_is_collectable(self):
+        """close() is documented as polite, not required: the sentinel
+        hook must not pin a dropped BatchQueue forever through the
+        module-global hook list."""
+        import gc
+        import weakref
+
+        q = BatchQueue()
+        ref = weakref.ref(q)
+        del q
+        gc.collect()
+        assert ref() is None, \
+            "sentinel hook registration leaked the queue"
+
+    def test_bench_serve_restores_metrics_opt_out(self):
+        import bench
+
+        metrics.off()
+        telemetry.off()
+        try:
+            bench.bench_serve(False, n=16, nreq=4, max_batch=2)
+            assert not metrics.enabled(), \
+                "bench_serve must not override a metrics opt-out"
+            assert not telemetry.enabled()
+        finally:
+            metrics.on()
+            telemetry.on()
+
+
+class TestSlowFaultKind:
+    def test_parse_and_poll(self):
+        plan = inject.parse_plan("serve.dispatch=slow:1.0", seed=9)
+        assert plan.poll("serve.dispatch") == "slow"
+
+    def test_slow_seconds_env(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_FAULT_SLOW_S", "0.123")
+        assert inject.slow_seconds() == 0.123
+        monkeypatch.setenv("SLATE_TPU_FAULT_SLOW_S", "junk")
+        assert inject.slow_seconds() == 0.05
+
+    def test_fault_here_sleeps_instead_of_raising(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_FAULT_SLOW_S", "0.05")
+        inject.install(inject.FaultPlan(seed=1).add(
+            "bench.startup", "slow", rate=1.0))
+        t0 = time.perf_counter()
+        assert inject.fault_here("bench.startup") is None
+        assert time.perf_counter() - t0 >= 0.04
+        inject.clear_plan()
+
+
+class TestOffByDefault:
+    def test_lowered_text_bit_identical_with_telemetry_on(self):
+        """Telemetry is host-side only: the traced/compiled program of
+        a batched driver is byte-identical whether telemetry is on or
+        off (the PR 4 contract extended to ISSUE 10's knobs)."""
+        import jax
+
+        from slate_tpu.linalg import batched
+
+        a = np.stack([_spd(8, seed=s) for s in range(2)])
+
+        def lower():
+            return jax.jit(
+                lambda x: batched.potrf_batched(x)).lower(a).as_text()
+
+        telemetry.off()
+        base = lower()
+        telemetry.on()
+        assert lower() == base
+        telemetry.configure_sentinel(baseline=2, window=2)
+        assert lower() == base
+
+    def test_submit_path_records_nothing_when_off(self):
+        telemetry.off()
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+        n = 16
+        srv.submit("posv", _spd(n), np.ones(n, np.float32)) \
+           .result(timeout=300)
+        srv.close()
+        snap = metrics.snapshot()
+        assert not any(k.startswith("serve.latency_ms")
+                       for k in snap["hists"])
+        assert "serve.slo.violations" not in snap["counters"]
+        assert telemetry.spans() == []
+        assert telemetry.sentinel().events == []
+
+    def test_observe_request_is_noop_when_off(self):
+        telemetry.off()
+        telemetry.observe_request("posv", "fp32.n16", 0.001,
+                                  slo_ms=0.0001)
+        assert metrics.snapshot()["hists"] == {}
+
+
+class TestRegressDirection:
+    def test_serve_percentiles_judged_lower_is_better(self):
+        from slate_tpu.perf import regress
+
+        assert regress.direction("serve_posv_fp32_n256_p99_ms") == -1.0
+        assert regress.direction("serve_posv_fp32_n256_p50_ms") == -1.0
+        assert regress.direction("posv_batched_fp32_n256_b64"
+                                 "_solves_per_s") == 1.0
+        assert regress.direction("getrf_fp32_n8192") == 1.0
+
+    def test_percentile_rows_have_no_gemm_fraction(self):
+        from slate_tpu.perf import regress
+
+        rep = regress.Report(rows=[], artifacts=[], threshold_pct=5.0)
+        assert regress.frac_of_gemm(
+            rep, "serve_posv_fp32_n256_p99_ms") is None
+
+
+class TestBenchServeRoutine:
+    def test_bench_serve_emits_percentile_submetrics(self):
+        import bench
+
+        label, gf, resid, extra = bench.bench_serve(
+            False, n=24, nreq=8, max_batch=4)
+        assert label == "serve_posv_fp32_n24"
+        assert gf > 0 and resid < 3
+        assert extra["serve_posv_fp32_n24_p50_ms"] > 0
+        assert extra["serve_posv_fp32_n24_p99_ms"] \
+            >= extra["serve_posv_fp32_n24_p50_ms"]
+
+
+class TestHealthQuarantineHook:
+    def test_quarantine_driver_public_wrapper(self):
+        from slate_tpu.resilience import health
+
+        # no timed/cached decisions on a fresh table: nothing demotable
+        assert health.quarantine_driver(
+            "posv_batched", reason="test") == 0
+
+
+class TestTelemetryReportCLI:
+    """tools/telemetry_report.py: stdlib-only, by-path loadable, never
+    imports jax (driven under a jax-poisoned PYTHONPATH like the
+    bench_diff tests)."""
+
+    def _write_log(self, path):
+        recs = (
+            [{"t": 100.0 + i, "kind": "request", "op": "posv",
+              "bucket": "fp32.n256", "latency_ms": 2.0 + i,
+              "error": False, "slo_violation": i > 6, "batch": 4}
+             for i in range(10)]
+            + [{"t": 105.0, "kind": "request", "op": "posv",
+                "bucket": "fp32.n256", "latency_ms": 0.0,
+                "error": True, "slo_violation": False, "batch": 4},
+               {"t": 111.0, "kind": "sentinel",
+                "event": {"classification": "degradation",
+                          "kind": "latency", "op": "posv",
+                          "bucket": "fp32.n256", "rise_pct": 120.0}},
+               {"t": 112.0, "kind": "snapshot",
+                "counters": {"serve.requests": 11.0}}])
+        with open(path, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            f.write("not json — a live log may be mid-write\n")
+
+    def _run(self, tmp_path, *args):
+        import os
+        import subprocess
+        import sys
+
+        poison = tmp_path / "poison"
+        (poison / "jax").mkdir(parents=True, exist_ok=True)
+        (poison / "jax" / "__init__.py").write_text(
+            "raise ImportError('offline tool must not import jax')")
+        env = dict(os.environ, PYTHONPATH=str(poison) + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        cli = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "telemetry_report.py")
+        return subprocess.run([sys.executable, cli, *args],
+                              capture_output=True, text=True, env=env,
+                              timeout=120)
+
+    def test_tables_with_slo_and_sentinel(self, tmp_path):
+        log = str(tmp_path / "serve.jsonl")
+        self._write_log(log)
+        r = self._run(tmp_path, log)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "posv" in r.stdout and "fp32.n256" in r.stdout
+        assert "degradation" in r.stdout
+        assert "serve.requests" in r.stdout
+        assert "1 malformed line(s) skipped" in r.stdout
+
+    def test_json_and_strict_exit(self, tmp_path):
+        log = str(tmp_path / "serve.jsonl")
+        self._write_log(log)
+        r = self._run(tmp_path, log, "--json")
+        blob = json.loads(r.stdout)
+        row = blob["rows"][0]
+        # exact percentiles from the raw values + counted outcomes
+        assert row["count"] == 11 and row["errors"] == 1
+        assert row["slo_violations"] == 3
+        assert abs(row["p50_ms"] - 6.5) < 1e-9
+        assert blob["degradations"] == 1
+        assert self._run(tmp_path, log, "--strict").returncode == 1

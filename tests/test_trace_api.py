@@ -78,6 +78,59 @@ def test_trace_decorator_lane_resolved_at_call_time():
     assert evts[0].lane == "worker-lane-7"
 
 
+def test_colliding_thread_names_get_distinct_stable_lanes():
+    """ISSUE 10 satellite: two live threads SHARING a name (e.g. two
+    BatchQueues' dispatcher threads, both named "slate-serve-dispatch")
+    must land in distinct, stably-named lanes — before the fix their
+    spans collapsed into one Perfetto track."""
+    import json
+    import threading
+
+    trace.clear()
+    trace.on()
+    bar = threading.Barrier(2, timeout=30)
+    done = threading.Barrier(3, timeout=30)
+
+    def work():
+        bar.wait()                  # both alive: distinct idents
+        with trace.Block("span"):
+            pass
+        done.wait()
+
+    threads = [threading.Thread(target=work, name="dup-lane-9")
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    done.wait()
+    for t in threads:
+        t.join()
+    trace.off()
+    evts = trace.events()
+    lanes = sorted(e.lane for e in evts)
+    assert len(evts) == 2
+    assert lanes[0] == "dup-lane-9" and lanes[1] == "dup-lane-9#2", lanes
+    # and the Perfetto export gives them distinct, named tids
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = trace.finish_perfetto(os.path.join(td, "lanes.json"))
+        d = json.load(open(path))
+    metas = {m["args"]["name"]: m["tid"] for m in d["traceEvents"]
+             if m["ph"] == "M"}
+    assert metas["dup-lane-9"] != metas["dup-lane-9#2"]
+
+
+def test_same_thread_keeps_one_lane_across_blocks():
+    """A thread's lane is stable: repeated blocks from one thread never
+    fork new '#k' lanes."""
+    trace.clear()
+    trace.on()
+    for _ in range(3):
+        with trace.Block("rep"):
+            pass
+    trace.off()
+    assert len({e.lane for e in trace.events()}) == 1
+
+
 def test_trace_decorator_explicit_lane_sticks():
     """An explicitly-given lane keeps overriding the calling thread."""
     import threading

@@ -1,0 +1,225 @@
+#!/usr/bin/env python3
+"""Render a JSONL telemetry log into per-op latency/throughput tables.
+
+Usage::
+
+    python tools/telemetry_report.py serve.jsonl
+    python tools/telemetry_report.py serve.jsonl --op posv
+    python tools/telemetry_report.py serve.jsonl --json
+    python tools/telemetry_report.py serve.jsonl --strict   # exit 1 on
+                                                 # degradation events
+
+The log is what :func:`slate_tpu.perf.telemetry.start_log` streams
+(``SLATE_TPU_TELEMETRY_LOG``): one JSON object per line —
+
+* ``request`` records (op, bucket, latency_ms, error, slo_violation,
+  batch) from every resolved serve request,
+* ``sentinel`` records (the live sentinel's structured degradation /
+  infra events, nested under ``event``),
+* periodic ``snapshot`` records (``serve.*``/``telemetry.*``/
+  ``resilience.*`` counters and gauges).
+
+The report aggregates requests per (op, bucket): count, error count,
+EXACT p50/p95/p99/max latency (the log carries the raw values — finer
+than the registry's log2 buckets), SLO-violation count and requests/s
+over the record span; then lists the sentinel events.  A rotated
+sibling (``<path>.1``) is read first when present so the report spans
+the rotation.
+
+Stdlib-only, loadable by file path like ``bench_diff.py`` — it never
+imports jax (CI runs it under a jax-poisoned path), so it works on any
+machine in milliseconds.
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import OrderedDict
+
+
+def load_records(paths):
+    """Parse JSONL records from ``paths`` (each preceded by its rotated
+    ``<path>.1`` sibling when one exists), oldest first.  Malformed
+    lines are counted, never fatal — a live log may be mid-write."""
+    recs, bad = [], 0
+    files = []
+    for p in paths:
+        if os.path.exists(p + ".1"):
+            files.append(p + ".1")
+        files.append(p)
+    for fp in files:
+        try:
+            with open(fp) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        bad += 1
+                        continue
+                    if isinstance(rec, dict) and "kind" in rec:
+                        recs.append(rec)
+                    else:
+                        bad += 1
+        except OSError as e:
+            print("unreadable %s: %s" % (fp, e), file=sys.stderr)
+    recs.sort(key=lambda r: r.get("t", 0.0))
+    return recs, bad
+
+
+def quantile(sorted_vals, q):
+    """Exact linear-interpolated quantile of a pre-sorted list."""
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+def aggregate(recs, op_filter=None):
+    """``{(op, bucket): row}`` over the request records + the sentinel
+    event list + the last snapshot (None when the log carries none)."""
+    rows = OrderedDict()
+    events = []
+    last_snapshot = None
+    for rec in recs:
+        kind = rec.get("kind")
+        if kind == "request":
+            op = str(rec.get("op", "?"))
+            if op_filter and op != op_filter:
+                continue
+            key = (op, str(rec.get("bucket", "?")))
+            row = rows.get(key)
+            if row is None:
+                row = rows[key] = {"op": key[0], "bucket": key[1],
+                                   "count": 0, "errors": 0,
+                                   "slo_violations": 0, "lat_ms": [],
+                                   "t_min": None, "t_max": None}
+            row["count"] += 1
+            t = rec.get("t")
+            if isinstance(t, (int, float)):
+                row["t_min"] = t if row["t_min"] is None \
+                    else min(row["t_min"], t)
+                row["t_max"] = t if row["t_max"] is None \
+                    else max(row["t_max"], t)
+            if rec.get("error"):
+                row["errors"] += 1
+            elif isinstance(rec.get("latency_ms"), (int, float)):
+                row["lat_ms"].append(float(rec["latency_ms"]))
+            if rec.get("slo_violation"):
+                row["slo_violations"] += 1
+        elif kind == "sentinel":
+            ev = rec.get("event")
+            if isinstance(ev, dict) \
+                    and (not op_filter or ev.get("op") == op_filter):
+                events.append(ev)
+        elif kind == "snapshot":
+            last_snapshot = rec
+    for row in rows.values():
+        lat = sorted(row.pop("lat_ms"))
+        span = ((row["t_max"] - row["t_min"])
+                if row["t_min"] is not None
+                and row["t_max"] is not None else 0.0)
+        row["p50_ms"] = quantile(lat, 0.50)
+        row["p95_ms"] = quantile(lat, 0.95)
+        row["p99_ms"] = quantile(lat, 0.99)
+        row["max_ms"] = lat[-1] if lat else None
+        row["req_per_s"] = (row["count"] / span) if span > 0 else None
+        del row["t_min"], row["t_max"]
+    return rows, events, last_snapshot
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.2f" % v
+    return str(v)
+
+
+def format_tables(rows, events, last_snapshot):
+    out = []
+    heads = ["op", "bucket", "count", "err", "p50_ms", "p95_ms",
+             "p99_ms", "max_ms", "req/s", "slo_viol"]
+    body = [[r["op"], r["bucket"], r["count"], r["errors"],
+             _fmt(r["p50_ms"]), _fmt(r["p95_ms"]), _fmt(r["p99_ms"]),
+             _fmt(r["max_ms"]), _fmt(r["req_per_s"]),
+             r["slo_violations"]] for r in rows.values()]
+    if body:
+        widths = [max(len(str(row[i])) for row in [heads] + body)
+                  for i in range(len(heads))]
+        for row in [heads] + body:
+            out.append("  ".join(str(c).ljust(w)
+                                 for c, w in zip(row, widths)).rstrip())
+    else:
+        out.append("no request records")
+    out.append("")
+    if events:
+        out.append("sentinel events: %d" % len(events))
+        for ev in events:
+            out.append(
+                "  [%s] %s %s %s/%s%s" % (
+                    ev.get("t", "?"), ev.get("classification", "?"),
+                    ev.get("kind", "?"), ev.get("op", "?"),
+                    ev.get("bucket", "?"),
+                    (" rise=%s%%" % ev["rise_pct"])
+                    if "rise_pct" in ev else
+                    (" error_rate=%s" % ev["error_rate"])
+                    if "error_rate" in ev else ""))
+    else:
+        out.append("sentinel events: none")
+    if last_snapshot:
+        counters = last_snapshot.get("counters") or {}
+        serve = {k: v for k, v in sorted(counters.items())
+                 if k.startswith("serve.")}
+        if serve:
+            out.append("")
+            out.append("last snapshot (serve.* counters):")
+            for k, v in serve.items():
+                out.append("  %s = %s" % (k, _fmt(float(v))))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="telemetry_report.py",
+        description="Render a slate_tpu JSONL telemetry log into "
+                    "per-op latency/throughput tables with "
+                    "SLO-violation counts.")
+    ap.add_argument("logs", nargs="+",
+                    help="JSONL telemetry log file(s) "
+                         "(SLATE_TPU_TELEMETRY_LOG), oldest first")
+    ap.add_argument("--op", help="only this op (e.g. posv)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of tables")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when the log carries any sentinel "
+                         "degradation event")
+    args = ap.parse_args(argv)
+
+    recs, bad = load_records(args.logs)
+    rows, events, last_snapshot = aggregate(recs, op_filter=args.op)
+    degradations = [e for e in events
+                    if e.get("classification") == "degradation"]
+    if args.json:
+        print(json.dumps({
+            "records": len(recs), "malformed": bad,
+            "rows": list(rows.values()), "sentinel_events": events,
+            "degradations": len(degradations),
+        }, indent=1))
+    else:
+        print(format_tables(rows, events, last_snapshot))
+        if bad:
+            print("\n%d malformed line(s) skipped" % bad)
+    return 1 if (args.strict and degradations) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
