@@ -164,6 +164,33 @@ def _metrics_delta(before):
 _PLATFORM = "tpu"
 
 
+def _bundle_tag():
+    """The active offline autotune bundle's identity (version/digest —
+    ``SLATE_TPU_AUTOTUNE_BUNDLE``, slate_tpu/perf/sweep.py) or None:
+    stamped on every JSON line and the aggregate so an artifact says
+    whether its numbers came from a bundle-warm or probe-cold process
+    (the sentinel NOTEs a change between rounds).  Never allowed to
+    kill a line."""
+    try:
+        from slate_tpu.perf import autotune
+
+        return autotune.bundle_info()
+    except Exception:
+        return None
+
+
+def _probes_avoided(snapshot):
+    """The ``probes_avoided`` counter family out of a metrics snapshot:
+    how many decisions resolved probe-free from the bundle (exact +
+    model), how many entries a quarantine masked, whether a stale
+    bundle was rejected — the aggregate's bundle-effectiveness block."""
+    counters = (snapshot or {}).get("counters") or {}
+    fam = {k: v for k, v in counters.items()
+           if k == "autotune.probes_avoided"
+           or k.startswith("autotune.bundle.")}
+    return fam or None
+
+
 def _attribution(label, gflops, metrics_delta, autotune_tags):
     """The routine's roofline gap report (slate_tpu/perf/attr.py):
     analytical per-stage flops/bytes joined with this routine's
@@ -461,6 +488,7 @@ def _partial_aggregate(sub, fails, infra, attribution=None):
         "partial": True,
         "failed": list(fails) + [f"infra: {s}" for s in infra],
         "autotune": _autotune_tags(set()),
+        "bundle": _bundle_tag(),
         "metrics": _metrics_snapshot(),
     }
     if attribution:
@@ -575,6 +603,7 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
                           "error": "infra: hard-hung in a blocking C "
                                    "call past the SIGALRM deadline",
                           "autotune": _autotune_tags(keys_before),
+                          "bundle": _bundle_tag(),
                           "metrics": _metrics_delta(snap_before)}),
               flush=True)
         print(json.dumps(_partial_aggregate(
@@ -601,6 +630,7 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
                                   "error": "residual_gate",
                                   "scaled_resid": float(resid),
                                   "autotune": tags,
+                                  "bundle": _bundle_tag(),
                                   "metrics": delta}),
                       flush=True)
                 return None
@@ -615,6 +645,7 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
             line = {"routine": name, "label": label,
                     "gflops": round(gf, 1), "scaled_resid": float(resid),
                     "autotune": tags,
+                    "bundle": _bundle_tag(),
                     "metrics": delta}
             rep = _attribution(label, gf, delta, tags)
             if rep is not None:
@@ -638,6 +669,7 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
     print(json.dumps({"routine": name,
                       "error": f"infra: {type(last_err).__name__}: {last_err}",
                       "autotune": _autotune_tags(keys_before),
+                      "bundle": _bundle_tag(),
                       "metrics": _metrics_delta(snap_before)}),
           flush=True)
     return None
@@ -1177,9 +1209,13 @@ def main():
         "submetrics": sub,
         "fraction_of_measured_gemm": peak,
         "autotune": _autotune_tags(set()),   # full decision table
+        "bundle": _bundle_tag(),             # bundle-warm or probe-cold?
         "metrics": _metrics_snapshot(),      # full registry snapshot
         "attribution": attr_map,             # per-routine gap reports
     }
+    pa = _probes_avoided(out["metrics"])
+    if pa:
+        out["probes_avoided"] = pa
     # regression tripwire (r4 lesson: geqrf silently lost 20% between
     # rounds): compare every submetric against the newest BENCH_r*.json
     # in the repo root and flag drops > 5%.  The offline/multi-artifact

@@ -95,6 +95,100 @@ def telemetry_smoke() -> int:
     return 1
 
 
+def sweep_smoke() -> int:
+    """The --sweep tier: tiny CPU grid end-to-end through the CLI in a
+    subprocess (sweep → versioned bundle artifact), then a second fresh
+    process booted with ONLY ``SLATE_TPU_AUTOTUNE_BUNDLE`` set proves
+    the ISSUE 11 acceptance criterion: first bucketed request with zero
+    timing reps, zero on-demand compiles, zero jit compiles — including
+    a shape absent from the sweep grid, resolved by the interpolating
+    model — and the analytical pre-pruning cut timing reps ≥2× vs
+    exhaustive, every pruned candidate logged with its predicted gap."""
+    import json
+    import tempfile
+
+    here = pathlib.Path(__file__).resolve().parent
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "bundle.json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "cache.json"))
+        env.pop("SLATE_TPU_AUTOTUNE_BUNDLE", None)
+        cmd = [sys.executable, str(here / "tools" / "sweep.py"),
+               "--grid", "smoke", "--out", out,
+               "--checkpoint", os.path.join(td, "ck.json")]
+        print("=== sweep: " + " ".join(cmd), flush=True)
+        try:
+            rc = subprocess.run(cmd, env=env, timeout=1500).returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+        if rc != 0:
+            print("==== sweep smoke FAILED (CLI rc=%d) ====" % rc)
+            return 1
+        with open(out) as f:
+            blob = json.load(f)
+        st = blob.get("stats", {})
+        checks = {
+            "bundle has decisions": bool(blob.get("decisions")),
+            "bundle has model points": bool(blob.get("model")),
+            "bundle has warm-start specs": bool(blob.get("warm_start")),
+            "pruning cut timing reps >= 2x vs exhaustive":
+                st.get("reps_exhaustive", 0)
+                >= 2 * max(1, st.get("reps_timed", 0)),
+            "every pruned candidate logged with predicted gap":
+                bool(blob.get("pruned")) and all(
+                    isinstance(p.get("predicted_gap"), (int, float))
+                    for p in blob["pruned"]),
+        }
+        code = (
+            "import numpy as np\n"
+            "from slate_tpu import serve\n"
+            "from slate_tpu.perf import autotune, metrics\n"
+            "metrics.on()\n"
+            "compiled = serve.warm_start()\n"
+            "assert compiled >= 1, compiled\n"
+            "metrics.reset()\n"
+            "rng = np.random.default_rng(0)\n"
+            "def spd(n):\n"
+            "    g = rng.standard_normal((n, n)).astype(np.float32)\n"
+            "    return g @ g.T + n * np.eye(n, dtype=np.float32)\n"
+            "serve.submit('posv', spd(64),\n"
+            "             np.ones(64, np.float32)).result(timeout=600)\n"
+            "serve.submit('posv', spd(96),\n"
+            "             np.ones(96, np.float32)).result(timeout=600)\n"
+            "serve.shutdown()\n"
+            "c = metrics.snapshot()['counters']\n"
+            "assert c.get('serve.compile.on_demand', 0) == 0, c\n"
+            "assert c.get('jit.backend_compiles', 0) == 0, c\n"
+            "assert autotune.timing_reps() == 0\n"
+            "src = {v['source'] for k, v in\n"
+            "       autotune.table().decisions.items()\n"
+            "       if k.startswith('batched_potrf|')}\n"
+            "assert 'bundle' in src and 'bundle-model' in src, src\n"
+            "print('SWEEP-BOOT-OK')\n")
+        env2 = dict(env, SLATE_TPU_AUTOTUNE_BUNDLE=out,
+                    SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "c2.json"))
+        try:
+            r2 = subprocess.run([sys.executable, "-c", code], env=env2,
+                                capture_output=True, text=True,
+                                timeout=900, cwd=str(here))
+            boot_ok = r2.returncode == 0 and "SWEEP-BOOT-OK" in r2.stdout
+            if not boot_ok:
+                print(r2.stdout)
+                print(r2.stderr)
+        except subprocess.TimeoutExpired:
+            boot_ok = False
+        checks["fresh process boots probe-free from the bundle "
+               "(zero reps/compiles, model resolves unswept shape)"] = \
+            boot_ok
+        for name, ok in checks.items():
+            print("  %s: %s" % (name, "ok" if ok else "FAIL"), flush=True)
+        if all(checks.values()):
+            print("==== sweep smoke passed ====")
+            return 0
+        print("==== sweep smoke FAILED ====")
+        return 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--quick", action="store_true")
@@ -119,10 +213,19 @@ def main(argv=None):
                     "telemetry on and scrape the Prometheus endpoint "
                     "once over a real socket (see docs/usage.md Live "
                     "telemetry)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="offline-autotune smoke: run tools/sweep.py on "
+                    "the tiny CPU grid in a subprocess, then boot a "
+                    "fresh process from the bundle and assert the "
+                    "zero-probe/zero-compile start (see docs/usage.md "
+                    "Offline autotune & bundles)")
     args = ap.parse_args(argv)
 
     if args.telemetry:
         return telemetry_smoke()
+
+    if args.sweep:
+        return sweep_smoke()
 
     if args.chaos:
         # setdefault: an explicit operator plan/tier wins over the can

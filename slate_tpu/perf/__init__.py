@@ -6,7 +6,9 @@ attribution engine (:mod:`.attr`) that joins the analytical per-stage
 cost model with the measured metrics to say where the time went, and
 the live serving telemetry layer (:mod:`.telemetry`): per-request
 tracing, SLO histograms, Prometheus/JSONL streaming exporters and the
-in-process live sentinel."""
+in-process live sentinel, and the offline autotune sweep engine +
+versioned warm-start bundles (:mod:`.sweep`) that close the loop
+between the roofline model and the decision table."""
 
 from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
                           ModuleProfile, collective_byte_census,
@@ -17,15 +19,16 @@ __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
     "attr", "autotune", "collective_byte_census", "metrics",
     "profile_fn", "profile_hlo_text", "regress",
-    "stablehlo_collective_shapes", "telemetry",
+    "stablehlo_collective_shapes", "sweep", "telemetry",
 ]
 
 
 def __getattr__(name):
     # lazy: autotune pulls in jax.random/pallas bits only when used;
-    # attr/metrics/regress/telemetry stay stdlib-light and import on
-    # demand
-    if name in ("attr", "autotune", "metrics", "regress", "telemetry"):
+    # attr/metrics/regress/sweep/telemetry stay stdlib-light and import
+    # on demand
+    if name in ("attr", "autotune", "metrics", "regress", "sweep",
+                "telemetry"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -63,7 +63,8 @@ __all__ = [
     "DEFAULT_NB", "attribute", "attribute_live",
     "expected_hbm_roundtrips", "explain_pair", "format_report",
     "fusion_from_autotune", "model_flops", "parse_label", "peaks",
-    "record_rooflines", "stage_model", "stage_timers",
+    "predict_seconds", "record_rooflines", "stage_model",
+    "stage_timers",
 ]
 
 #: panel width assumed when the submetric label carries no ``nb`` token
@@ -349,6 +350,49 @@ def stage_model(routine: str, dims: dict, dtype: str = "fp32",
                "bytes": raw[s][1]}
               for s in _STAGE_ORDER if s in raw]
     return stages, rts
+
+
+#: per-platform dispatch/launch latency (seconds) charged once per
+#: invocation and once per materialized HBM round trip by
+#: :func:`predict_seconds` — the term that separates fusion depths at
+#: small shapes, where the roofline minima alone are indistinguishable.
+#: Override with ``SLATE_TPU_LAUNCH_S`` for a new TPU generation.
+_DEF_LAUNCH_S = {"tpu": 5e-6, "cpu": 2e-5}
+
+
+def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
+                    fusion: str = "composed", platform: str = "tpu",
+                    launch_s=None):
+    """Model-predicted wall seconds for ONE invocation at the given
+    fusion depth: the per-stage roofline minima (:func:`stage_model` on
+    :func:`peaks`) plus a launch-latency + panel-strip-traffic term per
+    materialized HBM round trip.  This is the candidate pricing the
+    offline sweep (``perf/sweep.py``) prunes with BEFORE any timing rep
+    runs, and the analytical guard its interpolating decision model
+    cross-checks selections against — so it must stay loadable
+    stdlib-only, like everything else in this module.  None when the
+    routine has no stage model."""
+    model = stage_model(routine, dims, dtype, fusion)
+    if model is None:
+        return None
+    stages, rts = model
+    pk = peaks(platform, dtype)
+    t = 0.0
+    for s in stages:
+        t += max(s["flops"] / (pk["tflops"] * 1e12),
+                 s["bytes"] / (pk["hbm_gbs"] * 1e9))
+    if launch_s is None:
+        launch_s = _env_float("SLATE_TPU_LAUNCH_S")
+    if launch_s is None:
+        launch_s = _DEF_LAUNCH_S.get(platform, _DEF_LAUNCH_S["tpu"])
+    n = dims.get("n") or dims.get("m") or 1
+    nb = min(dims.get("nb") or DEFAULT_NB, n)
+    isz = _ITEMSIZE.get(dtype or "fp32", 4)
+    # one panel-strip write+read per materialized inter-stage
+    # intermediate (rts already carries the leading batch factor)
+    rt_bytes = 2.0 * n * nb * isz
+    t += launch_s + rts * (launch_s + rt_bytes / (pk["hbm_gbs"] * 1e9))
+    return t
 
 
 def expected_hbm_roundtrips(routine: str, dims: dict,

@@ -29,6 +29,14 @@ Environment knobs:
 
 * ``SLATE_TPU_AUTOTUNE_CACHE`` — cache file path (default
   ``$XDG_CACHE_HOME/slate_tpu/autotune.json``).
+* ``SLATE_TPU_AUTOTUNE_BUNDLE`` — path to an offline warm-start bundle
+  (``tools/sweep.py`` / :mod:`slate_tpu.perf.sweep`): a version-keyed
+  decision table + fitted interpolating model consumed as the
+  first-priority probe-free source.  The full resolution ladder is
+  forced pin → quarantine filter → bundle entry → cached timing →
+  bundle model (shapes never swept) → runtime probe fallback, with
+  quarantine demotions masking bundle entries exactly as they mask
+  cached winners.
 * ``SLATE_TPU_AUTOTUNE`` — ``0`` disables timing: every decision falls
   back to the first (heuristically preferred) eligible candidate.
 * ``SLATE_TPU_AUTOTUNE_FORCE`` — comma list of ``op=backend`` pairs
@@ -56,12 +64,13 @@ from contextlib import contextmanager
 from typing import Any, Callable, NamedTuple, Optional
 
 from . import metrics
+from . import sweep as _sweep
 
 __all__ = [
     "AutotuneTable", "Candidate", "table", "reset_table", "select",
     "decide", "decisions", "timing_reps", "kernel",
     "quarantine", "quarantine_key", "safe_backend",
-    "suppress_knob_records",
+    "suppress_knob_records", "bundle_info", "bundle_warm_specs",
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
     "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
     "choose_geqrf_panel", "choose_chase", "choose_lu_step",
@@ -149,8 +158,98 @@ def _cache_path() -> str:
     return os.path.join(base, "slate_tpu", "autotune.json")
 
 
-def _key_str(op: str, key_parts) -> str:
-    return op + "|" + ",".join(str(p) for p in key_parts)
+#: canonical decision-key string — shared with the sweep grid keys
+_key_str = _sweep.key_str
+
+
+def _load_bundle() -> Optional[dict]:
+    """The active warm-start bundle (``SLATE_TPU_AUTOTUNE_BUNDLE``), or
+    None.  Loaded when the decision table is constructed — NEVER at
+    import, and loading starts no exporters and runs no probes
+    (registry-guard pinned) — and version-checked exactly like the
+    timing cache: any jax/jaxlib/platform/libtpu component changing
+    rejects the whole artifact (``autotune.bundle.stale``)."""
+    path = os.environ.get(_sweep.BUNDLE_ENV, "").strip()
+    if not path:
+        return None
+    try:
+        blob = _sweep.read_bundle(path)
+    except (OSError, ValueError):
+        metrics.inc("autotune.bundle.unreadable")
+        return None
+    if blob.get("version") != _version_key():
+        metrics.inc("autotune.bundle.stale")
+        return None
+    blob.setdefault("path", path)
+    metrics.inc("autotune.bundle.loaded",
+                float(len(blob.get("decisions") or {})))
+    return blob
+
+
+def bundle_info() -> Optional[dict]:
+    """``{"path", "digest", "version"}`` of the ACTIVE warm-start
+    bundle (None without one) — bench.py tags every JSON line with it
+    so artifacts say whether numbers came from a bundle-warm or
+    probe-cold process."""
+    b = table().bundle
+    if not isinstance(b, dict):
+        return None
+    return {"path": b.get("path"), "digest": b.get("digest"),
+            "version": b.get("version")}
+
+
+def bundle_warm_specs() -> list:
+    """The AOT warm-start bucket specs the active bundle ships for
+    :func:`slate_tpu.serve.warm_start` (empty without a bundle)."""
+    b = table().bundle
+    specs = (b or {}).get("warm_start") if isinstance(b, dict) else None
+    if not isinstance(specs, list):
+        return []
+    return [dict(s) for s in specs if isinstance(s, dict)]
+
+
+def _bundle_entry(bundle: dict, key: str, names, quar) -> Optional[str]:
+    """Exact-entry stage of the bundle ladder: the offline decision for
+    this key, unless a live quarantine entry MASKS it exactly like a
+    cached winner (PR 9 negative evidence).  The ONE implementation
+    shared by :meth:`AutotuneTable.decide` and :func:`_default`."""
+    ent = (bundle.get("decisions") or {}).get(key)
+    b = ent.get("backend") if isinstance(ent, dict) else None
+    if isinstance(b, str) and b in names:
+        if b in quar:
+            metrics.inc("autotune.bundle.masked")
+        else:
+            return b
+    return None
+
+
+def _bundle_model(bundle: dict, op: str, key_parts, names, quar
+                  ) -> Optional[str]:
+    """Model stage of the bundle ladder: the fitted interpolating model
+    for shapes the sweep never timed, quarantined backends excluded.
+    Shared by :meth:`AutotuneTable.decide` and :func:`_default`."""
+    try:
+        mb = _sweep.model_backend(bundle, op, key_parts, names,
+                                  exclude=quar)
+    except Exception:       # a malformed model must never break dispatch
+        mb = None
+    if mb is not None and mb in names and mb not in quar:
+        return mb
+    return None
+
+
+def _bundle_resolve(bundle: dict, op: str, key: str, key_parts, names,
+                    quar) -> Optional[tuple]:
+    """Both bundle stages in sequence (the chooser-default ladder,
+    where no cached timing can sit between them).  Returns
+    ``(backend, source)`` or None."""
+    b = _bundle_entry(bundle, key, names, quar)
+    if b is not None:
+        return b, "bundle"
+    mb = _bundle_model(bundle, op, key_parts, names, quar)
+    if mb is not None:
+        return mb, "bundle-model"
+    return None
 
 
 #: op site -> the stock-library candidate name (the one whose failure
@@ -218,6 +317,9 @@ class AutotuneTable:
         self.decisions: dict = {}       # key -> {"backend", "source", ...}
         self.timing_reps = 0            # timed reps performed THIS process
         self._persist: dict = {}        # subset of decisions worth saving
+        # the offline warm-start bundle (SLATE_TPU_AUTOTUNE_BUNDLE):
+        # first-priority probe-free source, version-checked on load
+        self.bundle: Optional[dict] = _load_bundle()
         # key -> {backend -> {"until": epoch_s, "reason": str}}: runtime
         # demotions from the resilience health gates, persisted next to
         # the cache (see quarantine_backend)
@@ -358,7 +460,8 @@ class AutotuneTable:
 
     # -- the decision engine ----------------------------------------------
 
-    def decide(self, op: str, key_parts, candidates, reps: int = _REPS) -> str:
+    def decide(self, op: str, key_parts, candidates, reps: int = _REPS,
+               force_timing: bool = False) -> str:
         """Resolve one decision.  ``candidates`` is an ordered list of
         :class:`Candidate` — the first entry is the heuristic default
         used when timing is disabled; when EVERY candidate fails the
@@ -366,13 +469,26 @@ class AutotuneTable:
         A key with a live resilience quarantine entry (health-gate
         demotion, see :meth:`quarantine_backend`) resolves probe-free
         to the heuristic head of the non-quarantined candidates until
-        the TTL expires or the version key bumps.  Returns the chosen
-        backend name."""
+        the TTL expires or the version key bumps.
+
+        With a warm-start bundle active (``SLATE_TPU_AUTOTUNE_BUNDLE``)
+        the ladder is: forced pin → quarantine filter → bundle entry →
+        cached timing → interpolating bundle model (shapes never
+        swept) → runtime probe fallback — a quarantined backend masks
+        its bundle entry exactly as it masks a cached winner.
+
+        ``force_timing=True`` is the OFFLINE SWEEP's entry
+        (perf/sweep.py): skip every probe-free source (bundle, cache,
+        quarantine, the off-TPU short circuit, even the
+        single-candidate shortcut) and measure now — never set on a
+        serving path.  Returns the chosen backend name."""
 
         key = _key_str(op, key_parts)
         with self._lock:
             hit = self.decisions.get(key)
             names = [c.name for c in candidates]
+            if force_timing:
+                return self._probe(op, key, candidates, names, reps)
             forced = _forced(op)
             if forced is not None:
                 if forced in names:
@@ -384,6 +500,37 @@ class AutotuneTable:
                         metrics.inc("dispatch.%s.%s" % (op, forced))
                     return forced
                 _warn_bad_force(op, forced, names)
+            quar = self._live_quarantined(key)
+            if self.bundle is not None:
+                # fast path: a key already resolved from the bundle
+                # re-dispatches without re-running the lookup/model.  A
+                # "bundle-model" record only short-circuits while the
+                # bundle has no exact entry for the key — a model
+                # resolution recorded while a quarantine masked the
+                # entry must not outlive the mask (expiry re-admits the
+                # offline decision)
+                if hit is not None \
+                        and hit.get("source") in ("bundle", "bundle-model") \
+                        and (hit["source"] == "bundle"
+                             or key not in (self.bundle.get("decisions")
+                                            or {})) \
+                        and hit["backend"] in names \
+                        and hit["backend"] not in quar:
+                    metrics.inc("autotune.bundle.hit"
+                                if hit["source"] == "bundle"
+                                else "autotune.bundle.model_hit")
+                    metrics.inc("dispatch.%s.%s" % (op, hit["backend"]))
+                    return hit["backend"]
+                # the bundle's exact decision table: the first-priority
+                # probe-free source (measured OFFLINE on this exact
+                # version key, so it outranks this machine's cache); a
+                # live quarantine masks the entry — PR 9 negative
+                # evidence feeding back into the offline table
+                bb = _bundle_entry(self.bundle, key, names, quar)
+                if bb is not None:
+                    metrics.inc("autotune.bundle.hit")
+                    metrics.inc("autotune.probes_avoided")
+                    return self._record(op, key, bb, "bundle")
             # resilience demotions: while a LIVE quarantine entry names
             # this key, resolve to the heuristic head of the remaining
             # candidates (never the quarantined ones; the safe backend
@@ -392,8 +539,17 @@ class AutotuneTable:
             # choice, not a measurement.  Once the TTL expires (or the
             # version bumps) the quarantine vanishes and the next call
             # re-probes from scratch.
-            quar = self._live_quarantined(key)
             if quar:
+                # with a bundle active, offline evidence about the
+                # REMAINING candidates (the interpolating model with
+                # the quarantined backends excluded) still beats the
+                # heuristic head — same degraded ladder _default runs
+                if self.bundle is not None:
+                    mb = _bundle_model(self.bundle, op, key_parts,
+                                       names, quar)
+                    if mb is not None:
+                        metrics.inc("autotune.bundle.model_hit")
+                        return self._record(op, key, mb, "bundle-model")
                 safe_name = safe_backend(op)
                 kept = [c.name for c in candidates
                         if c.name not in quar or c.name == safe_name]
@@ -418,54 +574,73 @@ class AutotuneTable:
             metrics.inc("autotune.miss")
             if len(candidates) == 1:
                 return self._record(op, key, names[0], "only")
+            # shapes the sweep never timed: the bundle's fitted
+            # interpolating model resolves probe-free — below cached
+            # timing (an exact local measurement beats interpolation),
+            # above the heuristic default and the runtime probe.  The
+            # analytical >10× guard lives inside model_backend.
+            if self.bundle is not None:
+                mb = _bundle_model(self.bundle, op, key_parts, names,
+                                   quar)
+                if mb is not None:
+                    metrics.inc("autotune.bundle.model_hit")
+                    metrics.inc("autotune.probes_avoided")
+                    return self._record(op, key, mb, "bundle-model")
             if not _enabled() or not _on_tpu():
                 # no measurement possible/wanted: heuristic default.
                 # (Interpret-mode Pallas timings on CPU are meaningless.)
                 return self._record(op, key, names[0], "default")
-            times: dict = {}
-            failures: dict = {}
-            from ..resilience import inject as _inject
-            for cand in candidates:
-                try:
-                    # chaos seam: an injected "error" prunes the
-                    # candidate like a real compile failure; "nan"
-                    # corrupts the warm output so the accuracy guard
-                    # prunes it (no-op without an active fault plan)
-                    ikind = _inject.fault_here("autotune.probe")
-                    run = cand.setup()
-                    out = run()                       # compile + warm
-                    if ikind in ("nan", "inf"):
-                        out = _inject.corrupt_outputs(out, ikind)
-                    if cand.check is not None and not cand.check(out):
-                        failures[cand.name] = "accuracy-guard"
-                        metrics.inc("autotune.pruned.accuracy-guard")
-                        continue
-                    ts = []
-                    for _ in range(reps):
-                        t0 = time.perf_counter()
-                        run()
-                        ts.append(time.perf_counter() - t0)
-                    self.timing_reps += reps
-                    metrics.inc("autotune.probe_reps", float(reps))
-                    times[cand.name] = min(ts)
-                except Exception as e:  # compile failure / OOM / ...
-                    failures[cand.name] = f"{type(e).__name__}: {e}"
-                    metrics.inc("autotune.pruned.compile")
-            if not times:
-                metrics.inc("autotune.all_pruned")
-                # every candidate pruned (probe OOM, compile outage):
-                # fall back to the stock-XLA backend when one is listed
-                # — it is the only candidate whose failure mode is
-                # shared with the non-autotuned library — else the
-                # heuristic first entry
-                safe = "xla" if "xla" in names else names[0]
-                return self._record(op, key, safe, "all-pruned",
-                                    times=failures or None)
-            winner = min(times, key=times.get)
-            rounded = {k: round(v, 6) for k, v in times.items()}
-            rounded.update({k: f"pruned: {v}" for k, v in failures.items()})
-            return self._record(op, key, winner, "timed", times=rounded,
-                                persist=True)
+            return self._probe(op, key, candidates, names, reps)
+
+    def _probe(self, op: str, key: str, candidates, names,
+               reps: int) -> str:
+        """The measurement tail of :meth:`decide`: prune-by-exception /
+        accuracy-guard, time the survivors, record the winner.  Caller
+        holds the lock."""
+        times: dict = {}
+        failures: dict = {}
+        from ..resilience import inject as _inject
+        for cand in candidates:
+            try:
+                # chaos seam: an injected "error" prunes the
+                # candidate like a real compile failure; "nan"
+                # corrupts the warm output so the accuracy guard
+                # prunes it (no-op without an active fault plan)
+                ikind = _inject.fault_here("autotune.probe")
+                run = cand.setup()
+                out = run()                       # compile + warm
+                if ikind in ("nan", "inf"):
+                    out = _inject.corrupt_outputs(out, ikind)
+                if cand.check is not None and not cand.check(out):
+                    failures[cand.name] = "accuracy-guard"
+                    metrics.inc("autotune.pruned.accuracy-guard")
+                    continue
+                ts = []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    run()
+                    ts.append(time.perf_counter() - t0)
+                self.timing_reps += reps
+                metrics.inc("autotune.probe_reps", float(reps))
+                times[cand.name] = min(ts)
+            except Exception as e:  # compile failure / OOM / ...
+                failures[cand.name] = f"{type(e).__name__}: {e}"
+                metrics.inc("autotune.pruned.compile")
+        if not times:
+            metrics.inc("autotune.all_pruned")
+            # every candidate pruned (probe OOM, compile outage):
+            # fall back to the stock-XLA backend when one is listed
+            # — it is the only candidate whose failure mode is
+            # shared with the non-autotuned library — else the
+            # heuristic first entry
+            safe = "xla" if "xla" in names else names[0]
+            return self._record(op, key, safe, "all-pruned",
+                                times=failures or None)
+        winner = min(times, key=times.get)
+        rounded = {k: round(v, 6) for k, v in times.items()}
+        rounded.update({k: f"pruned: {v}" for k, v in failures.items()})
+        return self._record(op, key, winner, "timed", times=rounded,
+                            persist=True)
 
 
 _table: Optional[AutotuneTable] = None
@@ -488,8 +663,9 @@ def reset_table() -> None:
         _table = None
 
 
-def decide(op: str, key_parts, candidates, reps: int = _REPS) -> str:
-    return table().decide(op, key_parts, candidates, reps)
+def decide(op: str, key_parts, candidates, reps: int = _REPS,
+           force_timing: bool = False) -> str:
+    return table().decide(op, key_parts, candidates, reps, force_timing)
 
 
 def decisions() -> dict:
@@ -556,8 +732,11 @@ def _bucket_dim(d: int) -> int:
     candidates per shape on a cold cache (minutes of first-run stall on
     TPU), while one decision per power-of-two bucket covers them with
     log-many searches — the same bucketing ``linalg.lu``'s Pallas panel
-    applies to its lane dimension."""
-    return max(8, 1 << (int(d) - 1).bit_length())
+    applies to its lane dimension.  Delegates to the ONE shared helper
+    (:func:`slate_tpu.perf.sweep.pow2_bucket`) so autotune cache keys,
+    serve bucket keys and sweep grid keys can never drift apart
+    (agreement pinned in tests/test_sweep.py)."""
+    return _sweep.pow2_bucket(d)
 
 
 def _timed_call(fn, *args):
@@ -607,6 +786,47 @@ def _static(op: str, key_parts, backend: str, source: str) -> str:
     return backend
 
 
+def _default(op: str, key_parts, names, fallback: str) -> str:
+    """Probe-free resolution for the choosers' no-measurement branches
+    (off-TPU, timing disabled): the active warm-start bundle — timed
+    OFFLINE on matching hardware (``perf/sweep.py``) — outranks the
+    heuristic ``fallback``, with live quarantine entries masking bundle
+    entries exactly as in :meth:`AutotuneTable.decide`.  Without a
+    bundle this is exactly the old ``_static(..., "default")``."""
+    tab = table()
+    if tab.bundle is not None:
+        key = _key_str(op, key_parts)
+        with tab._lock:
+            quar = tab._live_quarantined(key)
+            hit = tab.decisions.get(key)
+        # fast path (mirrors decide's): a key already resolved from the
+        # bundle re-dispatches without re-running the lookup/model —
+        # the model interpolation must not re-price every hot dispatch.
+        # Same bundle-model caveat as decide's: an exact entry (masked
+        # when the model record was made) wins again once re-admitted.
+        if hit is not None \
+                and hit.get("source") in ("bundle", "bundle-model") \
+                and (hit["source"] == "bundle"
+                     or key not in (tab.bundle.get("decisions") or {})) \
+                and hit["backend"] in names \
+                and hit["backend"] not in quar:
+            metrics.inc("autotune.bundle.hit"
+                        if hit["source"] == "bundle"
+                        else "autotune.bundle.model_hit")
+            metrics.inc("dispatch.%s.%s" % (op, hit["backend"]))
+            return hit["backend"]
+        res = _bundle_resolve(tab.bundle, op, key, key_parts,
+                              list(names), quar)
+        if res is not None:
+            backend, src = res
+            metrics.inc("autotune.bundle.hit" if src == "bundle"
+                        else "autotune.bundle.model_hit")
+            if key not in tab.decisions:
+                metrics.inc("autotune.probes_avoided")
+            return _static(op, key_parts, backend, src)
+    return _static(op, key_parts, fallback, "default")
+
+
 # ---------------------------------------------------------------------------
 # Op-site choosers.  Each returns a backend NAME; the call site maps the
 # name to its implementation.  Candidate order = heuristic preference
@@ -643,7 +863,7 @@ def choose_matmul(shape_a, shape_b, dtype) -> str:
         if mode == "off":
             return _static("matmul", key, "xla", "forced-config")
         if not _on_tpu():
-            return _static("matmul", key, "xla", "default")
+            return _default("matmul", key, ("ozaki", "xla"), "xla")
         if mode == "on":
             return _static("matmul", key, "ozaki", "forced-config")
 
@@ -680,7 +900,7 @@ def choose_matmul(shape_a, shape_b, dtype) -> str:
     if mode == "on":
         return _static("matmul", key, "pallas", "forced-config")
     if not _on_tpu():
-        return _static("matmul", key, "xla", "default")
+        return _default("matmul", key, ("xla", "pallas"), "xla")
 
     def setup_pallas():
         from ..ops.pallas_kernels import matmul as pallas_matmul
@@ -754,7 +974,7 @@ def choose_potrf_panel(n: int, nb: int, dtype) -> str:
     if mode == "on":
         return _static("potrf_panel", key, "pallas", "forced-config")
     if not _on_tpu():
-        return _static("potrf_panel", key, "xla", "default")
+        return _default("potrf_panel", key, ("pallas", "xla"), "xla")
 
     probes: dict = {}
 
@@ -795,7 +1015,8 @@ def choose_potrf_panel_f64(n: int, nb: int) -> str:
     if mode == "off":
         return _static("potrf_panel_f64", key, "xla", "forced-config")
     if not _on_tpu():
-        return _static("potrf_panel_f64", key, "xla", "default")
+        return _default("potrf_panel_f64", key, ("ozaki_newton", "xla"),
+                        "xla")
     if mode == "on":
         return _static("potrf_panel_f64", key, "ozaki_newton", "forced-config")
 
@@ -920,7 +1141,7 @@ def choose_lu_driver(m: int, n: int, nb: int, dtype,
     if mode == "on":
         return _static("lu_driver", key, "scattered", "forced-config")
     if not _on_tpu():
-        return _static("lu_driver", key, "rec", "default")
+        return _default("lu_driver", key, ("rec", "scattered"), "rec")
 
     probes: dict = {}
 
@@ -995,7 +1216,8 @@ def choose_lu_step(m: int, n: int, nb: int, dtype, eligible: bool) -> str:
         forced = _forced("lu_step")
         if forced in ("fused", "fused_trsm", "composed"):
             return _static("lu_step", key, forced, "forced")
-        return _static("lu_step", key, "composed", "default")
+        return _default("lu_step", key,
+                        ("composed", "fused", "fused_trsm"), "composed")
 
     probes: dict = {}
 
@@ -1042,7 +1264,8 @@ def choose_potrf_step(n: int, nb: int, dtype, eligible: bool) -> str:
         forced = _forced("potrf_step")
         if forced in ("fused", "composed"):
             return _static("potrf_step", key, forced, "forced")
-        return _static("potrf_step", key, "composed", "default")
+        return _default("potrf_step", key, ("composed", "fused"),
+                        "composed")
 
     probes: dict = {}
 
@@ -1096,8 +1319,9 @@ def choose_dist_panel(op: str, nb: int, dtype, eligible: bool) -> str:
     if mode == "on":
         return _static("dist_panel", key, "pallas_panel", "forced-config")
     if _on_tpu() and dt == jnp.float32:
-        return _static("dist_panel", key, "pallas_panel", "default")
-    return _static("dist_panel", key, "xla", "default")
+        return _default("dist_panel", key, ("xla", "pallas_panel"),
+                        "pallas_panel")
+    return _default("dist_panel", key, ("xla", "pallas_panel"), "xla")
 
 
 def choose_trtri_panel(n: int, dtype) -> str:
@@ -1119,7 +1343,7 @@ def choose_trtri_panel(n: int, dtype) -> str:
     if mode == "on":
         return _static("trtri_panel", key, "pallas", "forced-config")
     if not _on_tpu():
-        return _static("trtri_panel", key, "xla", "default")
+        return _default("trtri_panel", key, ("xla", "pallas"), "xla")
 
     probes: dict = {}
 
@@ -1172,7 +1396,7 @@ def choose_geqrf_panel(m: int, n: int, nb: int, dtype) -> str:
     if mode == "on":
         return _static("geqrf_panel", key, "cholqr2", "forced-config")
     if not _on_tpu():
-        return _static("geqrf_panel", key, "xla", "default")
+        return _default("geqrf_panel", key, ("cholqr2", "xla"), "xla")
 
     probes: dict = {}
 
@@ -1234,7 +1458,9 @@ def choose_chase(kind: str, n: int, kd: int, dtype, eligible: bool) -> str:
         forced = _forced("chase")
         if forced == "pallas_wavefront":
             return _static("chase", key, forced, "forced")
-        return _static("chase", key, "host_native", "default")
+        return _default("chase", key,
+                        ("host_native", "pallas_wavefront"),
+                        "host_native")
 
     from .. import native
 
@@ -1348,7 +1574,8 @@ def _batched_common(op: str, b: int, n: int, dtype, eligible: bool,
         forced = _forced(op)
         if forced in (grid_name, "vmapped"):
             return key, dt, _static(op, key, forced, "forced")
-        return key, dt, _static(op, key, "vmapped", "default")
+        return key, dt, _default(op, key, (grid_name, "vmapped"),
+                                 "vmapped")
     return key, dt, None
 
 

@@ -125,6 +125,14 @@ class Artifact:
     submetrics: dict = field(default_factory=dict)
     autotune: dict = field(default_factory=dict)
     attribution: dict = field(default_factory=dict)
+    #: the active offline autotune bundle's identity (bench r11+ tags
+    #: every artifact with ``{"digest", "version", ...}`` or null —
+    #: whether the numbers came from a bundle-warm or probe-cold
+    #: process); None for older artifacts.  A digest change between
+    #: consecutive artifacts surfaces as a NOTE line next to the
+    #: verdicts — like a backend tag change, it must annotate, never
+    #: re-key, the alignment.
+    bundle: Optional[dict] = None
     infra: List[str] = field(default_factory=list)
     #: non-fatal annotations (e.g. ``retried_infra=true`` — the run
     #: absorbed a transient backend-init failure via the resilience
@@ -212,6 +220,8 @@ def load_artifact(path: str) -> "Artifact":
     art.attribution = {k: v for k, v in ab.items()
                        if isinstance(v, dict)} \
         if isinstance(ab, dict) else {}
+    bd = agg.get("bundle")
+    art.bundle = bd if isinstance(bd, dict) else None
     if not art.submetrics:
         art.infra.append("no parsed routines")
     if agg.get("partial"):
@@ -323,6 +333,21 @@ def diff(artifacts: List[Artifact],
     order = {"REGRESS": 0, "GONE": 1, "NEW": 2, "IMPROVE": 3, "OK": 4,
              "n/a": 5}
     rows.sort(key=lambda r: (order.get(r.verdict, 9), r.label))
+    # a bundle-version change between consecutive artifacts is a NOTE
+    # (provenance, like retried_infra): the numbers are comparable, but
+    # the reader must know one run was bundle-warm where the other was
+    # probe-cold (or swept against a different offline table)
+    prev_digest, seen_first = None, False
+    for a in artifacts:
+        if a.aggregate is None:
+            continue
+        cur = (a.bundle or {}).get("digest")
+        if seen_first and cur != prev_digest:
+            note = "bundle changed: %s -> %s" % (prev_digest or "none",
+                                                 cur or "none")
+            if note not in a.notes:
+                a.notes.append(note)
+        prev_digest, seen_first = cur, True
     return Report(rows=rows, artifacts=list(artifacts),
                   threshold_pct=threshold_pct)
 

@@ -1,0 +1,878 @@
+"""Offline autotune sweep engine, cost-model decision tables, and the
+versioned warm-start bundle (Autotune v2).
+
+Runtime first-use probing is a cold-start tax a production replica
+serving millions of users cannot pay, and pow2 buckets multiply the
+probe count.  SLATE itself ships tuned tile-size defaults instead of
+probing at run time, and the tile-granularity literature
+(Design-in-Tiles, BLASX — PAPERS.md) shows analytical models can select
+near-optimal configurations without exhaustive timing.  This module
+connects the two halves the library already owns — the persisted
+timing table (:mod:`.autotune`) and the analytical roofline
+(:mod:`.attr`) — into an OFFLINE layer between measurement and
+dispatch:
+
+1. **Enumerate** the candidate space per autotune site — backend,
+   fusion depth, nb, batch-per-launch — across a shape/dtype grid
+   (:data:`GRIDS`, or a custom spec through ``tools/sweep.py``).
+2. **Prune analytically before any clock starts**: every candidate is
+   priced with :func:`slate_tpu.perf.attr.predict_seconds` (roofline
+   minima + launch latency per materialized HBM round trip); a
+   candidate beyond a configurable ``margin`` of the predicted best is
+   SKIPPED, and the skip is recorded with its predicted gap so the
+   pruning is auditable (``bundle["pruned"]``).
+3. **Time the survivors** through the existing
+   :meth:`~slate_tpu.perf.autotune.AutotuneTable.decide` machinery
+   (``force_timing=True`` on a sweep-private table) with resumable
+   checkpointing and the classified-infra retry from
+   :mod:`slate_tpu.resilience.retry`.
+4. **Fit an interpolating decision model** — piecewise (inverse-
+   distance-blended nearest neighbors) over the pow2 key lattice in
+   log2 space — so shapes the sweep never timed still resolve
+   probe-free.  Selection is cross-checked against the analytical
+   model: a candidate the roofline prices more than
+   :data:`MODEL_GUARD`× the predicted best at the query shape can
+   never be selected by interpolation.
+
+The output is ONE **versioned warm-start bundle**: the decision table,
+the fitted model, AOT bucket specs for
+:func:`slate_tpu.serve.warm_start`, the pruning log, and the
+jax/jaxlib/platform/libtpu version key.  A serving replica boots with
+``SLATE_TPU_AUTOTUNE_BUNDLE=<path>``; :mod:`.autotune` consumes it as
+the first-priority source (forced pin → quarantine filter → bundle →
+cached timing → interpolating model → runtime probe fallback), with
+resilience quarantine events masking bundle entries the same way they
+mask cached winners.
+
+STDLIB-ONLY AT IMPORT, like ``regress.py``/``attr.py``: bundle loading
+and model evaluation must work in any process (and never start
+exporters or probes — registry-guard pinned); jax and the kernel
+layers are imported lazily inside the sweep-execution functions only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import tempfile
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+__all__ = [
+    "BUNDLE_ENV", "BUNDLE_FORMAT", "GRIDS", "MODEL_GUARD", "SITES",
+    "SiteSpec", "build_bundle", "bundle_digest", "key_str",
+    "model_backend", "model_fit", "pow2_bucket", "predict_times",
+    "prune", "read_bundle", "run_sweep", "split_key",
+    "warm_specs_from_results", "write_bundle",
+]
+
+#: env var naming the active bundle file (consumed by perf/autotune.py)
+BUNDLE_ENV = "SLATE_TPU_AUTOTUNE_BUNDLE"
+
+#: bundle schema version; a reader rejects files it does not speak
+BUNDLE_FORMAT = 1
+
+#: analytical-rejection factor for the interpolating model: a candidate
+#: the roofline model prices more than this many times the predicted
+#: best at the QUERY shape can never be selected by interpolation
+#: (pinned in tests/test_sweep.py)
+MODEL_GUARD = 10.0
+
+
+def pow2_bucket(d, floor: int = 8) -> int:
+    """Next power of two ≥ d (with a floor) — THE one shared bucketing
+    helper: autotune decision keys (``autotune._bucket_dim``), serve
+    executable-bucket keys (``serve.queue._bucket``) and the sweep grid
+    keys all derive from this function, so the three layers can never
+    drift apart (agreement pinned in tests/test_sweep.py)."""
+    return max(int(floor), 1 << (max(1, int(d)) - 1).bit_length())
+
+
+def key_str(op: str, key_parts) -> str:
+    """The canonical decision-key string ``"op|part,part,..."`` shared
+    with the autotune table."""
+    return op + "|" + ",".join(str(p) for p in key_parts)
+
+
+def split_key(key_parts):
+    """Split a decision key into ``(log2 coords, ctx)``: integer parts
+    become log2 coordinates (the pow2 lattice the model interpolates
+    over), string parts (dtype, precision) join into the exact-match
+    context."""
+    coords, ctx = [], []
+    for p in key_parts:
+        if isinstance(p, bool):
+            ctx.append(str(p))
+        elif isinstance(p, (int, float)):
+            coords.append(math.log2(max(1.0, float(p))))
+        else:
+            ctx.append(str(p))
+    return coords, ",".join(ctx)
+
+
+def _attr():
+    """The roofline pricing engine (``perf/attr.py``) — imported the
+    dual-life way (package-relative, else by file path) so the bundle
+    side of this module keeps working when loaded standalone on a
+    jax-free machine, exactly like ``regress.py`` does."""
+    try:
+        from . import attr
+        return attr
+    except ImportError:
+        import importlib.util
+        import sys
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "attr.py")
+        name = "_slate_tpu_attr"
+        if name in sys.modules:
+            return sys.modules[name]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
+# ---------------------------------------------------------------------------
+# Candidate pricing (the analytical pre-prune)
+# ---------------------------------------------------------------------------
+
+_SHORT_DTYPE = {"float32": "fp32", "float64": "fp64", "bfloat16": "bf16",
+                "complex64": "c64", "complex128": "c128"}
+
+#: effective slice-pass multiplier of the Ozaki int8-split fp64 matmul
+#: vs one bf16 MXU pass (compute AND operand traffic)
+_OZAKI_PASSES = 6.0
+
+
+def _short(dt) -> str:
+    return _SHORT_DTYPE.get(str(dt), "fp32")
+
+
+def _fusion_predict(routine: str, dims_of: Callable, fusion_of: dict):
+    """Pricing for sites whose candidates are FUSION DEPTHS of one
+    routine (or driver/backend pairs that map onto depths): each name
+    is priced as :func:`attr.predict_seconds` at its fusion, so the
+    materialized-round-trip term is what separates them.  An unknown
+    candidate name (or a missing stage model) disables pruning for the
+    whole unit — the sweep must never skip what it cannot price."""
+    def predict(key_parts, names, platform):
+        dims, dt = dims_of(key_parts)
+        a = _attr()
+        out = {}
+        for name in names:
+            f = fusion_of.get(name)
+            if f is None:
+                return {}
+            t = a.predict_seconds(routine, dims, dt, fusion=f,
+                                  platform=platform)
+            if t is None:
+                return {}
+            out[name] = t
+        return out
+    return predict
+
+
+def _dims_mnnb(key_parts):
+    m, n, nb = (int(x) for x in key_parts[:3])
+    return {"m": m, "n": n, "nb": nb}, _short(key_parts[3])
+
+
+def _dims_nnb(key_parts):
+    n, nb = int(key_parts[0]), int(key_parts[1])
+    return {"n": n, "nb": nb}, _short(key_parts[2])
+
+
+def _dims_batched(key_parts):
+    b, n = int(key_parts[0]), int(key_parts[1])
+    # the grid kernels hold whole problems VMEM-resident on an ib=32
+    # block grid; the vmapped composition steps nb=32 panels through HBM
+    return {"n": n, "b": b, "nb": min(n, 32)}, _short(key_parts[2])
+
+
+def _predict_matmul(key_parts, names, platform):
+    """Backend pricing for the 2-D product site: XLA and Pallas run the
+    same MXU pass (indistinguishable analytically — neither is ever
+    pruned against the other); the Ozaki fp64 split pays
+    :data:`_OZAKI_PASSES` bf16-grade passes vs XLA's software-emulated
+    fp64 peak — the one matmul choice the model CAN separate."""
+    m, k, n = (int(x) for x in key_parts[:3])
+    dt = _short(key_parts[3])
+    a = _attr()
+    fl = 2.0 * m * k * n
+    isz = {"fp64": 8, "c64": 8, "c128": 16, "bf16": 2}.get(dt, 4)
+    by = (m * k + k * n + 2.0 * m * n) * isz
+    out = {}
+    for name in names:
+        if name == "ozaki":
+            pk = a.peaks(platform, "bf16")
+            t = (fl * _OZAKI_PASSES / (pk["tflops"] * 1e12)
+                 + by * _OZAKI_PASSES / (pk["hbm_gbs"] * 1e9))
+        else:
+            pk = a.peaks(platform, dt)
+            t = max(fl / (pk["tflops"] * 1e12),
+                    by / (pk["hbm_gbs"] * 1e9))
+        out[name] = t
+    return out
+
+
+def predict_times(site: str, key_parts, names, platform: str = "tpu"
+                  ) -> dict:
+    """Model-predicted seconds per candidate for one sweep unit (or a
+    model-guard query).  ``{}`` when the site has no pricing — an
+    unpriced unit is never pruned and never guard-filtered."""
+    spec = SITES.get(site)
+    if spec is None:
+        return {}
+    try:
+        return dict(spec.predict(tuple(key_parts), list(names),
+                                 platform) or {})
+    except Exception:
+        return {}
+
+
+def prune(predicted: dict, names, margin: float):
+    """Split candidates into ``(survivors, pruned)`` on the analytical
+    prediction: a candidate priced more than ``margin`` (fractional)
+    above the predicted best is skipped before a single timing rep
+    runs.  Each pruned entry carries ``predicted_s`` /
+    ``best_predicted_s`` / ``predicted_gap`` so the skip is auditable.
+    With any candidate unpriced (or fewer than two candidates) nothing
+    is pruned; the predicted best always survives."""
+    names = list(names)
+    if len(names) < 2 or any(not isinstance(predicted.get(n2), (int, float))
+                             or predicted[n2] <= 0 for n2 in names):
+        return names, []
+    best = min(predicted[n2] for n2 in names)
+    survivors, dropped = [], []
+    for n2 in names:
+        if predicted[n2] <= best * (1.0 + float(margin)):
+            survivors.append(n2)
+        else:
+            dropped.append({
+                "candidate": n2,
+                "predicted_s": round(predicted[n2], 9),
+                "best_predicted_s": round(best, 9),
+                "predicted_gap": round(predicted[n2] / best, 3),
+            })
+    return survivors, dropped
+
+
+# ---------------------------------------------------------------------------
+# Site specs: candidate builders + pricing, one per swept autotune site
+# ---------------------------------------------------------------------------
+
+class SiteSpec(NamedTuple):
+    """One sweepable autotune site.
+
+    ``build(unit)`` (jax-side, imported lazily) returns ``(key_parts,
+    [Candidate, ...])`` with the SAME key derivation the runtime
+    chooser uses — a drifting key would write bundle entries dispatch
+    can never hit.  ``predict(key_parts, names, platform)`` returns
+    model-predicted seconds per candidate (``{}`` = unpriceable)."""
+
+    build: Callable
+    predict: Callable
+
+
+def _build_matmul(u):
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    m, k, n = (at._bucket_dim(int(u[d])) for d in ("m", "k", "n"))
+    key = (m, k, n, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _ab():
+        return at._memo(probes, "ab", lambda: (at._randn((m, k), dt, 0),
+                                               at._randn((k, n), dt, 1)))
+
+    if dt == jnp.float64:
+        def setup_ozaki():
+            from ..ops.ozaki import matmul_f64
+
+            return at._timed_call(matmul_f64, *_ab())
+
+        def setup_xla():
+            return at._timed_call(
+                lambda x, y: jnp.matmul(x, y,
+                                        precision=config.matmul_precision),
+                *_ab())
+
+        return key, [at.Candidate("ozaki", setup_ozaki),
+                     at.Candidate("xla", setup_xla)]
+
+    def setup_pallas():
+        from ..ops.pallas_kernels import matmul as pallas_matmul
+
+        def blk(dim, pref):
+            return pref if dim % pref == 0 else 128
+
+        return at._timed_call(
+            lambda x, y: pallas_matmul(x, y, bm=blk(m, 256), bn=blk(n, 256),
+                                       bk=blk(k, 512)), *_ab())
+
+    def setup_xla32():
+        return at._timed_call(
+            lambda x, y: jnp.matmul(x, y, precision=config.matmul_precision),
+            *_ab())
+
+    return key, [at.Candidate("xla", setup_xla32),
+                 at.Candidate("pallas", setup_pallas)]
+
+
+def _build_lu_step(u):
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    m, n, nb = int(u["m"]), int(u["n"]), int(u["nb"])
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    key = (m, n, nb, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _a():
+        return at._memo(probes, "a", lambda: at._randn((m, n), dt, 12))
+
+    def _setup(depth):
+        from ..linalg.lu import getrf_scattered
+
+        return at._timed_call(
+            lambda x: getrf_scattered(x, nb, step=depth), _a())
+
+    def check(out):
+        return at._lu_factor_residual_ok(out, _a(), m, n, dt)
+
+    return key, [at.Candidate(d, (lambda d=d: _setup(d)), check)
+                 for d in ("composed", "fused", "fused_trsm")]
+
+
+def _build_potrf_step(u):
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    n, nb = int(u["n"]), int(u["nb"])
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    key = (n, nb, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _spd():
+        return at._memo(probes, "spd", lambda: at._spd_probe(n, dt))
+
+    def setup_fused():
+        from ..ops import blocks
+
+        return at._timed_call(lambda x: blocks.potrf_steps(x, nb), _spd())
+
+    def setup_composed():
+        from ..ops import blocks
+
+        return at._timed_call(lambda x: blocks.potrf_panels(x, nb), _spd())
+
+    def check(out):
+        return at._potrf_guard(_spd(), out, 3.0)
+
+    return key, [at.Candidate("composed", setup_composed, check),
+                 at.Candidate("fused", setup_fused, check)]
+
+
+def _build_lu_driver(u):
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    m, n, nb = int(u["m"]), int(u["n"]), int(u["nb"])
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    key = (m, n, nb, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _a():
+        return at._memo(probes, "a", lambda: at._randn((m, n), dt, 8))
+
+    def setup_scattered():
+        from ..linalg.lu import getrf_scattered
+
+        return at._timed_call(lambda x: getrf_scattered(x, nb), _a())
+
+    def setup_rec():
+        from ..linalg.lu import getrf_rec
+
+        return at._timed_call(lambda x: getrf_rec(x, nb), _a())
+
+    def check(out):
+        return at._lu_factor_residual_ok(out, _a(), m, n, dt)
+
+    return key, [at.Candidate("rec", setup_rec, check),
+                 at.Candidate("scattered", setup_scattered, check)]
+
+
+def _build_batched(kind):
+    def build(u):
+        from . import autotune as at
+        import jax.numpy as jnp
+
+        bb, nn = pow2_bucket(int(u["b"])), pow2_bucket(int(u["n"]))
+        dt = jnp.dtype(u.get("dtype", "float32"))
+        key = (bb, nn, dt.name, at._precision_name())
+        probes: dict = {}
+
+        if kind == "potrf":
+            def _ops():
+                def mk():
+                    g = at._randn((bb, nn, nn), dt, 20)
+                    eye = nn * jnp.eye(nn, dtype=dt)
+                    return jnp.einsum("bij,bkj->bik", g, g) + eye[None]
+                return at._memo(probes, "a", mk)
+
+            def setup_grid():
+                from ..linalg.batched import _potrf_grid
+
+                return at._timed_call(_potrf_grid, _ops())
+
+            def setup_vmapped():
+                from ..linalg.batched import _potrf_vmapped
+
+                return at._timed_call(_potrf_vmapped, _ops())
+
+            def check(out):
+                from ..linalg.batched import batched_factor_resid_potrf
+
+                return batched_factor_resid_potrf(_ops(), out) < 100.0
+        else:
+            def _ops():
+                def mk():
+                    return (at._randn((bb, nn, nn), dt, 21)
+                            + nn * jnp.eye(nn, dtype=dt)[None])
+                return at._memo(probes, "a", mk)
+
+            def setup_grid():
+                from ..linalg.batched import _getrf_grid
+
+                return at._timed_call(_getrf_grid, _ops())
+
+            def setup_vmapped():
+                from ..linalg.batched import _getrf_vmapped
+
+                return at._timed_call(_getrf_vmapped, _ops())
+
+            def check(out):
+                from ..linalg.batched import batched_factor_resid_lu
+
+                return batched_factor_resid_lu(_ops(), out) < 100.0
+
+        return key, [at.Candidate("vmapped", setup_vmapped),
+                     at.Candidate("grid", setup_grid, check)]
+    return build
+
+
+SITES: Dict[str, SiteSpec] = {
+    "matmul": SiteSpec(_build_matmul, _predict_matmul),
+    "lu_step": SiteSpec(
+        _build_lu_step,
+        _fusion_predict("getrf", _dims_mnnb,
+                        {"composed": "composed", "fused": "fused",
+                         "fused_trsm": "fused_trsm"})),
+    "potrf_step": SiteSpec(
+        _build_potrf_step,
+        _fusion_predict("potrf", _dims_nnb,
+                        {"composed": "composed", "fused": "fused"})),
+    "lu_driver": SiteSpec(
+        _build_lu_driver,
+        # the scattered driver's step loop is the fused mega-kernel;
+        # the blocked recursion materializes the composed glue
+        _fusion_predict("getrf", _dims_mnnb,
+                        {"rec": "composed", "scattered": "fused"})),
+    "batched_potrf": SiteSpec(
+        _build_batched("potrf"),
+        _fusion_predict("potrf", _dims_batched,
+                        {"vmapped": "composed", "grid": "fused"})),
+    "batched_lu": SiteSpec(
+        _build_batched("lu"),
+        _fusion_predict("getrf", _dims_batched,
+                        {"vmapped": "composed", "grid": "fused"})),
+}
+
+
+# ---------------------------------------------------------------------------
+# Grids
+# ---------------------------------------------------------------------------
+
+def _full_units():
+    units = []
+    for n in (512, 1024, 2048, 4096, 8192):
+        units.append({"site": "matmul", "m": n, "k": n, "n": n,
+                      "dtype": "float32"})
+        if n >= 1024:
+            units.append({"site": "matmul", "m": n, "k": n, "n": n,
+                          "dtype": "float64"})
+    for n in (1024, 2048, 4096, 8192):
+        for nb in (256, 512):
+            units.append({"site": "lu_step", "m": n, "n": n, "nb": nb})
+            units.append({"site": "lu_driver", "m": n, "n": n, "nb": nb})
+            units.append({"site": "potrf_step", "n": n, "nb": nb})
+    for b in (8, 32, 64):
+        for n in (64, 128, 256, 512):
+            units.append({"site": "batched_potrf", "b": b, "n": n})
+            units.append({"site": "batched_lu", "b": b, "n": n})
+    return units
+
+
+#: named grids for ``tools/sweep.py --grid``.  ``smoke`` is the tiny
+#: CPU-runnable end-to-end grid ``run_tests.py --sweep`` drives; its
+#: shapes are the ones the interpret-mode CI already exercises.  The
+#: extra ``warm`` spec covers a serve bucket the grid never sweeps, so
+#: a bundle-booted replica proves the interpolating-model path too.
+GRIDS = {
+    "smoke": {
+        "margin": 0.1,
+        "units": [
+            {"site": "lu_step", "m": 256, "n": 256, "nb": 128},
+            {"site": "potrf_step", "n": 256, "nb": 128},
+            {"site": "lu_driver", "m": 256, "n": 256, "nb": 128},
+            {"site": "batched_potrf", "b": 4, "n": 64},
+            {"site": "batched_lu", "b": 4, "n": 64},
+        ],
+        "warm": [{"op": "posv", "batch": 1, "dims": [96],
+                  "dtype": "float32"}],
+    },
+    "full": {
+        "margin": 0.25,
+        "units": _full_units(),
+    },
+}
+
+
+# ---------------------------------------------------------------------------
+# The interpolating decision model
+# ---------------------------------------------------------------------------
+
+def model_fit(results) -> dict:
+    """Fit the decision model from sweep results: measured survivor
+    times (and the audited predictions) at every swept lattice point,
+    grouped ``{op: {ctx: [{"coords", "times"[, "predicted"]}]}}``.
+    Pruned candidates keep NO measured time — interpolation can only
+    ever select a candidate the sweep actually timed somewhere."""
+    model: dict = {}
+    for r in results:
+        coords, ctx = split_key(r.get("key_parts") or ())
+        pt = {"coords": [round(c, 6) for c in coords],
+              "times": {k: v for k, v in (r.get("times") or {}).items()
+                        if isinstance(v, (int, float)) and v > 0}}
+        if r.get("predicted"):
+            pt["predicted"] = dict(r["predicted"])
+        model.setdefault(r["site"], {}).setdefault(ctx, []).append(pt)
+    return model
+
+
+def model_backend(bundle: dict, op: str, key_parts, names,
+                  exclude=(), k: int = 4, guard: float = MODEL_GUARD
+                  ) -> Optional[str]:
+    """Resolve one UNSWEPT key through the bundle's fitted model:
+    inverse-distance-weighted geometric blend of each candidate's
+    measured times over the ``k`` nearest swept lattice points (L1 in
+    log2 space; the dtype/precision context must match exactly), then
+    argmin — with the analytical guard applied at the QUERY shape: a
+    candidate :func:`predict_times` prices more than ``guard``× the
+    predicted best is never selected, however its blended time reads.
+    None when the model has no usable data for this key."""
+    sites = (bundle.get("model") or {}).get(op)
+    if not isinstance(sites, dict):
+        return None
+    coords, ctx = split_key(key_parts)
+    pts = sites.get(ctx)
+    if not isinstance(pts, list) or not pts:
+        return None
+    scored = sorted(
+        ((sum(abs(a - b) for a, b in zip(coords, p["coords"])), p)
+         for p in pts
+         if isinstance(p, dict)
+         and len(p.get("coords") or ()) == len(coords)),
+        key=lambda dp: dp[0])
+    near = scored[:max(1, int(k))]
+    if not near:
+        return None
+    exclude = set(exclude or ())
+    est = {}
+    for name in names:
+        if name in exclude:
+            continue
+        num = den = 0.0
+        for d, p in near:
+            t = (p.get("times") or {}).get(name)
+            if isinstance(t, (int, float)) and t > 0:
+                w = 1.0 / (1.0 + d)
+                num += w * math.log(t)
+                den += w
+        if den > 0:
+            est[name] = math.exp(num / den)
+    if not est:
+        return None
+    platform = ((bundle.get("version") or {}).get("platform")) or "tpu"
+    pred = predict_times(op, key_parts, list(names), platform)
+    if pred:
+        best = min((v for n2, v in pred.items()
+                    if n2 in est and isinstance(v, (int, float)) and v > 0),
+                   default=None)
+        if best:
+            est = {n2: t for n2, t in est.items()
+                   if not isinstance(pred.get(n2), (int, float))
+                   or pred[n2] <= guard * best}
+    if not est:
+        return None
+    return min(est, key=est.get)
+
+
+# ---------------------------------------------------------------------------
+# The bundle artifact
+# ---------------------------------------------------------------------------
+
+def bundle_digest(blob: dict) -> str:
+    """Content digest over the decision-bearing parts (decisions +
+    model + version) — what bench.py tags artifacts with so a diff can
+    NOTE a bundle change between rounds."""
+    core = {"decisions": blob.get("decisions") or {},
+            "model": blob.get("model") or {},
+            "version": blob.get("version") or {}}
+    payload = json.dumps(core, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest()[:16]
+
+
+def build_bundle(results, version: dict, *, pruned=(), grid_name: str = "",
+                 warm=(), stats: Optional[dict] = None) -> dict:
+    """Assemble the versioned warm-start bundle from sweep results."""
+    decisions = {}
+    for r in results:
+        decisions[key_str(r["site"], r["key_parts"])] = {
+            "backend": r["backend"],
+            "times": {k: v for k, v in (r.get("times") or {}).items()
+                      if isinstance(v, (int, float))},
+        }
+    blob = {
+        "format": BUNDLE_FORMAT,
+        "version": dict(version),
+        "grid": grid_name,
+        "decisions": decisions,
+        "model": model_fit(results),
+        "pruned": [dict(p) for p in pruned],
+        "warm_start": [dict(s) for s in warm],
+        "stats": dict(stats or {}),
+    }
+    blob["digest"] = bundle_digest(blob)
+    return blob
+
+
+def read_bundle(path: str) -> dict:
+    """Load one bundle file.  Raises ``OSError``/``ValueError`` on an
+    unreadable or malformed file (the autotune loader classifies those
+    as ``autotune.bundle.unreadable``); a format this reader does not
+    speak is malformed too."""
+    with open(path) as f:
+        blob = json.load(f)
+    if not isinstance(blob, dict) \
+            or blob.get("format") != BUNDLE_FORMAT \
+            or not isinstance(blob.get("decisions", {}), dict):
+        raise ValueError(f"not a v{BUNDLE_FORMAT} autotune bundle: {path}")
+    return blob
+
+
+def write_bundle(path: str, blob: dict) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                exist_ok=True)
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump(blob, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+#: autotune batched-site op → the serve ops its sweep results warm
+#: (same mapping as serve.queue.specs_from_autotune_cache)
+_SITE_TO_SERVE = {"batched_potrf": ("potrf", "posv"),
+                  "batched_lu": ("getrf", "gesv"),
+                  "batched_qr": ("geqrf",)}
+
+
+def warm_specs_from_results(results, extra=()) -> list:
+    """AOT warm-start bucket specs for :func:`slate_tpu.serve.
+    warm_start`, derived from the swept ``batched_*`` sites (each key
+    names a (bucketed batch, bucketed n, dtype) a replica will serve)
+    plus any grid-spec extras."""
+    specs, seen = [], set()
+
+    def _add(sp):
+        sk = json.dumps(sp, sort_keys=True)
+        if sk not in seen:
+            seen.add(sk)
+            specs.append(sp)
+
+    for sp in extra:
+        if isinstance(sp, dict) and "op" in sp:
+            _add(dict(sp))
+    for r in results:
+        ops = _SITE_TO_SERVE.get(r.get("site"))
+        if not ops:
+            continue
+        kp = list(r.get("key_parts") or ())
+        try:
+            if r["site"] == "batched_qr":
+                b, dims, dt = int(kp[0]), [int(kp[1]), int(kp[2])], \
+                    str(kp[3])
+            else:
+                b, dims, dt = int(kp[0]), [int(kp[1])], str(kp[2])
+        except (ValueError, IndexError):
+            continue
+        for op in ops:
+            _add({"op": op, "batch": b, "dims": dims, "dtype": dt})
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# The sweep engine
+# ---------------------------------------------------------------------------
+
+def _write_checkpoint(path: str, done: dict) -> None:
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        json.dump({"format": BUNDLE_FORMAT, "done": done}, f)
+    os.replace(tmp, path)
+
+
+def run_sweep(grid="smoke", *, margin: Optional[float] = None,
+              reps: Optional[int] = None, checkpoint: Optional[str] = None,
+              resume: bool = False, out: Optional[str] = None,
+              table_path: Optional[str] = None,
+              log: Optional[Callable] = None) -> dict:
+    """Run the offline sweep and return (and optionally write) the
+    bundle.
+
+    ``grid`` is a name from :data:`GRIDS` or a spec dict (``{"units":
+    [...], "margin": ..., "warm": [...], "name": ...}``).  Per unit:
+    build the candidates with the runtime key derivation, PRICE them
+    analytically, skip the model-predicted losers past ``margin``
+    (logged with their predicted gap), and time the survivors through
+    ``AutotuneTable.decide(force_timing=True)`` on a sweep-private
+    table.  Each completed unit is checkpointed (``--resume`` skips
+    it on the next run) and transient infra failures take one
+    classified retry (:mod:`slate_tpu.resilience.retry`); a unit that
+    still fails is recorded in ``stats["units_failed"]`` and never
+    kills the sweep."""
+    from . import autotune as at
+    from ..resilience.retry import transient_infra, with_backoff
+
+    if isinstance(grid, str):
+        spec = GRIDS[grid]
+        grid_name = grid
+    else:
+        spec = dict(grid)
+        grid_name = str(spec.get("name", "custom"))
+    units = list(spec.get("units") or ())
+    margin = float(spec.get("margin", 0.25)) if margin is None \
+        else float(margin)
+    reps = at._REPS if reps is None else int(reps)
+    say = log or (lambda *a: None)
+    version = at._version_key()
+    platform = version.get("platform") or "tpu"
+    if platform not in ("tpu", "cpu"):
+        platform = "tpu"
+
+    done: dict = {}
+    if checkpoint and resume and os.path.exists(checkpoint):
+        try:
+            with open(checkpoint) as f:
+                done = (json.load(f) or {}).get("done", {}) or {}
+        except (OSError, ValueError):
+            done = {}
+
+    if table_path is None:
+        table_path = (checkpoint + ".table") if checkpoint else \
+            os.path.join(tempfile.mkdtemp(prefix="slate_tpu_sweep_"),
+                         "table.json")
+    tab = at.AutotuneTable(path=table_path)
+
+    results, pruned_log = [], []
+    stats = {"units": 0, "units_resumed": 0, "units_failed": 0,
+             "candidates": 0, "reps_timed": 0, "reps_saved": 0}
+    seen_this_run: set = set()
+
+    for u in units:
+        site = u.get("site")
+        sspec = SITES.get(site)
+        if sspec is None:
+            say(f"# sweep: unknown site {site!r}, skipped")
+            continue
+
+        def _one(u=u, site=site, sspec=sspec):
+            key_parts, cands = sspec.build(u)
+            uid = key_str(site, key_parts)
+            if uid in done:
+                return dict(done[uid], resumed=True)
+            names = [c.name for c in cands]
+            predicted = predict_times(site, key_parts, names, platform)
+            survivors, dropped = prune(predicted, names, margin)
+            keep = [c for c in cands if c.name in survivors]
+            backend = tab.decide(site, key_parts, keep, reps=reps,
+                                 force_timing=True)
+            rec = tab.decisions.get(uid) or {}
+            times = {k: v for k, v in (rec.get("times") or {}).items()
+                     if isinstance(v, (int, float))}
+            return {"site": site, "key_parts": list(key_parts),
+                    "backend": backend, "times": times,
+                    "predicted": {k: round(v, 9)
+                                  for k, v in predicted.items()},
+                    "pruned": [dict(d, site=site, key=uid)
+                               for d in dropped],
+                    "n_candidates": len(names), "n_timed": len(keep)}
+
+        try:
+            res, _retries = with_backoff(
+                _one, attempts=2, classify=transient_infra,
+                metric="autotune.sweep.retries")
+        except Exception as e:
+            stats["units_failed"] += 1
+            say(f"# sweep unit FAILED: {site} {u}: "
+                f"{type(e).__name__}: {e}")
+            continue
+        uid = key_str(res["site"], res["key_parts"])
+        if uid in seen_this_run:
+            # two grid units bucketing to the same pow2 key (e.g. b=5
+            # and b=8): one lattice point, once — a duplicate would
+            # double-weight the model's nearest-neighbor blend and
+            # duplicate the pruning audit
+            stats["units_duplicate"] = stats.get("units_duplicate", 0) + 1
+            say(f"# sweep: duplicate unit {uid} "
+                "(same pow2 bucket), skipped")
+            continue
+        seen_this_run.add(uid)
+        done[uid] = {k: v for k, v in res.items() if k != "resumed"}
+        results.append(done[uid])
+        pruned_log.extend(done[uid].get("pruned") or ())
+        if res.get("resumed"):
+            stats["units_resumed"] += 1
+        else:
+            stats["units"] += 1
+            stats["candidates"] += res.get("n_candidates", 0)
+            stats["reps_timed"] += res.get("n_timed", 0) * reps
+            stats["reps_saved"] += (res.get("n_candidates", 0)
+                                    - res.get("n_timed", 0)) * reps
+            say(f"# swept {uid}: winner {res['backend']} "
+                f"({res['n_timed']}/{res['n_candidates']} timed, "
+                f"{len(res.get('pruned') or ())} pruned by model)")
+        if checkpoint:
+            try:
+                _write_checkpoint(checkpoint, done)
+            except OSError:
+                pass                    # read-only FS: in-memory only
+    stats["reps_exhaustive"] = stats["reps_timed"] + stats["reps_saved"]
+    stats["timing_reps_actual"] = tab.timing_reps
+    warm = warm_specs_from_results(results, extra=spec.get("warm") or ())
+    bundle = build_bundle(results, version, pruned=pruned_log,
+                          grid_name=grid_name, warm=warm, stats=stats)
+    if out:
+        write_bundle(out, bundle)
+        say(f"# bundle written: {out} (digest {bundle['digest']}, "
+            f"{len(bundle['decisions'])} decisions, "
+            f"{stats['reps_timed']}/{stats['reps_exhaustive']} "
+            f"exhaustive reps timed)")
+    return bundle

@@ -215,7 +215,14 @@ def _quarantine_for(name: str, reason: str) -> int:
         op = info.get("op") or key.split("|", 1)[0]
         if op not in sites:
             continue
-        if info.get("source") not in ("timed", "cache"):
+        # settled, demotable evidence: locally timed winners, cached
+        # winners, AND offline-bundle winners ("bundle"/"bundle-model")
+        # — a failed gate must mask a poisoned offline decision too
+        # (the quarantine write makes autotune's ladder skip the bundle
+        # entry for this key until the TTL expires), never leave it
+        # pinned.  Heuristic records stay untouchable as before.
+        if info.get("source") not in ("timed", "cache", "bundle",
+                                      "bundle-model"):
             continue
         backend = info.get("backend")
         if backend == autotune.safe_backend(op):

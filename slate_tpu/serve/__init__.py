@@ -2,8 +2,10 @@
 drivers (:mod:`slate_tpu.linalg.batched`): request-batching queue with
 (op, dtype, shape-bucket) buckets under a max-wait/max-batch policy,
 one AOT-compiled executable per bucket, futures back to the caller,
-and a zero-compile warm start from the persisted autotune cache.  See
-:mod:`slate_tpu.serve.queue` for the full design.
+and a zero-compile warm start from the offline autotune bundle
+(``SLATE_TPU_AUTOTUNE_BUNDLE``, see :mod:`slate_tpu.perf.sweep`) or
+the persisted autotune cache.  See :mod:`slate_tpu.serve.queue` for
+the full design.
 
 Quick start::
 
@@ -25,5 +27,6 @@ telemetry" section of ``docs/usage.md``).
 
 from .queue import (  # noqa: F401
     Backpressure, BatchQueue, ServeConfig, SUPPORTED_OPS, get_server,
-    shutdown, specs_from_autotune_cache, submit, warm_start,
+    shutdown, specs_from_autotune_cache, specs_from_bundle, submit,
+    warm_start,
 )

@@ -125,13 +125,15 @@ from typing import Dict, List, Optional, Tuple
 from ..exceptions import SlateError
 from ..perf import metrics
 from ..perf import telemetry as _telemetry
+from ..perf.sweep import pow2_bucket as _pow2_bucket
 from ..resilience import health as _health
 from ..resilience import inject as _inject
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import transient_infra, with_backoff
 
 __all__ = ["ServeConfig", "BatchQueue", "Backpressure", "warm_start",
-           "get_server", "submit", "shutdown", "SUPPORTED_OPS"]
+           "get_server", "submit", "shutdown", "SUPPORTED_OPS",
+           "specs_from_bundle"]
 
 
 class Backpressure(SlateError):
@@ -160,10 +162,14 @@ def _finite_arrays(out) -> bool:
 def _bucket(d: int, policy: str = "pow2", floor: int = 8) -> int:
     """Pow2 shape bucket (floor 8 for dims — the autotune keys' floor;
     batch OCCUPANCY buckets pass floor=1 so a lone request is not padded
-    8×) — one compiled executable per bucket."""
+    8×) — one compiled executable per bucket.  Delegates to the ONE
+    shared pow2 helper (:func:`slate_tpu.perf.sweep.pow2_bucket`) also
+    used by the autotune cache keys and the offline sweep grid, so the
+    three layers can never bucket the same shape differently (pinned in
+    tests/test_sweep.py)."""
     if policy == "exact":
         return int(d)
-    return max(floor, 1 << (max(1, int(d)) - 1).bit_length())
+    return _pow2_bucket(d, floor)
 
 
 @dataclass
@@ -1050,6 +1056,20 @@ def specs_from_autotune_cache() -> List[dict]:
     return specs
 
 
+def specs_from_bundle() -> List[dict]:
+    """Warm-start specs carried by the ACTIVE offline autotune bundle
+    (``SLATE_TPU_AUTOTUNE_BUNDLE``; empty list without one): the AOT
+    bucket specs the sweep decided a fresh replica should compile
+    before its first request — the item the fleet router distributes
+    so a brand-new process boots with zero probes AND zero compiles."""
+    from ..perf import autotune
+
+    try:
+        return list(autotune.bundle_warm_specs())
+    except Exception:
+        return []
+
+
 def warm_start(server: Optional[BatchQueue] = None,
                specs: Optional[list] = None) -> int:
     """AOT-compile the bucket executables a serving process will need,
@@ -1057,16 +1077,18 @@ def warm_start(server: Optional[BatchQueue] = None,
 
     ``specs`` is a list of ``{"op", "batch", "dims", "dtype"[, "nrhs"]}``
     dicts (dims = (n,) for square ops, (m, n) for geqrf/gels); when
-    omitted they are derived from the persisted autotune cache
+    omitted they come from the active warm-start bundle
+    (:func:`specs_from_bundle` — the offline sweep's AOT bucket specs)
+    or, without a bundle, are derived from the persisted autotune cache
     (:func:`specs_from_autotune_cache`) — the shapes this machine has
     served before.  Returns the number of executables compiled.  After
     a warm start, the first request of every warmed bucket runs with
-    zero autotune timing reps (decisions come from the persisted cache)
-    and zero on-demand compiles (``serve.compile.on_demand`` stays 0 —
-    pinned in CI)."""
+    zero autotune timing reps (decisions come from the bundle or the
+    persisted cache) and zero on-demand compiles
+    (``serve.compile.on_demand`` stays 0 — pinned in CI)."""
     srv = server or get_server()
     if specs is None:
-        specs = specs_from_autotune_cache()
+        specs = specs_from_bundle() or specs_from_autotune_cache()
     done = 0
     with metrics.timer("serve.warm_start"):
         for sp in specs:

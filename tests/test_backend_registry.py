@@ -16,9 +16,12 @@ _PKG = pathlib.Path(st.__file__).resolve().parent
 
 #: modules allowed to name the kernel modules in import statements:
 #: the op layer itself (the kernels live there and ops/blocks.py IS the
-#: dispatch call site) and the autotune table (it times the kernels and
-#: serves them to registered backends via ``autotune.kernel``).
-_ALLOWED = {"ops", "perf/autotune.py"}
+#: dispatch call site), the autotune table (it times the kernels and
+#: serves them to registered backends via ``autotune.kernel``), and the
+#: offline sweep engine (the measurement layer's batch mode: it times
+#: the same candidates the table would, just offline — lazily, inside
+#: its jax-side builders only).
+_ALLOWED = {"ops", "perf/autotune.py", "perf/sweep.py"}
 
 _IMPORT_RE = re.compile(
     r"^\s*(?:from\s+[\w.]*\s+import\s+.*\b(pallas_kernels|ozaki)\b"
@@ -135,6 +138,80 @@ def test_telemetry_exporters_never_started_by_import():
         "print('OK')\n")
     with tempfile.TemporaryDirectory() as td:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_METRICS_PORT="0",
+                   SLATE_TPU_TELEMETRY_LOG=os.path.join(td, "t.jsonl"),
+                   SLATE_TPU_TELEMETRY="1")
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
+#: the dispatch layer must never depend on the OFFLINE layer's sweep
+#: engine: ops/ (and the linalg drivers) importing sweep would put the
+#: sweep's jax-side builders on the serving import path.  Only
+#: perf/autotune.py (bundle consumption), perf/__init__.py (lazy
+#: export) and serve/queue.py (the shared pow2 bucket helper) may name
+#: it.
+_SWEEP_IMPORT_RE = re.compile(
+    r"^\s*(?:from\s+[.\w]*\bsweep\b\s+import"
+    r"|from\s+[.\w]+\s+import\s+[^#\n]*\bsweep\b"
+    r"|import\s+[.\w]*\bsweep\b)")
+
+_SWEEP_ALLOWED = {"perf/autotune.py", "perf/__init__.py",
+                  "serve/queue.py"}
+
+
+def test_sweep_never_imported_outside_consumers():
+    offenders = []
+    for path in sorted(_PKG.rglob("*.py")):
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        if rel in _SWEEP_ALLOWED or rel == "perf/sweep.py":
+            continue
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _SWEEP_IMPORT_RE.match(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "perf/sweep.py imported outside its consumers (the offline "
+        "sweep layer must stay off the dispatch import path — consume "
+        "bundles through perf.autotune):\n" + "\n".join(offenders))
+
+
+def test_bundle_loading_inert_at_import():
+    """ISSUE 11 guard: with SLATE_TPU_AUTOTUNE_BUNDLE (and every
+    exporter env knob) SET, importing the autotune/serve modules must
+    not read the bundle, construct the decision table, start exporter
+    threads, or run a probe — bundle consumption begins at the first
+    table() use, never at import.  Subprocess, like the exporter
+    guard above."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    code = (
+        "import threading\n"
+        "import slate_tpu.perf.autotune as at\n"
+        "import slate_tpu.perf.sweep\n"
+        "import slate_tpu.serve\n"
+        "assert at._table is None, 'table constructed at import'\n"
+        "assert at.timing_reps.__call__ is not None\n"
+        "bad = [t.name for t in threading.enumerate()\n"
+        "       if t.name.startswith(('slate-telemetry',\n"
+        "                             'slate-serve'))]\n"
+        "assert not bad, bad\n"
+        "from slate_tpu.perf import telemetry\n"
+        "assert telemetry.exporter_port() is None\n"
+        "print('OK')\n")
+    with tempfile.TemporaryDirectory() as td:
+        # the bundle file is deliberately MALFORMED: if any import-time
+        # code path tried to read it, the table would count it
+        # unreadable — but nothing may even open it before table()
+        bundle = os.path.join(td, "bundle.json")
+        with open(bundle, "w") as f:
+            f.write("{not json")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_AUTOTUNE_BUNDLE=bundle,
                    SLATE_TPU_METRICS_PORT="0",
                    SLATE_TPU_TELEMETRY_LOG=os.path.join(td, "t.jsonl"),
                    SLATE_TPU_TELEMETRY="1")

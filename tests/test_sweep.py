@@ -1,0 +1,591 @@
+"""Autotune v2 (slate_tpu/perf/sweep.py): analytical pre-pruning with
+audited predicted gaps, the resumable sweep engine, bundle round-trip
+(fresh module state resolves probe-free from the bundle, including
+shapes the sweep never timed via the interpolating model),
+stale-version rejection, quarantine-masks-bundle-entry, the >10×
+analytical model guard, the shared pow2 bucketing helper across
+autotune/serve/sweep keys, and the serve warm-start-from-bundle
+zero-compile boot."""
+
+import importlib
+import json
+import time
+
+import numpy as np
+import pytest
+
+from slate_tpu.perf import autotune, metrics, sweep
+
+
+@pytest.fixture
+def atab(tmp_path, monkeypatch):
+    """Fresh table on a tmp cache (the test_autotune pattern)."""
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    autotune.reset_table()
+    yield autotune
+    autotune.reset_table()
+
+
+def _toy(name, delay, result="out"):
+    def setup():
+        def run():
+            time.sleep(delay)
+            return result
+        return run
+    return autotune.Candidate(name, setup)
+
+
+def _toy_site(predicted, durations):
+    """A sweepable toy site: key (n, dtype, precision) like the real
+    pow2-keyed sites, candidates that just sleep."""
+    def build(u):
+        key = (int(u["n"]), "float32", "HIGH")
+        return key, [_toy(n2, d) for n2, d in durations.items()]
+
+    def predict(key_parts, names, platform):
+        return dict(predicted)
+
+    return sweep.SiteSpec(build, predict)
+
+
+def _results(keys, times, site="toyop", backend=None):
+    return [{"site": site, "key_parts": list(kp), "times": dict(times),
+             "backend": backend or min(times, key=times.get)}
+            for kp in keys]
+
+
+def _write(tmp_path, results, warm=(), version=None, pruned=()):
+    blob = sweep.build_bundle(results, version or autotune._version_key(),
+                              pruned=pruned, grid_name="test", warm=warm)
+    path = tmp_path / "bundle.json"
+    sweep.write_bundle(str(path), blob)
+    return str(path), blob
+
+
+class TestSharedBucketing:
+    def test_one_pow2_helper_everywhere(self):
+        """The ISSUE 11 bucketing fix: sweep grid keys, autotune cache
+        keys and serve bucket keys must derive from ONE helper."""
+        from slate_tpu.serve.queue import _bucket as serve_bucket
+
+        for d in (1, 5, 8, 9, 37, 100, 511, 512, 513):
+            assert autotune._bucket_dim(d) == sweep.pow2_bucket(d)
+            assert serve_bucket(d) == sweep.pow2_bucket(d)
+            assert serve_bucket(d, floor=1) == sweep.pow2_bucket(d, 1)
+
+    def test_serve_autotune_sweep_keys_agree_for_same_shape(self, atab):
+        """For one raw shape, the serve bucket key, the batched
+        chooser's recorded decision key and the sweep builder's grid
+        key all name the SAME pow2 bucket."""
+        from slate_tpu.linalg import batched
+        from slate_tpu.serve.queue import BatchQueue
+
+        b, n = 3, 50
+        big = sweep.pow2_bucket(n)                      # 64
+        srv = BatchQueue()
+        skey = srv.bucket_key("potrf",
+                              (np.zeros((n, n), np.float32),))
+        srv.close()
+        assert skey == ("potrf", "float32", big)
+
+        rng = np.random.default_rng(0)
+        g = rng.standard_normal((b, n, n)).astype(np.float32)
+        spd = (np.einsum("bij,bkj->bik", g, g)
+               + n * np.eye(n, dtype=np.float32))
+        batched.potrf_batched(spd)
+        dec_keys = [k for k in autotune.decisions()
+                    if k.startswith("batched_potrf|")]
+        assert len(dec_keys) == 1
+
+        key_parts, _cands = sweep.SITES["batched_potrf"].build(
+            {"b": b, "n": n})
+        assert sweep.key_str("batched_potrf", key_parts) == dec_keys[0]
+        assert key_parts[0] == sweep.pow2_bucket(b)
+        assert key_parts[1] == big
+
+
+class TestPruning:
+    def test_prune_logs_predicted_gap(self):
+        pred = {"a": 1.0, "b": 1.05, "c": 3.0, "d": 9.0}
+        surv, dropped = sweep.prune(pred, ["a", "b", "c", "d"], 0.25)
+        assert surv == ["a", "b"]
+        assert [d["candidate"] for d in dropped] == ["c", "d"]
+        assert [d["predicted_gap"] for d in dropped] == [3.0, 9.0]
+        assert all(d["best_predicted_s"] == 1.0 for d in dropped)
+
+    def test_unpriced_units_never_pruned(self):
+        surv, dropped = sweep.prune({"a": 1.0}, ["a", "b"], 0.25)
+        assert surv == ["a", "b"] and not dropped
+        assert sweep.predict_times("no_such_site", (64,), ["a"]) == {}
+
+    def test_sweep_cuts_reps_2x_and_audits_skips(self, atab, tmp_path,
+                                                 monkeypatch):
+        """The acceptance pin: on a grid the model can price, pruning
+        cuts timing reps ≥2× vs exhaustive, and every skipped candidate
+        lands in bundle["pruned"] with its predicted gap."""
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            _toy_site({"a": 1.0, "b": 1.05, "c": 3.0, "d": 9.0},
+                      {"a": 0.0, "b": 0.002, "c": 0.02, "d": 0.02}))
+        grid = {"name": "toy", "margin": 0.25,
+                "units": [{"site": "toyop", "n": 64},
+                          {"site": "toyop", "n": 128}]}
+        bundle = sweep.run_sweep(
+            grid, table_path=str(tmp_path / "table.json"))
+        st = bundle["stats"]
+        assert st["reps_exhaustive"] >= 2 * st["reps_timed"] > 0
+        assert st["timing_reps_actual"] == st["reps_timed"]
+        assert len(bundle["pruned"]) == 4
+        for p in bundle["pruned"]:
+            assert p["predicted_gap"] >= 1.25
+            assert p["predicted_s"] > p["best_predicted_s"]
+        assert bundle["decisions"]["toyop|64,float32,HIGH"]["backend"] \
+            == "a"
+
+    def test_smoke_grid_prunes_every_fusion_site_to_one(self):
+        """The shipped smoke grid's pruning is deterministic: every
+        unit the roofline can price keeps exactly ONE survivor at its
+        margin (the ≥2× rep cut run_tests --sweep pins end-to-end)."""
+        grid = sweep.GRIDS["smoke"]
+        cases = {
+            "lu_step": ["composed", "fused", "fused_trsm"],
+            "potrf_step": ["composed", "fused"],
+            "lu_driver": ["rec", "scattered"],
+            "batched_potrf": ["vmapped", "grid"],
+            "batched_lu": ["vmapped", "grid"],
+        }
+        total = timed = 0
+        for u in grid["units"]:
+            names = cases[u["site"]]
+            if u["site"].startswith("batched"):
+                kp = (sweep.pow2_bucket(u["b"]),
+                      sweep.pow2_bucket(u["n"]), "float32", "HIGH")
+            elif u["site"] == "potrf_step":
+                kp = (u["n"], u["nb"], "float32", "HIGH")
+            else:
+                kp = (u["m"], u["n"], u["nb"], "float32", "HIGH")
+            pred = sweep.predict_times(u["site"], kp, names, "cpu")
+            surv, dropped = sweep.prune(pred, names, grid["margin"])
+            assert len(surv) == 1, (u, pred)
+            total += len(names)
+            timed += len(surv)
+        assert total >= 2 * timed
+
+
+class TestSweepEngine:
+    def test_checkpoint_resume_skips_done_units(self, atab, tmp_path,
+                                                monkeypatch):
+        calls = []
+
+        def build(u):
+            def setup():
+                calls.append(u["n"])
+                return lambda: "out"
+            return ((int(u["n"]), "float32", "HIGH"),
+                    [autotune.Candidate("a", setup),
+                     _toy("b", 0.005)])
+
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            sweep.SiteSpec(build, lambda kp, names, p: {}))
+        grid = {"units": [{"site": "toyop", "n": 64}]}
+        ck = str(tmp_path / "ck.json")
+        b1 = sweep.run_sweep(grid, checkpoint=ck,
+                             table_path=str(tmp_path / "t1.json"))
+        assert calls == [64] and b1["stats"]["units"] == 1
+        b2 = sweep.run_sweep(grid, checkpoint=ck, resume=True,
+                             table_path=str(tmp_path / "t2.json"))
+        assert calls == [64], "a resumed unit must not re-probe"
+        assert b2["stats"]["units_resumed"] == 1
+        assert b2["decisions"] == b1["decisions"]
+        assert b2["digest"] == b1["digest"]
+
+    def test_transient_infra_failure_retries_classified(self, atab,
+                                                        tmp_path,
+                                                        monkeypatch):
+        attempts = []
+
+        def build(u):
+            attempts.append(1)
+            if len(attempts) == 1:
+                raise TimeoutError("worker rpc deadline")   # transient
+            return ((64, "float32", "HIGH"), [_toy("a", 0.0)])
+
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            sweep.SiteSpec(build, lambda kp, names, p: {}))
+        bundle = sweep.run_sweep({"units": [{"site": "toyop", "n": 64}]},
+                                 table_path=str(tmp_path / "t.json"))
+        assert len(attempts) == 2
+        assert bundle["stats"]["units"] == 1
+        assert bundle["stats"]["units_failed"] == 0
+
+    def test_failed_unit_never_kills_sweep(self, atab, tmp_path,
+                                           monkeypatch):
+        def boom(u):
+            raise AssertionError("deterministic bug")       # never retried
+
+        monkeypatch.setitem(sweep.SITES, "toyop",
+                            sweep.SiteSpec(boom,
+                                           lambda kp, names, p: {}))
+        monkeypatch.setitem(
+            sweep.SITES, "toyop2",
+            _toy_site({}, {"a": 0.0, "b": 0.005}))
+        bundle = sweep.run_sweep(
+            {"units": [{"site": "toyop", "n": 64},
+                       {"site": "toyop2", "n": 32}]},
+            table_path=str(tmp_path / "t.json"))
+        assert bundle["stats"]["units_failed"] == 1
+        assert bundle["stats"]["units"] == 1
+        assert "toyop2|32,float32,HIGH" in bundle["decisions"]
+
+    def test_duplicate_pow2_bucket_units_swept_once(self, atab,
+                                                    tmp_path,
+                                                    monkeypatch):
+        """Two grid units bucketing to the same pow2 key yield ONE
+        lattice point — a duplicate would double-weight the model's
+        nearest-neighbor blend and duplicate the pruning audit."""
+        def build(u):
+            n = sweep.pow2_bucket(int(u["n"]))
+            return ((n, "float32", "HIGH"),
+                    [_toy("a", 0.0), _toy("b", 0.005)])
+
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            sweep.SiteSpec(build, lambda kp, names, p: {}))
+        bundle = sweep.run_sweep(
+            {"units": [{"site": "toyop", "n": 5},
+                       {"site": "toyop", "n": 8}]},     # both bucket to 8
+            table_path=str(tmp_path / "t.json"))
+        assert len(bundle["decisions"]) == 1
+        assert len(bundle["model"]["toyop"]["float32,HIGH"]) == 1
+        assert bundle["stats"]["units"] == 1
+        assert bundle["stats"]["units_duplicate"] == 1
+        assert bundle["stats"]["units_resumed"] == 0
+
+    def test_warm_specs_derived_from_batched_results(self):
+        res = _results([(8, 64, "float32", "HIGH")], {"grid": 1e-4},
+                       site="batched_potrf")
+        specs = sweep.warm_specs_from_results(
+            res, extra=[{"op": "posv", "batch": 1, "dims": [96],
+                         "dtype": "float32"}])
+        ops = {(s["op"], tuple(s["dims"]), s["batch"]) for s in specs}
+        assert ("potrf", (64,), 8) in ops
+        assert ("posv", (64,), 8) in ops
+        assert ("posv", (96,), 1) in ops
+
+
+class TestBundleLadder:
+    def test_bundle_roundtrip_zero_timing_reps(self, atab, tmp_path,
+                                               monkeypatch):
+        """The round-trip pin: a fresh module state with the bundle env
+        set resolves the swept key probe-free even ON TPU — to the
+        bundle's backend, NOT the one runtime timing would pick."""
+        path, _ = _write(tmp_path, _results(
+            [(64, "float32", "HIGH")], {"slow": 0.001, "fast": 0.005},
+            backend="slow"))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        cands = [_toy("slow", 0.02), _toy("fast", 0.0)]
+        got = autotune.decide("toyop", (64, "float32", "HIGH"), cands)
+        assert got == "slow", "the bundle entry must outrank timing"
+        assert autotune.timing_reps() == 0
+        info = autotune.table().decisions["toyop|64,float32,HIGH"]
+        assert info["source"] == "bundle"
+        # repeat dispatch stays probe-free through the fast path
+        assert autotune.decide("toyop", (64, "float32", "HIGH"),
+                               cands) == "slow"
+        assert autotune.timing_reps() == 0
+
+        # the satellite's importlib-reload analog of a fresh process
+        mod = importlib.reload(importlib.import_module(
+            "slate_tpu.perf.autotune"))
+        try:
+            monkeypatch.setattr(mod, "_on_tpu", lambda: True)
+            got = mod.decide("toyop", (64, "float32", "HIGH"),
+                             [mod.Candidate("slow", _toy("slow", 0.02).setup),
+                              mod.Candidate("fast", _toy("fast", 0.0).setup)])
+            assert got == "slow"
+            assert mod.timing_reps() == 0
+        finally:
+            mod.reset_table()
+
+    def test_model_resolves_unswept_shape_probe_free(self, atab,
+                                                     tmp_path,
+                                                     monkeypatch):
+        path, _ = _write(tmp_path, _results(
+            [(32, "float32", "HIGH"), (64, "float32", "HIGH")],
+            {"fast": 1e-4, "slow": 5e-4}))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        got = autotune.decide("toyop", (256, "float32", "HIGH"),
+                              [_toy("slow", 0.0), _toy("fast", 0.02)])
+        assert got == "fast"
+        assert autotune.timing_reps() == 0
+        info = autotune.table().decisions["toyop|256,float32,HIGH"]
+        assert info["source"] == "bundle-model"
+
+    def test_ctx_mismatch_falls_through_to_probe(self, atab, tmp_path,
+                                                 monkeypatch):
+        path, _ = _write(tmp_path, _results(
+            [(64, "float64", "HIGH")], {"fast": 1e-4, "slow": 5e-4}))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        got = autotune.decide("toyop", (64, "float32", "HIGH"),
+                              [_toy("slow", 0.02), _toy("fast", 0.0)])
+        assert got == "fast"
+        assert autotune.timing_reps() > 0, \
+            "a float64 model point must not resolve a float32 key"
+
+    def test_stale_version_bundle_rejected(self, atab, tmp_path,
+                                           monkeypatch):
+        version = dict(autotune._version_key(), jax="0.0.older")
+        path, _ = _write(tmp_path, _results(
+            [(64, "float32", "HIGH")], {"slow": 1e-4}, backend="slow"),
+            version=version)
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        assert autotune.table().bundle is None
+        assert autotune.bundle_info() is None
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        got = autotune.decide("toyop", (64, "float32", "HIGH"),
+                              [_toy("slow", 0.02), _toy("fast", 0.0)])
+        assert got == "fast"
+        assert autotune.timing_reps() > 0, \
+            "a stale bundle must retime, not resolve"
+
+    def test_malformed_bundle_rejected(self, atab, tmp_path,
+                                       monkeypatch):
+        path = tmp_path / "bad.json"
+        path.write_text("{not json")
+        monkeypatch.setenv(sweep.BUNDLE_ENV, str(path))
+        autotune.reset_table()
+        assert autotune.table().bundle is None
+
+    def test_quarantine_masks_bundle_entry(self, atab, tmp_path,
+                                           monkeypatch):
+        """PR 9 negative evidence: a live quarantine for the bundle's
+        winner masks the entry — the resolve degrades exactly as it
+        would for a cached winner, and never returns the demoted
+        backend."""
+        path, _ = _write(tmp_path, _results(
+            [(64, "float32", "HIGH")], {"slow": 1e-4, "fast": 5e-4},
+            backend="slow"))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        key = (64, "float32", "HIGH")
+        cands = [_toy("slow", 0.02), _toy("fast", 0.0)]
+        assert autotune.decide("toyop", key, cands) == "slow"
+        autotune.quarantine("toyop", key, "slow",
+                            reason="health gate failed")
+        got = autotune.decide("toyop", key, cands)
+        assert got == "fast"
+        # the mask degrades to the model's next-best offline evidence
+        # (quarantined backend excluded), not to a runtime probe
+        assert autotune.table().decisions[
+            "toyop|64,float32,HIGH"]["source"] == "bundle-model"
+        assert autotune.timing_reps() == 0
+        # expiry re-admits the bundle entry (the bundle-model record
+        # must not outlive the mask that produced it)
+        autotune.quarantine("toyop", key, "slow", ttl_s=0.0)
+        time.sleep(0.01)
+        assert autotune.decide("toyop", key, cands) == "slow"
+
+    def test_health_gate_demotes_bundle_sourced_winner(self, atab,
+                                                       tmp_path,
+                                                       monkeypatch):
+        """resilience/health.py treats bundle-sourced decisions as
+        settled, demotable evidence: quarantine_driver masks them like
+        timed/cached winners."""
+        from slate_tpu.resilience import health
+
+        path, _ = _write(tmp_path, _results(
+            [(8, 64, "float32", "HIGH")], {"grid": 1e-4, "vmapped": 5e-4},
+            site="batched_potrf", backend="grid"))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        cands = [_toy("vmapped", 0.0), _toy("grid", 0.02)]
+        assert autotune.decide("batched_potrf",
+                               (8, 64, "float32", "HIGH"),
+                               cands) == "grid"
+        demoted = health.quarantine_driver(
+            "potrf_batched", reason="live sentinel degradation")
+        assert demoted == 1
+        got = autotune.decide("batched_potrf", (8, 64, "float32", "HIGH"),
+                              cands)
+        assert got == "vmapped"
+
+    def test_forced_pin_outranks_bundle(self, atab, tmp_path,
+                                        monkeypatch):
+        path, _ = _write(tmp_path, _results(
+            [(64, "float32", "HIGH")], {"slow": 1e-4}, backend="slow"))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "toyop=fast")
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        got = autotune.decide("toyop", (64, "float32", "HIGH"),
+                              [_toy("slow", 0.02), _toy("fast", 0.0)])
+        assert got == "fast"
+        assert autotune.timing_reps() == 0
+
+
+class TestModelGuard:
+    def test_never_selects_candidate_rejected_10x_by_model(self,
+                                                           monkeypatch):
+        """Interpolation sanity: a candidate whose MEASURED grid times
+        look best but whose analytical prediction at the query shape is
+        >10× the predicted best can never be selected."""
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            sweep.SiteSpec(lambda u: None,
+                           lambda kp, names, p: {"fast": 1.0,
+                                                 "cheat": 100.0}))
+        results = _results(
+            [(32, "float32", "HIGH"), (64, "float32", "HIGH")],
+            {"cheat": 1e-6, "fast": 1e-3}, backend="cheat")
+        blob = sweep.build_bundle(results, {"platform": "cpu"})
+        got = sweep.model_backend(blob, "toyop",
+                                  (128, "float32", "HIGH"),
+                                  ["fast", "cheat"])
+        assert got == "fast"
+        # within the guard the measured times decide
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            sweep.SiteSpec(lambda u: None,
+                           lambda kp, names, p: {"fast": 1.0,
+                                                 "cheat": 2.0}))
+        assert sweep.model_backend(blob, "toyop",
+                                   (128, "float32", "HIGH"),
+                                   ["fast", "cheat"]) == "cheat"
+
+    def test_model_only_selects_measured_candidates(self):
+        results = _results([(32, "float32", "HIGH")], {"fast": 1e-3})
+        blob = sweep.build_bundle(results, {"platform": "cpu"})
+        assert sweep.model_backend(blob, "toyop", (64, "float32", "HIGH"),
+                                   ["fast", "never_timed"]) == "fast"
+        assert sweep.model_backend(blob, "toyop", (64, "float32", "HIGH"),
+                                   ["never_timed"]) is None
+        assert sweep.model_backend(blob, "nosite",
+                                   (64, "float32", "HIGH"),
+                                   ["fast"]) is None
+
+
+class TestServeBundleBoot:
+    def test_warm_start_from_bundle_zero_compiles(self, atab, tmp_path,
+                                                  monkeypatch):
+        """The in-process analog of the acceptance criterion: a fresh
+        table with only the bundle env set warm-starts from the
+        bundle's AOT specs and serves its first bucketed request —
+        including an UNSWEPT shape resolved by the model — with zero
+        timing reps, zero on-demand compiles and zero jit compiles."""
+        from slate_tpu import serve
+        from slate_tpu.serve.queue import BatchQueue, ServeConfig
+
+        prec = autotune._precision_name()
+        results = [{"site": "batched_potrf",
+                    "key_parts": [8, 64, "float32", prec],
+                    "backend": "vmapped",
+                    "times": {"vmapped": 1e-4}}]
+        warm = [{"op": "posv", "batch": 2, "dims": [64],
+                 "dtype": "float32"},
+                {"op": "posv", "batch": 1, "dims": [96],
+                 "dtype": "float32"}]
+        path, _ = _write(tmp_path, results, warm=warm)
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        was = metrics.enabled()
+        metrics.on()
+        metrics.reset()
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.005))
+        try:
+            assert serve.specs_from_bundle() == warm
+            compiled = serve.warm_start(srv)      # specs=None → bundle
+            assert compiled >= 3
+            metrics.reset()
+            rng = np.random.default_rng(0)
+
+            def spd(n):
+                g = rng.standard_normal((n, n)).astype(np.float32)
+                return g @ g.T + n * np.eye(n, dtype=np.float32)
+
+            eps = float(np.finfo(np.float32).eps)
+            for n in (64, 96):
+                a = spd(n)
+                b = np.ones(n, np.float32)
+                x = srv.submit("posv", a, b).result(timeout=120)
+                r = (np.linalg.norm(a @ x - b)
+                     / (np.linalg.norm(a) * np.linalg.norm(b)
+                        * eps * n))
+                assert r < 3, (n, r)
+            counters = metrics.snapshot()["counters"]
+            assert counters.get("serve.compile.on_demand", 0) == 0
+            assert counters.get("jit.backend_compiles", 0) == 0
+            assert autotune.timing_reps() == 0
+            dec = autotune.table().decisions
+            assert dec["batched_potrf|8,64,float32,%s" % prec][
+                "source"] == "bundle"
+            assert dec["batched_potrf|8,128,float32,%s" % prec][
+                "source"] == "bundle-model"
+        finally:
+            srv.close()
+            metrics.reset()
+            if not was:
+                metrics.off()
+
+
+class TestRegressNote:
+    def test_bundle_change_surfaces_as_note(self, tmp_path):
+        from slate_tpu.perf import regress
+
+        def art(name, bundle):
+            agg = {"metric": "factor_suite_fp32_geomean", "value": 1.0,
+                   "unit": "GFLOP/s",
+                   "submetrics": {"gemm_fp32_n8192": 100.0}}
+            if bundle is not None:
+                agg["bundle"] = bundle
+            p = tmp_path / name
+            p.write_text(json.dumps(agg))
+            return regress.load_artifact(str(p))
+
+        a1 = art("r1.json", None)
+        a2 = art("r2.json", {"digest": "abc123", "version": {}})
+        report = regress.diff([a1, a2])
+        table = regress.format_table(report)
+        assert "NOTE r2.json: bundle changed: none -> abc123" in table
+        assert report.exit_code == 0
+        # unchanged bundles stay silent
+        report2 = regress.diff([art("r3.json", {"digest": "abc123"}),
+                                art("r4.json", {"digest": "abc123"})])
+        assert "bundle changed" not in regress.format_table(report2)
+
+
+class TestBenchTag:
+    def test_bench_lines_carry_bundle_tag(self, atab, tmp_path,
+                                          monkeypatch, capsys):
+        bench = pytest.importorskip("bench")
+        path, blob = _write(tmp_path, _results(
+            [(64, "float32", "HIGH")], {"fast": 1e-4}))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        sub, fails, infra = {}, [], []
+        bench._run_routine("toy", lambda: ("toy_fp32_n64", 1.0, 0.0),
+                           sub, fails, infra)
+        line = json.loads(capsys.readouterr().out.strip()
+                          .splitlines()[-1])
+        assert line["bundle"]["digest"] == blob["digest"]
+        agg = bench._partial_aggregate(sub, fails, infra)
+        assert agg["bundle"]["digest"] == blob["digest"]
+        # probe-cold process: the tag is null, not absent
+        monkeypatch.delenv(sweep.BUNDLE_ENV)
+        autotune.reset_table()
+        bench._run_routine("toy2", lambda: ("toy2_fp32_n64", 1.0, 0.0),
+                           sub, fails, infra)
+        line = json.loads(capsys.readouterr().out.strip()
+                          .splitlines()[-1])
+        assert line["bundle"] is None

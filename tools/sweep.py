@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Offline autotune sweep CLI — produce a versioned warm-start bundle.
+
+Usage::
+
+    python tools/sweep.py --grid smoke --out bundle.json
+    python tools/sweep.py --grid full --out bundle.json \\
+        --checkpoint sweep.ck.json --resume
+    python tools/sweep.py --grid my_grid.json --sites lu_step,matmul
+    SLATE_TPU_AUTOTUNE_BUNDLE=bundle.json python my_replica.py
+
+Enumerates the candidate space per autotune site — backend, fusion
+depth, nb, batch-per-launch — over the grid's shape/dtype lattice,
+PRUNES model-predicted losers with the analytical roofline
+(``slate_tpu/perf/attr.py``) before a single timing rep runs (every
+skip is logged with its predicted gap in the bundle's ``pruned``
+list), times the survivors through the autotune decision engine with
+resumable checkpointing and classified-infra retries, fits the
+interpolating decision model, and writes ONE versioned bundle:
+decision table + model + AOT warm-start bucket specs + the
+jax/jaxlib/platform/libtpu version key.
+
+A serving replica consumes the bundle with
+``SLATE_TPU_AUTOTUNE_BUNDLE=<path>``: its first bucketed request runs
+with zero timing reps, zero on-demand compiles and zero jit compiles
+— including for shapes the sweep never timed, which resolve through
+the fitted model.  Run the sweep ON the hardware generation you will
+serve on: the bundle is rejected wholesale on any version-key
+mismatch.
+
+A custom ``--grid`` file is a JSON spec::
+
+    {"margin": 0.2,
+     "units": [{"site": "lu_step", "m": 4096, "n": 4096, "nb": 512},
+               {"site": "batched_potrf", "b": 64, "n": 256}],
+     "warm": [{"op": "posv", "batch": 64, "dims": [256],
+               "dtype": "float32"}]}
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sweep.py",
+        description="Offline autotune sweep: analytical pre-prune, "
+                    "timed survivors, interpolating decision model, "
+                    "one versioned warm-start bundle.")
+    ap.add_argument("--grid", default="smoke",
+                    help="named grid (smoke|full) or a JSON grid-spec "
+                         "file (default %(default)s)")
+    ap.add_argument("--out", default="autotune_bundle.json",
+                    help="bundle output path (default %(default)s)")
+    ap.add_argument("--checkpoint",
+                    help="checkpoint file: each completed unit is "
+                         "written here; with --resume, finished units "
+                         "are skipped on the next run")
+    ap.add_argument("--resume", action="store_true",
+                    help="skip units already in --checkpoint")
+    ap.add_argument("--margin", type=float, default=None,
+                    help="analytical prune margin (fractional gap over "
+                         "the predicted best a candidate may carry and "
+                         "still be timed; default: the grid's own, "
+                         "else 0.25)")
+    ap.add_argument("--reps", type=int, default=None,
+                    help="timed repetitions per surviving candidate "
+                         "(default: the autotuner's)")
+    ap.add_argument("--sites", help="comma list: only sweep these sites")
+    ap.add_argument("--list", action="store_true",
+                    help="print the resolved grid units and exit "
+                         "(never imports jax)")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, os.path.normpath(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir)))
+    from slate_tpu.perf import sweep as sw
+
+    if args.grid in sw.GRIDS:
+        spec = dict(sw.GRIDS[args.grid])
+        spec["name"] = args.grid
+    elif os.path.exists(args.grid):
+        with open(args.grid) as f:
+            spec = json.load(f)
+        spec.setdefault("name", os.path.basename(args.grid))
+    else:
+        ap.error(f"unknown grid {args.grid!r} (named: "
+                 f"{sorted(sw.GRIDS)}, or a JSON spec file)")
+    if args.sites:
+        keep = {s.strip() for s in args.sites.split(",") if s.strip()}
+        spec["units"] = [u for u in spec.get("units", ())
+                         if u.get("site") in keep]
+    if args.list:
+        print(json.dumps({"name": spec.get("name"),
+                          "margin": spec.get("margin"),
+                          "units": spec.get("units", [])}, indent=1))
+        return 0
+    if not spec.get("units"):
+        ap.error("grid has no units (check --sites filter)")
+
+    bundle = sw.run_sweep(spec, margin=args.margin, reps=args.reps,
+                          checkpoint=args.checkpoint, resume=args.resume,
+                          out=args.out,
+                          log=lambda *a: print(*a, flush=True))
+    st = bundle.get("stats", {})
+    print(json.dumps({"bundle": args.out, "digest": bundle.get("digest"),
+                      "version": bundle.get("version"),
+                      "decisions": len(bundle.get("decisions") or {}),
+                      "warm_start": len(bundle.get("warm_start") or ()),
+                      "pruned": len(bundle.get("pruned") or ()),
+                      "stats": st}, indent=1))
+    ok = st.get("units", 0) + st.get("units_resumed", 0) > 0 \
+        and st.get("units_failed", 0) == 0
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
