@@ -475,7 +475,8 @@ def _partial_aggregate(sub, fails, infra, attribution=None):
                      if k.startswith(("gemm_fp32", "potrf_fp32",
                                       "getrf_fp32", "geqrf_fp32",
                                       "gels_fp32"))
-                     and not k.endswith("_frac_of_gemm")]
+                     and not k.endswith(("_frac_of_gemm",
+                                         "_hbm_roundtrips"))]
     vals = [sub[k] for k in headline_keys
             if isinstance(sub[k], (int, float)) and sub[k] > 0]
     geomean = float(np.exp(np.mean(np.log(vals)))) if vals else 0.0
@@ -557,6 +558,24 @@ def _autotune_keys():
         return set(autotune.decisions())
     except Exception:
         return set()
+
+
+def _timed_in_window(keys_before, sites):
+    """Did a decision for one of ``sites`` land since ``keys_before``
+    with source "timed" — i.e. ``decide()`` actually probed candidates
+    (tracing the losers into the current routine's metrics delta)?
+    Forced pins, bundle hits, cache hits and static fallbacks run zero
+    candidates, so their windows stay clean — and an unrelated site's
+    probe in the same window must not count."""
+    try:
+        from slate_tpu.perf import autotune
+
+        return any(k.split("|", 1)[0] in sites
+                   and v.get("source") == "timed"
+                   for k, v in autotune.table().decisions.items()
+                   if k not in keys_before)
+    except Exception:
+        return False
 
 
 def _timeit(fn, args, iters):
@@ -652,6 +671,30 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
                 line["attribution"] = rep
                 if attr_sink is not None:
                     attr_sink[label] = rep
+            if label.startswith(("getrf_fp32", "potrf_fp32")) \
+                    and (delta.get("counters") or {}):
+                # structural submetric (ISSUE 12): materialized
+                # inter-stage HBM round trips per factorization — 0 on
+                # the fused/full depths, judged lower-is-better by the
+                # sentinel, excluded from every GFLOP/s aggregate.  A
+                # probing window is contaminated: decide() traces the
+                # LOSING depth candidates inside this routine's delta,
+                # so when a factorization-site decision was actually
+                # TIMED in-window the shipped depth's model count
+                # (already reconciled against the live counter in CI)
+                # stands in for the raw counter.  Forced pins, bundle
+                # hits and static fallbacks run zero candidates — their
+                # raw counter is clean and stays authoritative (the
+                # bundle-warm fresh-replica case must keep measuring).
+                probed = _timed_in_window(
+                    keys_before, ("lu_step", "potrf_step",
+                                  "lu_driver", "potrf_panel"))
+                if probed and rep is not None:
+                    rt = rep["hbm_roundtrips"]["model"]
+                else:
+                    rt = (delta.get("counters") or {}).get(
+                        "step.hbm_roundtrips", 0.0)
+                sub[label + "_hbm_roundtrips"] = float(rt)
             if len(out) > 3:
                 line.update(out[3])
             print(json.dumps(line), flush=True)
@@ -1160,7 +1203,8 @@ def main():
                      if k.startswith(("gemm_fp32", "potrf_fp32",
                                       "getrf_fp32", "geqrf_fp32",
                                       "gels_fp32"))
-                     and not k.endswith("_frac_of_gemm")]
+                     and not k.endswith(("_frac_of_gemm",
+                                         "_hbm_roundtrips"))]
     vals = [sub[k] for k in headline_keys
             if isinstance(sub[k], (int, float)) and sub[k] > 0]
     geomean = (float(np.exp(np.mean(np.log(vals)))) if vals else 0.0)
@@ -1170,9 +1214,10 @@ def main():
     low = []
     if gemm_gf and sub.get(gemm_key):
         for k, v in sub.items():
-            if k.endswith("_s") or k.endswith("_speedup_vs_loop"):
-                # solves/s rates, stage seconds and speedup ratios are
-                # not GFLOP/s — a gemm fraction would be unit salad
+            if k.endswith(("_s", "_speedup_vs_loop", "_hbm_roundtrips")):
+                # solves/s rates, stage seconds, speedup ratios and
+                # round-trip counts are not GFLOP/s — a gemm fraction
+                # would be unit salad
                 continue
             anchor = (sub.get(gemm64_key) if "fp64" in k
                       else sub.get(gemm_key))
@@ -1196,7 +1241,7 @@ def main():
         if not k.startswith(("potrf_", "getrf_", "geqrf_", "gels_",
                              "heev_", "svd_")):
             continue
-        if k.endswith("_s") or k.endswith("_frac_of_gemm"):
+        if k.endswith(("_s", "_frac_of_gemm", "_hbm_roundtrips")):
             continue
         anchor = sub.get(gemm64_key) if "fp64" in k else sub.get(gemm_key)
         if anchor and isinstance(sub[k], (int, float)):

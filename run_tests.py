@@ -95,6 +95,75 @@ def telemetry_smoke() -> int:
     return 1
 
 
+def full_fused_smoke() -> int:
+    """The --full-fused tier: force the whole-factorization depth
+    (``SLATE_TPU_AUTOTUNE_FORCE=lu_step=full,potrf_step=full``) at
+    interpret-safe dims in a fresh subprocess and prove the ISSUE 12
+    acceptance on CPU every run: the SHIPPED dispatch (not the raw
+    kernels) takes the ``full`` depth, exactly ONE pallas_call owns
+    each factorization, ``step.hbm_roundtrips == 0`` across it, and
+    the factors pass the scaled-residual gate end to end."""
+    import tempfile
+
+    here = pathlib.Path(__file__).resolve().parent
+    code = (
+        "import numpy as np, jax, jax.numpy as jnp\n"
+        "import slate_tpu as st\n"
+        "from slate_tpu.linalg.lu import getrf_scattered\n"
+        "from slate_tpu.perf import autotune, metrics\n"
+        "from slate_tpu.perf.hlo_profile import count_pallas_calls\n"
+        "metrics.on()\n"
+        "rng = np.random.default_rng(12)\n"
+        "a = rng.standard_normal((256, 256)).astype(np.float32)\n"
+        "lu, perm = jax.jit(lambda x: getrf_scattered(x, 128))("
+        "jnp.asarray(a))\n"
+        "lu, perm = np.asarray(lu), np.asarray(perm)\n"
+        "L = np.tril(lu, -1) + np.eye(256, dtype=np.float32)\n"
+        "U = np.triu(lu)\n"
+        "eps = float(np.finfo(np.float32).eps)\n"
+        "res = np.abs(a[perm] - L @ U).max() "
+        "/ (np.abs(a).max() * 256 * eps)\n"
+        "assert res < 3.0, res\n"
+        "dec = autotune.decisions()\n"
+        "assert any(k.startswith('lu_step|') and v == 'full'\n"
+        "           for k, v in dec.items()), dec\n"
+        "assert count_pallas_calls(\n"
+        "    lambda x: getrf_scattered(x, 128), jnp.asarray(a)) == 1\n"
+        "g = rng.standard_normal((1024, 1024)).astype(np.float32)\n"
+        "spd = g @ g.T / 1024 + np.eye(1024, dtype=np.float32)\n"
+        "fac = st.potrf(st.HermitianMatrix(jnp.asarray(spd), "
+        "uplo=st.Uplo.Lower))\n"
+        "l = np.asarray(fac.data)\n"
+        "res2 = np.linalg.norm(l @ l.T - spd) "
+        "/ (np.linalg.norm(spd) * eps * 1024)\n"
+        "assert res2 < 3.0, res2\n"
+        "dec = autotune.decisions()\n"
+        "assert any(k.startswith('potrf_step|') and v == 'full'\n"
+        "           for k, v in dec.items()), dec\n"
+        "snap = metrics.snapshot()['counters']\n"
+        "assert snap.get('step.hbm_roundtrips', 0.0) == 0.0, snap\n"
+        "print('full-fused smoke: getrf resid %.3g, potrf resid %.3g'\n"
+        "      % (res, res2))\n"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_AUTOTUNE_FORCE="lu_step=full,potrf_step=full",
+                   SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "cache.json"))
+        env.pop("SLATE_TPU_AUTOTUNE_BUNDLE", None)
+        print("=== full-fused tier: SLATE_TPU_AUTOTUNE_FORCE="
+              + env["SLATE_TPU_AUTOTUNE_FORCE"], flush=True)
+        try:
+            rc = subprocess.run([sys.executable, "-c", code], env=env,
+                                cwd=str(here), timeout=900).returncode
+        except subprocess.TimeoutExpired:
+            rc = 124
+    if rc == 0:
+        print("==== full-fused smoke passed ====")
+        return 0
+    print("==== full-fused smoke FAILED (rc=%d) ====" % rc)
+    return 1
+
+
 def sweep_smoke() -> int:
     """The --sweep tier: tiny CPU grid end-to-end through the CLI in a
     subprocess (sweep → versioned bundle artifact), then a second fresh
@@ -219,6 +288,13 @@ def main(argv=None):
                     "fresh process from the bundle and assert the "
                     "zero-probe/zero-compile start (see docs/usage.md "
                     "Offline autotune & bundles)")
+    ap.add_argument("--full-fused", action="store_true",
+                    help="whole-factorization smoke: force "
+                    "SLATE_TPU_AUTOTUNE_FORCE=lu_step=full,"
+                    "potrf_step=full at interpret-safe dims so CI "
+                    "exercises the full-depth mega-kernels on CPU "
+                    "every run (see docs/usage.md Whole-factorization "
+                    "kernels)")
     args = ap.parse_args(argv)
 
     if args.telemetry:
@@ -226,6 +302,9 @@ def main(argv=None):
 
     if args.sweep:
         return sweep_smoke()
+
+    if args.full_fused:
+        return full_fused_smoke()
 
     if args.chaos:
         # setdefault: an explicit operator plan/tier wins over the can

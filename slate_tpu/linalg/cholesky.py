@@ -66,17 +66,25 @@ def potrf(a, opts: Optional[Options] = None):
     from ..options import get_option
     method = get_option(opts, "method_factor", "auto")
     nbsel = 512 if nb <= 256 else nb
-    # fused-step dispatch first (ISSUE 6): when the ``potrf_step`` site
-    # picks "fused", ONE pallas invocation owns each right-looking step
+    # step-depth dispatch first (ISSUE 6/12): the ``potrf_step`` site
+    # arbitrates the fusion-depth ladder — "full" makes the WHOLE
+    # factorization one pallas invocation (grid over steps, in-kernel
+    # lookahead), "fused" keeps one invocation per right-looking step
     # (panel chol+inv + trsm-as-gemm + double-buffered streamed
     # trailing update) — otherwise the composed strip/XLA paths below
+    step_depth = None
     if method == "auto" and full.ndim == 2 \
-            and jnp.issubdtype(full.dtype, jnp.floating) \
-            and select_backend(
-                "potrf_step", n=int(full.shape[-1]), nb=nbsel,
-                dtype=full.dtype,
-                eligible=blocks.use_fused_potrf_step(
-                    int(full.shape[-1]), nbsel, full.dtype)) == "fused":
+            and jnp.issubdtype(full.dtype, jnp.floating):
+        step_depth = select_backend(
+            "potrf_step", n=int(full.shape[-1]), nb=nbsel,
+            dtype=full.dtype,
+            eligible=blocks.use_fused_potrf_step(
+                int(full.shape[-1]), nbsel, full.dtype),
+            eligible_full=blocks.use_full_potrf(
+                int(full.shape[-1]), nbsel, full.dtype))
+    if step_depth == "full":
+        l = blocks.potrf_full(full, nbsel)
+    elif step_depth == "fused":
         l = blocks.potrf_steps(full, nbsel)
     elif method == "auto" and full.dtype == jnp.float32 and full.ndim == 2 \
             and select_backend("potrf_panel", n=int(full.shape[-1]),

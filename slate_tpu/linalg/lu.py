@@ -588,13 +588,7 @@ def _fused_step_tc(m: int, n: int, nb: int) -> int:
     of nb (floor 128) whose double-buffered (tc, m) pair fits the VMEM
     budget (:mod:`slate_tpu.ops.vmem`) next to the resident panel, Π/G
     and block scratches."""
-    tc = nb
-    # halve only while the result stays at/above the 128 floor (nb need
-    # only be a multiple of 128, so a blind halving chain could dip
-    # below it for nb = 384, 640, ...)
-    while tc // 2 >= 128 and not _vmem.fits(_fused_step_bytes(m, nb, tc)):
-        tc //= 2
-    return tc
+    return _vmem.largest_tc(nb, lambda tc: _fused_step_bytes(m, nb, tc))
 
 
 def _fused_step_bytes(m: int, nb: int, tc: int, bb: int = 128) -> int:
@@ -621,6 +615,52 @@ def _use_fused_step(m: int, n: int, nb: int, dtype) -> bool:
     if n % tc != 0:
         return False
     return _vmem.fits(_fused_step_bytes(m, nb, tc))
+
+
+def _full_fused_bytes(m: int, nb: int, tc: int, bb: int = 128) -> int:
+    """Resident working set of the whole-factorization LU mega-kernel:
+    the step kernel's set plus the (nb, m) lookahead panel buffer and
+    the (nb, nb) panel-inverse scratch."""
+    bb = min(bb, nb)
+    return 4 * (m * (3 * nb + 2 * bb + 2 * tc + 2)
+                + 3 * nb * nb + 2 * bb * bb)
+
+
+def _full_fused_tc(m: int, nb: int) -> int:
+    return _vmem.largest_tc(nb, lambda tc: _full_fused_bytes(m, nb, tc))
+
+
+def _use_full_fused(m: int, n: int, nb: int, dtype) -> bool:
+    """Shape/VMEM ELIGIBILITY of the whole-factorization LU mega-kernel
+    (:func:`~slate_tpu.ops.pallas_kernels.getrf_full_fused`, depth
+    ``full``): the fused-step conditions with the larger resident set —
+    the lookahead holds TWO (nb, m) panels in VMEM at once.  Whether an
+    eligible shape actually takes the full depth is the ``lu_step``
+    autotune decision."""
+    from .. import config
+    if config.use_pallas_mode() == "off":
+        return False
+    if nb % 128 != 0:
+        return False
+    tc = _full_fused_tc(m, nb)
+    if n % tc != 0:
+        return False
+    return _vmem.fits(_full_fused_bytes(m, nb, tc))
+
+
+def _scattered_tail(at, piv_all, act, m: int, k: int):
+    """Recover the packed LAPACK layout from the scattered carry — the
+    factorization-order pivots plus, for m > k, the never-pivoted
+    remainder rows in stable scatter order, with ONE column gather at
+    the very end.  Shared by every depth of :func:`getrf_scattered` so
+    the tail contract (the act < 0.5 threshold, the stable sort) cannot
+    diverge between them."""
+    if m > k:
+        rem = jnp.argsort(act[0, :] < 0.5, stable=True)[: m - k]
+        perm = jnp.concatenate([piv_all, rem])
+    else:
+        perm = piv_all
+    return at[:, perm].T, perm
 
 
 def getrf_scattered(a, nb: int = 512, bb: int = 128, step=None):
@@ -662,8 +702,14 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128, step=None):
     update share one VMEM residency against the aliased carry
     (:func:`~slate_tpu.ops.pallas_kernels.getrf_step_fused`), zero
     materialized intermediates between sub-stages
-    (``step.hbm_roundtrips == 0``, pinned in CI).  ``step`` overrides
-    the table (the autotuner's probe hook).
+    (``step.hbm_roundtrips == 0``, pinned in CI); ``"full"`` goes one
+    rung further — ONE pallas_call owns the ENTIRE factorization
+    (:func:`~slate_tpu.ops.pallas_kernels.getrf_full_fused`): the grid
+    iterates the block-column steps, the layout state carries across
+    them, and each step's trailing phase lookahead-updates the next
+    panel in VMEM, so ``step.hbm_roundtrips == 0`` holds for the whole
+    factorization with a single kernel launch.  ``step`` overrides the
+    table (the autotuner's probe hook).
 
     Returns ``(lu, perm)`` with ``a[perm] = L·U`` — the
     :func:`getrf_rec` contract.  Requires min(m,n) % nb == 0; f32 on
@@ -680,7 +726,19 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128, step=None):
         from ..method import select_backend
         step = select_backend(
             "lu_step", m=m, n=n, nb=nb, dtype=a.dtype,
-            eligible=_use_fused_step(m, n, nb, a.dtype))
+            eligible=_use_fused_step(m, n, nb, a.dtype),
+            eligible_full=_use_full_fused(m, n, nb, a.dtype))
+    if step == "full":
+        # the whole factorization — every step's panel + trsm + trailing
+        # update, with in-kernel lookahead — is ONE pallas invocation on
+        # the aliased carry: zero materialized intermediates anywhere
+        at = a.T
+        act = jnp.ones((1, m), a.dtype)
+        metrics.inc("step.getrf.steps", float(k // nb))
+        with metrics.step_timer("getrf", "full"):
+            at, piv_all, act = kernel("getrf_full_fused")(
+                at, act, nb=nb, bb=bb, tc=_full_fused_tc(m, nb))
+        return _scattered_tail(at, piv_all, act, m, k)
     if step in ("fused", "fused_trsm"):
         getrf_step_fused = kernel("getrf_step_fused")
         tc = _fused_step_tc(m, n, nb)
@@ -739,12 +797,7 @@ def getrf_scattered(a, nb: int = 512, bb: int = 128, step=None):
                 at = at.at[k0 + nb:, :].add(-matmul(u12t, lmt))
                 at = at.at[k0 + nb:, piv].set(u12t)
     piv_all = jnp.concatenate(pivs) if len(pivs) > 1 else pivs[0]
-    if m > k:
-        rem = jnp.argsort(act[0, :] < 0.5, stable=True)[: m - k]
-        perm = jnp.concatenate([piv_all, rem])
-    else:
-        perm = piv_all
-    return at[:, perm].T, perm
+    return _scattered_tail(at, piv_all, act, m, k)
 
 
 #: panel width of the scattered driver (the fused kernel's nb)

@@ -394,10 +394,7 @@ def potrf_step_tc(n: int, nb: int) -> int:
     budget (:mod:`slate_tpu.ops.vmem`) next to the (n, nb) panel
     column."""
     from . import vmem
-    tc = nb
-    while tc // 2 >= 128 and not vmem.fits(_potrf_step_bytes(n, nb, tc)):
-        tc //= 2
-    return tc
+    return vmem.largest_tc(nb, lambda tc: _potrf_step_bytes(n, nb, tc))
 
 
 def use_fused_potrf_step(n: int, nb: int, dtype) -> bool:
@@ -442,6 +439,63 @@ def potrf_steps(a, nb: int = 512, tc: int | None = None):
     with metrics.step_timer("potrf", "fused"):
         for k0 in range(0, n, nb):
             a = potrf_step_fused(a, k0, nb=nb, tc=tc)
+    return jnp.tril(a)
+
+
+def _potrf_full_bytes(n: int, nb: int, tc: int) -> int:
+    """Resident working set of the whole-factorization potrf kernel:
+    the step kernel's set plus the (n, nb) lookahead column buffer."""
+    return (2 * n * nb + 2 * tc * tc + 3 * nb * nb) * 4
+
+
+def potrf_full_tc(n: int, nb: int) -> int:
+    from . import vmem
+    return vmem.largest_tc(nb, lambda tc: _potrf_full_bytes(n, nb, tc))
+
+
+def use_full_potrf(n: int, nb: int, dtype) -> bool:
+    """Shape/VMEM ELIGIBILITY of the whole-factorization Cholesky
+    mega-kernel (:func:`potrf_full`, depth ``full``): the fused-step
+    conditions with the larger resident set — the lookahead holds TWO
+    (n, nb) block-columns in VMEM at once.  Whether an eligible shape
+    actually takes the full depth is the ``potrf_step`` autotune
+    decision."""
+    if config.use_pallas_mode() == "off":
+        return False
+    if dtype != jnp.float32 or n % nb != 0 or n <= nb:
+        return False
+    if nb < 128 or (nb & (nb - 1)) != 0:
+        return False
+    from . import vmem
+    tc = potrf_full_tc(n, nb)
+    return vmem.fits(_potrf_full_bytes(n, nb, tc))
+
+
+def potrf_full(a, nb: int = 512, tc: int | None = None):
+    """Right-looking blocked Cholesky whose WHOLE factorization is ONE
+    Pallas invocation
+    (:func:`~slate_tpu.ops.pallas_kernels.potrf_full_fused`): the grid
+    iterates the block-column steps inside a single ``pallas_call``,
+    each step streams its shrinking trailing window through the
+    double-buffered VMEM residency against the aliased carry, and the
+    next panel block-column is lookahead-updated in VMEM — one kernel
+    launch and ``step.hbm_roundtrips == 0`` for the whole
+    factorization.  The ``potrf_step`` autotune site arbitrates this
+    ``full`` depth against :func:`potrf_steps` (per-step fused) and
+    :func:`potrf_panels` (composed) per (n, nb, dtype).
+
+    Requires ``n % nb == 0`` and nb a power of two (the in-kernel
+    recursive-doubling inverse); f32 on TPU, f32/f64 in interpret mode.
+    """
+
+    from ..perf import metrics
+    from .pallas_kernels import potrf_full_fused
+
+    n = a.shape[-1]
+    tc = tc if tc is not None else potrf_full_tc(n, nb)
+    metrics.inc("step.potrf.steps", float(n // nb))
+    with metrics.step_timer("potrf", "full"):
+        a = potrf_full_fused(a, nb=nb, tc=tc)
     return jnp.tril(a)
 
 

@@ -837,7 +837,8 @@ def getrf_panel_linv(slab_t, active_row, ib: int = 32):
 
 def _fused_panel_phase(s, nsteps, at_hbm, act_in, k0, out_hbm, piv_ref,
                        act_out, linv_ref, panel, cur, ohblk, lfull,
-                       l11s, l11i, sem, *, m, nb, bb, ib, ohfull=None):
+                       l11s, l11i, sem, *, m, nb, bb, ib, ohfull=None,
+                       piv_base=0, global_init=None, skip_dma=None):
     """Shared panel phase of the fused panel/step mega-kernels — one
     grid step = one bb-wide column block of the (nb, m) panel:
 
@@ -861,6 +862,13 @@ def _fused_panel_phase(s, nsteps, at_hbm, act_in, k0, out_hbm, piv_ref,
     it.  After the call at ``s == nsteps-1``: ``panel`` holds the
     factored panel (already written back to HBM), ``lfull`` the
     unit-lower pivot block L₁₁ and ``linv_ref`` its inverse.
+
+    The full-factorization mega-kernel reuses this phase once per
+    block-column step: ``piv_base`` offsets the pivot writes into its
+    factorization-wide pivot ref, ``global_init`` (a traced predicate)
+    restricts the carried act/piv seeding to the very first step, and
+    ``skip_dma`` (traced) skips the panel fetch when the lookahead
+    already left the panel resident in VMEM.
     """
 
     dt = jnp.promote_types(panel.dtype, jnp.float32)
@@ -868,19 +876,33 @@ def _fused_panel_phase(s, nsteps, at_hbm, act_in, k0, out_hbm, piv_ref,
 
     @pl.when(s == 0)
     def _init():
-        dma = pltpu.make_async_copy(
-            at_hbm.at[pl.ds(k0, nb), :], panel, sem)
-        dma.start()
-        dma.wait()
-        act_out[:] = act_in[:]
-        piv_ref[:] = jnp.zeros((1, nb), jnp.int32)
+        if skip_dma is None:
+            dma = pltpu.make_async_copy(
+                at_hbm.at[pl.ds(k0, nb), :], panel, sem)
+            dma.start()
+            dma.wait()
+        else:
+            @pl.when(jnp.logical_not(skip_dma))
+            def _fetch():
+                dma = pltpu.make_async_copy(
+                    at_hbm.at[pl.ds(k0, nb), :], panel, sem)
+                dma.start()
+                dma.wait()
+        if global_init is None:
+            act_out[:] = act_in[:]
+            piv_ref[:] = jnp.zeros(piv_ref.shape, jnp.int32)
+        else:
+            @pl.when(global_init)
+            def _seed():
+                act_out[:] = act_in[:]
+                piv_ref[:] = jnp.zeros(piv_ref.shape, jnp.int32)
         linv_ref[:] = jnp.zeros((nb, nb), dt)
         lfull[:] = jnp.zeros((nb, nb), dt)
 
     r0 = pl.multiple_of(s * bb, bb)
     cur[:] = panel[pl.ds(r0, bb), :]
     _factor_block_lane_major(cur, act_out, piv_ref, ohblk,
-                             m=m, bb=bb, ib=ib, piv0=r0)
+                             m=m, bb=bb, ib=ib, piv0=piv_base + r0)
     panel[pl.ds(r0, bb), :] = cur[:]
     if ohfull is not None:
         ohfull[pl.ds(r0, bb), :] = ohblk[:]
@@ -1110,6 +1132,38 @@ def _stream_chunks(hbm, bufs, in_sems, out_sems, c_lo, c_hi, slicer,
                                       out_sems[1]).wait()
 
 
+def _newton_x2(lfull, linv_ref, dt):
+    """Newton-refine the pivot-block inverse in place:
+    ``X₂ = X(2I − L₁₁X)`` — ``lfull`` holds unit-lower L₁₁ on entry
+    (the panel phase leaves it there) and X₂ on exit.  Algebraically
+    the composed driver's HIGHEST residual-correction pair, precomputed
+    once at (nb, nb) scale; shared by the step and full LU kernels so
+    the depths stay arithmetic-identical."""
+    hi = jax.lax.Precision.HIGHEST
+    t = jnp.dot(lfull[:], linv_ref[:], preferred_element_type=dt,
+                precision=hi)
+    lfull[:] = 2.0 * linv_ref[:] - jnp.dot(
+        linv_ref[:], t, preferred_element_type=dt, precision=hi)
+
+
+def _lu_chunk_update(rows, gbuf, wbuf, pivm_ref, dt):
+    """The LU trailing update of one resident row block — gather +
+    solve + scatter + rank-nb update in one pass:
+    ``rows·(1−pivm) + (rows·Gᵗ)·W`` (HIGH — the X₂ precompute already
+    absorbed the inverse's departure, so the remaining error is one
+    HIGH-gemm rounding, the same class as every library trailing
+    product).  ONE definition serves the step kernel's streamed chunks
+    and the full kernel's lookahead block + streamed chunks, which is
+    what makes the depths bitwise-comparable."""
+    hp = jax.lax.Precision.HIGH
+    u12t = jax.lax.dot_general(
+        rows, gbuf[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=dt, precision=hp)
+    return rows * (1.0 - pivm_ref[:]) + jnp.dot(
+        u12t, wbuf[:], preferred_element_type=dt, precision=hp)
+
+
 def _getrf_step_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
                              act_out, linv_ref, panel, cur, ohblk, lfull,
                              l11s, l11i, ohfull, pivm_ref, bufa, bufb,
@@ -1138,7 +1192,6 @@ def _getrf_step_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
 
     dt = jnp.promote_types(panel.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
-    hp = jax.lax.Precision.HIGH
     s = pl.program_id(0)
     nsteps = pl.num_programs(0)
     k0 = pl.multiple_of(k0_ref[0], bb)
@@ -1151,14 +1204,7 @@ def _getrf_step_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
     def _trailing():
         # pivot-lane mask of THIS step's nb pivots (the scatter target)
         pivm_ref[:] = jnp.sum(ohfull[:], axis=0, keepdims=True)
-        # Newton-refine the pivot-block inverse: X₂ = X(2I − L₁₁X).
-        # lfull holds unit-lower L₁₁ after the panel phase; reuse it
-        # for X₂ — the composed path's per-chunk HIGHEST correction
-        # pair collapses into this one (nb, nb) precompute.
-        t = jnp.dot(lfull[:], linv_ref[:], preferred_element_type=dt,
-                    precision=hi)
-        lfull[:] = 2.0 * linv_ref[:] - jnp.dot(
-            linv_ref[:], t, preferred_element_type=dt, precision=hi)
+        _newton_x2(lfull, linv_ref, dt)       # lfull ← X₂
         if update:
             # W = Π − Lᵗ into the panel buffer (its write-back DMA was
             # waited in the panel phase), then G = X₂·Π into ohfull
@@ -1174,16 +1220,7 @@ def _getrf_step_fused_kernel(at_hbm, act_in, k0_ref, out_hbm, piv_ref,
             gbuf, wbuf = panel, ohfull
 
         def compute(buf, c):
-            # gather + solve in one pass: u12ᵗ = chunk·Gᵗ (HIGH — the
-            # X₂ precompute already absorbed the inverse's departure,
-            # so the remaining error is one HIGH-gemm rounding, the
-            # same class as every library trailing product)
-            u12t = jax.lax.dot_general(
-                buf[:], gbuf[:],
-                dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=dt, precision=hp)
-            buf[:] = buf[:] * (1.0 - pivm_ref[:]) + jnp.dot(
-                u12t, wbuf[:], preferred_element_type=dt, precision=hp)
+            buf[:] = _lu_chunk_update(buf[:], gbuf, wbuf, pivm_ref, dt)
 
         c_lo = (k0 + nb) // tc
         _stream_chunks(out_hbm, (bufa, bufb), (ina, inb), (outa, outb),
@@ -1260,30 +1297,175 @@ def getrf_step_fused(at_full, active_row, k0, nb: int = 512,
     return out, piv[0], act_out, linv
 
 
-def _potrf_step_fused_kernel(a_in, k0_ref, a_out, linv_ref, col, akk,
-                             lkk, bufa, bufb, sem, ina, inb, outa, outb,
-                             *, n, nb, ib, tc):
-    """One pallas invocation owns a whole right-looking potrf step:
+# ---------------------------------------------------------------------------
+# Full-factorization mega-kernels (ISSUE 12) — ONE pallas_call owns the
+# ENTIRE right-looking factorization.  The PR 6 step kernels still
+# launch once per block-column: between steps the trailing window
+# round-trips dispatch glue, the panel re-fetches from HBM, and the
+# one-hot layout state is rebuilt.  Here the grid's leading dimension
+# iterates the block-column steps themselves: the carried state (active
+# mask, factorization-wide pivots, the VMEM-resident panel) persists
+# across steps inside one invocation, the shrinking trailing window
+# streams through the same double-buffered residency against the
+# aliased HBM carry, and the LP-GEMM layout propagation (pivot gather
+# folded into the gemm operands) carries ACROSS steps instead of being
+# re-gathered per step.  Single-chip lookahead: each step's trailing
+# phase updates the NEXT panel's rows first, in VMEM, and keeps them
+# resident — panel k+1 never waits on (or round-trips through) the
+# step-k trailing stream's HBM traffic, so the MXU enters the next
+# panel phase with zero HBM dependency (``step.hbm_roundtrips == 0``
+# for the whole factorization, structurally).
+# ---------------------------------------------------------------------------
 
-    * the (n, nb) panel block-column DMAs into a resident VMEM strip;
-    * the diagonal block factors with the fused chol+inverse core
-      (:func:`_chol_inv_kernel` — per-ib unblocked Cholesky, recursive-
-      doubling inverse), so the panel trsm is an MXU gemm
-      ``L₂₁ = A₂₁·L₁₁⁻ᵀ`` over the trailing row chunks only;
-    * the symmetric rank-nb trailing update streams (tc, tc) tiles of
-      the lower-triangle pairs through a double-buffered VMEM residency
-      against the aliased carry — flop-exact with the composed strip
-      driver (no full-height masking waste; tiles above the diagonal
-      are never touched).
-    """
+
+def _getrf_full_fused_kernel(at_hbm, act_in, out_hbm, piv_ref, act_out,
+                             panel, nxt, cur, ohblk, lfull, l11s, l11i,
+                             linv, ohfull, pivm_ref, bufa, bufb,
+                             sem, ina, inb, outa, outb,
+                             *, m, n_rows, nb, bb, ib, tc):
+    """Grid (ksteps, nb//bb): the leading dimension iterates the
+    factorization's block-column steps, the trailing one the panel's
+    bb-blocks (the shared :func:`_fused_panel_phase`).  The last panel
+    block of each step runs the step's trailing phase — Newton-refined
+    pivot-block inverse, pivot-gather-fused operands G/W, the lookahead
+    update of the next panel into the resident ``nxt`` buffer, then the
+    double-buffered stream over the remaining trailing rows."""
+
+    dt = jnp.promote_types(panel.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    kstep = pl.program_id(0)
+    ksteps = pl.num_programs(0)
+    s = pl.program_id(1)
+    nsteps = pl.num_programs(1)
+    k0 = pl.multiple_of(kstep * nb, nb)
+
+    # lookahead hand-off: the previous step's trailing phase already
+    # applied its rank-nb update to this panel's rows, in VMEM — carry
+    # them over instead of fetching the (stale) HBM copy
+    @pl.when((s == 0) & (kstep > 0))
+    def _carry_panel():
+        panel[:] = nxt[:]
+
+    _fused_panel_phase(s, nsteps, out_hbm, act_in, k0, out_hbm, piv_ref,
+                       act_out, linv, panel, cur, ohblk, lfull,
+                       l11s, l11i, sem, m=m, nb=nb, bb=bb, ib=ib,
+                       ohfull=ohfull, piv_base=k0,
+                       global_init=(kstep == 0), skip_dma=(kstep > 0))
+
+    has_trail = (k0 + nb) < n_rows          # wide carries keep updating
+    look = kstep + 1 < ksteps               # a next panel exists
+
+    @pl.when((s == nsteps - 1) & has_trail)
+    def _trailing():
+        pivm_ref[:] = jnp.sum(ohfull[:], axis=0, keepdims=True)
+        _newton_x2(lfull, linv, dt)           # lfull ← X₂
+        # W = Π − Lᵗ into the panel buffer, G = X₂·Π into ohfull — the
+        # layout propagation carried across steps: Π is consumed as a
+        # gemm operand here and never materialized in HBM
+        panel[:] = ohfull[:] - panel[:] * act_out[:]
+        ohfull[:] = jnp.dot(lfull[:], ohfull[:],
+                            preferred_element_type=dt, precision=hi)
+
+        @pl.when(look)
+        def _lookahead():
+            # panel k+1 first, in VMEM, kept resident: the next step's
+            # panel phase starts with zero HBM dependency while the
+            # trailing stream below still owns the DMA engines; the
+            # shared _lu_chunk_update makes it bitwise-identical to
+            # what the step kernel streams for these rows
+            ndma = pltpu.make_async_copy(
+                out_hbm.at[pl.ds(k0 + nb, nb), :], nxt, sem)
+            ndma.start()
+            ndma.wait()
+            nxt[:] = _lu_chunk_update(nxt[:], ohfull, panel,
+                                      pivm_ref, dt)
+
+        def compute(buf, c):
+            buf[:] = _lu_chunk_update(buf[:], ohfull, panel,
+                                      pivm_ref, dt)
+
+        # the lookahead already covered the next panel's rows — the
+        # stream starts past them (they never round-trip HBM)
+        c_lo = (k0 + nb) // tc + jnp.where(look, nb // tc, 0)
+        _stream_chunks(out_hbm, (bufa, bufb), (ina, inb), (outa, outb),
+                       c_lo, n_rows // tc,
+                       lambda c: (pl.ds(c * tc, tc), slice(None)),
+                       compute)
+
+
+@_x32_trace
+def getrf_full_fused(at_full, active_row, nb: int = 512, bb: int = 128,
+                     ib: int = 16, tc: int | None = None):
+    """ONE pallas invocation owns the WHOLE right-looking partial-pivot
+    LU of the TRANSPOSED scattered carry — every block-column step's
+    panel + pivot-gather-fused trsm + streamed rank-nb trailing update,
+    with in-kernel lookahead (see :func:`_getrf_full_fused_kernel`).
+    Returns ``(at_full', piv, active_out)`` with ``piv`` the ktot =
+    min(m, n_rows) physical pivot rows in factorization order; the
+    driver recovers the packed LAPACK layout with one column gather at
+    the very end (the :func:`getrf_step_fused` contract, minus the
+    per-step linv nobody composes against).  f32 on TPU; f32/f64 in
+    interpret mode."""
+
+    n_rows, m = at_full.shape
+    ktot = min(n_rows, m)
+    bb = min(bb, nb)
+    ib = min(ib, bb)
+    tc = tc if tc is not None else nb
+    tc = min(tc, nb)
+    assert nb % bb == 0 and bb % ib == 0 and m % 8 == 0, (m, nb, bb, ib)
+    assert bb % 8 == 0, bb
+    assert ktot % nb == 0, (n_rows, m, nb)
+    assert nb % tc == 0 and n_rows % tc == 0, (n_rows, nb, tc)
+    dt = jnp.promote_types(at_full.dtype, jnp.float32)
+    out, piv, act_out = pl.pallas_call(
+        functools.partial(_getrf_full_fused_kernel, m=m, n_rows=n_rows,
+                          nb=nb, bb=bb, ib=ib, tc=tc),
+        grid=(ktot // nb, nb // bb),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_shape=(jax.ShapeDtypeStruct((n_rows, m), dt),
+                   jax.ShapeDtypeStruct((1, ktot), jnp.int32),
+                   jax.ShapeDtypeStruct((1, m), dt)),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((nb, m), dt),     # resident panel / W
+                        pltpu.VMEM((nb, m), dt),     # lookahead panel k+1
+                        pltpu.VMEM((bb, m), dt),     # current block
+                        pltpu.VMEM((bb, m), dt),     # one-hot pivot rows
+                        pltpu.VMEM((nb, nb), dt),    # packed L rows / X₂
+                        pltpu.VMEM((bb, bb), dt),    # step L11
+                        pltpu.VMEM((bb, bb), dt),    # step L11⁻¹
+                        pltpu.VMEM((nb, nb), dt),    # panel L₁₁⁻¹
+                        pltpu.VMEM((nb, m), dt),     # step Π / G
+                        pltpu.VMEM((1, m), dt),      # pivot-lane mask
+                        pltpu.VMEM((tc, m), dt),     # trailing buffer A
+                        pltpu.VMEM((tc, m), dt),     # trailing buffer B
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={0: 0},
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
+        interpret=_interpret(),
+    )(at_full.astype(dt), active_row.astype(dt))
+    return out, piv[0], act_out
+
+
+def _potrf_panel_phase(a_out, k0, col, akk, lkk, linv_ref, sem,
+                       *, n, nb, ib, tc):
+    """Factor the RESIDENT (n, nb) block-column of one right-looking
+    Cholesky step (the caller DMA'd it in or lookahead-carried it):
+    diagonal chol+inverse (:func:`_chol_inv_kernel`), panel
+    trsm-as-gemm ``L₂₁ = A₂₁·L₁₁⁻ᵀ`` over the trailing row chunks, and
+    the write-back DMA into the aliased carry.  Shared by the step and
+    full potrf mega-kernels so the depths stay arithmetic-identical."""
 
     dt = jnp.promote_types(col.dtype, jnp.float32)
     hi = jax.lax.Precision.HIGHEST
-    hp = jax.lax.Precision.HIGH
-    k0 = pl.multiple_of(k0_ref[0], nb)
-    cdma = pltpu.make_async_copy(a_in.at[:, pl.ds(k0, nb)], col, sem)
-    cdma.start()
-    cdma.wait()
     akk[:] = col[pl.ds(k0, nb), :]
     _chol_inv_kernel(akk, lkk, linv_ref, nb=nb, ib=ib)
     col[pl.ds(k0, nb), :] = lkk[:]
@@ -1302,6 +1484,20 @@ def _potrf_step_fused_kernel(a_in, k0_ref, a_out, linv_ref, col, akk,
     odma = pltpu.make_async_copy(col, a_out.at[:, pl.ds(k0, nb)], sem)
     odma.start()
     odma.wait()
+    return c_lo, c_hi
+
+
+def _potrf_trailing_stream(a_out, col, bufa, bufb, ina, inb, outa, outb,
+                           j_lo, c_hi, tc):
+    """The symmetric rank-nb trailing update streamed as (tc, tc)
+    lower-triangle tile pairs through the double-buffered residency
+    against the aliased carry, column tiles ``j ∈ [j_lo, c_hi)`` —
+    flop-exact with the composed strip driver (tiles above the
+    diagonal are never touched).  ONE definition serves the step and
+    full kernels (the full kernel starts past its lookahead column)."""
+
+    dt = jnp.promote_types(col.dtype, jnp.float32)
+    hp = jax.lax.Precision.HIGH
 
     def j_body(j, carry):
         j0 = j * tc
@@ -1318,7 +1514,33 @@ def _potrf_step_fused_kernel(a_in, k0_ref, a_out, linv_ref, col, akk,
                        compute)
         return carry
 
-    jax.lax.fori_loop(c_lo, c_hi, j_body, 0)
+    jax.lax.fori_loop(j_lo, c_hi, j_body, 0)
+
+
+def _potrf_step_fused_kernel(a_in, k0_ref, a_out, linv_ref, col, akk,
+                             lkk, bufa, bufb, sem, ina, inb, outa, outb,
+                             *, n, nb, ib, tc):
+    """One pallas invocation owns a whole right-looking potrf step:
+
+    * the (n, nb) panel block-column DMAs into a resident VMEM strip;
+    * the diagonal block factors with the fused chol+inverse core
+      (:func:`_chol_inv_kernel` — per-ib unblocked Cholesky, recursive-
+      doubling inverse), so the panel trsm is an MXU gemm
+      ``L₂₁ = A₂₁·L₁₁⁻ᵀ`` over the trailing row chunks only
+      (:func:`_potrf_panel_phase`);
+    * the symmetric rank-nb trailing update streams (tc, tc) tiles of
+      the lower-triangle pairs through a double-buffered VMEM residency
+      against the aliased carry (:func:`_potrf_trailing_stream`).
+    """
+
+    k0 = pl.multiple_of(k0_ref[0], nb)
+    cdma = pltpu.make_async_copy(a_in.at[:, pl.ds(k0, nb)], col, sem)
+    cdma.start()
+    cdma.wait()
+    c_lo, c_hi = _potrf_panel_phase(a_out, k0, col, akk, lkk, linv_ref,
+                                    sem, n=n, nb=nb, ib=ib, tc=tc)
+    _potrf_trailing_stream(a_out, col, bufa, bufb, ina, inb, outa, outb,
+                           c_lo, c_hi, tc)
 
 
 @_x32_trace
@@ -1365,6 +1587,111 @@ def potrf_step_fused(a, k0, nb: int = 512, tc: int = 512):
             vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
         interpret=_interpret(),
     )(a.astype(dt), jnp.asarray(k0, jnp.int32).reshape(1))
+
+
+def _potrf_full_fused_kernel(a_in, a_out, linv_ref, col, ncol, akk, lkk,
+                             bufa, bufb, sem, ina, inb, outa, outb,
+                             *, n, nb, ib, tc):
+    """One grid step = one whole right-looking Cholesky step (the
+    :func:`_potrf_step_fused_kernel` body), with the steps themselves
+    iterated by the grid inside ONE invocation and single-chip
+    lookahead: each step's trailing phase updates the NEXT panel
+    block-column first, in VMEM, and keeps it resident in ``ncol`` — the
+    next step's diagonal factor and trsm-as-gemm start with zero HBM
+    dependency, and that column never round-trips HBM mid-step."""
+
+    dt = jnp.promote_types(col.dtype, jnp.float32)
+    hp = jax.lax.Precision.HIGH
+    kstep = pl.program_id(0)
+    ksteps = pl.num_programs(0)
+    k0 = pl.multiple_of(kstep * nb, nb)
+
+    @pl.when(kstep == 0)
+    def _load():
+        cdma = pltpu.make_async_copy(a_out.at[:, pl.ds(k0, nb)], col, sem)
+        cdma.start()
+        cdma.wait()
+
+    @pl.when(kstep > 0)
+    def _carry():
+        # lookahead hand-off: this column was already rank-nb-updated
+        # in VMEM by the previous step's trailing phase
+        col[:] = ncol[:]
+
+    c_lo, c_hi = _potrf_panel_phase(a_out, k0, col, akk, lkk, linv_ref,
+                                    sem, n=n, nb=nb, ib=ib, tc=tc)
+    look = kstep + 1 < ksteps
+
+    @pl.when(look)
+    def _lookahead():
+        # next panel block-column first: fetch, apply this step's
+        # symmetric rank-nb update over its trailing rows, keep
+        # resident — the one column the stream below never touches
+        ndma = pltpu.make_async_copy(
+            a_out.at[:, pl.ds(k0 + nb, nb)], ncol, sem)
+        ndma.start()
+        ndma.wait()
+        lj = col[pl.ds(k0 + nb, nb), :]
+
+        def nupd(c, carry):
+            rows = pl.ds(c * tc, tc)
+            ncol[rows, :] = ncol[rows, :] - jax.lax.dot_general(
+                col[rows, :], lj,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=dt, precision=hp)
+            return carry
+
+        jax.lax.fori_loop(c_lo, c_hi, nupd, 0)
+
+    # the lookahead already owns the next panel's column block — the
+    # streamed trailing tiles start past it
+    j_lo = c_lo + jnp.where(look, nb // tc, 0)
+    _potrf_trailing_stream(a_out, col, bufa, bufb, ina, inb, outa, outb,
+                           j_lo, c_hi, tc)
+
+
+@_x32_trace
+def potrf_full_fused(a, nb: int = 512, tc: int = 512):
+    """ONE pallas invocation owns the WHOLE right-looking Cholesky
+    factorization — the grid iterates the block-column steps, each
+    running the fused panel chol+inverse + trsm-as-gemm + streamed
+    symmetric trailing update with the next panel column lookahead-
+    updated in VMEM (see :func:`_potrf_full_fused_kernel`).  Same
+    carry contract as :func:`potrf_step_fused` (the driver tril-cleans
+    once at the end); nb must be a power of two ≥ 64 with tc | nb | n.
+    f32 on TPU; f32/f64 in interpret mode."""
+
+    n = a.shape[-1]
+    assert a.shape[-2] == n, a.shape
+    ib = min(32, nb)
+    tc = min(tc, nb)
+    assert nb % ib == 0 and (nb & (nb - 1)) == 0 and nb >= 64, nb
+    assert n % nb == 0 and nb % tc == 0, (n, nb, tc)
+    dt = jnp.promote_types(a.dtype, jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_potrf_full_fused_kernel, n=n, nb=nb, ib=ib,
+                          tc=tc),
+        grid=(n // nb,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=jax.ShapeDtypeStruct((n, n), dt),
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.VMEM((nb, nb), dt),    # L₁₁⁻¹
+                        pltpu.VMEM((n, nb), dt),     # resident panel col
+                        pltpu.VMEM((n, nb), dt),     # lookahead col k+1
+                        pltpu.VMEM((nb, nb), dt),    # diag block in
+                        pltpu.VMEM((nb, nb), dt),    # diag block L
+                        pltpu.VMEM((tc, tc), dt),    # trailing tile A
+                        pltpu.VMEM((tc, tc), dt),    # trailing tile B
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        input_output_aliases={0: 0},
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=vmem.pallas_call_limit_bytes()),
+        interpret=_interpret(),
+    )(a.astype(dt))
 # eig/SVD stage-2 middle section (or one checkpointed sweep-range chunk
 # of it).  The host chase in native/runtime.cc streams the band through
 # a single core and ships the packed reflector log back to the device

@@ -26,7 +26,8 @@ from __future__ import annotations
 import os
 
 __all__ = ["BUDGET_BYTES", "PALLAS_CALL_LIMIT_BYTES", "budget_bytes",
-           "pallas_call_limit_bytes", "fits", "batch_per_launch"]
+           "pallas_call_limit_bytes", "fits", "batch_per_launch",
+           "largest_tc"]
 
 #: default ``vmem_limit_bytes`` the fused kernels pin in their
 #: pallas_call compiler params (what Mosaic is allowed to allocate).
@@ -64,6 +65,19 @@ def pallas_call_limit_bytes() -> int:
 def fits(working_set_bytes: float) -> bool:
     """True when a kernel's resident working set fits the budget."""
     return working_set_bytes <= budget_bytes()
+
+
+def largest_tc(nb: int, bytes_at, floor: int = 128) -> int:
+    """Trailing-chunk edge planner shared by the fused step/full
+    kernels: the largest divisor of ``nb`` on the halving chain (floor
+    ``floor``) whose working set ``bytes_at(tc)`` fits the budget.
+    Halves only while the result stays at/above the floor — nb need
+    only be a multiple of the floor, so a blind halving chain could
+    dip below it for nb = 384, 640, ...."""
+    tc = nb
+    while tc // 2 >= floor and not fits(bytes_at(tc)):
+        tc //= 2
+    return tc
 
 
 def batch_per_launch(per_problem_bytes: float, fixed_bytes: float = 0.0,

@@ -106,7 +106,7 @@ _LABEL_RE = re.compile(
 _DIM_RE = re.compile(r"^([a-z]+)([0-9]+)$")
 
 #: autotune op site whose decision is the routine's fusion depth
-#: (``composed`` | ``fused_trsm`` | ``fused``).
+#: (``composed`` | ``fused_trsm`` | ``fused`` | ``full``).
 _FUSION_OPS = {"getrf": "lu_step", "potrf": "potrf_step"}
 
 
@@ -214,7 +214,8 @@ def _acc(stages, name, f, b):
     st[1] += b
 
 
-_RT_PER_STEP_GETRF = {"composed": 3.0, "fused_trsm": 1.0, "fused": 0.0}
+_RT_PER_STEP_GETRF = {"composed": 3.0, "fused_trsm": 1.0, "fused": 0.0,
+                      "full": 0.0}
 
 
 def _stages_getrf(m, n, nb, isz, fusion):
@@ -251,7 +252,7 @@ def _stages_potrf(n, nb, isz, fusion):
                  (2.0 * r * w + w * w) * isz)
             _acc(stages, "update", float(r) * (r + w) * w,
                  (float(r) * r + r * w) * isz)
-            if fusion not in ("fused", "fused_trsm"):
+            if fusion not in ("fused", "fused_trsm", "full"):
                 rts += 1.0 + len(range(k0 + w, n, ws))
     return stages, rts
 
@@ -378,9 +379,18 @@ def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
     stages, rts = model
     pk = peaks(platform, dtype)
     t = 0.0
+    mins = {}
     for s in stages:
-        t += max(s["flops"] / (pk["tflops"] * 1e12),
-                 s["bytes"] / (pk["hbm_gbs"] * 1e9))
+        m = max(s["flops"] / (pk["tflops"] * 1e12),
+                s["bytes"] / (pk["hbm_gbs"] * 1e9))
+        mins[s["stage"]] = mins.get(s["stage"], 0.0) + m
+        t += m
+    if fusion == "full":
+        # lookahead overlap credit: the full-depth kernel factors panel
+        # k+1 while step k's trailing gemm streams, so panel time hides
+        # under the update stage's roofline minimum (the same
+        # exposed-vs-overlapped split the dist_util pipeline models)
+        t -= min(mins.get("panel", 0.0), mins.get("update", 0.0))
     if launch_s is None:
         launch_s = _env_float("SLATE_TPU_LAUNCH_S")
     if launch_s is None:
@@ -496,6 +506,24 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
                        "bound": "mxu" if t_mxu >= t_hbm else "hbm",
                        "min_s": max(t_mxu, t_hbm)})
 
+    lookahead = None
+    if fusion == "full":
+        # in-kernel lookahead: panel k+1 factors while step k's trailing
+        # gemm streams — the panel stage's critical-path minimum shrinks
+        # by whatever hides under the update stage's roofline minimum
+        # (the overlap-budget rule of the collective split below)
+        pmin = sum(s["min_s"] for s in stages if s["stage"] == "panel")
+        budget = sum(s["min_s"] for s in stages if s["stage"] == "update")
+        overlapped = min(pmin, budget)
+        if pmin > 0:
+            for s in stages:
+                if s["stage"] == "panel":
+                    s["min_s"] -= overlapped * (s["min_s"] / pmin)
+        lookahead = {"panel_min_s": _r(pmin),
+                     "overlap_budget_s": _r(budget),
+                     "overlapped_s": _r(overlapped),
+                     "exposed_s": _r(pmin - overlapped)}
+
     collective = None
     if collective_bytes and collective_bytes > 0:
         coll_s = (float(collective_bytes)
@@ -592,6 +620,8 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
         },
         "n_devices": int(n_devices),
     }
+    if lookahead is not None:
+        report["lookahead"] = lookahead
     if collective is not None:
         report["collective"] = collective
     return report
@@ -694,6 +724,12 @@ def format_report(rep: dict) -> str:
         tail.append("  bottlenecks: " + ", ".join(
             "%s (%.0f%% of time)" % (b["stage"], b["gap_share"] * 100.0)
             for b in rep["bottlenecks"]))
+    if rep.get("lookahead"):
+        la = rep["lookahead"]
+        tail.append("  lookahead: panel min %.2f ms, %.2f overlapped "
+                    "under the update stream, %.2f exposed"
+                    % (la["panel_min_s"] * 1e3, la["overlapped_s"] * 1e3,
+                       la["exposed_s"] * 1e3))
     if rep.get("collective"):
         c = rep["collective"]
         tail.append("  collectives: %sB, %.2f ms (%.2f overlapped, "

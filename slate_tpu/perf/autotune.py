@@ -1189,18 +1189,35 @@ def _lu_factor_residual_ok(out, a, m: int, n: int, dt) -> bool:
     return r / max(den, 1e-300) < 100.0
 
 
-def choose_lu_step(m: int, n: int, nb: int, dtype, eligible: bool) -> str:
+def _lu_step_depths(eligible: bool, eligible_full: bool):
+    """The ``lu_step`` depth ladder admitted by the call site's gates,
+    in heuristic-preference order (shared with the sweep's candidate
+    builder so the offline and runtime candidate sets agree)."""
+    depths = ["composed"]
+    if eligible:
+        depths += ["fused", "fused_trsm"]
+    if eligible_full:
+        depths.append("full")
+    return depths
+
+
+def choose_lu_step(m: int, n: int, nb: int, dtype, eligible: bool,
+                   eligible_full: bool = False) -> str:
     """Fusion DEPTH of one right-looking step of the scattered LU
     driver: ``"composed"`` (fused panel kernel + XLA glue — pivot-row
     gather, u12 gemm pair, rank-nb trailing update: panel-only depth),
     ``"fused_trsm"`` (panel + pivot-gather-fused u12 scatter inside ONE
-    pallas invocation, trailing gemm in XLA) or ``"fused"`` (the whole
+    pallas invocation, trailing gemm in XLA), ``"fused"`` (the whole
     step — panel + trsm + streamed trailing update — one pallas_call on
     the aliased carry; ~2× the composed trailing MXU flops bought back
     by zero inter-stage HBM round trips, which is exactly the trade
-    this table exists to measure).  ``eligible`` is the call site's
-    shape/VMEM gate (``linalg.lu._use_fused_step``); off-TPU the forced
-    knob is honoured so interpret-mode CI can pin the fused depths."""
+    this table exists to measure) or ``"full"`` (ONE pallas_call owns
+    the ENTIRE factorization with in-kernel lookahead — zero launches
+    and zero round trips between steps, at the cost of a larger VMEM
+    residency).  ``eligible`` / ``eligible_full`` are the call site's
+    shape/VMEM gates (``linalg.lu._use_fused_step`` /
+    ``_use_full_fused``); off-TPU the forced knob is honoured so
+    interpret-mode CI can pin the fused depths."""
 
     import jax.numpy as jnp
 
@@ -1208,16 +1225,16 @@ def choose_lu_step(m: int, n: int, nb: int, dtype, eligible: bool) -> str:
 
     dt = jnp.dtype(dtype)
     key = (m, n, nb, dt.name, _precision_name())
-    if not eligible:
+    if not eligible and not eligible_full:
         return _static("lu_step", key, "composed", "ineligible")
     if config.use_pallas_mode() == "off":
         return _static("lu_step", key, "composed", "forced-config")
+    depths = _lu_step_depths(eligible, eligible_full)
     if not _on_tpu():
         forced = _forced("lu_step")
-        if forced in ("fused", "fused_trsm", "composed"):
+        if forced in depths:
             return _static("lu_step", key, forced, "forced")
-        return _default("lu_step", key,
-                        ("composed", "fused", "fused_trsm"), "composed")
+        return _default("lu_step", key, tuple(depths), "composed")
 
     probes: dict = {}
 
@@ -1234,21 +1251,46 @@ def choose_lu_step(m: int, n: int, nb: int, dtype, eligible: bool) -> str:
         return _lu_factor_residual_ok(out, _a(), m, n, dt)
 
     return decide("lu_step", key, [
-        Candidate("composed", lambda: _setup("composed"), check),
-        Candidate("fused", lambda: _setup("fused"), check),
-        Candidate("fused_trsm", lambda: _setup("fused_trsm"), check),
-    ])
+        Candidate(d, (lambda d=d: _setup(d)), check) for d in depths])
 
 
-def choose_potrf_step(n: int, nb: int, dtype, eligible: bool) -> str:
+def _potrf_step_depths(eligible: bool, eligible_full: bool):
+    """The ``potrf_step`` depth ladder admitted by the call site's
+    gates (shared with the sweep's candidate builder)."""
+    depths = ["composed"]
+    if eligible:
+        depths.append("fused")
+    if eligible_full:
+        depths.append("full")
+    return depths
+
+
+def _potrf_step_driver(depth: str):
+    """Depth rung → driver callable of the ``potrf_step`` ladder — ONE
+    map shared by the runtime chooser and the offline sweep's candidate
+    builder so a new rung cannot land in only one of them (the LU
+    ladder needs no map: every depth routes through
+    ``getrf_scattered(..., step=depth)``)."""
+    from ..ops import blocks
+
+    return {"composed": blocks.potrf_panels,
+            "fused": blocks.potrf_steps,
+            "full": blocks.potrf_full}[depth]
+
+
+def choose_potrf_step(n: int, nb: int, dtype, eligible: bool,
+                      eligible_full: bool = False) -> str:
     """Step composition of the f32 right-looking Cholesky driver:
     ``"composed"`` (the strip driver :func:`ops.blocks.potrf_panels` —
-    fused chol+inv panel kernel, XLA trsm-as-gemm and strip updates)
-    vs ``"fused"`` (:func:`ops.blocks.potrf_steps` — the WHOLE step as
+    fused chol+inv panel kernel, XLA trsm-as-gemm and strip updates),
+    ``"fused"`` (:func:`ops.blocks.potrf_steps` — the WHOLE step as
     one pallas invocation with the trailing tiles streamed through a
-    double-buffered VMEM residency).  ``eligible`` is the call site's
-    gate (``ops.blocks.use_fused_potrf_step``); off-TPU the forced
-    knob is honoured for interpret-mode CI."""
+    double-buffered VMEM residency) or ``"full"``
+    (:func:`ops.blocks.potrf_full` — ONE pallas invocation owns the
+    entire factorization, the next panel column lookahead-updated in
+    VMEM).  ``eligible`` / ``eligible_full`` are the call site's gates
+    (``ops.blocks.use_fused_potrf_step`` / ``use_full_potrf``); off-TPU
+    the forced knob is honoured for interpret-mode CI."""
 
     import jax.numpy as jnp
 
@@ -1256,39 +1298,31 @@ def choose_potrf_step(n: int, nb: int, dtype, eligible: bool) -> str:
 
     dt = jnp.dtype(dtype)
     key = (n, nb, dt.name, _precision_name())
-    if not eligible:
+    if not eligible and not eligible_full:
         return _static("potrf_step", key, "composed", "ineligible")
     if config.use_pallas_mode() == "off":
         return _static("potrf_step", key, "composed", "forced-config")
+    depths = _potrf_step_depths(eligible, eligible_full)
     if not _on_tpu():
         forced = _forced("potrf_step")
-        if forced in ("fused", "composed"):
+        if forced in depths:
             return _static("potrf_step", key, forced, "forced")
-        return _default("potrf_step", key, ("composed", "fused"),
-                        "composed")
+        return _default("potrf_step", key, tuple(depths), "composed")
 
     probes: dict = {}
 
     def _spd():
         return _memo(probes, "spd", lambda: _spd_probe(n, dt))
 
-    def setup_fused():
-        from ..ops import blocks
-
-        return _timed_call(lambda x: blocks.potrf_steps(x, nb), _spd())
-
-    def setup_composed():
-        from ..ops import blocks
-
-        return _timed_call(lambda x: blocks.potrf_panels(x, nb), _spd())
+    def _setup(depth):
+        fn = _potrf_step_driver(depth)
+        return _timed_call(lambda x: fn(x, nb), _spd())
 
     def check(out):
         return _potrf_guard(_spd(), out, 3.0)
 
     return decide("potrf_step", key, [
-        Candidate("composed", setup_composed, check),
-        Candidate("fused", setup_fused, check),
-    ])
+        Candidate(d, (lambda d=d: _setup(d)), check) for d in depths])
 
 
 def choose_dist_panel(op: str, nb: int, dtype, eligible: bool) -> str:
@@ -1702,10 +1736,14 @@ _CHOOSERS = {
     "lu_driver": lambda **kw: choose_lu_driver(kw["m"], kw["n"], kw["nb"],
                                                kw["dtype"], kw["eligible"]),
     "lu_step": lambda **kw: choose_lu_step(kw["m"], kw["n"], kw["nb"],
-                                           kw["dtype"], kw["eligible"]),
+                                           kw["dtype"], kw["eligible"],
+                                           kw.get("eligible_full",
+                                                  False)),
     "potrf_step": lambda **kw: choose_potrf_step(kw["n"], kw["nb"],
                                                  kw["dtype"],
-                                                 kw["eligible"]),
+                                                 kw["eligible"],
+                                                 kw.get("eligible_full",
+                                                        False)),
     "dist_panel": lambda **kw: choose_dist_panel(kw["driver"], kw["nb"],
                                                  kw["dtype"],
                                                  kw["eligible"]),

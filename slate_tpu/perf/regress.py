@@ -102,14 +102,17 @@ def parse_label(label: str):
 
 def direction(label: str) -> float:
     """+1 when bigger is better (GFLOP/s, ``*_solves_per_s`` rates,
-    speedup ratios), −1 for wall-second keys (``*_s`` stage timers) and
+    speedup ratios), −1 for wall-second keys (``*_s`` stage timers),
     the serve-latency percentile keys (``*_ms`` — the ISSUE 10
     ``serve_*_p50_ms``/``..._p99_ms`` family: milliseconds, lower is
     better; spelled out explicitly even though ``_ms`` ends in ``_s``
-    so the rule survives a refactor of the wall-second suffix)."""
+    so the rule survives a refactor of the wall-second suffix) and the
+    structural ``*_hbm_roundtrips`` counts (ISSUE 12: materialized
+    inter-stage intermediates per factorization — 0 on the full-fused
+    depth, and a rise is a structural regression)."""
     if label.endswith("_per_s"):
         return 1.0
-    if label.endswith("_ms"):
+    if label.endswith(("_ms", "_hbm_roundtrips")):
         return -1.0
     return -1.0 if label.endswith("_s") else 1.0
 
@@ -264,8 +267,15 @@ class Report:
         return 1 if (self.regressions or self.infra) else 0
 
 
-def _num(v) -> Optional[float]:
-    return float(v) if isinstance(v, (int, float)) and v > 0 else None
+def _num(v, label: str = "") -> Optional[float]:
+    if not isinstance(v, (int, float)):
+        return None
+    if label.endswith("_hbm_roundtrips"):
+        # the structural count's steady state IS 0: a zero here is a
+        # measured value the 0 -> N judge below compares against, not
+        # the failed-routine placeholder the v > 0 filter drops
+        return float(v) if v >= 0 else None
+    return float(v) if v > 0 else None
 
 
 def diff(artifacts: List[Artifact],
@@ -283,7 +293,7 @@ def diff(artifacts: List[Artifact],
                 labels.append(k)
     rows = []
     for label in labels:
-        vals = [_num(a.submetrics.get(label)) for a in artifacts]
+        vals = [_num(a.submetrics.get(label), label) for a in artifacts]
         present = [v for v in vals if v is not None]
         note = ""
         tags = [a.backend_tag(label) for a in artifacts
@@ -315,6 +325,13 @@ def diff(artifacts: List[Artifact],
                 change = sign * (v / prev - 1.0) * 100.0
                 worst_drop = min(worst_drop, change)
                 best_gain = max(best_gain, change)
+            elif prev == 0 and v > 0 \
+                    and label.endswith("_hbm_roundtrips"):
+                # the structural count's expected steady state IS 0, so
+                # a ratio can't express its headline regression — any
+                # materialized intermediate reappearing (0 -> N) is a
+                # REGRESS, not a skipped comparison
+                worst_drop = -float("inf")
             prev = v
         if -worst_drop > threshold_pct:
             verdict = "REGRESS"

@@ -346,8 +346,12 @@ def _build_lu_step(u):
     def check(out):
         return at._lu_factor_residual_ok(out, _a(), m, n, dt)
 
+    from ..linalg.lu import _use_full_fused, _use_fused_step
+
+    depths = at._lu_step_depths(_use_fused_step(m, n, nb, dt),
+                                _use_full_fused(m, n, nb, dt))
     return key, [at.Candidate(d, (lambda d=d: _setup(d)), check)
-                 for d in ("composed", "fused", "fused_trsm")]
+                 for d in depths]
 
 
 def _build_potrf_step(u):
@@ -362,21 +366,19 @@ def _build_potrf_step(u):
     def _spd():
         return at._memo(probes, "spd", lambda: at._spd_probe(n, dt))
 
-    def setup_fused():
-        from ..ops import blocks
-
-        return at._timed_call(lambda x: blocks.potrf_steps(x, nb), _spd())
-
-    def setup_composed():
-        from ..ops import blocks
-
-        return at._timed_call(lambda x: blocks.potrf_panels(x, nb), _spd())
+    def _setup(depth):
+        fn = at._potrf_step_driver(depth)
+        return at._timed_call(lambda x: fn(x, nb), _spd())
 
     def check(out):
         return at._potrf_guard(_spd(), out, 3.0)
 
-    return key, [at.Candidate("composed", setup_composed, check),
-                 at.Candidate("fused", setup_fused, check)]
+    from ..ops.blocks import use_full_potrf, use_fused_potrf_step
+
+    depths = at._potrf_step_depths(use_fused_potrf_step(n, nb, dt),
+                                   use_full_potrf(n, nb, dt))
+    return key, [at.Candidate(d, (lambda d=d: _setup(d)), check)
+                 for d in depths]
 
 
 def _build_lu_driver(u):
@@ -473,11 +475,12 @@ SITES: Dict[str, SiteSpec] = {
         _build_lu_step,
         _fusion_predict("getrf", _dims_mnnb,
                         {"composed": "composed", "fused": "fused",
-                         "fused_trsm": "fused_trsm"})),
+                         "fused_trsm": "fused_trsm", "full": "full"})),
     "potrf_step": SiteSpec(
         _build_potrf_step,
         _fusion_predict("potrf", _dims_nnb,
-                        {"composed": "composed", "fused": "fused"})),
+                        {"composed": "composed", "fused": "fused",
+                         "full": "full"})),
     "lu_driver": SiteSpec(
         _build_lu_driver,
         # the scattered driver's step loop is the fused mega-kernel;

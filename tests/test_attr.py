@@ -236,6 +236,79 @@ def test_fusion_depth_from_autotune_tags():
     assert rep["hbm_roundtrips"]["model"] == 0.0
 
 
+# ---------------------------------------------------------------------------
+# The ``full`` depth tag (ISSUE 12): lookahead overlap credit +
+# reconciliation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("label,gf,op", [
+    ("getrf_fp32_n8192_nb512", 7185.9, "lu_step"),
+    ("potrf_fp32_n8192", 16476.9, "potrf_step"),
+])
+def test_full_depth_reconciles_and_credits_lookahead(label, gf, op):
+    """The full-depth stage model still reconciles stage flops with the
+    reported GFLOP/s at 1% (flop conservation is untouched by the
+    overlap credit), models ZERO hbm round trips, and carries the
+    lookahead split — panel time hidden under the trailing update's
+    roofline minimum, exposed + overlapped summing to the panel's
+    uncredited minimum (the dist_util exposed-vs-overlapped shape)."""
+    tags = {op + "|whatever,512,float32,HIGH": "full"}
+    rep = attr.attribute(label, gf, autotune=tags)
+    assert rep["fusion"] == "full"
+    total = sum(s["flops"] for s in rep["stages"])
+    assert abs(total / rep["measured_s"] / 1e9 - gf) / gf < 0.01
+    assert rep["hbm_roundtrips"]["model"] == 0.0
+    la = rep["lookahead"]
+    assert la["overlapped_s"] + la["exposed_s"] == \
+        pytest.approx(la["panel_min_s"], rel=1e-6)
+    assert la["overlapped_s"] == pytest.approx(
+        min(la["panel_min_s"], la["overlap_budget_s"]), rel=1e-6)
+    # the panel stage's critical-path minimum shrank by the credit
+    pmin = sum(s["min_s"] for s in rep["stages"]
+               if s["stage"] == "panel")
+    assert pmin == pytest.approx(la["exposed_s"], abs=1e-9)
+    # fused (no credit) carries no lookahead block
+    rep_fused = attr.attribute(label, gf)
+    assert "lookahead" not in rep_fused
+    json.loads(json.dumps(rep))
+
+
+def test_full_depth_predicts_faster_than_fused():
+    """predict_seconds prices the full depth BELOW the per-step fused
+    depth (the overlap credit) and both below composed (the round-trip
+    term) — the ordering the sweep's analytical pruning relies on."""
+    dims = {"m": 8192, "n": 8192, "nb": 512}
+    t = {f: attr.predict_seconds("getrf", dims, "fp32", fusion=f)
+         for f in ("composed", "fused", "full")}
+    assert t["full"] < t["fused"] < t["composed"]
+    dims_p = {"n": 8192, "nb": 512}
+    tp = {f: attr.predict_seconds("potrf", dims_p, "fp32", fusion=f)
+          for f in ("composed", "fused", "full")}
+    assert tp["full"] < tp["fused"] < tp["composed"]
+
+
+def test_full_roundtrip_model_matches_live_counter():
+    from slate_tpu.linalg.lu import getrf_scattered
+    from slate_tpu.ops import blocks
+
+    a = jnp.zeros((256, 256), jnp.float32)
+    metrics.reset()
+    metrics.on()
+    try:
+        jax.make_jaxpr(lambda x: getrf_scattered(x, 128, step="full"))(a)
+        jax.make_jaxpr(lambda x: blocks.potrf_full(x, 128))(a)
+        live = metrics.snapshot()["counters"].get(
+            metrics.STEP_HBM_ROUNDTRIPS, 0.0)
+    finally:
+        metrics.reset()
+        metrics.off()
+    assert live == 0.0
+    assert attr.expected_hbm_roundtrips(
+        "getrf", {"m": 256, "n": 256, "nb": 128}, "full") == 0.0
+    assert attr.expected_hbm_roundtrips(
+        "potrf", {"n": 256, "nb": 128}, "full") == 0.0
+
+
 def test_peak_env_overrides(monkeypatch):
     base = attr.peaks("tpu", "fp32")
     monkeypatch.setenv("SLATE_TPU_PEAK_TFLOPS_FP32", "220.0")

@@ -297,6 +297,27 @@ def test_consecutive_regression_not_masked_by_recovery(tmp_path):
     assert [r.verdict for r in report.rows] == ["REGRESS"]
 
 
+def test_hbm_roundtrips_zero_to_n_reads_regress():
+    """ISSUE 12 structural family: the round-trip count's expected
+    steady state IS 0, which the ratio-based judge skips (prev > 0) —
+    a 0 -> N rise (materialized intermediates reappearing) must still
+    read REGRESS, and the N -> 0 win must not."""
+    a1 = regress.Artifact(path="r1", name="r1", submetrics={
+        "gemm_fp32_n8192": 50000.0,
+        "getrf_fp32_n8192_nb512_hbm_roundtrips": 0.0})
+    a2 = regress.Artifact(path="r2", name="r2", submetrics={
+        "gemm_fp32_n8192": 50000.0,
+        "getrf_fp32_n8192_nb512_hbm_roundtrips": 3.0})
+    by = {r.label: r.verdict for r in regress.diff([a1, a2]).rows}
+    assert by["getrf_fp32_n8192_nb512_hbm_roundtrips"] == "REGRESS"
+    by2 = {r.label: r.verdict for r in regress.diff([a2, a1]).rows}
+    assert by2["getrf_fp32_n8192_nb512_hbm_roundtrips"] in ("OK",
+                                                            "IMPROVE")
+    # an all-zero history (the steady state) stays OK
+    by3 = {r.label: r.verdict for r in regress.diff([a1, a1]).rows}
+    assert by3["getrf_fp32_n8192_nb512_hbm_roundtrips"] == "OK"
+
+
 def test_stage_time_submetrics_are_lower_is_better():
     """The per-stage eig/SVD submetrics are wall SECONDS (suffix
     ``_s``): the device bulge chase shrinking stage2_chase must read

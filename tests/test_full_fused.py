@@ -1,0 +1,293 @@
+"""The ISSUE-12 full-factorization mega-kernels — ONE pallas_call owns
+the ENTIRE right-looking factorization (``getrf_full_fused`` /
+``potrf_full_fused``) with in-kernel lookahead — and the ``full`` rung
+of the ``lu_step`` / ``potrf_step`` fusion-depth ladder, exercised in
+interpret mode on CPU (the same program the TPU compiles, so
+pivot/factor parity, the one-launch census and the zero-round-trip pin
+here certify the default-capable path).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+import scipy.linalg as sla
+
+import slate_tpu as st
+from slate_tpu.linalg.lu import getrf_scattered
+from slate_tpu.ops import blocks
+from slate_tpu.perf import autotune, metrics
+from slate_tpu.perf.hlo_profile import count_pallas_calls
+
+
+@functools.lru_cache(maxsize=None)
+def _scattered_fn(nb, step):
+    """One memoized jitted driver per (nb, depth): same-shape tests
+    share a single trace of the (expensive to interpret-trace) full
+    mega-kernel instead of re-tracing per fresh lambda."""
+    return jax.jit(functools.partial(getrf_scattered, nb=nb, step=step))
+
+
+@functools.lru_cache(maxsize=None)
+def _potrf_fn(depth, nb):
+    fn = {"fused": blocks.potrf_steps, "full": blocks.potrf_full}[depth]
+    return jax.jit(functools.partial(fn, nb=nb))
+
+
+def _scipy_perm(a):
+    """Replay scipy's swap sequence into a permutation vector."""
+    _, piv = sla.lu_factor(np.asarray(a, np.float64)
+                           if a.dtype == np.float64 else np.asarray(a),
+                           check_finite=False)
+    want = np.arange(a.shape[0])
+    for k, p in enumerate(piv):
+        want[k], want[p] = want[p], want[k]
+    return want
+
+
+def _check_lu(a, nb, step, pivot_parity=True, tol=3.0):
+    """Residual gate + (optionally) scipy-exact pivots for one step
+    composition of the scattered driver (the test_step_fused helper)."""
+    m, n = a.shape
+    lu, perm = _scattered_fn(nb, step)(jnp.asarray(a))
+    lu, perm = np.asarray(lu), np.asarray(perm)
+    k = min(m, n)
+    assert sorted(perm.tolist()) == list(range(m)), "perm not a permutation"
+    lmat = np.tril(lu[:, :k], -1) + np.eye(m, k, dtype=a.dtype)
+    umat = np.triu(lu[:k])
+    eps = np.finfo(a.dtype).eps
+    res = (np.abs(a[perm] - lmat @ umat).max()
+           / (np.abs(a).max() * max(m, n) * eps))
+    assert res < tol, f"scaled residual {res} ({step})"
+    # TRUE partial pivoting: |L| ≤ 1 up to roundoff
+    assert np.abs(np.tril(lu[:, :k], -1)).max() <= 1.0 + 100 * eps
+    if pivot_parity:
+        np.testing.assert_array_equal(perm[:k], _scipy_perm(a)[:k])
+    return lu, perm
+
+
+class TestGetrfFullFused:
+    """Driver-level parity of the whole-factorization depth vs scipy
+    across square/tall × f32/f64 × the nb sweep the ISSUE names."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("m,n", [(256, 256), (384, 256)])
+    def test_shapes(self, m, n, dtype):
+        a = np.random.default_rng(m + n).standard_normal(
+            (m, n)).astype(dtype)
+        _check_lu(a, 128, "full")
+
+    def test_wide(self):
+        """m < n: the LAST step has no next panel (look off) but still
+        streams the remainder U columns — the has_trail-without-look
+        branch, bitwise against the per-step fused depth."""
+        m, n = 256, 384
+        a = np.random.default_rng(m + n).standard_normal(
+            (m, n)).astype(np.float32)
+        lu_F, p_F = _check_lu(a, 128, "full")
+        lu_f, p_f = map(np.asarray,
+                        _scattered_fn(128, "fused")(jnp.asarray(a)))
+        np.testing.assert_array_equal(p_f, p_F)
+        np.testing.assert_array_equal(lu_f, lu_F)
+
+    @pytest.mark.parametrize("nb", [128, 256, 512])
+    def test_nb_sweep(self, nb):
+        n = 2 * nb if nb <= 256 else nb
+        a = np.random.default_rng(nb).standard_normal(
+            (n, n)).astype(np.float32)
+        _check_lu(a, nb, "full")
+
+    def test_many_tied_pivots(self):
+        """Adversarial ±1 matrix: every column's pivot search hits an
+        m-way exact magnitude tie; the carried-across-steps pivot state
+        must still produce a valid partial-pivot factorization
+        (distinct pivots, |L| ≤ 1, residual-gated) even though tie
+        ORDER differs from LAPACK."""
+        rng = np.random.default_rng(13)
+        a = np.sign(rng.standard_normal((256, 256))).astype(np.float32)
+        _check_lu(a, 128, "full", pivot_parity=False)
+
+    def test_depth_agreement(self):
+        """The full kernel runs the step kernel's exact per-chunk
+        arithmetic (same panel phase, same G/W composition) — where
+        pivots tie-break identically the pivots AND the factors must be
+        BITWISE identical to the fused depth, not merely close.  The
+        composed depth shares the panel arithmetic too (identical
+        pivots) but orders its trailing products differently, so its
+        factors agree only to gemm-rounding."""
+        a = np.random.default_rng(6).standard_normal(
+            (256, 256)).astype(np.float32)
+        lu_F, p_F = _check_lu(a, 128, "full")
+        lu_f, p_f = map(np.asarray,
+                        _scattered_fn(128, "fused")(jnp.asarray(a)))
+        np.testing.assert_array_equal(p_f, p_F)
+        np.testing.assert_array_equal(lu_f, lu_F)
+        lu_c, p_c = map(np.asarray,
+                        _scattered_fn(128, "composed")(jnp.asarray(a)))
+        np.testing.assert_array_equal(p_c, p_F)
+        assert np.abs(lu_F - lu_c).max() < 1e-3 * np.abs(lu_c).max()
+
+
+class TestPotrfFullFused:
+    """Factor parity of the whole-factorization Cholesky kernel."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("n,nb", [(256, 128), (384, 128), (512, 256)])
+    def test_factor_parity(self, n, nb, dtype):
+        rng = np.random.default_rng(n + nb)
+        g = rng.standard_normal((n, n)).astype(dtype)
+        spd = g @ g.T + n * np.eye(n, dtype=dtype)
+        l = np.asarray(_potrf_fn("full", nb)(jnp.asarray(spd)))
+        eps = np.finfo(dtype).eps
+        res = np.linalg.norm(l @ l.T - spd) / (
+            np.linalg.norm(spd) * eps * n)
+        assert res < 3.0, res
+        assert np.abs(np.triu(l, 1)).max() == 0.0
+        ref = np.linalg.cholesky(spd.astype(np.float64))
+        dev = np.abs(l - ref).max() / np.abs(ref).max()
+        assert dev < 300 * eps, dev
+
+    def test_nb512(self):
+        n, nb = 1024, 512
+        rng = np.random.default_rng(7)
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+        l = np.asarray(_potrf_fn("full", nb)(jnp.asarray(spd)))
+        eps = np.finfo(np.float32).eps
+        res = np.linalg.norm(l @ l.T - spd) / (
+            np.linalg.norm(spd) * eps * n)
+        assert res < 3.0, res
+
+    def test_matches_fused_steps_bitwise(self):
+        """Same per-tile arithmetic as the per-step kernel (the
+        lookahead column is the same dot partitioned differently) —
+        the factors must be bitwise identical."""
+        rng = np.random.default_rng(8)
+        g = rng.standard_normal((256, 256)).astype(np.float32)
+        spd = g @ g.T + 256 * np.eye(256, dtype=np.float32)
+        l_s = np.asarray(_potrf_fn("fused", 128)(jnp.asarray(spd)))
+        l_F = np.asarray(_potrf_fn("full", 128)(jnp.asarray(spd)))
+        np.testing.assert_array_equal(l_s, l_F)
+
+
+class TestLaunchAndRoundtripBudgets:
+    """The acceptance pins: exactly ONE pallas_call per whole
+    factorization at eligible sizes, and ``step.hbm_roundtrips == 0``
+    across the entire factorization — structurally, not just timed."""
+
+    def test_getrf_one_pallas_call_per_factorization(self):
+        for m, n, nb in ((256, 256, 128), (384, 256, 128),
+                         (512, 512, 256)):
+            a = jnp.zeros((m, n), jnp.float32)
+            calls = count_pallas_calls(
+                lambda x: getrf_scattered(x, nb, step="full"), a)
+            assert calls == 1, (m, n, nb, calls)
+
+    def test_potrf_one_pallas_call_per_factorization(self):
+        for n, nb in ((256, 128), (512, 256)):
+            a = jnp.zeros((n, n), jnp.float32)
+            calls = count_pallas_calls(
+                lambda x: blocks.potrf_full(x, nb), a)
+            assert calls == 1, (n, nb, calls)
+
+    def _roundtrips(self, fn, *args):
+        was = metrics.enabled()
+        metrics.reset()
+        metrics.on()
+        try:
+            jax.make_jaxpr(fn)(*args)   # trace-time counters fire here
+            snap = metrics.snapshot()["counters"]
+        finally:
+            metrics.reset()
+            if not was:
+                metrics.off()
+        return snap.get(metrics.STEP_HBM_ROUNDTRIPS, 0.0)
+
+    def test_full_depth_pins_zero_hbm_roundtrips(self):
+        a = jnp.zeros((256, 256), jnp.float32)
+        assert self._roundtrips(
+            lambda x: getrf_scattered(x, 128, step="full"), a) == 0.0
+        assert self._roundtrips(
+            lambda x: blocks.potrf_full(x, 128), a) == 0.0
+
+    def test_eligibility_gates(self):
+        """The full gates plan against the shared VMEM budget and sit
+        strictly inside the step gates (TWO resident panels)."""
+        from slate_tpu.linalg.lu import (_full_fused_bytes,
+                                         _fused_step_bytes,
+                                         _use_full_fused)
+
+        assert _use_full_fused(256, 256, 128, jnp.float32)
+        assert not _use_full_fused(256, 256, 192, jnp.float32)  # nb%128
+        for m, nb, tc in ((8192, 512, 512), (4096, 256, 128)):
+            assert _full_fused_bytes(m, nb, tc) > \
+                _fused_step_bytes(m, nb, tc)
+        assert blocks._potrf_full_bytes(1024, 512, 512) > \
+            blocks._potrf_step_bytes(1024, 512, 512)
+        assert blocks.use_full_potrf(1024, 512, jnp.float32)
+        assert not blocks.use_full_potrf(512, 512, jnp.float32)  # n<=nb
+        assert not blocks.use_full_potrf(1024, 512, jnp.float64)
+
+    def test_vmem_budget_moves_the_full_gates(self, monkeypatch):
+        """A starved SLATE_TPU_VMEM_BUDGET_MB must close the full
+        gates through the shared ops.vmem budget (the ONE-helper
+        contract of ISSUE 8)."""
+        from slate_tpu.linalg.lu import _use_full_fused
+
+        monkeypatch.setenv("SLATE_TPU_VMEM_BUDGET_MB", "1")
+        assert not _use_full_fused(4096, 4096, 512, jnp.float32)
+        assert not blocks.use_full_potrf(4096, 512, jnp.float32)
+
+
+class TestEndToEndThroughFullSites:
+    """gesv/posv routed through the full-depth mega-kernels by the
+    autotune sites (force knobs), residual-gated end to end — proof the
+    SHIPPED dispatch (not just the raw drivers) takes the full path."""
+
+    @pytest.fixture(autouse=True)
+    def _force(self, monkeypatch):
+        from slate_tpu.linalg import lu as lu_mod
+        monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+        monkeypatch.setattr(lu_mod, "_SCATTERED_NB", 128)
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                           "lu_step=full,potrf_step=full")
+        autotune.reset_table()
+        yield
+        autotune.reset_table()
+
+    def test_gesv(self):
+        rng = np.random.default_rng(4)
+        n, nrhs = 256, 3
+        a = (rng.standard_normal((n, n)).astype(np.float32)
+             + n * np.eye(n, dtype=np.float32))
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=128),
+                              jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, f"solve residual {res}"
+        dec = autotune.decisions()
+        assert any(k.startswith("lu_step|") and v == "full"
+                   for k, v in dec.items()), dec
+
+    def test_posv(self):
+        rng = np.random.default_rng(9)
+        n, nrhs = 1024, 2
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        a = (g @ g.T / n + np.eye(n, dtype=np.float32)).astype(np.float32)
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        fac, x = st.posv(st.HermitianMatrix(jnp.asarray(a),
+                                            uplo=st.Uplo.Lower),
+                         jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, f"solve residual {res}"
+        dec = autotune.decisions()
+        assert any(k.startswith("potrf_step|") and v == "full"
+                   for k, v in dec.items()), dec

@@ -149,8 +149,8 @@ class TestPruning:
         margin (the ≥2× rep cut run_tests --sweep pins end-to-end)."""
         grid = sweep.GRIDS["smoke"]
         cases = {
-            "lu_step": ["composed", "fused", "fused_trsm"],
-            "potrf_step": ["composed", "fused"],
+            "lu_step": ["composed", "fused", "fused_trsm", "full"],
+            "potrf_step": ["composed", "fused", "full"],
             "lu_driver": ["rec", "scattered"],
             "batched_potrf": ["vmapped", "grid"],
             "batched_lu": ["vmapped", "grid"],
@@ -365,6 +365,59 @@ class TestBundleLadder:
         monkeypatch.setenv(sweep.BUNDLE_ENV, str(path))
         autotune.reset_table()
         assert autotune.table().bundle is None
+
+    def test_pre_full_bundle_still_resolves(self, atab, tmp_path,
+                                            monkeypatch):
+        """ISSUE 12 compat pin: a bundle swept BEFORE the ``full``
+        depth rung existed keeps loading and resolving — its ``fused``
+        entry wins even though today's candidate list carries ``full``,
+        and a key the old bundle never swept falls through to cached
+        timing/probe instead of erroring."""
+        import jax.numpy as jnp
+
+        old_key = (256, 256, 128, "float32", "HIGH")
+        path, _ = _write(tmp_path, _results(
+            [old_key],
+            {"composed": 5e-4, "fused": 1e-4, "fused_trsm": 3e-4},
+            site="lu_step", backend="fused"))
+        monkeypatch.setenv(sweep.BUNDLE_ENV, path)
+        autotune.reset_table()
+        assert autotune.table().bundle is not None
+
+        # off-TPU chooser ladder (the CI path): the old entry resolves
+        # against the WIDENED depth ladder, probe-free
+        monkeypatch.delenv("SLATE_TPU_AUTOTUNE_FORCE", raising=False)
+        got = autotune.choose_lu_step(256, 256, 128, jnp.float32,
+                                      eligible=True, eligible_full=True)
+        assert got == "fused"
+        assert autotune.timing_reps() == 0
+        info = autotune.table().decisions["lu_step|256,256,128,"
+                                          "float32,HIGH"]
+        assert info["source"] == "bundle"
+
+        # decide() with the full candidate present: the bundle entry
+        # still outranks timing for the swept key...
+        autotune.reset_table()
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        cands = [_toy(d, t) for d, t in
+                 (("composed", 0.02), ("fused", 0.01),
+                  ("fused_trsm", 0.015), ("full", 0.0))]
+        assert autotune.decide("lu_step", old_key, cands) == "fused"
+        assert autotune.timing_reps() == 0
+        # ...an unswept same-context key resolves through the old
+        # bundle's interpolating model (still probe-free, still a
+        # pre-full rung — no KeyError on the widened ladder)...
+        got = autotune.decide("lu_step", (512, 512, 128, "float32",
+                                          "HIGH"), cands)
+        assert got == "fused"
+        assert autotune.timing_reps() == 0
+        # ...and a key NEITHER the entries nor the model can match
+        # (different dtype context) falls through to the probe, where
+        # timing is free to pick the new rung
+        got = autotune.decide("lu_step", (512, 512, 128, "float64",
+                                          "HIGH"), cands)
+        assert got == "full"
+        assert autotune.timing_reps() > 0
 
     def test_quarantine_masks_bundle_entry(self, atab, tmp_path,
                                            monkeypatch):
