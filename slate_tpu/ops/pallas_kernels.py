@@ -588,6 +588,90 @@ def trtri_panel(l):
     )(l.astype(dt))
 
 
+def _chol_l21_kernel(a_ref, pan_ref, l_ref, x_ref, inv_ref, *, nb, ib):
+    dt = jnp.promote_types(l_ref.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    _chol_inv_kernel(a_ref, l_ref, inv_ref, nb=nb, ib=ib)
+    # trailing correction fused in: L21 = panel · L⁻ᵀ (trsm-as-gemm on
+    # the whole replicated panel, same VMEM residency as the factor)
+    x_ref[:] = jnp.dot(pan_ref[:], inv_ref[:].T,
+                       preferred_element_type=dt, precision=hi)
+
+
+@_x32_trace
+def chol_l21_panel(a, panel):
+    """ISSUE 13 fused dist_panel body for ppotrf: the (nb, nb) Cholesky
+    + explicit inverse of :func:`chol_inv_panel` AND the full-height
+    trailing trsm-as-gemm L21 = panel·L⁻ᵀ in ONE pallas invocation —
+    the per-step launch of the distributed driver's ``pallas_fused``
+    backend.  Returns ``(L, L21)``.  nb a power of two ≥ 32; f32 on
+    TPU, f32/f64 in interpret mode (dtype follows the operands)."""
+
+    nb = a.shape[-1]
+    ib = min(32, nb)
+    assert nb % ib == 0 and (nb & (nb - 1)) == 0, nb
+    dt = jnp.promote_types(a.dtype, jnp.float32)
+    m = panel.shape[0]
+    l, x = pl.pallas_call(
+        functools.partial(_chol_l21_kernel, nb=nb, ib=ib),
+        out_shape=(jax.ShapeDtypeStruct((nb, nb), dt),
+                   jax.ShapeDtypeStruct((m, nb), dt)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((nb, nb), dt)],
+        interpret=_interpret(),
+    )(a.astype(dt), panel.astype(dt))
+    return l, x
+
+
+def _lu_u12_kernel(l_ref, b_ref, u_ref, dev_ref, inv_ref, *, nb, ib):
+    dt = jnp.promote_types(l_ref.dtype, jnp.float32)
+    hi = jax.lax.Precision.HIGHEST
+    _trtri_panel_kernel(l_ref, inv_ref, nb=nb, ib=ib)
+    b = b_ref[:]
+    # U12 = L⁻¹·A12 with one Newton-style residual correction (the
+    # composed pallas_panel path's gemm pair, fused into the launch)
+    u1 = jnp.dot(inv_ref[:], b, preferred_element_type=dt, precision=hi)
+    r1 = b - jnp.dot(l_ref[:], u1, preferred_element_type=dt, precision=hi)
+    u_ref[:] = u1 + jnp.dot(inv_ref[:], r1,
+                            preferred_element_type=dt, precision=hi)
+    tiny = jnp.finfo(dt).tiny
+    dev_ref[0, 0] = jnp.max(jnp.abs(r1)) / jnp.maximum(
+        jnp.max(jnp.abs(b)), tiny)
+
+
+@_x32_trace
+def lu_u12_panel(l11, rowblk):
+    """ISSUE 13 fused dist_panel body for pgetrf: the unit-lower
+    (nb, nb) trtri of :func:`trtri_panel` AND the block-row solve
+    U12 = L₁₁⁻¹·A12 with its residual-correction gemm pair in ONE
+    pallas invocation.  Returns ``(u12, dev)`` where ``dev`` is the
+    (1, 1) scaled departure ‖A12 − L₁₁·U12′‖∞/‖A12‖∞ of the
+    pre-correction solve — the caller's guard threshold for falling
+    back to the exact trsm (a correction step cannot rescue a wrong
+    inverse on a high-growth panel).  nb a power of two ≥ 32; f32 on
+    TPU, f32/f64 in interpret mode."""
+
+    nb = l11.shape[-1]
+    ib = min(32, nb)
+    assert nb % ib == 0 and (nb & (nb - 1)) == 0, nb
+    dt = jnp.promote_types(l11.dtype, jnp.float32)
+    w = rowblk.shape[1]
+    return pl.pallas_call(
+        functools.partial(_lu_u12_kernel, nb=nb, ib=ib),
+        out_shape=(jax.ShapeDtypeStruct((nb, w), dt),
+                   jax.ShapeDtypeStruct((1, 1), dt)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        scratch_shapes=[pltpu.VMEM((nb, nb), dt)],
+        interpret=_interpret(),
+    )(l11.astype(dt), rowblk.astype(dt))
+
+
 # ---------------------------------------------------------------------------
 # Tall-panel LU with TRUE partial pivoting, scattered-row (no-swap) form —
 # the TPU answer to the reference's multithreaded panel kernel

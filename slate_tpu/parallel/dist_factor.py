@@ -52,12 +52,14 @@ def _conj(a, conj: bool):
 
 @lru_cache(maxsize=None)
 def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
-                  panel_backend: str = "xla"):
+                  panel_backend: str = "xla", depth: int = 1,
+                  chunks: int = 1):
     p, q = mesh_grid_shape(mesh)
     conj = "complex" in dtype_name
     mtp = p * ml
     M = mtp * nb
     bounds = stage_bounds(nt)
+    depth = max(1, min(int(depth), max(1, nt)))
 
     def _panel_factor(d, panel):
         """(L₁₁, L₂₁-below) of the replicated (M, nb) panel — the
@@ -66,7 +68,14 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
         inverse into ONE kernel launch so the full-height trsm becomes
         an MXU gemm — the single-chip fused-panel win inherited by the
         lookahead pipeline (one launch per step per device, was a
-        cholesky + triangular_solve chain)."""
+        cholesky + triangular_solve chain).  ``pallas_fused`` (ISSUE
+        13) folds that trsm-as-gemm INTO the launch: panel + immediate
+        trailing correction in one pallas invocation per step body."""
+        if panel_backend == "pallas_fused":
+            from ..perf.autotune import kernel as _kern
+
+            lkk, x = _kern("chol_l21_panel")(d, panel)
+            return lkk.astype(d.dtype), x.astype(d.dtype)
         if panel_backend == "pallas_panel":
             from ..perf.autotune import kernel as _kern
 
@@ -97,7 +106,10 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
             jblk = jnp.arange(col0 // nb, nl) * q + c
 
             def body(k, carry):
-                a_loc, panel = carry            # panel: bcast column k
+                a_loc, ring = carry     # ring[0]: bcast column k; the
+                # rest are the in-flight panels for steps k+1..k+D-1
+                # (replicated, updated through step k-1)
+                panel = ring[0]
                 # ---- redundant panel factor on the replicated panel:
                 # nb×nb Cholesky + (M, nb) trsm (src/potrf.cc:221-231),
                 # or the fused Pallas chol+inverse panel + MXU gemm
@@ -106,20 +118,34 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 l11, x = _panel_factor(d, panel)
                 w_full = x * (gblk > k)[:, None].astype(dt)     # L21
                 fac = lax.dynamic_update_slice(w_full, l11, (k * nb, 0))
-                # ---- lookahead: update ONLY block column k+1 (narrow
-                # rank-nb gemm off this panel) and issue its broadcast —
-                # no data dependence on the trailing update below, so
-                # the collective overlaps the trailing MXU contraction
                 w_rows = jnp.take(w_full, grows, axis=0)
-                wnext = lax.dynamic_slice(w_full, ((k + 1) * nb, 0),
+                # ---- deep lookahead (ISSUE 13): the in-flight panels
+                # for steps k+1..k+D-1 were broadcast in earlier steps;
+                # bring each up to date with step k's rank-nb correction
+                # computed ENTIRELY from replicated operands (w_full) —
+                # zero extra collectives, so the per-step collective
+                # count is independent of the ring depth
+                new_ring = []
+                for j in range(1, depth):
+                    pj = ring[j]
+                    wj = lax.dynamic_slice(w_full, ((k + j) * nb, 0),
+                                           (nb, nb))
+                    new_ring.append(pj - _mm(w_full, _conj(wj, conj).T))
+                # ---- lookahead broadcast: update ONLY block column
+                # k+D (narrow rank-nb gemm off this panel) and issue
+                # its broadcast — no data dependence on the trailing
+                # update below, so the collective overlaps the trailing
+                # MXU contraction (D = 1 is the PR 1 next-column form)
+                wnext = lax.dynamic_slice(w_full, ((k + depth) * nb, 0),
                                           (nb, nb))
                 # rows above the window are factored (zero in w_rows and
-                # masked off when the next step rolls the panel), so the
-                # narrow gemm and the broadcast ride the window only
-                coln = getcol(a_loc, k + 1)[row0:] \
+                # masked off when the consuming step slices the panel),
+                # so the narrow gemm and the broadcast ride the window
+                coln = getcol(a_loc, k + depth)[row0:] \
                     - _mm(w_rows[row0:], _conj(wnext, conj).T)
-                panel_next = bcast_block_col(
-                    coln, grows[row0:], (k + 1) % q == c, M)
+                new_ring.append(bcast_block_col(
+                    coln, grows[row0:], (k + depth) % q == c, M,
+                    chunks=chunks))
                 # ---- write the factored column into the owner column
                 keep = (gblk_loc >= k)[:, None]
                 newcol = jnp.where(keep, jnp.take(fac, grows, axis=0),
@@ -135,13 +161,15 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 w_cols = w_cols.reshape(-1, nb)
                 win = a_loc[row0:, col0:]
                 win = win - _mm(w_rows[row0:], _conj(w_cols, conj).T)
-                return a_loc.at[row0:, col0:].set(win), panel_next
+                return a_loc.at[row0:, col0:].set(win), tuple(new_ring)
 
             return body
 
-        carry = (a_loc, bcast_block_col(getcol(a_loc, 0), grows,
-                                        0 % q == c, M))
-        return staged_fori(bounds, p, q, nb, make_body, carry)[0]
+        ring0 = tuple(
+            bcast_block_col(getcol(a_loc, j), grows, j % q == c, M,
+                            chunks=chunks) for j in range(depth))
+        return staged_fori(bounds, p, q, nb, make_body,
+                           (a_loc, ring0))[0]
 
     fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
                    out_specs=P(AXIS_P, AXIS_Q))
@@ -157,7 +185,8 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
     padding) — see :func:`pposv` for the glue.
     """
 
-    from .dist_util import dist_panel_backend
+    from .dist_util import (dist_chunk_slices, dist_lookahead_depth,
+                            dist_panel_backend)
 
     p, q = a.grid_shape
     if a.m != a.n:
@@ -167,14 +196,19 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
                          "(distribute with row_mult=q, col_mult=p)")
     ml, nl = a.mtp // p, a.ntp // q
     nt = ceildiv(a.n, a.nb)
+    # the scale-out knobs resolve through autotune BEFORE the lru_cached
+    # shard_map build (part of the build key; see pgetrf)
     fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
-                       dist_panel_backend("potrf", a.nb, a.dtype))
+                       dist_panel_backend("potrf", a.nb, a.dtype,
+                                          m=a.mtp * a.nb),
+                       dist_lookahead_depth("potrf", nt, a.nb, a.dtype),
+                       dist_chunk_slices("potrf", a.nb, a.dtype, a.mesh))
     return like(a, fn(a.data))
 
 
 @lru_cache(maxsize=None)
 def _build_ptrsm(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
-                 trans: bool, dtype_name: str):
+                 trans: bool, dtype_name: str, chunks: int = 1):
     """Distributed left-lower triangular solve; ``trans=True`` solves
     L^H X = B (the second half of potrs).
 
@@ -218,7 +252,8 @@ def _build_ptrsm(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
                 # fused block-column broadcast, diagonal block included
                 col = lax.dynamic_slice(l_loc, (0, (k // q) * nb),
                                         (ml * nb, nb))
-                lcol = bcast_block_col(col, grows, k % q == c, M)
+                lcol = bcast_block_col(col, grows, k % q == c, M,
+                                       chunks=chunks)
                 lkk = lax.dynamic_slice(lcol, (k * nb, 0), (nb, nb))
                 x = lax.linalg.triangular_solve(
                     lkk, bk, left_side=True, lower=True)
@@ -244,7 +279,8 @@ def _build_ptrsm(mesh, nb: int, nt: int, ml: int, nl: int, nrhs_l: int,
                 # fused block-ROW broadcast of L (diagonal included)
                 row = lax.dynamic_slice(l_loc, ((k // p) * nb, 0),
                                         (nb, nl * nb))
-                lrow = bcast_block_row(row, gcols, k % p == r, N)
+                lrow = bcast_block_row(row, gcols, k % p == r, N,
+                                       chunks=chunks)
                 lkk = lax.dynamic_slice(lrow, (0, k * nb), (nb, nb))
                 x = lax.linalg.triangular_solve(
                     lkk, bk, left_side=True, lower=True,
@@ -289,8 +325,15 @@ def ppotrs(l: DistMatrix, b: DistMatrix) -> DistMatrix:
     if b.mtp != l.mtp:
         raise ValueError("B row padding must match the factor "
                          "(distribute with row_mult=q)")
-    fwd = _build_ptrsm(l.mesh, l.nb, nt, ml, nl, nrhs_l, False, str(l.dtype))
-    bwd = _build_ptrsm(l.mesh, l.nb, nt, ml, nl, nrhs_l, True, str(l.dtype))
+    from .dist_util import dist_chunk_slices
+
+    # the solve sweeps ride the same chunked-broadcast arbitration as
+    # the factorizations (dist_chunk, resolved before the cached build)
+    chunks = dist_chunk_slices("trsm", l.nb, l.dtype, l.mesh)
+    fwd = _build_ptrsm(l.mesh, l.nb, nt, ml, nl, nrhs_l, False,
+                       str(l.dtype), chunks)
+    bwd = _build_ptrsm(l.mesh, l.nb, nt, ml, nl, nrhs_l, True,
+                       str(l.dtype), chunks)
     y = fwd(l.data, b.data)
     x = bwd(l.data, y)
     return like(b, x)

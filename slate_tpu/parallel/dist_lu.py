@@ -74,13 +74,149 @@ def _roll_rows(x, shift):
     return jnp.take(x, (jnp.arange(m) + shift) % m, axis=0)
 
 
+# ---------------------------------------------------------------------------
+# Tournament (CALU) pivoting — the ``dist_pivot`` site's second backend
+# (ISSUE 13).  The maxloc path's per-column argmax chain over the full
+# replicated (M, nb) panel is M rows long and strictly sequential; CALU
+# splits the rows into p owner groups, factors each independently for nb
+# local pivot candidates (the groups run data-parallel on the MXU), and
+# combines the candidate sets in a log₂(p) pairwise tournament — the
+# longest sequential chain drops to M/p + nb·log₂(p) rows and the whole
+# pivot search is ONE reduction shape per panel.  Everything below runs
+# REDUNDANTLY on the already-replicated panel: zero extra collectives.
+# ---------------------------------------------------------------------------
+
+def _tournament_pivots(masked, p: int, ml: int, nb: int):
+    """Slot indices (elimination order) of the nb tournament pivot rows
+    of a masked (M, nb) panel.  Groups are the rolled panel's cyclic
+    owner partition (slot block b ↦ group b mod p); each group's local
+    partial-pivot LU nominates its top-nb ORIGINAL rows, then pairwise
+    (2nb, nb) partial-pivot LUs reduce the p candidate sets — the CALU
+    reduction tree with the all-gather amortized into the panel
+    broadcast that already replicated the rows."""
+    grp = masked.reshape(ml, p, nb, nb).transpose(1, 0, 2, 3) \
+        .reshape(p, ml * nb, nb)
+    _, _, perms = jax.vmap(lax.linalg.lu)(grp)
+    sel = perms[:, :nb]                          # (p, nb) local winners
+    cand = jnp.take_along_axis(grp, sel[:, :, None], axis=1)
+    rr = jnp.arange(p, dtype=sel.dtype)[:, None]
+    slot = ((sel // nb) * p + rr) * nb + sel % nb
+    sets = [(cand[r], slot[r]) for r in range(p)]
+    while len(sets) > 1:
+        nxt = []
+        for i in range(0, len(sets) - 1, 2):
+            va, sa = sets[i]
+            vb, sb = sets[i + 1]
+            v = jnp.concatenate([va, vb], axis=0)
+            s = jnp.concatenate([sa, sb], axis=0)
+            _, _, pr = lax.linalg.lu(v)
+            win = pr[:nb]
+            nxt.append((jnp.take(v, win, axis=0), jnp.take(s, win)))
+        if len(sets) % 2 == 1:        # odd count: bye to the next round
+            nxt.append(sets[-1])
+        sets = nxt
+    return sets[0][1].astype(jnp.int32)          # (nb,) slots
+
+
+def _perm_from_targets(t, M: int, nb: int, vma=()):
+    """Sequential-transposition form of "move original rows ``t`` to the
+    top nb slots": returns ``(perm, piv)`` with ``perm`` the full M-slot
+    permutation (``new[i] = old[perm[i]]``) and ``piv`` the LAPACK-style
+    targets (slot j swapped with piv[j], j ascending) — the exact
+    contract ``lax.linalg.lu``'s ``(perm, piv)`` satisfies, so the
+    cross-mesh swap machinery and the gperm fold consume either form
+    unchanged."""
+    pos0 = jnp.arange(M, dtype=jnp.int32)
+    piv0 = jnp.zeros((nb,), jnp.int32)
+    if vma:
+        pos0 = pvary(pos0, vma)
+        piv0 = pvary(piv0, vma)
+
+    def body(j, carry):
+        pos, piv = carry
+        s = jnp.argmax(pos == t[j]).astype(jnp.int32)
+        pj, ps = pos[j], pos[s]
+        pos = pos.at[j].set(ps).at[s].set(pj)
+        return pos, piv.at[j].set(s)
+
+    return lax.fori_loop(0, nb, body, (pos0, piv0))
+
+
+def _elim_col(j, a, rows, cols):
+    """One right-looking elimination step on an (M, nb) panel whose
+    step-``j`` pivot row sits at slot ``j`` — the ONE place both
+    ``dist_pivot`` backends run their arithmetic, so maxloc and
+    tournament factors are bitwise identical whenever their pivot
+    choices agree (per-row updates: a row's value trajectory depends
+    only on its own values and the pivot row's, never on which slot
+    the row occupies).  Packed ``lax.linalg.lu`` layout: U on/above
+    the diagonal, unit-L multipliers strictly below.  A zero pivot
+    (structurally dead panel column) divides by 1 instead of poisoning
+    the factor with NaN."""
+    col = a[:, j]
+    piv = col[j]
+    denom = jnp.where(piv == 0, 1, piv)
+    l = jnp.where(rows > j, col / denom, 0).astype(a.dtype)
+    urow = jnp.where(cols > j, a[j], 0)
+    a = a - l[:, None] * urow[None, :]
+    return a.at[:, j].set(jnp.where(rows > j, l, col))
+
+
+def _nopivot_lu_panel(a):
+    """Right-looking unpivoted elimination of an (M, nb) panel whose
+    pivot rows already sit in the top nb slots (the tournament path's
+    factor step: the search is done, only the arithmetic remains)."""
+    M, nb = a.shape
+    rows = jnp.arange(M)
+    cols = jnp.arange(nb)
+    return lax.fori_loop(
+        0, nb, lambda j, a: _elim_col(j, a, rows, cols), a)
+
+
+def _maxloc_lu_panel(a, vma=()):
+    """``(lu, piv, perm)`` of the masked (M, nb) panel with classic
+    partial pivoting — the per-column |·| argmax chain the tournament
+    collapses, kept as the ``dist_pivot`` baseline.  First-max wins
+    (LAPACK's isamax tie-break) and the elimination arithmetic is the
+    SHARED :func:`_elim_col` step, so on tie-free inputs where the
+    tournament nominates the same rows the two backends' whole
+    factorizations are bitwise identical — the CI pin that makes the
+    arbitration trustworthy.  Same contract as ``lax.linalg.lu``:
+    packed rows in final permuted order, ``perm`` the full M-slot
+    permutation (``new[i] = old[perm[i]]``), ``piv`` the LAPACK-style
+    swap targets."""
+    M, nb = a.shape
+    rows = jnp.arange(M)
+    cols = jnp.arange(nb)
+    pos0 = jnp.arange(M, dtype=jnp.int32)
+    piv0 = jnp.zeros((nb,), jnp.int32)
+    if vma:
+        pos0 = pvary(pos0, vma)
+        piv0 = pvary(piv0, vma)
+
+    def body(j, carry):
+        a, pos, piv = carry
+        mag = jnp.where(rows >= j, jnp.abs(a[:, j]), -1)
+        s = jnp.argmax(mag).astype(jnp.int32)
+        aj, as_ = a[j], a[s]
+        a = a.at[j].set(as_).at[s].set(aj)
+        pj, ps = pos[j], pos[s]
+        pos = pos.at[j].set(ps).at[s].set(pj)
+        return _elim_col(j, a, rows, cols), pos, piv.at[j].set(s)
+
+    a, pos, piv = lax.fori_loop(0, nb, body, (a, pos0, piv0))
+    return a, piv, pos
+
+
 @lru_cache(maxsize=None)
 def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
-                  panel_backend: str = "xla"):
+                  panel_backend: str = "xla", pivot: str = "maxloc",
+                  depth: int = 1, chunks: int = 1):
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
     M = mtp * nb
     bounds = stage_bounds(nt)
+    depth = max(1, min(int(depth), max(1, nt)))
 
     def _u12_solve(l11, rowblk):
         """U₁₂ = L₁₁⁻¹·A₁₂ on the replicated block row.  With the
@@ -91,8 +227,21 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
         ‖(I − L₁₁·X)·c‖∞/‖c‖∞ the exact trsm takes over (a correction
         step cannot rescue a wrong inverse on a high-growth panel; the
         cond compiles once per stage body, not per step — the r4 geqrf
-        per-panel-cond lesson).  The ``xla`` backend keeps the
-        triangular_solve chain."""
+        per-panel-cond lesson).  ``pallas_fused`` (ISSUE 13) folds the
+        trtri AND the solve-with-correction into ONE launch, returning
+        the same departure scalar for the guard.  The ``xla`` backend
+        keeps the triangular_solve chain."""
+        if panel_backend == "pallas_fused":
+            from ..perf.autotune import kernel as _kern
+
+            u12, dev = _kern("lu_u12_panel")(l11, rowblk)
+            return lax.cond(
+                dev[0, 0].astype(l11.dtype) < 1e-2,
+                lambda _: u12.astype(l11.dtype),
+                lambda _: lax.linalg.triangular_solve(
+                    l11, rowblk, left_side=True, lower=True,
+                    unit_diagonal=True),
+                operand=None)
         if panel_backend != "pallas_panel":
             return lax.linalg.triangular_solve(
                 l11, rowblk, left_side=True, lower=True,
@@ -136,17 +285,36 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
             gcblk_w = (wcols // nb) * q + c
 
             def body(k, carry):
-                a_loc, gperm, panel = carry     # panel: bcast column k
+                a_loc, gperm, ring = carry  # ring[0]: bcast column k;
+                # the rest are in-flight panels for steps k+1..k+D-1
+                panel = ring[0]
                 # shift so the diagonal block leads; zero the wrapped
                 # (already factored) rows so they never win a pivot
                 shifted = _roll_rows(panel, k * nb)
                 valid = (rows_g < M - k * nb)[:, None].astype(dt)
-                # ---- redundant panel LU (internal::getrf_panel analog)
-                lu_p, piv, perm = lax.linalg.lu(shifted * valid)
+                masked = shifted * valid
+                if pivot == "tournament":
+                    # ---- CALU: per-group candidates + pairwise
+                    # tournament pick the pivots, then ONE pivot-given
+                    # elimination of the permuted panel (the dist_pivot
+                    # site's arbitration; everything replicated)
+                    tslots = _tournament_pivots(masked, p, ml, nb)
+                    perm, piv = _perm_from_targets(
+                        tslots, M, nb, (AXIS_P, AXIS_Q))
+                    lu_p = _nopivot_lu_panel(
+                        jnp.take(masked, perm, axis=0))
+                else:
+                    # ---- redundant panel LU (internal::getrf_panel
+                    # analog) — the maxloc per-column argmax chain,
+                    # eliminating through the SAME _elim_col arithmetic
+                    # as the tournament path so the two dist_pivot
+                    # backends are bitwise-comparable when pivots agree
+                    lu_p, piv, perm = _maxloc_lu_panel(
+                        masked, (AXIS_P, AXIS_Q))
                 # ---- vectorized cross-mesh row swaps (permuteRows):
                 # destinations = top nb positions ∪ pivot targets (2nb)
-                drel = jnp.concatenate([jnp.arange(nb),
-                                        piv.astype(jnp.int32)])
+                drel = jnp.concatenate([jnp.arange(nb, dtype=jnp.int32),
+                                        piv])
                 srel = jnp.take(perm, drel).astype(jnp.int32)
                 dg = k * nb + drel
                 sg = k * nb + srel
@@ -185,21 +353,69 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                     a_loc[:, col0:], newrow, ((k // p) * nb, 0))
                 a_loc = jnp.where(k % p == r,
                                   a_loc.at[:, col0:].set(upd), a_loc)
-                # ---- lookahead: update ONLY block column k+1 (narrow
-                # rank-nb gemm) and issue its broadcast — it depends on
-                # the swap fetch and the panel, never on the trailing
-                # update below, so the collective overlaps the trailing
-                # MXU contraction
                 myl = myrows * (rel >= nb)[:, None].astype(dt)
+                # ---- deep lookahead (ISSUE 13): in-flight panels for
+                # steps k+1..k+D-1 mirror step k's row swap and receive
+                # its rank-nb correction — all from REPLICATED operands
+                # (the buffer's own post-swap block row k + the rolled-
+                # back factored panel), zero extra collectives
+                new_ring = []
+                if depth > 1:
+                    lu_glob = _roll_rows(lu_p, -(k * nb))
+                    lmask = (rows_g // nb > k)[:, None].astype(dt)
+                    l_glob = lu_glob * lmask
+                    swapped = [ring[j].at[dg].set(
+                        jnp.take(ring[j], sg, axis=0))
+                        for j in range(1, depth)]
+                    # ONE solve for every in-flight panel: the
+                    # concatenated (nb, (D-1)·nb) block row rides a
+                    # single _u12_solve — one launch and one trtri of
+                    # L11 instead of D-1 identical ones (the solve is
+                    # column-independent, so the split-back blocks
+                    # match the per-panel solves bitwise)
+                    us = _u12_solve(l11, jnp.concatenate(
+                        [lax.dynamic_slice(pj, (k * nb, 0), (nb, nb))
+                         for pj in swapped], axis=1))
+                    for i, pj in enumerate(swapped):
+                        uj = us[:, i * nb:(i + 1) * nb]
+                        new_ring.append(pj - _mm(l_glob, uj))
+                        if panel_backend != "xla":
+                            # the pallas solves guard on a departure
+                            # scalar scoped to THEIR block row, so this
+                            # ring solve's cond can branch differently
+                            # from the window solve that wrote U12 into
+                            # a_loc above — and the trailing rows below
+                            # were just corrected with THIS uj.  Make
+                            # the ring solve authoritative for its own
+                            # columns so stored U12 and applied
+                            # correction always agree (a no-op when the
+                            # guards agree: the per-column arithmetic
+                            # is shared).  xla's branch-free solve
+                            # needs no overwrite — keeps the depth
+                            # bitwise pins exactly on the baseline path
+                            kj = k + 1 + i
+                            uput = lax.dynamic_update_slice(
+                                a_loc, uj.astype(dt),
+                                ((k // p) * nb, (kj // q) * nb))
+                            a_loc = jnp.where(
+                                (k % p == r) & (kj % q == c) & (kj < nt),
+                                uput, a_loc)
+                # ---- lookahead broadcast: update ONLY block column
+                # k+D (narrow rank-nb gemm) and issue its broadcast —
+                # it depends on the swap fetch and the panel, never on
+                # the trailing update below, so the collective overlaps
+                # the trailing MXU contraction
                 u_next = lax.dynamic_slice(
-                    newrow, (0, ((k + 1) // q) * nb - col0), (nb, nb))
+                    newrow, (0, ((k + depth) // q) * nb - col0),
+                    (nb, nb))
                 # rows above the window are factored (zero in myl and
-                # masked off when the next step rolls the panel), so the
-                # narrow gemm and the broadcast ride the window only
-                coln = getcol(a_loc, k + 1)[row0:] - _mm(myl[row0:],
-                                                         u_next)
-                panel_next = bcast_block_col(
-                    coln, grows[row0:], (k + 1) % q == c, M)
+                # masked off when the consuming step rolls the panel),
+                # so the narrow gemm and the broadcast ride the window
+                coln = getcol(a_loc, k + depth)[row0:] - _mm(myl[row0:],
+                                                             u_next)
+                new_ring.append(bcast_block_col(
+                    coln, grows[row0:], (k + depth) % q == c, M,
+                    chunks=chunks))
                 # ---- trailing update on the live window only (the
                 # O(n³) hot loop, src/getrf.cc:142+)
                 win = a_loc[row0:, col0:]
@@ -210,7 +426,7 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 gp_perm = jnp.take(gp_shift, perm)
                 gp_back = _roll_rows(gp_perm[:, None], -(k * nb))[:, 0]
                 gperm = jnp.where(rows_g < k * nb, gperm, gp_back)
-                return a_loc, gperm, panel_next
+                return a_loc, gperm, tuple(new_ring)
 
             return body
 
@@ -218,8 +434,10 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
         # the loop body derives gperm from cross-mesh data, making it
         # device-varying in shard_map's type system; match the carry type
         gperm0 = pvary(gperm0, (AXIS_P, AXIS_Q))
-        carry = (a_loc, gperm0,
-                 bcast_block_col(getcol(a_loc, 0), grows, 0 % q == c, M))
+        ring0 = tuple(
+            bcast_block_col(getcol(a_loc, j), grows, j % q == c, M,
+                            chunks=chunks) for j in range(depth))
+        carry = (a_loc, gperm0, ring0)
         a_loc, gperm, _ = staged_fori(bounds, p, q, nb, make_body, carry)
         # every device holds the same permutation; pmax makes that
         # replication visible to the type system for the P() out-spec
@@ -246,12 +464,21 @@ def pgetrf(a: DistMatrix):
     if a.mtp != a.ntp:
         raise ValueError("pgetrf needs square padded storage "
                          "(distribute with row_mult=q, col_mult=p)")
-    from .dist_util import dist_panel_backend
+    from .dist_util import (dist_chunk_slices, dist_lookahead_depth,
+                            dist_panel_backend, dist_pivot_backend)
 
     ml, nl = a.mtp // p, a.ntp // q
     nt = ceildiv(a.n, a.nb)
+    # every scale-out knob resolves through autotune BEFORE the
+    # lru_cached shard_map build so the decisions are part of the build
+    # key (a forced knob change reaches a fresh build, never a stale
+    # cache entry)
     fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
-                       dist_panel_backend("getrf", a.nb, a.dtype))
+                       dist_panel_backend("getrf", a.nb, a.dtype,
+                                          w=nl * a.nb),
+                       dist_pivot_backend(a.nb, p, a.dtype),
+                       dist_lookahead_depth("getrf", nt, a.nb, a.dtype),
+                       dist_chunk_slices("getrf", a.nb, a.dtype, a.mesh))
     lu_data, gperm = fn(a.data)
     return like(a, lu_data), gperm
 

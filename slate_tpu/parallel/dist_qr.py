@@ -58,11 +58,44 @@ from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
 @lru_cache(maxsize=None)
-def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
+def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
+                  panel_backend: str = "xla", depth: int = 1,
+                  chunks: int = 1):
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
     M = mtp * nb
     bounds = stage_bounds(nt)
+    depth = max(1, min(int(depth), max(1, nt)))
+
+    def _panel_factor(masked, rr, cc, dt):
+        """(packed, taus, tmat) of the replicated masked panel.  The
+        ``dist_panel`` site's ``pallas_panel`` backend (ISSUE 13
+        satellite) is the CholQR² + Householder-reconstruction panel —
+        three MXU gemm pairs + fused Pallas chol+inv/trtri kernels, T
+        produced directly (no larft_rec recursion) — guarded by the
+        same validity gate as the single-chip driver
+        (:mod:`slate_tpu.linalg.qr`): CholQR² restores orthogonality
+        only while the first-pass departure ``dev`` < 1, so past the
+        0.25 margin the Householder panel reruns (the operands are
+        replicated, so every device takes the same branch); ``xla``
+        keeps the sequential Householder panel."""
+        def _hh(_=None):
+            packed, taus = _panel_geqrf(masked)
+            v_full = jnp.where(rr > cc, packed,
+                               jnp.where(rr == cc, 1, 0).astype(dt))
+            return packed, taus, larft_rec(v_full, taus)
+
+        if panel_backend != "pallas_panel":
+            return _hh()
+        from ..linalg.qr import _cholqr2_panel
+
+        y, rprime, taus, tmat, dev = _cholqr2_panel(masked)
+        packed = jnp.concatenate(
+            [rprime + jnp.tril(y[:nb], -1), y[nb:]], axis=0)
+        devv = jnp.where(jnp.isfinite(dev), dev, 2.0)
+        return lax.cond(devv < 0.25,
+                        lambda _: (packed, taus, tmat), _hh,
+                        operand=None)
 
     def kernel(a_loc):
         r = lax.axis_index(AXIS_P)
@@ -84,14 +117,15 @@ def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
             gcblk_w = (wcols // nb) * q + c
 
             def body(k, carry):
-                a_loc, tmats, taus_all, panel = carry
+                a_loc, tmats, taus_all, ring = carry
+                panel = ring[0]
                 shifted = _roll_rows(panel, k * nb)
                 valid = (rows_g < M - k * nb)[:, None].astype(dt)
                 # ---- redundant Householder panel + compact-WY T
-                packed, taus = _panel_geqrf(shifted * valid)
+                packed, taus, tmat = _panel_factor(shifted * valid,
+                                                   rr, cc, dt)
                 v_full = jnp.where(rr > cc, packed,
                                    jnp.where(rr == cc, 1, 0).astype(dt))
-                tmat = larft_rec(v_full, taus)
                 # ---- write the packed factor back into column k
                 rel = grows - k * nb
                 myrows = jnp.take(packed, jnp.clip(rel, 0, M - 1), axis=0)
@@ -109,20 +143,35 @@ def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
                 cwin = a_loc[row0:, col0:] * cmask
                 w = lax.psum(_mm(_ct(v_loc[row0:]), cwin), AXIS_P)
                 tw = _mm(_ct(tmat), w)
-                # ---- lookahead: update ONLY block column k+1 (narrow
-                # rank-nb gemm off the replicated W slice) and issue its
-                # broadcast — no data dependence on the wide trailing
-                # contraction below, so XLA overlaps the collective with
-                # the trailing MXU work
+                # ---- deep lookahead (ISSUE 13): the in-flight panels
+                # for steps k+1..k+D-1 receive step k's block-reflector
+                # correction from REPLICATED operands only (the rolled-
+                # back V and the buffer itself — no psum: the buffer is
+                # already whole), zero extra collectives per step
+                new_ring = []
+                if depth > 1:
+                    v_glob = _roll_rows(v_full, -(k * nb)) \
+                        * (rows_g >= k * nb)[:, None].astype(dt)
+                for j in range(1, depth):
+                    pj = ring[j]
+                    wj = _mm(_ct(v_glob), pj)
+                    new_ring.append(
+                        pj - _mm(v_glob, _mm(_ct(tmat), wj)))
+                # ---- lookahead broadcast: update ONLY block column
+                # k+D (narrow rank-nb gemm off the replicated W slice)
+                # and issue its broadcast — no data dependence on the
+                # wide trailing contraction below, so XLA overlaps the
+                # collective with the trailing MXU work
                 u_next = lax.dynamic_slice(
-                    tw, (0, ((k + 1) // q) * nb - col0), (nb, nb))
+                    tw, (0, ((k + depth) // q) * nb - col0), (nb, nb))
                 # rows above the window are factored (zero in v_loc and
-                # masked off when the next step rolls the panel), so the
-                # narrow gemm and the broadcast ride the window only
-                coln = getcol(a_loc, k + 1)[row0:] - _mm(v_loc[row0:],
-                                                         u_next)
-                panel_next = bcast_block_col(
-                    coln, grows[row0:], (k + 1) % q == c, M)
+                # masked off when the consuming step rolls the panel),
+                # so the narrow gemm and the broadcast ride the window
+                coln = getcol(a_loc, k + depth)[row0:] - _mm(v_loc[row0:],
+                                                             u_next)
+                new_ring.append(bcast_block_col(
+                    coln, grows[row0:], (k + depth) % q == c, M,
+                    chunks=chunks))
                 # ---- wide trailing update on the live window
                 win = a_loc[row0:, col0:] - _mm(v_loc[row0:], tw) * cmask
                 a_loc = a_loc.at[row0:, col0:].set(win)
@@ -130,7 +179,7 @@ def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
                     tmats, tmat[None], (k, 0, 0))
                 taus_all = lax.dynamic_update_slice(
                     taus_all, taus[None], (k, 0))
-                return a_loc, tmats, taus_all, panel_next
+                return a_loc, tmats, taus_all, tuple(new_ring)
 
             return body
 
@@ -138,8 +187,10 @@ def _build_pgeqrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str):
                        (AXIS_P, AXIS_Q))
         taus0 = pvary(jnp.zeros((nt, nb), a_loc.dtype),
                       (AXIS_P, AXIS_Q))
-        carry = (a_loc, tmats0, taus0,
-                 bcast_block_col(getcol(a_loc, 0), grows, 0 % q == c, M))
+        ring0 = tuple(
+            bcast_block_col(getcol(a_loc, j), grows, j % q == c, M,
+                            chunks=chunks) for j in range(depth))
+        carry = (a_loc, tmats0, taus0, ring0)
         a_loc, tmats, taus, _ = staged_fori(bounds, p, q, nb, make_body,
                                             carry)
         # replicated values → invariant type for the P() out-specs
@@ -162,6 +213,9 @@ def pgeqrf(a: DistMatrix):
     triangle of ``qr``, V's packed below, and replicated compact-WY T
     blocks ``tmats[k]`` per panel."""
 
+    from .dist_util import (dist_chunk_slices, dist_lookahead_depth,
+                            dist_panel_backend)
+
     p, q = a.grid_shape
     if a.m < a.n:
         raise ValueError("pgeqrf requires m >= n (tall); use gelqf "
@@ -170,7 +224,13 @@ def pgeqrf(a: DistMatrix):
     nt = ceildiv(a.n, a.nb)
     if a.mtp < nt or a.ntp < nt:
         raise ValueError("padded grid too small for the panel count")
-    fn = _build_pgeqrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype))
+    # the QR panel rides the same dist_panel arbitration as
+    # ppotrf/pgetrf (ISSUE 13 satellite), resolved with the lookahead/
+    # chunk knobs BEFORE the lru_cached shard_map build
+    fn = _build_pgeqrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                       dist_panel_backend("geqrf", a.nb, a.dtype),
+                       dist_lookahead_depth("geqrf", nt, a.nb, a.dtype),
+                       dist_chunk_slices("geqrf", a.nb, a.dtype, a.mesh))
     qr_data, tmats, taus = fn(a.data)
     return like(a, qr_data), tmats, taus
 

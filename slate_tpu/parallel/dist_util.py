@@ -44,9 +44,11 @@ def local_grows(ml: int, nb: int, p, r):
     return ((lrows // nb) * p + r) * nb + lrows % nb
 
 
-def dist_panel_backend(op: str, nb: int, dtype) -> str:
+def dist_panel_backend(op: str, nb: int, dtype, m: int | None = None,
+                       w: int | None = None) -> str:
     """Resolve the autotuned ``dist_panel`` site for a distributed
-    driver's per-step panel solve (``"xla"`` | ``"pallas_panel"`` — see
+    driver's per-step panel solve (``"xla"`` | ``"pallas_panel"`` |
+    ``"pallas_fused"`` — see
     :func:`slate_tpu.perf.autotune.choose_dist_panel`).  Called by the
     public drivers BEFORE the ``lru_cache``'d shard_map builders so the
     decision is part of the build key — a forced knob change reaches a
@@ -55,16 +57,87 @@ def dist_panel_backend(op: str, nb: int, dtype) -> str:
     recursive-doubling inverse supports; on a real TPU only f32 (the
     Pallas panels are f32-class there — f64 would hit Mosaic's
     bitwidth ≤ 32 layout check; off-TPU interpret mode runs any real
-    float, which the forced knob uses in CI)."""
+    float, which the forced knob uses in CI).  ``"geqrf"`` resolves the
+    same site (ISSUE 13 satellite): its Pallas panel is the CholQR²
+    reconstruction (:func:`slate_tpu.linalg.qr._cholqr2_panel`), which
+    is f32-class everywhere, so the eligibility tightens to f32.
+
+    ``m``/``w`` are the fused rung's VMEM-resident operand dims —
+    the replicated panel height (ppotrf's ``chol_l21_panel`` stages
+    the whole (m, nb) panel in + L21 out) and the widest block-row
+    solve (pgetrf's ``lu_u12_panel``: (nb, w) in + out).  Unlike the
+    (nb, nb)-operand ``pallas_panel`` rung, those grow with the
+    matrix, so the fused rung is budget-gated like every single-chip
+    Pallas gate (:mod:`slate_tpu.ops.vmem`); callers that do not pass
+    the dims keep the rung eligible (direct chooser probes)."""
     from ..method import select_backend
+    from ..ops import vmem
 
     dt = jnp.dtype(dtype)
     on_tpu = jax.default_backend() == "tpu"
     eligible = (dt.kind == "f" and 32 <= nb <= 1024
                 and (nb & (nb - 1)) == 0
                 and (dt == jnp.float32 or not on_tpu))
+    if op == "geqrf":
+        eligible = eligible and dt == jnp.float32
+    # kernels promote to >= f32 in VMEM; count in + out + nb² scratch
+    isz = max(dt.itemsize, 4)
+    fused_ok = True
+    if m is not None:
+        fused_ok = vmem.fits((2 * m * nb + 3 * nb * nb) * isz)
+    if w is not None:
+        fused_ok = fused_ok and vmem.fits((2 * nb * w + 3 * nb * nb) * isz)
     return select_backend("dist_panel", driver=op, nb=nb, dtype=dt,
+                          eligible=eligible, eligible_fused=fused_ok,
+                          m=m, w=w)
+
+
+def dist_pivot_backend(nb: int, p: int, dtype) -> str:
+    """Resolve the ``dist_pivot`` site for pgetrf's panel pivot search:
+    ``"maxloc"`` (today's path — one ``lax.linalg.lu`` over the full
+    replicated (M, nb) panel, whose per-column argmax chain is M rows
+    long) vs ``"tournament"`` (CALU-style: per-mesh-row local
+    partial-pivot candidates combined in a log₂(p) pairwise tournament,
+    so the longest sequential pivot chain is M/p + nb·log₂(p) rows and
+    the whole search costs ONE reduction shape per panel).  Heuristic +
+    forceable like ``dist_panel`` (timing a collective driver needs the
+    mesh, which the autotuner does not own)."""
+    from ..method import select_backend
+
+    dt = jnp.dtype(dtype)
+    eligible = dt.kind == "f" and nb >= 2 and p >= 1
+    return select_backend("dist_pivot", nb=nb, p=p, dtype=dt,
                           eligible=eligible)
+
+
+def dist_chunk_slices(op: str, nb: int, dtype, mesh) -> int:
+    """Resolve the ``dist_chunk`` site — how many pipelined slices each
+    fused panel broadcast splits into (``"whole"`` = today's single
+    (M, nb) psum; ``"2"``/``"4"`` = that many narrower psums XLA's
+    latency-hiding scheduler can interleave with the trailing MXU
+    contraction).  Keyed per (driver, mesh shape, nb, dtype); returns
+    the slice COUNT as an int clamped to [1, nb]."""
+    from ..method import select_backend
+
+    p, q = mesh_grid_shape(mesh)
+    name = select_backend("dist_chunk", driver=op, nb=nb,
+                          dtype=jnp.dtype(dtype), p=p, q=q)
+    n = 1 if name == "whole" else int(name)
+    return max(1, min(n, nb))
+
+
+def dist_lookahead_depth(op: str, nt: int, nb: int, dtype) -> int:
+    """Resolve the ``dist_lookahead`` site — the depth D of the
+    double-buffered panel ring the lookahead-pipelined drivers carry
+    (D = 1 is the PR 1 single-panel carry; D > 1 keeps the next D
+    block-column panels in flight so panel broadcasts for steps
+    k+1..k+D overlap the step-k trailing contraction).  Returns the
+    depth as an int clamped to the step count."""
+    from ..method import select_backend
+
+    name = select_backend("dist_lookahead", driver=op, nt=nt, nb=nb,
+                          dtype=jnp.dtype(dtype))
+    return max(1, min(int(name), max(1, nt)))
 
 
 def _inject_bcast(out):
@@ -88,7 +161,7 @@ def _inject_bcast(out):
     return out
 
 
-def bcast_block_col(col_loc, grows, own, M: int):
+def bcast_block_col(col_loc, grows, own, M: int, chunks: int = 1):
     """Fused panel broadcast — ONE collective per factorization step.
 
     Replaces the masked ``psum``-along-'q' + ``all_gather``-along-'p'
@@ -99,33 +172,63 @@ def bcast_block_col(col_loc, grows, own, M: int):
     nonzero contributor, so the sum is an all-to-all broadcast).  One
     collective latency instead of two serialized ones, and the trailing
     update's operands never ride a second hop.
+
+    ``chunks > 1`` (the autotuned ``dist_chunk`` site) splits the psum
+    into that many column slices — the same total bytes as that many
+    independent collectives XLA's latency-hiding scheduler can pipeline
+    into the surrounding MXU work, trading per-slice latency for
+    overlap.  Values are bitwise identical to the whole-panel form
+    (each element still rides exactly one psum).
     """
 
     dt = col_loc.dtype
+    w = col_loc.shape[1]
+    chunks = max(1, min(int(chunks), w))
     if metrics.enabled():
-        # trace-time census: one count per bcast in each compiled step
-        # body (multiply by stage_bounds trip counts for per-run totals)
-        metrics.inc("collective.bcast_col.count")
+        # trace-time census: one count per collective in each compiled
+        # step body (multiply by stage_bounds trip counts for totals)
+        metrics.inc("collective.bcast_col.count", float(chunks))
         metrics.inc("collective.bcast_col.bytes",
-                    float(M * col_loc.shape[1] * jnp.dtype(dt).itemsize))
-    buf = jnp.zeros((M, col_loc.shape[1]), dt)
-    buf = buf.at[grows].set(col_loc * own.astype(dt))
-    return _inject_bcast(lax.psum(buf, (AXIS_P, AXIS_Q)))
+                    float(M * w * jnp.dtype(dt).itemsize))
+    scaled = col_loc * own.astype(dt)
+    if chunks == 1:
+        buf = jnp.zeros((M, w), dt)
+        buf = buf.at[grows].set(scaled)
+        return _inject_bcast(lax.psum(buf, (AXIS_P, AXIS_Q)))
+    csz = ceildiv(w, chunks)
+    parts = []
+    for i in range(0, w, csz):
+        buf = jnp.zeros((M, min(csz, w - i)), dt)
+        buf = buf.at[grows].set(scaled[:, i:i + csz])
+        parts.append(lax.psum(buf, (AXIS_P, AXIS_Q)))
+    return _inject_bcast(jnp.concatenate(parts, axis=1))
 
 
-def bcast_block_row(row_loc, gcols, own, N: int):
+def bcast_block_row(row_loc, gcols, own, N: int, chunks: int = 1):
     """Row-space mirror of :func:`bcast_block_col`: replicate a global
     block row (w, N) with one collective (the Lᴴ/U sweeps need the
-    factor's block ROW k)."""
+    factor's block ROW k).  ``chunks`` splits along the w rows exactly
+    as the column form splits along its width."""
 
     dt = row_loc.dtype
+    w = row_loc.shape[0]
+    chunks = max(1, min(int(chunks), w))
     if metrics.enabled():
-        metrics.inc("collective.bcast_row.count")
+        metrics.inc("collective.bcast_row.count", float(chunks))
         metrics.inc("collective.bcast_row.bytes",
-                    float(row_loc.shape[0] * N * jnp.dtype(dt).itemsize))
-    buf = jnp.zeros((row_loc.shape[0], N), dt)
-    buf = buf.at[:, gcols].set(row_loc * own.astype(dt))
-    return _inject_bcast(lax.psum(buf, (AXIS_P, AXIS_Q)))
+                    float(w * N * jnp.dtype(dt).itemsize))
+    scaled = row_loc * own.astype(dt)
+    if chunks == 1:
+        buf = jnp.zeros((w, N), dt)
+        buf = buf.at[:, gcols].set(scaled)
+        return _inject_bcast(lax.psum(buf, (AXIS_P, AXIS_Q)))
+    csz = ceildiv(w, chunks)
+    parts = []
+    for i in range(0, w, csz):
+        buf = jnp.zeros((min(csz, w - i), N), dt)
+        buf = buf.at[:, gcols].set(scaled[i:i + csz])
+        parts.append(lax.psum(buf, (AXIS_P, AXIS_Q)))
+    return _inject_bcast(jnp.concatenate(parts, axis=0))
 
 
 def overlap_summary(n_devices: Optional[int] = None,
@@ -186,6 +289,51 @@ def overlap_summary(n_devices: Optional[int] = None,
             "exposed_collective_s": exposed,
             "overlap_efficiency": eff,
             "per_device": per_device}
+
+
+def scaling_curve(points, floor: float = 0.01) -> dict:
+    """Assemble the MULTICHIP scaling-curve artifact block from the
+    per-device-count measurement points the dry-run children emit
+    (``MULTICHIP_POINT`` lines: ``{"n_devices", "n", "nb", "wall_s",
+    "gflops", "overlap": <overlap_summary block>}``).
+
+    Per-device efficiency is NORMALIZED to the 1-device point (weak
+    scaling at fixed per-device memory: perfect scaling keeps
+    GFLOP/s-per-device flat, so the 1-device point is 1.0 by
+    construction and a collapsing curve reads directly as efficiency
+    < 1).  ``floor`` is the pinned per-device-efficiency floor the
+    regression sentinel judges as a sentinel row
+    (``slate_tpu/perf/regress.py`` — a point below the floor fails CI
+    like any bench regression)."""
+
+    # dedup by device count, keep LAST: a retried scaling child (the
+    # dryrun's classified-infra retry) may have appended its point line
+    # before the first attempt died, and the retry's line — the one
+    # that ran to a clean exit — lands after it in the point file
+    by_nd = {int(p.get("n_devices", 0)): dict(p) for p in points}
+    pts = [by_nd[nd] for nd in sorted(by_nd)]
+    base = None
+    for p in pts:
+        if int(p.get("n_devices", 0)) == 1:
+            base = float(p.get("gflops", 0.0)) or None
+            break
+    if base is None and pts:
+        nd0 = max(1, int(pts[0].get("n_devices", 1)))
+        base = (float(pts[0].get("gflops", 0.0)) / nd0) or None
+    out = []
+    for p in pts:
+        nd = max(1, int(p.get("n_devices", 1)))
+        perdev = float(p.get("gflops", 0.0)) / nd
+        eff = (perdev / base) if base else 0.0
+        out.append({"n_devices": nd,
+                    "n": int(p.get("n", 0)),
+                    "nb": int(p.get("nb", 0)),
+                    "wall_s": float(p.get("wall_s", 0.0)),
+                    "gflops": float(p.get("gflops", 0.0)),
+                    "per_device_gflops": perdev,
+                    "per_device_efficiency": eff,
+                    "overlap": p.get("overlap")})
+    return {"points": out, "efficiency_floor": float(floor)}
 
 
 def stage_bounds(nt: int, nstages: int = 4):

@@ -74,7 +74,8 @@ __all__ = [
     "choose_matmul", "choose_potrf_panel", "choose_potrf_panel_f64",
     "choose_lu_panel", "choose_lu_driver", "choose_trtri_panel",
     "choose_geqrf_panel", "choose_chase", "choose_lu_step",
-    "choose_potrf_step", "choose_dist_panel", "choose_batched_potrf",
+    "choose_potrf_step", "choose_dist_panel", "choose_dist_pivot",
+    "choose_dist_chunk", "choose_dist_lookahead", "choose_batched_potrf",
     "choose_batched_lu", "choose_batched_qr",
 ]
 
@@ -260,6 +261,7 @@ _SAFE_BACKENDS = {
     "lu_driver": "rec", "lu_step": "composed", "potrf_step": "composed",
     "batched_potrf": "vmapped", "batched_lu": "vmapped",
     "batched_qr": "vmapped", "chase": "host_native",
+    "dist_pivot": "maxloc", "dist_chunk": "whole", "dist_lookahead": "1",
 }
 
 
@@ -1325,37 +1327,147 @@ def choose_potrf_step(n: int, nb: int, dtype, eligible: bool,
         Candidate(d, (lambda d=d: _setup(d)), check) for d in depths])
 
 
-def choose_dist_panel(op: str, nb: int, dtype, eligible: bool) -> str:
+def choose_dist_panel(op: str, nb: int, dtype, eligible: bool,
+                      eligible_fused: bool = True, m: int | None = None,
+                      w: int | None = None) -> str:
     """Per-step panel solve backend inside the DISTRIBUTED drivers'
     shard_map bodies: ``"xla"`` (lax cholesky/lu + triangular_solve
-    chain — today's path) vs ``"pallas_panel"`` (the fused VMEM
+    chain — today's path), ``"pallas_panel"`` (the fused VMEM
     chol+inverse / trtri panel kernel + MXU gemms — ONE kernel launch
     per step per device, the single-chip fused-step win inherited by
-    the lookahead pipeline).  Heuristic + forceable only: timing a
-    collective driver needs the mesh, which the autotuner does not
-    own, so on TPU the Pallas panel is the default for eligible shapes
-    and ``SLATE_TPU_AUTOTUNE_FORCE=dist_panel=...`` pins either way."""
+    the lookahead pipeline) or ``"pallas_fused"`` (ISSUE 13: the panel
+    kernel fused with its IMMEDIATE trailing correction — chol+inv+l21
+    / trtri+u12+Newton-correction in one launch per step body, so the
+    per-step glue gemms ride the same VMEM residency as the panel).
+    ``"geqrf"`` resolves two candidates only (``xla`` vs
+    ``pallas_panel`` = the CholQR² reconstruction panel, which already
+    carries its T matrix — there is no separate correction to fuse).
+    Heuristic + forceable only: timing a collective driver needs the
+    mesh, which the autotuner does not own, so on TPU the fused Pallas
+    panel is the default for eligible shapes and
+    ``SLATE_TPU_AUTOTUNE_FORCE=dist_panel=...`` pins any rung.
+
+    ``eligible_fused`` gates the ``pallas_fused`` rung separately: its
+    kernels stage the full-height (M, nb) panel (ppotrf) / full-width
+    (nb, W) block row (pgetrf) as VMEM operands, so unlike the
+    (nb, nb)-operand ``pallas_panel`` rung it must fit the VMEM budget
+    — :func:`slate_tpu.parallel.dist_util.dist_panel_backend` plans
+    the footprint with :mod:`slate_tpu.ops.vmem` and drops the rung
+    (forced pins included) when it cannot compile."""
 
     import jax.numpy as jnp
 
     from .. import config
 
     dt = jnp.dtype(dtype)
-    key = (op, nb, dt.name)
+    # the m/w dims drive the fused rung's VMEM eligibility, so they
+    # belong in the key (pow2-bucketed like lu_step's dims) — one
+    # (op, nb, dtype) key flapping between backends as the matrix size
+    # changes would re-record every dispatch and let a quarantine
+    # raised at one size govern the other
+    key = (op, nb, dt.name) \
+        + (() if m is None else ("m%d" % _bucket_dim(m),)) \
+        + (() if w is None else ("w%d" % _bucket_dim(w),))
+    names = (("xla", "pallas_panel") if op == "geqrf"
+             else ("xla", "pallas_panel", "pallas_fused"))
+    if not eligible_fused and "pallas_fused" in names:
+        names = names[:-1]
     if not eligible:
         return _static("dist_panel", key, "xla", "ineligible")
     forced = _forced("dist_panel")
-    if forced in ("xla", "pallas_panel"):
+    if forced in names:
         return _static("dist_panel", key, forced, "forced")
     mode = config.use_pallas_mode()
     if mode == "off":
         return _static("dist_panel", key, "xla", "forced-config")
     if mode == "on":
-        return _static("dist_panel", key, "pallas_panel", "forced-config")
-    if _on_tpu() and dt == jnp.float32:
-        return _default("dist_panel", key, ("xla", "pallas_panel"),
-                        "pallas_panel")
-    return _default("dist_panel", key, ("xla", "pallas_panel"), "xla")
+        return _static("dist_panel", key, names[-1], "forced-config")
+    if _on_tpu() and dt == jnp.float32 and op != "geqrf":
+        return _default("dist_panel", key, names, names[-1])
+    return _default("dist_panel", key, names, "xla")
+
+
+def choose_dist_pivot(nb: int, p: int, dtype, eligible: bool) -> str:
+    """Pivot-search strategy for pgetrf's replicated panel:
+    ``"maxloc"`` (the classic per-column |·|-argmax chain over the
+    full (M, nb) panel, eliminating through the shared ``_elim_col``
+    step — deliberately unblocked so the two backends are bitwise
+    comparable) vs ``"tournament"``
+    (CALU: the panel rows split into p owner groups, each factored
+    independently for nb local pivot candidates, candidates combined
+    in a log₂(p) pairwise tournament, then ONE pivot-given elimination
+    of the permuted panel — the longest sequential chain shrinks to
+    M/p + nb·log₂(p) rows and the whole search is one reduction shape
+    per panel).  Heuristic + forceable (no mesh to time): tournament
+    is the TPU default for multi-row meshes, maxloc everywhere else —
+    and the arbitration point where depth-1/maxloc can win back."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (nb, p, dt.name)
+    if not eligible:
+        return _static("dist_pivot", key, "maxloc", "ineligible")
+    forced = _forced("dist_pivot")
+    if forced in ("maxloc", "tournament"):
+        return _static("dist_pivot", key, forced, "forced")
+    if _on_tpu() and p > 1:
+        return _default("dist_pivot", key, ("maxloc", "tournament"),
+                        "tournament")
+    return _default("dist_pivot", key, ("maxloc", "tournament"), "maxloc")
+
+
+def choose_dist_chunk(op: str, nb: int, dtype, p: int, q: int) -> str:
+    """Pipelined slice count for the distributed drivers' fused panel
+    broadcasts (``dist_util.bcast_block_col/row``): ``"whole"`` (one
+    (M, nb) psum — today's path), ``"2"`` or ``"4"`` (that many
+    narrower psums XLA's latency-hiding scheduler interleaves with the
+    trailing MXU contraction; same total bytes, bitwise-identical
+    values).  Keyed per (driver, mesh shape, nb, dtype) — the ICI
+    topology axis of the ISSUE 13 co-design; ``perf/sweep.py`` prices
+    the candidates with attr.py's ICI roofline (wire time ÷ slices +
+    per-slice latency) so the offline bundle can pin it per mesh."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (op, p, q, nb, dt.name)
+    names = ("whole", "2", "4")
+    forced = _forced("dist_chunk")
+    if forced in names:
+        return _static("dist_chunk", key, forced, "forced")
+    if _on_tpu() and nb >= 1024:
+        # big panels: wire time dominates the per-slice latency, so a
+        # 2-way split exposes half the bytes to overlap for one extra
+        # collective launch (the sweep's roofline pricing refines this)
+        return _default("dist_chunk", key, names, "2")
+    return _default("dist_chunk", key, names, "whole")
+
+
+def choose_dist_lookahead(op: str, nt: int, nb: int, dtype) -> str:
+    """Depth D of the lookahead panel ring the distributed
+    factorizations carry (``"1"`` — the PR 1 single double-buffered
+    panel — through ``"4"``).  Depth D keeps the next D block-column
+    panels in flight: broadcasts for steps k+1..k+D all overlap the
+    step-k trailing contraction, at the cost of D−1 redundant (M, nb)
+    rank-nb corrections per step (replicated compute, ZERO extra
+    collectives — the per-step collective count is pinned independent
+    of D in tests/test_multichip_scaleout.py).  Heuristic + forceable: deeper
+    rings only pay when the trailing window is wide enough to hide
+    more than one broadcast, so depth 2 is the TPU default for long
+    factorizations and depth 1 everywhere else."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (op, nt, nb, dt.name)
+    names = ("1", "2", "3", "4")
+    forced = _forced("dist_lookahead")
+    if forced in names:
+        return _static("dist_lookahead", key, forced, "forced")
+    if _on_tpu() and nt >= 8:
+        return _default("dist_lookahead", key, names, "2")
+    return _default("dist_lookahead", key, names, "1")
 
 
 def choose_trtri_panel(n: int, dtype) -> str:
@@ -1746,7 +1858,18 @@ _CHOOSERS = {
                                                         False)),
     "dist_panel": lambda **kw: choose_dist_panel(kw["driver"], kw["nb"],
                                                  kw["dtype"],
+                                                 kw["eligible"],
+                                                 kw.get("eligible_fused",
+                                                        True),
+                                                 kw.get("m"), kw.get("w")),
+    "dist_pivot": lambda **kw: choose_dist_pivot(kw["nb"], kw["p"],
+                                                 kw["dtype"],
                                                  kw["eligible"]),
+    "dist_chunk": lambda **kw: choose_dist_chunk(kw["driver"], kw["nb"],
+                                                 kw["dtype"], kw["p"],
+                                                 kw["q"]),
+    "dist_lookahead": lambda **kw: choose_dist_lookahead(
+        kw["driver"], kw["nt"], kw["nb"], kw["dtype"]),
     "trtri_panel": lambda **kw: choose_trtri_panel(kw["n"], kw["dtype"]),
     "geqrf_panel": lambda **kw: choose_geqrf_panel(kw["m"], kw["n"],
                                                    kw["nb"], kw["dtype"]),

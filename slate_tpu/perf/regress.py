@@ -37,6 +37,20 @@ RATE again — higher is better — so :func:`direction` carves it back
 out of the wall-time rule; the sentinel pins serving throughput like
 any other metric.
 
+Multichip scaling curves (ISSUE 13): ``MULTICHIP_r*.json`` artifacts
+whose tail carries the ``MULTICHIP_CURVE`` line (r6+ dry runs —
+``__graft_entry__.dryrun_multichip``'s weak-scaling sweep assembled by
+``dist_util.scaling_curve``) load as per-device-efficiency submetrics
+(``multichip_d<nd>_perdev_eff`` / ``..._perdev_gflops``, higher is
+better) plus ONE sentinel row ``multichip_min_eff_over_floor`` — the
+worst point's efficiency over the artifact's pinned floor.  Any
+``*_over_floor`` row whose newest value is below 1.0 is a REGRESS even
+with no predecessor artifact: the floor is a pinned CI gate, so a
+collapsing curve fails exactly like a bench regression.  Curve-less
+multichip artifacts that predate the sweep (r03–r05: rc=0 with the
+``DRYRUN_MULTICHIP_OK`` marker) load clean with a provenance note;
+rc≠0 or marker-less ones are infra-shaped as always.
+
 Gap explanation (r7): when the sentinel flags a drop, :func:`explain`
 diffs the two artifacts' roofline attribution blocks (bench r7 embeds
 them; older artifacts get the analytical model derived on the spot from
@@ -175,10 +189,65 @@ def _aggregate_from_lines(text: str):
     return agg
 
 
+def _load_multichip(art: "Artifact", blob: dict) -> "Artifact":
+    """Multichip dry-run wrapper (``{"n_devices", "rc", "tail", ...}``):
+    parse the ``MULTICHIP_CURVE`` tail line into per-device-efficiency
+    submetrics plus the ``multichip_min_eff_over_floor`` sentinel row
+    (see module docstring)."""
+    try:
+        art.rc = int(blob.get("rc", 0))
+    except (TypeError, ValueError):
+        art.rc = -1
+    if art.rc != 0:
+        art.infra.append(f"rc={art.rc}")
+    tail = str(blob.get("tail", ""))
+    if "DRYRUN_RETRIED_INFRA" in tail:
+        art.notes.append("retried_infra=true")
+    curve = None
+    for ln in tail.splitlines():
+        if ln.startswith("MULTICHIP_CURVE "):
+            try:
+                curve = json.loads(ln[len("MULTICHIP_CURVE "):])
+            except ValueError:
+                art.infra.append("unparseable scaling curve")
+    if not isinstance(curve, dict):
+        if not art.infra and "DRYRUN_MULTICHIP_OK" in tail:
+            # pre-r6 dry runs are complete artifacts without a curve —
+            # provenance, not breakage
+            art.notes.append("predates scaling curve")
+        elif not art.infra:
+            art.infra.append("no scaling curve")
+        return art
+    try:
+        floor = float(curve.get("efficiency_floor") or 0.0)
+    except (TypeError, ValueError):
+        floor = 0.0
+    subs = {}
+    min_eff = None
+    for pt in curve.get("points") or ():
+        try:
+            nd = int(pt["n_devices"])
+            eff = float(pt["per_device_efficiency"])
+            gf = float(pt.get("per_device_gflops", 0.0))
+        except (TypeError, KeyError, ValueError):
+            art.infra.append("malformed scaling-curve point")
+            continue
+        subs[f"multichip_d{nd}_perdev_eff"] = eff
+        subs[f"multichip_d{nd}_perdev_gflops"] = gf
+        min_eff = eff if min_eff is None else min(min_eff, eff)
+    if min_eff is not None and floor > 0:
+        subs["multichip_min_eff_over_floor"] = min_eff / floor
+    art.submetrics = subs
+    if not subs:
+        art.infra.append("empty scaling curve")
+    return art
+
+
 def load_artifact(path: str) -> "Artifact":
-    """Load one artifact: driver wrapper dict, bare aggregate dict, or
-    raw bench JSON-lines output.  Never raises on malformed content —
-    a file the sentinel cannot parse IS an infra finding."""
+    """Load one artifact: driver wrapper dict, bare aggregate dict,
+    multichip dry-run wrapper, or raw bench JSON-lines output.  Never
+    raises on malformed content — a file the sentinel cannot parse IS
+    an infra finding."""
     name = path.rsplit("/", 1)[-1]
     art = Artifact(path=path, name=name)
     try:
@@ -192,6 +261,9 @@ def load_artifact(path: str) -> "Artifact":
         blob = json.loads(text)
     except ValueError:
         pass
+    if isinstance(blob, dict) and "n_devices" in blob \
+            and "parsed" not in blob:
+        return _load_multichip(art, blob)
     if isinstance(blob, dict) and ("parsed" in blob or "rc" in blob):
         # driver wrapper: {"n", "cmd", "rc", "tail", "parsed"}
         try:
@@ -270,12 +342,27 @@ class Report:
 def _num(v, label: str = "") -> Optional[float]:
     if not isinstance(v, (int, float)):
         return None
-    if label.endswith("_hbm_roundtrips"):
-        # the structural count's steady state IS 0: a zero here is a
-        # measured value the 0 -> N judge below compares against, not
-        # the failed-routine placeholder the v > 0 filter drops
+    if label.endswith(("_hbm_roundtrips", "_over_floor")):
+        # structural counts (steady state 0) and floor-sentinel ratios
+        # (a total efficiency collapse IS 0): zero is a measured value
+        # the structural judges below compare against, not the
+        # failed-routine placeholder the v > 0 filter drops
         return float(v) if v >= 0 else None
     return float(v) if v > 0 else None
+
+
+def _floor_override(label: str, vals, verdict: str, note: str):
+    """``*_over_floor`` sentinel rows (the multichip curve's pinned
+    per-device-efficiency floor): a newest value below 1.0 is a REGRESS
+    regardless of history — the floor gates CI even on the first
+    artifact that carries the curve."""
+    if not label.endswith("_over_floor"):
+        return verdict, note
+    last = next((v for v in reversed(vals) if v is not None), None)
+    if last is not None and last < 1.0:
+        return "REGRESS", ((note + "; ") if note else "") \
+            + "below pinned floor"
+    return verdict, note
 
 
 def diff(artifacts: List[Artifact],
@@ -309,6 +396,7 @@ def diff(artifacts: List[Artifact],
                 verdict = "NEW"
             elif present and vals and vals[-1] is None:
                 verdict = "GONE"
+            verdict, note = _floor_override(label, vals, verdict, note)
             rows.append(Row(label, vals, verdict, None, note))
             continue
         worst_drop = 0.0
@@ -333,8 +421,10 @@ def diff(artifacts: List[Artifact],
                 # REGRESS, not a skipped comparison
                 worst_drop = -float("inf")
             prev = v
-        if -worst_drop > threshold_pct:
+        if -worst_drop > threshold_pct or _floor_override(
+                label, vals, "", "")[0] == "REGRESS":
             verdict = "REGRESS"
+            _, note = _floor_override(label, vals, verdict, note)
         elif vals[-1] is None:
             # present history but missing from the NEWEST artifact: the
             # silent-dropout mode the sentinel exists to catch must not

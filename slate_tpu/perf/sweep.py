@@ -217,6 +217,44 @@ def _predict_matmul(key_parts, names, platform):
     return out
 
 
+#: representative local block-row count per mesh row for pricing the
+#: distributed panel broadcast (the dist_chunk key carries no matrix
+#: height — relative candidate ordering only needs a typical panel)
+_CHUNK_ROWS_PER_DEV = 8
+
+
+def _predict_dist_chunk(key_parts, names, platform):
+    """ICI-roofline pricing for the ``dist_chunk`` site (ISSUE 13):
+    splitting the fused (M, nb) panel broadcast into ``c`` pipelined
+    slices exposes roughly ``wire/c`` seconds of fabric time (the
+    first slice; the rest hide under the trailing MXU contraction) but
+    pays one collective dispatch latency PER slice — predicted exposed
+    ≈ c·launch + wire/c, minimized near c* = √(wire/launch).  Wire
+    time uses :func:`attr.peaks`' ``ici_gbs`` with a representative
+    panel height (:data:`_CHUNK_ROWS_PER_DEV` block rows per mesh
+    row); the key carries no matrix size, so this prices candidate
+    ORDER per (mesh, nb, dtype), which is all pruning needs."""
+    if len(key_parts) < 4:
+        return {}
+    _op, p, q, nb = key_parts[:4]
+    dt = key_parts[4] if len(key_parts) > 4 else "float32"
+    a = _attr()
+    p, q, nb = int(p), int(q), int(nb)
+    isz = {"float64": 8, "complex64": 8, "complex128": 16,
+           "bfloat16": 2}.get(str(dt), 4)
+    m = _CHUNK_ROWS_PER_DEV * p * nb
+    wire = m * nb * isz / (a.peaks(platform)["ici_gbs"] * 1e9)
+    launch = a._DEF_LAUNCH_S.get(platform, a._DEF_LAUNCH_S["tpu"])
+    out = {}
+    for name in names:
+        try:
+            c = 1 if name == "whole" else int(name)
+        except ValueError:
+            return {}
+        out[name] = c * launch + wire / max(1, c)
+    return out
+
+
 def predict_times(site: str, key_parts, names, platform: str = "tpu"
                   ) -> dict:
     """Model-predicted seconds per candidate for one sweep unit (or a
@@ -410,6 +448,77 @@ def _build_lu_driver(u):
                  at.Candidate("scattered", setup_scattered, check)]
 
 
+def _build_dist_chunk(u):
+    """Sweep unit for the distributed panel-broadcast slice count: time
+    the fused ``bcast_block_col`` at each chunking on THE MESH THIS
+    PROCESS OWNS (all available devices, the squarest grid — offline
+    sweeps run on the target topology, which is the whole point of the
+    per-mesh key).  Values are bitwise identical across candidates, so
+    no residual check is needed."""
+    from . import autotune as at
+    import jax
+    import jax.numpy as jnp
+
+    from .._jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ..parallel import dist_util
+    from ..parallel.mesh import AXIS_P, AXIS_Q, make_grid_mesh, \
+        mesh_grid_shape
+
+    op = str(u.get("op", "potrf"))
+    nb = int(u["nb"])
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    mesh = make_grid_mesh()
+    p, q = mesh_grid_shape(mesh)
+    key = (op, p, q, nb, dt.name)
+    mlb = _CHUNK_ROWS_PER_DEV           # block rows per mesh row (the
+    M = mlb * nb * p                    # pricing model's panel height)
+    probes: dict = {}
+
+    nlb = _CHUNK_ROWS_PER_DEV           # block cols per mesh col (the
+    N = nlb * nb * q                    # row-space mirror, for "trsm")
+
+    def _col():
+        return at._memo(probes, "col",
+                        lambda: at._randn((M, nb), dt, 3))
+
+    def _row():
+        return at._memo(probes, "row",
+                        lambda: at._randn((nb, N), dt, 4))
+
+    def _setup(chunks):
+        if op == "trsm":
+            # the ptrsm backward sweep's bcast_block_row — the one
+            # row-space chunked broadcast — times its own variant so
+            # the bundle can pin the solve sweeps too
+            def kernel(row):
+                c = jax.lax.axis_index(AXIS_Q)
+                gcols = dist_util.local_grows(nlb, nb, q, c)
+                own = (jax.lax.axis_index(AXIS_P) == 0)
+                return dist_util.bcast_block_row(row, gcols, own, N,
+                                                 chunks=chunks)
+
+            fn = shard_map(kernel, mesh=mesh,
+                           in_specs=(P(None, AXIS_Q),),
+                           out_specs=P(None, None))
+            return at._timed_call(fn, _row())
+
+        def kernel(col):
+            r = jax.lax.axis_index(AXIS_P)
+            grows = dist_util.local_grows(mlb, nb, p, r)
+            own = (jax.lax.axis_index(AXIS_Q) == 0)
+            return dist_util.bcast_block_col(col, grows, own, M,
+                                             chunks=chunks)
+
+        fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, None),),
+                       out_specs=P(None, None))
+        return at._timed_call(fn, _col())
+
+    return key, [at.Candidate("whole", lambda: _setup(1)),
+                 at.Candidate("2", lambda: _setup(2)),
+                 at.Candidate("4", lambda: _setup(4))]
+
+
 def _build_batched(kind):
     def build(u):
         from . import autotune as at
@@ -495,6 +604,11 @@ SITES: Dict[str, SiteSpec] = {
         _build_batched("lu"),
         _fusion_predict("getrf", _dims_batched,
                         {"vmapped": "composed", "grid": "fused"})),
+    # the distributed panel-broadcast slice count (ISSUE 13): priced
+    # analytically with attr.py's ICI roofline (c·launch + wire/c), so
+    # the offline bundle can pin the chunking per (mesh shape, nb,
+    # dtype) without the runtime ever owning a timeable mesh
+    "dist_chunk": SiteSpec(_build_dist_chunk, _predict_dist_chunk),
 }
 
 
@@ -519,6 +633,9 @@ def _full_units():
         for n in (64, 128, 256, 512):
             units.append({"site": "batched_potrf", "b": b, "n": n})
             units.append({"site": "batched_lu", "b": b, "n": n})
+    for op in ("potrf", "getrf", "geqrf", "trsm"):
+        for nb in (256, 512, 1024):
+            units.append({"site": "dist_chunk", "op": op, "nb": nb})
     return units
 
 

@@ -221,6 +221,31 @@ def test_bundle_loading_inert_at_import():
         (out.stdout, out.stderr)
 
 
+#: raw environment access in the distributed layer: every scale-out
+#: knob (panel backend, pivot strategy, broadcast chunking, lookahead
+#: depth) must resolve through ``method.select_backend`` / the autotune
+#: table so the decision is recorded, forceable, quarantine-maskable
+#: and part of the lru_cached build key — an ``os.environ`` read inside
+#: parallel/ would be an invisible, unforceable knob.
+_ENV_READ_RE = re.compile(r"\bos\.environ\b|\bos\.getenv\b|\bgetenv\(")
+
+
+def test_no_raw_env_reads_in_parallel_layer():
+    """ISSUE 13 guard: every dist_* collective/schedule decision
+    resolves through autotune — no raw env reads in parallel/."""
+    offenders = []
+    for path in sorted((_PKG / "parallel").rglob("*.py")):
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _ENV_READ_RE.search(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "raw environment reads in the parallel/ layer (route the knob "
+        "through perf.autotune / method.select_backend so it is "
+        "recorded, forceable and part of the shard_map build key):\n"
+        + "\n".join(offenders))
+
+
 def test_multi_backend_sites_populate_autotune_table():
     """Exercising each tunable op site must leave a decision entry —
     proof the site consults the table rather than hard-coding a
@@ -259,10 +284,20 @@ def test_multi_backend_sites_populate_autotune_table():
     getrf_scattered(jnp.asarray(np.random.default_rng(1).standard_normal(
         (256, 256)).astype(np.float32)), 128)
 
-    # distributed per-step panel site (resolved by ppotrf/pgetrf before
-    # their shard_map builders run)
-    from slate_tpu.parallel.dist_util import dist_panel_backend
+    # distributed per-step panel site (resolved by ppotrf/pgetrf/pgeqrf
+    # before their shard_map builders run), plus the ISSUE 13 scale-out
+    # knobs: pivot strategy, broadcast chunking, lookahead-ring depth —
+    # every dist_* collective/schedule decision goes through the table
+    from slate_tpu.parallel.dist_util import (dist_chunk_slices,
+                                              dist_lookahead_depth,
+                                              dist_panel_backend,
+                                              dist_pivot_backend)
+    from slate_tpu.parallel.mesh import make_grid_mesh
     dist_panel_backend("potrf", 64, jnp.float32)
+    dist_panel_backend("geqrf", 64, jnp.float32)
+    dist_pivot_backend(64, 2, jnp.float32)
+    dist_lookahead_depth("getrf", 16, 64, jnp.float32)
+    dist_chunk_slices("getrf", 64, jnp.float32, make_grid_mesh(2, 4))
 
     # QR panel site
     st.geqrf(jnp.asarray(rng.standard_normal((2 * n, n)).astype(np.float32)))
@@ -289,6 +324,8 @@ def test_multi_backend_sites_populate_autotune_table():
                "matmul|8,8,8,float64",
                "potrf_panel|", "trtri_panel|", "lu_panel|", "lu_driver|",
                "lu_step|", "potrf_step|", "dist_panel|potrf",
+               "dist_panel|geqrf", "dist_pivot|", "dist_chunk|",
+               "dist_lookahead|",
                "geqrf_panel|", "chase|hb2st",
                "batched_potrf|", "batched_lu|", "batched_qr|"):
         assert any(k.startswith(op) for k in dec), \

@@ -1,0 +1,449 @@
+"""ISSUE 13 multichip scale-out pins: deep-lookahead panel rings,
+tournament (CALU) pivoting, and chunked panel broadcasts.
+
+Three structural guarantees make the new knobs trustworthy enough to
+autotune:
+
+* **bitwise neutrality** — lookahead depth and broadcast chunking are
+  SCHEDULE knobs: every element still receives exactly the same
+  arithmetic (rank-nb corrections off replicated operands; each column
+  rides exactly one psum), so depth-2/chunked results are bitwise
+  identical to the depth-1/whole-panel baselines, and on tie-free
+  inputs the tournament nominates the same pivots as the maxloc chain
+  and shares its elimination arithmetic (``_elim_col``) — bitwise
+  identical factors there too.
+* **collective budget** — the per-step collective count is pinned
+  INDEPENDENT of lookahead depth (the ring updates use only replicated
+  operands) and of the pivot backend (the tournament runs redundantly
+  on the already-replicated panel); chunking splits the one panel psum
+  into exactly ``chunks`` narrower psums moving the same total bytes.
+* **residual gates** — the adversarial many-tied-pivot case (every
+  candidate magnitude equal) may legitimately pick different pivots
+  per backend, so there the gate is the end-to-end gesv residual, not
+  bitwise equality.
+
+All on the 2×4 virtual CPU mesh; the HLO pins hold for the TPU
+lowering of the same programs.  Compiled baselines are shared through
+module fixtures — each distinct (backend, pivot, depth, chunks) build
+compiles exactly once in this module.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.parallel import distribute, make_grid_mesh, pgesv, \
+    undistribute
+from slate_tpu.parallel.dist_factor import _build_ppotrf
+from slate_tpu.parallel.dist_lu import _build_pgetrf
+from slate_tpu.parallel.dist_qr import _build_pgeqrf
+from slate_tpu.perf.hlo_profile import profile_fn
+
+P, Q = 2, 4
+N, NB = 64, 8
+NT = N // NB
+ML, NL = NT // P, NT // Q
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    return make_grid_mesh(P, Q)
+
+
+@pytest.fixture(scope="module")
+def spd_dist(mesh24):
+    g = _rng(0).standard_normal((N, N))
+    a = g @ g.T + N * np.eye(N)
+    return distribute(a, mesh24, NB, diag_pad=1.0, row_mult=Q, col_mult=P)
+
+
+@pytest.fixture(scope="module")
+def gen_dist(mesh24):
+    """Tie-free general matrix (continuous iid entries: pivot-magnitude
+    ties have probability zero)."""
+    a = _rng(1).standard_normal((N, N)) + N * np.eye(N)
+    return distribute(a, mesh24, NB, diag_pad=1.0, row_mult=Q, col_mult=P)
+
+
+def _build(driver, mesh, *, pivot="maxloc", depth=1, chunks=1,
+           geom=(NB, NT, ML, NL)):
+    nb, nt, ml, nl = geom
+    if driver == "ppotrf":
+        return _build_ppotrf(mesh, nb, nt, ml, nl, "float64", "xla",
+                             depth, chunks)
+    if driver == "pgetrf":
+        return _build_pgetrf(mesh, nb, nt, ml, nl, "float64", "xla",
+                             pivot, depth, chunks)
+    return _build_pgeqrf(mesh, nb, nt, ml, nl, "float64", "xla",
+                         depth, chunks)
+
+
+@pytest.fixture(scope="module")
+def ref_potrf(mesh24, spd_dist):
+    fn = _build("ppotrf", mesh24)
+    return np.asarray(jax.jit(fn)(spd_dist.data))
+
+
+@pytest.fixture(scope="module")
+def ref_getrf(mesh24, gen_dist):
+    lu, perm = jax.jit(_build("pgetrf", mesh24))(gen_dist.data)
+    return np.asarray(lu), np.asarray(perm)
+
+
+# ---------------------------------------------------------------------------
+# Bitwise neutrality of the schedule knobs
+# ---------------------------------------------------------------------------
+
+def test_potrf_lookahead_depth_bitwise(mesh24, spd_dist, ref_potrf):
+    deep = _build("ppotrf", mesh24, depth=2)
+    out = np.asarray(jax.jit(deep)(spd_dist.data))
+    assert np.array_equal(ref_potrf, out), \
+        "ppotrf depth-2 ring diverged from the depth-1 baseline"
+
+
+def test_getrf_lookahead_depth_bitwise(mesh24, gen_dist, ref_getrf):
+    deep = _build("pgetrf", mesh24, depth=2)
+    lu2, p2 = jax.jit(deep)(gen_dist.data)
+    assert np.array_equal(ref_getrf[1], np.asarray(p2))
+    assert np.array_equal(ref_getrf[0], np.asarray(lu2)), \
+        "pgetrf depth-2 ring diverged from the depth-1 baseline"
+
+
+@pytest.mark.slow
+def test_geqrf_lookahead_depth_exact_to_roundoff(mesh24, gen_dist):
+    """QR's ring correction is the one place the deep ring REASSOCIATES
+    a reduction: pⱼ − V·Tᵀ·(Vᵀ·pⱼ) contracts Vᵀ·pⱼ over all M rows in
+    ONE replicated gemm (zero extra collectives), where the depth-1
+    panel correction rides the psum-reduced W (p partial gemms summed
+    by the fabric).  Same arithmetic count, different association — so
+    the pin here is exact-to-roundoff + identical shapes, not bitwise
+    (potrf/getrf rings contract over nb only and stay bitwise)."""
+    r0 = jax.jit(_build("pgeqrf", mesh24))(gen_dist.data)
+    r2 = jax.jit(_build("pgeqrf", mesh24, depth=2))(gen_dist.data)
+    eps = np.finfo(np.float64).eps
+    for x0, x2, what in zip(r0, r2, ("qr", "tmats", "taus")):
+        a0, a2 = np.asarray(x0), np.asarray(x2)
+        scale = max(float(np.abs(a0).max()), 1.0)
+        assert np.abs(a0 - a2).max() < 100 * eps * N * scale, \
+            f"pgeqrf depth-2 {what} beyond roundoff of depth-1"
+
+
+def test_tournament_bitwise_parity_tie_free(mesh24, gen_dist, ref_getrf):
+    """On tie-free inputs the tournament nominates exactly the maxloc
+    pivots and eliminates through the shared ``_elim_col`` arithmetic,
+    so the packed factor AND the permutation are bitwise identical —
+    the pin that makes the ``dist_pivot`` arbitration trustworthy."""
+    tr = _build("pgetrf", mesh24, pivot="tournament")
+    lu1, p1 = jax.jit(tr)(gen_dist.data)
+    assert np.array_equal(ref_getrf[1], np.asarray(p1)), \
+        "tournament picked different pivots on a tie-free matrix"
+    assert np.array_equal(ref_getrf[0], np.asarray(lu1))
+
+
+def test_chunked_bcast_bitwise(mesh24, spd_dist, ref_potrf):
+    """Chunking only SPLITS the panel psum — every element still rides
+    exactly one collective, so the factor is bitwise unchanged."""
+    spl = _build("ppotrf", mesh24, chunks=2)
+    out = np.asarray(jax.jit(spl)(spd_dist.data))
+    assert np.array_equal(ref_potrf, out)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end through the public drivers (the autotune-site wiring)
+# ---------------------------------------------------------------------------
+
+def _scaled_res(a, x, b):
+    return np.linalg.norm(a @ x - b) / (
+        np.linalg.norm(a) * np.linalg.norm(x) + np.linalg.norm(b))
+
+
+def test_gesv_depth2_matches_depth1_end_to_end(mesh8, monkeypatch):
+    """The forced ``dist_lookahead`` knob reaches pgesv through the
+    build key, and the depth-2 solve is bitwise the depth-1 solve."""
+    n, nb = 64, 16
+    a = _rng(2).standard_normal((n, n)) + n * np.eye(n)
+    b = _rng(3).standard_normal((n, 3))
+    xs = {}
+    for d in ("1", "2"):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                           "dist_lookahead=" + d)
+        _, _, x = pgesv(a, b, mesh8, nb)
+        xs[d] = np.asarray(undistribute(x))
+    assert np.array_equal(xs["1"], xs["2"])
+    assert _scaled_res(a, xs["2"], b) < 3 * np.finfo(np.float64).eps * n
+
+
+@pytest.mark.parametrize("dtype", [
+    np.float32,
+    pytest.param(np.float64, marks=pytest.mark.slow)])
+@pytest.mark.parametrize("pivot", ["maxloc", "tournament"])
+def test_tied_pivots_residual_gated(mesh8, monkeypatch, dtype, pivot):
+    """Adversarial many-tied-pivot case: a ±1 matrix ties EVERY pivot
+    candidate's magnitude, so the two backends may legitimately pick
+    different rows — the gate is the end-to-end gesv residual, for
+    both dtypes, through the forced ``dist_pivot`` site."""
+    n, nb = 64, 16
+    rng = _rng(4)
+    a = np.where(rng.standard_normal((n, n)) >= 0, 1.0, -1.0) \
+        .astype(dtype)
+    while abs(np.linalg.det(a.astype(np.float64))) < 1e-6:
+        a = np.where(rng.standard_normal((n, n)) >= 0, 1.0,
+                     -1.0).astype(dtype)
+    b = rng.standard_normal((n, 3)).astype(dtype)
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "dist_pivot=" + pivot)
+    _, _, x = pgesv(a, b, mesh8, nb)
+    xh = np.asarray(undistribute(x))
+    # ±1 matrices have real element growth; gate at the usual 3·eps·n
+    # scaled residual times a growth allowance
+    assert _scaled_res(a, xh, b) < 30 * np.finfo(dtype).eps * n
+
+
+def test_chunked_trsm_sweeps_bitwise(mesh24, monkeypatch):
+    """``dist_chunk`` reaches the ptrsm solve sweeps too — including
+    the backward sweep's ``bcast_block_row``, the one row-space
+    chunked broadcast in the codebase — and, like the factorization
+    broadcasts, splitting is a pure schedule knob: the solve is
+    bitwise the whole-psum baseline."""
+    from slate_tpu.parallel import pposv
+    from slate_tpu.perf import autotune
+
+    n, nb = 128, 32
+    g = _rng(31).standard_normal((n, n))
+    a = g @ g.T + n * np.eye(n)
+    b = _rng(32).standard_normal((n, 4))
+    xs = {}
+    for ch in ("whole", "4"):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "dist_chunk=" + ch)
+        autotune.reset_table()
+        try:
+            _, x = pposv(a, b, mesh24, nb=nb)
+            xs[ch] = np.asarray(undistribute(x))
+        finally:
+            autotune.reset_table()
+    assert np.array_equal(xs["whole"], xs["4"])
+    assert _scaled_res(a, xs["4"], b) < 3 * np.finfo(np.float64).eps * n
+
+
+def test_geqrf_rides_dist_panel_site(mesh8, monkeypatch):
+    """ISSUE 13 satellite: pgeqrf resolves the ``dist_panel`` site —
+    forced to the CholQR² reconstruction panel it stays residual-gated
+    and the decision lands in the autotune table keyed under geqrf."""
+    from slate_tpu.parallel import pgeqrf
+    from slate_tpu.perf import autotune
+
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                       "dist_panel=pallas_panel")
+    autotune.reset_table()
+    m, n, nb = 128, 64, 32
+    a = _rng(7).standard_normal((m, n)).astype(np.float32)
+    da = distribute(a, mesh8, nb=nb, diag_pad=1.0, row_mult=Q,
+                    col_mult=P)
+    qr, _, _ = pgeqrf(da)
+    r = np.triu(np.asarray(undistribute(qr)))[:n, :n]
+    res = np.linalg.norm(a.T @ a - r.T @ r) / (np.linalg.norm(a) ** 2)
+    assert res < 10 * np.finfo(np.float32).eps * m
+    dec = autotune.decisions()
+    hits = {k: v for k, v in dec.items()
+            if k.startswith("dist_panel|geqrf")}
+    assert hits and all(v == "pallas_panel" for v in hits.values()), \
+        f"geqrf did not resolve the dist_panel site: {sorted(dec)}"
+    autotune.reset_table()
+
+
+# ---------------------------------------------------------------------------
+# The pallas_fused dist_panel rung (panel + immediate trailing
+# correction in ONE launch per step body) — kernel parity, end-to-end
+# residual gates, launch census, and the VMEM eligibility gate
+# ---------------------------------------------------------------------------
+
+def test_fused_panel_kernels_match_composed():
+    """``chol_l21_panel`` / ``lu_u12_panel`` fold the pallas_panel
+    rung's glue gemms into the launch — same arithmetic, one
+    invocation: the factor block is bitwise the shared
+    ``_chol_inv_kernel``/``_trtri_panel_kernel`` output and the fused
+    trailing solve matches the composed gemm (pair) to roundoff."""
+    from slate_tpu.perf.autotune import kernel
+
+    nb, m = 32, 96
+    rng = _rng(11)
+    g = rng.standard_normal((nb, nb))
+    d = g @ g.T + nb * np.eye(nb)
+    panel = rng.standard_normal((m, nb))
+    l_ref, linv = kernel("chol_inv_panel")(jnp.asarray(d))
+    l, x = kernel("chol_l21_panel")(jnp.asarray(d), jnp.asarray(panel))
+    assert np.array_equal(np.asarray(l_ref), np.asarray(l))
+    eps = np.finfo(np.float64).eps
+    assert np.allclose(np.asarray(x) @ np.asarray(l).T, panel,
+                       atol=100 * eps * nb * np.abs(panel).max())
+
+    # tame subdiagonal: a raw N(0,1) unit-lower triangle's condition
+    # grows ~2ⁿ (Viswanath–Trefethen), which would swamp the dev gate
+    l11 = np.tril(rng.standard_normal((nb, nb)), -1) / np.sqrt(nb) \
+        + np.eye(nb)
+    rowblk = rng.standard_normal((nb, 3 * nb))
+    u12, dev = kernel("lu_u12_panel")(jnp.asarray(l11),
+                                      jnp.asarray(rowblk))
+    linv2 = np.asarray(kernel("trtri_panel")(jnp.asarray(l11)))
+    u1 = linv2 @ rowblk
+    r1 = rowblk - l11 @ u1
+    assert np.allclose(np.asarray(u12), u1 + linv2 @ r1,
+                       atol=100 * eps * nb * np.abs(rowblk).max())
+    assert float(np.asarray(dev)[0, 0]) < 1e-8
+    assert np.allclose(l11 @ np.asarray(u12), rowblk,
+                       atol=100 * eps * nb * np.abs(rowblk).max())
+
+
+def test_dist_panel_fused_parity_end_to_end(mesh24, monkeypatch):
+    """The fused rung must not move the numerics: pposv and pgesv
+    residual-gated end to end with ``dist_panel=pallas_fused`` forced
+    (interpret mode inside the CPU shard_map), including the
+    depth-2-ring combination — the shipped TPU default configuration,
+    where the ring's guarded U12 re-solve must stay consistent with
+    the stored factor."""
+    from slate_tpu.parallel import pposv
+    from slate_tpu.perf import autotune
+
+    n, nb = 192, 32
+    g = _rng(51).standard_normal((n, n))
+    a_spd = g @ g.T + n * np.eye(n)
+    a_gen = _rng(52).standard_normal((n, n)) + n * np.eye(n)
+    b = _rng(53).standard_normal((n, 4))
+    eps = np.finfo(np.float64).eps
+    for force in ("dist_panel=pallas_fused",
+                  "dist_panel=pallas_fused,dist_lookahead=2"):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", force)
+        autotune.reset_table()
+        try:
+            _, x = pposv(a_spd, b, mesh24, nb=nb)
+            assert _scaled_res(a_spd, np.asarray(undistribute(x)),
+                               b) < 3 * eps * n, force
+            _, _, x2 = pgesv(a_gen, b, mesh24, nb=nb)
+            assert _scaled_res(a_gen, np.asarray(undistribute(x2)),
+                               b) < 3 * eps * n, force
+        finally:
+            autotune.reset_table()
+
+
+def test_dist_panel_fused_launch_budget(mesh24):
+    """Census pin for the fused rung: ONE pallas_call per step body —
+    the panel AND its immediate trailing correction ride a single
+    launch (the depth-2 pgetrf ring adds exactly one more launch per
+    body: the in-flight panels' concatenated U12 re-solve)."""
+    from slate_tpu.parallel.dist_factor import _build_ppotrf
+    from slate_tpu.parallel.dist_lu import _build_pgetrf
+    from slate_tpu.parallel.dist_util import stage_bounds
+    from slate_tpu.perf.hlo_profile import count_pallas_calls
+
+    n, nb = 256, 32
+    nt = n // nb
+    ml, nl = nt // P, nt // Q
+    nstages = len(stage_bounds(nt)) - 1
+    data = jnp.zeros((n, n), jnp.float64)
+    fn_c = _build_ppotrf(mesh24, nb, nt, ml, nl, "float64",
+                         "pallas_fused")
+    assert count_pallas_calls(fn_c, data) == nstages
+    fn_l = _build_pgetrf(mesh24, nb, nt, ml, nl, "float64",
+                         "pallas_fused")
+    assert count_pallas_calls(fn_l, data) == nstages
+    fn_l2 = _build_pgetrf(mesh24, nb, nt, ml, nl, "float64",
+                          "pallas_fused", depth=2)
+    assert count_pallas_calls(fn_l2, data) == 2 * nstages
+
+
+def test_dist_panel_fused_vmem_gated(monkeypatch):
+    """Unlike the (nb, nb)-operand pallas_panel rung, the fused
+    kernels stage the full (m, nb) panel / (nb, w) block row in VMEM —
+    the site must drop the rung (forced pins included) for shapes the
+    budget cannot hold, falling back instead of shipping a launch
+    Mosaic would reject at the ISSUE-13 target sizes."""
+    from slate_tpu.parallel.dist_util import dist_panel_backend
+    from slate_tpu.perf import autotune
+
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                       "dist_panel=pallas_fused")
+    autotune.reset_table()
+    try:
+        nb = 512
+        assert dist_panel_backend("potrf", nb, jnp.float32,
+                                  m=4096) == "pallas_fused"
+        assert dist_panel_backend("potrf", nb, jnp.float32,
+                                  m=65536) != "pallas_fused"
+        assert dist_panel_backend("getrf", nb, jnp.float32,
+                                  w=4096) == "pallas_fused"
+        assert dist_panel_backend("getrf", nb, jnp.float32,
+                                  w=1 << 20) != "pallas_fused"
+    finally:
+        autotune.reset_table()
+
+
+# ---------------------------------------------------------------------------
+# Collective budgets off the compiled HLO
+# ---------------------------------------------------------------------------
+
+#: compile-only profile geometry: the per-step collective COUNT is
+#: geometry-independent (only the HLO is inspected, nothing runs), so
+#: the pins compile the smallest program that still KEEPS its staged
+#: while loops — nt = 8 (nt = 4 would give 1-trip stages XLA unrolls,
+#: leaving no communicating loop bodies to census) at the tiny nb = 4
+_PGEOM = (4, 8, 4, 2)                    # (nb, nt, ml, nl) on the 2x4 mesh
+_PN = _PGEOM[0] * _PGEOM[1]
+
+
+@pytest.fixture(scope="module")
+def profiles(mesh24):
+    """Every HLO profile this module pins, compiled once each: the
+    (pivot, depth, chunks) variants of the three factorizations."""
+    data = jnp.zeros((_PN, _PN), jnp.float64)
+    out = {}
+    for driver in ("ppotrf", "pgetrf", "pgeqrf"):
+        for depth in (1, 2):
+            out[(driver, "maxloc", depth, 1)] = profile_fn(
+                _build(driver, mesh24, depth=depth, geom=_PGEOM), data)
+    out[("pgetrf", "tournament", 1, 1)] = profile_fn(
+        _build("pgetrf", mesh24, pivot="tournament", geom=_PGEOM), data)
+    out[("ppotrf", "maxloc", 1, 2)] = profile_fn(
+        _build("ppotrf", mesh24, chunks=2, geom=_PGEOM), data)
+    return out
+
+
+def _per_body_counts(prof):
+    return [b.collective_count for b in prof.step_loops]
+
+
+@pytest.mark.parametrize("driver", ["ppotrf", "pgetrf", "pgeqrf"])
+def test_per_step_collectives_do_not_grow_with_depth(profiles, driver):
+    """The acceptance pin: the lookahead ring updates use REPLICATED
+    operands only, so the per-step collective count is identical at
+    depth 1 and depth 2 — deeper rings buy overlap with redundant
+    compute, never with extra fabric traffic."""
+    base = _per_body_counts(profiles[(driver, "maxloc", 1, 1)])
+    assert base, f"{driver}: no communicating step loops"
+    deep = _per_body_counts(profiles[(driver, "maxloc", 2, 1)])
+    assert deep == base, \
+        f"{driver}: per-step collectives changed with lookahead " \
+        f"depth 2: {base} -> {deep}"
+
+
+def test_tournament_adds_no_collectives(profiles):
+    """CALU runs redundantly on the already-replicated panel: the
+    whole pivot search costs ZERO extra collectives per step."""
+    assert _per_body_counts(profiles[("pgetrf", "tournament", 1, 1)]) \
+        == _per_body_counts(profiles[("pgetrf", "maxloc", 1, 1)])
+
+
+def test_chunked_bcast_splits_but_moves_same_bytes(profiles):
+    """chunks=2 splits the ONE panel psum into exactly two narrower
+    psums per step — collective count +1, total collective bytes
+    unchanged (the dist_chunk trade the sweep prices with the ICI
+    roofline)."""
+    whole = profiles[("ppotrf", "maxloc", 1, 1)]
+    split = profiles[("ppotrf", "maxloc", 1, 2)]
+    for bw, bs in zip(whole.step_loops, split.step_loops):
+        assert bs.collective_count == bw.collective_count + 1
+        assert bs.collective_bytes == bw.collective_bytes

@@ -1,10 +1,16 @@
-"""MULTICHIP artifact schema: the base dry-run wrapper fields plus the
-r7 per-device overlap/efficiency block (``MULTICHIP_ATTR`` tail line,
-produced by ``dist_util.overlap_summary``) that graduates the artifacts
-from smoke markers to the scaling-curve input of ROADMAP item 3.
+"""MULTICHIP artifact schema: the base dry-run wrapper fields, the
+per-device overlap/efficiency block (``MULTICHIP_ATTR`` tail line,
+produced by ``dist_util.overlap_summary``), and the ISSUE 13
+scaling-curve artifact (``MULTICHIP_POINT`` lines + the
+``MULTICHIP_CURVE`` line assembled by ``dist_util.scaling_curve``:
+per-point device count, per-device efficiency normalized to the
+1-device point, overlap split per point, pinned efficiency floor) that
+``perf/regress.py`` judges across rounds like BENCH_r* — including the
+pinned failure on an injected efficiency collapse.
 
-Old artifacts (r01–r05) predate the overlap block and must validate
-WITHOUT it; any artifact that carries one must carry it complete."""
+Old artifacts (r01–r05) predate the overlap block AND the curve and
+must keep loading; any artifact that carries either must carry it
+complete."""
 
 import glob
 import json
@@ -69,6 +75,46 @@ def _overlap_blocks_in_tail(tail: str):
     return out
 
 
+_CURVE_POINT_KEYS = {
+    "n_devices": int,
+    "n": int,
+    "nb": int,
+    "wall_s": (int, float),
+    "gflops": (int, float),
+    "per_device_gflops": (int, float),
+    "per_device_efficiency": (int, float),
+}
+
+
+def _check_curve(curve):
+    """The scaling-curve block: sorted points, the 1-device anchor at
+    efficiency 1.0 when present, a positive pinned floor, and a
+    COMPLETE overlap block wherever one is attached."""
+    assert isinstance(curve, dict)
+    assert isinstance(curve.get("efficiency_floor"), (int, float))
+    assert curve["efficiency_floor"] > 0
+    pts = curve.get("points")
+    assert isinstance(pts, list) and pts
+    devs = []
+    for pt in pts:
+        for key, typ in _CURVE_POINT_KEYS.items():
+            assert key in pt, f"curve point missing {key}"
+            assert isinstance(pt[key], typ), (key, pt[key])
+        assert "overlap" in pt, "curve point missing overlap split"
+        if isinstance(pt["overlap"], dict):
+            _check_overlap_block(pt["overlap"])
+        devs.append(pt["n_devices"])
+        if pt["n_devices"] == 1 and pt["gflops"] > 0:
+            assert pt["per_device_efficiency"] == pytest.approx(1.0)
+    assert devs == sorted(devs)
+
+
+def _curves_in_tail(tail: str):
+    return [json.loads(ln[len("MULTICHIP_CURVE "):])
+            for ln in tail.splitlines()
+            if ln.startswith("MULTICHIP_CURVE ")]
+
+
 def test_checked_in_multichip_artifacts_validate():
     paths = sorted(glob.glob(os.path.join(_REPO, "MULTICHIP_r*.json")))
     assert paths, "no MULTICHIP artifacts checked in"
@@ -79,10 +125,13 @@ def test_checked_in_multichip_artifacts_validate():
             assert key in blob, f"{path}: missing {key}"
             assert isinstance(blob[key], typ), (path, key)
         assert isinstance(blob.get("tail", ""), str)
-        # the overlap block is OPTIONAL (r01-r05 predate it) but must be
-        # complete wherever it appears
+        # the overlap block and the scaling curve are OPTIONAL
+        # (r01-r05 predate both) but must be complete wherever they
+        # appear
         for blk in _overlap_blocks_in_tail(blob.get("tail", "")):
             _check_overlap_block(blk)
+        for curve in _curves_in_tail(blob.get("tail", "")):
+            _check_curve(curve)
 
 
 def test_overlap_summary_schema_from_live_counters(mesh8):
@@ -152,3 +201,120 @@ def test_overlap_summary_without_traffic_is_clean():
     finally:
         metrics.reset()
         metrics.off()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the scaling-curve artifact and its regression judge
+# ---------------------------------------------------------------------------
+
+def _mk_points(effs):
+    """Synthetic weak-scaling points shaped exactly like the
+    ``MULTICHIP_POINT`` lines ``__graft_entry__._scaling_point``
+    emits: per-device GFLOP/s = ``eff`` relative to the 1-device
+    anchor's 2.0."""
+    return [{"n_devices": nd, "n": 32 * nd, "nb": 8, "wall_s": 0.25,
+             "gflops": 2.0 * nd * eff, "overlap": None}
+            for nd, eff in effs]
+
+
+def test_scaling_curve_assembly_normalizes_to_one_device():
+    from slate_tpu.parallel import dist_util
+
+    curve = dist_util.scaling_curve(
+        _mk_points([(4, 0.7), (1, 1.0), (2, 0.9), (8, 0.6)]))
+    _check_curve(curve)
+    pts = curve["points"]
+    assert [p["n_devices"] for p in pts] == [1, 2, 4, 8]
+    assert [round(p["per_device_efficiency"], 6) for p in pts] \
+        == [1.0, 0.9, 0.7, 0.6]
+    json.loads(json.dumps(curve))        # the artifact line is JSON-clean
+
+
+def _wrap_curve(path, curve):
+    tail = "DRYRUN_MULTICHIP_OK r6\nMULTICHIP_CURVE " \
+        + json.dumps(curve) + "\n"
+    with open(path, "w") as f:
+        json.dump({"n_devices": 8, "rc": 0, "ok": True,
+                   "skipped": False, "tail": tail}, f)
+    return str(path)
+
+
+def test_regress_judges_curve_and_fails_on_injected_collapse(tmp_path):
+    """The acceptance pin: a healthy curve passes the sentinel; an
+    injected per-device-efficiency collapse (a point under the pinned
+    floor) fails CI like any bench regression — even as the ONLY
+    artifact, via the ``*_over_floor`` sentinel row."""
+    from slate_tpu.parallel import dist_util
+    from slate_tpu.perf import regress
+
+    good = dist_util.scaling_curve(
+        _mk_points([(1, 1.0), (2, 0.9), (4, 0.8), (8, 0.75)]),
+        floor=0.5)
+    bad = dist_util.scaling_curve(
+        _mk_points([(1, 1.0), (2, 0.9), (4, 0.3), (8, 0.05)]),
+        floor=0.5)
+    ga = regress.load_artifact(_wrap_curve(tmp_path / "good.json", good))
+    assert not ga.infra
+    assert ga.submetrics["multichip_d8_perdev_eff"] \
+        == pytest.approx(0.75)
+    assert regress.diff([ga]).exit_code == 0
+
+    ba = regress.load_artifact(_wrap_curve(tmp_path / "bad.json", bad))
+    rep = regress.diff([ba])
+    assert rep.exit_code == 1
+    floor_rows = [r for r in rep.rows
+                  if r.label == "multichip_min_eff_over_floor"]
+    assert floor_rows and floor_rows[0].verdict == "REGRESS"
+    assert "below pinned floor" in floor_rows[0].note
+    # across rounds the per-device rows diff like any BENCH metric
+    pair = regress.diff([ga, ba])
+    assert pair.exit_code == 1
+    assert any(r.label == "multichip_d8_perdev_eff"
+               and r.verdict == "REGRESS" for r in pair.rows)
+
+
+def test_old_multichip_artifacts_load_clean_in_regress():
+    """r03–r05 (rc=0, no curve) are provenance-noted, never
+    infra-shaped; red rounds (r01/r02, rc=1) stay infra-shaped."""
+    from slate_tpu.perf import regress
+
+    for name, want_infra in (("MULTICHIP_r05.json", False),
+                             ("MULTICHIP_r03.json", False),
+                             ("MULTICHIP_r01.json", True)):
+        art = regress.load_artifact(os.path.join(_REPO, name))
+        assert bool(art.infra) == want_infra, (name, art.infra)
+        if not want_infra:
+            assert "predates scaling curve" in art.notes
+
+
+def test_dryrun_default_sweep_covers_1_2_4_8():
+    """The driver-facing default: the weak-scaling sweep covers at
+    least 1, 2, 4 and 8 simulated devices."""
+    import inspect
+
+    import __graft_entry__ as g
+
+    sig = inspect.signature(g.dryrun_multichip)
+    assert tuple(sig.parameters["scale_counts"].default) == (1, 2, 4, 8)
+
+
+@pytest.mark.slow
+def test_dryrun_emits_scaling_curve_end_to_end(capfd):
+    """Reduced-scale end-to-end: the real subprocess sweep emits one
+    MULTICHIP_POINT per device count (each with a complete overlap
+    block) and a schema-valid MULTICHIP_CURVE whose 1-device anchor is
+    efficiency 1.0."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(2, scale_counts=(1, 2))
+    out = capfd.readouterr().out
+    points = [json.loads(ln[len("MULTICHIP_POINT "):])
+              for ln in out.splitlines()
+              if ln.startswith("MULTICHIP_POINT ")]
+    assert [p["n_devices"] for p in points] == [1, 2]
+    for p in points:
+        _check_overlap_block(p["overlap"])
+    curves = _curves_in_tail(out)
+    assert len(curves) == 1
+    _check_curve(curves[0])
+    assert [p["n_devices"] for p in curves[0]["points"]] == [1, 2]

@@ -21,6 +21,16 @@ missing/empty/partial aggregate) — the checks that would have flagged
 the r3→r4 geqrf drop (23.5 → 18.9 TF/s) and the empty BENCH_r05
 (rc=124, parsed null) automatically.
 
+``MULTICHIP_r*.json`` dry-run wrappers load too (ISSUE 13): an artifact
+whose tail carries the ``MULTICHIP_CURVE`` weak-scaling line is judged
+as per-device-efficiency rows (``multichip_d<nd>_perdev_eff``, higher
+is better) plus the ``multichip_min_eff_over_floor`` sentinel row — a
+value below 1.0 (a point under the curve's pinned efficiency floor)
+fails even with a single artifact, so a collapsing scaling curve fails
+CI like any bench regression::
+
+    python tools/bench_diff.py MULTICHIP_r06.json MULTICHIP_r07.json
+
 Stdlib-only: the implementation (``slate_tpu/perf/regress.py``) is
 loaded directly by file path so this tool never imports jax and runs in
 milliseconds on any machine.
