@@ -33,10 +33,16 @@ MEDIUM = "512,1024"
 
 #: the --chaos tier's canned low-rate deterministic fault plan
 #: (slate_tpu/resilience/inject.py grammar): every routine suite runs
-#: once with these faults firing and SLATE_TPU_HEALTH=retry degrading
-#: around them — green means the resilience ladder absorbs them.
+#: once with these faults firing and SLATE_TPU_HEALTH=retry +
+#: SLATE_TPU_ABFT=correct degrading around them — green means the
+#: resilience ladder absorbs them.  ISSUE 14 adds the numerical-silent
+#: kinds: ``bitflip`` at the trailing-update seam (ABFT detects,
+#: locates, corrects or recomputes) and ``device_loss`` at the step
+#: boundary (checkpoint/restart resumes); zero stranded work and every
+#: answer residual-gated, mirroring the PR 9 serve-chaos shape.
 CHAOS_PLAN = ("driver.output=nan:0.02,autotune.probe=error:0.05,"
-              "serve.dispatch=error:0.05")
+              "serve.dispatch=error:0.05,driver.update=bitflip:0.05,"
+              "step.boundary=device_loss:0.02:2")
 CHAOS_SEED = "20260803"
 
 SINGLE = ["gemm", "symm", "hemm", "syrk", "herk", "syr2k", "her2k", "trmm",
@@ -311,12 +317,16 @@ def main(argv=None):
         os.environ.setdefault("SLATE_TPU_FAULT_INJECT", CHAOS_PLAN)
         os.environ.setdefault("SLATE_TPU_FAULT_SEED", CHAOS_SEED)
         os.environ.setdefault("SLATE_TPU_HEALTH", "retry")
+        os.environ.setdefault("SLATE_TPU_ABFT", "correct")
+        os.environ.setdefault("SLATE_TPU_CKPT_EVERY_STEPS", "2")
         if not args.medium:
             args.quick = True       # "fast" tier: quick dims
         print(f"=== chaos tier: SLATE_TPU_FAULT_INJECT="
               f"{os.environ['SLATE_TPU_FAULT_INJECT']} seed="
               f"{os.environ['SLATE_TPU_FAULT_SEED']} health="
-              f"{os.environ['SLATE_TPU_HEALTH']}", flush=True)
+              f"{os.environ['SLATE_TPU_HEALTH']} abft="
+              f"{os.environ['SLATE_TPU_ABFT']} ckpt_every="
+              f"{os.environ['SLATE_TPU_CKPT_EVERY_STEPS']}", flush=True)
 
     dims = QUICK if args.quick else (MEDIUM if args.medium else SMALL)
     routines = (args.routines.split(",") if args.routines

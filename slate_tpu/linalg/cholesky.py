@@ -52,29 +52,54 @@ def potrf(a, opts: Optional[Options] = None):
     if full.shape[-1] != full.shape[-2]:
         from ..exceptions import SlateError
         raise SlateError(f"potrf requires a square matrix, got {full.shape}")
-    # Method dispatch (reference method.hh / internal_potrf.cc:53-72:
-    # the diagonal factor goes to the vendor library): under Auto the
-    # backend comes from the autotune table (method.select_backend →
-    # perf.autotune): f32 times the fused Pallas panel path (the
-    # unrolled chol+inv diagonal kernel, ~290 µs/512² vs ~1190 µs for
-    # XLA's cholesky at n=8192) against XLA's fused cholesky per
-    # (n, nb, dtype) key; fp64 times the f32-panel+Newton+Ozaki path
-    # against XLA's emulated-fp64 cholesky.  Off-TPU (CPU mesh tests,
-    # complex) Auto resolves to XLA with zero timing; "recursive"
-    # keeps the explicit nb recursion.
-    from ..method import select_backend
     from ..options import get_option
     method = get_option(opts, "method_factor", "auto")
     nbsel = 512 if nb <= 256 else nb
-    # step-depth dispatch first (ISSUE 6/12): the ``potrf_step`` site
-    # arbitrates the fusion-depth ladder — "full" makes the WHOLE
-    # factorization one pallas invocation (grid over steps, in-kernel
-    # lookahead), "fused" keeps one invocation per right-looking step
-    # (panel chol+inv + trsm-as-gemm + double-buffered streamed
-    # trailing update) — otherwise the composed strip/XLA paths below
+    branch = _potrf_branch(full, nb, nbsel, method)
+    from ..resilience import abft as _abft
+    if _abft.eligible(full):
+        # ABFT (ISSUE 14): the stock branches run the checksum-carried
+        # step loop (the checksum block-row rides each trailing
+        # syrk-gemm, per-step verify/correct/recompute) at the CALLER's
+        # nb — the jnp-composed loop has no 512-wide panel-kernel
+        # constraint, and finer steps mean finer verify/recompute
+        # granularity.  The kernel-owned branches (fused/full depths,
+        # Pallas panels, Ozaki) run under the checksum envelope —
+        # verify the factor identity after the invocation (still
+        # dispatched at nbsel, unchanged), recompute it on detection.
+        l = _abft.potrf_guarded(
+            full, nb, branch,
+            lambda: _potrf_dispatch(branch, full, nb, nbsel))
+    else:
+        l = _potrf_dispatch(branch, full, nb, nbsel)
+    fac = l if uplo is Uplo.Lower else jnp.conj(l.T)
+    out = TriangularMatrix(fac, uplo=uplo, diag=Diag.NonUnit,
+                           mb=getattr(a, "mb", nb), nb=nb,
+                           grid=getattr(a, "grid", None))
+    return out
+
+
+def _potrf_branch(full, nb: int, nbsel: int, method) -> str:
+    """Resolve which potrf backend branch the Auto dispatch takes —
+    reference method.hh / internal_potrf.cc:53-72 (the diagonal factor
+    goes to the vendor library), autotuned per ISSUE 2/6/12: the
+    ``potrf_step`` site arbitrates the fusion-depth ladder first
+    ("full" = the whole factorization in ONE pallas invocation with
+    in-kernel lookahead; "fused" = one invocation per right-looking
+    step), then the f32 Pallas panel path (~290 µs/512² vs ~1190 µs
+    for XLA's cholesky at n=8192), then the fp64
+    f32-panel+Newton+Ozaki path, with XLA's fused cholesky as the
+    stock fallback.  Off-TPU (CPU mesh tests, complex) Auto resolves
+    to "xla" with zero timing; "recursive" keeps the explicit nb
+    recursion.  Split out of :func:`potrf` so the ABFT layer can see
+    WHICH branch ships (kernel-owned branches take the checksum
+    envelope, stock ones the checksum-carried loop)."""
+    from ..method import select_backend
+
+    if method != "auto":
+        return "recursive"
     step_depth = None
-    if method == "auto" and full.ndim == 2 \
-            and jnp.issubdtype(full.dtype, jnp.floating):
+    if full.ndim == 2 and jnp.issubdtype(full.dtype, jnp.floating):
         step_depth = select_backend(
             "potrf_step", n=int(full.shape[-1]), nb=nbsel,
             dtype=full.dtype,
@@ -82,17 +107,28 @@ def potrf(a, opts: Optional[Options] = None):
                 int(full.shape[-1]), nbsel, full.dtype),
             eligible_full=blocks.use_full_potrf(
                 int(full.shape[-1]), nbsel, full.dtype))
-    if step_depth == "full":
-        l = blocks.potrf_full(full, nbsel)
-    elif step_depth == "fused":
-        l = blocks.potrf_steps(full, nbsel)
-    elif method == "auto" and full.dtype == jnp.float32 and full.ndim == 2 \
+    if step_depth in ("full", "fused"):
+        return step_depth
+    if full.dtype == jnp.float32 and full.ndim == 2 \
             and select_backend("potrf_panel", n=int(full.shape[-1]),
                                nb=nbsel, dtype=full.dtype) == "pallas":
-        l = blocks.potrf_panels(full, nbsel)
-    elif method == "auto" and full.dtype == jnp.float64 and full.ndim == 2 \
+        return "pallas"
+    if full.dtype == jnp.float64 and full.ndim == 2 \
             and select_backend("potrf_panel_f64", n=int(full.shape[-1]),
                                nb=nbsel) == "ozaki_newton":
+        return "ozaki"
+    return "xla"
+
+
+def _potrf_dispatch(branch: str, full, nb: int, nbsel: int):
+    """Run one resolved potrf branch (see :func:`_potrf_branch`)."""
+    if branch == "full":
+        return blocks.potrf_full(full, nbsel)
+    if branch == "fused":
+        return blocks.potrf_steps(full, nbsel)
+    if branch == "pallas":
+        return blocks.potrf_panels(full, nbsel)
+    if branch == "ozaki":
         # fp64 on TPU: f32 Pallas panel + fp64 Newton refinement, Ozaki
         # MXU trailing updates — replaces XLA's emulated-fp64 cholesky.
         # A panel whose f32 seed breaks down (SPD but cond ≳ 1/ε₃₂)
@@ -101,22 +137,15 @@ def potrf(a, opts: Optional[Options] = None):
         # non-SPD input stays NaN there too — the info signal).
         from jax import lax as _lax
         fast = blocks.potrf_panels_f64(full, nbsel)
-        l = _lax.cond(
+        return _lax.cond(
             jnp.all(jnp.isfinite(fast)),
             lambda ops: ops[0],
             lambda ops: jnp.tril(_lax.linalg.cholesky(ops[1])),
             (fast, full))
-    elif method == "auto":
-        import jax.numpy as _jnp
-        from jax import lax as _lax
-        l = _jnp.tril(_lax.linalg.cholesky(full))
-    else:
-        l = blocks.potrf_rec(full, nb)
-    fac = l if uplo is Uplo.Lower else jnp.conj(l.T)
-    out = TriangularMatrix(fac, uplo=uplo, diag=Diag.NonUnit,
-                           mb=getattr(a, "mb", nb), nb=nb,
-                           grid=getattr(a, "grid", None))
-    return out
+    if branch == "recursive":
+        return blocks.potrf_rec(full, nb)
+    from jax import lax as _lax
+    return jnp.tril(_lax.linalg.cholesky(full))
 
 
 @instrument_driver("potrs")

@@ -830,13 +830,37 @@ def _getrf_partial(av, nb: int, raw_method=MethodLU.Auto):
     where the autotune table picks it (``lu_driver`` site), else the
     tall-panel loop or the blocked recursion.  Shared by
     :func:`getrf` and the bench harness so the measured path IS the
-    shipped path."""
+    shipped path.
 
+    With ``SLATE_TPU_ABFT`` on (ISSUE 14) eager square calls route
+    through the checksum-carried ABFT layer
+    (:mod:`slate_tpu.resilience.abft`): the composed rung runs the
+    Huang–Abraham step loop (checksum block-row/column riding each
+    step's trailing gemm, per-step verify/correct/recompute), the
+    scattered/fused/full Pallas rungs run under the checksum envelope.
+    Off (default) this is one env read — same path, bit-identical
+    lowering."""
+    from ..resilience import abft as _abft
+
+    if _abft.eligible(av):
+        return _abft.getrf_guarded(av, nb, raw_method)
+    return _getrf_partial_impl(av, nb, raw_method)
+
+
+def _choose_lu_driver(av) -> str:
+    """The ``lu_driver`` site decision for one operand — ONE derivation
+    shared by the shipped dispatch and the ABFT layer (which must
+    predict the same branch it wraps; a second hand-rolled eligibility
+    check here would drift)."""
     from ..method import select_backend
     m, n = (av.shape[0], av.shape[1]) if av.ndim == 2 else (0, 0)
-    driver = select_backend(
+    return select_backend(
         "lu_driver", m=m, n=n, nb=_SCATTERED_NB, dtype=av.dtype,
         eligible=_use_scattered(av, _SCATTERED_NB))
+
+
+def _getrf_partial_impl(av, nb: int, raw_method=MethodLU.Auto):
+    driver = _choose_lu_driver(av)
     if driver == "scattered":
         # TPU f32 fast path: scattered-row partial pivoting (no swap
         # traffic, one fused Pallas panel invocation per step) — LAPACK

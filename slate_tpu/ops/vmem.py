@@ -27,7 +27,7 @@ import os
 
 __all__ = ["BUDGET_BYTES", "PALLAS_CALL_LIMIT_BYTES", "budget_bytes",
            "pallas_call_limit_bytes", "fits", "batch_per_launch",
-           "largest_tc"]
+           "checksum_block_rows", "largest_tc"]
 
 #: default ``vmem_limit_bytes`` the fused kernels pin in their
 #: pallas_call compiler params (what Mosaic is allowed to allocate).
@@ -78,6 +78,25 @@ def largest_tc(nb: int, bytes_at, floor: int = 128) -> int:
     while tc // 2 >= floor and not fits(bytes_at(tc)):
         tc //= 2
     return tc
+
+
+#: sublane tile edge per element width — the row granularity TPU
+#: operand slabs tile at (8 f32 rows, 4 f64 rows per sublane tile).
+_SUBLANE_ROWS = {4: 8, 8: 4}
+
+
+def checksum_block_rows(dtype) -> int:
+    """Height of the ABFT checksum block-row
+    (:mod:`slate_tpu.resilience.abft`): ONE checksum lane padded up to
+    the dtype's sublane tile edge, so a checksum-augmented operand
+    ``[A; eᵀA]`` keeps the row-divisibility every tile-shaped gate and
+    kernel in this package assumes (the pad lanes ride the trailing
+    gemm as exact zeros).  The same constant is what the attribution
+    model prices the checksum traffic with
+    (``slate_tpu/perf/attr.py``)."""
+    import numpy as np
+
+    return _SUBLANE_ROWS.get(np.dtype(dtype).itemsize, 8)
 
 
 def batch_per_launch(per_problem_bytes: float, fixed_bytes: float = 0.0,

@@ -203,7 +203,36 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
                                           m=a.mtp * a.nb),
                        dist_lookahead_depth("potrf", nt, a.nb, a.dtype),
                        dist_chunk_slices("potrf", a.nb, a.dtype, a.mesh))
-    return like(a, fn(a.data))
+    return like(a, _ppotrf_abft_check(a, fn))
+
+
+def _ppotrf_abft_check(a: DistMatrix, fn):
+    """ABFT envelope for the distributed Cholesky (ISSUE 14): with
+    ``SLATE_TPU_ABFT`` on, verify ``(eᵀL)·Lᴴ = eᵀA`` over the padded
+    natural-order operands after the run and recompute once on a
+    detection; off (default) this is one env read around the build's
+    single invocation."""
+    from ..resilience import abft as _abft
+
+    out = fn(a.data)
+    if not _abft.enabled():
+        return out
+    import numpy as np
+
+    from .dist_lu import _natural_padded
+
+    # reference checksums off the hermitized STORED triangle — the
+    # upper triangle of a ppotrf operand may be junk by contract
+    a_nat = _natural_padded(a)
+    a_ref = np.tril(a_nat) + np.conj(np.tril(a_nat, -1)).T
+    cs_row0 = a_ref.sum(axis=0)
+
+    def verify(o):
+        return _abft.verify_chol_factors(
+            cs_row0, np.tril(_natural_padded(a, o)))
+
+    return _abft._envelope("ppotrf", lambda: fn(a.data),
+                           lambda o: o, verify, out=out)
 
 
 @lru_cache(maxsize=None)

@@ -45,6 +45,7 @@ Pivots are tracked as a replicated global permutation ``gperm`` with
 from __future__ import annotations
 
 from functools import lru_cache
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -208,14 +209,26 @@ def _maxloc_lu_panel(a, vma=()):
     return a, piv, pos
 
 
+def _range_bounds(bounds, lo: int, hi: int):
+    """Clip the staged-window bounds to a step sub-range [lo, hi): the
+    chunked (checkpointed) runner re-uses the SAME stage boundaries the
+    monolithic driver jits, so cadence-aligned chunks execute the
+    identical (step, window) sequence — the bitwise-resume contract."""
+    inner = [b for b in bounds if lo < b < hi]
+    return [lo] + inner + [hi]
+
+
 @lru_cache(maxsize=None)
 def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                   panel_backend: str = "xla", pivot: str = "maxloc",
-                  depth: int = 1, chunks: int = 1):
+                  depth: int = 1, chunks: int = 1, k_lo: int = 0,
+                  k_hi: Optional[int] = None, carry_in: bool = False,
+                  carry_out: bool = False):
     p, q = mesh_grid_shape(mesh)
     mtp = p * ml
     M = mtp * nb
-    bounds = stage_bounds(nt)
+    k_hi = nt if k_hi is None else int(k_hi)
+    bounds = _range_bounds(stage_bounds(nt), int(k_lo), k_hi)
     depth = max(1, min(int(depth), max(1, nt)))
 
     def _u12_solve(l11, rowblk):
@@ -261,7 +274,7 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 unit_diagonal=True),
             operand=None)
 
-    def kernel(a_loc):
+    def kernel_core(a_loc, gperm_c, ring_c):
         r = lax.axis_index(AXIS_P)
         c = lax.axis_index(AXIS_Q)
         dt = a_loc.dtype
@@ -430,22 +443,47 @@ def _build_pgetrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
 
             return body
 
-        gperm0 = jnp.arange(M, dtype=jnp.int32)
-        # the loop body derives gperm from cross-mesh data, making it
-        # device-varying in shard_map's type system; match the carry type
-        gperm0 = pvary(gperm0, (AXIS_P, AXIS_Q))
-        ring0 = tuple(
-            bcast_block_col(getcol(a_loc, j), grows, j % q == c, M,
-                            chunks=chunks) for j in range(depth))
+        if gperm_c is not None:
+            # resumed chunk: the carry (permutation + in-flight panel
+            # ring) arrives replicated from the previous chunk's
+            # outputs / the restored checkpoint
+            gperm0 = pvary(gperm_c, (AXIS_P, AXIS_Q))
+            ring0 = tuple(pvary(rj, (AXIS_P, AXIS_Q)) for rj in ring_c)
+        else:
+            gperm0 = jnp.arange(M, dtype=jnp.int32)
+            # the loop body derives gperm from cross-mesh data, making
+            # it device-varying in shard_map's type system; match the
+            # carry type
+            gperm0 = pvary(gperm0, (AXIS_P, AXIS_Q))
+            ring0 = tuple(
+                bcast_block_col(getcol(a_loc, k_lo + j), grows,
+                                (k_lo + j) % q == c, M, chunks=chunks)
+                for j in range(depth))
         carry = (a_loc, gperm0, ring0)
-        a_loc, gperm, _ = staged_fori(bounds, p, q, nb, make_body, carry)
+        a_loc, gperm, ring = staged_fori(bounds, p, q, nb, make_body,
+                                         carry)
         # every device holds the same permutation; pmax makes that
         # replication visible to the type system for the P() out-spec
         gperm = lax.pmax(lax.pmax(gperm, AXIS_P), AXIS_Q)
+        if carry_out:
+            ring = tuple(lax.pmax(lax.pmax(rj, AXIS_P), AXIS_Q)
+                         for rj in ring)
+            return (a_loc, gperm) + ring
         return a_loc, gperm
 
-    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
-                   out_specs=(P(AXIS_P, AXIS_Q), P()))
+    if carry_in:
+        def kernel(a_loc, gperm_c, *ring_c):
+            return kernel_core(a_loc, gperm_c, ring_c)
+        in_specs = (P(AXIS_P, AXIS_Q), P()) + (P(),) * depth
+    else:
+        def kernel(a_loc):
+            return kernel_core(a_loc, None, None)
+        in_specs = (P(AXIS_P, AXIS_Q),)
+    out_specs = (P(AXIS_P, AXIS_Q), P())
+    if carry_out:
+        out_specs = out_specs + (P(),) * depth
+    fn = shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
     return jax.jit(fn)
 
 
@@ -473,14 +511,81 @@ def pgetrf(a: DistMatrix):
     # lru_cached shard_map build so the decisions are part of the build
     # key (a forced knob change reaches a fresh build, never a stale
     # cache entry)
-    fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
-                       dist_panel_backend("getrf", a.nb, a.dtype,
-                                          w=nl * a.nb),
-                       dist_pivot_backend(a.nb, p, a.dtype),
-                       dist_lookahead_depth("getrf", nt, a.nb, a.dtype),
-                       dist_chunk_slices("getrf", a.nb, a.dtype, a.mesh))
-    lu_data, gperm = fn(a.data)
+    knobs = (dist_panel_backend("getrf", a.nb, a.dtype, w=nl * a.nb),
+             dist_pivot_backend(a.nb, p, a.dtype),
+             dist_lookahead_depth("getrf", nt, a.nb, a.dtype),
+             dist_chunk_slices("getrf", a.nb, a.dtype, a.mesh))
+    from ..resilience import checkpoint as _ckpt
+
+    every = _ckpt.every_steps()
+    if 0 < every < nt:
+        # step-cadence checkpoint/restart (ISSUE 14): run the SAME
+        # staged step bodies in every-step chunks, snapshotting the
+        # carry (local trailing window + pivot vector + lookahead
+        # panel ring) at each boundary — an injected device_loss (or a
+        # real transient failure) rewinds one chunk instead of the run
+        def run_chunk(carry, k0, k1):
+            if carry is None:
+                fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl,
+                                   str(a.dtype), *knobs, 0, k1,
+                                   False, True)
+                return fn(a.data)
+            fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                               *knobs, k0, k1, True, True)
+            return fn(carry[0], carry[1], *carry[2:])
+
+        out = _ckpt.run_checkpointed(nt, every, run_chunk,
+                                     label="pgetrf")
+        lu_data, gperm = out[0], out[1]
+    else:
+        fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                           *knobs)
+        lu_data, gperm = fn(a.data)
+    lu_data, gperm = _pgetrf_abft_check(a, lu_data, gperm, knobs, nt,
+                                        ml, nl)
     return like(a, lu_data), gperm
+
+
+def _natural_padded(dm: DistMatrix, data=None):
+    """Host copy of a distributed operand in NATURAL (unshuffled) order
+    at the full padded extent — the layout the ABFT factor-identity
+    sweeps run in (the factorization factors the whole padded matrix,
+    so trimming first would verify the wrong identity)."""
+    from .dist_util import _unshuffle
+
+    p, q = dm.grid_shape
+    return np.asarray(_unshuffle(dm.data if data is None else data,
+                                 dm.mtp, dm.ntp, dm.nb, p, q))
+
+
+def _pgetrf_abft_check(a: DistMatrix, lu_data, gperm, knobs, nt: int,
+                       ml: int, nl: int):
+    """ABFT envelope for the distributed LU (ISSUE 14): with
+    ``SLATE_TPU_ABFT`` on, verify the factor checksum identities
+    ``(eᵀL)·U = eᵀA`` / ``L·(U·e) = (A·e)[gperm]`` — two O(M²) sweeps
+    over operands the panel broadcasts already replicated — and on a
+    detection recompute the factorization once (``abft.recomputed``);
+    a second failure flows to the caller's residual gates
+    (``abft.unrecovered``).  Off (default): one env read."""
+    from ..resilience import abft as _abft
+
+    if not _abft.enabled():
+        return lu_data, gperm
+    a_nat = _natural_padded(a)
+    cs_row0, cs_col0 = a_nat.sum(axis=0), a_nat.sum(axis=1)
+
+    def run():
+        fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                           *knobs)
+        return fn(a.data)
+
+    def verify(out):
+        return _abft.verify_lu_factors(
+            cs_row0, cs_col0, _natural_padded(a, out[0]),
+            np.asarray(out[1]))
+
+    return _abft._envelope("pgetrf", run, lambda out: out, verify,
+                           out=(lu_data, gperm))
 
 
 @lru_cache(maxsize=None)

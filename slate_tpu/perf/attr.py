@@ -217,6 +217,55 @@ def _acc(stages, name, f, b):
 _RT_PER_STEP_GETRF = {"composed": 3.0, "fused_trsm": 1.0, "fused": 0.0,
                       "full": 0.0}
 
+#: ABFT checksum block-row height per element width (ISSUE 14) — one
+#: checksum lane sublane-padded, matching
+#: ``slate_tpu.ops.vmem.checksum_block_rows`` (kept as a literal here:
+#: this module must stay stdlib-only).
+_CHECKSUM_ROWS = {4: 8, 8: 4}
+
+_ABFT_ENV = "SLATE_TPU_ABFT"
+
+
+def _abft_wanted(abft) -> bool:
+    """Resolve the ``abft`` model flag: an explicit bool wins; None
+    reads ``SLATE_TPU_ABFT`` (so the offline sweep's candidate pricing
+    and the autotune ladder see the checksum overhead automatically
+    whenever the process runs with ABFT on, without plumbing a flag
+    through every call site)."""
+    if abft is not None:
+        return bool(abft)
+    raw = os.environ.get(_ABFT_ENV, "").strip().lower()
+    return raw in ("correct", "verify", "1", "on", "true", "yes")
+
+
+def _abft_stages(raw, routine: str, m, n, nb, isz):
+    """Price the checksum carriage + per-step verify into the stage
+    map: the checksum block-row/column ride the trailing update's gemm
+    (extra rank-``cb`` rows/cols through the same contraction) and each
+    step's verify reads the live trailing block once for its two sum
+    sweeps.  Mutates ``raw`` in place — runs BEFORE the normalization
+    that reconciles stage flops with the driver's model count."""
+    cb = _CHECKSUM_ROWS.get(isz, 8)
+    k = min(m, n)
+    for k0 in range(0, k, nb):
+        w = min(nb, k - k0)
+        rows = m - k0
+        r = n - k0 - w
+        if r <= 0:
+            continue
+        if routine in ("getrf", "gesv"):
+            # checksum row rides as cb extra L21 rows, checksum column
+            # as cb extra U12 columns — both through the ONE step gemm
+            _acc(raw, "update", 2.0 * cb * w * (r + rows),
+                 cb * (r + rows) * isz)
+            trail = (rows - w) * r
+        else:                              # potrf / posv
+            _acc(raw, "update", 2.0 * cb * w * r, cb * r * isz)
+            trail = float(r) * r
+        # per-step verify: one read of the trailing block + two sum
+        # sweeps (HBM-bound — the dominant ABFT cost at large n)
+        _acc(raw, "verify", 2.0 * trail, trail * isz)
+
 
 def _stages_getrf(m, n, nb, isz, fusion):
     stages, rts = {}, 0.0
@@ -295,19 +344,23 @@ def _stages_twostage(n, isz, total):
 
 
 #: stage order for reports (model dicts are unordered)
-_STAGE_ORDER = ("panel", "pivot", "trsm", "update", "solve",
+_STAGE_ORDER = ("panel", "pivot", "trsm", "update", "verify", "solve",
                 "stage1", "chase", "stage3", "mxu", "collective")
 
 
 def stage_model(routine: str, dims: dict, dtype: str = "fp32",
-                fusion: str = "composed"):
+                fusion: str = "composed", abft=None):
     """``(stages, hbm_roundtrips)`` for one routine invocation, or None
     when no model exists.  ``stages`` is ``[{"stage", "flops",
     "bytes"}]`` in pipeline order with the flops NORMALIZED so they sum
     exactly to :func:`model_flops` (the self-reconciliation contract);
     ``hbm_roundtrips`` is the materialized inter-stage intermediate
     count the composed drivers record on ``step.hbm_roundtrips`` (0 on
-    the fused paths — the CI pin)."""
+    the fused paths — the CI pin).  ``abft`` (ISSUE 14; None = read
+    ``SLATE_TPU_ABFT``) prices the checksum block-row carriage and the
+    per-step verify sweep into the factorization families, so abft-on
+    reports still reconcile and :func:`predict_seconds` sees the
+    overhead."""
     total = model_flops(routine, dims)
     if total is None or total <= 0:
         return None
@@ -338,6 +391,9 @@ def stage_model(routine: str, dims: dict, dtype: str = "fp32",
         raw, rts = _stages_twostage(n, isz, total / bfac)
     else:
         return None
+    if _abft_wanted(abft) and bfac == 1 \
+            and routine in ("getrf", "gesv", "potrf", "posv"):
+        _abft_stages(raw, routine, m, n, nb, isz)
     if bfac > 1:
         # leading batch dim: per-problem stage bytes and round trips
         # scale with the batch; flops ride the normalization below
@@ -363,7 +419,7 @@ _DEF_LAUNCH_S = {"tpu": 5e-6, "cpu": 2e-5}
 
 def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
                     fusion: str = "composed", platform: str = "tpu",
-                    launch_s=None):
+                    launch_s=None, abft=None):
     """Model-predicted wall seconds for ONE invocation at the given
     fusion depth: the per-stage roofline minima (:func:`stage_model` on
     :func:`peaks`) plus a launch-latency + panel-strip-traffic term per
@@ -372,8 +428,12 @@ def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
     runs, and the analytical guard its interpolating decision model
     cross-checks selections against — so it must stay loadable
     stdlib-only, like everything else in this module.  None when the
-    routine has no stage model."""
-    model = stage_model(routine, dims, dtype, fusion)
+    routine has no stage model.  ``abft`` (None = read
+    ``SLATE_TPU_ABFT``) includes the checksum-carriage and verify
+    pricing, so depth rankings under ABFT stay honest — a depth whose
+    verify is whole-run (fused/full envelope) and one that verifies
+    per step are priced with the same sweep term."""
+    model = stage_model(routine, dims, dtype, fusion, abft=abft)
     if model is None:
         return None
     stages, rts = model

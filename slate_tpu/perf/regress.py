@@ -69,12 +69,20 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = [
-    "Artifact", "Report", "Row", "load_artifact", "diff", "direction",
-    "explain", "format_table", "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
+    "ABFT_OVERHEAD_CEILING_PCT", "Artifact", "Report", "Row",
+    "load_artifact", "diff", "direction", "explain", "format_table",
+    "frac_of_gemm", "DEFAULT_THRESHOLD_PCT",
 ]
 
 #: flag a drop bigger than this (percent) between consecutive artifacts
 DEFAULT_THRESHOLD_PCT = 5.0
+
+#: pinned ceiling for the ``*_abft_overhead_pct`` family (ISSUE 14):
+#: checksum carriage + per-step verify must stay within this share of
+#: the abft-off wall; a newest value above it is a REGRESS even on the
+#: first artifact carrying the submetric (like the multichip
+#: efficiency floor).
+ABFT_OVERHEAD_CEILING_PCT = 10.0
 
 _LABEL_RE = re.compile(
     r"^(?P<routine>[a-z0-9]+?)(?P<batched>_batched)?_"
@@ -123,10 +131,14 @@ def direction(label: str) -> float:
     so the rule survives a refactor of the wall-second suffix) and the
     structural ``*_hbm_roundtrips`` counts (ISSUE 12: materialized
     inter-stage intermediates per factorization — 0 on the full-fused
-    depth, and a rise is a structural regression)."""
+    depth, and a rise is a structural regression) and the
+    ``*_abft_overhead_pct`` family (ISSUE 14: abft-on vs abft-off wall
+    overhead in percent — lower is better, with the
+    :data:`ABFT_OVERHEAD_CEILING_PCT` ceiling pinned even
+    single-artifact)."""
     if label.endswith("_per_s"):
         return 1.0
-    if label.endswith(("_ms", "_hbm_roundtrips")):
+    if label.endswith(("_ms", "_hbm_roundtrips", "_abft_overhead_pct")):
         return -1.0
     return -1.0 if label.endswith("_s") else 1.0
 
@@ -342,6 +354,11 @@ class Report:
 def _num(v, label: str = "") -> Optional[float]:
     if not isinstance(v, (int, float)):
         return None
+    if label.endswith("_abft_overhead_pct"):
+        # overhead percentages legitimately sit at (or noisily below)
+        # zero — every finite value is a measurement the ceiling
+        # sentinel must see
+        return float(v)
     if label.endswith(("_hbm_roundtrips", "_over_floor")):
         # structural counts (steady state 0) and floor-sentinel ratios
         # (a total efficiency collapse IS 0): zero is a measured value
@@ -355,13 +372,20 @@ def _floor_override(label: str, vals, verdict: str, note: str):
     """``*_over_floor`` sentinel rows (the multichip curve's pinned
     per-device-efficiency floor): a newest value below 1.0 is a REGRESS
     regardless of history — the floor gates CI even on the first
-    artifact that carries the curve."""
-    if not label.endswith("_over_floor"):
-        return verdict, note
+    artifact that carries the curve.  The ``*_abft_overhead_pct``
+    family gets the mirror-image CEILING pin: a newest overhead above
+    :data:`ABFT_OVERHEAD_CEILING_PCT` is a REGRESS single-artifact
+    (checksum protection that costs more than 10% of the run is a
+    broken integration, not a tuning choice)."""
     last = next((v for v in reversed(vals) if v is not None), None)
-    if last is not None and last < 1.0:
-        return "REGRESS", ((note + "; ") if note else "") \
-            + "below pinned floor"
+    if label.endswith("_over_floor"):
+        if last is not None and last < 1.0:
+            return "REGRESS", ((note + "; ") if note else "") \
+                + "below pinned floor"
+    elif label.endswith("_abft_overhead_pct"):
+        if last is not None and last > ABFT_OVERHEAD_CEILING_PCT:
+            return "REGRESS", ((note + "; ") if note else "") \
+                + "above pinned %.0f%% ceiling" % ABFT_OVERHEAD_CEILING_PCT
     return verdict, note
 
 
@@ -403,13 +427,20 @@ def diff(artifacts: List[Artifact],
         best_gain = 0.0
         # "_s"-suffixed labels are wall SECONDS (lower is better, the
         # sign flips) — EXCEPT the "*_per_s" throughput rates, which
-        # are higher-is-better like GFLOP/s (see :func:`direction`)
+        # are higher-is-better like GFLOP/s (see :func:`direction`).
+        # The *_abft_overhead_pct family is judged by its PINNED
+        # ceiling only (the _floor_override below): it is a noisy
+        # near-zero percentage where a 2.0 -> 2.2 move is a "-10%"
+        # ratio regression in name only — the consecutive-ratio rule
+        # would make the sentinel flaky exactly where the ceiling is
+        # the meaningful gate.
+        ratio_judged = not label.endswith("_abft_overhead_pct")
         sign = direction(label)
         prev = None
         for v in vals:
             if v is None:
                 continue
-            if prev is not None and prev > 0:
+            if ratio_judged and prev is not None and prev > 0:
                 change = sign * (v / prev - 1.0) * 100.0
                 worst_drop = min(worst_drop, change)
                 best_gain = max(best_gain, change)

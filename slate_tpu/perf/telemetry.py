@@ -298,6 +298,24 @@ def observe_dispatch_error(op: str, bucket: str,
                        dtype=dtype, n=n)
 
 
+def observe_abft(driver: str, rung: str, detail: str = "") -> None:
+    """One ABFT recovery-ladder escalation (ISSUE 14): counts
+    ``telemetry.abft.<rung>``, appends an ``abft`` JSONL record, and —
+    for the rungs that mean repeated hardware trouble (``recomputed``
+    / ``restarted`` / ``unrecovered``) — feeds the live sentinel's
+    error window under the synthetic ``abft`` bucket, so a burst of
+    silent-corruption recoveries on one driver classifies as an infra
+    degradation exactly like a dispatch-error burst would.  One
+    attribute read when telemetry is off."""
+    if not _state.enabled:
+        return
+    metrics.inc("telemetry.abft.%s" % rung)
+    log_record("abft", driver=str(driver), rung=str(rung),
+               detail=str(detail)[:200])
+    if rung in ("recomputed", "restarted", "unrecovered"):
+        sentinel().observe(str(driver), "abft", 0.0, error=True, batch=1)
+
+
 # ---------------------------------------------------------------------------
 # Prometheus text exposition
 # ---------------------------------------------------------------------------

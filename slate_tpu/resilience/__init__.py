@@ -25,6 +25,17 @@ device behavior instead of assuming every launch succeeds:
   retry-with-exponential-backoff (transient infra errors: TPU init
   RPCs, injected faults) used by bench startup, the multichip dryrun
   and the serve dispatch loop.
+* :mod:`~slate_tpu.resilience.abft` — algorithm-based fault tolerance
+  (ISSUE 14, ``SLATE_TPU_ABFT``): Huang–Abraham checksum blocks the
+  factorizations' own trailing updates maintain, with a per-step
+  verify → correct-in-place → recompute-step → restart-from-checkpoint
+  → stock-retry recovery ladder.  Lazy-loaded by the drivers — never
+  imported (and its knobs never consulted) at package import.
+* :mod:`~slate_tpu.resilience.checkpoint` — step-cadence device
+  snapshots (``SLATE_TPU_CKPT_EVERY_STEPS``) of the factorization
+  carry (trailing window + pivot vector + lookahead ring) so an
+  injected ``device_loss`` mid-``pgetrf`` resumes from the last
+  checkpoint and reproduces the uninterrupted factors bitwise.
 
 Everything emits ``resilience.*`` counters through the metrics registry
 (:mod:`slate_tpu.perf.metrics`) so every degradation is observable in

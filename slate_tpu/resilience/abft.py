@@ -1,0 +1,800 @@
+"""Algorithm-based fault tolerance: checksum-carried factorizations
+(Huang–Abraham) with a detect → correct → recompute → restart ladder.
+
+A silent bitflip in one trailing-update element poisons every later
+step of a factorization, and the PR 9/10 resilience stack only guards
+the host seams (dispatch, probes, driver outputs) — it can re-run a
+whole driver on the stock backend but cannot *see* in-flight numerical
+corruption, let alone fix it cheaply.  ABFT can: augment the operand
+with checksum blocks the factorization's own trailing updates maintain
+for free, and every step's state becomes self-verifying.
+
+**The invariant.**  For LU, carry one checksum block-row and one
+checksum block-column: ``W = [A, A·e; eᵀA, eᵀAe]``.  Factoring the
+real rows right-looking and letting the checksum row ride the trailing
+gemm as one extra L₂₁ row (its multipliers are ``cs·U₁₁⁻¹``) and the
+checksum column ride as one extra U₁₂ column keeps, after EVERY step,
+
+* checksum row  == column sums of the live trailing Schur complement,
+* checksum col  == row sums of the live trailing Schur complement,
+
+exactly in exact arithmetic and to roundoff in floats.  Cholesky needs
+only the block-row (the trailing matrix is symmetric, so row syndromes
+come from the symmetry residual).  The maintenance IS the trailing
+gemm — the augmented operand adds one block-row/column to the same
+``matmul``, no second pass over the data.
+
+**Per-step verify → the recovery ladder.**  After each trailing
+update, compare the checksums against fresh sums:
+
+1. **verify** — syndromes under tolerance: continue (``abft.checks``);
+2. **correct** — exactly one row syndrome entry ``j`` and one column
+   syndrome entry ``i`` fire and they agree in magnitude: a single
+   corrupted element, corrected IN PLACE at ``(i, j)`` by the syndrome
+   value (``abft.detected`` + ``abft.corrected``);
+3. **recompute** — anything else (multi-element, or the correction's
+   re-verify fails): restore the step's entry state and re-run ONLY
+   the poisoned step (``abft.recomputed``);
+4. **restart** — an injected ``device_loss`` at a step boundary
+   rewinds to the last ``SLATE_TPU_CKPT_EVERY_STEPS`` snapshot
+   (:mod:`~slate_tpu.resilience.checkpoint`, ``abft.restarted``);
+5. **stock retry** — a still-dirty result flows out and the existing
+   PR 9 health gate (``SLATE_TPU_HEALTH=retry``) re-runs the driver on
+   the stock backend (the final, most expensive rung).
+
+Every escalation is counted and fed to the PR 10 live sentinel
+(:func:`slate_tpu.perf.telemetry.observe_abft`).
+
+**Depth-ladder wiring.**  The checksum-carried step loops here
+(:func:`getrf_abft` / :func:`potrf_abft`) cover the composed depth —
+their panels still resolve through the autotuned panel sites, and the
+checksum blocks ride the step's one trailing ``matmul``.  The fused /
+full Pallas rungs own their whole step (or the whole factorization)
+inside one kernel whose active-row masking cannot admit foreign
+checksum rows, so there ABFT wraps the rung in a checksum ENVELOPE:
+reference checksums of the input are taken up front, the factor
+identity syndromes (``(eᵀL)U − eᵀA`` and ``L(Ue) − (PA)e``) are
+verified after the run, and a detection recomputes the poisoned
+invocation — which for the ``full`` rung is exactly "recompute the
+poisoned step", the step being the whole kernel.  The distributed
+drivers (``pgetrf`` / ``ppotrf``) verify the same factor identities on
+their block-cyclic global arrays (the checksum operands replicate
+through the panel broadcasts the lookahead rings already pay for —
+zero extra collectives) and recompute on detection.
+
+**Knobs.**  ``SLATE_TPU_ABFT = off | verify | correct`` (default off —
+with it unset nothing here is consulted and compiled programs are
+bit-identical, pinned in CI).  ``verify`` detects and counts only;
+``correct`` (= ``1``/``on``) runs the full ladder.
+``SLATE_TPU_ABFT_TOL`` scales the syndrome tolerance (default 1.0).
+The ABFT layers are host-side and eager-only: under a jit trace the
+drivers skip them entirely, exactly like the health gates.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import warnings
+from typing import Callable
+
+from ..perf import metrics
+
+__all__ = [
+    "ENV_ABFT", "ENV_TOL", "augment_lu", "checksums", "classify",
+    "correct_single", "enabled", "getrf_abft", "getrf_guarded", "mode",
+    "potrf_abft", "potrf_guarded", "syndromes", "tol_scale",
+    "verify_chol_factors", "verify_lu_factors",
+]
+
+ENV_ABFT = "SLATE_TPU_ABFT"
+ENV_TOL = "SLATE_TPU_ABFT_TOL"
+
+MODES = ("off", "verify", "correct")
+
+#: relative syndrome tolerance factor: syndromes are judged against
+#: ``_RTOL_FACTOR · eps · sqrt(n) · (|checksum| + |fresh sum| + scale)``
+#: — the accumulated roundoff of n-term sums maintained through ~n/nb
+#: rank-nb updates, with generous headroom (an exponent-bit flip of an
+#: O(1) element sits orders of magnitude above it).
+_RTOL_FACTOR = 64.0
+
+
+def mode() -> str:
+    """The effective ABFT tier (``SLATE_TPU_ABFT``): ``off`` (default),
+    ``verify`` (detect + count only) or ``correct`` (full ladder;
+    ``1``/``on``/``true`` alias it)."""
+    raw = os.environ.get(ENV_ABFT, "").strip().lower()
+    if raw in ("correct", "1", "on", "true", "yes"):
+        return "correct"
+    if raw == "verify":
+        return "verify"
+    return "off"
+
+
+def enabled() -> bool:
+    return mode() != "off"
+
+
+def tol_scale() -> float:
+    """The ``SLATE_TPU_ABFT_TOL`` tolerance multiplier (default 1.0)."""
+    try:
+        return float(os.environ.get(ENV_TOL, "").strip() or 1.0)
+    except ValueError:
+        return 1.0
+
+
+def _escalate(driver: str, rung: str, detail: str = "") -> None:
+    """Count one recovery-ladder rung and feed it to the live sentinel
+    (best-effort — observability must never break a recovery)."""
+    metrics.inc("abft." + rung)
+    try:
+        from ..perf import telemetry
+
+        telemetry.observe_abft(driver, rung, detail)
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Checksum arithmetic (pure, numpy-level — the unit-testable core)
+# ---------------------------------------------------------------------------
+
+def checksums(a):
+    """``(column sums, row sums)`` of a 2-D array — the Huang–Abraham
+    reference checksums ``(eᵀA, A·e)``."""
+    import numpy as np
+
+    a = np.asarray(a)
+    return a.sum(axis=0), a.sum(axis=1)
+
+
+def syndromes(s, cs_row, cs_col):
+    """``(row_syn, col_syn)`` of a trailing block against its carried
+    checksums: ``row_syn[j] = cs_row[j] − Σᵢ S[i,j]`` and
+    ``col_syn[i] = cs_col[i] − Σⱼ S[i,j]``.  A single corruption
+    ``S[i,j] += δ`` shows as ``row_syn[j] = col_syn[i] = −δ``."""
+    import numpy as np
+
+    s = np.asarray(s)
+    return (np.asarray(cs_row) - s.sum(axis=0),
+            np.asarray(cs_col) - s.sum(axis=1))
+
+
+def _thresholds(syn, cs, sums, n: int, dtype, scale: float):
+    import numpy as np
+
+    eps = float(np.finfo(dtype).eps)
+    rtol = _RTOL_FACTOR * tol_scale() * eps * math.sqrt(max(float(n), 16.0))
+    return rtol * (np.abs(cs) + np.abs(sums) + scale)
+
+
+def classify(s, cs_row, cs_col, dtype=None, scale=None):
+    """Judge one trailing block against its checksums.  Returns
+    ``(kind, i, j, delta)`` with kind ``"clean"`` (all syndromes under
+    tolerance), ``"single"`` (exactly one row and one column syndrome
+    fire and agree — ``delta`` is the correction to ADD at ``(i, j)``),
+    ``"nonfinite"`` (the block itself carries NaN/Inf — the documented
+    info-signal / operand-poison domain of the health gates, NOT
+    silent corruption: a non-SPD potrf input propagating NaN must flow
+    out as its info signal, never trigger a recompute storm) or
+    ``"multi"`` (anything else — recompute territory)."""
+    import numpy as np
+
+    s = np.asarray(s)
+    if s.size == 0:
+        return "clean", -1, -1, 0.0
+    if not np.isfinite(s).all():
+        return "nonfinite", -1, -1, 0.0
+    if dtype is None:
+        dtype = s.dtype
+    if scale is None:
+        scale = max(1.0, float(np.max(np.abs(s))))
+    n = max(s.shape)
+    row_syn, col_syn = syndromes(s, cs_row, cs_col)
+    thr_r = _thresholds(row_syn, np.asarray(cs_row), s.sum(axis=0), n,
+                        dtype, scale)
+    thr_c = _thresholds(col_syn, np.asarray(cs_col), s.sum(axis=1), n,
+                        dtype, scale)
+    # a NaN/Inf syndrome (corruption overflowed) can never pass a >
+    # comparison — treat non-finite as corrupt explicitly
+    bad_r = ~np.isfinite(row_syn) | (np.abs(row_syn) > thr_r)
+    bad_c = ~np.isfinite(col_syn) | (np.abs(col_syn) > thr_c)
+    if not bad_r.any() and not bad_c.any():
+        return "clean", -1, -1, 0.0
+    if bad_r.sum() == 1 and bad_c.sum() == 1:
+        j = int(np.argmax(bad_r))
+        i = int(np.argmax(bad_c))
+        dr, dc = float(row_syn[j]), float(col_syn[i])
+        # one flipped element shows the SAME syndrome on both axes
+        if math.isfinite(dr) and math.isfinite(dc) \
+                and abs(dr - dc) <= max(float(thr_r[j]), float(thr_c[i])):
+            return "single", i, j, 0.5 * (dr + dc)
+    return "multi", -1, -1, 0.0
+
+
+def correct_single(s, i: int, j: int, delta: float):
+    """Correct one located corruption in place: the true value is the
+    observed one plus the syndrome (``S[i,j] += delta``).  Returns a
+    corrected copy (numpy)."""
+    import numpy as np
+
+    out = np.array(s, copy=True)
+    out[i, j] += delta
+    return out
+
+
+def augment_lu(a):
+    """``[A, A·e; eᵀA, eᵀAe]`` — the checksum-augmented LU operand
+    (one extra block-row and block-column of width
+    :func:`slate_tpu.ops.vmem.checksum_block_rows`, sublane-padded so
+    augmented operands stay tile-aligned; only lane 0 carries the
+    checksum, the pad lanes ride as zeros)."""
+    import numpy as np
+
+    from ..ops import vmem
+
+    a = np.asarray(a)
+    m, n = a.shape
+    cb = vmem.checksum_block_rows(a.dtype)
+    w = np.zeros((m + cb, n + cb), a.dtype)
+    w[:m, :n] = a
+    w[m, :n] = a.sum(axis=0)
+    w[:m, n] = a.sum(axis=1)
+    w[m, n] = a.sum()
+    return w
+
+
+# ---------------------------------------------------------------------------
+# The checksum-carried composed step loops
+# ---------------------------------------------------------------------------
+
+def _seam(site: str = "driver.update"):
+    """Poll the trailing-update fault seam — exactly
+    :func:`slate_tpu.resilience.inject.fault_here` (raises on
+    ``error``/``device_loss``, sleeps a ``slow`` fault in place,
+    returns corruption kinds like ``bitflip`` for the caller to
+    apply)."""
+    from . import inject
+
+    return inject.fault_here(site)
+
+
+def _apply_bitflip(w, r0: int, r1: int, c0: int, c1: int,
+                   site: str = "driver.update"):
+    """Flip one seeded exponent bit inside ``w[r0:r1, c0:c1]`` (the
+    live trailing block) — the ``bitflip`` kind's corruption at the
+    trailing-update seam."""
+    import numpy as np
+
+    from . import inject
+
+    if r1 <= r0 or c1 <= c0:
+        return w
+    blk, (bi, bj) = inject.corrupt_bitflip(np.asarray(w[r0:r1, c0:c1]),
+                                           site)
+    return w.at[r0 + bi, c0 + bj].set(blk[bi, bj])
+
+
+def _verify_and_heal(w, m: int, n: int, t0: int, driver: str):
+    """The per-step verify/correct rungs on the augmented working
+    matrix ``w`` (real block ``[:m, :n]``, checksum row ``m``, checksum
+    column ``n``), trailing from ``t0``.  Returns ``(w, status)`` with
+    status ``"clean"`` | ``"corrected"`` | ``"dirty"`` (dirty =
+    recompute the step)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    if t0 >= min(m, n):
+        return w, "clean"
+    metrics.inc("abft.checks")
+    s = np.asarray(w[t0:m, t0:n])
+    cs_row = np.asarray(w[m, t0:n])
+    cs_col = np.asarray(w[t0:m, n])
+    kind, i, j, delta = classify(s, cs_row, cs_col, dtype=s.dtype)
+    if kind == "clean":
+        return w, "clean"
+    if kind == "nonfinite":
+        # NaN/Inf in the trailing block is the operand's info signal
+        # (or a poisoned input) — the health gates' domain, not silent
+        # corruption; let it flow without burning recomputes
+        metrics.inc("abft.nonfinite_input")
+        return w, "clean"
+    _escalate(driver, "detected",
+              "step syndrome at trailing offset %d" % t0)
+    if mode() != "correct":
+        return w, "clean"          # verify tier: count, never act
+    if kind == "single":
+        w = w.at[t0 + i, t0 + j].add(jnp.asarray(delta, w.dtype))
+        s2 = np.asarray(w[t0:m, t0:n])
+        k2, _, _, _ = classify(s2, cs_row, cs_col, dtype=s2.dtype)
+        if k2 == "clean":
+            _escalate(driver, "corrected",
+                      "single element (%d, %d)" % (t0 + i, t0 + j))
+            return w, "corrected"
+    return w, "dirty"
+
+
+def getrf_abft(av, nb: int = 512, tall_panel: str = "tournament"):
+    """Checksum-carried right-looking partial-pivot LU (the composed
+    rung of the ABFT ladder): ``a[perm] = L·U`` with the Huang–Abraham
+    checksum block-row/column riding every step's ONE trailing
+    ``matmul``, a per-step verify, in-place single-element correction,
+    poisoned-step recompute, and ``SLATE_TPU_CKPT_EVERY_STEPS``-cadence
+    snapshots for device-loss restart.  Square real matrices; eager
+    only (callers gate on tracers).  Panels taller than XLA's fused-LU
+    VMEM limit take the same tall-panel rungs as
+    :func:`slate_tpu.linalg.lu.getrf_panels` (``tall_panel`` =
+    ``"tournament"`` CALU default, ``"pp"`` for an explicit PartialPiv
+    request).  Returns ``(lu, perm)`` — the
+    :func:`slate_tpu.linalg.lu.getrf_rec` contract."""
+    import jax.numpy as jnp
+
+    from . import checkpoint as _ckpt
+    from ..ops.blocks import matmul
+
+    m, n = av.shape
+    if m != n:
+        raise ValueError("getrf_abft handles square matrices; "
+                         "non-square shapes take the envelope path")
+    w0 = jnp.asarray(augment_lu(av))
+    gperm = jnp.arange(m)
+    every = _ckpt.every_steps()
+    ck = (0, w0, gperm)
+    k0, wmat = 0, w0
+    restarts = redo = 0
+    healing = True
+    while k0 < n:
+        wpan = min(nb, n - k0)
+        entry = (wmat, gperm)              # the step's recompute state
+        try:
+            _seam("step.boundary")         # device_loss fires here
+            wmat, gperm = _lu_step(wmat, gperm, k0, wpan, m, n, matmul,
+                                   tall_panel)
+            kind = _seam()
+            if kind == "bitflip":
+                wmat = _apply_bitflip(wmat, k0 + wpan, m, k0 + wpan, n)
+            if healing:
+                wmat, status = _verify_and_heal(wmat, m, n, k0 + wpan,
+                                                "getrf")
+                if status == "dirty":
+                    if redo >= 2:
+                        _unrecovered("getrf")
+                        # the corruption survived two recomputes and
+                        # will propagate: stop paying the verify +
+                        # recompute tax per remaining step and let the
+                        # health gate judge the final result ONCE
+                        healing = False
+                    else:
+                        redo += 1
+                        _escalate("getrf", "recomputed",
+                                  "step at column %d" % k0)
+                        wmat, gperm = entry
+                        continue
+                else:
+                    redo = 0
+        except Exception as e:
+            from .retry import transient_infra
+
+            if not transient_infra(e) or restarts >= 3:
+                raise
+            restarts += 1
+            metrics.inc("ckpt.restored")
+            _escalate("getrf", "restarted", str(e))
+            k0, wmat, gperm = ck
+            continue
+        k0 += wpan
+        if every and k0 < n and (k0 // nb) % every == 0:
+            ck = (k0, wmat, gperm)
+            metrics.inc("ckpt.saved")
+    return wmat[:m, :n], gperm
+
+
+def _lu_step(wmat, gperm, k0: int, wpan: int, m: int, n: int, matmul,
+             tall_panel: str = "tournament"):
+    """One right-looking LU step on the checksum-augmented carry:
+    autotuned panel factor on the real rows, row permutation (checksum
+    lanes never pivot), U₁₂ solve including the checksum column, and
+    ONE trailing gemm whose L₂₁ operand carries the checksum row's
+    multipliers — the checksum maintenance rides the update it
+    protects."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    pan = wmat[k0:m, k0:k0 + wpan]
+    lu_p, pl = _panel_factor(pan, tall_panel)
+    body = wmat[k0:m][pl]
+    body = body.at[:, k0:k0 + wpan].set(lu_p)
+    wmat = wmat.at[k0:m].set(body)
+    gperm = gperm.at[k0:].set(gperm[k0:][pl])
+    c_lo = k0 + wpan
+    l11 = lu_p[:wpan]
+    u12 = lax.linalg.triangular_solve(
+        l11, wmat[k0:c_lo, c_lo:], left_side=True, lower=True,
+        unit_diagonal=True)
+    wmat = wmat.at[k0:c_lo, c_lo:].set(u12)
+    # the checksum row's multipliers: l_cs = cs_panel · U11⁻¹ (the
+    # extra L21 block-row that makes the checksum ride the gemm)
+    l_cs = lax.linalg.triangular_solve(
+        l11, wmat[m:, k0:k0 + wpan], left_side=False, lower=False)
+    wmat = wmat.at[m:, k0:k0 + wpan].set(l_cs)
+    l21aug = jnp.concatenate([lu_p[wpan:], l_cs], axis=0)
+    # ONE gemm updates the real trailing block, the checksum row AND
+    # the checksum column together (u12 already includes the column)
+    wmat = wmat.at[c_lo:, c_lo:].add(-matmul(l21aug, u12))
+    return wmat, gperm
+
+
+def _panel_factor(pan, tall_panel: str):
+    """Panel factor for the ABFT step loop: the autotuned leaf for
+    ordinary heights, the tall-panel rungs (CALU tournament, or the
+    true-partial-pivot loop for an explicit PartialPiv request) past
+    XLA's fused-LU VMEM limit — the same ladder
+    :func:`slate_tpu.linalg.lu.getrf_panels` dispatches."""
+    from ..linalg import lu as _lu
+
+    if pan.shape[0] > _lu._MAX_LU_PANEL_ROWS:
+        if tall_panel == "pp":
+            return _lu._tall_panel_lu_pp(pan)
+        return _lu._tall_panel_lu(pan)
+    out = _lu._panel_lu_auto(pan)
+    return out[0], out[1]
+
+
+def potrf_abft(full, nb: int = 512):
+    """Checksum-carried right-looking Cholesky (the composed ABFT
+    rung): the checksum block-row rides each step's trailing syrk-gemm
+    as one extra L₂₁ row; row syndromes come from the carried checksum,
+    column location from the symmetry residual of the trailing block
+    (S is symmetric — a single corruption is the one element breaking
+    it).  Returns the lower factor (full array, lower triangle
+    valid)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from . import checkpoint as _ckpt
+    from ..ops.blocks import matmul
+
+    n = full.shape[-1]
+    w0 = jnp.asarray(_augment_potrf(np.asarray(full)))
+    every = _ckpt.every_steps()
+    ck = (0, w0)
+    k0, wmat = 0, w0
+    restarts = redo = 0
+    healing = True
+    while k0 < n:
+        wpan = min(nb, n - k0)
+        entry = wmat
+        try:
+            _seam("step.boundary")         # device_loss fires here
+            wmat = _potrf_step(wmat, k0, wpan, n, matmul)
+            kind = _seam()
+            if kind == "bitflip":
+                wmat = _apply_bitflip(wmat, k0 + wpan, n, k0 + wpan, n)
+            if healing:
+                wmat, status = _verify_potrf(wmat, n, k0 + wpan)
+                if status == "dirty":
+                    if redo >= 2:
+                        _unrecovered("potrf")
+                        healing = False    # see getrf_abft
+                    else:
+                        redo += 1
+                        _escalate("potrf", "recomputed",
+                                  "step at column %d" % k0)
+                        wmat = entry
+                        continue
+                else:
+                    redo = 0
+        except Exception as e:
+            from .retry import transient_infra
+
+            if not transient_infra(e) or restarts >= 3:
+                raise
+            restarts += 1
+            metrics.inc("ckpt.restored")
+            _escalate("potrf", "restarted", str(e))
+            k0, wmat = ck
+            continue
+        k0 += wpan
+        if every and k0 < n and (k0 // nb) % every == 0:
+            ck = (k0, wmat)
+            metrics.inc("ckpt.saved")
+    return jnp.tril(wmat[:n, :n])
+
+
+def _augment_potrf(a):
+    import numpy as np
+
+    from ..ops import vmem
+
+    n = a.shape[-1]
+    cb = vmem.checksum_block_rows(a.dtype)
+    w = np.zeros((n + cb, n), np.asarray(a).dtype)
+    w[:n] = a
+    w[n] = a.sum(axis=0)
+    return w
+
+
+def _potrf_step(wmat, k0: int, wpan: int, n: int, matmul):
+    import jax.numpy as jnp
+    from jax import lax
+
+    c_lo = k0 + wpan
+    d = wmat[k0:c_lo, k0:c_lo]
+    l11 = jnp.tril(lax.linalg.cholesky(d))
+    l21 = lax.linalg.triangular_solve(
+        l11, wmat[c_lo:n, k0:c_lo], left_side=False, lower=True,
+        transpose_a=True)
+    l_cs = lax.linalg.triangular_solve(
+        l11, wmat[n:, k0:c_lo], left_side=False, lower=True,
+        transpose_a=True)
+    wmat = wmat.at[k0:c_lo, k0:c_lo].set(l11)
+    wmat = wmat.at[c_lo:n, k0:c_lo].set(l21)
+    wmat = wmat.at[n:, k0:c_lo].set(l_cs)
+    if c_lo < n:
+        l21aug = jnp.concatenate([l21, l_cs], axis=0)
+        # ONE gemm: the symmetric trailing update with the checksum
+        # block-row riding as the extra L21 row
+        wmat = wmat.at[c_lo:, c_lo:n].add(-matmul(l21aug, l21.T))
+    return wmat
+
+
+def _verify_potrf(wmat, n: int, t0: int):
+    """Cholesky per-step verify: row syndromes off the carried checksum
+    row, column location off the symmetry residual."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    if t0 >= n:
+        return wmat, "clean"
+    metrics.inc("abft.checks")
+    s = np.asarray(wmat[t0:n, t0:n])
+    if not np.isfinite(s).all():
+        # the non-SPD info signal (NaN factor) — health-gate domain
+        metrics.inc("abft.nonfinite_input")
+        return wmat, "clean"
+    cs_row = np.asarray(wmat[n, t0:n])
+    row_syn = cs_row - s.sum(axis=0)
+    scale = max(1.0, float(np.max(np.abs(s))))
+    thr = _thresholds(row_syn, cs_row, s.sum(axis=0), n - t0, s.dtype,
+                      scale)
+    bad = ~np.isfinite(row_syn) | (np.abs(row_syn) > thr)
+    if not bad.any():
+        return wmat, "clean"
+    _escalate("potrf", "detected",
+              "step syndrome at trailing offset %d" % t0)
+    if mode() != "correct":
+        return wmat, "clean"
+    if bad.sum() == 1:
+        j = int(np.argmax(bad))
+        sym = np.abs(s[:, j] - s[j, :])
+        i = int(np.argmax(sym)) if float(sym.max()) > float(thr[j]) else j
+        wmat = wmat.at[t0 + i, t0 + j].add(
+            jnp.asarray(row_syn[j], wmat.dtype))
+        s2 = np.asarray(wmat[t0:n, t0:n])
+        if not (np.abs(cs_row - s2.sum(axis=0)) > thr).any():
+            _escalate("potrf", "corrected",
+                      "single element (%d, %d)" % (t0 + i, t0 + j))
+            return wmat, "corrected"
+    return wmat, "dirty"
+
+
+def _unrecovered(driver: str) -> None:
+    metrics.inc("abft.unrecovered")
+    warnings.warn(
+        "%s: ABFT verify still failing after recompute; the result "
+        "flows to the health gate (SLATE_TPU_HEALTH) for the "
+        "stock-backend rung" % driver, RuntimeWarning, stacklevel=3)
+
+
+# ---------------------------------------------------------------------------
+# Factor-identity verification — the envelope for the fused/full Pallas
+# rungs and the distributed drivers
+# ---------------------------------------------------------------------------
+
+def verify_lu_factors(cs_row0, cs_col0, lu, perm, dtype=None):
+    """Checksum verify of finished LU factors against the operand's
+    reference checksums: ``row_syn = (eᵀL)·U − eᵀA`` (column sums are
+    permutation-invariant) and ``col_syn = L·(U·e) − (A·e)[perm]`` —
+    two O(n²) matvec sweeps.  Returns ``(ok, detail)``."""
+    import numpy as np
+
+    lu = np.asarray(lu)
+    if not np.isfinite(lu).all():
+        # a NaN/Inf factor is the info signal (singular/poisoned
+        # input), the health gates' domain — not silent corruption
+        metrics.inc("abft.nonfinite_input")
+        return True, "nonfinite factors (info signal; health-gate domain)"
+    n = lu.shape[0]
+    lmat = np.tril(lu, -1)
+    np.fill_diagonal(lmat, 1.0)
+    umat = np.triu(lu)
+    if dtype is None:
+        dtype = lu.dtype
+    row = lmat.sum(axis=0) @ umat
+    col = lmat @ umat.sum(axis=1)
+    cs_row0 = np.asarray(cs_row0)
+    cs_col0 = np.asarray(cs_col0)[np.asarray(perm)]
+    scale = max(1.0, float(np.max(np.abs(lu))))
+    thr_r = _thresholds(row, cs_row0, row, n, dtype, scale)
+    thr_c = _thresholds(col, cs_col0, col, n, dtype, scale)
+    syn_r, syn_c = row - cs_row0, col - cs_col0
+    bad_r = ~np.isfinite(syn_r) | (np.abs(syn_r) > thr_r)
+    bad_c = ~np.isfinite(syn_c) | (np.abs(syn_c) > thr_c)
+    if not bad_r.any() and not bad_c.any():
+        return True, ""
+    return False, ("factor syndromes: %d column(s), %d row(s)"
+                   % (int(bad_r.sum()), int(bad_c.sum())))
+
+
+def verify_chol_factors(cs_row0, l, dtype=None):
+    """Checksum verify of a finished Cholesky factor:
+    ``row_syn = (eᵀL)·Lᴴ − eᵀA``.  Returns ``(ok, detail)``."""
+    import numpy as np
+
+    l = np.asarray(l)
+    if not np.isfinite(l).all():
+        # the non-SPD info signal — see verify_lu_factors
+        metrics.inc("abft.nonfinite_input")
+        return True, "nonfinite factors (info signal; health-gate domain)"
+    n = l.shape[0]
+    lmat = np.tril(l)
+    if dtype is None:
+        dtype = l.dtype
+    row = lmat.sum(axis=0) @ np.conj(lmat).T
+    cs_row0 = np.asarray(cs_row0)
+    scale = max(1.0, float(np.max(np.abs(l))))
+    thr = _thresholds(row, cs_row0, row, n, dtype, scale)
+    syn = row - cs_row0
+    bad = ~np.isfinite(syn) | (np.abs(syn) > thr)
+    if not bad.any():
+        return True, ""
+    return False, "factor syndromes: %d column(s)" % int(bad.sum())
+
+
+_UNSET = object()
+
+
+def _envelope(driver: str, run: Callable, corrupt: Callable,
+              verify: Callable, out=_UNSET):
+    """The fused/full-rung checksum envelope: run the kernel-owned
+    invocation, apply the trailing-update fault seam to its output,
+    verify the factor identities, and on detection recompute the
+    poisoned invocation (for the ``full`` rung the invocation IS the
+    step).  A second failure flows out to the health gate.  ``out``
+    lets a caller that already holds the first result (the distributed
+    drivers — their checkpointed runner produced it) skip the first
+    ``run()``; ``run`` stays the recompute path.  ONE copy of the
+    ladder control flow — the distributed checks reuse it verbatim so
+    counter semantics cannot drift per driver."""
+    if out is _UNSET:
+        out = run()
+    out = corrupt(out)
+    metrics.inc("abft.checks")       # count every verify, pass or fail
+    ok, detail = verify(out)         # (the composed loop's convention)
+    if ok:
+        return out
+    _escalate(driver, "detected", detail)
+    if mode() != "correct":
+        return out
+    _escalate(driver, "recomputed", "whole-invocation recompute")
+    out2 = run()
+    out2 = corrupt(out2)
+    metrics.inc("abft.checks")
+    ok2, _ = verify(out2)
+    if not ok2:
+        _unrecovered(driver)
+    return out2
+
+
+# ---------------------------------------------------------------------------
+# Driver-facing dispatch
+# ---------------------------------------------------------------------------
+
+def _is_tracer(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.core.Tracer)
+    except Exception:                      # pragma: no cover
+        return False
+
+
+def eligible(av) -> bool:
+    """Gate for the ABFT layer on one eager driver operand: knob on,
+    concrete (no tracers — the layer is host-side, like the health
+    gates), 2-D SQUARE real floating (the checksum identities here are
+    square-factor shaped; other shapes keep the unguarded path and the
+    PR 9 health gates)."""
+    import numpy as np
+
+    if not enabled() or _is_tracer(av):
+        return False
+    if getattr(av, "ndim", 0) != 2:
+        return False
+    if av.shape[0] != av.shape[1]:
+        return False
+    dt = np.dtype(getattr(av, "dtype", np.float32))
+    return dt.kind == "f"
+
+
+def getrf_guarded(av, nb: int, raw_method=None):
+    """ABFT dispatch for the PartialPiv LU driver: the checksum-carried
+    composed loop where the shipped path is composed-class, the
+    checksum envelope around the scattered driver (whose Pallas rungs
+    — panel-fused through full — own their steps in-kernel).  Callers
+    guarantee :func:`eligible` (square, real, eager)."""
+    import numpy as np
+
+    from ..linalg import lu as _lu
+
+    if _lu._choose_lu_driver(av) != "scattered":
+        from ..enums import MethodLU
+
+        tall = ("pp" if raw_method is MethodLU.PartialPiv
+                else "tournament")
+        return getrf_abft(av, nb, tall_panel=tall)
+
+    a_np = np.asarray(av)
+    cs_row0, cs_col0 = checksums(a_np)
+
+    def run():
+        return _lu._getrf_partial_impl(av, nb, raw_method)
+
+    def corrupt(out):
+        kind = _seam()
+        if kind != "bitflip":
+            return out
+        import jax.numpy as jnp
+
+        blk, (bi, bj) = _corrupt_np(out[0])
+        return jnp.asarray(blk), out[1]
+
+    def verify(out):
+        return verify_lu_factors(cs_row0, cs_col0, out[0], out[1])
+
+    return _envelope("getrf", run, corrupt, verify)
+
+
+def potrf_guarded(full, nb: int, branch: str, dispatch: Callable):
+    """ABFT dispatch for potrf: the checksum-carried composed loop for
+    the Auto stock branch (``xla``), the envelope around every other
+    branch — the kernel-owned rungs (``fused`` / ``full`` step depths,
+    the Pallas panel and Ozaki paths) AND an explicitly requested
+    ``method_factor`` (``recursive``): a user's algorithm choice must
+    keep running verbatim, ABFT only verifying around it."""
+    import numpy as np
+
+    if branch == "xla":
+        return potrf_abft(full, nb)
+
+    cs_row0 = np.asarray(full).sum(axis=0)
+
+    def corrupt(l):
+        kind = _seam()
+        if kind != "bitflip":
+            return l
+        import jax.numpy as jnp
+
+        from . import inject
+
+        # the factor's upper triangle is structurally zero — land the
+        # seeded flip in the meaningful (lower) triangle
+        blk, (bi, bj) = _corrupt_np(l)
+        if bi < bj:
+            blk = np.array(np.asarray(l), copy=True)
+            blk[bj, bi] = inject.flip_exponent_bit(blk[bj, bi])
+        return jnp.asarray(blk)
+
+    def verify(l):
+        return verify_chol_factors(cs_row0, l)
+
+    return _envelope("potrf", dispatch, corrupt, verify)
+
+
+def _corrupt_np(arr):
+    import numpy as np
+
+    from . import inject
+
+    return inject.corrupt_bitflip(np.asarray(arr), "driver.update")

@@ -1,0 +1,134 @@
+"""Step-cadence checkpoint/restart for long factorizations (ISSUE 14).
+
+PR 13's multichip scale-out makes a single ``pgetrf`` long enough that
+one lost device discards the whole run; this module is the generic
+snapshot/resume harness the step-chunked drivers use to make that loss
+cost one *chunk* instead:
+
+* **Cadence knob.**  ``SLATE_TPU_CKPT_EVERY_STEPS`` (:func:`every_steps`)
+  — snapshot the factorization carry every K block-column steps.  Off
+  (0 / unset) by default, and when off nothing here is ever consulted:
+  the drivers keep their monolithic single-jit form and compiled
+  programs stay bit-identical (pinned, like every PR 9 knob).
+* **Snapshot = the step carry.**  A checkpoint is the device→host copy
+  of exactly what the step loop carries between steps — for ``pgetrf``
+  the local trailing window, the replicated pivot vector and the
+  in-flight lookahead panel ring; for the ABFT step loops the
+  checksum-augmented working matrix and the permutation.  Restoring is
+  just feeding those arrays back into the same jitted chunk program,
+  so a resumed run replays the identical arithmetic and reproduces the
+  uninterrupted factors **bitwise** (tie-free pivots).
+* **Recovery.**  :func:`run_checkpointed` polls the ``step.boundary``
+  fault site between chunks (the ``device_loss`` kind of
+  :mod:`~slate_tpu.resilience.inject` fires there) and catches
+  classified-transient failures out of the chunk itself; either way the
+  in-flight chunk is considered lost, the carry rewinds to the last
+  snapshot (``ckpt.restored`` / ``abft.restarted``) and the chunk
+  re-runs.  Non-transient errors and restart storms past
+  ``max_restarts`` propagate — a checkpoint must never retry a
+  numerical failure into silence (the PR 9 classifier contract).
+
+Counters: ``ckpt.saved`` / ``ckpt.restored`` / ``abft.restarted``; each
+restart is also fed to the live telemetry sentinel
+(:func:`slate_tpu.perf.telemetry.observe_abft`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ..perf import metrics
+
+__all__ = ["ENV_EVERY", "every_steps", "run_checkpointed", "snapshot"]
+
+ENV_EVERY = "SLATE_TPU_CKPT_EVERY_STEPS"
+
+
+def every_steps() -> int:
+    """The checkpoint cadence in block-column steps
+    (``SLATE_TPU_CKPT_EVERY_STEPS``); 0 = checkpointing off (default)."""
+    raw = os.environ.get(ENV_EVERY, "").strip()
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
+def snapshot(state):
+    """Device→host copy of a step carry (tuple/list of arrays — jax,
+    numpy, or host scalars).  ``np.asarray`` materializes each leaf on
+    the host; feeding the copies back into the same jitted chunk
+    program re-places them per its shardings, so a restore is
+    value-exact."""
+    import numpy as np
+
+    if state is None:
+        return None
+    if isinstance(state, (tuple, list)):
+        return tuple(snapshot(s) for s in state)
+    if hasattr(state, "shape"):
+        return np.asarray(state)
+    return state
+
+
+def run_checkpointed(total_steps: int, every: int, run_chunk: Callable,
+                     label: str = "", max_restarts: int = 3):
+    """Drive ``run_chunk(carry, k0, k1)`` over ``[0, total_steps)`` in
+    ``every``-step chunks with snapshot-on-boundary and restore-on-loss.
+
+    ``run_chunk`` receives the previous chunk's carry (None for the
+    first chunk) and returns the new carry; it must be deterministic in
+    its inputs (same carry → same outputs bitwise), which every jitted
+    step program here is.  The ``step.boundary`` fault site is polled
+    after each chunk: an injected ``device_loss`` (or any
+    classified-transient exception out of the chunk) discards the
+    chunk's result and rewinds to the last snapshot.  Returns the final
+    carry."""
+    from . import inject
+    from .retry import transient_infra
+
+    every = max(1, int(every))
+    k = 0
+    carry = None
+    ck_k: int = 0
+    ck_state = None
+    restarts = 0
+    while k < total_steps:
+        k1 = min(k + every, total_steps)
+        try:
+            new_carry = run_chunk(carry, k, k1)
+            kind = inject.poll("step.boundary")
+            if kind == "device_loss":
+                raise inject.DeviceLoss("step.boundary")
+            if kind == "error":
+                raise inject.InjectedFault("step.boundary")
+        except Exception as e:
+            if not transient_infra(e) or restarts >= max(0, max_restarts):
+                raise
+            restarts += 1
+            metrics.inc("ckpt.restored")
+            metrics.inc("abft.restarted")
+            _feed_sentinel(label or "ckpt", "restarted", str(e))
+            # the in-flight chunk is lost; resume from the snapshot
+            # (or from scratch when the first chunk never completed)
+            k, carry = ck_k, ck_state
+            continue
+        carry, k = new_carry, k1
+        if k < total_steps:
+            ck_k, ck_state = k, snapshot(new_carry)
+            metrics.inc("ckpt.saved")
+    return carry
+
+
+def _feed_sentinel(driver: str, rung: str, detail: str = "") -> None:
+    """Best-effort escalation feed into the PR 10 live sentinel — an
+    observability failure must never break a recovery path."""
+    try:
+        from ..perf import telemetry
+
+        telemetry.observe_abft(driver, rung, detail)
+    except Exception:
+        pass

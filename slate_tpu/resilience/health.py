@@ -129,8 +129,66 @@ def _resid_getrf_batched(args, kwargs, out) -> float:
     return batched_factor_resid_lu(args[0], out)
 
 
+def _probe_vec(n: int, dtype):
+    """Deterministic well-spread probe vector for the matvec residuals
+    (no RNG — the gate must be replayable)."""
+    import numpy as np
+
+    x = 1.0 + np.cos(np.arange(n, dtype=np.float64))
+    return x.astype(np.dtype(dtype) if np.dtype(dtype).kind == "f"
+                    else np.float64)
+
+
+def _resid_getrf(args, kwargs, out) -> float:
+    """O(n²) matvec factor residual ‖L(Ux) − (PA)x‖ / (‖A‖‖x‖εn) for
+    the single getrf facade — the stock-retry rung of the ISSUE 14
+    recovery ladder needs the gate to SEE finite silent corruption
+    (a bitflip never trips the NaN census)."""
+    import numpy as np
+
+    a = np.asarray(getattr(args[0], "array", args[0]))
+    lu = np.asarray(getattr(out[0], "array", out[0]))
+    perm = np.asarray(out[1])
+    if a.ndim != 2 or a.shape[0] != a.shape[1]:
+        raise ValueError("square-only probe")
+    n = a.shape[0]
+    lmat = np.tril(lu, -1) + np.eye(n, dtype=lu.dtype)
+    x = _probe_vec(n, a.dtype)
+    r = lmat @ (np.triu(lu) @ x) - a[perm] @ x
+    eps = float(np.finfo(np.asarray(a.real).dtype).eps)
+    denom = (np.abs(a).max() * np.abs(x).max() * eps * n) or 1.0
+    return float(np.abs(r).max() / denom)
+
+
+def _resid_potrf(args, kwargs, out) -> float:
+    """Matvec residual ‖L(Lᴴx) − Ax‖ / (‖A‖‖x‖εn) for the potrf
+    facade (either stored triangle)."""
+    import numpy as np
+
+    from ..linalg.cholesky import _hermitian_full
+
+    full = np.asarray(_hermitian_full(args[0]))
+    f = np.asarray(getattr(out, "array", out))
+    if full.ndim != 2:
+        raise ValueError("2-D-only probe")
+    n = full.shape[0]
+    lmat = np.tril(f)
+    # an Upper-stored factor has an EMPTY strict lower triangle (the
+    # diagonal alone is populated either way, so test below it)
+    if not np.abs(np.tril(f, -1)).sum() > 0 \
+            and np.abs(np.triu(f, 1)).sum() > 0:
+        lmat = np.conj(np.triu(f)).T
+    x = _probe_vec(n, full.dtype)
+    r = lmat @ (np.conj(lmat).T @ x) - full @ x
+    eps = float(np.finfo(np.asarray(full.real).dtype).eps)
+    denom = (np.abs(full).max() * np.abs(x).max() * eps * n) or 1.0
+    return float(np.abs(r).max() / denom)
+
+
 register_residual("potrf_batched", _resid_potrf_batched)
 register_residual("getrf_batched", _resid_getrf_batched)
+register_residual("getrf", _resid_getrf)
+register_residual("potrf", _resid_potrf)
 
 
 def _healthy(name: str, args, kwargs, out) -> bool:

@@ -32,7 +32,14 @@ to drive faults through it on demand.  This module is that harness:
   seam sleeps :func:`slow_seconds` (``SLATE_TPU_FAULT_SLOW_S``, default
   50 ms) before answering: the sustained-latency degradation the live
   telemetry sentinel (ISSUE 10) exists to classify, injectable on
-  demand.
+  demand; ``bitflip`` (ISSUE 14) — the seam flips ONE exponent bit of
+  one seeded element of its output (:func:`corrupt_bitflip`): the
+  silent in-flight corruption the ABFT checksum ladder
+  (:mod:`~slate_tpu.resilience.abft`) detects, locates and corrects;
+  ``device_loss`` (ISSUE 14) — the seam raises :class:`DeviceLoss`
+  (transient, classified-retryable): a device falling out mid-run at a
+  step boundary, the failure the step checkpoint/restart machinery
+  (:mod:`~slate_tpu.resilience.checkpoint`) resumes across.
 
 * **Sites** wired today: ``autotune.probe`` (candidate compile/time),
   ``serve.dispatch`` (bucket batch dispatch), ``driver.output``
@@ -61,17 +68,18 @@ from ..exceptions import SlateError
 from ..perf import metrics
 
 __all__ = [
-    "ENV_PLAN", "ENV_SEED", "ENV_SLOW_S", "KINDS", "FaultPlan",
-    "FaultSpec", "InjectedFault", "active", "clear_plan",
-    "corrupt_outputs", "fault_here", "get_plan", "install",
-    "iter_leaves", "parse_plan", "poll", "slow_seconds",
+    "ENV_PLAN", "ENV_SEED", "ENV_SLOW_S", "KINDS", "DeviceLoss",
+    "FaultPlan", "FaultSpec", "InjectedFault", "active", "clear_plan",
+    "corrupt_bitflip", "corrupt_outputs", "fault_here", "flip_exponent_bit",
+    "get_plan", "install", "iter_leaves", "parse_plan", "poll",
+    "slow_seconds",
 ]
 
 ENV_PLAN = "SLATE_TPU_FAULT_INJECT"
 ENV_SEED = "SLATE_TPU_FAULT_SEED"
 ENV_SLOW_S = "SLATE_TPU_FAULT_SLOW_S"
 
-KINDS = ("error", "nan", "inf", "slow")
+KINDS = ("error", "nan", "inf", "slow", "bitflip", "device_loss")
 
 
 def slow_seconds() -> float:
@@ -92,6 +100,20 @@ class InjectedFault(SlateError):
         self.index = index
         at = "" if index is None else f" (event #{index})"
         super().__init__(f"injected fault at {site}{at}")
+
+
+class DeviceLoss(InjectedFault):
+    """An injected mid-run device loss (the ``device_loss`` kind): a
+    classified-transient error raised at a factorization step boundary.
+    The checkpoint/restart machinery
+    (:mod:`slate_tpu.resilience.checkpoint`) catches it and resumes
+    from the last step-cadence snapshot; anything without a checkpoint
+    treats it like any other transient infra failure (retry from
+    scratch)."""
+
+    def __init__(self, site: str, index: Optional[int] = None):
+        super().__init__(site, index)
+        self.args = (f"injected device loss at {site}",)
 
 
 @dataclass(frozen=True)
@@ -227,13 +249,16 @@ def poll(site: str) -> Optional[str]:
 
 def fault_here(site: str) -> Optional[str]:
     """Poll ``site`` and raise :class:`InjectedFault` on an ``error``
-    fault; a ``slow`` fault sleeps :func:`slow_seconds` in place (and
-    returns None — the seam continues normally, just later); returns
-    the kind (``nan``/``inf``) for seams that also support output
+    fault (:class:`DeviceLoss` on ``device_loss``); a ``slow`` fault
+    sleeps :func:`slow_seconds` in place (and returns None — the seam
+    continues normally, just later); returns the kind
+    (``nan``/``inf``/``bitflip``) for seams that also support output
     corruption, else None."""
     kind = poll(site)
     if kind == "error":
         raise InjectedFault(site)
+    if kind == "device_loss":
+        raise DeviceLoss(site)
     if kind == "slow":
         time.sleep(slow_seconds())
         return None
@@ -284,6 +309,52 @@ def _is_float_array(x) -> bool:
         return False
     return np.issubdtype(np.dtype(dt), np.floating) \
         or np.issubdtype(np.dtype(dt), np.complexfloating)
+
+
+#: exponent bit flipped by the ``bitflip`` kind, per float width: bit 3
+#: of the biased exponent (f32 bit 26, f64 bit 55) — scales the value
+#: by 2^±8 (f32) / 2^±8 (f64), a large-but-finite silent corruption
+#: (the exponent MSB would overflow small values straight to inf, which
+#: the plain finite checks already catch; ABFT exists for the finite
+#: flips they cannot see).
+_FLIP_BIT = {4: 26, 8: 55}
+
+
+def flip_exponent_bit(x):
+    """One genuine exponent-bit flip of a float scalar (numpy f32/f64):
+    the value reinterpreted as its integer bits with :data:`_FLIP_BIT`
+    XORed — what a real SEU in an HBM word looks like."""
+    import numpy as np
+
+    x = np.asarray(x)
+    itemsize = x.dtype.itemsize
+    bit = _FLIP_BIT.get(itemsize)
+    if bit is None:                      # no flip defined for this width
+        return x
+    iview = np.array([x]).view(np.dtype("i%d" % itemsize))
+    iview ^= np.dtype("i%d" % itemsize).type(1) << bit
+    return iview.view(x.dtype)[0]
+
+
+def corrupt_bitflip(arr, site: str):
+    """Flip one exponent bit of ONE seeded element of a 2-D array — the
+    ``bitflip`` fault kind's corruption.  The element coordinates are a
+    pure function of (plan seed, site, per-site fired count), so the
+    same seed replays the same flip.  Returns ``(corrupted numpy copy,
+    (i, j))``."""
+    import numpy as np
+
+    plan = get_plan()
+    seed = plan.seed if plan is not None else 0
+    idx = plan.fired(site) if plan is not None else 0
+    rng = random.Random(f"{seed}|{site}|bitflip|{idx}")
+    out = np.array(arr, copy=True)
+    if out.ndim != 2 or out.size == 0:
+        return out, (0, 0)
+    i = rng.randrange(out.shape[0])
+    j = rng.randrange(out.shape[1])
+    out[i, j] = flip_exponent_bit(out[i, j])
+    return out, (i, j)
 
 
 def corrupt_outputs(out, kind: str):

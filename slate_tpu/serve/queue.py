@@ -810,6 +810,14 @@ class BatchQueue:
             kind = _inject.poll("serve.dispatch")
             if kind == "error":
                 raise _inject.InjectedFault("serve.dispatch")
+            if kind == "device_loss":
+                # a device dying under a batch (ISSUE 14): transient
+                # like any infra blip (the classified retry / singles
+                # fallback absorb it), but counted apart — a run of
+                # serve.device_loss means hardware attrition, not
+                # queue-tuning trouble
+                metrics.inc("serve.device_loss")
+                raise _inject.DeviceLoss("serve.dispatch")
             if kind == "slow":
                 # the injected sustained-latency degradation the live
                 # sentinel classifies (ISSUE 10)

@@ -1,0 +1,768 @@
+"""ISSUE 14 — algorithm-based fault tolerance: checksum-carried
+factorizations, the detect → correct → recompute → restart ladder, and
+step checkpoint/restart.
+
+Four structural guarantees under test:
+
+* **exact location** — a single corrupted trailing element shows the
+  SAME syndrome on the row and column checksum; `classify` names its
+  exact coordinates and the in-place correction restores the value to
+  roundoff (unit-level, f32/f64, hand-injected deltas);
+* **end-to-end recovery** — a seeded exponent-bit flip injected at the
+  `driver.update` seam of getrf/potrf (composed loop AND the
+  scattered/fused/full envelope rungs through the SHIPPED dispatch) is
+  detected and corrected/recomputed, final residuals passing the
+  existing gates, with ladder counters exact;
+* **bitwise restart** — an injected `device_loss` mid-`pgetrf` on the
+  CPU mesh resumes from the `SLATE_TPU_CKPT_EVERY_STEPS` snapshot and
+  reproduces the uninterrupted factors bitwise (tie-free pivots); the
+  chunked runner itself is bitwise against the monolithic build;
+* **inertness** — with every new knob unset, compiled programs are
+  bit-identical (lowered-text pin) and no ABFT module loads at package
+  import (the registry-side pin lives in test_backend_registry).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import slate_tpu as st
+from slate_tpu.linalg import cholesky as chol_mod
+from slate_tpu.linalg import lu as lu_mod
+from slate_tpu.perf import autotune, metrics, regress
+from slate_tpu.perf import attr
+from slate_tpu.resilience import abft, checkpoint, inject
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    metrics.reset()
+    metrics.on()
+    inject.clear_plan()
+    yield
+    inject.clear_plan()
+    metrics.reset()
+
+
+def _abft_counters():
+    snap = metrics.snapshot()["counters"]
+    return {k: v for k, v in snap.items()
+            if k.startswith(("abft.", "ckpt."))}
+
+
+def _lu_mat(n, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)) + 2.0 * np.sqrt(n) * np.eye(n)
+    return a.astype(dtype)
+
+
+def _spd_mat(n, dtype=np.float32, seed=1):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((n, n))
+    return (g @ g.T / n + np.eye(n)).astype(dtype)
+
+
+def _lu_resid(a, lu, perm):
+    n = a.shape[0]
+    lmat = np.tril(lu, -1) + np.eye(n, dtype=a.dtype)
+    umat = np.triu(lu)
+    eps = np.finfo(a.dtype).eps
+    return float(np.abs(a[perm] - lmat @ umat).max()
+                 / (np.abs(a).max() * n * eps))
+
+
+def _chol_resid(a, l):
+    n = a.shape[0]
+    eps = np.finfo(a.dtype).eps
+    return float(np.linalg.norm(np.tril(l) @ np.tril(l).T - a)
+                 / (np.linalg.norm(a) * eps * n))
+
+
+# ---------------------------------------------------------------------------
+# Checksum arithmetic: syndromes, exact location, exact correction
+# ---------------------------------------------------------------------------
+
+class TestChecksumCore:
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_clean_block_classifies_clean(self, dtype):
+        rng = np.random.default_rng(2)
+        s = rng.standard_normal((96, 96)).astype(dtype)
+        cs_row, cs_col = abft.checksums(s)
+        kind, i, j, _ = abft.classify(s, cs_row, cs_col)
+        assert kind == "clean" and (i, j) == (-1, -1)
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("ij", [(0, 0), (17, 83), (95, 1)])
+    def test_single_corruption_located_exactly_and_corrected(
+            self, dtype, ij):
+        rng = np.random.default_rng(3)
+        s0 = rng.standard_normal((96, 96)).astype(dtype)
+        cs_row, cs_col = abft.checksums(s0)
+        i0, j0 = ij
+        s = s0.copy()
+        s[i0, j0] += dtype(7.5)
+        kind, i, j, delta = abft.classify(s, cs_row, cs_col)
+        assert kind == "single"
+        assert (i, j) == (i0, j0), "syndrome pair must locate exactly"
+        fixed = abft.correct_single(s, i, j, delta)
+        # correction restores to checksum-roundoff, far under eps·n gate
+        tol = 200 * np.finfo(dtype).eps * 96
+        assert abs(float(fixed[i0, j0] - s0[i0, j0])) < tol
+
+    def test_multi_corruption_classifies_multi(self):
+        rng = np.random.default_rng(4)
+        s = rng.standard_normal((64, 64)).astype(np.float32)
+        cs_row, cs_col = abft.checksums(s)
+        s[3, 9] += 5.0
+        s[40, 41] -= 11.0
+        kind = abft.classify(s, cs_row, cs_col)[0]
+        assert kind == "multi"
+
+    def test_nonfinite_syndrome_detected(self):
+        rng = np.random.default_rng(5)
+        s = rng.standard_normal((32, 32)).astype(np.float32)
+        cs_row, cs_col = abft.checksums(s)
+        s[2, 2] = np.inf
+        assert abft.classify(s, cs_row, cs_col)[0] != "clean"
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    def test_bitflip_is_an_involution(self, dtype):
+        x = dtype(1.3), dtype(-271.25), dtype(3e-4)
+        for v in x:
+            f = inject.flip_exponent_bit(v)
+            assert f != v
+            assert inject.flip_exponent_bit(f) == v
+
+    def test_corrupt_bitflip_seeded_deterministic(self):
+        a = np.arange(64, dtype=np.float32).reshape(8, 8) + 1.0
+        inject.install(inject.FaultPlan(seed=42))
+        out1, ij1 = inject.corrupt_bitflip(a, "driver.update")
+        out2, ij2 = inject.corrupt_bitflip(a, "driver.update")
+        inject.clear_plan()
+        assert ij1 == ij2 and np.array_equal(out1, out2)
+
+    def test_augment_lu_layout(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        w = abft.augment_lu(a)
+        from slate_tpu.ops import vmem
+
+        cb = vmem.checksum_block_rows(np.float32)
+        assert w.shape == (4 + cb, 3 + cb)
+        np.testing.assert_allclose(w[4, :3], a.sum(axis=0))
+        np.testing.assert_allclose(w[:4, 3], a.sum(axis=1))
+        assert w[4, 3] == a.sum()
+        # pad lanes beyond the checksum lane ride as exact zeros
+        assert not w[5:, :].any() and not w[:, 4:].any()
+
+
+# ---------------------------------------------------------------------------
+# Checksum-carried composed step loops
+# ---------------------------------------------------------------------------
+
+class TestComposedLoops:
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("nb", [128, 256])
+    def test_getrf_abft_clean(self, dtype, nb):
+        n = 256
+        a = _lu_mat(n, dtype)
+        lu, perm = map(np.asarray, abft.getrf_abft(jnp.asarray(a), nb))
+        assert sorted(perm.tolist()) == list(range(n))
+        assert _lu_resid(a, lu, perm) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.checks", 0) == max(0, n // nb - 1)
+        assert "abft.detected" not in c, "clean run must not false-alarm"
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float64])
+    @pytest.mark.parametrize("nb", [128, 256])
+    def test_potrf_abft_clean(self, dtype, nb):
+        n = 256
+        a = _spd_mat(n, dtype)
+        l = np.asarray(abft.potrf_abft(jnp.asarray(a), nb))
+        assert _chol_resid(a, l) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.checks", 0) == max(0, n // nb - 1)
+        assert "abft.detected" not in c
+
+    def test_getrf_nb512_single_panel(self):
+        # nb covers the whole matrix: no trailing block, zero verifies,
+        # still the correct factorization
+        n = 512
+        a = _lu_mat(n)
+        lu, perm = map(np.asarray,
+                       abft.getrf_abft(jnp.asarray(a), 512))
+        assert _lu_resid(a, lu, perm) < 3.0
+        assert "abft.checks" not in _abft_counters()
+
+    def test_getrf_bitflip_detected_corrected_counters_exact(
+            self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        n, nb = 256, 64
+        a = _lu_mat(n)
+        clean = np.asarray(abft.getrf_abft(jnp.asarray(a), nb)[0])
+        metrics.reset()
+        metrics.on()
+        plan = inject.install(
+            inject.FaultPlan(seed=7).add("driver.update", "bitflip",
+                                         rate=1.0, count=1))
+        lu, perm = map(np.asarray, abft.getrf_abft(jnp.asarray(a), nb))
+        assert plan.fired("driver.update") == 1
+        assert _lu_resid(a, lu, perm) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.detected") == 1
+        assert c.get("abft.corrected") == 1
+        assert "abft.recomputed" not in c and "abft.restarted" not in c
+        # in-place correction restores the element to checksum
+        # roundoff, so the final factors match the clean run tightly
+        np.testing.assert_allclose(lu, clean, rtol=1e-4, atol=1e-4)
+
+    def test_potrf_bitflip_detected_corrected(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        n, nb = 256, 64
+        a = _spd_mat(n)
+        inject.install(
+            inject.FaultPlan(seed=3).add("driver.update", "bitflip",
+                                         rate=1.0, count=1))
+        l = np.asarray(abft.potrf_abft(jnp.asarray(a), nb))
+        assert _chol_resid(a, l) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.detected") == 1
+        assert c.get("abft.corrected") == 1
+
+    def test_verify_tier_counts_but_never_acts(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_ABFT", "verify")
+        n, nb = 256, 64
+        a = _lu_mat(n)
+        inject.install(
+            inject.FaultPlan(seed=7).add("driver.update", "bitflip",
+                                         rate=1.0, count=1))
+        lu, perm = map(np.asarray, abft.getrf_abft(jnp.asarray(a), nb))
+        c = _abft_counters()
+        # the uncorrected corruption propagates, so every later step's
+        # verify re-detects it — the tier counts, it never acts
+        assert c.get("abft.detected", 0) >= 1
+        assert "abft.corrected" not in c and "abft.recomputed" not in c
+
+    def test_non_spd_info_signal_not_treated_as_corruption(
+            self, monkeypatch):
+        # review finding: a non-SPD potrf input propagating NaN is the
+        # DOCUMENTED info signal (health-gate domain) — ABFT must let
+        # it flow, never burn recomputes or feed the sentinel
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        n = 128
+        a = _spd_mat(n, seed=16)
+        a[0, 0] = -1000.0                 # decisively indefinite
+        l = np.asarray(abft.potrf_abft(jnp.asarray(a), 32))
+        assert not np.isfinite(l).all(), "info signal must flow out"
+        c = _abft_counters()
+        assert "abft.detected" not in c and "abft.recomputed" not in c
+        assert c.get("abft.nonfinite_input", 0) >= 1
+
+    def test_tall_panel_rung_dispatches(self, monkeypatch):
+        # panels past XLA's fused-LU VMEM limit must take the
+        # tall-panel rungs, exactly like getrf_panels (review finding:
+        # the first cut sent them to the fused XLA panel) — pinned
+        # cheaply by shrinking the limit instead of factoring n>8192
+        monkeypatch.setattr(lu_mod, "_MAX_LU_PANEL_ROWS", 128)
+        n, nb = 256, 64
+        a = _lu_mat(n, seed=14)
+        lu, perm = map(np.asarray,
+                       abft.getrf_abft(jnp.asarray(a), nb))
+        assert _lu_resid(a, lu, perm) < 3.0
+        from slate_tpu.enums import MethodLU
+
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        lu2, perm2 = map(np.asarray, abft.getrf_guarded(
+            jnp.asarray(a), nb, MethodLU.PartialPiv))
+        assert _lu_resid(a, lu2, perm2) < 3.0
+
+    def test_health_probe_accepts_upper_factor(self, monkeypatch):
+        # review finding: the potrf health probe's uplo detection used
+        # tril(f) (diagonal included) and mis-probed Upper factors
+        from slate_tpu.resilience import health
+
+        a = _spd_mat(64, seed=15)
+        hm = st.HermitianMatrix(jnp.asarray(np.triu(a)),
+                                uplo=st.Uplo.Upper, nb=32)
+        fac = st.potrf(hm)
+        r = health._resid_potrf((hm,), {}, fac)
+        assert r < 100.0, r
+
+    def test_getrf_device_loss_restart_bitwise(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "2")
+        n, nb = 256, 64
+        a = _lu_mat(n)
+        base_lu, base_perm = map(np.asarray,
+                                 abft.getrf_abft(jnp.asarray(a), nb))
+        metrics.reset()
+        metrics.on()
+        inject.install(
+            inject.FaultPlan(seed=1).add("step.boundary", "device_loss",
+                                         rate=1.0, count=1))
+        lu, perm = map(np.asarray, abft.getrf_abft(jnp.asarray(a), nb))
+        c = _abft_counters()
+        assert c.get("abft.restarted") == 1
+        assert c.get("ckpt.restored") == 1
+        assert c.get("ckpt.saved", 0) >= 1
+        np.testing.assert_array_equal(lu, base_lu)
+        np.testing.assert_array_equal(perm, base_perm)
+
+
+# ---------------------------------------------------------------------------
+# The shipped dispatch end to end: gesv/posv with ABFT on, and the
+# scattered/fused/full envelope rungs
+# ---------------------------------------------------------------------------
+
+class TestShippedDispatch:
+
+    def test_gesv_bitflip_residual_gated(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        rng = np.random.default_rng(6)
+        n, nrhs = 256, 3
+        a = _lu_mat(n, seed=6)
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        inject.install(
+            inject.FaultPlan(seed=7).add("driver.update", "bitflip",
+                                         rate=1.0, count=1))
+        lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=64),
+                              jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, res
+        assert _abft_counters().get("abft.detected") == 1
+
+    def test_posv_bitflip_residual_gated(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        rng = np.random.default_rng(8)
+        n, nrhs = 256, 2
+        a = _spd_mat(n, seed=8)
+        b = rng.standard_normal((n, nrhs)).astype(np.float32)
+        inject.install(
+            inject.FaultPlan(seed=3).add("driver.update", "bitflip",
+                                         rate=1.0, count=1))
+        fac, x = st.posv(st.HermitianMatrix(jnp.asarray(a),
+                                            uplo=st.Uplo.Lower, nb=64),
+                         jnp.asarray(b))
+        xv = np.asarray(x)
+        eps = np.finfo(np.float32).eps
+        res = (np.linalg.norm(a @ xv - b)
+               / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))
+        assert res < 3, res
+        assert _abft_counters().get("abft.detected") == 1
+
+
+class TestEnvelopeRungs:
+    """The fused/full Pallas rungs through the SHIPPED `_getrf_partial`
+    dispatch (forced sites, interpret mode — the test_step_fused
+    pattern), wrapped by the ABFT checksum envelope."""
+
+    @pytest.fixture(autouse=True)
+    def _force(self, monkeypatch):
+        monkeypatch.setattr("slate_tpu.config.scattered_lu", True)
+        monkeypatch.setattr(lu_mod, "_SCATTERED_NB", 128)
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        autotune.reset_table()
+        yield
+        autotune.reset_table()
+
+    @pytest.mark.parametrize("depth", ["composed", "fused_trsm",
+                                       "fused", "full"])
+    def test_bitflip_detected_recomputed_every_depth(self, depth,
+                                                     monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                           "lu_step=%s" % depth)
+        autotune.reset_table()
+        n = 256
+        a = _lu_mat(n, seed=11)
+        inject.install(
+            inject.FaultPlan(seed=11).add("driver.update", "bitflip",
+                                          rate=1.0, count=1))
+        lu, perm = map(np.asarray,
+                       lu_mod._getrf_partial(jnp.asarray(a), 128))
+        assert _lu_resid(a, lu, perm) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.detected") == 1
+        assert c.get("abft.recomputed") == 1
+        assert "abft.unrecovered" not in c
+
+    def test_clean_envelope_no_false_alarm(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "lu_step=fused")
+        autotune.reset_table()
+        a = _lu_mat(256, seed=11)
+        lu, perm = map(np.asarray,
+                       lu_mod._getrf_partial(jnp.asarray(a), 128))
+        assert _lu_resid(a, lu, perm) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.checks") == 1
+        assert "abft.detected" not in c
+
+    def test_potrf_envelope_bitflip(self):
+        # the potrf envelope mechanics directly (branch says the
+        # kernel-owned path): corruption lands on the finished factor,
+        # the identity sweep detects, the invocation recomputes
+        n = 256
+        a = _spd_mat(n, seed=12)
+        from slate_tpu.ops import blocks
+
+        inject.install(
+            inject.FaultPlan(seed=13).add("driver.update", "bitflip",
+                                          rate=1.0, count=1))
+        l = np.asarray(abft.potrf_guarded(
+            jnp.asarray(a), 128, "fused",
+            lambda: jnp.tril(jax.lax.linalg.cholesky(jnp.asarray(a)))))
+        assert _chol_resid(a, l) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.detected") == 1
+        assert c.get("abft.recomputed") == 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint/restart: the generic runner and pgetrf on the CPU mesh
+# ---------------------------------------------------------------------------
+
+class TestCheckpointRunner:
+
+    def test_run_checkpointed_plain(self):
+        log = []
+
+        def chunk(carry, k0, k1):
+            log.append((k0, k1))
+            return (carry or 0) + (k1 - k0)
+
+        out = checkpoint.run_checkpointed(10, 4, chunk)
+        assert out == 10
+        assert log == [(0, 4), (4, 8), (8, 10)]
+        assert _abft_counters().get("ckpt.saved") == 2
+
+    def test_run_checkpointed_restores_on_device_loss(self):
+        log = []
+        inject.install(
+            inject.FaultPlan(seed=2).add("step.boundary", "device_loss",
+                                         rate=1.0, count=1))
+
+        def chunk(carry, k0, k1):
+            log.append((k0, k1))
+            return (carry or 0) + (k1 - k0)
+
+        out = checkpoint.run_checkpointed(10, 4, chunk)
+        assert out == 10
+        # the first chunk's result was lost at the boundary poll and
+        # recomputed from scratch
+        assert log[0] == (0, 4) and log[1] == (0, 4)
+        c = _abft_counters()
+        assert c.get("ckpt.restored") == 1
+        assert c.get("abft.restarted") == 1
+
+    def test_nontransient_failure_propagates(self):
+        def chunk(carry, k0, k1):
+            raise TypeError("programming error, never retried")
+
+        with pytest.raises(TypeError):
+            checkpoint.run_checkpointed(4, 2, chunk)
+
+    def test_restart_storm_capped(self):
+        inject.install(
+            inject.FaultPlan(seed=2).add("step.boundary",
+                                         "device_loss", rate=1.0))
+        with pytest.raises(inject.DeviceLoss):
+            checkpoint.run_checkpointed(4, 2, lambda c, a, b: 0,
+                                        max_restarts=2)
+        assert _abft_counters().get("ckpt.restored") == 2
+
+
+class TestPgetrfCheckpoint:
+
+    @pytest.fixture()
+    def operands(self, mesh8):
+        from slate_tpu.parallel import distribute
+
+        n, nb = 64, 8
+        a = _lu_mat(n, seed=0)
+        ad = distribute(jnp.asarray(a), mesh8, nb, diag_pad=1.0,
+                        row_mult=4, col_mult=2)
+        return a, ad
+
+    def test_chunked_bitwise_vs_monolithic(self, operands, monkeypatch):
+        from slate_tpu.parallel.dist_lu import pgetrf
+
+        a, ad = operands
+        lu0, gp0 = pgetrf(ad)
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "3")
+        lu1, gp1 = pgetrf(ad)
+        np.testing.assert_array_equal(np.asarray(lu1.data),
+                                      np.asarray(lu0.data))
+        np.testing.assert_array_equal(np.asarray(gp1), np.asarray(gp0))
+        assert _abft_counters().get("ckpt.saved", 0) >= 1
+
+    def test_device_loss_mid_pgetrf_resumes_bitwise(self, operands,
+                                                    monkeypatch):
+        from slate_tpu.parallel.dist_lu import pgetrf
+
+        a, ad = operands
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "3")
+        base_lu, base_gp = pgetrf(ad)
+        metrics.reset()
+        metrics.on()
+        inject.install(
+            inject.FaultPlan(seed=5).add("step.boundary", "device_loss",
+                                         rate=1.0, count=1))
+        lu, gp = pgetrf(ad)
+        c = _abft_counters()
+        assert c.get("abft.restarted") == 1
+        assert c.get("ckpt.restored") == 1
+        np.testing.assert_array_equal(np.asarray(lu.data),
+                                      np.asarray(base_lu.data))
+        np.testing.assert_array_equal(np.asarray(gp),
+                                      np.asarray(base_gp))
+
+    def test_pgetrf_abft_verify_clean_and_detects(self, operands,
+                                                  monkeypatch):
+        from slate_tpu.parallel import dist_lu
+
+        a, ad = operands
+        lu0, gp0 = dist_lu.pgetrf(ad)
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        lu1, gp1 = dist_lu.pgetrf(ad)
+        c = _abft_counters()
+        assert c.get("abft.checks") == 1
+        assert "abft.detected" not in c
+        np.testing.assert_array_equal(np.asarray(lu1.data),
+                                      np.asarray(lu0.data))
+        # corrupt one factor element -> the identity sweep detects and
+        # the envelope recomputes to the clean factors
+        bad = np.asarray(lu0.data).copy()
+        bad[3, 5] = inject.flip_exponent_bit(bad[3, 5])
+        from slate_tpu.grid import ceildiv
+        from slate_tpu.parallel.mesh import mesh_grid_shape
+
+        p, q = mesh_grid_shape(ad.mesh)
+        metrics.reset()
+        metrics.on()
+        knobs = ("xla", "maxloc", 1, 1)
+        lu2, gp2 = dist_lu._pgetrf_abft_check(
+            ad, jnp.asarray(bad), gp0, knobs,
+            ceildiv(ad.n, ad.nb), ad.mtp // p, ad.ntp // q)
+        c = _abft_counters()
+        assert c.get("abft.detected") == 1
+        assert c.get("abft.recomputed") == 1
+        np.testing.assert_array_equal(np.asarray(lu2),
+                                      np.asarray(lu0.data))
+
+    def test_ppotrf_abft_verify_clean(self, mesh8, monkeypatch):
+        from slate_tpu.parallel import distribute
+        from slate_tpu.parallel.dist_factor import ppotrf
+
+        n, nb = 64, 8
+        a = _spd_mat(n, seed=9)
+        ad = distribute(jnp.asarray(a), mesh8, nb, diag_pad=1.0,
+                        row_mult=4, col_mult=2)
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        from slate_tpu.parallel import undistribute
+
+        l = np.tril(np.asarray(undistribute(ppotrf(ad))))
+        assert _chol_resid(a, l) < 3.0
+        c = _abft_counters()
+        assert c.get("abft.checks") == 1
+        assert "abft.detected" not in c
+
+
+# ---------------------------------------------------------------------------
+# Inertness: bit-identical programs, env grammar, replay determinism
+# ---------------------------------------------------------------------------
+
+class TestInertAndDeterminism:
+
+    def test_lowering_bit_identical_with_and_without_abft(self,
+                                                          monkeypatch):
+        a = jnp.asarray(_lu_mat(128))
+
+        def lower():
+            def f(v):        # fresh function: defeat the trace cache
+                return lu_mod._getrf_partial(v, 64)
+
+            return jax.jit(f).lower(a).as_text()
+
+        base = lower()
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "2")
+        monkeypatch.setenv("SLATE_TPU_FAULT_INJECT",
+                           "driver.update=bitflip:1.0,"
+                           "step.boundary=device_loss:1.0")
+        assert lower() == base, (
+            "ABFT is host-side/eager-only: under a trace the knobs "
+            "must not change the compiled program")
+
+    def test_env_grammar_parses_new_kinds(self):
+        plan = inject.parse_plan(
+            "driver.update=bitflip:0.5:3,step.boundary=device_loss:1.0",
+            seed=9)
+        assert plan.specs["driver.update"].kind == "bitflip"
+        assert plan.specs["driver.update"].count == 3
+        assert plan.specs["step.boundary"].kind == "device_loss"
+
+    def test_unknown_kind_still_rejected(self):
+        with pytest.raises(ValueError):
+            inject.parse_plan("driver.update=gamma_ray:1.0")
+
+    def test_replay_log_deterministic(self):
+        def run(seed):
+            plan = inject.FaultPlan(seed=seed).add(
+                "driver.update", "bitflip", rate=0.5)
+            for _ in range(40):
+                plan.poll("driver.update")
+            return list(plan.log)
+
+        assert run(123) == run(123)
+        assert run(123) != run(124)
+
+    def test_device_loss_is_classified_transient(self):
+        from slate_tpu.resilience.retry import transient_infra
+
+        assert transient_infra(inject.DeviceLoss("step.boundary"))
+
+    def test_serve_device_loss_counter(self):
+        from slate_tpu.serve.queue import BatchQueue, ServeConfig
+
+        inject.install(
+            inject.FaultPlan(seed=4).add("serve.dispatch",
+                                         "device_loss", rate=1.0,
+                                         count=1))
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.001))
+        try:
+            n = 16
+            a = _spd_mat(n)
+            b = np.ones(n, np.float32)
+            x = np.asarray(srv.submit("posv", a, b).result(timeout=300))
+        finally:
+            srv.close()
+        res = (np.linalg.norm(a @ x - b)
+               / (np.linalg.norm(a) * np.linalg.norm(b)
+                  * np.finfo(np.float32).eps * n))
+        assert res < 3
+        snap = metrics.snapshot()["counters"]
+        assert snap.get("serve.device_loss") == 1
+
+
+# ---------------------------------------------------------------------------
+# Pricing + sentinel satellites: attr model, bench submetric, regress
+# ceiling
+# ---------------------------------------------------------------------------
+
+class TestModelAndSentinel:
+
+    def test_attr_checksum_rows_agree_with_vmem(self):
+        # attr.py is stdlib-only so it carries the sublane map as a
+        # literal — this pin keeps it from drifting off the one true
+        # definition in ops/vmem.py
+        from slate_tpu.ops import vmem
+
+        assert attr._CHECKSUM_ROWS == vmem._SUBLANE_ROWS
+        for isz in (4, 8):
+            assert attr._CHECKSUM_ROWS[isz] \
+                == vmem.checksum_block_rows(np.dtype("f%d" % isz))
+
+    @pytest.mark.parametrize("routine", ["getrf", "potrf"])
+    def test_stage_model_abft_reconciles_and_adds_verify(self, routine):
+        dims = {"n": 2048, "nb": 256}
+        off = attr.stage_model(routine, dims, "fp32", abft=False)
+        on = attr.stage_model(routine, dims, "fp32", abft=True)
+        total = attr.model_flops(routine, dims)
+        for stages, _ in (off, on):
+            got = sum(s["flops"] for s in stages)
+            assert abs(got - total) / total < 1e-9, (
+                "stage flops must reconcile with the model count")
+        names_on = {s["stage"] for s in on[0]}
+        assert "verify" in names_on
+        assert "verify" not in {s["stage"] for s in off[0]}
+
+    @pytest.mark.parametrize("routine", ["getrf", "potrf"])
+    def test_predict_seconds_sees_abft_overhead(self, routine):
+        dims = {"n": 4096, "nb": 512}
+        t_off = attr.predict_seconds(routine, dims, abft=False)
+        t_on = attr.predict_seconds(routine, dims, abft=True)
+        assert t_on > t_off
+        # and the env default resolves the same flag (the sweep's path)
+        os.environ["SLATE_TPU_ABFT"] = "correct"
+        try:
+            assert attr.predict_seconds(routine, dims) == t_on
+        finally:
+            os.environ.pop("SLATE_TPU_ABFT")
+
+    def test_attribute_reconciles_with_abft_env(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_ABFT", "correct")
+        rep = attr.attribute("getrf_fp32_n2048_nb256", 1000.0)
+        got = rep["total_flops"] / rep["measured_s"] / 1e9
+        assert abs(got - 1000.0) / 1000.0 < 0.01
+        assert any(s["stage"] == "verify" for s in rep["stages"])
+
+    def test_regress_direction_and_num(self):
+        assert regress.direction("getrf_fp32_n8192_abft_overhead_pct") \
+            == -1.0
+        # zero / negative overheads are measurements, not placeholders
+        assert regress._num(-0.4, "x_abft_overhead_pct") == -0.4
+        assert regress._num(0.0, "x_abft_overhead_pct") == 0.0
+
+    def test_regress_ceiling_single_artifact(self):
+        label = "getrf_fp32_n8192_nb512_abft_overhead_pct"
+        art = regress.Artifact(path="r1", name="r1",
+                               aggregate={"metric": "x"},
+                               submetrics={label: 12.5,
+                                           "getrf_fp32_n8192_nb512": 100.0})
+        rep = regress.diff([art])
+        row = next(r for r in rep.rows if r.label == label)
+        assert row.verdict == "REGRESS"
+        assert "ceiling" in row.note
+        assert rep.exit_code == 1
+
+    def test_regress_overhead_not_ratio_judged(self):
+        # review finding: a 2.0% -> 2.3% move is a "-15%" ratio in name
+        # only; the family is judged by the pinned ceiling alone
+        label = "getrf_fp32_n8192_nb512_abft_overhead_pct"
+        arts = [regress.Artifact(
+            path=nm, name=nm, aggregate={"metric": "x"},
+            submetrics={label: v, "getrf_fp32_n8192_nb512": 100.0})
+            for nm, v in (("r1", 2.0), ("r2", 2.3))]
+        rep = regress.diff(arts)
+        row = next(r for r in rep.rows if r.label == label)
+        assert row.verdict == "OK"
+        assert rep.exit_code == 0
+
+    def test_regress_ceiling_passes_under_10pct(self):
+        label = "getrf_fp32_n8192_nb512_abft_overhead_pct"
+        arts = []
+        for name, v in (("r1", 4.0), ("r2", 3.0)):
+            arts.append(regress.Artifact(
+                path=name, name=name, aggregate={"metric": "x"},
+                submetrics={label: v,
+                            "getrf_fp32_n8192_nb512": 100.0}))
+        rep = regress.diff(arts)
+        row = next(r for r in rep.rows if r.label == label)
+        assert row.verdict in ("OK", "IMPROVE")
+        assert rep.exit_code == 0
+
+    def test_bench_overhead_helper_restores_env(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("SLATE_TPU_ABFT", "verify")
+        calls = []
+        out = bench._abft_overhead_pct(lambda: calls.append(1),
+                                       reps=1)
+        assert isinstance(out, float)
+        assert os.environ["SLATE_TPU_ABFT"] == "verify"
+        assert len(calls) == 4            # (warm + 1 rep) x two modes
+
+    def test_bench_overhead_helper_none_on_failure(self):
+        import bench
+
+        def boom():
+            raise RuntimeError("driver exploded")
+
+        assert bench._abft_overhead_pct(boom) is None
+        assert "SLATE_TPU_ABFT" not in os.environ

@@ -221,6 +221,50 @@ def test_bundle_loading_inert_at_import():
         (out.stdout, out.stderr)
 
 
+def test_abft_and_checkpoint_inert_at_import():
+    """ISSUE 14 guard: with the ABFT/checkpoint knobs SET, importing
+    the package (and the driver modules that consult the layer) must
+    not load ``resilience.abft`` / ``resilience.checkpoint`` or act on
+    the knobs — the ladder engages at the first ELIGIBLE eager driver
+    call, never at import.  Subprocess, like the exporter/bundle
+    guards above."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import slate_tpu as st\n"
+        "import slate_tpu.linalg.lu\n"
+        "import slate_tpu.linalg.cholesky\n"
+        "import slate_tpu.parallel.dist_lu\n"
+        "assert 'slate_tpu.resilience.abft' not in sys.modules, \\\n"
+        "    'abft loaded at import'\n"
+        "assert 'slate_tpu.resilience.checkpoint' not in sys.modules, \\\n"
+        "    'checkpoint loaded at import'\n"
+        "from slate_tpu.resilience import abft, checkpoint\n"
+        "assert abft.mode() == 'correct'\n"
+        "assert checkpoint.every_steps() == 4\n"
+        "print('OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLATE_TPU_ABFT="correct",
+               SLATE_TPU_CKPT_EVERY_STEPS="4")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
+def test_abft_knobs_documented():
+    """The new knobs must be registered in the user-facing knob table
+    (docs/usage.md ABFT section) — an undocumented resilience knob is
+    an invisible one."""
+    docs = (_PKG.parent / "docs" / "usage.md").read_text()
+    for knob in ("SLATE_TPU_ABFT", "SLATE_TPU_ABFT_TOL",
+                 "SLATE_TPU_CKPT_EVERY_STEPS"):
+        assert knob in docs, f"{knob} missing from docs/usage.md"
+
+
 #: raw environment access in the distributed layer: every scale-out
 #: knob (panel backend, pivot strategy, broadcast chunking, lookahead
 #: depth) must resolve through ``method.select_backend`` / the autotune
