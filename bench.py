@@ -176,6 +176,35 @@ def _metrics_delta(before):
 _PLATFORM = "tpu"
 
 
+def _bb_record(kind, **fields):
+    """Bench-lifecycle seam of the flight recorder (ISSUE 15): one
+    event per routine phase so a forensic bundle names the routine the
+    process died inside.  Never allowed to kill a routine."""
+    try:
+        from slate_tpu.perf import blackbox
+
+        blackbox.record(kind, **fields)
+    except Exception:
+        pass
+
+
+def _blackbox_bundle(reason, detail=""):
+    """Dump (or point at) a flight-recorder bundle for an infra-shaped
+    failure: the trigger respects the per-process dump cap, so a late
+    failure past the cap still references the last bundle written —
+    every degraded line points at A postmortem.  Returns
+    ``{"path", "digest", "reason"}`` or None (recorder off / dump
+    failed); never raises."""
+    try:
+        from slate_tpu.perf import blackbox
+
+        if not blackbox.enabled():
+            return None
+        return blackbox.trigger(reason, detail) or blackbox.last_bundle()
+    except Exception:
+        return None
+
+
 def _bundle_tag():
     """The active offline autotune bundle's identity (version/digest —
     ``SLATE_TPU_AUTOTUNE_BUNDLE``, slate_tpu/perf/sweep.py) or None:
@@ -478,7 +507,8 @@ def _stage_delta(label, stage_map, before):
             for k in stage_map}
 
 
-def _partial_aggregate(sub, fails, infra, attribution=None):
+def _partial_aggregate(sub, fails, infra, attribution=None,
+                       blackbox_bundles=None):
     """The aggregate line's load-bearing fields from whatever completed
     so far — emitted by the hard watchdog so a hard hang still ends the
     artifact with a parseable LAST-line aggregate (the tail-reader
@@ -505,6 +535,8 @@ def _partial_aggregate(sub, fails, infra, attribution=None):
     }
     if attribution:
         out["attribution"] = dict(attribution)
+    if blackbox_bundles:
+        out["blackbox_bundles"] = list(blackbox_bundles)
     return out
 
 
@@ -653,7 +685,7 @@ def _abft_overhead_pct(run_eager, reps: int = 2):
 
 
 def _run_routine(name, fn, sub, fails, infra, deadline=None,
-                 attr_sink=None):
+                 attr_sink=None, bb_sink=None):
     """Run one routine under its own watchdog with a bounded infra-error
     retry count; classify failures.
 
@@ -682,16 +714,23 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
     def _on_hard_hang():
         # snap_before rebinds per attempt: the hard-hang line's delta
         # covers only the attempt that hung
-        print(json.dumps({"routine": name,
-                          "error": "infra: hard-hung in a blocking C "
-                                   "call past the SIGALRM deadline",
-                          "autotune": _autotune_tags(keys_before),
-                          "bundle": _bundle_tag(),
-                          "metrics": _metrics_delta(snap_before)}),
-              flush=True)
+        bb = _blackbox_bundle("bench.watchdog",
+                              f"{name}: hard-hung in a blocking C call")
+        line = {"routine": name,
+                "error": "infra: hard-hung in a blocking C "
+                         "call past the SIGALRM deadline",
+                "autotune": _autotune_tags(keys_before),
+                "bundle": _bundle_tag(),
+                "metrics": _metrics_delta(snap_before)}
+        if bb:
+            line["blackbox"] = bb
+            if bb_sink is not None:
+                bb_sink.append(dict(bb, routine=name))
+        print(json.dumps(line), flush=True)
         print(json.dumps(_partial_aggregate(
             sub, fails, infra + [f"{name}: hard-hung"],
-            attribution=attr_sink)), flush=True)
+            attribution=attr_sink, blackbox_bundles=bb_sink)),
+            flush=True)
 
     for attempt in range(2):
         try:
@@ -699,6 +738,8 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
                 snap_before = _metrics_snapshot()   # failed attempt's
             from slate_tpu.resilience import inject as _inj
 
+            _bb_record("bench.routine", name=name, phase="start",
+                       attempt=attempt)
             # chaos seam: an injected routine-startup fault takes the
             # same classified-infra retry path a real one would
             _inj.fault_here("bench.startup")
@@ -709,6 +750,8 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
             delta = _metrics_delta(snap_before)
             if resid > 3.0:
                 fails.append(f"{name}: scaled_resid={resid:.3e} > 3")
+                _bb_record("bench.routine", name=name,
+                           phase="residual_fail", resid=float(resid))
                 print(json.dumps({"routine": name, "label": label,
                                   "error": "residual_gate",
                                   "scaled_resid": float(resid),
@@ -762,23 +805,40 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
             if len(out) > 3:
                 line.update(out[3])
             print(json.dumps(line), flush=True)
+            _bb_record("bench.routine", name=name, phase="ok",
+                       label=label)
             return gf
         except _RoutineTimeout as e:  # hung kernel: no retry, move on
             last_err = e
+            _bb_record("bench.routine", name=name, phase="deadline")
             print(f"# {name} hit its routine deadline: {e}", file=sys.stderr)
             break
         except Exception as e:  # infra: tunnel RPC, OOM, compile, ...
             last_err = e
+            _bb_record("bench.routine", name=name, phase="infra_error",
+                       error=type(e).__name__)
             traceback.print_exc(file=sys.stderr)
             print(f"# retry {name} after infra error (attempt {attempt})",
                   file=sys.stderr)
     infra.append(f"{name}: {type(last_err).__name__}: {last_err}")
-    print(json.dumps({"routine": name,
-                      "error": f"infra: {type(last_err).__name__}: {last_err}",
-                      "autotune": _autotune_tags(keys_before),
-                      "bundle": _bundle_tag(),
-                      "metrics": _metrics_delta(snap_before)}),
-          flush=True)
+    # the flight-recorder postmortem rides the flushed infra line: a
+    # degraded artifact points at its own bundle (path + digest), and
+    # the aggregate collects them so the regression sentinel can
+    # surface each as a NOTE row
+    bb = _blackbox_bundle(
+        "bench.watchdog" if isinstance(last_err, _RoutineTimeout)
+        else "bench.infra",
+        f"{name}: {type(last_err).__name__}: {last_err}")
+    line = {"routine": name,
+            "error": f"infra: {type(last_err).__name__}: {last_err}",
+            "autotune": _autotune_tags(keys_before),
+            "bundle": _bundle_tag(),
+            "metrics": _metrics_delta(snap_before)}
+    if bb:
+        line["blackbox"] = bb
+        if bb_sink is not None:
+            bb_sink.append(dict(bb, routine=name))
+    print(json.dumps(line), flush=True)
     return None
 
 
@@ -837,11 +897,15 @@ def main():
     fails = []   # residual-gate failures → exit 1 (after printing JSON)
     infra = []   # infrastructure failures → recorded, exit stays 0
     attr_map = {}   # label -> roofline attribution block (aggregate)
+    bb_sink = []    # flight-recorder bundles attached to infra lines
 
     # the bench run is an observability harness: turn the metrics
     # registry on (host-side counters only — it never changes the
     # compiled programs) so every JSON line carries the snapshot;
-    # SLATE_TPU_METRICS=0 opts out
+    # SLATE_TPU_METRICS=0 opts out.  The flight recorder rides along
+    # under the same contract (SLATE_TPU_BLACKBOX=0 opts out) so an
+    # infra-classified failure, watchdog timeout or SIGTERM flush can
+    # attach its forensic bundle to the flushed JSON line.
     try:
         from slate_tpu.perf import metrics as _metrics_mod
 
@@ -850,18 +914,32 @@ def main():
             _metrics_mod.on()
     except Exception:
         pass
+    try:
+        if os.environ.get("SLATE_TPU_BLACKBOX", "").strip().lower() \
+                not in ("0", "false", "off", "no"):
+            from slate_tpu.perf import blackbox as _blackbox_mod
+
+            _blackbox_mod.on()
+    except Exception:
+        pass
 
     # an outer `timeout` sends SIGTERM before SIGKILL: flush the
     # aggregate LAST line with whatever completed so the artifact stays
     # parseable (the other half of the BENCH_r05 root cause — the suite
     # died with every number buffered behind one final print)
     def _on_sigterm(signum, frame):
-        print(json.dumps({"routine": "_suite",
-                          "error": "infra: SIGTERM before completion"}),
-              flush=True)
+        bb = _blackbox_bundle("bench.sigterm",
+                              "SIGTERM before suite completion")
+        line = {"routine": "_suite",
+                "error": "infra: SIGTERM before completion"}
+        if bb:
+            line["blackbox"] = bb
+            bb_sink.append(dict(bb, routine="_suite"))
+        print(json.dumps(line), flush=True)
         print(json.dumps(_partial_aggregate(
             sub, fails, infra + ["suite: SIGTERM"],
-            attribution=attr_map)), flush=True)
+            attribution=attr_map, blackbox_bundles=bb_sink)),
+            flush=True)
         os._exit(0)
 
     if hasattr(signal, "SIGTERM"):
@@ -1268,7 +1346,8 @@ def main():
             deadline = max(MIN_DEADLINE_S, min(ROUTINE_TIMEOUT_S, per))
         results[name] = _run_routine(name, fn, sub, fails, infra,
                                      deadline=deadline,
-                                     attr_sink=attr_map)
+                                     attr_sink=attr_map,
+                                     bb_sink=bb_sink)
     gemm_gf = results.get("gemm")
 
     # headline geomean: fp32 factor suite ONLY (the metric BENCH_r01-r03
@@ -1335,6 +1414,10 @@ def main():
     pa = _probes_avoided(out["metrics"])
     if pa:
         out["probes_avoided"] = pa
+    if bb_sink:
+        # each degraded routine's forensic bundle (path + digest) —
+        # regress.py/tools/bench_diff.py surface these as NOTE rows
+        out["blackbox_bundles"] = list(bb_sink)
     # regression tripwire (r4 lesson: geqrf silently lost 20% between
     # rounds): compare every submetric against the newest BENCH_r*.json
     # in the repo root and flag drops > 5%.  The offline/multi-artifact

@@ -170,6 +170,114 @@ def full_fused_smoke() -> int:
     return 1
 
 
+def blackbox_smoke() -> int:
+    """The --blackbox fast tier (ISSUE 15): run a distributed pgesv on
+    a virtual CPU mesh with the flight recorder on, a 2-step checkpoint
+    cadence, and ONE injected ``device_loss`` at a step boundary.  The
+    loss rewinds one chunk (the run still residual-gates clean) and the
+    recorder dumps EXACTLY ONE forensic bundle whose event tail names
+    the checkpoint-restore rung; the stdlib ``tools/blackbox.py`` CLI
+    then renders it — on a jax-poisoned path, like the other CLIs —
+    and exits 0."""
+    import glob as _glob
+    import json
+    import tempfile
+
+    here = pathlib.Path(__file__).resolve().parent
+    code = (
+        "import numpy as np\n"
+        "from slate_tpu.parallel import make_grid_mesh, pgesv, "
+        "undistribute\n"
+        "mesh = make_grid_mesh(2, 2)\n"
+        "rng = np.random.default_rng(0)\n"
+        "n, nb = 32, 4\n"
+        "a = rng.standard_normal((n, n)).astype(np.float32) "
+        "+ n * np.eye(n, dtype=np.float32)\n"
+        "b = rng.standard_normal((n, 4)).astype(np.float32)\n"
+        "_, _, x = pgesv(a, b, mesh, nb)\n"
+        "xh = np.asarray(undistribute(x))\n"
+        "res = np.linalg.norm(a @ xh - b) / (np.linalg.norm(a) "
+        "* np.linalg.norm(xh) + np.linalg.norm(b))\n"
+        "assert res < 1e-3, res\n"
+        "print('BLACKBOX-RUN-OK')\n")
+    with tempfile.TemporaryDirectory() as td:
+        bdir = os.path.join(td, "bundles")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=4",
+                   SLATE_TPU_BLACKBOX="1",
+                   SLATE_TPU_BLACKBOX_DIR=bdir,
+                   SLATE_TPU_CKPT_EVERY_STEPS="2",
+                   SLATE_TPU_FAULT_INJECT="step.boundary="
+                                          "device_loss:1:1",
+                   SLATE_TPU_FAULT_SEED="7")
+        env.pop("SLATE_TPU_DIST_TIMELINE", None)
+        print("=== blackbox tier: SLATE_TPU_FAULT_INJECT="
+              + env["SLATE_TPU_FAULT_INJECT"], flush=True)
+        try:
+            r = subprocess.run([sys.executable, "-c", code], env=env,
+                               cwd=str(here), capture_output=True,
+                               text=True, timeout=900)
+        except subprocess.TimeoutExpired:
+            print("==== blackbox smoke FAILED (timeout) ====")
+            return 1
+        checks = {"chaos run survived the device loss":
+                  r.returncode == 0 and "BLACKBOX-RUN-OK" in r.stdout}
+        if not checks["chaos run survived the device loss"]:
+            print(r.stdout)
+            print(r.stderr)
+        bundles = sorted(_glob.glob(
+            os.path.join(bdir, "slate_tpu_blackbox_*.json")))
+        checks["exactly one bundle dumped"] = len(bundles) == 1
+        if bundles:
+            with open(bundles[0]) as f:
+                blob = json.load(f)
+            kinds = [e.get("kind") for e in blob.get("events", [])]
+            checks["trigger reason is device_loss"] = \
+                blob.get("trigger", {}).get("reason") == "device_loss"
+            checks["event tail names the checkpoint-restore rung"] = \
+                any(k in ("ckpt.restored", "abft.restarted")
+                    for k in kinds[-8:])
+            checks["ring saw the injected fault firing"] = \
+                "inject.fired" in kinds
+            # the CLI must render the bundle on a jax-free machine
+            poison = os.path.join(td, "poison", "jax")
+            os.makedirs(poison, exist_ok=True)
+            with open(os.path.join(poison, "__init__.py"), "w") as f:
+                f.write("raise ImportError('jax poisoned for CLI "
+                        "test')\n")
+            env2 = dict(os.environ,
+                        PYTHONPATH=os.path.dirname(poison) + os.pathsep
+                        + os.environ.get("PYTHONPATH", ""))
+            c = subprocess.run(
+                [sys.executable, str(here / "tools" / "blackbox.py"),
+                 bundles[0]], env=env2, capture_output=True, text=True,
+                timeout=300)
+            checks["CLI renders the bundle (rc 0)"] = \
+                c.returncode == 0 and "device_loss" in c.stdout \
+                and "ckpt.restored" in c.stdout
+            cj = subprocess.run(
+                [sys.executable, str(here / "tools" / "blackbox.py"),
+                 bundles[0], "--json", "--strict"], env=env2,
+                capture_output=True, text=True, timeout=300)
+            ok_json = False
+            try:
+                ok_json = json.loads(cj.stdout)["trigger"]["reason"] \
+                    == "device_loss"
+            except (ValueError, KeyError, TypeError):
+                pass
+            # the loss was RECOVERED: --strict must stay green
+            checks["--json --strict parses and exits 0"] = \
+                cj.returncode == 0 and ok_json
+        for name, ok in checks.items():
+            print("  %s: %s" % (name, "ok" if ok else "FAIL"),
+                  flush=True)
+        if all(checks.values()):
+            print("==== blackbox smoke passed ====")
+            return 0
+        print("==== blackbox smoke FAILED ====")
+        return 1
+
+
 def sweep_smoke() -> int:
     """The --sweep tier: tiny CPU grid end-to-end through the CLI in a
     subprocess (sweep → versioned bundle artifact), then a second fresh
@@ -294,6 +402,13 @@ def main(argv=None):
                     "fresh process from the bundle and assert the "
                     "zero-probe/zero-compile start (see docs/usage.md "
                     "Offline autotune & bundles)")
+    ap.add_argument("--blackbox", action="store_true",
+                    help="flight-recorder smoke: inject a device_loss "
+                    "mid-pgetrf with the recorder on, assert exactly "
+                    "one forensic bundle whose event tail names the "
+                    "checkpoint-restore rung, and render it with the "
+                    "stdlib tools/blackbox.py CLI (see docs/usage.md "
+                    "Flight recorder & forensics)")
     ap.add_argument("--full-fused", action="store_true",
                     help="whole-factorization smoke: force "
                     "SLATE_TPU_AUTOTUNE_FORCE=lu_step=full,"
@@ -305,6 +420,9 @@ def main(argv=None):
 
     if args.telemetry:
         return telemetry_smoke()
+
+    if args.blackbox:
+        return blackbox_smoke()
 
     if args.sweep:
         return sweep_smoke()

@@ -31,18 +31,19 @@ global block ``i = il*p + r`` (see ``dist.py``).
 from __future__ import annotations
 
 from functools import lru_cache
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from .._jax_compat import shard_map
+from .._jax_compat import pvary, shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
 from ..ops.blocks import matmul as _mm
 from .dist import DistMatrix, distribute, like, undistribute
-from .dist_util import (bcast_block_col, bcast_block_row, local_grows,
-                        stage_bounds, staged_fori)
+from .dist_util import (_range_bounds, bcast_block_col, bcast_block_row,
+                        local_grows, stage_bounds, staged_fori)
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
@@ -53,12 +54,22 @@ def _conj(a, conj: bool):
 @lru_cache(maxsize=None)
 def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                   panel_backend: str = "xla", depth: int = 1,
-                  chunks: int = 1):
+                  chunks: int = 1, k_lo: int = 0,
+                  k_hi: Optional[int] = None, carry_in: bool = False,
+                  carry_out: bool = False):
+    """``k_lo``/``k_hi``/``carry_in``/``carry_out`` carve the step loop
+    into resumable chunks exactly like :func:`.dist_lu._build_pgetrf`:
+    the chunk re-uses the SAME staged window boundaries
+    (``_range_bounds``) and carries the in-flight lookahead panel ring
+    between chunks, so chunked execution reproduces the monolithic
+    factor bitwise — the contract the ``SLATE_TPU_DIST_TIMELINE``
+    measured runner leans on."""
     p, q = mesh_grid_shape(mesh)
     conj = "complex" in dtype_name
     mtp = p * ml
     M = mtp * nb
-    bounds = stage_bounds(nt)
+    k_hi = nt if k_hi is None else int(k_hi)
+    bounds = _range_bounds(stage_bounds(nt), int(k_lo), k_hi)
     depth = max(1, min(int(depth), max(1, nt)))
 
     def _panel_factor(d, panel):
@@ -88,7 +99,7 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
             transpose_a=True, conjugate_a=conj)
         return l11, x
 
-    def kernel(a_loc):
+    def kernel_core(a_loc, ring_c):
         r = lax.axis_index(AXIS_P)
         c = lax.axis_index(AXIS_Q)
         dt = a_loc.dtype
@@ -165,14 +176,39 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
 
             return body
 
-        ring0 = tuple(
-            bcast_block_col(getcol(a_loc, j), grows, j % q == c, M,
-                            chunks=chunks) for j in range(depth))
-        return staged_fori(bounds, p, q, nb, make_body,
-                           (a_loc, ring0))[0]
+        if ring_c is not None:
+            # resumed chunk: the in-flight panel ring arrives
+            # replicated from the previous chunk's outputs
+            ring0 = tuple(pvary(rj, (AXIS_P, AXIS_Q)) for rj in ring_c)
+        else:
+            ring0 = tuple(
+                bcast_block_col(getcol(a_loc, k_lo + j), grows,
+                                (k_lo + j) % q == c, M, chunks=chunks)
+                for j in range(depth))
+        a_loc, ring = staged_fori(bounds, p, q, nb, make_body,
+                                  (a_loc, ring0))
+        if carry_out:
+            # the ring is value-replicated (every entry is a psum
+            # result or a correction of one); pmax makes that visible
+            # to the type system for the P() out-spec
+            ring = tuple(lax.pmax(lax.pmax(rj, AXIS_P), AXIS_Q)
+                         for rj in ring)
+            return (a_loc,) + ring
+        return a_loc
 
-    fn = shard_map(kernel, mesh=mesh, in_specs=(P(AXIS_P, AXIS_Q),),
-                   out_specs=P(AXIS_P, AXIS_Q))
+    if carry_in:
+        def kernel(a_loc, *ring_c):
+            return kernel_core(a_loc, ring_c)
+        in_specs = (P(AXIS_P, AXIS_Q),) + (P(),) * depth
+    else:
+        def kernel(a_loc):
+            return kernel_core(a_loc, None)
+        in_specs = (P(AXIS_P, AXIS_Q),)
+    out_specs = P(AXIS_P, AXIS_Q)
+    if carry_out:
+        out_specs = (P(AXIS_P, AXIS_Q),) + (P(),) * depth
+    fn = shard_map(kernel, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs)
     return jax.jit(fn)
 
 
@@ -198,23 +234,48 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
     nt = ceildiv(a.n, a.nb)
     # the scale-out knobs resolve through autotune BEFORE the lru_cached
     # shard_map build (part of the build key; see pgetrf)
-    fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
-                       dist_panel_backend("potrf", a.nb, a.dtype,
-                                          m=a.mtp * a.nb),
-                       dist_lookahead_depth("potrf", nt, a.nb, a.dtype),
-                       dist_chunk_slices("potrf", a.nb, a.dtype, a.mesh))
-    return like(a, _ppotrf_abft_check(a, fn))
+    knobs = (dist_panel_backend("potrf", a.nb, a.dtype,
+                                m=a.mtp * a.nb),
+             dist_lookahead_depth("potrf", nt, a.nb, a.dtype),
+             dist_chunk_slices("potrf", a.nb, a.dtype, a.mesh))
+    from ..perf import blackbox
+
+    def run():
+        return _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                             *knobs)(a.data)
+
+    if blackbox.timeline_wanted() and nt > 1:
+        # measured step timeline (SLATE_TPU_DIST_TIMELINE): the same
+        # staged bodies driven one step-window at a time through the
+        # chunked builder, per-step walls + collective byte deltas
+        # recorded (see dist_lu.pgetrf) — bitwise-identical factors
+        from .dist_util import run_timeline
+
+        def run_chunk(carry, k0, k1):
+            if carry is None:
+                fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl,
+                                   str(a.dtype), *knobs, 0, k1,
+                                   False, True)
+                return fn(a.data)
+            fn = _build_ppotrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                               *knobs, k0, k1, True, True)
+            return fn(carry[0], *carry[1:])
+
+        out = run_timeline("ppotrf", nt, blackbox.timeline_window(),
+                           run_chunk)[0]
+    else:
+        out = run()
+    return like(a, _ppotrf_abft_check(a, run, out))
 
 
-def _ppotrf_abft_check(a: DistMatrix, fn):
+def _ppotrf_abft_check(a: DistMatrix, run, out):
     """ABFT envelope for the distributed Cholesky (ISSUE 14): with
     ``SLATE_TPU_ABFT`` on, verify ``(eᵀL)·Lᴴ = eᵀA`` over the padded
-    natural-order operands after the run and recompute once on a
-    detection; off (default) this is one env read around the build's
-    single invocation."""
+    natural-order operands after the run and recompute once (via
+    ``run``) on a detection; off (default) this is one env read around
+    the already-computed ``out``."""
     from ..resilience import abft as _abft
 
-    out = fn(a.data)
     if not _abft.enabled():
         return out
     import numpy as np
@@ -231,8 +292,7 @@ def _ppotrf_abft_check(a: DistMatrix, fn):
         return _abft.verify_chol_factors(
             cs_row0, np.tril(_natural_padded(a, o)))
 
-    return _abft._envelope("ppotrf", lambda: fn(a.data),
-                           lambda o: o, verify, out=out)
+    return _abft._envelope("ppotrf", run, lambda o: o, verify, out=out)
 
 
 @lru_cache(maxsize=None)

@@ -57,7 +57,8 @@ from jax.sharding import PartitionSpec as P
 from ..grid import ceildiv
 from ..ops.blocks import matmul as _mm
 from .dist import DistMatrix, distribute, like, undistribute
-from .dist_util import bcast_block_col, local_grows, stage_bounds, staged_fori
+from .dist_util import (_range_bounds, bcast_block_col, local_grows,
+                        stage_bounds, staged_fori)
 from .mesh import AXIS_P, AXIS_Q, mesh_grid_shape
 
 
@@ -207,15 +208,6 @@ def _maxloc_lu_panel(a, vma=()):
 
     a, pos, piv = lax.fori_loop(0, nb, body, (a, pos0, piv0))
     return a, piv, pos
-
-
-def _range_bounds(bounds, lo: int, hi: int):
-    """Clip the staged-window bounds to a step sub-range [lo, hi): the
-    chunked (checkpointed) runner re-uses the SAME stage boundaries the
-    monolithic driver jits, so cadence-aligned chunks execute the
-    identical (step, window) sequence — the bitwise-resume contract."""
-    inner = [b for b in bounds if lo < b < hi]
-    return [lo] + inner + [hi]
 
 
 @lru_cache(maxsize=None)
@@ -515,7 +507,18 @@ def pgetrf(a: DistMatrix):
              dist_pivot_backend(a.nb, p, a.dtype),
              dist_lookahead_depth("getrf", nt, a.nb, a.dtype),
              dist_chunk_slices("getrf", a.nb, a.dtype, a.mesh))
+    from ..perf import blackbox
     from ..resilience import checkpoint as _ckpt
+
+    def run_chunk(carry, k0, k1):
+        if carry is None:
+            fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl,
+                               str(a.dtype), *knobs, 0, k1,
+                               False, True)
+            return fn(a.data)
+        fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
+                           *knobs, k0, k1, True, True)
+        return fn(carry[0], carry[1], *carry[2:])
 
     every = _ckpt.every_steps()
     if 0 < every < nt:
@@ -524,18 +527,21 @@ def pgetrf(a: DistMatrix):
         # carry (local trailing window + pivot vector + lookahead
         # panel ring) at each boundary — an injected device_loss (or a
         # real transient failure) rewinds one chunk instead of the run
-        def run_chunk(carry, k0, k1):
-            if carry is None:
-                fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl,
-                                   str(a.dtype), *knobs, 0, k1,
-                                   False, True)
-                return fn(a.data)
-            fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),
-                               *knobs, k0, k1, True, True)
-            return fn(carry[0], carry[1], *carry[2:])
-
         out = _ckpt.run_checkpointed(nt, every, run_chunk,
                                      label="pgetrf")
+        lu_data, gperm = out[0], out[1]
+    elif blackbox.timeline_wanted() and nt > 1:
+        # measured step timeline (SLATE_TPU_DIST_TIMELINE): the same
+        # chunked step-window machinery, driven one window at a time
+        # with per-step host walls + collective byte deltas recorded —
+        # the measured compute signal overlap_summary feeds the
+        # MULTICHIP overlap blocks with (checkpointing, when also
+        # configured with a cadence, takes precedence: resilience
+        # over observability)
+        from .dist_util import run_timeline
+
+        out = run_timeline("pgetrf", nt, blackbox.timeline_window(),
+                           run_chunk)
         lu_data, gperm = out[0], out[1]
     else:
         fn = _build_pgetrf(a.mesh, a.nb, nt, ml, nl, str(a.dtype),

@@ -231,9 +231,77 @@ def bcast_block_row(row_loc, gcols, own, N: int, chunks: int = 1):
     return _inject_bcast(jnp.concatenate(parts, axis=0))
 
 
+#: measured per-step rows of the most recent timeline-chunked dist run
+#: (:func:`run_timeline`) — the measured compute signal
+#: :func:`overlap_summary` prefers over any modeled budget.  Module
+#: state, reset at each run's end; :func:`clear_timeline` for tests.
+_timeline_steps: list = []
+
+
+def timeline_steps() -> list:
+    """Copies of the most recent timeline run's per-step rows
+    (``{"driver", "k0", "k1", "wall_s", "bcast_bytes",
+    "bcast_count"}``); empty when no ``SLATE_TPU_DIST_TIMELINE`` run
+    has happened in this process."""
+    return [dict(r) for r in _timeline_steps]
+
+
+def clear_timeline() -> None:
+    del _timeline_steps[:]
+
+
+def run_timeline(driver: str, nt: int, window: int, run_chunk):
+    """Drive ``run_chunk(carry, k0, k1)`` over ``[0, nt)`` one
+    ``window``-step chunk at a time, MEASURING each chunk: host wall
+    (synced — ``jax.block_until_ready`` on the carry), the window's
+    collective byte/count deltas off the metrics registry, a
+    ``dist.step.<driver>`` timer, a ``trace.Block`` span on the
+    existing Perfetto clock, and a ``dist.step`` flight-recorder event.
+    The chunk bodies are the SAME staged step programs the monolithic
+    driver jits (``_range_bounds``), so the factors are bitwise
+    identical — the timeline costs chunked dispatch, never numerics.
+    Returns the final carry; the per-step rows land in
+    :func:`timeline_steps`."""
+    import time as _time
+
+    from .. import trace as _trace
+    from ..perf import blackbox
+
+    window = max(1, int(window))
+    steps = []
+    carry = None
+    k = 0
+    while k < nt:
+        k1 = min(k + window, nt)
+        before = metrics.snapshot()
+        t0 = _time.perf_counter()
+        with _trace.Block("dist.%s.k%d" % (driver, k)):
+            carry = run_chunk(carry, k, k1)
+            jax.block_until_ready(carry)
+        wall = _time.perf_counter() - t0
+        delta = metrics.snapshot_delta(before, metrics.snapshot())
+        c = delta.get("counters") or {}
+        row = {"driver": driver, "k0": int(k), "k1": int(k1),
+               "wall_s": wall,
+               "bcast_bytes": float(
+                   c.get("collective.bcast_col.bytes", 0.0)
+                   + c.get("collective.bcast_row.bytes", 0.0)),
+               "bcast_count": float(
+                   c.get("collective.bcast_col.count", 0.0)
+                   + c.get("collective.bcast_row.count", 0.0))}
+        steps.append(row)
+        metrics.observe_time("dist.step.%s" % driver, wall)
+        blackbox.record("dist.step", **row)
+        k = k1
+    _timeline_steps[:] = steps
+    return carry
+
+
 def overlap_summary(n_devices: Optional[int] = None,
                     compute_s: Optional[float] = None,
-                    platform: Optional[str] = None) -> dict:
+                    platform: Optional[str] = None,
+                    window: Optional[dict] = None,
+                    measured_steps: Optional[list] = None) -> dict:
     """Per-device exposed-vs-overlapped collective accounting from the
     registry's ``collective.bcast_*`` counters — the block the
     MULTICHIP artifacts carry so ROADMAP item 3's scaling curve reads
@@ -243,16 +311,38 @@ def overlap_summary(n_devices: Optional[int] = None,
     time (multiply by trip counts upstream if you profiled one body);
     the time model prices them at the attribution engine's ICI peak
     (``slate_tpu/perf/attr.py``, ``SLATE_TPU_PEAK_ICI_GBS``-
-    overridable).  ``compute_s`` is the overlap budget — the MXU work
-    the lookahead pipeline can hide collectives under; when omitted it
-    is taken from the registry's ``driver.*`` / ``step.*`` / ``chase.*``
-    timer totals, and with no such signal the collectives are
-    conservatively reported fully exposed (efficiency 0, not a flattering
-    guess)."""
+    overridable).
+
+    ``window`` is an optional :func:`slate_tpu.perf.metrics.
+    snapshot_delta` (or snapshot) dict to read counters/timers from
+    instead of the live registry — a long-lived process accumulates
+    counters across every run it ever made, so a lifetime snapshot
+    inflates a later run's overlap budget with earlier runs' timers;
+    the dryrun children window each measurement (regression-tested in
+    ``tests/test_multichip_schema.py``).
+
+    The overlap budget ``compute_s`` — the MXU work the lookahead
+    pipeline can hide collectives under — resolves down a ladder (the
+    block's ``compute_source`` names the rung taken):
+
+    1. ``"measured_steps"`` — the ``measured_steps`` rows the CALLER
+       passes (a ``SLATE_TPU_DIST_TIMELINE`` run's per-step host
+       walls, fetched via :func:`timeline_steps` right after the
+       measured run — explicit by design: the rows are module state
+       from the LAST timeline run, and only the caller knows whether
+       they belong to this block's window); the rows ride the block so
+       the exposed-vs-overlapped split is an observation, not a
+       roofline guess;
+    2. ``"explicit"`` — the caller's ``compute_s``;
+    3. ``"timers"`` — the (window's) ``driver.*`` / ``step.*`` /
+       ``chase.*`` / ``dist.step.*`` timer totals;
+    4. ``"none"`` — no signal: the collectives are conservatively
+       reported fully exposed (efficiency 0, not a flattering guess).
+    """
     from ..perf import attr
 
-    snap = metrics.snapshot()
-    counters = snap.get("counters", {})
+    snap = window if window is not None else metrics.snapshot()
+    counters = snap.get("counters", {}) or {}
     nbytes = (counters.get("collective.bcast_col.bytes", 0.0)
               + counters.get("collective.bcast_row.bytes", 0.0))
     count = (counters.get("collective.bcast_col.count", 0.0)
@@ -263,10 +353,19 @@ def overlap_summary(n_devices: Optional[int] = None,
         platform = "tpu" if jax.default_backend() == "tpu" else "cpu"
     pk = attr.peaks(platform, "fp32")
     coll_s = nbytes / (pk["ici_gbs"] * 1e9) / max(1, n_devices)
-    if compute_s is None:
+    measured = [dict(r) for r in measured_steps] if measured_steps \
+        else []
+    if measured:
+        compute_s = sum(float(r.get("wall_s", 0.0)) for r in measured)
+        source = "measured_steps"
+    elif compute_s is not None:
+        source = "explicit"
+    else:
         compute_s = sum(
-            t.get("total_s", 0.0) for k, t in snap.get("timers", {}).items()
-            if k.startswith(("driver.", "step.", "chase.")))
+            t.get("total_s", 0.0)
+            for k, t in (snap.get("timers", {}) or {}).items()
+            if k.startswith(("driver.", "step.", "chase.", "dist.step.")))
+        source = "timers" if compute_s > 0 else "none"
     overlapped = min(coll_s, float(compute_s))
     exposed = coll_s - overlapped
     eff = (overlapped / coll_s) if coll_s > 0 else 1.0
@@ -279,16 +378,24 @@ def overlap_summary(n_devices: Optional[int] = None,
                    "exposed_collective_s": exposed,
                    "overlap_efficiency": eff}
                   for i in range(nd)]
-    return {"n_devices": nd,
-            "platform": platform,
-            "ici_gbs": pk["ici_gbs"],
-            "collective_count": count,
-            "collective_bytes": nbytes,
-            "collective_min_s": coll_s,
-            "overlapped_collective_s": overlapped,
-            "exposed_collective_s": exposed,
-            "overlap_efficiency": eff,
-            "per_device": per_device}
+    out = {"n_devices": nd,
+           "platform": platform,
+           "ici_gbs": pk["ici_gbs"],
+           "collective_count": count,
+           "collective_bytes": nbytes,
+           "collective_min_s": coll_s,
+           "overlapped_collective_s": overlapped,
+           "exposed_collective_s": exposed,
+           "overlap_efficiency": eff,
+           "compute_s": float(compute_s),
+           "compute_source": source,
+           "per_device": per_device}
+    if measured:
+        out["measured_steps"] = {
+            "count": len(measured),
+            "wall_s_total": float(compute_s),
+            "per_step": measured}
+    return out
 
 
 def scaling_curve(points, floor: float = 0.01) -> dict:
@@ -347,6 +454,16 @@ def stage_bounds(nt: int, nstages: int = 4):
 
     s = max(1, min(nstages, nt))
     return [round(i * nt / s) for i in range(s + 1)]
+
+
+def _range_bounds(bounds, lo: int, hi: int):
+    """Clip the staged-window bounds to a step sub-range [lo, hi): the
+    chunked (checkpointed / timeline-measured) runners re-use the SAME
+    stage boundaries the monolithic drivers jit, so cadence-aligned
+    chunks execute the identical (step, window) sequence — the
+    bitwise-resume contract."""
+    inner = [b for b in bounds if lo < b < hi]
+    return [lo] + inner + [hi]
 
 
 def staged_fori(bounds, p: int, q: int, nb: int, make_body, carry):

@@ -17,7 +17,7 @@ from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
 
 __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
-    "attr", "autotune", "collective_byte_census", "metrics",
+    "attr", "autotune", "blackbox", "collective_byte_census", "metrics",
     "profile_fn", "profile_hlo_text", "regress",
     "stablehlo_collective_shapes", "sweep", "telemetry",
 ]
@@ -27,8 +27,8 @@ def __getattr__(name):
     # lazy: autotune pulls in jax.random/pallas bits only when used;
     # attr/metrics/regress/sweep/telemetry stay stdlib-light and import
     # on demand
-    if name in ("attr", "autotune", "metrics", "regress", "sweep",
-                "telemetry"):
+    if name in ("attr", "autotune", "blackbox", "metrics", "regress",
+                "sweep", "telemetry"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -63,7 +63,7 @@ import time
 from contextlib import contextmanager
 from typing import Any, Callable, NamedTuple, Optional
 
-from . import metrics
+from . import blackbox, metrics
 from . import sweep as _sweep
 
 __all__ = [
@@ -426,6 +426,13 @@ class AutotuneTable:
                 self._save()
             self._save_quarantine()
         metrics.inc("resilience.demotions")
+        # flight-recorder trigger (outside the table lock — a dump does
+        # file IO): a quarantine means a measured winner just got
+        # demoted for cause, exactly the moment a postmortem wants
+        blackbox.record("autotune.quarantine", key=key, backend=backend,
+                        reason=str(reason)[:200])
+        blackbox.trigger("quarantine",
+                         "%s -> %s: %s" % (key, backend, reason))
 
     def _live_quarantined(self, key: str) -> set:
         """Backends currently quarantined for ``key``; expired entries
@@ -453,6 +460,11 @@ class AutotuneTable:
             info["times"] = times
         self.decisions[key] = info
         metrics.inc("dispatch.%s.%s" % (op, backend))
+        # flight-recorder seam: the decision enters the ring so a
+        # postmortem bundle names the backends the failing run was
+        # actually dispatched to (one attribute read when off)
+        blackbox.record("autotune.decide", site=op, key=key,
+                        backend=backend, source=source)
         if source == "timed":
             metrics.inc("autotune.win.%s.%s" % (op, backend))
         if persist:

@@ -1,0 +1,399 @@
+"""Black-box flight recorder: a bounded ring of decision events plus
+one-shot forensic failure bundles.
+
+When a long run dies today — a health-gate trip, an ABFT ladder
+escalation, an injected (or real) ``device_loss``, an r05-shaped
+infra-failed bench — the context the process held at that moment
+(recent autotune decisions, breaker state, fault-plan firings, step
+timings) evaporates with it.  This module is the aircraft-style black
+box the postmortem needs:
+
+* **The ring.**  A process-wide, thread-safe, bounded ``deque`` of
+  structured events recorded at every decision seam that already
+  exists: autotune decide/quarantine
+  (:mod:`slate_tpu.perf.autotune`), health verdicts and safe-backend
+  retries (:mod:`slate_tpu.resilience.health`), ABFT ladder rungs
+  (:mod:`slate_tpu.resilience.abft`), breaker transitions
+  (:mod:`slate_tpu.resilience.breaker`), fault-plan firings
+  (:mod:`slate_tpu.resilience.inject`), serve dispatch/deadline/
+  backpressure (:mod:`slate_tpu.serve.queue` — serve events carry the
+  PR 10 request trace ids), bench routine lifecycle (``bench.py``) and
+  distributed step boundaries (:mod:`slate_tpu.resilience.checkpoint`
+  and the measured timeline below).
+* **The trigger ladder.**  On a trigger — health strict failure,
+  autotune quarantine, ``device_loss``, breaker open/trip, bench
+  watchdog/SIGTERM, or the opt-in excepthook — :func:`trigger` dumps
+  ONE versioned forensic bundle: ring contents +
+  ``metrics.snapshot()`` + knob/config state + an autotune table
+  digest + the active ``FaultPlan``'s replay log + python/jax/platform
+  keys.  Bundles render with the stdlib-only, by-path-loadable
+  ``tools/blackbox.py`` CLI.
+* **The measured distributed timeline.**  ``SLATE_TPU_DIST_TIMELINE=1``
+  drives ``pgetrf``/``ppotrf`` through their chunked step-window
+  builders one window at a time
+  (:func:`slate_tpu.parallel.dist_util.run_timeline`), recording
+  per-step host walls + per-step collective byte deltas as ring events
+  and ``trace.Block`` Perfetto spans — the measured compute signal
+  ``dist_util.overlap_summary`` feeds the MULTICHIP overlap blocks
+  with, replacing the "fully exposed" roofline guess.
+
+**Off-by-default, the PR 4 no-op contract**: every recording entry
+point checks one attribute (``_rec.enabled``) and returns; nothing
+here ever touches a traced program, so compiled executables stay
+bit-identical whatever the knobs (pinned in
+``tests/test_backend_registry.py``).  Importing this module starts no
+threads, opens no files and installs no hooks.
+
+Env knobs (all unset by default):
+
+* ``SLATE_TPU_BLACKBOX=1`` — enable the recorder (ring + triggers).
+* ``SLATE_TPU_BLACKBOX_RING`` — ring capacity in events (default 512).
+* ``SLATE_TPU_BLACKBOX_DIR`` — bundle directory (default: the system
+  temp dir).
+* ``SLATE_TPU_BLACKBOX_MAX_DUMPS`` — per-process bundle cap (default
+  8); past it triggers record but stop dumping.
+* ``SLATE_TPU_BLACKBOX_EXCEPTHOOK=1`` — dump a bundle from an
+  uncaught exception (installed lazily at the first recorded event or
+  :func:`on`, never at import).
+* ``SLATE_TPU_DIST_TIMELINE=1`` — measured per-step distributed
+  timelines (see above); ``SLATE_TPU_DIST_TIMELINE_WINDOW`` sets the
+  steps per measured window (default 1 — one wall/byte sample per
+  factorization step; larger windows amortize the chunked re-dispatch
+  cost).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from . import metrics
+
+__all__ = [
+    "ENV_BLACKBOX", "ENV_DIR", "ENV_EXCEPTHOOK", "ENV_MAX_DUMPS",
+    "ENV_RING", "ENV_TIMELINE", "ENV_TIMELINE_WINDOW", "SCHEMA",
+    "dump", "enabled", "events", "install_excepthook", "last_bundle",
+    "off", "on", "record", "reset", "ring_size", "timeline_wanted",
+    "timeline_window", "trigger",
+]
+
+ENV_BLACKBOX = "SLATE_TPU_BLACKBOX"
+ENV_RING = "SLATE_TPU_BLACKBOX_RING"
+ENV_DIR = "SLATE_TPU_BLACKBOX_DIR"
+ENV_MAX_DUMPS = "SLATE_TPU_BLACKBOX_MAX_DUMPS"
+ENV_EXCEPTHOOK = "SLATE_TPU_BLACKBOX_EXCEPTHOOK"
+ENV_TIMELINE = "SLATE_TPU_DIST_TIMELINE"
+ENV_TIMELINE_WINDOW = "SLATE_TPU_DIST_TIMELINE_WINDOW"
+
+#: bundle schema identity — bump on incompatible layout changes so the
+#: CLI can refuse bundles it does not understand under ``--strict``
+SCHEMA = "slate_tpu.blackbox/1"
+
+_DEFAULT_RING = 512
+_DEFAULT_MAX_DUMPS = 8
+
+
+def _env_int(name: str, default: int, lo: int = 1) -> int:
+    try:
+        return max(lo, int(os.environ.get(name, "").strip() or default))
+    except ValueError:
+        return default
+
+
+class _Recorder:
+    """The process-wide ring.  Private — use the module facade (the
+    registry-guard test forbids ``blackbox._*`` / ``_ring`` access
+    outside perf/)."""
+
+    def __init__(self):
+        self.enabled = metrics.env_flag(ENV_BLACKBOX)
+        # RLock (like the metrics registry): bench's SIGTERM handler
+        # dumps a bundle from a signal frame that may have interrupted
+        # the SAME thread inside a recorder critical section — a plain
+        # Lock would self-deadlock and eat the artifact's LAST-line
+        # aggregate flush
+        self.lock = threading.RLock()
+        self.ring: deque = deque(maxlen=_env_int(ENV_RING, _DEFAULT_RING))
+        self.dumps = 0
+        self.last: dict | None = None
+
+
+_rec = _Recorder()
+
+#: lazily install the excepthook on the first recorded event when the
+#: env opts in (never at import — the inert-at-import guard)
+_hook_wanted = [metrics.env_flag(ENV_EXCEPTHOOK)]
+_prev_hook: list = [None]
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    return _rec.enabled
+
+
+def on(ring: int | None = None) -> None:
+    """Enable the recorder (optionally resizing the ring); installs the
+    excepthook when ``SLATE_TPU_BLACKBOX_EXCEPTHOOK`` opts in."""
+    rec = _rec
+    if ring is not None and int(ring) != rec.ring.maxlen:
+        with rec.lock:
+            rec.ring = deque(rec.ring, maxlen=max(1, int(ring)))
+    rec.enabled = True
+    if _hook_wanted[0]:
+        install_excepthook()
+
+
+def off() -> None:
+    _rec.enabled = False
+
+
+def reset() -> None:
+    """Drop every recorded event and the dump bookkeeping (the enabled
+    flag is left as is) — test/bench isolation."""
+    rec = _rec
+    with rec.lock:
+        rec.ring.clear()
+        rec.dumps = 0
+        rec.last = None
+
+
+def ring_size() -> int:
+    return int(_rec.ring.maxlen or 0)
+
+
+# ---------------------------------------------------------------------------
+# Recording
+# ---------------------------------------------------------------------------
+
+def record(kind: str, **fields) -> None:
+    """Append one structured event to the ring.  ONE attribute read and
+    out when the recorder is off — cheap enough for every decision seam
+    to call unconditionally."""
+    rec = _rec
+    if not rec.enabled:
+        return
+    if _hook_wanted[0]:
+        install_excepthook()
+    ev = {"t": time.time(), "kind": str(kind)}
+    ev.update(fields)
+    with rec.lock:
+        rec.ring.append(ev)
+
+
+def events() -> list:
+    """A copy of the ring, oldest first."""
+    with _rec.lock:
+        return [dict(e) for e in _rec.ring]
+
+
+# ---------------------------------------------------------------------------
+# Distributed-timeline knobs (read here so the parallel/ layer keeps
+# its no-raw-env-reads guard; consumed by dist_util.run_timeline and
+# the pgetrf/ppotrf drivers)
+# ---------------------------------------------------------------------------
+
+def timeline_wanted() -> bool:
+    """The ``SLATE_TPU_DIST_TIMELINE=1`` opt-in: drive pgetrf/ppotrf
+    through their chunked step-window builders and measure per-step
+    walls + collective byte deltas (read per call so tests can
+    monkeypatch the environment)."""
+    return metrics.env_flag(ENV_TIMELINE)
+
+
+def timeline_window() -> int:
+    """Steps per measured window (``SLATE_TPU_DIST_TIMELINE_WINDOW``,
+    default 1 — one sample per factorization step)."""
+    return _env_int(ENV_TIMELINE_WINDOW, 1)
+
+
+# ---------------------------------------------------------------------------
+# Bundle assembly — every section individually guarded: a forensic
+# dump must never raise out of a recovery path, and must never IMPORT
+# heavyweight modules the process had not already loaded (reading
+# versions off sys.modules keeps a dump cheap and side-effect-free).
+# ---------------------------------------------------------------------------
+
+def _host_info() -> dict:
+    info = {"python": sys.version.split()[0], "platform": sys.platform,
+            "pid": os.getpid(), "argv0": sys.argv[0] if sys.argv else ""}
+    for mod in ("jax", "jaxlib", "numpy"):
+        m = sys.modules.get(mod)
+        if m is not None:
+            info[mod] = str(getattr(m, "__version__", "?"))
+    return info
+
+
+def _knob_state() -> dict:
+    keep = {k: v for k, v in os.environ.items()
+            if k.startswith("SLATE_TPU_")}
+    for k in ("JAX_PLATFORMS", "XLA_FLAGS"):
+        if k in os.environ:
+            keep[k] = os.environ[k]
+    return dict(sorted(keep.items()))
+
+
+def _config_state() -> dict:
+    cfg = sys.modules.get("slate_tpu.config")
+    if cfg is None:
+        return {}
+    return {"use_pallas": cfg.use_pallas_mode(),
+            "f64_mxu": cfg.f64_mxu_mode(),
+            "scattered_lu": cfg.scattered_lu_mode(),
+            "matmul_precision": str(cfg.matmul_precision),
+            "default_block_size": int(cfg.default_block_size)}
+
+
+def _autotune_digest() -> dict:
+    """Compact identity of the live decision table: per-site counts and
+    a content hash — enough for a postmortem to say WHICH table state a
+    failure happened under without shipping the whole table.  Only
+    reads a table that already exists (never constructs one)."""
+    at = sys.modules.get("slate_tpu.perf.autotune")
+    tab = getattr(at, "_table", None) if at is not None else None
+    if tab is None:
+        return {"decisions": 0}
+    dec = dict(tab.decisions)
+    sites: dict = {}
+    lines = []
+    for key in sorted(dec):
+        info = dec[key] or {}
+        site = key.split("|", 1)[0]
+        sites[site] = sites.get(site, 0) + 1
+        lines.append("%s=%s:%s" % (key, info.get("backend"),
+                                   info.get("source")))
+    sha = hashlib.sha1("\n".join(lines).encode()).hexdigest()[:12]
+    return {"decisions": len(dec), "sites": sites, "sha1": sha,
+            "quarantined": sum(len(v) for v in
+                               getattr(tab, "quarantine", {}).values())}
+
+
+def _fault_plan_state() -> dict | None:
+    inj = sys.modules.get("slate_tpu.resilience.inject")
+    if inj is None:
+        return None
+    plan = inj.get_plan()
+    if plan is None:
+        return None
+    return {"seed": plan.seed,
+            "specs": [{"site": s.site, "kind": s.kind, "rate": s.rate,
+                       "count": s.count}
+                      for s in plan.specs.values()],
+            "fired": plan.fired(),
+            "log": [{"site": s, "index": i, "kind": k}
+                    for s, i, k in plan.log[-200:]]}
+
+
+def _section(fn):
+    try:
+        return fn()
+    except Exception as e:          # a dump must never break a recovery
+        return {"error": "%s: %s" % (type(e).__name__, e)}
+
+
+def _assemble(reason: str, detail: str) -> dict:
+    return {
+        "schema": SCHEMA,
+        "created": time.time(),
+        "trigger": {"reason": str(reason), "detail": str(detail)[:500],
+                    "t": time.time()},
+        "host": _section(_host_info),
+        "knobs": _section(_knob_state),
+        "config": _section(_config_state),
+        "autotune": _section(_autotune_digest),
+        "fault_plan": _section(_fault_plan_state),
+        "metrics": _section(metrics.snapshot),
+        "events": events(),
+    }
+
+
+def dump(reason: str, detail: str = "", path: str | None = None):
+    """Write one forensic bundle NOW (ignores the per-process cap —
+    harnesses that want a bundle on demand).  Returns
+    ``{"path", "digest", "reason"}`` or None when the recorder is off
+    or the write failed."""
+    rec = _rec
+    if not rec.enabled:
+        return None
+    try:
+        blob = _assemble(reason, detail)
+        text = json.dumps(blob, default=str)
+        digest = hashlib.sha256(text.encode()).hexdigest()[:16]
+        if path is None:
+            d = os.environ.get(ENV_DIR, "").strip()
+            if not d:
+                import tempfile
+
+                d = tempfile.gettempdir()
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, "slate_tpu_blackbox_%d_%d.json"
+                % (int(time.time() * 1e3), os.getpid()))
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except Exception:
+        metrics.inc("blackbox.dump_errors")
+        return None
+    info = {"path": path, "digest": digest, "reason": str(reason)}
+    with rec.lock:
+        rec.dumps += 1
+        rec.last = info
+    metrics.inc("blackbox.dumps")
+    return info
+
+
+def trigger(reason: str, detail: str = ""):
+    """One rung of the trigger ladder: record the trigger event and —
+    while under the per-process dump cap — write the bundle.  Returns
+    the :func:`dump` info dict (None when off, capped, or failed)."""
+    rec = _rec
+    if not rec.enabled:
+        return None
+    record("trigger", reason=str(reason), detail=str(detail)[:500])
+    metrics.inc("blackbox.trigger." + str(reason).replace(" ", "_"))
+    with rec.lock:
+        capped = rec.dumps >= _env_int(ENV_MAX_DUMPS, _DEFAULT_MAX_DUMPS)
+    if capped:
+        return None
+    return dump(reason, detail)
+
+
+def last_bundle():
+    """The most recent bundle's ``{"path", "digest", "reason"}`` (None
+    when no dump has happened) — lets a late failure line point at an
+    earlier postmortem once the dump cap is hit."""
+    with _rec.lock:
+        return dict(_rec.last) if _rec.last else None
+
+
+# ---------------------------------------------------------------------------
+# Opt-in excepthook
+# ---------------------------------------------------------------------------
+
+def install_excepthook() -> None:
+    """Chain a bundle dump into ``sys.excepthook`` (idempotent; the
+    previous hook always runs).  Installed lazily — never at import —
+    by :func:`on`/:func:`record` when ``SLATE_TPU_BLACKBOX_EXCEPTHOOK``
+    opts in, or explicitly by a harness."""
+    _hook_wanted[0] = False
+    if _prev_hook[0] is not None:
+        return
+    prev = sys.excepthook
+    _prev_hook[0] = prev
+
+    def hook(tp, val, tb):
+        try:
+            trigger("excepthook", "%s: %s" % (tp.__name__, val))
+        finally:
+            prev(tp, val, tb)
+
+    sys.excepthook = hook

@@ -313,6 +313,17 @@ def load_artifact(path: str) -> "Artifact":
         art.infra.append("no parsed routines")
     if agg.get("partial"):
         art.infra.append("partial aggregate (suite truncated)")
+    bbs = agg.get("blackbox_bundles")
+    if isinstance(bbs, list):
+        # flight-recorder postmortems (ISSUE 15): a degraded artifact
+        # points at its own forensic bundle — surfaced as NOTE rows
+        # next to the verdicts, never re-keying the alignment
+        for b in bbs:
+            if isinstance(b, dict) and b.get("path"):
+                art.notes.append(
+                    "blackbox bundle [%s] %s (digest %s)"
+                    % (b.get("routine") or b.get("reason", "?"),
+                       b["path"], b.get("digest", "?")))
     if agg.get("retried_infra"):
         # tagged, not failed: bench absorbed a transient init error
         # with its classified retry (resilience satellite) — the

@@ -807,6 +807,14 @@ class LiveSentinel:
     def _emit(self, ev: dict) -> None:
         metrics.inc("telemetry.sentinel.events")
         metrics.inc("telemetry.sentinel." + ev["classification"])
+        # flight-recorder seam: the sentinel verdict enters the ring so
+        # a bundle and the JSONL log correlate on the same event
+        # (tools/telemetry_report.py --blackbox joins them by time)
+        from . import blackbox
+
+        blackbox.record("sentinel." + str(ev["classification"]),
+                        op=ev.get("op"), bucket=ev.get("bucket"),
+                        what=ev.get("kind"), detail=ev.get("detail"))
         # nested under "event": the event's own "kind" (latency/
         # throughput/errors) must not collide with the record kind
         log_record("sentinel", event=dict(ev))

@@ -126,8 +126,12 @@ def tol_scale() -> float:
 
 def _escalate(driver: str, rung: str, detail: str = "") -> None:
     """Count one recovery-ladder rung and feed it to the live sentinel
-    (best-effort — observability must never break a recovery)."""
+    and the flight recorder (best-effort — observability must never
+    break a recovery)."""
     metrics.inc("abft." + rung)
+    from ..perf import blackbox
+
+    blackbox.record("abft." + rung, driver=driver, detail=detail[:200])
     try:
         from ..perf import telemetry
 
@@ -381,6 +385,7 @@ def getrf_abft(av, nb: int = 512, tall_panel: str = "tournament"):
             restarts += 1
             metrics.inc("ckpt.restored")
             _escalate("getrf", "restarted", str(e))
+            _maybe_loss_trigger("getrf", e)
             k0, wmat, gperm = ck
             continue
         k0 += wpan
@@ -493,6 +498,7 @@ def potrf_abft(full, nb: int = 512):
             restarts += 1
             metrics.inc("ckpt.restored")
             _escalate("potrf", "restarted", str(e))
+            _maybe_loss_trigger("potrf", e)
             k0, wmat = ck
             continue
         k0 += wpan
@@ -579,8 +585,22 @@ def _verify_potrf(wmat, n: int, t0: int):
     return wmat, "dirty"
 
 
+def _maybe_loss_trigger(driver: str, e: Exception) -> None:
+    """Flight-recorder trigger for a device loss absorbed by one of the
+    composed ABFT step loops' restart rungs (the chunked distributed
+    drivers trigger from :mod:`.checkpoint` instead)."""
+    from . import inject
+    from ..perf import blackbox
+
+    if isinstance(e, inject.DeviceLoss):
+        blackbox.trigger("device_loss", "%s: %s" % (driver, e))
+
+
 def _unrecovered(driver: str) -> None:
     metrics.inc("abft.unrecovered")
+    from ..perf import blackbox
+
+    blackbox.record("abft.unrecovered", driver=driver)
     warnings.warn(
         "%s: ABFT verify still failing after recompute; the result "
         "flows to the health gate (SLATE_TPU_HEALTH) for the "

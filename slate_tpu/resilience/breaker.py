@@ -15,7 +15,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..perf import metrics
+from ..perf import blackbox, metrics
 
 __all__ = ["CircuitBreaker"]
 
@@ -52,6 +52,7 @@ class CircuitBreaker:
                     and self._clock() - self._opened_at >= self.cooldown_s:
                 self._state = HALF_OPEN
                 metrics.inc(self._prefix + ".half_open")
+                blackbox.record("breaker.half_open", name=self.name)
                 return True
             return False
 
@@ -59,6 +60,7 @@ class CircuitBreaker:
         with self._lock:
             if self._state == HALF_OPEN:
                 metrics.inc(self._prefix + ".close")
+                blackbox.record("breaker.close", name=self.name)
             self._state = CLOSED
             self._failures = 0
 
@@ -75,16 +77,27 @@ class CircuitBreaker:
             self._state = OPEN
             self._failures = 0
             self._opened_at = self._clock()
+        # flight-recorder trigger (outside the lock: a dump does file
+        # IO and must never serialize against the serving path)
+        blackbox.record("breaker.trip", name=self.name)
+        blackbox.trigger("breaker.trip", self.name)
 
     def failure(self) -> None:
+        opened = False
         with self._lock:
             if self._state == HALF_OPEN:
                 self._state = OPEN           # trial failed: re-open
                 self._opened_at = self._clock()
                 metrics.inc(self._prefix + ".open")
-                return
-            self._failures += 1
-            if self._state == CLOSED and self._failures >= self.threshold:
-                self._state = OPEN
-                self._opened_at = self._clock()
-                metrics.inc(self._prefix + ".open")
+                opened = True
+            else:
+                self._failures += 1
+                if self._state == CLOSED \
+                        and self._failures >= self.threshold:
+                    self._state = OPEN
+                    self._opened_at = self._clock()
+                    metrics.inc(self._prefix + ".open")
+                    opened = True
+        if opened:
+            blackbox.record("breaker.open", name=self.name)
+            blackbox.trigger("breaker.open", self.name)

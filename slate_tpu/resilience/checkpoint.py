@@ -38,7 +38,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional
 
-from ..perf import metrics
+from ..perf import blackbox, metrics
 
 __all__ = ["ENV_EVERY", "every_steps", "run_checkpointed", "snapshot"]
 
@@ -111,11 +111,29 @@ def run_checkpointed(total_steps: int, every: int, run_chunk: Callable,
             restarts += 1
             metrics.inc("ckpt.restored")
             metrics.inc("abft.restarted")
+            # flight-recorder seam: the restore rung enters the ring
+            # BEFORE the device-loss trigger dumps, so the bundle's
+            # event tail names the recovery that absorbed the loss
+            blackbox.record("ckpt.restored", label=label or "ckpt",
+                            lost_chunk=[int(k), int(k1)],
+                            resume_step=int(ck_k),
+                            error="%s: %s" % (type(e).__name__,
+                                              str(e)[:200]))
+            blackbox.record("abft.restarted", driver=label or "ckpt",
+                            detail=str(e)[:200])
             _feed_sentinel(label or "ckpt", "restarted", str(e))
+            if isinstance(e, inject.DeviceLoss):
+                # trigger-ladder rung: a device fell out mid-run — dump
+                # the postmortem with the restore already on the ring
+                blackbox.trigger(
+                    "device_loss", "%s: chunk [%d, %d) lost, resumed "
+                    "at step %d" % (label or "ckpt", k, k1, ck_k))
             # the in-flight chunk is lost; resume from the snapshot
             # (or from scratch when the first chunk never completed)
             k, carry = ck_k, ck_state
             continue
+        blackbox.record("dist.chunk", label=label or "ckpt",
+                        k0=int(k), k1=int(k1))
         carry, k = new_carry, k1
         if k < total_steps:
             ck_k, ck_state = k, snapshot(new_carry)

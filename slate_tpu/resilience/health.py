@@ -41,7 +41,7 @@ from contextlib import contextmanager
 from typing import Callable, Dict, Optional, Tuple
 
 from ..exceptions import SlateError
-from ..perf import metrics
+from ..perf import blackbox, metrics
 from .inject import iter_leaves
 
 __all__ = [
@@ -335,6 +335,9 @@ def driver_gate(name: str, fn, args, kwargs, out):
     if _healthy(name, args, kwargs, out):
         return out
     metrics.inc("resilience.health.fail")
+    # flight-recorder seam: every gate verdict (and each ladder rung
+    # below) enters the ring — a later bundle shows the escalation
+    blackbox.record("health.fail", driver=name, mode=m)
     if m == "warn":
         warnings.warn(
             f"{name}: output failed the health gate (non-finite or "
@@ -348,15 +351,23 @@ def driver_gate(name: str, fn, args, kwargs, out):
     # singular pivot, a NaN operand) is the problem and demoting
     # healthy winners for 24h would punish the hardware for the data.
     metrics.inc("resilience.retry")
+    blackbox.record("health.retry", driver=name)
     with safe_backend():
         out2 = fn(*args, **kwargs)
     if _healthy(name, args, kwargs, out2):
         _quarantine_for(name, reason=f"health gate failed in {name}; "
                         "stock backend recovered")
         metrics.inc("resilience.recovered")
+        blackbox.record("health.recovered", driver=name)
         return out2
     metrics.inc("resilience.unrecovered")
+    blackbox.record("health.unrecovered", driver=name, mode=m)
     if m == "strict":
+        # trigger-ladder rung: a strict failure is terminal for the
+        # caller — dump the postmortem BEFORE the raise unwinds the
+        # context the bundle exists to preserve
+        blackbox.trigger("health.strict",
+                         f"{name}: unrecovered on the stock backend")
         raise SlateError(
             f"{name}: output failed the health gate even on the "
             "stock-XLA backend (SLATE_TPU_HEALTH=strict)")

@@ -65,7 +65,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..exceptions import SlateError
-from ..perf import metrics
+from ..perf import blackbox, metrics
 
 __all__ = [
     "ENV_PLAN", "ENV_SEED", "ENV_SLOW_S", "KINDS", "DeviceLoss",
@@ -167,6 +167,11 @@ class FaultPlan:
             self._fired[site] = self._fired.get(site, 0) + 1
             self.log.append((site, idx, spec.kind))
         metrics.inc("resilience.inject." + site)
+        # flight-recorder seam: the fault-plan firing enters the ring
+        # so a postmortem bundle shows WHICH injected fault preceded
+        # the trigger (one attribute read when the recorder is off)
+        blackbox.record("inject.fired", site=site, index=idx,
+                        fault=spec.kind)
         return spec.kind
 
     def fired(self, site: Optional[str] = None) -> int:

@@ -123,6 +123,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..exceptions import SlateError
+from ..perf import blackbox as _blackbox
 from ..perf import metrics
 from ..perf import telemetry as _telemetry
 from ..perf.sweep import pow2_bucket as _pow2_bucket
@@ -393,6 +394,8 @@ class BatchQueue:
             depth = sum(len(v) for v in self._buckets.values())
             if depth >= self.config.max_queue_depth:
                 metrics.inc("serve.backpressure")
+                _blackbox.record("serve.backpressure", op=op,
+                                 depth=depth)
                 raise Backpressure(
                     f"serve queue at its depth bound "
                     f"({depth} >= {self.config.max_queue_depth}); "
@@ -537,6 +540,8 @@ class BatchQueue:
                     self._wake.wait(timeout=max(soonest - now, 1e-4))
             for key, r in expired:
                 metrics.inc("serve.deadline_expired")
+                _blackbox.record("serve.deadline", op=key[0],
+                                 trace_id=r.trace_id)
                 if not r.future.done():
                     r.future.set_exception(TimeoutError(
                         "serve request deadline expired before "
@@ -699,6 +704,18 @@ class BatchQueue:
         fails."""
         t0 = time.perf_counter()
         metrics.inc("serve.dispatches")
+        # flight-recorder seam: the dispatch enters the ring carrying
+        # the PR 10 request trace ids, so a postmortem bundle joins
+        # onto the telemetry spans/JSONL of the same requests (the
+        # enabled() guard keeps the hot path at one attribute read —
+        # the label/id args must not be built for a recorder that is
+        # off)
+        if _blackbox.enabled():
+            _blackbox.record(
+                "serve.dispatch", op=key[0], batch=len(reqs),
+                bucket=self._bucket_label(key),
+                trace_ids=[r.trace_id for r in reqs
+                           if r.trace_id is not None] or None)
         metrics.observe("serve.batch.occupancy", float(len(reqs)))
         for r in reqs:
             metrics.observe_time("serve.wait", t0 - r.t_submit)
@@ -714,6 +731,8 @@ class BatchQueue:
         except Exception as e:      # one bad batch must not kill the loop
             cb.failure()
             metrics.inc("serve.errors")
+            _blackbox.record("serve.error", op=key[0],
+                             error=type(e).__name__)
             if transient_infra(e) or isinstance(e, _UnhealthyBatch):
                 # the singles fallback below records each request's ONE
                 # final outcome — only the dispatch-level error feeds

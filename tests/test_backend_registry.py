@@ -265,6 +265,96 @@ def test_abft_knobs_documented():
         assert knob in docs, f"{knob} missing from docs/usage.md"
 
 
+#: private-surface access patterns for the flight recorder (ISSUE 15):
+#: touching the ``_rec`` singleton, the ``_ring`` deque, or any
+#: ``blackbox._x`` attribute outside perf/ — every seam must go
+#: through the public facade (``blackbox.record``/``trigger``/...)
+#: so the recorder stays swappable and its one-attribute-read no-op
+#: contract stays enforceable in one place.
+_BLACKBOX_PRIVATE_RE = re.compile(
+    r"(\bblackbox\._\w+"
+    r"|from\s+[\w.]*\bblackbox\b\s+import\s+[^#\n]*\b_\w+"
+    r"|\b_ring\b|\b_rec\b)")
+
+
+def test_no_private_blackbox_access_outside_perf():
+    offenders = []
+    for path in sorted(_PKG.rglob("*.py")):
+        rel = str(path.relative_to(_PKG)).replace("\\", "/")
+        if rel.startswith("perf/"):
+            continue                    # the recorder lives there
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if _BLACKBOX_PRIVATE_RE.search(line):
+                offenders.append(f"slate_tpu/{rel}:{lineno}: {line.strip()}")
+    assert not offenders, (
+        "flight recorder reached outside the public perf.blackbox "
+        "facade (use blackbox.record/trigger/events/... instead):\n"
+        + "\n".join(offenders))
+
+
+def test_blackbox_recorder_inert_at_import():
+    """ISSUE 15 guard: with every recorder env knob SET, importing the
+    package (and the serve/telemetry surfaces that record into it)
+    must not write a bundle, install the excepthook, or record an
+    event — the recorder starts at the first seam event or an explicit
+    on(), never at import.  Subprocess, like the exporter guards."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    code = (
+        "import sys\n"
+        "import slate_tpu\n"
+        "import slate_tpu.serve\n"
+        "from slate_tpu.perf import blackbox\n"
+        "import glob, os\n"
+        "assert blackbox.enabled()\n"
+        "assert blackbox.events() == [], 'events recorded at import'\n"
+        "assert sys.excepthook is sys.__excepthook__, \\\n"
+        "    'excepthook installed at import'\n"
+        "assert not glob.glob(os.path.join(\n"
+        "    os.environ['SLATE_TPU_BLACKBOX_DIR'], '*')), \\\n"
+        "    'bundle written at import'\n"
+        "print('OK')\n")
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_BLACKBOX="1",
+                   SLATE_TPU_BLACKBOX_EXCEPTHOOK="1",
+                   SLATE_TPU_BLACKBOX_DIR=td)
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
+def test_blackbox_off_by_default_lowering_bit_identity():
+    """ISSUE 15 pin: the recorder is host-side only — with every knob
+    unset, enabling it must leave compiled programs bit-identical (the
+    PR 4 contract every observability layer carries)."""
+    import numpy as np
+
+    from slate_tpu.perf import blackbox
+
+    a = jnp.asarray(np.eye(32, dtype=np.float32) * 4
+                    + np.ones((32, 32), np.float32))
+
+    def lower():
+        import jax
+
+        return jax.jit(lambda x: st.getrf(x)[0]).lower(a).as_text()
+
+    base = lower()
+    blackbox.on()
+    try:
+        blackbox.record("unit", probe=1)
+        assert lower() == base
+    finally:
+        blackbox.off()
+        blackbox.reset()
+    assert lower() == base
+
+
 #: raw environment access in the distributed layer: every scale-out
 #: knob (panel backend, pivot strategy, broadcast chunking, lookahead
 #: depth) must resolve through ``method.select_backend`` / the autotune

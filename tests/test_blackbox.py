@@ -1,0 +1,402 @@
+"""Flight recorder (ISSUE 15): the bounded ring, the trigger ladder's
+forensic bundles, the measured distributed timeline's bitwise parity,
+the serve trace-id join, and the stdlib CLIs — ``tools/blackbox.py``
+and the ``telemetry_report.py --blackbox`` correlation — on a
+jax-poisoned path like the other offline tools."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from slate_tpu.perf import blackbox, metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CLI = os.path.join(_REPO, "tools", "blackbox.py")
+_TELE_CLI = os.path.join(_REPO, "tools", "telemetry_report.py")
+
+
+@pytest.fixture
+def recorder(tmp_path, monkeypatch):
+    """Recorder on, dumping into tmp_path; always restored off+empty."""
+    monkeypatch.setenv("SLATE_TPU_BLACKBOX_DIR", str(tmp_path))
+    blackbox.reset()
+    blackbox.on()
+    yield tmp_path
+    blackbox.off()
+    blackbox.reset()
+
+
+def _poison_env(tmp_path):
+    poison = tmp_path / "poison"
+    (poison / "jax").mkdir(parents=True, exist_ok=True)
+    (poison / "jax" / "__init__.py").write_text(
+        "raise ImportError('jax must not be imported by this CLI')\n")
+    return dict(os.environ,
+                PYTHONPATH=str(poison) + os.pathsep
+                + os.environ.get("PYTHONPATH", ""))
+
+
+# ---------------------------------------------------------------------------
+# The ring
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_off_is_a_no_op(self):
+        blackbox.off()
+        blackbox.reset()
+        blackbox.record("x", a=1)
+        assert blackbox.events() == []
+        assert blackbox.trigger("nope") is None
+        assert blackbox.dump("nope") is None
+
+    def test_bounded_oldest_dropped(self, recorder):
+        blackbox.on(ring=4)
+        try:
+            for i in range(10):
+                blackbox.record("k", i=i)
+            evs = blackbox.events()
+            assert len(evs) == 4
+            assert [e["i"] for e in evs] == [6, 7, 8, 9]
+        finally:
+            blackbox.on(ring=512)
+
+    def test_events_are_stamped_and_typed(self, recorder):
+        t0 = time.time()
+        blackbox.record("health.fail", driver="potrf", mode="retry")
+        (ev,) = blackbox.events()
+        assert ev["kind"] == "health.fail"
+        assert ev["driver"] == "potrf"
+        assert abs(ev["t"] - t0) < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Bundles
+# ---------------------------------------------------------------------------
+
+class TestBundle:
+    def test_trigger_dumps_versioned_bundle(self, recorder):
+        blackbox.record("abft.detected", driver="getrf", detail="syn")
+        info = blackbox.trigger("quarantine", "unit-test detail")
+        assert info and os.path.exists(info["path"])
+        with open(info["path"]) as f:
+            text = f.read()
+        import hashlib
+
+        assert info["digest"] == \
+            hashlib.sha256(text.encode()).hexdigest()[:16]
+        blob = json.loads(text)
+        assert blob["schema"] == blackbox.SCHEMA
+        assert blob["trigger"]["reason"] == "quarantine"
+        kinds = [e["kind"] for e in blob["events"]]
+        assert kinds[-1] == "trigger" and "abft.detected" in kinds
+        # every bundle section present (content best-effort)
+        for key in ("host", "knobs", "config", "autotune",
+                    "fault_plan", "metrics"):
+            assert key in blob, key
+        assert blackbox.last_bundle()["path"] == info["path"]
+
+    def test_bundle_carries_fault_plan_log(self, recorder):
+        from slate_tpu.resilience import inject
+
+        inject.install(inject.FaultPlan(seed=3).add("driver.output",
+                                                    "nan", rate=1.0))
+        try:
+            assert inject.poll("driver.output") == "nan"
+            info = blackbox.trigger("health.strict")
+            with open(info["path"]) as f:
+                blob = json.load(f)
+            fp = blob["fault_plan"]
+            assert fp["seed"] == 3 and fp["fired"] == 1
+            assert fp["log"][0]["site"] == "driver.output"
+            # the firing also entered the ring as an event
+            assert any(e["kind"] == "inject.fired"
+                       for e in blob["events"])
+        finally:
+            inject.clear_plan()
+
+    def test_dump_cap_honoured(self, recorder, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_BLACKBOX_MAX_DUMPS", "2")
+        assert blackbox.trigger("breaker.open") is not None
+        assert blackbox.trigger("breaker.open") is not None
+        assert blackbox.trigger("breaker.open") is None  # capped
+        assert len(glob.glob(str(recorder / "*.json"))) == 2
+        # capped triggers still reference the last bundle written
+        assert blackbox.last_bundle() is not None
+
+    def test_breaker_trip_triggers_bundle(self, recorder):
+        from slate_tpu.resilience.breaker import CircuitBreaker
+
+        CircuitBreaker(name="unit/bucket").trip()
+        bundles = glob.glob(str(recorder / "*.json"))
+        assert len(bundles) == 1
+        with open(bundles[0]) as f:
+            blob = json.load(f)
+        assert blob["trigger"]["reason"] == "breaker.trip"
+        assert any(e["kind"] == "breaker.trip"
+                   and e.get("name") == "unit/bucket"
+                   for e in blob["events"])
+
+    def test_health_strict_failure_triggers_bundle(self, recorder,
+                                                   monkeypatch):
+        from slate_tpu.exceptions import SlateError
+        from slate_tpu.resilience import health
+
+        monkeypatch.setenv("SLATE_TPU_HEALTH", "strict")
+        bad = np.full((2, 2), np.nan, np.float32)
+        with pytest.raises(SlateError):
+            health.driver_gate("gemm", lambda: bad, (), {}, bad)
+        bundles = glob.glob(str(recorder / "*.json"))
+        assert len(bundles) == 1
+        with open(bundles[0]) as f:
+            blob = json.load(f)
+        assert blob["trigger"]["reason"] == "health.strict"
+        kinds = [e["kind"] for e in blob["events"]]
+        assert "health.fail" in kinds and "health.retry" in kinds \
+            and "health.unrecovered" in kinds
+
+    def test_excepthook_optin_dumps_on_uncaught(self, tmp_path):
+        code = (
+            "from slate_tpu.perf import blackbox\n"
+            "blackbox.record('bench.routine', name='x')\n"
+            "raise RuntimeError('uncaught-unit-test')\n")
+        env = dict(os.environ, SLATE_TPU_BLACKBOX="1",
+                   SLATE_TPU_BLACKBOX_EXCEPTHOOK="1",
+                   SLATE_TPU_BLACKBOX_DIR=str(tmp_path),
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode != 0
+        bundles = glob.glob(str(tmp_path / "slate_tpu_blackbox_*.json"))
+        assert len(bundles) == 1, (r.stdout, r.stderr)
+        with open(bundles[0]) as f:
+            blob = json.load(f)
+        assert blob["trigger"]["reason"] == "excepthook"
+        assert "uncaught-unit-test" in blob["trigger"]["detail"]
+
+
+# ---------------------------------------------------------------------------
+# Serve join: dispatch events carry the PR 10 trace ids
+# ---------------------------------------------------------------------------
+
+def test_serve_dispatch_events_carry_trace_ids(recorder):
+    from slate_tpu.perf import telemetry
+    from slate_tpu.serve.queue import BatchQueue, ServeConfig
+
+    was_tele, was_metrics = telemetry.enabled(), metrics.enabled()
+    telemetry.on()
+    srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.002))
+    try:
+        n = 8
+        rng = np.random.default_rng(0)
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        spd = g @ g.T + n * np.eye(n, dtype=np.float32)
+        fut = srv.submit("posv", spd, np.ones(n, np.float32))
+        fut.result(timeout=300)
+        disp = [e for e in blackbox.events()
+                if e["kind"] == "serve.dispatch"]
+        assert disp, blackbox.events()
+        assert fut.trace_id in (disp[-1].get("trace_ids") or [])
+        assert disp[-1]["op"] == "posv"
+    finally:
+        srv.close()
+        # the served request buffered telemetry spans: drain them so a
+        # later finish_perfetto test exports only its own events
+        telemetry.drain_spans()
+        metrics.drain_samples()
+        if not was_tele:
+            telemetry.off()
+        if not was_metrics:
+            metrics.off()
+
+
+# ---------------------------------------------------------------------------
+# Measured distributed timeline (SLATE_TPU_DIST_TIMELINE)
+# ---------------------------------------------------------------------------
+
+class TestDistTimeline:
+    def _spd(self, n):
+        rng = np.random.default_rng(1)
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        return g @ g.T + n * np.eye(n, dtype=np.float32)
+
+    def test_ppotrf_timeline_bitwise_and_measured(self, mesh8,
+                                                  monkeypatch,
+                                                  recorder):
+        from slate_tpu.parallel import dist_util, distribute, ppotrf
+
+        p, q = 2, 4
+        n, nb = 32, 4
+        a = self._spd(n)
+
+        def dist():
+            return distribute(a, mesh8, nb, diag_pad=1.0, row_mult=q,
+                              col_mult=p)
+
+        mono = np.asarray(ppotrf(dist()).data)
+        monkeypatch.setenv("SLATE_TPU_DIST_TIMELINE", "1")
+        try:
+            timed = np.asarray(ppotrf(dist()).data)
+            # the chunked step windows run the SAME staged bodies: the
+            # measured timeline never changes the numbers
+            assert np.array_equal(mono, timed)
+            steps = dist_util.timeline_steps()
+            assert steps and steps[0]["driver"] == "ppotrf"
+            # default window = 1: one measured row per step, windows
+            # contiguous over [0, nt)
+            assert steps[0]["k0"] == 0
+            assert all(a["k1"] == b["k0"]
+                       for a, b in zip(steps, steps[1:]))
+            assert steps[-1]["k1"] == 8          # nt = 32 / nb=4
+            assert all(s["wall_s"] > 0 for s in steps)
+            # the per-step events entered the flight-recorder ring
+            kinds = [e["kind"] for e in blackbox.events()]
+            assert kinds.count("dist.step") >= len(steps)
+        finally:
+            dist_util.clear_timeline()
+
+    def test_pgetrf_timeline_matches_monolithic(self, mesh8,
+                                                monkeypatch):
+        from slate_tpu.parallel import dist_util, pgesv, undistribute
+
+        n, nb = 32, 4
+        rng = np.random.default_rng(2)
+        a = rng.standard_normal((n, n)).astype(np.float32) \
+            + n * np.eye(n, dtype=np.float32)
+        b = rng.standard_normal((n, 4)).astype(np.float32)
+        _, _, x0 = pgesv(a, b, mesh8, nb)
+        x0 = np.asarray(undistribute(x0))
+        monkeypatch.setenv("SLATE_TPU_DIST_TIMELINE", "1")
+        try:
+            _, _, x1 = pgesv(a, b, mesh8, nb)
+            x1 = np.asarray(undistribute(x1))
+            assert np.array_equal(x0, x1)
+            steps = dist_util.timeline_steps()
+            assert steps and steps[0]["driver"] == "pgetrf"
+        finally:
+            dist_util.clear_timeline()
+
+
+# ---------------------------------------------------------------------------
+# The sentinel NOTE rows and the stdlib CLIs (jax-poisoned)
+# ---------------------------------------------------------------------------
+
+def test_regress_renders_bundle_note_rows(tmp_path):
+    from slate_tpu.perf import regress
+
+    agg = {"metric": "factor_suite_fp32_geomean", "value": 1.0,
+           "unit": "GFLOP/s", "vs_baseline": 0.0,
+           "submetrics": {"gemm_fp32_n1024": 10.0},
+           "blackbox_bundles": [
+               {"routine": "potrf", "path": "/tmp/bb.json",
+                "digest": "abcd1234"}]}
+    p = tmp_path / "BENCH_bb.json"
+    p.write_text(json.dumps(agg))
+    art = regress.load_artifact(str(p))
+    assert any("blackbox bundle [potrf]" in note
+               and "abcd1234" in note for note in art.notes)
+    table = regress.format_table(regress.diff([art]))
+    assert "NOTE BENCH_bb.json: blackbox bundle [potrf]" in table
+
+
+def _write_bundle(path, events, reason="device_loss"):
+    blob = {"schema": "slate_tpu.blackbox/1", "created": 100.0,
+            "trigger": {"reason": reason, "detail": "", "t": 100.0},
+            "host": {"python": "3", "platform": "linux", "pid": 1},
+            "knobs": {}, "config": {}, "autotune": {"decisions": 0},
+            "fault_plan": None, "metrics": {}, "events": events}
+    with open(path, "w") as f:
+        json.dump(blob, f)
+    return str(path)
+
+
+class TestCli:
+    def test_render_and_strict_clean(self, tmp_path):
+        p = _write_bundle(tmp_path / "b.json", [
+            {"t": 99.0, "kind": "inject.fired", "site": "step.boundary",
+             "fault": "device_loss"},
+            {"t": 99.5, "kind": "ckpt.restored", "label": "pgetrf",
+             "resume_step": 2},
+            {"t": 100.0, "kind": "trigger", "reason": "device_loss"}])
+        r = subprocess.run([sys.executable, _CLI, p, "--strict"],
+                           capture_output=True, text=True,
+                           env=_poison_env(tmp_path), timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "trigger: device_loss" in r.stdout
+        assert "ckpt.restored" in r.stdout
+        assert "trigger chain" in r.stdout
+
+    def test_strict_flags_unrecovered(self, tmp_path):
+        p = _write_bundle(tmp_path / "b.json", [
+            {"t": 99.0, "kind": "abft.unrecovered", "driver": "getrf"}])
+        r = subprocess.run([sys.executable, _CLI, p, "--strict"],
+                           capture_output=True, text=True,
+                           env=_poison_env(tmp_path), timeout=300)
+        assert r.returncode == 1
+        assert "unrecovered" in r.stdout
+
+    def test_strict_flags_malformed(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text("{not json")
+        r = subprocess.run([sys.executable, _CLI, str(p), "--strict"],
+                           capture_output=True, text=True,
+                           env=_poison_env(tmp_path), timeout=300)
+        assert r.returncode == 1
+
+    def test_json_output(self, tmp_path):
+        p = _write_bundle(tmp_path / "b.json", [
+            {"t": 99.5, "kind": "health.fail", "driver": "potrf"}])
+        r = subprocess.run([sys.executable, _CLI, p, "--json"],
+                           capture_output=True, text=True,
+                           env=_poison_env(tmp_path), timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        blob = json.loads(r.stdout)
+        assert blob["trigger"]["reason"] == "device_loss"
+        assert blob["counts"] == {"health.fail": 1}
+        assert blob["chain"][0]["kind"] == "health.fail"
+
+    def test_telemetry_report_blackbox_join(self, tmp_path):
+        log = tmp_path / "serve.jsonl"
+        recs = [
+            {"t": 100.0, "kind": "request", "op": "posv",
+             "bucket": "fp32.n64", "latency_ms": 3.0, "error": False,
+             "slo_violation": False, "batch": 4},
+            {"t": 102.0, "kind": "sentinel", "event": {
+                "t": 102.0, "classification": "degradation",
+                "kind": "latency", "op": "posv",
+                "bucket": "fp32.n64", "rise_pct": 80.0}},
+        ]
+        log.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        p = _write_bundle(tmp_path / "b.json", [
+            {"t": 101.5, "kind": "serve.dispatch", "op": "posv",
+             "batch": 4, "trace_ids": [7]},
+            {"t": 102.2, "kind": "breaker.trip", "name": "posv/64"},
+            {"t": 300.0, "kind": "bench.routine", "name": "far-away"}],
+            reason="breaker.trip")
+        r = subprocess.run(
+            [sys.executable, _TELE_CLI, str(log), "--blackbox", p],
+            capture_output=True, text=True, env=_poison_env(tmp_path),
+            timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "blackbox correlation" in r.stdout
+        assert "serve.dispatch" in r.stdout
+        assert "breaker.trip" in r.stdout
+        assert "far-away" not in r.stdout          # outside the window
+        rj = subprocess.run(
+            [sys.executable, _TELE_CLI, str(log), "--blackbox", p,
+             "--json"],
+            capture_output=True, text=True, env=_poison_env(tmp_path),
+            timeout=300)
+        blob = json.loads(rj.stdout)
+        corr = blob["blackbox"]["correlated"]
+        assert len(corr) == 1
+        kinds = {e["kind"] for e in corr[0]["nearby"]}
+        assert kinds == {"serve.dispatch", "breaker.trip"}

@@ -50,10 +50,36 @@ _PER_DEVICE_KEYS = {
 }
 
 
+_MEASURED_STEP_KEYS = {
+    "driver": str,
+    "k0": int,
+    "k1": int,
+    "wall_s": (int, float),
+    "bcast_bytes": (int, float),
+    "bcast_count": (int, float),
+}
+
+
 def _check_overlap_block(blk):
     for key, typ in _OVERLAP_KEYS.items():
         assert key in blk, f"overlap block missing {key}"
         assert isinstance(blk[key], typ), (key, blk[key])
+    # ISSUE 15: blocks name their compute-budget provenance; the
+    # measured per-step rows must be complete wherever they appear
+    if "compute_source" in blk:
+        assert blk["compute_source"] in ("measured_steps", "explicit",
+                                         "timers", "none")
+        assert (blk["compute_source"] == "measured_steps") \
+            == ("measured_steps" in blk)
+    if "measured_steps" in blk:
+        ms = blk["measured_steps"]
+        assert ms["count"] == len(ms["per_step"]) >= 1
+        for row in ms["per_step"]:
+            for key, typ in _MEASURED_STEP_KEYS.items():
+                assert key in row, f"measured step missing {key}"
+                assert isinstance(row[key], typ), (key, row[key])
+        assert ms["wall_s_total"] == pytest.approx(
+            sum(r["wall_s"] for r in ms["per_step"]), rel=1e-6)
     assert blk["n_devices"] >= 1
     assert len(blk["per_device"]) == blk["n_devices"]
     assert 0.0 <= blk["overlap_efficiency"] <= 1.0
@@ -183,6 +209,116 @@ def test_overlap_summary_schema_from_live_counters(mesh8):
 def _check_and_return(blk):
     _check_overlap_block(blk)
     return blk
+
+
+def test_overlap_summary_window_isolates_back_to_back_runs(mesh8):
+    """ISSUE 15 satellite: the overlap budget and byte totals must be
+    windowable — a long-lived process accumulates ``driver.*`` timers
+    and collective counters across every run it ever made, and the old
+    lifetime-snapshot read inflated a later run's overlap block with
+    the earlier runs' signal."""
+    from slate_tpu._jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from slate_tpu.parallel import dist_util
+    from slate_tpu.parallel.mesh import AXIS_P, AXIS_Q
+
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    try:
+        p, nb, mlb = 2, 2, 2
+        M = mlb * nb * p
+
+        def kernel(col):
+            r = jax.lax.axis_index(AXIS_P)
+            grows = dist_util.local_grows(mlb, nb, p, r)
+            own = jnp.ones((mlb * nb, 1), jnp.float32)
+            return dist_util.bcast_block_col(col, grows, own, M)
+
+        fn = shard_map(kernel, mesh=mesh8,
+                       in_specs=(P(AXIS_P, None),),
+                       out_specs=P(None, None))
+        # a stale compute signal from "an earlier run" of this process
+        metrics.observe_time("driver.stale_earlier_run", 123.0)
+        # run 1 (w=3) traces and counts its bytes; run 2 (w=5) is a new
+        # shape, so it traces and counts its own — the window around
+        # run 2 must carry run 2's bytes only
+        np.asarray(jax.jit(fn)(jnp.ones((mlb * nb * p, 3),
+                                        jnp.float32)))
+        snap1 = metrics.snapshot()
+        np.asarray(jax.jit(fn)(jnp.ones((mlb * nb * p, 5),
+                                        jnp.float32)))
+        window = metrics.snapshot_delta(snap1, metrics.snapshot())
+
+        blk = _check_and_return(
+            dist_util.overlap_summary(n_devices=8, window=window))
+        assert blk["collective_bytes"] == M * 5 * 4   # run 2 only
+        # the stale lifetime timer must NOT leak into the window's
+        # budget: no in-window compute signal -> fully exposed
+        assert blk["compute_source"] == "none"
+        assert blk["overlap_efficiency"] == 0.0
+
+        life = _check_and_return(dist_util.overlap_summary(n_devices=8))
+        assert life["collective_bytes"] == M * 3 * 4 + M * 5 * 4
+        assert life["compute_source"] == "timers"   # the stale timer
+        assert life["overlap_efficiency"] == 1.0    # ...inflates it
+    finally:
+        metrics.reset()
+        metrics.off()
+
+
+def test_overlap_block_measured_fields_under_timeline_knob(
+        mesh8, monkeypatch):
+    """ISSUE 15 pin: under ``SLATE_TPU_DIST_TIMELINE=1`` the overlap
+    block's efficiency comes from MEASURED per-step walls (rows
+    present, sums reconciling with the driver wall); with the knob
+    unset the conservative ladder stands and no measured fields
+    appear."""
+    import time as _time
+
+    from slate_tpu.parallel import dist_util, distribute, ppotrf
+
+    metrics.off()
+    metrics.reset()
+    metrics.on()
+    monkeypatch.setenv("SLATE_TPU_DIST_TIMELINE", "1")
+    try:
+        p, q = 2, 4
+        n, nb = 32, 4
+        rng = np.random.default_rng(5)
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        a = g @ g.T + n * np.eye(n, dtype=np.float32)
+        ad = distribute(a, mesh8, nb, diag_pad=1.0, row_mult=q,
+                        col_mult=p)
+        snap0 = metrics.snapshot()
+        t0 = _time.perf_counter()
+        ppotrf(ad)
+        wall = _time.perf_counter() - t0
+        window = metrics.snapshot_delta(snap0, metrics.snapshot())
+        blk = _check_and_return(
+            dist_util.overlap_summary(
+                n_devices=8, compute_s=wall, window=window,
+                measured_steps=dist_util.timeline_steps()))
+        assert blk["compute_source"] == "measured_steps"
+        ms = blk["measured_steps"]
+        assert ms["count"] == 8                    # nt = 32/4, window 1
+        # the per-step span sums reconcile with the driver wall: they
+        # are measured INSIDE it, within the chunked-dispatch overhead
+        assert 0.0 < ms["wall_s_total"] <= wall * 1.001
+        assert blk["compute_s"] == pytest.approx(ms["wall_s_total"])
+
+        # no measured rows passed -> conservative ladder, no measured
+        # fields (the rows are never sniffed off module state: stale
+        # steps from an earlier run must not misprice a later block)
+        blk2 = _check_and_return(
+            dist_util.overlap_summary(n_devices=8, compute_s=wall,
+                                      window=window))
+        assert "measured_steps" not in blk2
+        assert blk2["compute_source"] == "explicit"
+    finally:
+        dist_util.clear_timeline()
+        metrics.reset()
+        metrics.off()
 
 
 def test_overlap_summary_without_traffic_is_clean():

@@ -1,0 +1,216 @@
+#!/usr/bin/env python3
+"""Render a slate_tpu flight-recorder bundle into a postmortem report.
+
+Usage::
+
+    python tools/blackbox.py BUNDLE.json
+    python tools/blackbox.py BUNDLE.json --last 30
+    python tools/blackbox.py BUNDLE.json --json
+    python tools/blackbox.py BUNDLE.json --strict   # exit 1 on
+                                  # unrecovered/strict events or a
+                                  # malformed/unknown-schema bundle
+
+The bundle is what :func:`slate_tpu.perf.blackbox.trigger` dumps on a
+trigger (health strict failure, quarantine, device_loss, breaker
+open/trip, bench watchdog/SIGTERM, opt-in excepthook): the event ring +
+metrics snapshot + knob/config state + autotune digest + fault-plan
+replay log + host keys, schema ``slate_tpu.blackbox/1``.
+
+The report shows, in order: the trigger header (reason, detail, host,
+knobs), the **last-events timeline** (relative seconds to the trigger,
+one line per ring event), the **trigger chain** (only the
+resilience/escalation events — inject firings, health verdicts, ABFT
+rungs, checkpoint restores, breaker transitions, quarantines, sentinel
+verdicts — the causal spine a postmortem reads first), and per-kind
+event counts.
+
+Stdlib-only, loadable by file path like ``bench_diff.py`` — it never
+imports jax (CI runs it under a jax-poisoned path), so it works on any
+machine in milliseconds.
+"""
+
+import argparse
+import json
+import sys
+
+SCHEMA = "slate_tpu.blackbox/1"
+
+#: event kinds (prefix match) that form the causal escalation spine
+CHAIN_PREFIXES = ("inject.", "health.", "abft.", "ckpt.", "breaker.",
+                  "autotune.quarantine", "sentinel.", "trigger",
+                  "serve.deadline", "serve.backpressure", "serve.error",
+                  "bench.")
+
+#: event kinds whose presence means the run ended UNRECOVERED — the
+#: ``--strict`` gate (a recovered ladder leaves none of these)
+STRICT_KINDS = ("health.unrecovered", "abft.unrecovered")
+
+
+def load_bundle(path):
+    """Parse one bundle; returns (bundle|None, problems list)."""
+    problems = []
+    try:
+        with open(path) as f:
+            blob = json.load(f)
+    except (OSError, ValueError) as e:
+        return None, ["unreadable bundle: %s" % e]
+    if not isinstance(blob, dict):
+        return None, ["bundle is not a JSON object"]
+    if blob.get("schema") != SCHEMA:
+        problems.append("unknown schema %r (this tool reads %s)"
+                        % (blob.get("schema"), SCHEMA))
+    if not isinstance(blob.get("events"), list):
+        problems.append("missing events ring")
+        blob["events"] = []
+    if not isinstance(blob.get("trigger"), dict):
+        problems.append("missing trigger block")
+        blob["trigger"] = {}
+    return blob, problems
+
+
+def _fields(ev):
+    """One compact ``k=v`` tail for an event line (the bookkeeping keys
+    are rendered elsewhere)."""
+    parts = []
+    for k in sorted(ev):
+        if k in ("t", "kind"):
+            continue
+        v = ev[k]
+        if v is None:
+            continue
+        if isinstance(v, float):
+            v = "%.6g" % v
+        parts.append("%s=%s" % (k, v))
+    return " ".join(parts)
+
+
+def _chain(events):
+    return [ev for ev in events
+            if str(ev.get("kind", "")).startswith(CHAIN_PREFIXES)]
+
+
+def _counts(events):
+    out = {}
+    for ev in events:
+        k = str(ev.get("kind", "?"))
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def strict_findings(blob, problems):
+    """The ``--strict`` verdict inputs: bundle problems plus any
+    unrecovered-class events on the ring."""
+    findings = list(problems)
+    for ev in blob.get("events", []):
+        if str(ev.get("kind", "")) in STRICT_KINDS:
+            findings.append("unrecovered event on the ring: %s (%s)"
+                            % (ev.get("kind"), _fields(ev)))
+    return findings
+
+
+def report(blob, problems, last=40):
+    trig = blob.get("trigger", {})
+    t_trig = trig.get("t") or blob.get("created") or 0.0
+    host = blob.get("host", {}) or {}
+    out = []
+    out.append("flight-recorder bundle (%s)" % blob.get("schema", "?"))
+    out.append("trigger: %s%s" % (
+        trig.get("reason", "?"),
+        (" — " + str(trig.get("detail"))) if trig.get("detail") else ""))
+    out.append("host: python %s on %s, pid %s%s" % (
+        host.get("python", "?"), host.get("platform", "?"),
+        host.get("pid", "?"),
+        (", jax %s" % host["jax"]) if host.get("jax") else ""))
+    at = blob.get("autotune", {}) or {}
+    if at.get("decisions"):
+        out.append("autotune table: %d decision(s), sha1 %s, "
+                   "%d quarantined"
+                   % (at.get("decisions", 0), at.get("sha1", "?"),
+                      at.get("quarantined", 0)))
+    fp = blob.get("fault_plan")
+    if isinstance(fp, dict) and fp:
+        out.append("fault plan: seed=%s fired=%s specs=%s" % (
+            fp.get("seed"), fp.get("fired"),
+            ",".join("%s=%s" % (s.get("site"), s.get("kind"))
+                     for s in fp.get("specs", []))))
+    knobs = blob.get("knobs", {}) or {}
+    set_knobs = sorted(k for k in knobs if k.startswith("SLATE_TPU_"))
+    if set_knobs:
+        out.append("knobs set: " + " ".join(set_knobs))
+    for p in problems:
+        out.append("PROBLEM: " + p)
+    events = blob.get("events", [])
+    out.append("")
+    tail = events[-max(1, int(last)):] if events else []
+    out.append("last %d event(s) (dt relative to the trigger):"
+               % len(tail))
+    for ev in tail:
+        dt = float(ev.get("t", t_trig) or 0.0) - float(t_trig or 0.0)
+        out.append("  %+9.3fs  %-22s %s"
+                   % (dt, ev.get("kind", "?"), _fields(ev)))
+    if not tail:
+        out.append("  (empty ring)")
+    chain = _chain(events)
+    out.append("")
+    out.append("trigger chain (%d escalation event(s)):" % len(chain))
+    for ev in chain[-max(1, int(last)):]:
+        dt = float(ev.get("t", t_trig) or 0.0) - float(t_trig or 0.0)
+        out.append("  %+9.3fs  %-22s %s"
+                   % (dt, ev.get("kind", "?"), _fields(ev)))
+    if not chain:
+        out.append("  (none recorded)")
+    counts = _counts(events)
+    if counts:
+        out.append("")
+        out.append("event counts: " + "  ".join(
+            "%s=%d" % (k, counts[k]) for k in sorted(counts)))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="blackbox.py",
+        description="Render a slate_tpu flight-recorder forensic "
+                    "bundle: trigger header, last-events timeline, "
+                    "escalation chain.")
+    ap.add_argument("bundle", help="bundle JSON dumped by the recorder")
+    ap.add_argument("--last", type=int, default=40,
+                    help="events shown in the timeline/chain "
+                         "(default %(default)s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when the bundle is malformed, carries "
+                         "an unknown schema, or records an "
+                         "unrecovered health/ABFT event")
+    args = ap.parse_args(argv)
+
+    blob, problems = load_bundle(args.bundle)
+    if blob is None:
+        print("\n".join(problems), file=sys.stderr)
+        return 1
+    findings = strict_findings(blob, problems)
+    if args.json:
+        events = blob.get("events", [])
+        print(json.dumps({
+            "schema": blob.get("schema"),
+            "trigger": blob.get("trigger"),
+            "host": blob.get("host"),
+            "autotune": blob.get("autotune"),
+            "fault_plan": blob.get("fault_plan"),
+            "events": events[-max(1, args.last):],
+            "chain": _chain(events),
+            "counts": _counts(events),
+            "problems": problems,
+            "strict_findings": findings,
+        }, indent=1, default=str))
+    else:
+        print(report(blob, problems, last=args.last))
+        if args.strict and findings:
+            for f in findings:
+                print("STRICT: " + f)
+    return 1 if (args.strict and findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,13 @@ over the record span; then lists the sentinel events.  A rotated
 sibling (``<path>.1``) is read first when present so the report spans
 the rotation.
 
+``--blackbox BUNDLE`` joins a flight-recorder bundle
+(``slate_tpu.perf.blackbox``; rendered alone by ``tools/blackbox.py``)
+onto the sentinel events: for each degradation/infra event the report
+lists the recorder's ring events within ``--blackbox-window`` seconds
+of it — the decisions, fault firings and breaker moves that surrounded
+the degradation, correlated on the shared epoch clock.
+
 Stdlib-only, loadable by file path like ``bench_diff.py`` — it never
 imports jax (CI runs it under a jax-poisoned path), so it works on any
 machine in milliseconds.
@@ -144,6 +151,63 @@ def _fmt(v):
     return str(v)
 
 
+def load_blackbox(path):
+    """The bundle's event ring + trigger header (``None`` + a reason on
+    any parse problem — the join must degrade, not crash the report)."""
+    try:
+        with open(path) as f:
+            blob = json.load(f)
+        events = blob.get("events")
+        if not isinstance(events, list):
+            return None, "bundle carries no events ring"
+        return {"trigger": blob.get("trigger") or {},
+                "events": events}, None
+    except (OSError, ValueError) as e:
+        return None, str(e)
+
+
+def correlate_blackbox(events, bundle, window_s=5.0):
+    """``[(sentinel event, [nearby ring events])]`` — ring events whose
+    epoch stamp falls within ``window_s`` of each sentinel event."""
+    out = []
+    ring = bundle.get("events", []) if bundle else []
+    for ev in events:
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            out.append((ev, []))
+            continue
+        near = [r for r in ring
+                if isinstance(r.get("t"), (int, float))
+                and abs(r["t"] - t) <= window_s]
+        out.append((ev, near))
+    return out
+
+
+def format_blackbox_join(pairs, path, err):
+    out = ["", "blackbox correlation (%s):" % path]
+    if err:
+        out.append("  unreadable bundle: %s" % err)
+        return "\n".join(out)
+    if not pairs:
+        out.append("  no sentinel events to correlate")
+        return "\n".join(out)
+    for ev, near in pairs:
+        out.append("  [%s] %s %s %s/%s:" % (
+            ev.get("t", "?"), ev.get("classification", "?"),
+            ev.get("kind", "?"), ev.get("op", "?"),
+            ev.get("bucket", "?")))
+        if not near:
+            out.append("    (no recorder events in the window)")
+        for r in near:
+            dt = float(r.get("t", 0.0)) - float(ev.get("t", 0.0))
+            fields = " ".join(
+                "%s=%s" % (k, r[k]) for k in sorted(r)
+                if k not in ("t", "kind") and r[k] is not None)
+            out.append("    %+7.3fs %-20s %s"
+                       % (dt, r.get("kind", "?"), fields))
+    return "\n".join(out)
+
+
 def format_tables(rows, events, last_snapshot):
     out = []
     heads = ["op", "bucket", "count", "err", "p50_ms", "p95_ms",
@@ -202,20 +266,43 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 when the log carries any sentinel "
                          "degradation event")
+    ap.add_argument("--blackbox",
+                    help="flight-recorder bundle to correlate the "
+                         "sentinel events against (ring events within "
+                         "--blackbox-window seconds of each event)")
+    ap.add_argument("--blackbox-window", type=float, default=5.0,
+                    help="correlation half-width in seconds "
+                         "(default %(default)s)")
     args = ap.parse_args(argv)
 
     recs, bad = load_records(args.logs)
     rows, events, last_snapshot = aggregate(recs, op_filter=args.op)
     degradations = [e for e in events
                     if e.get("classification") == "degradation"]
+    bundle = bb_err = pairs = None
+    if args.blackbox:
+        bundle, bb_err = load_blackbox(args.blackbox)
+        pairs = correlate_blackbox(events, bundle,
+                                   window_s=args.blackbox_window)
     if args.json:
-        print(json.dumps({
+        blob = {
             "records": len(recs), "malformed": bad,
             "rows": list(rows.values()), "sentinel_events": events,
             "degradations": len(degradations),
-        }, indent=1))
+        }
+        if args.blackbox:
+            blob["blackbox"] = {
+                "path": args.blackbox, "error": bb_err,
+                "trigger": (bundle or {}).get("trigger"),
+                "correlated": [
+                    {"event": ev, "nearby": near}
+                    for ev, near in (pairs or [])]}
+        print(json.dumps(blob, indent=1))
     else:
         print(format_tables(rows, events, last_snapshot))
+        if args.blackbox:
+            print(format_blackbox_join(pairs or [], args.blackbox,
+                                       bb_err))
         if bad:
             print("\n%d malformed line(s) skipped" % bad)
     return 1 if (args.strict and degradations) else 0
