@@ -278,6 +278,136 @@ def blackbox_smoke() -> int:
         return 1
 
 
+def split_smoke() -> int:
+    """The --split fast tier (ISSUE 16): two fresh subprocesses on CPU.
+    Leg 1 forces the bf16x3 split-gemm backend
+    (``SLATE_TPU_SPLIT_GEMM=1``) at interpret-safe dims and proves the
+    SHIPPED dispatch takes it — gesv/posv residual-gate clean end to
+    end, the mixed-precision wrapper rides the split factor leg, and
+    the autotune census pins a ``matmul -> split3`` decision.  Leg 2
+    proves the health-demotion path: a seeded demotable (timed) split3
+    winner plus one injected NaN under ``SLATE_TPU_HEALTH=retry`` must
+    quarantine split3 while the stock re-run answers clean."""
+    import tempfile
+
+    here = pathlib.Path(__file__).resolve().parent
+    code1 = (
+        "import numpy as np, jax.numpy as jnp\n"
+        "import slate_tpu as st\n"
+        "from slate_tpu.perf import autotune\n"
+        "eps = float(np.finfo(np.float32).eps)\n"
+        "rng = np.random.default_rng(16)\n"
+        "n, nrhs = 256, 3\n"
+        "a = (rng.standard_normal((n, n)).astype(np.float32)\n"
+        "     + n * np.eye(n, dtype=np.float32))\n"
+        "b = rng.standard_normal((n, nrhs)).astype(np.float32)\n"
+        "lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=128),\n"
+        "                      jnp.asarray(b))\n"
+        "xv = np.asarray(x)\n"
+        "res = (np.linalg.norm(a @ xv - b)\n"
+        "       / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))\n"
+        "assert res < 3.0, res\n"
+        "g = rng.standard_normal((n, n)).astype(np.float32)\n"
+        "spd = g @ g.T / n + np.eye(n, dtype=np.float32)\n"
+        "fac, x2 = st.posv(st.HermitianMatrix(jnp.asarray(spd),\n"
+        "                                     uplo=st.Uplo.Lower),\n"
+        "                  jnp.asarray(b))\n"
+        "x2v = np.asarray(x2)\n"
+        "res2 = (np.linalg.norm(spd @ x2v - b)\n"
+        "        / (np.linalg.norm(spd) * np.linalg.norm(x2v) * n * eps))\n"
+        "assert res2 < 3.0, res2\n"
+        "x3, iters = st.posv_mixed(st.HermitianMatrix(jnp.asarray(spd),\n"
+        "                                             uplo=st.Uplo.Lower),\n"
+        "                          jnp.asarray(b))\n"
+        "x3v = np.asarray(x3)\n"
+        "res3 = (np.linalg.norm(spd @ x3v - b)\n"
+        "        / (np.linalg.norm(spd) * np.linalg.norm(x3v) * n * eps))\n"
+        "assert res3 < 3.0, res3\n"
+        "dec = autotune.decisions()\n"
+        "assert any(k.startswith('matmul|') and v == 'split3'\n"
+        "           for k, v in dec.items()), dec\n"
+        "print('split smoke: gesv resid %.3g, posv resid %.3g, '\n"
+        "      'posv_mixed resid %.3g (iters %d)'\n"
+        "      % (res, res2, res3, int(iters)))\n"
+    )
+    code2 = (
+        "import numpy as np, jax.numpy as jnp\n"
+        "import slate_tpu as st\n"
+        "from slate_tpu.perf import autotune, metrics\n"
+        "metrics.on()\n"
+        "tab = autotune.table()\n"
+        "key = 'matmul|256,256,256,float32,highest'\n"
+        "tab._record('matmul', key, 'split3', 'timed')\n"
+        "eps = float(np.finfo(np.float32).eps)\n"
+        "rng = np.random.default_rng(5)\n"
+        "n = 128\n"
+        "a = (rng.standard_normal((n, n)).astype(np.float32)\n"
+        "     + n * np.eye(n, dtype=np.float32))\n"
+        "b = rng.standard_normal((n, 2)).astype(np.float32)\n"
+        "lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=64),\n"
+        "                      jnp.asarray(b))\n"
+        "xv = np.asarray(x)\n"
+        "assert np.isfinite(xv).all()\n"
+        "res = (np.linalg.norm(a @ xv - b)\n"
+        "       / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))\n"
+        "assert res < 3.0, res\n"
+        "q = tab.quarantine\n"
+        "assert any('split3' in bks for bks in q.values()), q\n"
+        "snap = metrics.snapshot()['counters']\n"
+        "assert snap.get('resilience.recovered', 0.0) >= 1.0, snap\n"
+        "print('SPLIT-DEMOTE-OK')\n"
+    )
+    checks = {}
+    with tempfile.TemporaryDirectory() as td:
+        env1 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    SLATE_TPU_SPLIT_GEMM="1",
+                    SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "c1.json"))
+        for k in ("SLATE_TPU_AUTOTUNE_FORCE", "SLATE_TPU_AUTOTUNE_BUNDLE",
+                  "SLATE_TPU_FAULT_INJECT", "SLATE_TPU_HEALTH"):
+            env1.pop(k, None)
+        print("=== split tier leg 1: SLATE_TPU_SPLIT_GEMM=1 (forced "
+              "bf16x3, residual-gated, census-pinned)", flush=True)
+        try:
+            r1 = subprocess.run([sys.executable, "-c", code1], env=env1,
+                                cwd=str(here), timeout=900)
+            checks["forced split3 residual-gates + census pin"] = \
+                r1.returncode == 0
+        except subprocess.TimeoutExpired:
+            checks["forced split3 residual-gates + census pin"] = False
+        # count 2: the first fault lands on getrf, whose Matrix-wrapped
+        # output the injector leaves alone; the second poisons getrs's
+        # raw solution array, which trips the finite gate
+        env2 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    SLATE_TPU_HEALTH="retry",
+                    SLATE_TPU_FAULT_INJECT="driver.output=nan:1:2",
+                    SLATE_TPU_FAULT_SEED="3",
+                    SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "c2.json"))
+        for k in ("SLATE_TPU_AUTOTUNE_FORCE", "SLATE_TPU_AUTOTUNE_BUNDLE",
+                  "SLATE_TPU_SPLIT_GEMM"):
+            env2.pop(k, None)
+        print("=== split tier leg 2: SLATE_TPU_FAULT_INJECT="
+              + env2["SLATE_TPU_FAULT_INJECT"]
+              + " (health gate demotes split3)", flush=True)
+        try:
+            r2 = subprocess.run([sys.executable, "-c", code2], env=env2,
+                                cwd=str(here), capture_output=True,
+                                text=True, timeout=900)
+            checks["health gate quarantines split3, stock recovers"] = \
+                r2.returncode == 0 and "SPLIT-DEMOTE-OK" in r2.stdout
+            if r2.returncode != 0:
+                print(r2.stdout)
+                print(r2.stderr)
+        except subprocess.TimeoutExpired:
+            checks["health gate quarantines split3, stock recovers"] = False
+    for name, ok in checks.items():
+        print("  %s: %s" % (name, "ok" if ok else "FAIL"), flush=True)
+    if all(checks.values()):
+        print("==== split smoke passed ====")
+        return 0
+    print("==== split smoke FAILED ====")
+    return 1
+
+
 def sweep_smoke() -> int:
     """The --sweep tier: tiny CPU grid end-to-end through the CLI in a
     subprocess (sweep → versioned bundle artifact), then a second fresh
@@ -416,6 +546,13 @@ def main(argv=None):
                     "exercises the full-depth mega-kernels on CPU "
                     "every run (see docs/usage.md Whole-factorization "
                     "kernels)")
+    ap.add_argument("--split", action="store_true",
+                    help="split-precision gemm smoke: force the bf16x3 "
+                    "backend (SLATE_TPU_SPLIT_GEMM=1) at interpret-safe "
+                    "dims — gesv/posv residual-gated, autotune census "
+                    "pinned — then prove the health gate demotes a "
+                    "seeded split3 winner under injected corruption "
+                    "(see docs/usage.md Split-precision gemm)")
     args = ap.parse_args(argv)
 
     if args.telemetry:
@@ -429,6 +566,9 @@ def main(argv=None):
 
     if args.full_fused:
         return full_fused_smoke()
+
+    if args.split:
+        return split_smoke()
 
     if args.chaos:
         # setdefault: an explicit operator plan/tier wins over the can

@@ -22,7 +22,8 @@ that knob is moot.  The knobs that matter on TPU instead:
 
 Env vars: ``SLATE_TPU_PRECISION`` ∈ {highest, high, default},
 ``SLATE_TPU_NB`` (int), and the tri-state backend knobs
-``SLATE_TPU_USE_PALLAS`` / ``SLATE_TPU_F64_MXU`` ∈ {auto, 1, 0}
+``SLATE_TPU_USE_PALLAS`` / ``SLATE_TPU_F64_MXU`` /
+``SLATE_TPU_SCATTERED_LU`` / ``SLATE_TPU_SPLIT_GEMM`` ∈ {auto, 1, 0}
 consumed by the autotuned dispatch layer
 (:mod:`slate_tpu.perf.autotune`; see also ``SLATE_TPU_AUTOTUNE``,
 ``SLATE_TPU_AUTOTUNE_CACHE``, ``SLATE_TPU_AUTOTUNE_FORCE`` there).
@@ -98,6 +99,18 @@ f64_mxu = _tri_state("SLATE_TPU_F64_MXU")
 #: every other multi-backend site.)
 scattered_lu = _tri_state("SLATE_TPU_SCATTERED_LU")
 
+#: Route fp32 2-D matmuls through the bf16x3/bf16x6 split-product MXU
+#: kernel (:mod:`slate_tpu.ops.split_gemm`): HIGHEST-grade (~k·ε₃₂
+#: envelope) accuracy at 3 (or 6) bf16 passes instead of the 6-pass
+#: emulated fp32 dot.  Tri-state (``SLATE_TPU_SPLIT_GEMM``): ``auto``
+#: (default) admits the split as an autotune candidate at the
+#: ``matmul`` site on TPU — off-TPU the ladder still resolves to stock
+#: XLA, so unset-knob lowering stays bit-identical; ``1`` forces
+#: ``split3`` for every eligible fp32 product (no 128-alignment
+#: requirement — the K-fold is a concat, not a tile grid); ``0``
+#: removes the split candidates everywhere.
+split_gemm = _tri_state("SLATE_TPU_SPLIT_GEMM")
+
 
 def use_pallas_mode() -> str:
     """Resolve the tri-state :data:`use_pallas` knob to one of
@@ -118,4 +131,11 @@ def scattered_lu_mode() -> str:
     """Resolve the tri-state :data:`scattered_lu` knob to
     ``"auto" | "on" | "off"``."""
     v = scattered_lu
+    return "auto" if v == "auto" else ("on" if v else "off")
+
+
+def split_gemm_mode() -> str:
+    """Resolve the tri-state :data:`split_gemm` knob to
+    ``"auto" | "on" | "off"``."""
+    v = split_gemm
     return "auto" if v == "auto" else ("on" if v else "off")

@@ -21,7 +21,8 @@ from .norms import (  # noqa: F401
     col_norms, gbnorm, genorm, hbnorm, henorm, norm, synorm, trnorm,
 )
 from .qr import (  # noqa: F401
-    cholqr, gelqf, gels, gels_cholqr, gels_qr, geqrf, ungqr, unmlq, unmqr,
+    cholqr, gelqf, gels, gels_cholqr, gels_mixed, gels_qr, geqrf, ungqr,
+    unmlq, unmqr,
 )
 from .util import add, copy, scale, scale_row_col, set  # noqa: F401
 from .eig import (  # noqa: F401

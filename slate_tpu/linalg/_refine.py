@@ -19,6 +19,8 @@ Stopping criterion (both loops, reference ``gesv_mixed.cc``):
 
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -170,12 +172,61 @@ def fgmres_refine(av, bv, precond, solve_full, *, anorm, thresh, itermax,
 
 
 def lo_dtype(dtype):
-    """The reference pairs fp64→fp32 (``gesv_mixed`` 278 LoC).  fp32→bf16
-    is *not* accurate enough for IR's contraction bound, so fp64→fp32 and
-    fp32→fp32 (no-op refine) are used."""
+    """The reference pairs fp64→fp32 (``gesv_mixed`` 278 LoC).  A raw
+    fp32→bf16 demotion is *not* accurate enough for IR's contraction
+    bound, so fp64→fp32 and fp32→fp32 are used — but the fp32 "low" leg
+    is not a no-op on TPU: under :func:`split_factor_leg` its trailing
+    updates run as bf16x3 split products
+    (:mod:`slate_tpu.ops.split_gemm`), ε₃₂-grade accuracy at the MXU's
+    bf16 rate, so the fp32→fp32 pairing gets a genuine speed leg the
+    residual loop then polishes."""
     d = jnp.dtype(dtype)
     if d == jnp.float64:
         return jnp.float32
     if d == jnp.complex128:
         return jnp.complex64
     return d
+
+
+def use_split_leg(dtype) -> bool:
+    """Should an fp32 mixed-precision driver factor its low leg under
+    :func:`split_factor_leg`?  True for real fp32 operands when the
+    split-gemm knob is forced on, or (``auto``) when running on TPU —
+    where the bf16x3 trailing updates actually outrun the emulated-fp32
+    dot.  Off-TPU ``auto`` resolves False so default CPU lowering (and
+    CI timing) is untouched; ``SLATE_TPU_SPLIT_GEMM=0`` disables the
+    leg everywhere."""
+    from .. import config
+
+    if jnp.dtype(dtype) != jnp.float32:
+        return False
+    mode = config.split_gemm_mode()
+    if mode != "auto":
+        return mode == "on"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@contextmanager
+def split_factor_leg():
+    """Force the bf16x3 split backend at the ``matmul`` site for the
+    scope of a mixed driver's low-precision factor leg: every eligible
+    fp32 trailing update inside resolves to ``split3`` (the
+    ``config.split_gemm`` pin, no 128-alignment requirement), and the
+    forced resolutions are kept out of the stored autotune table
+    (:func:`~slate_tpu.perf.autotune.suppress_knob_records`) so a
+    refinement leg cannot pollute the census or bundles the
+    unconstrained sites train on.  The knob is restored on exit even if
+    the factor throws."""
+    from .. import config
+    from ..perf import autotune
+
+    saved = config.split_gemm
+    config.split_gemm = True
+    try:
+        with autotune.suppress_knob_records():
+            yield
+    finally:
+        config.split_gemm = saved

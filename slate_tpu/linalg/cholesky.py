@@ -252,7 +252,7 @@ def _posv_mixed_setup(a, b, opts, tol):
     from ..enums import Norm
     from ..options import get_option
     from .norms import norm as _norm
-    from ._refine import lo_dtype
+    from ._refine import lo_dtype, split_factor_leg, use_split_leg
 
     full = _hermitian_full(a)
     bv = _arr(b)
@@ -266,7 +266,30 @@ def _posv_mixed_setup(a, b, opts, tol):
               else float(eps) * float(jnp.sqrt(n)))
 
     lo = lo_dtype(full.dtype)
-    l_lo = blocks.potrf_rec(full.astype(lo), nb)
+    if use_split_leg(lo):
+        # fp32 low-precision leg on the MXU's bf16 peak: factor with
+        # every trailing update forced through the bf16x3 split product
+        # (ops/split_gemm.py, ~3·k·ε₃₂ backward error — inside what the
+        # refinement loop contracts).  Condition-aware demotion: when
+        # κ(A)·n·ε₃₂ approaches 1 the split factor cannot seed a
+        # converging iteration, so re-factor stock before the loop ever
+        # stagnates into the full-precision fallback.
+        import math
+
+        from .condest import norm1est
+
+        with split_factor_leg():
+            l_lo = blocks.potrf_rec(full.astype(lo), nb)
+        n_ = full.shape[-1]
+        ainv = norm1est(
+            lambda v: _chol_solve(l_lo, v.astype(lo), nb),
+            lambda v: _chol_solve(l_lo, v.astype(lo), nb), n_)
+        kappa_eps = (float(anorm) * float(ainv) * n_
+                     * float(jnp.finfo(lo).eps))
+        if not math.isfinite(kappa_eps) or kappa_eps > 0.25:
+            l_lo = blocks.potrf_rec(full.astype(lo), nb)
+    else:
+        l_lo = blocks.potrf_rec(full.astype(lo), nb)
     solve_lo = jax.jit(
         lambda r: _chol_solve(l_lo, r.astype(lo), nb).astype(full.dtype))
 

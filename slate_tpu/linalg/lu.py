@@ -1009,6 +1009,38 @@ def getri(lu, perm, opts: Optional[Options] = None):
 from ._refine import fgmres_refine, ir_refine, lo_dtype as _lo_dtype
 
 
+def _getrf_lo(av, lo, nb, anorm):
+    """Low-precision LU factor leg shared by the mixed drivers.  Under
+    :func:`~slate_tpu.linalg._refine.use_split_leg` an fp32 leg factors
+    with every trailing update forced through the bf16x3 split product
+    (:mod:`slate_tpu.ops.split_gemm`, ~3·k·ε₃₂ backward error at the
+    MXU's bf16 rate); a Higham–Tisseur condition probe on the fresh
+    factor (the :func:`~slate_tpu.linalg.condest.gecondest` closures)
+    demotes back to the stock factor when κ(A)·n·ε₃₂ approaches 1 —
+    past that a split-seeded iteration cannot contract and would only
+    stagnate into the full-precision fallback."""
+    from ._refine import split_factor_leg, use_split_leg
+
+    if not use_split_leg(lo):
+        return getrf_rec(av.astype(lo), nb)
+    import math
+
+    from .condest import norm1est
+
+    with split_factor_leg():
+        lu_lo, perm = _getrf_lo(av, lo, nb, anorm)
+    n = av.shape[-1]
+    ainv = norm1est(
+        lambda v: as_array(getrs(lu_lo, perm, v.astype(lo))),
+        lambda v: as_array(getrs(lu_lo, perm, v.astype(lo),
+                                 op=Op.ConjTrans)), n)
+    kappa_eps = (float(anorm) * float(ainv) * n
+                 * float(jnp.finfo(lo).eps))
+    if not math.isfinite(kappa_eps) or kappa_eps > 0.25:
+        return getrf_rec(av.astype(lo), nb)
+    return lu_lo, perm
+
+
 def gesv_mixed(a, b, opts: Optional[Options] = None, *, tol=None,
                return_info: bool = False):
     """Mixed-precision LU solve with iterative refinement — reference
@@ -1033,7 +1065,7 @@ def gesv_mixed(a, b, opts: Optional[Options] = None, *, tol=None,
               else float(eps) * float(jnp.sqrt(n)))
 
     lo = _lo_dtype(av.dtype)
-    lu_lo, perm = getrf_rec(av.astype(lo), nb)
+    lu_lo, perm = _getrf_lo(av, lo, nb, anorm)
     solve_lo = jax.jit(
         lambda r: _lu_solve(lu_lo, perm, r.astype(lo), nb).astype(av.dtype))
 
@@ -1069,7 +1101,7 @@ def gesv_mixed_gmres(a, b, opts: Optional[Options] = None, *, tol=None,
     thresh = float(tol) if tol is not None else float(eps) * float(jnp.sqrt(n))
 
     lo = _lo_dtype(av.dtype)
-    lu_lo, perm = getrf_rec(av.astype(lo), nb)
+    lu_lo, perm = _getrf_lo(av, lo, nb, anorm)
     precond = jax.jit(
         lambda r: _lu_solve(lu_lo, perm, r.astype(lo), nb).astype(av.dtype))
 

@@ -563,3 +563,95 @@ def gels(a, b, opts: Optional[Options] = None):
     if method is MethodGels.CholQR and m >= n:
         return gels_cholqr(a, b, opts)
     return gels_qr(a, b, opts)
+
+
+def gels_mixed(a, b, opts: Optional[Options] = None, *, tol=None):
+    """Mixed-precision least squares with iterative refinement — the QR
+    analogue of ``gesv_mixed``/``posv_mixed`` (the reference has no
+    gels_mixed; this is corrected semi-normal equations, Björck 1987,
+    over the shared refine core).  Factor A = Q·R once in the low leg —
+    an fp32 leg runs its trailing updates through the bf16x3 split
+    product under :func:`~slate_tpu.linalg._refine.split_factor_leg` —
+    then iterate the NORMAL-EQUATION residual ``s = Aᴴ(b − A·x)``,
+    which vanishes at the LS solution even when the plain residual does
+    not; each correction solves the semi-normal equations
+    ``Rᴴ·R·d = s`` (two triangular sweeps against the resident low
+    factor).  Condition-aware demotion re-factors stock when
+    κ(R)²·n·ε_lo approaches 1 (the SNE contraction bound).
+    Overdetermined shapes only (m ≥ n).  Returns ``(x, iters)``;
+    negative ``iters`` flags the working-precision :func:`gels_qr`
+    fallback (reference info convention)."""
+
+    from ..enums import Norm
+    from .norms import norm as _norm
+    from ._refine import (ir_refine_core, lo_dtype, split_factor_leg,
+                          use_split_leg)
+
+    av, bv = as_array(a), as_array(b)
+    m, n = av.shape
+    if m < n:
+        raise ValueError("gels_mixed refines overdetermined systems "
+                         "(m >= n); use gels for minimum-norm shapes")
+    nb = _nb(a, opts)
+    itermax = int(get_option(opts, "max_iterations", 30))
+    use_fallback = bool(get_option(opts, "use_fallback_solver", True))
+    squeeze = bv.ndim == 1
+    if squeeze:
+        bv = bv[:, None]
+    eps = float(jnp.finfo(av.dtype).eps)
+    # the refined operator is AᴴA: scale the stopping test with
+    # ‖AᴴA‖∞ ≤ ‖Aᴴ‖∞·‖A‖∞ = ‖A‖₁·‖A‖∞
+    anorm2 = float(_norm(Norm.One, av)) * float(_norm(Norm.Inf, av))
+    thresh = float(tol) if tol is not None else eps * float(n) ** 0.5
+
+    lo = lo_dtype(av.dtype)
+
+    def _factor():
+        f, _taus = geqrf_rec(av.astype(lo), nb)
+        return jnp.triu(f[:n])
+
+    if use_split_leg(lo):
+        import math
+
+        from .condest import norm1est
+
+        with split_factor_leg():
+            r_lo = _factor()
+        # κ₁(R)²·n·ε_lo is the SNE contraction bound: past ~0.25 the
+        # semi-normal corrections stop converging on a split factor,
+        # so demote to the stock low-precision factorization
+        rinv = norm1est(
+            lambda v: blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit,
+                                      r_lo, v.astype(lo), nb),
+            lambda v: blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit,
+                                      _ct(r_lo), v.astype(lo), nb), n)
+        kappa = float(_norm(Norm.One, r_lo)) * float(rinv)
+        ke = kappa * kappa * n * float(jnp.finfo(lo).eps)
+        if not math.isfinite(ke) or ke > 0.25:
+            r_lo = _factor()
+    else:
+        r_lo = _factor()
+
+    ah = _ct(av)
+
+    def solve_lo(s):
+        w = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit,
+                            _ct(r_lo), s.astype(lo), nb)
+        d = blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit,
+                            r_lo, w, nb)
+        return d.astype(av.dtype)
+
+    def solve_full(_s0):
+        # working-precision fallback: stock gels_qr on the ORIGINAL
+        # right-hand side (the core hands us the normal-equation rhs,
+        # which the full path does not need)
+        return as_array(gels_qr(av, bv, opts))
+
+    residual = jax.jit(lambda x: matmul_hi(ah, bv - matmul_hi(av, x)))
+    s0 = residual(jnp.zeros((n, bv.shape[1]), av.dtype))
+    x, iters = ir_refine_core(s0, solve_lo, solve_full, residual,
+                              anorm=anorm2, thresh=thresh,
+                              itermax=itermax, use_fallback=use_fallback)
+    if squeeze:
+        x = x[:, 0]
+    return _wrap_like(b, x), iters

@@ -56,9 +56,11 @@ def matmul(a, b):
     choose_matmul` for the measured winner among stock XLA dot, the
     hand-tuned Pallas VMEM kernel
     (:func:`slate_tpu.ops.pallas_kernels.matmul`, f32-class tile-grid-
-    aligned shapes) and the Ozaki int8-slice fp64 MXU kernel
-    (:mod:`slate_tpu.ops.ozaki`, real fp64 on TPU).  The tri-state
-    ``config.use_pallas`` / ``config.f64_mxu`` knobs force a backend on
+    aligned shapes), the Ozaki int8-slice fp64 MXU kernel
+    (:mod:`slate_tpu.ops.ozaki`, real fp64 on TPU) and the bf16-slice
+    fp32 split kernels (:mod:`slate_tpu.ops.split_gemm`, bf16x3/bf16x6
+    on the MXU's bf16 peak).  The tri-state ``config.use_pallas`` /
+    ``config.f64_mxu`` / ``config.split_gemm`` knobs force a backend on
     or off; complex and batched operands always take the XLA path.
     """
     if (a.ndim == 2 and b.ndim == 2 and a.dtype == b.dtype
@@ -70,6 +72,11 @@ def matmul(a, b):
             from .ozaki import matmul_f64
 
             return matmul_f64(a, b)
+        if backend in ("split3", "split6"):
+            from .split_gemm import matmul_split3, matmul_split6
+
+            return (matmul_split3 if backend == "split3"
+                    else matmul_split6)(a, b)
         if backend == "pallas":
             from .pallas_kernels import matmul as pallas_matmul
 
@@ -90,6 +97,38 @@ def matmul_hi(a, b):
     loosen them: these sites feed error estimates whose own error must
     sit well below what they measure."""
     return jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+
+
+def matmul_backend(shape_a, shape_b, dtype) -> str:
+    """Resolved matmul backend name for a 2-D product — the ops-layer
+    face of :func:`~slate_tpu.perf.autotune.choose_matmul`.  The
+    distributed drivers consult it BEFORE their cached shard_map builds
+    so a split-gemm winner lets them pre-split a resident panel once
+    per step (the registry contract keeps the backend kernel modules
+    importable from ops/ only)."""
+    from ..perf.autotune import choose_matmul
+
+    return choose_matmul(shape_a, shape_b, dtype)
+
+
+def panel_split(x):
+    """bf16 mantissa slices of a resident fp32 panel — re-export of
+    :func:`slate_tpu.ops.split_gemm.split_slices` for the
+    registry-guarded layers.  Split once per panel; because the
+    elementwise split commutes with slicing, windows of the result feed
+    :func:`matmul_presplit` per strip with no re-split."""
+    from .split_gemm import split_slices
+
+    return split_slices(x)
+
+
+def matmul_presplit(backend: str, sa, sb):
+    """Split-product dot over pre-split operand slices — re-export of
+    :func:`slate_tpu.ops.split_gemm.matmul_sliced` (``backend`` ∈
+    {"split3", "split6"})."""
+    from .split_gemm import matmul_sliced
+
+    return matmul_sliced(backend, sa, sb)
 
 
 def _split(n: int, nb: int) -> int:
@@ -568,12 +607,36 @@ def _potrf_strips(a, nb, panel):
             # the fused path at zero.
             nstrips = len(range(k0 + w, n, ws))
             metrics.count_hbm_roundtrips(1.0 + nstrips)
+            # LP-GEMM operand folding: when the matmul site resolves to
+            # a split backend for this step's strip products, the
+            # resident panel splits into its bf16 slices ONCE here —
+            # the elementwise split commutes with slicing, so every
+            # strip reuses row/column windows of the same slices
+            # instead of re-splitting per chunk.
+            sl = sr = None
+            if a.ndim == 2 and a.dtype == jnp.float32 and nstrips:
+                from ..perf.autotune import choose_matmul
+
+                mrem = n - (k0 + w)
+                sbk = choose_matmul((mrem, w), (w, mrem), a.dtype)
+                if sbk in ("split3", "split6"):
+                    from .split_gemm import split_slices
+
+                    sl = split_slices(l21)
+                    sr = tuple(_ct(s) for s in sl)
             with metrics.step_timer("potrf", "update"):
                 for j0 in range(k0 + w, n, ws):
                     jw = min(ws, n - j0)
-                    lj = l21[j0 - (k0 + w):j0 - (k0 + w) + jw]
-                    a = a.at[j0:, j0:j0 + jw].add(
-                        -matmul(l21[j0 - (k0 + w):], _ct(lj)))
+                    o = j0 - (k0 + w)
+                    if sl is not None:
+                        from .split_gemm import matmul_sliced
+
+                        upd = matmul_sliced(
+                            sbk, tuple(s[o:] for s in sl),
+                            tuple(s[:, o:o + jw] for s in sr))
+                    else:
+                        upd = matmul(l21[o:], _ct(l21[o:o + jw]))
+                    a = a.at[j0:, j0:j0 + jw].add(-upd)
     return jnp.tril(a)
 
 

@@ -1,0 +1,157 @@
+"""Split-precision fp32 matmul on the bf16 MXU (bf16x3 / bf16x6).
+
+The fp32 sibling of the Ozaki int8-slice fp64 kernel
+(:mod:`slate_tpu.ops.ozaki`), exploiting the same exactness property of
+MXU accumulation one precision class down:
+
+* **Slicing.**  bf16 shares fp32's 8-bit exponent, so — unlike the
+  int8 split — NO per-row/column pow2 scaling is needed.  Each fp32
+  operand splits into three bf16 mantissa slices at their natural
+  scale::
+
+      s0 = bf16(x);  r1 = x − f32(s0)     # exact (Sterbenz-style:
+      s1 = bf16(r1); r2 = r1 − f32(s1)    #  both terms are multiples
+      s2 = bf16(r2)                        #  of ulp(x), diff < 2²⁴ ulp)
+
+  The dropped tail |x − Σsᵢ| is ≲ 2⁻²⁵·|x| — below fp32 resolution.
+
+* **Exact pair products.**  Each slice carries ≤ 8 mantissa bits, so
+  any pairwise product sᵢ(a)·sⱼ(b) has ≤ 16 significant bits and is
+  EXACT in the MXU's native bf16×bf16→fp32 accumulation mode; only the
+  k-direction accumulation rounds, in fp32.
+
+* **bf16x3** (:func:`matmul_split3`, throughput grade): fold the three
+  DOMINANT slice pairs along K — ``concat([s0a,s0a,s1a], 1) @
+  concat([s0b,s1b,s0b], 0)`` — so ONE ``lax.dot`` of length 3k
+  computes s₀a·s₀b + s₀a·s₁b + s₁a·s₀b inside the fp32 accumulator.
+  This is the LP-GEMM operand-folding trick: 3 bf16-gemm-equivalents
+  total, and a pre-split resident panel (:func:`split_slices`) folds
+  once, not once per chunk.  The dropped pairs (s₁s₁, s₀s₂, s₂s₀) are
+  each ≤ 2⁻¹⁶·|a||b|, so the componentwise error is
+  ≈ (2⁷ + 3k)·ε₃₂·(|a|·|b|) — inside the stock fp32 gemm's k·ε₃₂
+  backward-error envelope class for the blocked drivers' trailing
+  contractions (k ≥ 64), and a full precision class above the
+  library-default ``high`` 3-pass dot (~1.3e-5 componentwise, which
+  never meets that envelope).
+
+* **bf16x6** (:func:`matmul_split6`, accuracy grade): Ozaki-style
+  diagonal combining — keep ALL slice-pair diagonals tot = i+j ≤ 2
+  (six products, 6 bf16 passes), accumulate each diagonal in its own
+  fp32 dot and sum them smallest-magnitude-first.  No dropped-pair
+  floor: true ~3k·ε₃₂ componentwise (``Precision.HIGHEST`` grade),
+  with each accumulator only ever adding same-magnitude terms — for
+  ill-scaled or short-k trailing updates where the 3-pass variant's
+  2⁻¹⁶ envelope term shows.
+
+Caveats (the documented contract, matching ``ozaki.py``):
+
+* **Subnormals flush (DAZ/FTZ).**  TPU flushes bf16 subnormals: slices
+  whose scale falls below 2⁻¹²⁶ vanish, so inputs within ~2⁸ of the
+  fp32 subnormal range lose low-order slices and fully subnormal
+  inputs contribute zero.  Same semantics as the int8 split's flush.
+* **Non-finite inputs produce garbage.**  Inf/NaN survive the bf16
+  cast but the residual recurrence (∞ − ∞) manufactures NaN.  Callers
+  that admit non-finite data must gate on the input, as the drivers'
+  residual/health gates do.
+* fp32 2-D operands only — the split is pointless for bf16 inputs and
+  wrong for fp64 (use :mod:`.ozaki`).
+
+Throughput: 3 (split3) or 6 (split6) bf16 passes against the MXU's
+bf16 peak (~2–3.3× the fp32 ``HIGHEST`` rate on v5e), priced in the
+offline sweep against ``SLATE_TPU_PEAK_TFLOPS_BF16``.  Selection is
+the ``matmul`` autotune site (``SLATE_TPU_SPLIT_GEMM`` tri-state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+#: bf16 mantissa slices per fp32 operand — 3×8 explicit bits cover the
+#: 24-bit fp32 significand
+NSLICES = 3
+
+
+def _guard(a, b) -> None:
+    if a.ndim != 2 or b.ndim != 2:
+        raise ValueError(
+            "split gemm is 2-D only (the blocked drivers' tile and "
+            f"trailing-update products); got {a.ndim}-D @ {b.ndim}-D")
+    if a.dtype != jnp.float32 or b.dtype != jnp.float32:
+        raise TypeError(
+            "split gemm wants float32 operands (bf16x3 slices share "
+            f"fp32's exponent range); got {a.dtype} @ {b.dtype}")
+
+
+def split_slices(x):
+    """The three bf16 mantissa slices of fp32 ``x`` (elementwise, any
+    shape), with ``s0 + s1 + s2 == x`` to ~2⁻²⁵ relative.
+
+    The split is ELEMENTWISE, so slicing commutes with splitting:
+    ``split_slices(x)[i][rows, cols] == split_slices(x[rows, cols])[i]``
+    bit-for-bit.  That is what makes panel folding work — a resident
+    trailing-update panel splits ONCE and every strip product reuses
+    row/column windows of the same slices (LP-GEMM operand folding).
+    """
+    s = []
+    r = x
+    for _ in range(NSLICES):
+        si = r.astype(jnp.bfloat16)
+        s.append(si)
+        r = r - si.astype(jnp.float32)
+    return tuple(s)
+
+
+def matmul_sliced3(sa, sb):
+    """bf16x3 product from pre-split operands: ``sa`` are the lhs
+    slices (each (m, k)), ``sb`` the rhs slices (each (k, n)).  The
+    three DOMINANT slice pairs — s₀a·s₀b + s₀a·s₁b + s₁a·s₀b, every
+    product of magnitude ≥ 2⁻⁸·|ab| — folded along K into ONE dot of
+    length 3k in the fp32 MXU accumulator.  A same-length fold of the
+    (i, i) diagonal would drop the 2⁻⁸ cross terms and land at bf16
+    grade; pairing (0,0), (0,1), (1,0) leaves only the ≤ 2⁻¹⁶ terms
+    (s₁s₁, s₀s₂, s₂s₀) out of the sum."""
+    fa = jnp.concatenate((sa[0], sa[0], sa[1]), axis=1)  # (m, 3k) bf16
+    fb = jnp.concatenate((sb[0], sb[1], sb[0]), axis=0)  # (3k, n) bf16
+    return lax.dot(fa, fb, preferred_element_type=jnp.float32)
+
+
+def matmul_sliced6(sa, sb):
+    """bf16x6 product from pre-split operands: the three slice-pair
+    diagonals tot = i+j ≤ 2 as separate fp32-accumulated dots, summed
+    smallest-first so each addition only rounds against terms of its
+    own magnitude."""
+    def diag(xs, ys):
+        return lax.dot(jnp.concatenate(xs, axis=1),
+                       jnp.concatenate(ys, axis=0),
+                       preferred_element_type=jnp.float32)
+
+    d2 = diag((sa[0], sa[1], sa[2]), (sb[2], sb[1], sb[0]))  # ~2⁻¹⁶·|ab|
+    d1 = diag((sa[0], sa[1]), (sb[1], sb[0]))                # ~2⁻⁸·|ab|
+    d0 = lax.dot(sa[0], sb[0], preferred_element_type=jnp.float32)
+    return (d2 + d1) + d0
+
+
+def matmul_sliced(backend: str, sa, sb):
+    """Dispatch a pre-split product by autotune backend name
+    (``"split3"`` | ``"split6"``) — the panel-folded call sites keep
+    one code path for both grades."""
+    if backend == "split6":
+        return matmul_sliced6(sa, sb)
+    return matmul_sliced3(sa, sb)
+
+
+def matmul_split3(a, b):
+    """fp32 matmul via the K-folded bf16x3 split: ~(2⁷ + 3k)·ε₃₂
+    componentwise — the stock k·ε₃₂ envelope class for k ≥ 64 — at
+    3 bf16-gemm passes."""
+    _guard(a, b)
+    return matmul_sliced3(split_slices(a), split_slices(b))
+
+
+def matmul_split6(a, b):
+    """fp32 matmul via the diagonal-combined bf16x6 split: true
+    ~3k·ε₃₂ componentwise (no dropped-pair floor) at 6 bf16-gemm
+    passes."""
+    _guard(a, b)
+    return matmul_sliced6(split_slices(a), split_slices(b))

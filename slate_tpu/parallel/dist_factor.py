@@ -41,6 +41,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..grid import ceildiv
 from ..ops.blocks import matmul as _mm
+from ..ops.blocks import matmul_backend, matmul_presplit
+from ..ops.blocks import panel_split as _panel_split
 from .dist import DistMatrix, distribute, like, undistribute
 from .dist_util import (_range_bounds, bcast_block_col, bcast_block_row,
                         local_grows, stage_bounds, staged_fori)
@@ -54,7 +56,8 @@ def _conj(a, conj: bool):
 @lru_cache(maxsize=None)
 def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                   panel_backend: str = "xla", depth: int = 1,
-                  chunks: int = 1, k_lo: int = 0,
+                  chunks: int = 1, trail_backend: str = "xla",
+                  k_lo: int = 0,
                   k_hi: Optional[int] = None, carry_in: bool = False,
                   carry_out: bool = False):
     """``k_lo``/``k_hi``/``carry_in``/``carry_out`` carve the step loop
@@ -63,7 +66,14 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
     (``_range_bounds``) and carries the in-flight lookahead panel ring
     between chunks, so chunked execution reproduces the monolithic
     factor bitwise — the contract the ``SLATE_TPU_DIST_TIMELINE``
-    measured runner leans on."""
+    measured runner leans on.  ``trail_backend`` (resolved through the
+    ``matmul`` autotune site before the cached build, like the other
+    knobs) selects the trailing-update gemm: ``"split3"``/``"split6"``
+    pre-split the replicated panel into its bf16 mantissa slices once
+    per step and fold every consumer — ring corrections, the lookahead
+    column, the trailing herk — off the same slices
+    (:mod:`slate_tpu.ops.split_gemm`); anything else takes the stock
+    :func:`~slate_tpu.ops.blocks.matmul` path."""
     p, q = mesh_grid_shape(mesh)
     conj = "complex" in dtype_name
     mtp = p * ml
@@ -130,6 +140,24 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 w_full = x * (gblk > k)[:, None].astype(dt)     # L21
                 fac = lax.dynamic_update_slice(w_full, l11, (k * nb, 0))
                 w_rows = jnp.take(w_full, grows, axis=0)
+                use_split = trail_backend in ("split3", "split6")
+                if use_split:
+                    # LP-GEMM operand folding (ops/split_gemm.py): the
+                    # resident replicated panel splits into its bf16
+                    # mantissa slices ONCE per step; every consumer
+                    # below — ring corrections, the lookahead column,
+                    # the trailing herk — folds windows of the SAME
+                    # slices, because the elementwise split commutes
+                    # with slicing/permutation (split3 resolves only
+                    # for real fp32, so ``conj`` is moot on this path)
+                    s_full = _panel_split(w_full)
+                    s_rows = tuple(jnp.take(s, grows, axis=0)
+                                   for s in s_full)
+
+                    def _nbsliceT(blk):
+                        return tuple(lax.dynamic_slice(
+                            s, (blk * nb, 0), (nb, nb)).T
+                            for s in s_full)
                 # ---- deep lookahead (ISSUE 13): the in-flight panels
                 # for steps k+1..k+D-1 were broadcast in earlier steps;
                 # bring each up to date with step k's rank-nb correction
@@ -139,21 +167,32 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 new_ring = []
                 for j in range(1, depth):
                     pj = ring[j]
-                    wj = lax.dynamic_slice(w_full, ((k + j) * nb, 0),
-                                           (nb, nb))
-                    new_ring.append(pj - _mm(w_full, _conj(wj, conj).T))
+                    if use_split:
+                        corr = matmul_presplit(trail_backend, s_full,
+                                               _nbsliceT(k + j))
+                    else:
+                        wj = lax.dynamic_slice(
+                            w_full, ((k + j) * nb, 0), (nb, nb))
+                        corr = _mm(w_full, _conj(wj, conj).T)
+                    new_ring.append(pj - corr)
                 # ---- lookahead broadcast: update ONLY block column
                 # k+D (narrow rank-nb gemm off this panel) and issue
                 # its broadcast — no data dependence on the trailing
                 # update below, so the collective overlaps the trailing
                 # MXU contraction (D = 1 is the PR 1 next-column form)
-                wnext = lax.dynamic_slice(w_full, ((k + depth) * nb, 0),
-                                          (nb, nb))
                 # rows above the window are factored (zero in w_rows and
                 # masked off when the consuming step slices the panel),
                 # so the narrow gemm and the broadcast ride the window
-                coln = getcol(a_loc, k + depth)[row0:] \
-                    - _mm(w_rows[row0:], _conj(wnext, conj).T)
+                if use_split:
+                    corrn = matmul_presplit(
+                        trail_backend,
+                        tuple(s[row0:] for s in s_rows),
+                        _nbsliceT(k + depth))
+                else:
+                    wnext = lax.dynamic_slice(
+                        w_full, ((k + depth) * nb, 0), (nb, nb))
+                    corrn = _mm(w_rows[row0:], _conj(wnext, conj).T)
+                coln = getcol(a_loc, k + depth)[row0:] - corrn
                 new_ring.append(bcast_block_col(
                     coln, grows[row0:], (k + depth) % q == c, M,
                     chunks=chunks))
@@ -166,12 +205,23 @@ def _build_ppotrf(mesh, nb: int, nt: int, ml: int, nl: int, dtype_name: str,
                 a_loc = jnp.where(k % q == c, written, a_loc)
                 # ---- trailing herk on the live window only (the O(n³)
                 # hot loop, src/potrf.cc:256-259)
-                w_cols = jnp.take(w_full.reshape(mtp, nb, nb), jblk,
-                                  axis=0)
-                w_cols = w_cols * (jblk > k)[:, None, None].astype(dt)
-                w_cols = w_cols.reshape(-1, nb)
                 win = a_loc[row0:, col0:]
-                win = win - _mm(w_rows[row0:], _conj(w_cols, conj).T)
+                if use_split:
+                    s_cols = tuple(
+                        (jnp.take(s.reshape(mtp, nb, nb), jblk, axis=0)
+                         * (jblk > k)[:, None, None].astype(s.dtype)
+                         ).reshape(-1, nb).T
+                        for s in s_full)
+                    upd = matmul_presplit(
+                        trail_backend,
+                        tuple(s[row0:] for s in s_rows), s_cols)
+                else:
+                    w_cols = jnp.take(w_full.reshape(mtp, nb, nb), jblk,
+                                      axis=0)
+                    w_cols = w_cols * (jblk > k)[:, None, None].astype(dt)
+                    w_cols = w_cols.reshape(-1, nb)
+                    upd = _mm(w_rows[row0:], _conj(w_cols, conj).T)
+                win = win - upd
                 return a_loc.at[row0:, col0:].set(win), tuple(new_ring)
 
             return body
@@ -233,11 +283,21 @@ def ppotrf(a: DistMatrix) -> DistMatrix:
     ml, nl = a.mtp // p, a.ntp // q
     nt = ceildiv(a.n, a.nb)
     # the scale-out knobs resolve through autotune BEFORE the lru_cached
-    # shard_map build (part of the build key; see pgetrf)
+    # shard_map build (part of the build key; see pgetrf); the trailing
+    # gemm backend rides the single-chip ``matmul`` site at the local
+    # trailing-update shape so a split-gemm winner turns on the
+    # once-per-step panel fold inside the step body
+    trail = "xla"
+    if a.dtype == jnp.float32:
+        bk = matmul_backend((ml * a.nb, a.nb), (a.nb, nl * a.nb),
+                            a.dtype)
+        if bk in ("split3", "split6"):
+            trail = bk
     knobs = (dist_panel_backend("potrf", a.nb, a.dtype,
                                 m=a.mtp * a.nb),
              dist_lookahead_depth("potrf", nt, a.nb, a.dtype),
-             dist_chunk_slices("potrf", a.nb, a.dtype, a.mesh))
+             dist_chunk_slices("potrf", a.nb, a.dtype, a.mesh),
+             trail)
     from ..perf import blackbox
 
     def run():

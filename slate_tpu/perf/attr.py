@@ -343,6 +343,26 @@ def _stages_twostage(n, isz, total):
     return stages, 0.0
 
 
+#: bf16 MXU passes behind one nominal fp32 flop on the split-product
+#: gemm (``ops/split_gemm.py`` bf16x3): the K-folded slice dot streams
+#: three bf16 gemm passes to produce one error-free fp32 product, so
+#: its roofline lane is the bf16 peak with a 3x flop carriage.
+SPLIT_GEMM_PASSES = 3.0
+
+
+def split_lane(label: str):
+    """``(lane_dtype, pass_multiplier)`` for a bench label.  The
+    ``gemm_fp32_split_n*`` family (``ops/split_gemm.py``) executes
+    :data:`SPLIT_GEMM_PASSES` bf16 MXU passes per nominal fp32 flop, so
+    gap reports and :func:`predict_seconds` must price it against the
+    bf16 peak (``SLATE_TPU_PEAK_TFLOPS_BF16`` overridable via
+    :func:`peaks`) instead of the emulated-fp32 lane; every other label
+    prices in its own dtype lane at 1x (``(None, 1.0)``)."""
+    if "_split_" in (label or ""):
+        return "bf16", SPLIT_GEMM_PASSES
+    return None, 1.0
+
+
 #: stage order for reports (model dicts are unordered)
 _STAGE_ORDER = ("panel", "pivot", "trsm", "update", "verify", "solve",
                 "stage1", "chase", "stage3", "mxu", "collective")
@@ -419,7 +439,8 @@ _DEF_LAUNCH_S = {"tpu": 5e-6, "cpu": 2e-5}
 
 def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
                     fusion: str = "composed", platform: str = "tpu",
-                    launch_s=None, abft=None):
+                    launch_s=None, abft=None, lane=None,
+                    lane_passes: float = 1.0):
     """Model-predicted wall seconds for ONE invocation at the given
     fusion depth: the per-stage roofline minima (:func:`stage_model` on
     :func:`peaks`) plus a launch-latency + panel-strip-traffic term per
@@ -432,16 +453,19 @@ def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
     ``SLATE_TPU_ABFT``) includes the checksum-carriage and verify
     pricing, so depth rankings under ABFT stay honest — a depth whose
     verify is whole-run (fused/full envelope) and one that verifies
-    per step are priced with the same sweep term."""
+    per step are priced with the same sweep term.  ``lane`` /
+    ``lane_passes`` (see :func:`split_lane`) price an emulated-precision
+    invocation against another dtype's peak with a flop multiplier —
+    the bf16 lane the split-product gemm family reconciles against."""
     model = stage_model(routine, dims, dtype, fusion, abft=abft)
     if model is None:
         return None
     stages, rts = model
-    pk = peaks(platform, dtype)
+    pk = peaks(platform, lane or dtype)
     t = 0.0
     mins = {}
     for s in stages:
-        m = max(s["flops"] / (pk["tflops"] * 1e12),
+        m = max(s["flops"] * lane_passes / (pk["tflops"] * 1e12),
                 s["bytes"] / (pk["hbm_gbs"] * 1e9))
         mins[s["stage"]] = mins.get(s["stage"], 0.0) + m
         t += m
@@ -528,8 +552,12 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
               platform: str = "tpu", n_devices: int = 1,
               collective_bytes=None) -> dict | None:
     """The gap report for one routine invocation, or None when the
-    label has no model (derived ``_s`` / ``_frac_of_gemm`` keys, zero
-    throughput, unknown routines).
+    label has no model (derived ``_s`` / ``_frac_of_gemm`` /
+    ``_frac_of_split_gemm`` / ``_over_floor`` keys, zero throughput,
+    unknown routines).  Labels carrying the ``_split_`` marker (the
+    ``gemm_fp32_split_n*`` family) are priced against the bf16 roofline
+    lane with the :data:`SPLIT_GEMM_PASSES` flop carriage — see
+    :func:`split_lane`.
 
     Inputs are exactly what a bench JSON line carries: the submetric
     label, its GFLOP/s, the routine's metrics snapshot (ideally the
@@ -537,7 +565,8 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     pass ``n_devices`` and either ``collective_bytes`` or a snapshot
     carrying the ``collective.bcast_*.bytes`` counters.
     """
-    if label.endswith("_s") or label.endswith("_frac_of_gemm"):
+    if label.endswith(("_s", "_frac_of_gemm", "_frac_of_split_gemm",
+                       "_over_floor")):
         return None
     if not isinstance(gflops, (int, float)) or gflops <= 0:
         return None
@@ -547,7 +576,21 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     if model is None:
         return None
     stage_fb, model_rts = model
-    pk = peaks(platform, dtype)
+    lane, lane_passes = split_lane(label)
+    if lane:
+        # bf16 lane: the split kernel streams ``lane_passes`` bf16
+        # slice copies of each operand (itemsize 2) through the MXU and
+        # writes the fp32 result once, so the mxu stage's byte model is
+        # re-derived here instead of inheriting the fp32 operand bytes
+        nn = dims.get("n")
+        mm = dims.get("m", nn)
+        kk = dims.get("k", nn if mm is None else min(mm, nn))
+        if nn:
+            for s in stage_fb:
+                if s["stage"] == "mxu":
+                    s["bytes"] = (lane_passes * (mm * kk + kk * nn) * 2.0
+                                  + 2.0 * mm * nn * 4.0)
+    pk = peaks(platform, lane or dtype)
     total_flops = sum(s["flops"] for s in stage_fb)
     measured_s = total_flops / (float(gflops) * 1e9)
 
@@ -559,7 +602,7 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
 
     stages = []
     for s in stage_fb:
-        t_mxu = s["flops"] / (pk["tflops"] * 1e12)
+        t_mxu = s["flops"] * lane_passes / (pk["tflops"] * 1e12)
         t_hbm = s["bytes"] / (pk["hbm_gbs"] * 1e9)
         stages.append({"stage": s["stage"], "flops": s["flops"],
                        "bytes": s["bytes"],
@@ -669,7 +712,7 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
         "gap_s": _r(gap_s),
         "achieved_frac": _r(min(model_s / measured_s, 1.0)
                             if measured_s > 0 else 1.0, 4),
-        "frac_of_peak": _r(total_flops / measured_s
+        "frac_of_peak": _r(total_flops * lane_passes / measured_s
                            / (pk["tflops"] * 1e12)
                            if measured_s > 0 else 0.0, 4),
         "stages": stages,
@@ -680,6 +723,9 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
         },
         "n_devices": int(n_devices),
     }
+    if lane:
+        report["lane"] = lane
+        report["lane_passes"] = float(lane_passes)
     if lookahead is not None:
         report["lookahead"] = lookahead
     if collective is not None:

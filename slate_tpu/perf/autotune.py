@@ -850,8 +850,9 @@ def _default(op: str, key_parts, names, fallback: str) -> str:
 def choose_matmul(shape_a, shape_b, dtype) -> str:
     """Backend for a 2-D real tile/trailing-update product:
     ``"xla"`` | ``"pallas"`` (VMEM K-loop kernel) | ``"ozaki"``
-    (int8-slice fp64).  Also covers every recursive trailing update —
-    the blocked drivers' hot GEMMs all flow through
+    (int8-slice fp64) | ``"split3"`` / ``"split6"`` (bf16-slice fp32,
+    :mod:`slate_tpu.ops.split_gemm`).  Also covers every recursive
+    trailing update — the blocked drivers' hot GEMMs all flow through
     :func:`slate_tpu.ops.blocks.matmul`."""
 
     import jax.numpy as jnp
@@ -905,16 +906,35 @@ def choose_matmul(shape_a, shape_b, dtype) -> str:
         ])
 
     mode = config.use_pallas_mode()
+    smode = config.split_gemm_mode()
+    # the bf16 slices share fp32's exponent range — the split is only
+    # defined (and only profitable) for the fp32 precision class
+    split_ok = dt == jnp.float32
+    if smode == "on" and split_ok:
+        # the split pin wins over shape eligibility AND over a pallas
+        # pin: the K-fold is a concat + one dot, so it needs no
+        # tile-grid alignment — forced mode covers ragged shapes too
+        return _static("matmul", key, "split3", "forced-config")
     eligible = (jnp.issubdtype(dt, jnp.floating)
                 and am % 128 == 0 and an % 128 == 0 and ak % 128 == 0)
     if not eligible:
         return "xla"
-    if mode == "off":
-        return _static("matmul", key, "xla", "forced-config")
     if mode == "on":
         return _static("matmul", key, "pallas", "forced-config")
+    names = ["xla"]
+    if mode != "off":
+        names.append("pallas")
+    if smode != "off" and split_ok:
+        names += ["split3", "split6"]
+    if len(names) == 1:
+        return _static("matmul", key, "xla", "forced-config")
     if not _on_tpu():
-        return _default("matmul", key, ("xla", "pallas"), "xla")
+        # an explicit env pin must work off-TPU too (the --split CI
+        # tier and the interpret-mode tests pin split3/split6 this way)
+        forced = _forced("matmul")
+        if forced in names:
+            return _static("matmul", key, forced, "forced")
+        return _default("matmul", key, tuple(names), "xla")
 
     def setup_pallas():
         from ..ops.pallas_kernels import matmul as pallas_matmul
@@ -931,20 +951,33 @@ def choose_matmul(shape_a, shape_b, dtype) -> str:
             lambda x, y: jnp.matmul(x, y, precision=config.matmul_precision),
             *_ab())
 
-    def check_pallas(out):
+    def setup_split3():
+        from ..ops.split_gemm import matmul_split3
+
+        return _timed_call(matmul_split3, *_ab())
+
+    def setup_split6():
+        from ..ops.split_gemm import matmul_split6
+
+        return _timed_call(matmul_split6, *_ab())
+
+    def check_hi(out):
         import jax
         from jax import lax
 
         ref = jax.jit(lambda x, y: jnp.matmul(
             x, y, precision=lax.Precision.HIGHEST))(*_ab())
-        # the kernel accumulates at HIGHEST in VMEM: agreement with the
-        # 6-pass XLA dot should be ~eps-grade; 1e-4 is the library gate
+        # pallas accumulates at HIGHEST in VMEM and the bf16 splits
+        # land at ~(2⁷+3k)·eps32 (split3) / ~3k·eps32 (split6)
+        # componentwise: agreement with the 6-pass XLA dot should be
+        # well under 1e-4, the library gate
         return _rel_fro(out, ref) < 1e-4
 
-    return decide("matmul", key, [
-        Candidate("xla", setup_xla32),
-        Candidate("pallas", setup_pallas, check_pallas),
-    ])
+    setups = {"xla": Candidate("xla", setup_xla32),
+              "pallas": Candidate("pallas", setup_pallas, check_hi),
+              "split3": Candidate("split3", setup_split3, check_hi),
+              "split6": Candidate("split6", setup_split6, check_hi)}
+    return decide("matmul", key, [setups[nm] for nm in names])
 
 
 def _spd_probe(n, dtype, seed=2):

@@ -65,6 +65,7 @@ Env knobs (all unset by default):
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
 import sys
@@ -96,6 +97,7 @@ SCHEMA = "slate_tpu.blackbox/1"
 
 _DEFAULT_RING = 512
 _DEFAULT_MAX_DUMPS = 8
+_dump_seq = itertools.count()
 
 
 def _env_int(name: str, default: int, lo: int = 1) -> int:
@@ -333,9 +335,13 @@ def dump(reason: str, detail: str = "", path: str | None = None):
 
                 d = tempfile.gettempdir()
             os.makedirs(d, exist_ok=True)
+            # ms timestamp + pid alone can collide when two triggers
+            # fire within the same millisecond — a process-wide
+            # sequence number keeps every bundle filename distinct
+            # (itertools.count is atomic under the GIL)
             path = os.path.join(
-                d, "slate_tpu_blackbox_%d_%d.json"
-                % (int(time.time() * 1e3), os.getpid()))
+                d, "slate_tpu_blackbox_%d_%d_%d.json"
+                % (int(time.time() * 1e3), os.getpid(), next(_dump_seq)))
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             f.write(text)

@@ -135,7 +135,11 @@ def direction(label: str) -> float:
     ``*_abft_overhead_pct`` family (ISSUE 14: abft-on vs abft-off wall
     overhead in percent — lower is better, with the
     :data:`ABFT_OVERHEAD_CEILING_PCT` ceiling pinned even
-    single-artifact)."""
+    single-artifact).  The split-gemm families (ISSUE 16) need no
+    special case: ``*_frac_of_split_gemm`` fractions and the
+    ``*_over_floor`` sentinel (split rate ÷ stock rate ÷ 1.5× floor —
+    judged REGRESS below 1.0 even single-artifact, see
+    ``_floor_override``) are both bigger-is-better, the +1 default."""
     if label.endswith("_per_s"):
         return 1.0
     if label.endswith(("_ms", "_hbm_roundtrips", "_abft_overhead_pct")):
@@ -527,18 +531,35 @@ def frac_of_gemm(report: Report, label: str) -> Optional[float]:
     return float(v) if isinstance(v, (int, float)) else None
 
 
+def frac_of_split_gemm(report: Report, label: str) -> Optional[float]:
+    """The NEWEST artifact's ``<label>_frac_of_split_gemm`` derived
+    submetric (bench.py ISSUE 16: fp32 routine TF/s ÷ same-run bf16x3
+    split-gemm TF/s — the fraction of the EMULATED-fp32 peak each
+    factorization banks).  Same strict-newest / absent-not-stale
+    contract as :func:`frac_of_gemm`."""
+    if label.endswith(("_frac_of_gemm", "_frac_of_split_gemm", "_s")):
+        return None
+    if not report.artifacts:
+        return None
+    v = report.artifacts[-1].submetrics.get(label + "_frac_of_split_gemm")
+    return float(v) if isinstance(v, (int, float)) else None
+
+
 def format_table(report: Report) -> str:
     """Human-readable verdict table + infra findings.  The ``frac``
-    column renders each routine's newest fraction-of-gemm (see
-    :func:`frac_of_gemm`)."""
+    column renders each routine's newest fraction-of-gemm
+    (:func:`frac_of_gemm`); ``frac_split`` the fraction of the bf16x3
+    split-gemm anchor (:func:`frac_of_split_gemm`, ISSUE 16)."""
     heads = ["routine"] + [a.name for a in report.artifacts] \
-        + ["Δ%", "frac", "verdict"]
+        + ["Δ%", "frac", "frac_split", "verdict"]
     body = []
     for r in report.rows:
         delta = "%+.1f%%" % r.delta_pct if r.delta_pct is not None else "-"
         frac = frac_of_gemm(report, r.label)
+        fsp = frac_of_split_gemm(report, r.label)
         line = [r.label] + [_fmt_val(v) for v in r.values] \
             + [delta, "%.3f" % frac if frac is not None else "-",
+               "%.3f" % fsp if fsp is not None else "-",
                r.verdict + ((" (%s)" % r.note) if r.note else "")]
         body.append(line)
     widths = [max(len(str(row[i])) for row in [heads] + body)

@@ -145,6 +145,12 @@ _SHORT_DTYPE = {"float32": "fp32", "float64": "fp64", "bfloat16": "bf16",
 #: vs one bf16 MXU pass (compute AND operand traffic)
 _OZAKI_PASSES = 6.0
 
+#: bf16-pass multipliers of the fp32 split gemm (ops/split_gemm.py):
+#: bf16x3 is one K-folded 3k-length dot, bf16x6 keeps the three
+#: slice-pair diagonals (k + 2k + 3k)
+_SPLIT3_PASSES = 3.0
+_SPLIT6_PASSES = 6.0
+
 
 def _short(dt) -> str:
     return _SHORT_DTYPE.get(str(dt), "fp32")
@@ -196,19 +202,26 @@ def _predict_matmul(key_parts, names, platform):
     same MXU pass (indistinguishable analytically — neither is ever
     pruned against the other); the Ozaki fp64 split pays
     :data:`_OZAKI_PASSES` bf16-grade passes vs XLA's software-emulated
-    fp64 peak — the one matmul choice the model CAN separate."""
+    fp64 peak, and the fp32 bf16x3/bf16x6 splits pay
+    :data:`_SPLIT3_PASSES` / :data:`_SPLIT6_PASSES` bf16 passes vs the
+    stock fp32 dot — the matmul choices the model CAN separate.  The
+    bf16 lane reads ``SLATE_TPU_PEAK_TFLOPS_BF16`` via
+    :func:`attr.peaks`, so an operator who pins the measured bf16 peak
+    re-prices the split against the real emulated-fp32 ceiling."""
     m, k, n = (int(x) for x in key_parts[:3])
     dt = _short(key_parts[3])
     a = _attr()
     fl = 2.0 * m * k * n
     isz = {"fp64": 8, "c64": 8, "c128": 16, "bf16": 2}.get(dt, 4)
     by = (m * k + k * n + 2.0 * m * n) * isz
+    passes = {"ozaki": _OZAKI_PASSES, "split3": _SPLIT3_PASSES,
+              "split6": _SPLIT6_PASSES}
     out = {}
     for name in names:
-        if name == "ozaki":
+        if name in passes:
             pk = a.peaks(platform, "bf16")
-            t = (fl * _OZAKI_PASSES / (pk["tflops"] * 1e12)
-                 + by * _OZAKI_PASSES / (pk["hbm_gbs"] * 1e9))
+            t = (fl * passes[name] / (pk["tflops"] * 1e12)
+                 + by * passes[name] / (pk["hbm_gbs"] * 1e9))
         else:
             pk = a.peaks(platform, dt)
             t = max(fl / (pk["tflops"] * 1e12),
@@ -359,8 +372,25 @@ def _build_matmul(u):
             lambda x, y: jnp.matmul(x, y, precision=config.matmul_precision),
             *_ab())
 
-    return key, [at.Candidate("xla", setup_xla32),
-                 at.Candidate("pallas", setup_pallas)]
+    cands = [at.Candidate("xla", setup_xla32),
+             at.Candidate("pallas", setup_pallas)]
+    if dt == jnp.float32:
+        # the bf16x3/bf16x6 split candidates (same runtime candidate
+        # set choose_matmul probes, same key) so the warm-start bundle
+        # can pin a split winner for the zero-probe replica boot
+        def setup_split3():
+            from ..ops.split_gemm import matmul_split3
+
+            return at._timed_call(matmul_split3, *_ab())
+
+        def setup_split6():
+            from ..ops.split_gemm import matmul_split6
+
+            return at._timed_call(matmul_split6, *_ab())
+
+        cands += [at.Candidate("split3", setup_split3),
+                  at.Candidate("split6", setup_split6)]
+    return key, cands
 
 
 def _build_lu_step(u):

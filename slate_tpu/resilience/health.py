@@ -77,27 +77,29 @@ _safe_lock = threading.RLock()
 def safe_backend():
     """Force the stock-library backends (XLA ops, vmapped batching, the
     blocked recursions) for the body's duration: the Pallas / Ozaki /
-    scattered knobs are pinned off, so every autotune chooser resolves
-    to its safe candidate without consulting (possibly poisoned) timed
-    winners.  Process-global by necessity (the knobs are module
-    globals) — held under one lock so concurrent degraded re-runs
-    serialize instead of racing the restore."""
+    scattered / split-gemm knobs are pinned off, so every autotune
+    chooser resolves to its safe candidate without consulting (possibly
+    poisoned) timed winners.  Process-global by necessity (the knobs
+    are module globals) — held under one lock so concurrent degraded
+    re-runs serialize instead of racing the restore."""
     from .. import config
     from ..perf import autotune
 
     with _safe_lock:
-        saved = (config.use_pallas, config.f64_mxu, config.scattered_lu)
+        saved = (config.use_pallas, config.f64_mxu, config.scattered_lu,
+                 config.split_gemm)
         config.use_pallas = False
         config.f64_mxu = False
         config.scattered_lu = False
+        config.split_gemm = False
         try:
             # the temporarily-forced knobs must not overwrite settled
             # autotune decisions (they would re-probe after restore)
             with autotune.suppress_knob_records():
                 yield
         finally:
-            (config.use_pallas, config.f64_mxu,
-             config.scattered_lu) = saved
+            (config.use_pallas, config.f64_mxu, config.scattered_lu,
+             config.split_gemm) = saved
 
 
 # ---------------------------------------------------------------------------

@@ -1,7 +1,7 @@
 """Every multi-backend op site must dispatch through the autotune table
 (pattern of test_driver_wrapping.py: the kernel registry is easy to
 bypass by accident; this test catches a new call site that imports
-``pallas_kernels``/``ozaki`` directly instead of going through
+``pallas_kernels``/``ozaki``/``split_gemm`` directly instead of going through
 ``slate_tpu.perf.autotune`` / ``method.select_backend``)."""
 
 import pathlib
@@ -24,9 +24,9 @@ _PKG = pathlib.Path(st.__file__).resolve().parent
 _ALLOWED = {"ops", "perf/autotune.py", "perf/sweep.py"}
 
 _IMPORT_RE = re.compile(
-    r"^\s*(?:from\s+[\w.]*\s+import\s+.*\b(pallas_kernels|ozaki)\b"
-    r"|from\s+[\w.]*(pallas_kernels|ozaki)\s+import"
-    r"|import\s+[\w.]*(pallas_kernels|ozaki)\b)")
+    r"^\s*(?:from\s+[\w.]*\s+import\s+.*\b(pallas_kernels|ozaki|split_gemm)\b"
+    r"|from\s+[\w.]*(pallas_kernels|ozaki|split_gemm)\s+import"
+    r"|import\s+[\w.]*(pallas_kernels|ozaki|split_gemm)\b)")
 
 
 def _is_allowed(rel: str) -> bool:

@@ -1,0 +1,266 @@
+"""Split-precision bf16 gemm (ops/split_gemm.py): bf16x3/bf16x6 fp32.
+
+The CPU build exercises the same bf16 slice products and fp32
+accumulation as the chip (lax.dot with preferred_element_type is
+platform-agnostic), so these componentwise bounds pin the scheme's
+arithmetic against an fp64 oracle, not just a residual — the fp32
+sibling of test_ozaki.py."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import slate_tpu as st
+from slate_tpu import config
+from slate_tpu.ops.split_gemm import (
+    matmul_split3, matmul_split6, split_slices,
+)
+from slate_tpu.perf import autotune
+
+EPS32 = float(np.finfo(np.float32).eps)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1632)
+
+
+@pytest.fixture
+def fresh_table():
+    autotune.reset_table()
+    yield
+    autotune.reset_table()
+
+
+def _rel_err(fn, a, b):
+    """max |fn(a,b) − ab| / (|a||b|) against the fp64 oracle."""
+    c = np.asarray(fn(jnp.asarray(a), jnp.asarray(b))).astype(np.float64)
+    true = a.astype(np.float64) @ b.astype(np.float64)
+    env = np.abs(a).astype(np.float64) @ np.abs(b).astype(np.float64)
+    return (np.abs(c - true) / np.maximum(env, 1e-300)).max()
+
+
+def _tol(fn, k):
+    """The documented componentwise contract with 4× headroom:
+    (2⁷ + 3k)·ε₃₂ for the 3-pass grade (the 2⁷ term is the dropped
+    ≤2⁻¹⁶ slice pairs), 3k·ε₃₂ for the 6-pass grade."""
+    floor = 2.0 ** 7 if fn is matmul_split3 else 0.0
+    return 4 * (floor + 3 * k) * EPS32
+
+
+@pytest.mark.parametrize("fn", [matmul_split3, matmul_split6])
+@pytest.mark.parametrize("m,k,n", [(64, 64, 64), (96, 256, 64),
+                                   (128, 1000, 64)])
+def test_componentwise_fp32_grade(rng, fn, m, k, n):
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    # |C − AB| ≤ tol · |A||B| componentwise
+    assert _rel_err(fn, a, b) < _tol(fn, k)
+
+
+def test_wide_dynamic_range_and_zero_rows(rng):
+    # adversarial exponent spreads within fp32 range: no pow2 scaling
+    # exists to get wrong (bf16 shares the exponent), but mixed-scale
+    # rows stress the residual recurrence and slice alignment
+    m = k = n = 96
+    a = (rng.standard_normal((m, k))
+         * np.exp2(rng.integers(-40, 40, size=(m, 1)).astype(np.float64))
+         ).astype(np.float32)
+    b = (rng.standard_normal((k, n))
+         * np.exp2(rng.integers(-40, 40, size=(1, n)).astype(np.float64))
+         ).astype(np.float32)
+    a[3, :] = 0.0
+    b[:, 5] = 0.0
+    for fn in (matmul_split3, matmul_split6):
+        c = np.asarray(fn(jnp.asarray(a), jnp.asarray(b)))
+        assert _rel_err(fn, a, b) < _tol(fn, k)
+        assert np.all(c[3, :] == 0.0)
+        assert np.all(c[:, 5] == 0.0)
+
+
+def test_exact_powers_of_two():
+    # power-of-two values live entirely in slice 0: the product must
+    # come back bit-exact through both grades
+    a = np.full((32, 32), 0.5, dtype=np.float32)
+    b = np.eye(32, dtype=np.float32)
+    for fn in (matmul_split3, matmul_split6):
+        c = np.asarray(fn(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_array_equal(c, a)
+
+
+def test_long_contraction_correlated():
+    # all-positive correlated operands past any √k error cancellation:
+    # pins the fp32 k-accumulation against the 3k·ε₃₂ envelope
+    k = 3000
+    a = np.full((2, k), np.float32(1 - 2 ** -12), dtype=np.float32)
+    assert _rel_err(matmul_split3, a, a.T) < _tol(matmul_split3, k)
+    assert _rel_err(matmul_split6, a, a.T) < _tol(matmul_split6, k)
+
+
+def test_extreme_exponent_scales():
+    # huge-scale rows against tiny-scale columns: the product is in
+    # fp32 range even though the slices span ~2⁻²⁴ below each operand
+    a = np.full((4, 4), 2.0 ** 120, dtype=np.float32)
+    b = np.full((4, 4), 2.0 ** -100, dtype=np.float32)
+    c = np.asarray(matmul_split3(jnp.asarray(a), jnp.asarray(b)))
+    assert np.isfinite(c).all()
+    assert c[0, 0] == np.float32(4 * 2.0 ** 20)
+    # inputs at/below the fp32 subnormal boundary: low slices flush on
+    # TPU (DAZ/FTZ, the ozaki.py contract) — either way never NaN/Inf
+    a = np.full((4, 4), 2.0 ** -130, dtype=np.float32)
+    b = np.full((4, 4), 2.0 ** 100, dtype=np.float32)
+    c = np.asarray(matmul_split3(jnp.asarray(a), jnp.asarray(b)))
+    assert np.isfinite(c).all()
+
+
+def test_bitwise_determinism(rng):
+    a = rng.standard_normal((64, 96)).astype(np.float32)
+    b = rng.standard_normal((96, 64)).astype(np.float32)
+    for fn in (matmul_split3, matmul_split6):
+        c1 = np.asarray(fn(jnp.asarray(a), jnp.asarray(b)))
+        c2 = np.asarray(fn(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_array_equal(c1.view(np.int32), c2.view(np.int32))
+
+
+def test_split_commutes_with_slicing(rng):
+    # the property panel folding rests on: the split is elementwise, so
+    # window-then-split == split-then-window bit-for-bit
+    x = (rng.standard_normal((96, 64))
+         * np.exp2(rng.integers(-30, 30, size=(96, 1)).astype(np.float64))
+         ).astype(np.float32)
+    whole = split_slices(jnp.asarray(x))
+    rows, cols = slice(17, 53), slice(5, 60)
+    window = split_slices(jnp.asarray(x[rows, cols]))
+    for sw, sv in zip(whole, window):
+        np.testing.assert_array_equal(
+            np.asarray(sw[rows, cols]).view(np.int16),
+            np.asarray(sv).view(np.int16))
+
+
+def test_slices_reconstruct(rng):
+    x = rng.standard_normal((64, 64)).astype(np.float32)
+    s = split_slices(jnp.asarray(x))
+    back = sum(np.asarray(si).astype(np.float64) for si in s)
+    assert np.abs(back - x).max() <= 2.0 ** -24 * np.abs(x).max()
+
+
+def test_type_and_shape_guards(rng):
+    a32 = jnp.asarray(rng.standard_normal((8, 8)).astype(np.float32))
+    with pytest.raises(TypeError):
+        matmul_split3(a32.astype(jnp.float64), a32.astype(jnp.float64))
+    with pytest.raises(TypeError):
+        matmul_split6(a32.astype(jnp.bfloat16), a32.astype(jnp.bfloat16))
+    with pytest.raises(ValueError):
+        matmul_split3(a32[None], a32[None])
+
+
+# ---------------------------------------------------------------------------
+# Dispatch integration: forced-site e2e drivers + census + lowering pin
+# ---------------------------------------------------------------------------
+
+def test_forced_split_gesv_posv_residual_gates(rng, fresh_table,
+                                               monkeypatch):
+    """SLATE_TPU_SPLIT_GEMM=1 end to end: the SHIPPED blocked drivers
+    take the split3 backend at every fp32 matmul site, residual-gate
+    clean, and the autotune census records the decision."""
+    monkeypatch.setattr(config, "split_gemm", True)
+    n, nrhs = 128, 2
+    a = (rng.standard_normal((n, n)).astype(np.float32)
+         + n * np.eye(n, dtype=np.float32))
+    b = rng.standard_normal((n, nrhs)).astype(np.float32)
+    lu, perm, x = st.gesv(st.Matrix.from_array(a, nb=64), jnp.asarray(b))
+    xv = np.asarray(x)
+    res = (np.linalg.norm(a @ xv - b)
+           / (np.linalg.norm(a) * np.linalg.norm(xv) * n * EPS32))
+    assert res < 3.0, f"gesv residual {res}"
+
+    g = rng.standard_normal((n, n)).astype(np.float32)
+    spd = g @ g.T / n + np.eye(n, dtype=np.float32)
+    fac, x2 = st.posv(st.HermitianMatrix(jnp.asarray(spd),
+                                         uplo=st.Uplo.Lower, mb=64, nb=64),
+                      jnp.asarray(b))
+    x2v = np.asarray(x2)
+    res2 = (np.linalg.norm(spd @ x2v - b)
+            / (np.linalg.norm(spd) * np.linalg.norm(x2v) * n * EPS32))
+    assert res2 < 3.0, f"posv residual {res2}"
+
+    dec = autotune.decisions()
+    assert any(k.startswith("matmul|") and v == "split3"
+               for k, v in dec.items()), dec
+
+
+def test_forced_split6_census(rng, fresh_table, monkeypatch):
+    # the env pin (SLATE_TPU_AUTOTUNE_FORCE=matmul=split6) is the way
+    # to select the 6-pass grade off-TPU — the tri-state knob's "on"
+    # heuristically prefers split3
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "matmul=split6")
+    from slate_tpu.ops import blocks
+    a = jnp.asarray(rng.standard_normal((128, 128)).astype(np.float32))
+    c = np.asarray(blocks.matmul(a, a))
+    assert np.isfinite(c).all()
+    dec = autotune.decisions()
+    assert any(k.startswith("matmul|") and v == "split6"
+               for k, v in dec.items()), dec
+
+
+def test_mixed_wrappers_split_leg(rng, fresh_table, monkeypatch):
+    """posv_mixed / gels_mixed ride the bf16-split low-precision factor
+    leg when forced on and still refine to fp64-grade residuals — the
+    split error lives entirely in the lo factor, where IR absorbs
+    it."""
+    monkeypatch.setattr(config, "split_gemm", True)
+    n = 96
+    g = rng.standard_normal((n, n))
+    a = g @ g.T + n * np.eye(n)
+    b = rng.standard_normal((n, 2))
+    A = st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower,
+                           mb=32, nb=32)
+    x, iters = st.posv_mixed(A, jnp.asarray(b))
+    assert iters >= 0, "mixed solver fell back unexpectedly"
+    xv = np.asarray(x)
+    res = np.linalg.norm(a @ xv - b) / (np.linalg.norm(a)
+                                        * np.linalg.norm(xv))
+    assert res < 1e-13, f"refined residual {res}"
+
+    m = 160
+    am = rng.standard_normal((m, n))
+    bm = rng.standard_normal((m, 2))
+    xq, qiters = st.gels_mixed(jnp.asarray(am), jnp.asarray(bm))
+    xqv = np.asarray(xq)
+    # least-squares optimality: the residual is orthogonal to range(A)
+    grad = am.T @ (am @ xqv - bm)
+    rel = np.linalg.norm(grad) / (np.linalg.norm(am) ** 2
+                                  * np.linalg.norm(xqv))
+    assert rel < 1e-12, f"normal-equations residual {rel}"
+
+
+def test_gels_mixed_stock_matches_gels(rng):
+    # without the split leg the mixed wrapper must still refine to
+    # fp64 grade and agree with the one-shot QR solve
+    m, n = 96, 48
+    a = rng.standard_normal((m, n))
+    b = rng.standard_normal(m)
+    x, iters = st.gels_mixed(jnp.asarray(a), jnp.asarray(b))
+    xref = np.linalg.lstsq(a, b, rcond=None)[0]
+    assert np.asarray(x).shape == (n,)
+    np.testing.assert_allclose(np.asarray(x), xref, rtol=1e-9, atol=1e-9)
+    with pytest.raises(ValueError):
+        st.gels_mixed(jnp.asarray(a.T), jnp.asarray(b[:n]))
+
+
+def test_off_by_default_lowering_bit_identity(fresh_table, monkeypatch):
+    """PR 4 contract: with the knob unset on CPU the auto mode resolves
+    to stock — compiled programs are bit-identical to forced-off."""
+    import jax
+
+    a = jnp.asarray(np.eye(64, dtype=np.float32) * 4
+                    + np.ones((64, 64), np.float32))
+
+    def lower():
+        return jax.jit(lambda x: st.getrf(x)[0]).lower(a).as_text()
+
+    monkeypatch.setattr(config, "split_gemm", False)
+    base = lower()
+    autotune.reset_table()
+    monkeypatch.setattr(config, "split_gemm", None)      # unset / auto
+    assert lower() == base

@@ -11,7 +11,11 @@ Aligns routines across the artifacts (by routine name, dtype and dims
 parsed from the submetric labels), prints a verdict table — including a
 ``frac`` column with each routine's newest ``frac_of_gemm`` derived
 submetric (bench.py r6+: routine TF/s ÷ same-run gemm TF/s, the unit
-the ROADMAP fraction targets are written in) and the batched serving
+the ROADMAP fraction targets are written in), a ``frac_split`` column
+with the newest ``frac_of_split_gemm`` (ISSUE 16: fp32 routine TF/s ÷
+same-run bf16x3 split-gemm TF/s — the fraction of the emulated-fp32
+peak; the ``gemm_fp32_split_speedup_over_floor`` sentinel row rides
+the generic ``*_over_floor`` floor pin) and the batched serving
 throughput rows (``*_solves_per_s``, r8: higher is better, judged with
 the rate direction — the sentinel pins serving throughput like any
 other metric) — and exits nonzero when
@@ -91,7 +95,9 @@ def main(argv=None) -> int:
                                     if regress.direction(r.label) > 0
                                     else "lower_is_better"),
                       "frac_of_gemm": regress.frac_of_gemm(report,
-                                                           r.label)}
+                                                           r.label),
+                      "frac_of_split_gemm": regress.frac_of_split_gemm(
+                          report, r.label)}
                      for r in report.rows],
             "infra": [{"artifact": n, "reasons": rs}
                       for n, rs in report.infra],
