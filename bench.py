@@ -113,7 +113,7 @@ BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
 #: silently pollute the headline by missing a hand-copied tuple.
 DERIVED_SUFFIXES = ("_frac_of_gemm", "_frac_of_split_gemm",
                     "_hbm_roundtrips", "_abft_overhead_pct",
-                    "_over_floor")
+                    "_over_floor", "_host_gb_transferred")
 
 #: everything a gemm-fraction would be unit salad for: wall seconds,
 #: speedup ratios, and the derived families above.
@@ -1353,6 +1353,145 @@ def main():
         label = "svd_fp64_n%d" % nev
         return label, gf, resid, _stage_delta(label, _SVD_STAGES, stages0)
 
+
+    # ---- out-of-core getrf/potrf (ISSUE 17) --------------------------
+    # host-DRAM tile pool with a FORCED tiny window (3 tiles) at
+    # in-core dims: every run proves LRU eviction + dirty write-back +
+    # prefetch against real transfers, and `_host_gb_transferred`
+    # (lower-is-better, derived — excluded from every GFLOP/s
+    # aggregate) is the measured ooc.host_bytes odometer for ONE cold
+    # factorization.  The true out-of-core row (SLATE_TPU_BENCH_OOC_N,
+    # e.g. 131072) is opt-in and bail-governed: it runs only when the
+    # attr roofline (the host stage on the PCIe lane) projects the
+    # single factorization inside the routine watchdog — a mispriced
+    # giant probe skips to omitted submetrics, never an infra line.
+    def _ooc_big_row(routine, run, flops_of):
+        big_n = int(os.environ.get("SLATE_TPU_BENCH_OOC_N", "0") or 0)
+        nb_b = 1024
+        if big_n <= 0 or big_n % nb_b or big_n // nb_b < 2:
+            return {}
+        try:
+            from slate_tpu.perf import attr as attr_mod
+
+            pred = attr_mod.predict_seconds(
+                routine, {"m": big_n, "n": big_n, "nb": nb_b, "ooc": 1},
+                "fp32", platform=_PLATFORM)
+            if not pred or pred * 1.5 > ROUTINE_TIMEOUT_S * 0.8:
+                return {}              # projected wall over budget: bail
+            rng = np.random.default_rng(13)
+            a = rng.standard_normal((big_n, big_n), dtype=np.float32)
+            if routine == "potrf":
+                # blocked in-place symmetrization (a whole-matrix
+                # (a + a.T)/2 would triple the host footprint) plus a
+                # Gershgorin shift past the GOE spectral radius √(2n)
+                bs = 8192
+                for i0 in range(0, big_n, bs):
+                    for j0 in range(i0, big_n, bs):
+                        blk = 0.5 * (a[i0:i0 + bs, j0:j0 + bs]
+                                     + a[j0:j0 + bs, i0:i0 + bs].T)
+                        a[i0:i0 + bs, j0:j0 + bs] = blk
+                        a[j0:j0 + bs, i0:i0 + bs] = blk.T
+                a[np.diag_indices(big_n)] += 4.0 * np.sqrt(big_n)
+            snap = _metrics_snapshot()
+            t0 = time.perf_counter()
+            run(a, nb_b)
+            t = time.perf_counter() - t0
+        except _RoutineTimeout:
+            raise
+        except Exception:
+            return {}
+        gb = ((_metrics_delta(snap).get("counters") or {})
+              .get("ooc.host_bytes", 0.0)) / 1e9
+        label = "%s_ooc_fp32_n%d_nb%d" % (routine, big_n, nb_b)
+        out = {label: round(flops_of(big_n) / t / 1e9, 1)}
+        if gb > 0:
+            out[label + "_host_gb_transferred"] = round(gb, 3)
+        return out
+
+
+    def bench_getrf_ooc():
+        rng = np.random.default_rng(11)  # per-routine stream: a retry cannot shift later routines
+        n_o, nb_o = 1024 // scale, 256 // scale
+        a_np = (rng.standard_normal((n_o, n_o)).astype(np.float32)
+                + n_o * np.eye(n_o, dtype=np.float32))
+
+        from slate_tpu.linalg import ooc as ooc_mod
+
+        def run():
+            lu, perm = ooc_mod.getrf_ooc(jnp.asarray(a_np), nb=nb_o,
+                                         capacity=3, depth=2)
+            jax.block_until_ready(lu)
+            return lu, perm
+
+        snap = _metrics_snapshot()
+        t0 = time.perf_counter()
+        lu, perm = run()                   # cold: compiles the tile ops
+        t = time.perf_counter() - t0
+        gb = ((_metrics_delta(snap).get("counters") or {})
+              .get("ooc.host_bytes", 0.0)) / 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            lu, perm = run()
+            t = min(t, time.perf_counter() - t0)
+        gf = 2.0 * n_o ** 3 / 3.0 / t / 1e9
+        lu_np, perm_np = np.asarray(lu), np.asarray(perm)
+        l_f = np.tril(lu_np, -1) + np.eye(n_o, dtype=np.float32)
+        u_f = np.triu(lu_np)
+        x = rng.standard_normal((n_o,)).astype(np.float32)
+        resid = (np.linalg.norm(mv(l_f, mv(u_f, x))
+                                - mv(a_np[perm_np], x))
+                 / (np.linalg.norm(a_np) * np.linalg.norm(x) * eps * n_o))
+        label = "getrf_ooc_fp32_n%d_nb%d" % (n_o, nb_o)
+        aux = {}
+        if gb > 0:
+            aux[label + "_host_gb_transferred"] = round(gb, 4)
+        aux.update(_ooc_big_row(
+            "getrf",
+            lambda a, nb: ooc_mod.getrf_ooc(a, nb=nb, to_device=False),
+            lambda N: 2.0 * N ** 3 / 3.0))
+        return label, gf, resid, aux
+
+
+    def bench_potrf_ooc():
+        rng = np.random.default_rng(12)  # per-routine stream: a retry cannot shift later routines
+        n_o, nb_o = 1024 // scale, 256 // scale
+        g = rng.standard_normal((n_o, n_o)).astype(np.float32)
+        spd_np = g @ g.T + n_o * np.eye(n_o, dtype=np.float32)
+
+        from slate_tpu.linalg import ooc as ooc_mod
+
+        def run():
+            l = ooc_mod.potrf_ooc(jnp.asarray(spd_np), nb=nb_o,
+                                  capacity=3, depth=2)
+            jax.block_until_ready(l)
+            return l
+
+        snap = _metrics_snapshot()
+        t0 = time.perf_counter()
+        l = run()                          # cold: compiles the tile ops
+        t = time.perf_counter() - t0
+        gb = ((_metrics_delta(snap).get("counters") or {})
+              .get("ooc.host_bytes", 0.0)) / 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            l = run()
+            t = min(t, time.perf_counter() - t0)
+        gf = n_o ** 3 / 3.0 / t / 1e9
+        l_np = np.asarray(l)
+        x = rng.standard_normal((n_o,)).astype(np.float32)
+        resid = (np.linalg.norm(mv(l_np, mv(l_np.T, x)) - mv(spd_np, x))
+                 / (np.linalg.norm(spd_np) * np.linalg.norm(x)
+                    * eps * n_o))
+        label = "potrf_ooc_fp32_n%d_nb%d" % (n_o, nb_o)
+        aux = {}
+        if gb > 0:
+            aux[label + "_host_gb_transferred"] = round(gb, 4)
+        aux.update(_ooc_big_row(
+            "potrf",
+            lambda a, nb: ooc_mod.potrf_ooc(a, nb=nb, to_device=False),
+            lambda N: N ** 3 / 3.0))
+        return label, gf, resid, aux
+
     # ---- the runner loop: global deadline budgeting ------------------
     # The routine list is known up front, so each routine's SIGALRM
     # deadline can be derived from ONE global budget
@@ -1372,6 +1511,8 @@ def main():
         ("batched_posv", lambda: bench_batched_posv(on_tpu), False),
         ("batched_gesv", lambda: bench_batched_gesv(on_tpu), False),
         ("serve_posv", lambda: bench_serve(on_tpu), False),
+        ("getrf_ooc", bench_getrf_ooc, True),
+        ("potrf_ooc", bench_potrf_ooc, True),
         ("heev_fp32", bench_heev32, True),
         ("svd_fp32", bench_svd32, True),
         ("heev_fp64", bench_heev64, True),
@@ -1426,10 +1567,13 @@ def main():
                 peak[k] = round(v / anchor, 3)
                 if peak[k] < 0.10 and "gemm" not in k and "mxu" not in k \
                         and "heev" not in k and "svd" not in k \
-                        and "batched" not in k and "serve" not in k:
-                    # two-stage eig/svd run partly on host and the
+                        and "batched" not in k and "serve" not in k \
+                        and "_ooc_" not in k:
+                    # two-stage eig/svd run partly on host, the
                     # batched/serve suites' tiny per-problem shapes
-                    # cannot reach big-matrix fractions; informational
+                    # cannot reach big-matrix fractions, and the
+                    # out-of-core rows are PCIe-bound by design;
+                    # informational
                     low.append(k)
     # frac_of_gemm as a FIRST-CLASS derived submetric per factorization
     # routine (routine TF/s ÷ same-run gemm TF/s): the ROADMAP targets

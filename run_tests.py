@@ -408,6 +408,156 @@ def split_smoke() -> int:
     return 1
 
 
+def ooc_smoke() -> int:
+    """The --ooc fast tier (ISSUE 17): two fresh subprocesses on CPU.
+    Leg 1 forces the out-of-core site (``SLATE_TPU_OOC=1``) with a tiny
+    3-tile window at interpret-safe dims and proves the SHIPPED
+    dispatch takes it — the forced-window getrf/potrf factors are
+    BITWISE identical to their all-resident runs (residency never
+    changes arithmetic), gesv/posv residual-gate clean end to end
+    through the pool, and the autotune census pins an ``ooc -> pool``
+    decision.  Leg 2 composes the pool with the PR 14 checkpoint
+    harness: a 2-step cadence plus ONE injected ``device_loss`` at a
+    step boundary must rewind to the window-boundary snapshot and
+    reproduce the uninterrupted factors bitwise."""
+    import tempfile
+
+    here = pathlib.Path(__file__).resolve().parent
+    code1 = (
+        "import numpy as np, jax.numpy as jnp\n"
+        "import slate_tpu as st\n"
+        "from slate_tpu.linalg import lu as lu_mod, ooc\n"
+        "from slate_tpu.perf import autotune, metrics\n"
+        "metrics.on()\n"
+        "eps = float(np.finfo(np.float32).eps)\n"
+        "rng = np.random.default_rng(17)\n"
+        "n, nb = 128, 32\n"
+        "a = (rng.standard_normal((n, n)).astype(np.float32)\n"
+        "     + 2.0 * np.sqrt(n) * np.eye(n, dtype=np.float32))\n"
+        "lu_t, p_t = ooc.getrf_ooc(jnp.asarray(a), nb=nb, capacity=2,\n"
+        "                          depth=1)\n"
+        "lu_a, p_a = ooc.getrf_ooc(jnp.asarray(a), nb=nb, capacity=64,\n"
+        "                          depth=4)\n"
+        "assert np.array_equal(np.asarray(lu_t), np.asarray(lu_a))\n"
+        "assert np.array_equal(np.asarray(p_t), np.asarray(p_a))\n"
+        "lmat = np.tril(np.asarray(lu_t), -1) + np.eye(n,\n"
+        "                                              dtype=np.float32)\n"
+        "umat = np.triu(np.asarray(lu_t))\n"
+        "res_f = (np.abs(a[np.asarray(p_t)] - lmat @ umat).max()\n"
+        "         / (np.abs(a).max() * n * eps))\n"
+        "assert res_f < 3.0, res_f\n"
+        "g = rng.standard_normal((n, n)).astype(np.float32)\n"
+        "spd = (g @ g.T / n + np.eye(n)).astype(np.float32)\n"
+        "l_t = np.asarray(ooc.potrf_ooc(jnp.asarray(spd), nb=nb,\n"
+        "                               capacity=2, depth=1))\n"
+        "l_a = np.asarray(ooc.potrf_ooc(jnp.asarray(spd), nb=nb,\n"
+        "                               capacity=64, depth=4))\n"
+        "assert np.array_equal(l_t, l_a)\n"
+        "b = rng.standard_normal((n, 3)).astype(np.float32)\n"
+        "lu2, perm2, x = lu_mod.gesv(jnp.asarray(a), jnp.asarray(b))\n"
+        "xv = np.asarray(x)\n"
+        "res = (np.linalg.norm(a @ xv - b)\n"
+        "       / (np.linalg.norm(a) * np.linalg.norm(xv) * n * eps))\n"
+        "assert res < 3.0, res\n"
+        "fac, x2 = st.posv(st.HermitianMatrix(jnp.asarray(spd),\n"
+        "                                     uplo=st.Uplo.Lower),\n"
+        "                  jnp.asarray(b))\n"
+        "x2v = np.asarray(x2)\n"
+        "res2 = (np.linalg.norm(spd @ x2v - b)\n"
+        "        / (np.linalg.norm(spd) * np.linalg.norm(x2v) * n * eps))\n"
+        "assert res2 < 3.0, res2\n"
+        "dec = autotune.decisions()\n"
+        "assert any(k.startswith('ooc|') and v == 'pool'\n"
+        "           for k, v in dec.items()), sorted(dec)\n"
+        "snap = metrics.snapshot()['counters']\n"
+        "assert snap.get('ooc.host_bytes', 0.0) > 0, snap\n"
+        "print('ooc smoke: window parity bitwise, gesv resid %.3g, '\n"
+        "      'posv resid %.3g, host GB %.4f'\n"
+        "      % (res, res2, snap['ooc.host_bytes'] / 1e9))\n"
+        "print('OOC-PARITY-OK')\n"
+    )
+    code2 = (
+        "import numpy as np, jax.numpy as jnp\n"
+        "from slate_tpu.linalg import ooc\n"
+        "from slate_tpu.perf import metrics\n"
+        "from slate_tpu.resilience import inject\n"
+        "metrics.on()\n"
+        "rng = np.random.default_rng(18)\n"
+        "n, nb = 128, 32\n"
+        "a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)\n"
+        "                + 2.0 * np.sqrt(n)\n"
+        "                * np.eye(n, dtype=np.float32))\n"
+        "inject.clear_plan()\n"
+        "lu_c, p_c = ooc.getrf_ooc(a, nb=nb, capacity=3)\n"
+        "inject.install(inject.FaultPlan(seed=7).add(\n"
+        "    'step.boundary', 'device_loss', rate=1.0, count=1))\n"
+        "lu_x, p_x = ooc.getrf_ooc(a, nb=nb, capacity=3)\n"
+        "assert np.array_equal(np.asarray(lu_c), np.asarray(lu_x))\n"
+        "assert np.array_equal(np.asarray(p_c), np.asarray(p_x))\n"
+        "snap = metrics.snapshot()['counters']\n"
+        "assert snap.get('ckpt.restored', 0.0) >= 1.0, snap\n"
+        "assert snap.get('ckpt.saved', 0.0) >= 1.0, snap\n"
+        "print('OOC-CHAOS-OK')\n"
+    )
+    checks = {}
+    with tempfile.TemporaryDirectory() as td:
+        env1 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    SLATE_TPU_OOC="1",
+                    SLATE_TPU_OOC_NB="32",
+                    SLATE_TPU_OOC_WINDOW_TILES="3",
+                    SLATE_TPU_OOC_PREFETCH_DEPTH="2",
+                    SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "c1.json"))
+        for k in ("SLATE_TPU_AUTOTUNE_FORCE", "SLATE_TPU_AUTOTUNE_BUNDLE",
+                  "SLATE_TPU_FAULT_INJECT", "SLATE_TPU_HEALTH",
+                  "SLATE_TPU_CKPT_EVERY_STEPS"):
+            env1.pop(k, None)
+        print("=== ooc tier leg 1: SLATE_TPU_OOC=1 window=3 (forced "
+              "pool, bitwise window parity, residual-gated, "
+              "census-pinned)", flush=True)
+        try:
+            r1 = subprocess.run([sys.executable, "-c", code1], env=env1,
+                                cwd=str(here), capture_output=True,
+                                text=True, timeout=900)
+            checks["forced pool: bitwise parity + residual + census"] = \
+                r1.returncode == 0 and "OOC-PARITY-OK" in r1.stdout
+            if r1.returncode != 0:
+                print(r1.stdout)
+                print(r1.stderr)
+            else:
+                print(r1.stdout.strip())
+        except subprocess.TimeoutExpired:
+            checks["forced pool: bitwise parity + residual + census"] = \
+                False
+        env2 = dict(os.environ, JAX_PLATFORMS="cpu",
+                    SLATE_TPU_CKPT_EVERY_STEPS="2",
+                    SLATE_TPU_AUTOTUNE_CACHE=os.path.join(td, "c2.json"))
+        for k in ("SLATE_TPU_AUTOTUNE_FORCE", "SLATE_TPU_AUTOTUNE_BUNDLE",
+                  "SLATE_TPU_FAULT_INJECT", "SLATE_TPU_HEALTH",
+                  "SLATE_TPU_OOC"):
+            env2.pop(k, None)
+        print("=== ooc tier leg 2: SLATE_TPU_CKPT_EVERY_STEPS=2 + one "
+              "injected device_loss (bitwise rewind)", flush=True)
+        try:
+            r2 = subprocess.run([sys.executable, "-c", code2], env=env2,
+                                cwd=str(here), capture_output=True,
+                                text=True, timeout=900)
+            checks["device_loss rewinds to snapshot, bitwise resume"] = \
+                r2.returncode == 0 and "OOC-CHAOS-OK" in r2.stdout
+            if r2.returncode != 0:
+                print(r2.stdout)
+                print(r2.stderr)
+        except subprocess.TimeoutExpired:
+            checks["device_loss rewinds to snapshot, bitwise resume"] = \
+                False
+    for name, ok in checks.items():
+        print("  %s: %s" % (name, "ok" if ok else "FAIL"), flush=True)
+    if all(checks.values()):
+        print("==== ooc smoke passed ====")
+        return 0
+    print("==== ooc smoke FAILED ====")
+    return 1
+
+
 def sweep_smoke() -> int:
     """The --sweep tier: tiny CPU grid end-to-end through the CLI in a
     subprocess (sweep → versioned bundle artifact), then a second fresh
@@ -553,6 +703,15 @@ def main(argv=None):
                     "pinned — then prove the health gate demotes a "
                     "seeded split3 winner under injected corruption "
                     "(see docs/usage.md Split-precision gemm)")
+    ap.add_argument("--ooc", action="store_true",
+                    help="out-of-core smoke: force the host-DRAM tile "
+                    "pool (SLATE_TPU_OOC=1) with a tiny 3-tile window "
+                    "at interpret-safe dims — forced-window factors "
+                    "bitwise-match all-resident runs, gesv/posv "
+                    "residual-gated through the pool, census pinned — "
+                    "then compose with the checkpoint harness under an "
+                    "injected device_loss (see docs/usage.md "
+                    "Out-of-core factorizations)")
     args = ap.parse_args(argv)
 
     if args.telemetry:
@@ -569,6 +728,9 @@ def main(argv=None):
 
     if args.split:
         return split_smoke()
+
+    if args.ooc:
+        return ooc_smoke()
 
     if args.chaos:
         # setdefault: an explicit operator plan/tier wins over the can

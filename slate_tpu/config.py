@@ -112,6 +112,20 @@ scattered_lu = _tri_state("SLATE_TPU_SCATTERED_LU")
 split_gemm = _tri_state("SLATE_TPU_SPLIT_GEMM")
 
 
+#: Route eligible square f32/f64 factorizations through the
+#: out-of-core tile-pool drivers (``linalg.ooc.getrf_ooc`` /
+#: ``potrf_ooc`` over ``ops.tilepool`` — host-DRAM tile grid, bounded
+#: HBM window, LRU + dirty write-back + async prefetch) instead of the
+#: in-core paths.  Tri-state (``SLATE_TPU_OOC``): ``auto`` (default)
+#: lets the ``ooc`` autotune site weigh the working set against the
+#: HBM budget (``SLATE_TPU_OOC_HBM_MB``) analytically on TPU — off-TPU
+#: the ladder resolves to in-core, so unset-knob lowering stays
+#: bit-identical; ``1`` forces the pool wherever it is shape-eligible
+#: (CPU CI proves the mechanism with a forced tiny window); ``0``
+#: forces it off everywhere.
+ooc = _tri_state("SLATE_TPU_OOC")
+
+
 def use_pallas_mode() -> str:
     """Resolve the tri-state :data:`use_pallas` knob to one of
     ``"auto" | "on" | "off"`` (reading the module global so tests that
@@ -138,4 +152,11 @@ def split_gemm_mode() -> str:
     """Resolve the tri-state :data:`split_gemm` knob to
     ``"auto" | "on" | "off"``."""
     v = split_gemm
+    return "auto" if v == "auto" else ("on" if v else "off")
+
+
+def ooc_mode() -> str:
+    """Resolve the tri-state :data:`ooc` knob to
+    ``"auto" | "on" | "off"``."""
+    v = ooc
     return "auto" if v == "auto" else ("on" if v else "off")

@@ -860,6 +860,21 @@ def _choose_lu_driver(av) -> str:
 
 
 def _getrf_partial_impl(av, nb: int, raw_method=MethodLU.Auto):
+    from . import ooc as _ooc
+
+    if _ooc.choose(av) == "pool":
+        # out-of-core (ISSUE 17): the matrix lives in host DRAM as an
+        # (nb, nb)-tile grid and factors through a bounded HBM window
+        # (ops/tilepool.py) — same (lu, perm) contract, the existing
+        # in-core kernels do every flop on resident operands
+        return _ooc.getrf_ooc(av)
+    return _getrf_incore(av, nb, raw_method)
+
+
+def _getrf_incore(av, nb: int, raw_method=MethodLU.Auto):
+    """The in-core PartialPiv body below the ``ooc`` gate — also the
+    panel factor of the out-of-core driver itself, which must never
+    re-enter the gate (a forced-pool panel would recurse)."""
     driver = _choose_lu_driver(av)
     if driver == "scattered":
         # TPU f32 fast path: scattered-row partial pivoting (no swap

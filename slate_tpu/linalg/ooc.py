@@ -1,0 +1,267 @@
+"""Out-of-core getrf/potrf — right-looking factorizations over the
+host-DRAM tile pool (ISSUE 17).
+
+The drivers here factor a matrix whose fp32 footprint exceeds the HBM
+window: the matrix lives in host DRAM as an (nb, nb)-tile grid
+(:class:`slate_tpu.ops.tilepool.TilePool`) and each right-looking step
+assembles its panel and trailing strips from the pool's bounded
+device-resident window — the existing in-core kernels do every flop
+(the panel factors through ``linalg.lu._getrf_partial_impl``, trailing
+updates through ``ops.blocks.matmul``), the pool only decides WHERE the
+operands live and prefetches the next strip's tiles under the current
+step's MXU work.
+
+Residency never changes arithmetic: the same jnp operations run in the
+same order whatever the window size, so a forced 2-tile window and an
+all-resident window produce bitwise-identical factors (the parity pin
+in tests/test_tilepool.py) — an all-resident pool IS the in-core
+execution of this driver.
+
+Checkpoint composition (PR 14): with ``SLATE_TPU_CKPT_EVERY_STEPS`` set
+the step loop runs under
+:func:`slate_tpu.resilience.checkpoint.run_checkpointed` — the pool is
+flushed at every window boundary so the snapshot is the exact host
+image, and an injected ``device_loss`` rewinds to the last boundary and
+replays bitwise (multi-hour n=131072 runs restart mid-factorization
+instead of from zero).
+
+Dispatch: the ``ooc`` autotune site
+(:func:`slate_tpu.perf.autotune.choose_ooc`) arbitrates ``"pool"`` vs
+``"incore"`` per (n, nb, dtype) exactly like every other backend
+ladder; ``SLATE_TPU_OOC`` is the tri-state force knob.  Importing this
+module never imports the tile pool — ``ops.tilepool`` loads only when
+a driver actually runs (the inert-at-import pin).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..perf import metrics
+
+__all__ = ["getrf_ooc", "potrf_ooc", "ooc_nb", "pool_eligible", "choose"]
+
+#: the pool pays off only when the tile grid is at least this many
+#: tiles on a side (a 1×1 grid is definitionally in-core)
+_OOC_MIN_GRID = 2
+
+
+def ooc_nb() -> int:
+    """Out-of-core tile edge (``SLATE_TPU_OOC_NB``, default 512 — the
+    fused step kernels' panel width).  Read here, NOT from
+    ``ops.tilepool``, so the dispatch gate in linalg/ can run without
+    importing the pool (the inert-at-import contract)."""
+    raw = os.environ.get("SLATE_TPU_OOC_NB", "").strip()
+    try:
+        return max(8, int(raw)) if raw else 512
+    except ValueError:
+        return 512
+
+
+def _is_tracer(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.core.Tracer)
+    except Exception:                      # pragma: no cover
+        return False
+
+
+def pool_eligible(av) -> bool:
+    """Shape/dtype ELIGIBILITY of the out-of-core drivers: CONCRETE
+    (the pool is host-side/eager-only, like the ABFT layer — a traced
+    operand keeps the in-core path whatever the knobs say) real square
+    f32/f64 matrices on a uniform (nb, nb) tile grid of at least
+    2×2 tiles.  Whether an eligible shape actually takes the pool is
+    the ``ooc`` autotune site's decision (forced with ``SLATE_TPU_OOC``
+    or ``SLATE_TPU_AUTOTUNE_FORCE=ooc=pool``) — no raw env read decides
+    dispatch here."""
+    if _is_tracer(av) or getattr(av, "ndim", 0) != 2:
+        return False
+    m, n = int(av.shape[0]), int(av.shape[1])
+    if m != n or av.dtype not in (jnp.float32, jnp.float64):
+        return False
+    t = ooc_nb()
+    return n % t == 0 and n // t >= _OOC_MIN_GRID
+
+
+def choose(av) -> str:
+    """The ``ooc`` site decision for one operand — ONE derivation
+    shared by the getrf and potrf dispatches (mirrors
+    ``linalg.lu._choose_lu_driver``)."""
+    from ..method import select_backend
+
+    n = int(av.shape[-1]) if getattr(av, "ndim", 0) == 2 else 0
+    return select_backend("ooc", n=n, nb=ooc_nb(), dtype=av.dtype,
+                          eligible=pool_eligible(av))
+
+
+def _ckpt_every():
+    from ..resilience import checkpoint
+
+    return checkpoint, checkpoint.every_steps()
+
+
+# ---------------------------------------------------------------------------
+# getrf
+# ---------------------------------------------------------------------------
+
+def _getrf_steps(pool, perm: np.ndarray, k0: int, k1: int) -> np.ndarray:
+    """Run right-looking LU steps ``k ∈ [k0, k1)`` on the pool in
+    place; returns the updated global row permutation.  Per step: the
+    block-column panel is assembled from resident tiles and factored by
+    the in-core PartialPiv driver, then every other block column's
+    rows-below-k strip is assembled, row-swapped (laswp on BOTH sides,
+    the LAPACK contract), triangular-solved and rank-nb updated — all
+    with the same jnp ops at every window size."""
+    from .lu import _getrf_incore
+    from ..ops import blocks
+
+    g, nb = pool.gi, pool.nb
+    for k in range(k0, k1):
+        rows = list(range(k, g))
+        pool.prefetch((i, k) for i in rows)
+        with metrics.step_timer("getrf", "panel"):
+            panel = jnp.concatenate([pool.get(i, k) for i in rows],
+                                    axis=0)
+            lu_p, piv = _getrf_incore(panel, nb)
+        for t, i in enumerate(rows):
+            pool.put(i, k, lu_p[t * nb:(t + 1) * nb])
+        piv_np = np.asarray(piv)
+        perm = perm.copy()
+        perm[k * nb:] = perm[k * nb:][piv_np]
+        l11 = lu_p[:nb]
+        l21 = lu_p[nb:]
+        # trailing columns first: their tiles are the next step's
+        # working set, so they end the step most-recently-used
+        for j in [jj for jj in range(k + 1, g)] + list(range(k)):
+            pool.prefetch((i, j) for i in rows)
+            strip = jnp.concatenate([pool.get(i, j) for i in rows],
+                                    axis=0)
+            with metrics.step_timer("getrf", "pivot"):
+                strip = strip[piv]
+            if j > k:
+                with metrics.step_timer("getrf", "trsm"):
+                    u = lax.linalg.triangular_solve(
+                        l11, strip[:nb], left_side=True, lower=True,
+                        unit_diagonal=True)
+                if strip.shape[0] > nb:
+                    with metrics.step_timer("getrf", "update"):
+                        rest = strip[nb:] - blocks.matmul(l21, u)
+                    strip = jnp.concatenate([u, rest], axis=0)
+                else:
+                    strip = u
+            for t, i in enumerate(rows):
+                pool.put(i, j, strip[t * nb:(t + 1) * nb])
+    return perm
+
+
+def getrf_ooc(a, nb: int | None = None, capacity: int | None = None,
+              depth: int | None = None, to_device: bool = True):
+    """Out-of-core partial-pivot LU over the host-DRAM tile pool.
+    Same ``(lu, perm)`` contract as the in-core drivers
+    (``A[perm] = L·U``); ``capacity``/``depth`` override the
+    ``SLATE_TPU_OOC_WINDOW_TILES`` / ``_PREFETCH_DEPTH`` knobs (the
+    tests force a 2–4-tile window through them).  ``to_device=False``
+    returns host ndarrays — the only possible form at the sizes this
+    driver exists for, where the factor itself exceeds HBM."""
+    from ..ops.tilepool import TilePool
+
+    a_np = np.asarray(a)
+    nb = int(nb) if nb else ooc_nb()
+    m, n = a_np.shape
+    if m != n or n % nb:
+        raise ValueError(f"getrf_ooc needs a square matrix on a uniform "
+                         f"{nb}-tile grid, got {a_np.shape}")
+    g = n // nb
+    ckpt, every = _ckpt_every()
+    if every > 0 and g > 1:
+        def run_chunk(carry, k0, k1):
+            host, perm = carry if carry is not None \
+                else (a_np, np.arange(m))
+            pool = TilePool(host, nb, capacity, depth, op="getrf")
+            perm = _getrf_steps(pool, np.asarray(perm), k0, k1)
+            return (pool.array(), perm)
+
+        host, perm = ckpt.run_checkpointed(g, every, run_chunk,
+                                           label="getrf_ooc")
+    else:
+        pool = TilePool(a_np, nb, capacity, depth, op="getrf")
+        perm = _getrf_steps(pool, np.arange(m), 0, g)
+        host = pool.array()
+    if not to_device:
+        return host, np.asarray(perm)
+    return jnp.asarray(host), jnp.asarray(perm)
+
+
+# ---------------------------------------------------------------------------
+# potrf
+# ---------------------------------------------------------------------------
+
+def _potrf_steps(pool, k0: int, k1: int) -> None:
+    """Right-looking tiled Cholesky steps ``k ∈ [k0, k1)``: diagonal
+    factor, block-column trsm, symmetric rank-nb trailing update on the
+    lower tiles only — per-tile gemms with a full (un-split) nb
+    contraction, so tiling changes nothing bitwise."""
+    from ..ops import blocks
+
+    g = pool.gi
+    for k in range(k0, k1):
+        with metrics.step_timer("potrf", "panel"):
+            lkk = jnp.tril(lax.linalg.cholesky(pool.get(k, k)))
+        pool.put(k, k, lkk)
+        below = list(range(k + 1, g))
+        pool.prefetch((i, k) for i in below)
+        for i in below:
+            with metrics.step_timer("potrf", "trsm"):
+                lik = lax.linalg.triangular_solve(
+                    lkk, pool.get(i, k), left_side=False, lower=True,
+                    transpose_a=True)
+            pool.put(i, k, lik)
+        for j in below:
+            ljk_t = pool.get(j, k).T
+            pool.prefetch((i, j) for i in range(j, g))
+            for i in range(j, g):
+                with metrics.step_timer("potrf", "update"):
+                    upd = pool.get(i, j) - blocks.matmul(pool.get(i, k),
+                                                         ljk_t)
+                pool.put(i, j, upd)
+
+
+def potrf_ooc(a, nb: int | None = None, capacity: int | None = None,
+              depth: int | None = None, to_device: bool = True):
+    """Out-of-core Cholesky over the host-DRAM tile pool: returns the
+    full lower-triangular factor array (the ``_potrf_dispatch``
+    contract — ``linalg.cholesky.potrf`` wraps it).
+    ``to_device=False`` returns the host ndarray for factors that
+    exceed HBM."""
+    from ..ops.tilepool import TilePool
+
+    a_np = np.asarray(a)
+    nb = int(nb) if nb else ooc_nb()
+    n = a_np.shape[-1]
+    if a_np.ndim != 2 or a_np.shape[0] != n or n % nb:
+        raise ValueError(f"potrf_ooc needs a square matrix on a uniform "
+                         f"{nb}-tile grid, got {a_np.shape}")
+    g = n // nb
+    ckpt, every = _ckpt_every()
+    if every > 0 and g > 1:
+        def run_chunk(carry, k0, k1):
+            host = carry if carry is not None else a_np
+            pool = TilePool(host, nb, capacity, depth, op="potrf")
+            _potrf_steps(pool, k0, k1)
+            return pool.array()
+
+        host = ckpt.run_checkpointed(g, every, run_chunk,
+                                     label="potrf_ooc")
+    else:
+        pool = TilePool(a_np, nb, capacity, depth, op="potrf")
+        _potrf_steps(pool, 0, g)
+        host = pool.array()
+    if not to_device:
+        return np.tril(host)
+    return jnp.tril(jnp.asarray(host))

@@ -1,0 +1,265 @@
+"""Host-DRAM-backed tile pool — software residency for out-of-core
+factorizations (ISSUE 17).
+
+Every in-core driver assumes the whole matrix fits HBM, which caps the
+single-chip size axis around n=65536 fp32.  This module stores a matrix
+as an (nb, nb)-tile grid in host DRAM and manages a BOUNDED window of
+device-resident tiles with the classic software-cache trio:
+
+* **LRU residency** — ``get()`` returns the device copy of a tile,
+  fetching over the host link on a miss and evicting the
+  least-recently-used resident tile once the window is full;
+* **dirty write-back** — tiles rewritten by the factorization
+  (``put()``) are marked dirty and flushed to host DRAM exactly once,
+  at eviction or ``flush()``, so host DRAM is the single source of
+  truth between steps (the coherence protocol is trivial because there
+  is one device);
+* **async prefetch** — ``prefetch()`` issues ``jax.device_put`` for the
+  tiles the next panel/trailing strip will need WITHOUT blocking; the
+  transfer overlaps the current step's MXU work exactly like the
+  double-buffered ``_stream_chunks`` DMA residency inside the fused
+  step kernels (ops/pallas_kernels.py), one level up the hierarchy
+  (PCIe→HBM instead of HBM→VMEM).
+
+The BLASX two-level tile-cache design (PAPERS.md) is the shape being
+reproduced: compute stays at in-core rates while the working set lives
+a PCIe hop away, and the prefetch schedule is priced — not guessed —
+by the ``host`` roofline stage in :mod:`slate_tpu.perf.attr` on the
+``SLATE_TPU_PCIE_GBS`` link peak, arbitrated through the ``ooc``
+autotune site.
+
+Observability rides the PR 4 metrics contract: the
+``ooc.prefetch.hits`` / ``ooc.prefetch.misses`` / ``ooc.evictions`` /
+``ooc.write_backs`` counters and the ``ooc.host_bytes`` byte odometer
+are all routed through :func:`slate_tpu.perf.metrics.inc`, so with the
+registry off (the default) each event costs one attribute read and
+records nothing.
+
+Inert at import: importing this module touches no jax API, allocates
+nothing on any device and reads no environment variable — all state is
+per-:class:`TilePool` (pinned by tests/test_backend_registry.py).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+from ..perf import metrics
+
+__all__ = [
+    "TilePool", "DEFAULT_WINDOW_TILES", "DEFAULT_PREFETCH_DEPTH",
+    "window_tiles", "prefetch_depth", "hbm_budget_bytes", "ooc_nb",
+]
+
+#: resident-window size (tiles) when ``SLATE_TPU_OOC_WINDOW_TILES`` is
+#: unset: 64 × (512² fp32 = 1 MiB) tiles ≈ 64 MiB of managed HBM per
+#: pool — small against any real HBM, large enough that one panel plus
+#: the strip being updated plus the prefetch depth all stay resident.
+DEFAULT_WINDOW_TILES = 64
+
+#: tiles fetched ahead per ``prefetch()`` call when
+#: ``SLATE_TPU_OOC_PREFETCH_DEPTH`` is unset.
+DEFAULT_PREFETCH_DEPTH = 4
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def window_tiles() -> int:
+    """Resident-window capacity in tiles (``SLATE_TPU_OOC_WINDOW_TILES``,
+    floor 2 — one tile being computed on plus one being prefetched)."""
+    return max(2, _env_int("SLATE_TPU_OOC_WINDOW_TILES",
+                           DEFAULT_WINDOW_TILES))
+
+
+def prefetch_depth() -> int:
+    """Prefetch look-ahead in tiles (``SLATE_TPU_OOC_PREFETCH_DEPTH``,
+    0 disables prefetching; capped by the window so prefetch can never
+    thrash the tile being computed on)."""
+    return max(0, _env_int("SLATE_TPU_OOC_PREFETCH_DEPTH",
+                           DEFAULT_PREFETCH_DEPTH))
+
+
+def hbm_budget_bytes() -> int:
+    """The HBM byte budget the ``ooc`` autotune site weighs a working
+    set against (``SLATE_TPU_OOC_HBM_MB``, default 24576 MiB — one
+    v5p-class chip next to the 819 GB/s roofline constant in
+    perf/attr.py)."""
+    return _env_int("SLATE_TPU_OOC_HBM_MB", 24576) * (1 << 20)
+
+
+def ooc_nb() -> int:
+    """The out-of-core tile edge (``SLATE_TPU_OOC_NB``, default 512 —
+    the fused step kernels' panel width, so the pool feeds the existing
+    lu_step/potrf_step rungs exactly the operand shapes they already
+    tune for)."""
+    return max(8, _env_int("SLATE_TPU_OOC_NB", 512))
+
+
+class TilePool:
+    """A bounded device-resident window over a host-DRAM tile grid.
+
+    ``a`` (array-like, 2-D) is copied into a zero-padded host grid of
+    ``(nb, nb)`` tiles.  ``capacity`` bounds the number of
+    simultaneously resident device tiles (default
+    :func:`window_tiles`); ``depth`` the prefetch look-ahead (default
+    :func:`prefetch_depth`).  ``op`` names the driver for the
+    ``step.<op>.host`` stage timer so the attr.py measured-timer join
+    sees the host-transfer stage like every other stage.
+
+    Device arrays returned by :meth:`get` stay valid after eviction
+    (eviction drops the pool's reference, not the buffer), so a caller
+    may assemble a panel strip wider than the window — the window then
+    only determines how much re-use the NEXT step gets for free.
+    Residency never changes arithmetic: results are bitwise identical
+    across window sizes (pinned in tests/test_tilepool.py).
+    """
+
+    def __init__(self, a, nb: int, capacity: int | None = None,
+                 depth: int | None = None, op: str = "ooc"):
+        a_np = np.asarray(a)
+        if a_np.ndim != 2:
+            raise ValueError(f"TilePool needs a 2-D matrix, got "
+                             f"{a_np.shape}")
+        self.nb = int(nb)
+        self.m, self.n = (int(a_np.shape[0]), int(a_np.shape[1]))
+        self.gi = -(-self.m // self.nb)
+        self.gj = -(-self.n // self.nb)
+        self.dtype = a_np.dtype
+        self.op = op
+        host = np.zeros((self.gi * self.nb, self.gj * self.nb),
+                        dtype=a_np.dtype)
+        host[:self.m, :self.n] = a_np
+        self.host = host
+        self.capacity = max(2, int(capacity) if capacity is not None
+                            else window_tiles())
+        self.depth = (int(depth) if depth is not None
+                      else prefetch_depth())
+        self._resident: OrderedDict = OrderedDict()   # (i, j) -> device
+        self._dirty: set = set()
+        self._prefetched: set = set()
+        #: total bytes moved across the host link, both directions —
+        #: the measured number behind the bench `_host_gb_transferred`
+        #: submetric and the attr.py host-stage byte model
+        self.bytes_moved = 0
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def tile_bytes(self) -> int:
+        return self.nb * self.nb * self.dtype.itemsize
+
+    def _slice(self, i: int, j: int):
+        nb = self.nb
+        return (slice(i * nb, (i + 1) * nb), slice(j * nb, (j + 1) * nb))
+
+    # -- the residency protocol --------------------------------------------
+
+    def _fetch(self, i: int, j: int):
+        """host → device transfer of one tile (async under the hood —
+        ``jax.device_put`` returns a future-backed array, so a prefetch
+        overlaps whatever the MXU is doing now)."""
+        import jax
+
+        self.bytes_moved += self.tile_bytes
+        metrics.inc("ooc.host_bytes", float(self.tile_bytes))
+        return jax.device_put(self.host[self._slice(i, j)])
+
+    def _write_back(self, key, dev) -> None:
+        """device → host flush of one dirty tile (exact: the host copy
+        is byte-for-byte the device value)."""
+        with metrics.step_timer(self.op, "host"):
+            self.host[self._slice(*key)] = np.asarray(dev)
+        self.bytes_moved += self.tile_bytes
+        metrics.inc("ooc.host_bytes", float(self.tile_bytes))
+        metrics.inc("ooc.write_backs")
+
+    def _evict_to_capacity(self, keep=()) -> None:
+        while len(self._resident) > self.capacity:
+            victim = next((k for k in self._resident if k not in keep),
+                          None)
+            if victim is None:
+                return            # everything pinned by the caller
+            dev = self._resident.pop(victim)
+            self._prefetched.discard(victim)
+            if victim in self._dirty:
+                self._dirty.discard(victim)
+                self._write_back(victim, dev)
+            metrics.inc("ooc.evictions")
+
+    def get(self, i: int, j: int):
+        """The device copy of tile (i, j): a window hit is free, a miss
+        pays one synchronous host→HBM transfer and may evict the LRU
+        resident tile (writing it back first when dirty)."""
+        key = (i, j)
+        dev = self._resident.get(key)
+        if dev is not None:
+            self._resident.move_to_end(key)
+            if key in self._prefetched:
+                self._prefetched.discard(key)
+                metrics.inc("ooc.prefetch.hits")
+            return dev
+        metrics.inc("ooc.prefetch.misses")
+        with metrics.step_timer(self.op, "host"):
+            dev = self._fetch(i, j)
+        self._resident[key] = dev
+        self._evict_to_capacity(keep=(key,))
+        return dev
+
+    def put(self, i: int, j: int, dev) -> None:
+        """Install a freshly computed device tile as the resident copy
+        and mark it dirty (host DRAM is stale until write-back)."""
+        key = (i, j)
+        self._resident[key] = dev
+        self._resident.move_to_end(key)
+        self._dirty.add(key)
+        self._prefetched.discard(key)
+        self._evict_to_capacity(keep=(key,))
+
+    def prefetch(self, coords) -> int:
+        """Issue host→HBM transfers for up to ``depth`` of ``coords``
+        not yet resident, without blocking: ``jax.device_put`` queues
+        the copy and returns immediately, so the next panel's tiles
+        stream in UNDER the current step's compute (the
+        ``_stream_chunks`` overlap, one level up).  Returns the number
+        of transfers issued."""
+        budget = min(self.depth, max(0, self.capacity - 1))
+        issued = 0
+        for key in coords:
+            if issued >= budget:
+                break
+            if key in self._resident:
+                continue
+            self._resident[key] = self._fetch(*key)
+            self._prefetched.add(key)
+            self._evict_to_capacity(keep=(key,))
+            issued += 1
+        return issued
+
+    # -- coherence ----------------------------------------------------------
+
+    def flush(self) -> None:
+        """Write back every dirty resident tile; host DRAM becomes the
+        exact image of the computation so far (window boundaries call
+        this before a checkpoint snapshot)."""
+        for key in list(self._dirty):
+            self._write_back(key, self._resident[key])
+        self._dirty.clear()
+
+    def array(self) -> np.ndarray:
+        """Flush and return the (trimmed, copied) host matrix."""
+        self.flush()
+        return self.host[:self.m, :self.n].copy()
+
+    def host_gb_transferred(self) -> float:
+        return self.bytes_moved / 1e9

@@ -90,17 +90,19 @@ _DEF_PEAKS = {
                    "c64": 27.0, "c128": 3.2},
         "hbm_gbs": 819.0,
         "ici_gbs": 45.0,
+        "pcie_gbs": 32.0,
     },
     "cpu": {
         "tflops": {"fp32": 0.10, "bf16": 0.10, "fp64": 0.05,
                    "c64": 0.05, "c128": 0.025},
         "hbm_gbs": 20.0,
         "ici_gbs": 10.0,
+        "pcie_gbs": 8.0,
     },
 }
 
 _LABEL_RE = re.compile(
-    r"^(?P<routine>[a-z0-9]+?)(?:_batched)?_"
+    r"^(?P<routine>[a-z0-9]+?)(?:_batched)?(?P<ooc>_ooc)?_"
     r"(?P<dtype>fp32|fp64|bf16|c64|c128)_"
     r"(?P<dims>.+)$")
 _DIM_RE = re.compile(r"^([a-z]+)([0-9]+)$")
@@ -127,13 +129,16 @@ def peaks(platform: str = "tpu", dtype: str = "fp32") -> dict:
     * ``SLATE_TPU_PEAK_TFLOPS_<DTYPE>`` (e.g. ``_FP32``) then
       ``SLATE_TPU_PEAK_TFLOPS`` — compute peak in TF/s;
     * ``SLATE_TPU_PEAK_HBM_GBS`` — HBM bandwidth in GB/s;
-    * ``SLATE_TPU_PEAK_ICI_GBS`` — per-link ICI bandwidth in GB/s.
+    * ``SLATE_TPU_PEAK_ICI_GBS`` — per-link ICI bandwidth in GB/s;
+    * ``SLATE_TPU_PCIE_GBS`` (alias ``SLATE_TPU_PEAK_PCIE_GBS``) — the
+      host↔HBM link the out-of-core tile pool streams over (ISSUE 17:
+      the ``host`` stage's roofline lane).
     """
     base = _DEF_PEAKS.get(platform) or _DEF_PEAKS["tpu"]
     dtype = dtype or "fp32"
     tf = base["tflops"].get(dtype, base["tflops"]["fp32"])
     out = {"tflops": tf, "hbm_gbs": base["hbm_gbs"],
-           "ici_gbs": base["ici_gbs"]}
+           "ici_gbs": base["ici_gbs"], "pcie_gbs": base["pcie_gbs"]}
     env_tf = _env_float("SLATE_TPU_PEAK_TFLOPS_" + dtype.upper())
     if env_tf is None:
         env_tf = _env_float("SLATE_TPU_PEAK_TFLOPS")
@@ -145,6 +150,11 @@ def peaks(platform: str = "tpu", dtype: str = "fp32") -> dict:
     env_ici = _env_float("SLATE_TPU_PEAK_ICI_GBS")
     if env_ici is not None:
         out["ici_gbs"] = env_ici
+    env_pcie = _env_float("SLATE_TPU_PCIE_GBS")
+    if env_pcie is None:
+        env_pcie = _env_float("SLATE_TPU_PEAK_PCIE_GBS")
+    if env_pcie is not None:
+        out["pcie_gbs"] = env_pcie
     return out
 
 
@@ -153,8 +163,11 @@ def parse_label(label: str):
     "nb": 512})``.  Batched-driver labels carry a ``_batched`` marker
     and a leading-batch-dim token (``posv_batched_fp32_n256_b64`` →
     ``("posv", "fp32", {"n": 256, "b": 64})``) — the routine keeps its
-    base name and the model scales by ``b``.  Labels that don't match
-    the bench convention return ``(label, "", {})``."""
+    base name and the model scales by ``b``.  Out-of-core labels carry
+    an ``_ooc`` marker (``getrf_ooc_fp32_n131072_nb512``), surfaced as
+    ``dims["ooc"] = 1`` so :func:`stage_model` prices the host-transfer
+    stage without a signature change.  Labels that don't match the
+    bench convention return ``(label, "", {})``."""
     m = _LABEL_RE.match(label or "")
     if not m:
         return (label, "", {})
@@ -163,6 +176,8 @@ def parse_label(label: str):
         dm = _DIM_RE.match(tok)
         if dm:
             dims[dm.group(1)] = int(dm.group(2))
+    if m.group("ooc"):
+        dims["ooc"] = 1
     return (m.group("routine"), m.group("dtype"), dims)
 
 
@@ -365,7 +380,27 @@ def split_lane(label: str):
 
 #: stage order for reports (model dicts are unordered)
 _STAGE_ORDER = ("panel", "pivot", "trsm", "update", "verify", "solve",
-                "stage1", "chase", "stage3", "mxu", "collective")
+                "host", "stage1", "chase", "stage3", "mxu",
+                "collective")
+
+
+def _ooc_host_bytes(routine: str, n: int, nb: int, isz: int) -> float:
+    """Byte model of the out-of-core tile pool's host↔HBM traffic
+    (ISSUE 17) — what ``ooc.host_bytes`` counts with a cold window.
+    Per right-looking step k over a g = n/nb tile grid the getrf driver
+    reads + writes the (g−k)-tile strip of EVERY block column (panel,
+    laswp'd left columns, updated trailing columns); potrf touches only
+    the lower tiles.  A warm window turns re-reads into hits, so the
+    measured counter is ≤ this cold-window ceiling."""
+    g = max(1, n // max(1, nb))
+    tb = float(nb) * nb * isz
+    if routine in ("potrf", "posv"):
+        # Σ_k [1 diag + (g−k−1) panel + lower-trailing reads+writes]
+        strips = (g * (g + 1) / 2.0        # panel column tiles, r/w
+                  + g * (g + 1) * (g + 2) / 6.0)  # trailing lower tiles
+        return 2.0 * tb * strips
+    # getrf/gesv: every block column's rows-below-k strip, read + write
+    return 2.0 * tb * g * (g * (g + 1) / 2.0)
 
 
 def stage_model(routine: str, dims: dict, dtype: str = "fp32",
@@ -414,6 +449,11 @@ def stage_model(routine: str, dims: dict, dtype: str = "fp32",
     if _abft_wanted(abft) and bfac == 1 \
             and routine in ("getrf", "gesv", "potrf", "posv"):
         _abft_stages(raw, routine, m, n, nb, isz)
+    if dims.get("ooc") and routine in ("getrf", "gesv", "potrf", "posv"):
+        # out-of-core tile pool (ISSUE 17): the host↔HBM tile traffic
+        # as a zero-flop stage priced on the PCIe lane (flop
+        # normalization is untouched, so reconciliation stays exact)
+        _acc(raw, "host", 0.0, _ooc_host_bytes(routine, n, nb, isz))
     if bfac > 1:
         # leading batch dim: per-problem stage bytes and round trips
         # scale with the batch; flops ride the normalization below
@@ -465,8 +505,10 @@ def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
     t = 0.0
     mins = {}
     for s in stages:
+        # the host stage streams over the PCIe link, not HBM (ISSUE 17)
+        bw = pk["pcie_gbs"] if s["stage"] == "host" else pk["hbm_gbs"]
         m = max(s["flops"] * lane_passes / (pk["tflops"] * 1e12),
-                s["bytes"] / (pk["hbm_gbs"] * 1e9))
+                s["bytes"] / (bw * 1e9))
         mins[s["stage"]] = mins.get(s["stage"], 0.0) + m
         t += m
     if fusion == "full":
@@ -603,11 +645,19 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     stages = []
     for s in stage_fb:
         t_mxu = s["flops"] * lane_passes / (pk["tflops"] * 1e12)
-        t_hbm = s["bytes"] / (pk["hbm_gbs"] * 1e9)
+        if s["stage"] == "host":
+            # out-of-core tile traffic prices on the PCIe lane, and the
+            # pool's prefetch overlaps it with MXU work — but the gap
+            # report keeps it on the critical path (worst case) so an
+            # overlap regression shows up as a closing gap, not a lie
+            t_bw = s["bytes"] / (pk["pcie_gbs"] * 1e9)
+            bound = "pcie"
+        else:
+            t_bw = s["bytes"] / (pk["hbm_gbs"] * 1e9)
+            bound = "mxu" if t_mxu >= t_bw else "hbm"
         stages.append({"stage": s["stage"], "flops": s["flops"],
-                       "bytes": s["bytes"],
-                       "bound": "mxu" if t_mxu >= t_hbm else "hbm",
-                       "min_s": max(t_mxu, t_hbm)})
+                       "bytes": s["bytes"], "bound": bound,
+                       "min_s": max(t_mxu, t_bw)})
 
     lookahead = None
     if fusion == "full":

@@ -1372,6 +1372,54 @@ def choose_potrf_step(n: int, nb: int, dtype, eligible: bool,
         Candidate(d, (lambda d=d: _setup(d)), check) for d in depths])
 
 
+def choose_ooc(n: int, nb: int, dtype, eligible: bool) -> str:
+    """Single-chip residency of one square factorization: ``"pool"``
+    (the out-of-core tile-pool drivers — host-DRAM (nb, nb)-tile grid
+    with a bounded LRU window of HBM-resident tiles, dirty write-back
+    and async prefetch, ``linalg.ooc`` over ``ops.tilepool``) vs
+    ``"incore"`` (every existing driver; the matrix stays in HBM).
+    ``eligible`` is the call site's shape gate
+    (``linalg.ooc.pool_eligible``); the tri-state ``SLATE_TPU_OOC``
+    knob forces the decision.
+
+    Unlike the kernel ladders this site resolves ANALYTICALLY under
+    ``auto`` (the ``dist_chunk`` precedent): a timing rep at genuinely
+    out-of-core dims (n=131072 fp32 = 64 GiB) would itself be a
+    multi-hour factorization, so on TPU the decision weighs the
+    working set — operand + factor + workspace headroom — against the
+    HBM budget (``SLATE_TPU_OOC_HBM_MB``), both ends priced by the same
+    ``host``-stage roofline (``SLATE_TPU_PCIE_GBS``) the attr.py gap
+    reports reconcile against.  Off-TPU the ladder resolves to in-core
+    (the forced knob honoured for CI, like every other site)."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (n, nb, dt.name, _precision_name())
+    if not eligible:
+        return _static("ooc", key, "incore", "ineligible")
+    mode = config.ooc_mode()
+    if mode == "off":
+        return _static("ooc", key, "incore", "forced-config")
+    if mode == "on":
+        return _static("ooc", key, "pool", "forced-config")
+    if not _on_tpu():
+        forced = _forced("ooc")
+        if forced in ("incore", "pool"):
+            return _static("ooc", key, forced, "forced")
+        return _default("ooc", key, ("incore", "pool"), "incore")
+    from ..ops import tilepool
+
+    # 3x: operand tiles + trailing workspace + double-buffer headroom —
+    # in-core needs the whole set resident, the pool only its window
+    need = 3.0 * n * n * dt.itemsize
+    if need > tilepool.hbm_budget_bytes():
+        return _static("ooc", key, "pool", "analytic")
+    return _default("ooc", key, ("incore", "pool"), "incore")
+
+
 def choose_dist_panel(op: str, nb: int, dtype, eligible: bool,
                       eligible_fused: bool = True, m: int | None = None,
                       w: int | None = None) -> str:
@@ -1901,6 +1949,8 @@ _CHOOSERS = {
                                                  kw["eligible"],
                                                  kw.get("eligible_full",
                                                         False)),
+    "ooc": lambda **kw: choose_ooc(kw["n"], kw["nb"], kw["dtype"],
+                                   kw["eligible"]),
     "dist_panel": lambda **kw: choose_dist_panel(kw["driver"], kw["nb"],
                                                  kw["dtype"],
                                                  kw["eligible"],

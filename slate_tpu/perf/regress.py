@@ -85,7 +85,7 @@ DEFAULT_THRESHOLD_PCT = 5.0
 ABFT_OVERHEAD_CEILING_PCT = 10.0
 
 _LABEL_RE = re.compile(
-    r"^(?P<routine>[a-z0-9]+?)(?P<batched>_batched)?_"
+    r"^(?P<routine>[a-z0-9]+?)(?P<batched>_batched)?(?P<ooc>_ooc)?_"
     r"(?P<dtype>fp32|fp64|bf16|c64|c128)_"
     r"(?P<dims>.+)$")
 
@@ -107,6 +107,10 @@ _OPS_FOR_ROUTINE = {
     "gesv_batched": ("batched_lu",),
     "geqrf_batched": ("batched_qr",),
     "gels_batched": ("batched_qr",),
+    # out-of-core labels (<op>_ooc_<dtype>_n<n>_nb<nb>, ISSUE 17): the
+    # backend tag is the ooc site's pool-vs-incore residency decision
+    "getrf_ooc": ("ooc",),
+    "potrf_ooc": ("ooc",),
 }
 
 
@@ -118,7 +122,8 @@ def parse_label(label: str):
     m = _LABEL_RE.match(label)
     if not m:
         return (label, "", "")
-    return (m.group("routine") + (m.group("batched") or ""),
+    return (m.group("routine") + (m.group("batched") or "")
+            + (m.group("ooc") or ""),
             m.group("dtype"), m.group("dims"))
 
 
@@ -142,7 +147,11 @@ def direction(label: str) -> float:
     ``_floor_override``) are both bigger-is-better, the +1 default."""
     if label.endswith("_per_s"):
         return 1.0
-    if label.endswith(("_ms", "_hbm_roundtrips", "_abft_overhead_pct")):
+    if label.endswith(("_ms", "_hbm_roundtrips", "_abft_overhead_pct",
+                       "_host_gb_transferred")):
+        # _host_gb_transferred (ISSUE 17): GB moved over the host link
+        # per out-of-core factorization — a rise means the window or
+        # prefetch schedule regressed into re-fetching tiles
         return -1.0
     return -1.0 if label.endswith("_s") else 1.0
 
@@ -374,11 +383,13 @@ def _num(v, label: str = "") -> Optional[float]:
         # zero — every finite value is a measurement the ceiling
         # sentinel must see
         return float(v)
-    if label.endswith(("_hbm_roundtrips", "_over_floor")):
-        # structural counts (steady state 0) and floor-sentinel ratios
-        # (a total efficiency collapse IS 0): zero is a measured value
-        # the structural judges below compare against, not the
-        # failed-routine placeholder the v > 0 filter drops
+    if label.endswith(("_hbm_roundtrips", "_over_floor",
+                       "_host_gb_transferred")):
+        # structural counts (steady state 0), floor-sentinel ratios (a
+        # total efficiency collapse IS 0) and host-link byte odometers
+        # (an all-resident window legitimately moves ~0 GB): zero is a
+        # measured value the structural judges below compare against,
+        # not the failed-routine placeholder the v > 0 filter drops
         return float(v) if v >= 0 else None
     return float(v) if v > 0 else None
 

@@ -478,6 +478,83 @@ def _build_lu_driver(u):
                  at.Candidate("scattered", setup_scattered, check)]
 
 
+def _build_ooc(u):
+    """Sweep unit for the out-of-core residency site (ISSUE 17): time
+    the in-core blocked recursion against the host-DRAM tile pool at
+    the SAME key ``choose_ooc`` derives.  Both candidates share one
+    diag-dominant probe and the LU factor residual gate; at sweepable
+    dims the pool pays pure PCIe overhead — in-core should win, and a
+    bundle that says otherwise is auditable evidence the host path
+    regressed.  The tiny forced window (capacity 4) makes the CPU
+    smoke sweep exercise eviction + write-back, not just residency."""
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    n, nb = int(u["n"]), int(u["nb"])
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    key = (n, nb, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _a():
+        def mk():
+            return at._randn((n, n), dt, 17) + n * jnp.eye(n, dtype=dt)
+        return at._memo(probes, "a", mk)
+
+    def setup_incore():
+        from ..linalg.lu import getrf_rec
+
+        return at._timed_call(lambda x: getrf_rec(x, nb), _a())
+
+    def setup_pool():
+        import jax
+
+        from ..linalg import ooc
+
+        # NOT _timed_call: the pool is host-side/eager-only (a jitted
+        # probe would trace the host grid) — time the eager driver
+        # exactly as dispatch runs it
+        x = _a()
+
+        def run():
+            return jax.block_until_ready(
+                ooc.getrf_ooc(x, nb=nb, capacity=4))
+
+        return run
+
+    def check(out):
+        return at._lu_factor_residual_ok(out, _a(), n, n, dt)
+
+    return key, [at.Candidate("incore", setup_incore, check),
+                 at.Candidate("pool", setup_pool, check)]
+
+
+def _predict_ooc(key_parts, names, platform):
+    """Roofline pricing for the ``ooc`` site (ISSUE 17): both
+    candidates run the same right-looking tile arithmetic, so the pool
+    is priced as the in-core prediction PLUS the cold-window host↔HBM
+    tile traffic (attr's zero-flop ``host`` stage on the PCIe
+    roofline).  At any HBM-resident shape in-core prices cheaper —
+    that ordering is all pruning needs; the runtime chooser owns the
+    case pricing can't express, the working set exceeding HBM."""
+    if len(key_parts) < 3:
+        return {}
+    n, nb = int(key_parts[0]), int(key_parts[1])
+    dt = _short(key_parts[2])
+    a = _attr()
+    out = {}
+    for name in names:
+        dims = {"m": n, "n": n, "nb": nb}
+        if name == "pool":
+            dims["ooc"] = 1
+        elif name != "incore":
+            return {}
+        t = a.predict_seconds("getrf", dims, dt, platform=platform)
+        if t is None:
+            return {}
+        out[name] = t
+    return out
+
+
 def _build_dist_chunk(u):
     """Sweep unit for the distributed panel-broadcast slice count: time
     the fused ``bcast_block_col`` at each chunking on THE MESH THIS
@@ -639,6 +716,10 @@ SITES: Dict[str, SiteSpec] = {
     # the offline bundle can pin the chunking per (mesh shape, nb,
     # dtype) without the runtime ever owning a timeable mesh
     "dist_chunk": SiteSpec(_build_dist_chunk, _predict_dist_chunk),
+    # host-DRAM tile-pool residency (ISSUE 17): priced as in-core +
+    # PCIe tile traffic, timed with a forced tiny window so the smoke
+    # sweep proves eviction/write-back end to end
+    "ooc": SiteSpec(_build_ooc, _predict_ooc),
 }
 
 
@@ -666,6 +747,9 @@ def _full_units():
     for op in ("potrf", "getrf", "geqrf", "trsm"):
         for nb in (256, 512, 1024):
             units.append({"site": "dist_chunk", "op": op, "nb": nb})
+    for n in (4096, 8192):
+        for nb in (512, 1024):
+            units.append({"site": "ooc", "n": n, "nb": nb})
     return units
 
 
@@ -683,6 +767,7 @@ GRIDS = {
             {"site": "lu_driver", "m": 256, "n": 256, "nb": 128},
             {"site": "batched_potrf", "b": 4, "n": 64},
             {"site": "batched_lu", "b": 4, "n": 64},
+            {"site": "ooc", "n": 128, "nb": 32},
         ],
         "warm": [{"op": "posv", "batch": 1, "dims": [96],
                   "dtype": "float32"}],

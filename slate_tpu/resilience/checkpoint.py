@@ -59,8 +59,11 @@ def every_steps() -> int:
 
 def snapshot(state):
     """Device→host copy of a step carry (tuple/list of arrays — jax,
-    numpy, or host scalars).  ``np.asarray`` materializes each leaf on
-    the host; feeding the copies back into the same jitted chunk
+    numpy, or host scalars).  Each leaf is materialized on the host AS
+    A COPY: ``np.asarray`` alone would alias a leaf that is already a
+    numpy array, and a chunk that then mutates its carry in place (the
+    out-of-core tile pool's host grid) would silently corrupt the
+    rewind image.  Feeding the copies back into the same jitted chunk
     program re-places them per its shardings, so a restore is
     value-exact."""
     import numpy as np
@@ -70,7 +73,7 @@ def snapshot(state):
     if isinstance(state, (tuple, list)):
         return tuple(snapshot(s) for s in state)
     if hasattr(state, "shape"):
-        return np.asarray(state)
+        return np.array(state, copy=True)
     return state
 
 

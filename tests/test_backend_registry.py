@@ -255,6 +255,50 @@ def test_abft_and_checkpoint_inert_at_import():
         (out.stdout, out.stderr)
 
 
+def test_tilepool_inert_at_import():
+    """ISSUE 17 guard: with every out-of-core knob SET, importing the
+    package (and the lu/cholesky drivers that consult the ``ooc``
+    dispatch gate, and the gate module itself) must not load
+    ``ops.tilepool`` — the pool loads at the first pool-routed driver
+    call, never at import.  Subprocess, like the guards above."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import slate_tpu as st\n"
+        "import slate_tpu.linalg.lu\n"
+        "import slate_tpu.linalg.cholesky\n"
+        "import slate_tpu.linalg.ooc\n"
+        "assert 'slate_tpu.ops.tilepool' not in sys.modules, \\\n"
+        "    'tilepool loaded at import'\n"
+        "from slate_tpu.ops import tilepool\n"
+        "assert tilepool.window_tiles() == 3\n"
+        "assert tilepool.ooc_nb() == 32\n"
+        "print('OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLATE_TPU_OOC="1", SLATE_TPU_OOC_NB="32",
+               SLATE_TPU_OOC_WINDOW_TILES="3",
+               SLATE_TPU_OOC_PREFETCH_DEPTH="2")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
+def test_ooc_knobs_documented():
+    """The out-of-core knobs must be registered in the user-facing knob
+    table (docs/usage.md) — an undocumented residency knob is an
+    invisible one."""
+    docs = (_PKG.parent / "docs" / "usage.md").read_text()
+    for knob in ("SLATE_TPU_OOC", "SLATE_TPU_OOC_NB",
+                 "SLATE_TPU_OOC_WINDOW_TILES",
+                 "SLATE_TPU_OOC_PREFETCH_DEPTH",
+                 "SLATE_TPU_OOC_HBM_MB", "SLATE_TPU_PCIE_GBS"):
+        assert knob in docs, f"{knob} missing from docs/usage.md"
+
+
 def test_abft_knobs_documented():
     """The new knobs must be registered in the user-facing knob table
     (docs/usage.md ABFT section) — an undocumented resilience knob is
@@ -460,7 +504,7 @@ def test_multi_backend_sites_populate_autotune_table():
                "lu_step|", "potrf_step|", "dist_panel|potrf",
                "dist_panel|geqrf", "dist_pivot|", "dist_chunk|",
                "dist_lookahead|",
-               "geqrf_panel|", "chase|hb2st",
+               "geqrf_panel|", "chase|hb2st", "ooc|",
                "batched_potrf|", "batched_lu|", "batched_qr|"):
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"

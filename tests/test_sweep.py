@@ -154,6 +154,7 @@ class TestPruning:
             "lu_driver": ["rec", "scattered"],
             "batched_potrf": ["vmapped", "grid"],
             "batched_lu": ["vmapped", "grid"],
+            "ooc": ["incore", "pool"],
         }
         total = timed = 0
         for u in grid["units"]:
@@ -161,7 +162,7 @@ class TestPruning:
             if u["site"].startswith("batched"):
                 kp = (sweep.pow2_bucket(u["b"]),
                       sweep.pow2_bucket(u["n"]), "float32", "HIGH")
-            elif u["site"] == "potrf_step":
+            elif u["site"] in ("potrf_step", "ooc"):
                 kp = (u["n"], u["nb"], "float32", "HIGH")
             else:
                 kp = (u["m"], u["n"], u["nb"], "float32", "HIGH")

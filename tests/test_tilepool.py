@@ -1,0 +1,383 @@
+"""ISSUE 17 — out-of-core tile pool: host-DRAM residency for
+factorizations whose working set exceeds HBM.
+
+Five structural guarantees under test:
+
+* **residency protocol** — LRU eviction order, dirty write-back
+  exactness (host DRAM is byte-for-byte the device value after flush),
+  prefetch-hit accounting, and the off-by-default metrics contract
+  (registry off → no ``ooc.*`` key ever materializes);
+* **window-size bitwise parity** — a forced 2-tile window and an
+  all-resident window produce bitwise-identical getrf/potrf factors
+  (residency never changes arithmetic: an all-resident pool IS the
+  in-core execution of the OOC driver), plus residual gates against
+  the factorization identities;
+* **dispatch** — with the ``ooc`` site forced, end-to-end gesv/posv
+  route through the pool (decision recorded in the autotune table,
+  host-link odometer moves) and still pass their residual gates;
+* **checkpoint composition** — ``SLATE_TPU_CKPT_EVERY_STEPS`` +
+  injected ``device_loss`` rewinds to the window-boundary snapshot and
+  reproduces the uninterrupted factors bitwise (the PR 14 contract
+  carried into the out-of-core drivers);
+* **inertness** — forcing every OOC knob must not change compiled
+  programs (traced operands keep the in-core path; the pool is
+  host-side/eager-only), and the attr.py ``host`` stage is zero-flop
+  so the roofline gap report still reconciles exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import slate_tpu as st
+from slate_tpu import config
+from slate_tpu.linalg import cholesky as chol_mod
+from slate_tpu.linalg import lu as lu_mod
+from slate_tpu.linalg import ooc
+from slate_tpu.ops import tilepool
+from slate_tpu.perf import attr, autotune, metrics, regress
+from slate_tpu.resilience import inject
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    autotune.reset_table()
+    inject.clear_plan()
+    metrics.reset()
+    metrics.off()
+    yield
+    inject.clear_plan()
+    metrics.reset()
+    metrics.off()
+    autotune.reset_table()
+
+
+def _lu_mat(n, dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)) + 2.0 * np.sqrt(n) * np.eye(n)
+    return a.astype(dtype)
+
+
+def _spd_mat(n, dtype=np.float32, seed=1):
+    rng = np.random.default_rng(seed)
+    g = rng.standard_normal((n, n))
+    return (g @ g.T / n + np.eye(n)).astype(dtype)
+
+
+def _lu_resid(a, lu, perm):
+    n = a.shape[0]
+    lmat = np.tril(lu, -1) + np.eye(n, dtype=a.dtype)
+    umat = np.triu(lu)
+    eps = np.finfo(a.dtype).eps
+    return float(np.abs(a[perm] - lmat @ umat).max()
+                 / (np.abs(a).max() * n * eps))
+
+
+def _chol_resid(a, l):
+    n = a.shape[0]
+    eps = np.finfo(a.dtype).eps
+    return float(np.linalg.norm(np.tril(l) @ np.tril(l).T - a)
+                 / (np.linalg.norm(a) * eps * n))
+
+
+def _ooc_counters():
+    return {k: v for k, v in metrics.snapshot()["counters"].items()
+            if k.startswith(("ooc.", "ckpt."))}
+
+
+# ---------------------------------------------------------------------------
+# The residency protocol: LRU, write-back, prefetch, metrics contract
+# ---------------------------------------------------------------------------
+
+class TestTilePool:
+
+    def test_lru_eviction_order(self):
+        metrics.on()
+        a = _lu_mat(96)
+        pool = tilepool.TilePool(a, 32, capacity=2, depth=0)
+        pool.get(0, 0)
+        pool.get(0, 1)
+        pool.get(0, 2)               # over capacity: (0, 0) is LRU
+        assert (0, 0) not in pool._resident
+        assert (0, 1) in pool._resident and (0, 2) in pool._resident
+        pool.get(0, 1)               # touch: (0, 1) becomes MRU
+        pool.get(1, 0)               # now (0, 2) is the LRU victim
+        assert (0, 2) not in pool._resident
+        assert (0, 1) in pool._resident
+        assert _ooc_counters().get("ooc.evictions") == 2.0
+
+    def test_dirty_write_back_exact(self):
+        a = _lu_mat(96)
+        pool = tilepool.TilePool(a, 32, capacity=2, depth=0)
+        fresh = jnp.asarray(
+            np.random.default_rng(3).standard_normal((32, 32))
+            .astype(np.float32))
+        pool.put(1, 1, fresh)
+        # host DRAM is stale until flush, then byte-for-byte exact
+        assert not np.array_equal(pool.host[32:64, 32:64],
+                                  np.asarray(fresh))
+        pool.flush()
+        assert np.array_equal(pool.host[32:64, 32:64],
+                              np.asarray(fresh))
+        # eviction write-back takes the same exact path
+        other = fresh + jnp.float32(1.0)
+        pool.put(2, 2, other)
+        pool.get(0, 0)
+        pool.get(0, 1)               # evicts the dirty (2, 2)
+        assert (2, 2) not in pool._resident
+        assert np.array_equal(pool.host[64:96, 64:96],
+                              np.asarray(other))
+
+    def test_prefetch_hit_accounting(self):
+        metrics.on()
+        a = _lu_mat(96)
+        pool = tilepool.TilePool(a, 32, capacity=4, depth=2)
+        assert pool.prefetch([(0, 0), (0, 1), (0, 2)]) == 2  # depth-capped
+        pool.get(0, 0)
+        pool.get(0, 1)
+        c = _ooc_counters()
+        assert c.get("ooc.prefetch.hits") == 2.0
+        assert "ooc.prefetch.misses" not in c
+        pool.get(0, 2)               # never prefetched: a miss
+        assert _ooc_counters().get("ooc.prefetch.misses") == 1.0
+
+    def test_bytes_odometer_counts_both_directions(self):
+        a = _lu_mat(64)
+        pool = tilepool.TilePool(a, 32, capacity=4, depth=0)
+        tb = pool.tile_bytes
+        pool.get(0, 0)                          # one fetch
+        pool.put(0, 0, pool.get(0, 0) * 2.0)    # dirty
+        pool.flush()                            # one write-back
+        assert pool.bytes_moved == 2 * tb
+        assert pool.host_gb_transferred() == pytest.approx(2 * tb / 1e9)
+
+    def test_metrics_off_records_nothing(self):
+        # the PR 4 contract: with the registry off (the default) every
+        # pool event is a one-attribute-read no-op — no ooc.* key ever
+        # materializes
+        a = _lu_mat(96)
+        pool = tilepool.TilePool(a, 32, capacity=2, depth=1)
+        pool.prefetch([(0, 0)])
+        pool.get(0, 0)
+        pool.put(0, 1, pool.get(0, 1))
+        pool.flush()
+        snap = metrics.snapshot()
+        assert not any(k.startswith("ooc.")
+                       for k in (snap.get("counters") or {}))
+
+
+# ---------------------------------------------------------------------------
+# The OOC drivers: window parity, residuals, dispatch composition
+# ---------------------------------------------------------------------------
+
+class TestOOCDrivers:
+
+    def test_getrf_window_parity_bitwise(self):
+        a = _lu_mat(128)
+        lu_all, p_all = ooc.getrf_ooc(jnp.asarray(a), nb=32,
+                                      capacity=64, depth=4)
+        lu_tiny, p_tiny = ooc.getrf_ooc(jnp.asarray(a), nb=32,
+                                        capacity=2, depth=1)
+        assert np.array_equal(np.asarray(lu_all), np.asarray(lu_tiny))
+        assert np.array_equal(np.asarray(p_all), np.asarray(p_tiny))
+        assert _lu_resid(a, np.asarray(lu_all), np.asarray(p_all)) < 3.0
+
+    def test_getrf_residual_vs_incore(self):
+        # vs the in-core dispatch the residual gate is the contract
+        # (pivot ties and trailing-update summation order may differ)
+        a = _lu_mat(128, seed=5)
+        lu_p, perm_p = ooc.getrf_ooc(jnp.asarray(a), nb=32, capacity=3)
+        lu_i, perm_i = lu_mod._getrf_partial(jnp.asarray(a), 32)
+        assert _lu_resid(a, np.asarray(lu_p), np.asarray(perm_p)) < 3.0
+        assert _lu_resid(a, np.asarray(lu_i), np.asarray(perm_i)) < 3.0
+
+    def test_potrf_window_parity_bitwise(self):
+        a = _spd_mat(128)
+        l_all = ooc.potrf_ooc(jnp.asarray(a), nb=32, capacity=64,
+                              depth=4)
+        l_tiny = ooc.potrf_ooc(jnp.asarray(a), nb=32, capacity=2,
+                               depth=1)
+        assert np.array_equal(np.asarray(l_all), np.asarray(l_tiny))
+        assert _chol_resid(a, np.asarray(l_all)) < 3.0
+
+    def test_getrf_f64_supported(self):
+        a = _lu_mat(96, dtype=np.float64, seed=7)
+        lu, perm = ooc.getrf_ooc(jnp.asarray(a, jnp.float64), nb=32,
+                                 capacity=3)
+        assert np.asarray(lu).dtype == np.float64
+        assert _lu_resid(a, np.asarray(lu), np.asarray(perm)) < 3.0
+
+    def test_gesv_through_forced_site(self, monkeypatch):
+        metrics.on()
+        monkeypatch.setattr(config, "ooc", True)
+        monkeypatch.setenv("SLATE_TPU_OOC_NB", "32")
+        monkeypatch.setenv("SLATE_TPU_OOC_WINDOW_TILES", "3")
+        a = _lu_mat(128, seed=2)
+        b = np.random.default_rng(4).standard_normal(
+            (128, 8)).astype(np.float32)
+        lu, perm, x = lu_mod.gesv(jnp.asarray(a), jnp.asarray(b))
+        resid = (np.linalg.norm(a @ np.asarray(x) - b)
+                 / (np.linalg.norm(a) * np.linalg.norm(b)
+                    * np.finfo(np.float32).eps * 128))
+        assert resid < 3.0
+        dec = autotune.decisions()
+        assert any(k.startswith("ooc|") and v == "pool"
+                   for k, v in dec.items()), sorted(dec)
+        assert _ooc_counters().get("ooc.host_bytes", 0.0) > 0
+
+    def test_posv_through_forced_site(self, monkeypatch):
+        metrics.on()
+        monkeypatch.setattr(config, "ooc", True)
+        monkeypatch.setenv("SLATE_TPU_OOC_NB", "32")
+        monkeypatch.setenv("SLATE_TPU_OOC_WINDOW_TILES", "3")
+        a = _spd_mat(128, seed=3)
+        b = np.random.default_rng(5).standard_normal(
+            (128, 4)).astype(np.float32)
+        fac, x = chol_mod.posv(
+            st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower),
+            jnp.asarray(b))
+        resid = (np.linalg.norm(a @ np.asarray(x) - b)
+                 / (np.linalg.norm(a) * np.linalg.norm(b)
+                    * np.finfo(np.float32).eps * 128))
+        assert resid < 3.0
+        dec = autotune.decisions()
+        assert any(k.startswith("ooc|") and v == "pool"
+                   for k, v in dec.items()), sorted(dec)
+        assert _ooc_counters().get("ooc.host_bytes", 0.0) > 0
+
+    def test_config_off_never_pools(self, monkeypatch):
+        metrics.on()
+        monkeypatch.setattr(config, "ooc", False)
+        monkeypatch.setenv("SLATE_TPU_OOC_NB", "32")
+        lu, perm = lu_mod._getrf_partial(jnp.asarray(_lu_mat(128)), 32)
+        assert _lu_resid(_lu_mat(128), np.asarray(lu),
+                         np.asarray(perm)) < 3.0
+        assert not any(k.startswith("ooc.")
+                       for k in _ooc_counters())
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint composition: window-boundary snapshots, bitwise rewind
+# ---------------------------------------------------------------------------
+
+class TestOOCCheckpoint:
+
+    def test_getrf_device_loss_resume_bitwise(self, monkeypatch):
+        metrics.on()
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "2")
+        a = jnp.asarray(_lu_mat(128, seed=9))
+        lu_clean, p_clean = ooc.getrf_ooc(a, nb=32, capacity=3)
+        inject.install(
+            inject.FaultPlan(seed=7).add("step.boundary", "device_loss",
+                                         rate=1.0, count=1))
+        lu_chaos, p_chaos = ooc.getrf_ooc(a, nb=32, capacity=3)
+        assert np.array_equal(np.asarray(lu_clean),
+                              np.asarray(lu_chaos))
+        assert np.array_equal(np.asarray(p_clean), np.asarray(p_chaos))
+        c = _ooc_counters()
+        assert c.get("ckpt.restored") == 1.0
+        assert c.get("ckpt.saved", 0.0) >= 1.0
+
+    def test_potrf_device_loss_resume_bitwise(self, monkeypatch):
+        metrics.on()
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "2")
+        a = jnp.asarray(_spd_mat(128, seed=11))
+        l_clean = ooc.potrf_ooc(a, nb=32, capacity=3)
+        inject.install(
+            inject.FaultPlan(seed=7).add("step.boundary", "device_loss",
+                                         rate=1.0, count=1))
+        l_chaos = ooc.potrf_ooc(a, nb=32, capacity=3)
+        assert np.array_equal(np.asarray(l_clean), np.asarray(l_chaos))
+        assert _ooc_counters().get("ckpt.restored") == 1.0
+
+    def test_checkpointed_matches_unchunked_bitwise(self, monkeypatch):
+        # chunking only changes WHEN the pool flushes, never arithmetic
+        a = jnp.asarray(_lu_mat(128, seed=13))
+        lu_mono, p_mono = ooc.getrf_ooc(a, nb=32, capacity=3)
+        monkeypatch.setenv("SLATE_TPU_CKPT_EVERY_STEPS", "1")
+        lu_chunk, p_chunk = ooc.getrf_ooc(a, nb=32, capacity=3)
+        assert np.array_equal(np.asarray(lu_mono),
+                              np.asarray(lu_chunk))
+        assert np.array_equal(np.asarray(p_mono), np.asarray(p_chunk))
+
+
+# ---------------------------------------------------------------------------
+# Inertness and the pricing model
+# ---------------------------------------------------------------------------
+
+class TestInertAndModel:
+
+    def test_lowering_bit_identical_with_ooc_forced(self, monkeypatch):
+        a = jnp.asarray(_lu_mat(64))
+
+        def lower():
+            def f(v):        # fresh function: defeat the trace cache
+                return lu_mod._getrf_partial(v, 32)
+
+            return jax.jit(f).lower(a).as_text()
+
+        base = lower()
+        monkeypatch.setattr(config, "ooc", True)
+        monkeypatch.setenv("SLATE_TPU_OOC_NB", "32")
+        monkeypatch.setenv("SLATE_TPU_OOC_WINDOW_TILES", "2")
+        autotune.reset_table()
+        assert lower() == base, (
+            "the pool is host-side/eager-only: under a trace the OOC "
+            "knobs must not change the compiled program")
+
+    def test_parse_label_ooc_marker(self):
+        routine, dt, dims = attr.parse_label(
+            "getrf_ooc_fp32_n131072_nb1024")
+        assert routine == "getrf" and dt == "fp32"
+        assert dims["n"] == 131072 and dims["nb"] == 1024
+        assert dims["ooc"] == 1
+        # the marker-free label stays marker-free
+        assert "ooc" not in attr.parse_label("getrf_fp32_n8192_nb512")[2]
+
+    def test_host_stage_zero_flop_reconciles(self):
+        dims = {"m": 512, "n": 512, "nb": 128, "ooc": 1}
+        stages, _rts = attr.stage_model("getrf", dims)
+        by_name = {s["stage"]: s for s in stages}
+        assert "host" in by_name
+        assert by_name["host"]["flops"] == 0.0
+        assert by_name["host"]["bytes"] > 0
+        # zero-flop host stage leaves the normalization contract exact:
+        # stage flops still sum to the model count (the 1% gap-report
+        # reconciliation rides on this)
+        total = sum(s["flops"] for s in stages)
+        model = attr.model_flops("getrf", dims)
+        assert total == pytest.approx(model, rel=1e-9)
+
+    def test_pool_priced_above_incore(self):
+        dims = {"m": 1024, "n": 1024, "nb": 256}
+        t_inc = attr.predict_seconds("getrf", dims, "fp32",
+                                     platform="cpu")
+        t_pool = attr.predict_seconds("getrf", dict(dims, ooc=1),
+                                      "fp32", platform="cpu")
+        assert t_pool > t_inc        # the PCIe host stage costs time
+
+    def test_pcie_peak_env_override(self, monkeypatch):
+        monkeypatch.setenv("SLATE_TPU_PCIE_GBS", "64")
+        assert attr.peaks("tpu")["pcie_gbs"] == 64.0
+
+    def test_regress_judges_host_gb_lower_better(self):
+        key = "getrf_ooc_fp32_n128_nb32_host_gb_transferred"
+        assert regress.direction(key) == -1.0
+        # an all-resident window legitimately moves ~0 GB — zero is a
+        # measurement, not a failed-routine placeholder
+        assert regress._num(0.0, key) == 0.0
+
+    def test_choose_ooc_analytic_budget(self, monkeypatch):
+        # off-TPU the ladder resolves in-core; the analytic HBM-budget
+        # rule is still unit-testable through the chooser directly by
+        # faking the platform check
+        monkeypatch.setattr(autotune, "_on_tpu", lambda: True)
+        monkeypatch.setenv("SLATE_TPU_OOC_HBM_MB", "1")  # 1 MiB budget
+        autotune.reset_table()
+        assert autotune.choose_ooc(1024, 256, jnp.float32,
+                                   eligible=True) == "pool"
+        dec = autotune.table().decisions
+        assert any(k.startswith("ooc|") and v.get("source") == "analytic"
+                   for k, v in dec.items()), sorted(dec)
