@@ -493,6 +493,19 @@ _SVD_STAGES = {"stage1_s": "stage.svd.stage1",
                "stage2_chase_s": "chase.tb2bd",
                "stage3_s": "stage.svd.stage3"}
 
+#: the QDWH spectral tier's stage timers (ISSUE 18): the polar loop and
+#: D&C record stage.<ns>.{qr,chol,gemm} (linalg/polar.py); crossover
+#: leaves falling back to the two-stage chain still land on
+#: stage.<ns>.stage1 — carried so the leaf share is visible.
+_QDWH_HEEV_STAGES = {"qr_s": "stage.heev.qr",
+                     "chol_s": "stage.heev.chol",
+                     "gemm_s": "stage.heev.gemm",
+                     "stage1_s": "stage.heev.stage1"}
+_QDWH_SVD_STAGES = {"qr_s": "stage.svd.qr",
+                    "chol_s": "stage.svd.chol",
+                    "gemm_s": "stage.svd.gemm",
+                    "stage1_s": "stage.svd.stage1"}
+
 
 def _stage_totals(stage_map):
     timers = _metrics_snapshot().get("timers", {})
@@ -1354,6 +1367,60 @@ def main():
         return label, gf, resid, _stage_delta(label, _SVD_STAGES, stages0)
 
 
+    # ---- QDWH spectral tier (ISSUE 18) -------------------------------
+    # heev/svd through the gemm-rich QDWH drivers, pinned per call via
+    # the eig_driver/svd_driver options (forced dispatch, not autotune —
+    # the plain heev/svd rows above keep measuring whatever the table
+    # picks).  Labeled heev_qdwh_*/svd_qdwh_* so attr.py prices them
+    # with the QDWH stage model; excluded from the headline geomean
+    # like every other spectral row.
+    nqd32 = nev32 // 2
+
+    def bench_heev_qdwh32():
+        rng = np.random.default_rng(11)
+        g = rng.standard_normal((nqd32, nqd32)).astype(np.float32)
+        herm_np = ((g + g.T) / 2).astype(np.float32)
+        import slate_tpu as st
+        from slate_tpu.enums import Uplo
+        hm = st.HermitianMatrix(jnp.asarray(herm_np), uplo=Uplo.Lower)
+        opts = {"eig_driver": "qdwh"}
+        jax.block_until_ready(
+            st.heev(hm, jobz=True, opts=opts)[1])        # warm + sync
+        stages0 = _stage_totals(_QDWH_HEEV_STAGES)
+        t0 = time.perf_counter()
+        w, z = st.heev(hm, jobz=True, opts=opts)
+        w = np.asarray(w); z = np.asarray(z)
+        t = time.perf_counter() - t0
+        gf = (4.0 / 3.0) * nqd32 ** 3 / t / 1e9
+        e32 = 10.0 * eps
+        resid = (np.linalg.norm(herm_np @ z - z * w[None, :])
+                 / (np.linalg.norm(herm_np) * nqd32 * e32))
+        label = "heev_qdwh_fp32_n%d" % nqd32
+        return label, gf, resid, _stage_delta(label, _QDWH_HEEV_STAGES,
+                                              stages0)
+
+
+    def bench_svd_qdwh32():
+        rng = np.random.default_rng(12)
+        a_np = rng.standard_normal((nqd32, nqd32)).astype(np.float32)
+        import slate_tpu as st
+        opts = {"svd_driver": "qdwh"}
+        jax.block_until_ready(
+            st.svd(jnp.asarray(a_np), opts=opts)[1])     # warm + sync
+        stages0 = _stage_totals(_QDWH_SVD_STAGES)
+        t0 = time.perf_counter()
+        sv, u, vt = st.svd(jnp.asarray(a_np), opts=opts)
+        sv = np.asarray(sv); u = np.asarray(u); vt = np.asarray(vt)
+        t = time.perf_counter() - t0
+        gf = (8.0 / 3.0) * nqd32 ** 3 / t / 1e9
+        e32 = 10.0 * eps
+        resid = (np.linalg.norm(a_np - (u * sv[None, :]) @ vt)
+                 / (np.linalg.norm(a_np) * nqd32 * e32))
+        label = "svd_qdwh_fp32_n%d" % nqd32
+        return label, gf, resid, _stage_delta(label, _QDWH_SVD_STAGES,
+                                              stages0)
+
+
     # ---- out-of-core getrf/potrf (ISSUE 17) --------------------------
     # host-DRAM tile pool with a FORCED tiny window (3 tiles) at
     # in-core dims: every run proves LRU eviction + dirty write-back +
@@ -1515,6 +1582,8 @@ def main():
         ("potrf_ooc", bench_potrf_ooc, True),
         ("heev_fp32", bench_heev32, True),
         ("svd_fp32", bench_svd32, True),
+        ("heev_qdwh_fp32", bench_heev_qdwh32, True),
+        ("svd_qdwh_fp32", bench_svd_qdwh32, True),
         ("heev_fp64", bench_heev64, True),
         ("svd_fp64", bench_svd64, True),
     ]

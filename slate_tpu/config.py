@@ -126,6 +126,34 @@ split_gemm = _tri_state("SLATE_TPU_SPLIT_GEMM")
 ooc = _tri_state("SLATE_TPU_OOC")
 
 
+#: Route heev/svd through the QDWH spectral tier
+#: (:mod:`slate_tpu.linalg.polar` — polar decomposition by
+#: dynamically-weighted Halley iteration, then spectral divide-and-
+#: conquer), replacing the two-stage band reduction with geqrf / potrf
+#: / gemm calls that run on the autotuned sites.  Tri-state
+#: (``SLATE_TPU_QDWH``): ``auto`` (default) lets the ``eig_driver`` /
+#: ``svd_driver`` autotune sites time qdwh against twostage per
+#: (n-bucket, dtype) on TPU — off-TPU the ladder resolves to twostage,
+#: so unset-knob lowering stays bit-identical; ``1`` forces qdwh
+#: wherever it is shape-eligible; ``0`` forces it off everywhere.
+qdwh = _tri_state("SLATE_TPU_QDWH")
+
+#: Block dimension at which the QDWH divide-and-conquer recursion hands
+#: the remaining subproblem to the stock two-stage solver
+#: (``SLATE_TPU_QDWH_CROSSOVER``, default 128).  Below this size the
+#: band reduction's O(n³) is too small for the polar iteration's
+#: constant factors to pay off.
+qdwh_crossover = int(os.environ.get("SLATE_TPU_QDWH_CROSSOVER", "128"))
+
+#: Halley-weight threshold at which a QDWH iteration switches from the
+#: QR-based step (backward stable at any conditioning) to the cheaper
+#: Cholesky-based step ``chol(I + c·XᴴX)`` (``SLATE_TPU_QDWH_SWITCH_C``,
+#: default 100).  ``I + c·XᴴX`` has condition ≈ c once X is nearly
+#: orthogonal, so small c makes the Cholesky variant safe; the
+#: ``qdwh_step`` autotune site can override per (n, c-regime, dtype).
+qdwh_switch_c = float(os.environ.get("SLATE_TPU_QDWH_SWITCH_C", "100"))
+
+
 def use_pallas_mode() -> str:
     """Resolve the tri-state :data:`use_pallas` knob to one of
     ``"auto" | "on" | "off"`` (reading the module global so tests that
@@ -159,4 +187,11 @@ def ooc_mode() -> str:
     """Resolve the tri-state :data:`ooc` knob to
     ``"auto" | "on" | "off"``."""
     v = ooc
+    return "auto" if v == "auto" else ("on" if v else "off")
+
+
+def qdwh_mode() -> str:
+    """Resolve the tri-state :data:`qdwh` knob to
+    ``"auto" | "on" | "off"``."""
+    v = qdwh
     return "auto" if v == "auto" else ("on" if v else "off")

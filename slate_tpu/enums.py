@@ -147,6 +147,17 @@ class Option(enum.Enum):
     #: route psvd's bidiagonal stage through the checkpointed tb2bd +
     #: Golub–Kahan pstedc middle — default on for n >= 2048
     SvdDist = "svd_dist"
+    #: pin the heev driver chain per call ("twostage" | "qdwh"),
+    #: bypassing the autotuned ``eig_driver`` site
+    EigDriver = "eig_driver"
+    #: pin the svd driver chain per call ("twostage" | "qdwh")
+    SvdDriver = "svd_driver"
+    #: QDWH divide-and-conquer crossover dimension (defaults to
+    #: ``config.qdwh_crossover`` / SLATE_TPU_QDWH_CROSSOVER)
+    QdwhCrossover = "qdwh_crossover"
+    #: Halley iteration cap for one polar decomposition (default 6 —
+    #: the proven QDWH bound for κ up to 1/ε)
+    QdwhMaxiter = "qdwh_maxiter"
 
 
 class MethodGemm(enum.Enum):

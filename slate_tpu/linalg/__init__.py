@@ -40,7 +40,11 @@ from .batched import (  # noqa: F401
 from .band import (  # noqa: F401
     gbmm, gbsv, gbtrf, gbtrs, hbmm, pbsv, pbtrf, pbtrs, tbsm,
 )
-from .condest import gecondest, norm1est, pocondest, trcondest  # noqa: F401
+from .condest import (  # noqa: F401
+    gecondest, norm1est, pocondest, refine_kappa_eps, spectral_interval,
+    trcondest,
+)
+from .polar import heev_qdwh, polar, svd_qdwh  # noqa: F401
 from ._stedc import (  # noqa: F401
     stedc_deflate, stedc_merge, stedc_secular, stedc_solve, stedc_sort,
     stedc_z_vector,

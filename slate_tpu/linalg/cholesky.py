@@ -287,19 +287,16 @@ def _posv_mixed_setup(a, b, opts, tol):
         # κ(A)·n·ε₃₂ approaches 1 the split factor cannot seed a
         # converging iteration, so re-factor stock before the loop ever
         # stagnates into the full-precision fallback.
-        import math
-
-        from .condest import norm1est
+        from .condest import refine_kappa_eps
 
         with split_factor_leg():
             l_lo = blocks.potrf_rec(full.astype(lo), nb)
-        n_ = full.shape[-1]
-        ainv = norm1est(
-            lambda v: _chol_solve(l_lo, v.astype(lo), nb),
-            lambda v: _chol_solve(l_lo, v.astype(lo), nb), n_)
-        kappa_eps = (float(anorm) * float(ainv) * n_
-                     * float(jnp.finfo(lo).eps))
-        if not math.isfinite(kappa_eps) or kappa_eps > 0.25:
+
+        def _solve(v):
+            return _chol_solve(l_lo, v, nb)
+
+        if refine_kappa_eps(_solve, _solve, full.shape[-1],
+                            anorm, lo) > 0.25:
             l_lo = blocks.potrf_rec(full.astype(lo), nb)
     else:
         l_lo = blocks.potrf_rec(full.astype(lo), nb)

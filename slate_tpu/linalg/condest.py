@@ -12,7 +12,8 @@ per iteration still runs on the MXU.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import math
+from typing import Callable, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -123,3 +124,104 @@ def trcondest(norm_type: Norm, a, uplo: Optional[Uplo] = None,
     dt = np.dtype(np.complex128 if jnp.iscomplexobj(av) else np.float64)
     ainv_norm = norm1est(solve, solve_h, n, dtype=dt)
     return 1.0 / (anorm * ainv_norm) if ainv_norm else 0.0
+
+
+# ---------------------------------------------------------------------------
+# Shared condition probes: the mixed-precision split legs and QDWH
+# ---------------------------------------------------------------------------
+
+def refine_kappa_eps(apply_inv, apply_inv_h, n: int, anorm: float, lo,
+                     power: int = 1) -> float:
+    """κ·ε condition probe shared by the mixed-precision split-factor
+    legs (lu / cholesky / qr demotion gates): estimate ``‖A⁻¹‖₁`` with
+    :func:`norm1est` from solve closures whose inputs are cast to the
+    low precision ``lo`` HERE (one cast site instead of per-caller
+    lambda pairs), form ``κ = anorm·est``, and return
+    ``κ**power · n · ε(lo)``.  A non-finite estimate collapses to
+    ``inf`` so callers gate with a single comparison against their
+    contraction threshold (0.25 for IR / SNE)."""
+
+    lo = np.dtype(lo)
+
+    def _cast(fn):
+        return lambda v: as_array(fn(jnp.asarray(v).astype(lo)))
+
+    dt = np.dtype(np.complex128 if lo.kind == "c" else np.float64)
+    ainv = norm1est(_cast(apply_inv), _cast(apply_inv_h), n, dtype=dt)
+    kappa = float(anorm) * float(ainv)
+    ke = (kappa ** power) * float(n) * float(np.finfo(lo).eps)
+    return ke if math.isfinite(ke) else math.inf
+
+
+def spectral_interval(a, opts: Optional[Options] = None,
+                      ) -> Tuple[float, float]:
+    """Two-sided singular-spectrum interval ``(alpha, smin_est)``:
+    ``alpha ≥ σ_max(A)`` rigorously (``sqrt(‖A‖₁·‖A‖∞)``, cross-checked
+    against a two-pass power-iteration lower bound so a norm bug cannot
+    return an interval the power estimate refutes) and ``smin_est`` a
+    deliberately LOW estimate of ``σ_min(A)`` from a Higham–Tisseur
+    1-norm estimate on the inverse of A's triangular QR factor, divided
+    by √n (norm-equivalence slack — :func:`norm1est` lower-bounds the
+    1-norm, so the raw reciprocal would overestimate σ_min).
+
+    Shared by QDWH's ``(alpha, l0 = smin_est/alpha)`` scaling — where
+    underestimating σ_min only costs Halley iterations while
+    overestimating breaks the weight recurrence — and by condition
+    reporting around the ``_refine`` probes.  Costs one ``geqrf`` of A
+    plus O(n²) estimator sweeps."""
+
+    av = as_array(a)
+    if av.ndim != 2:
+        raise ValueError("spectral_interval expects a 2-D matrix")
+    m, n = av.shape
+    if m < n:                      # σ(A) = σ(Aᴴ); factor the tall side
+        av = _ct(av)
+        m, n = n, m
+    if n == 0:
+        return 0.0, 0.0
+    nb = _nb(a, opts)
+    abs_a = jnp.abs(av)
+    n1 = float(abs_a.sum(axis=0).max())
+    ninf = float(abs_a.sum(axis=1).max())
+    alpha = math.sqrt(n1 * ninf)
+    if alpha == 0.0 or not math.isfinite(alpha):
+        return alpha, 0.0
+    # power-iteration lower bound on σ_max (deterministic probe, two
+    # AᴴA passes): certifies alpha from below and guards against a
+    # pathological norm product
+    x = jnp.asarray(1.0 + np.cos(np.arange(n, dtype=np.float64)),
+                    dtype=av.dtype)
+    low = 0.0
+    for _ in range(2):
+        y = av @ x
+        nx = float(jnp.linalg.norm(x))
+        if nx == 0.0:
+            break
+        low = float(jnp.linalg.norm(y)) / nx
+        x = _ct(av) @ y
+    alpha = max(alpha, low)
+    # σ_min via the R factor: σ_min(A) = σ_min(R) = 1/‖R⁻¹‖₂, with
+    # ‖R⁻¹‖₂ ≤ √n·‖R⁻¹‖₁ absorbing the estimator's lower-bound bias
+    from .qr import geqrf_rec
+
+    f, _taus = geqrf_rec(av, nb)
+    r = jnp.triu(f[:n])
+
+    # probe vectors are built in the estimator's f64 bookkeeping dtype;
+    # cast to the factor's dtype at the closure boundary (the one cast
+    # site, as in :func:`refine_kappa_eps`) — without it an x64-enabled
+    # session feeds f64 probes to an f32 triangular factor
+    def solve(v):
+        return blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit, r,
+                               jnp.asarray(v).astype(r.dtype), nb)
+
+    def solve_h(v):
+        return blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit,
+                               _ct(r), jnp.asarray(v).astype(r.dtype), nb)
+
+    dt = np.dtype(np.complex128 if jnp.iscomplexobj(av) else np.float64)
+    rinv = norm1est(solve, solve_h, n, dtype=dt)
+    if not (rinv > 0.0) or not math.isfinite(rinv):
+        return alpha, 0.0
+    smin = 1.0 / (rinv * math.sqrt(n))
+    return alpha, min(smin, alpha)

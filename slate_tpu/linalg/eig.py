@@ -653,7 +653,34 @@ def heev(a, jobz: bool = True, opts: Optional[Options] = None):
     ``jobz`` is False.  Method selection mirrors ``MethodEig``
     (``enums.hh:60-63``): D&C by default, QR / Bisection / MRRR on
     request.
+
+    Driver selection consults the autotuned ``eig_driver`` site
+    (``twostage`` — the band-reduction chain below — vs ``qdwh``, the
+    gemm-rich spectral divide-and-conquer of
+    :mod:`slate_tpu.linalg.polar`); an ``eig_driver`` per-call option
+    or a ``SLATE_TPU_AUTOTUNE_FORCE=eig_driver=...`` pin overrides.
     """
+
+    method = get_option(opts, "method_eig", MethodEig.Auto)
+    driver = get_option(opts, "eig_driver", None)
+    if driver is None:
+        from ..perf import autotune
+
+        av = as_array(a)
+        driver = autotune.select("eig_driver", n=av.shape[-1],
+                                 dtype=av.dtype,
+                                 eligible=method is MethodEig.Auto)
+    if driver == "qdwh":
+        from .polar import heev_qdwh
+
+        return heev_qdwh(a, jobz=jobz, opts=opts)
+    return _heev_twostage(a, jobz, opts)
+
+
+def _heev_twostage(a, jobz: bool, opts: Optional[Options]):
+    """The two-stage chain (he2hb → band eig → back-transform) — the
+    ``eig_driver=twostage`` backend, and the crossover leaf the QDWH
+    recursion bottoms out on."""
 
     method = get_option(opts, "method_eig", MethodEig.Auto)
     auto = method is MethodEig.Auto

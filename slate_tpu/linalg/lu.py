@@ -1038,20 +1038,15 @@ def _getrf_lo(av, lo, nb, anorm):
 
     if not use_split_leg(lo):
         return getrf_rec(av.astype(lo), nb)
-    import math
-
-    from .condest import norm1est
+    from .condest import refine_kappa_eps
 
     with split_factor_leg():
         lu_lo, perm = _getrf_lo(av, lo, nb, anorm)
-    n = av.shape[-1]
-    ainv = norm1est(
-        lambda v: as_array(getrs(lu_lo, perm, v.astype(lo))),
-        lambda v: as_array(getrs(lu_lo, perm, v.astype(lo),
-                                 op=Op.ConjTrans)), n)
-    kappa_eps = (float(anorm) * float(ainv) * n
-                 * float(jnp.finfo(lo).eps))
-    if not math.isfinite(kappa_eps) or kappa_eps > 0.25:
+    kappa_eps = refine_kappa_eps(
+        lambda v: getrs(lu_lo, perm, v),
+        lambda v: getrs(lu_lo, perm, v, op=Op.ConjTrans),
+        av.shape[-1], anorm, lo)
+    if kappa_eps > 0.25:
         return getrf_rec(av.astype(lo), nb)
     return lu_lo, perm
 

@@ -1,0 +1,312 @@
+"""QDWH polar decomposition and the spectral drivers built on it.
+
+``polar`` computes A = U_p·H (U_p a partial isometry, H Hermitian
+positive semidefinite) by the QR-based dynamically-weighted Halley
+iteration of Nakatsukasa, Bai & Gygi (2010): at most six iterations
+for κ up to 1/ε, each one either a QR factorization of the stacked
+``[√c·X; I]`` operand (backward stable at any conditioning) or — once
+the convergence parameter makes ``I + c·XᴴX`` well-conditioned — a
+Cholesky factorization plus two triangular solves.  Every flop is a
+geqrf / potrf / trsm / gemm already owned by the autotuned sites, so
+the polar iteration rides the split-gemm and Pallas rungs for free and
+its roofline is the gemm roofline rather than the bulge chase's.
+
+On top of it, QDWH-eig and QDWH-SVD (Nakatsukasa & Higham, 2013):
+
+* :func:`heev_qdwh` — spectral divide-and-conquer: the polar factor of
+  a shifted matrix is a matrix sign, its projector splits the spectrum
+  at the shift, an orthonormal basis from one geqrf rotates A into
+  block-diagonal form, and the halves recurse down to a crossover where
+  the stock two-stage solver finishes the small blocks.
+* :func:`svd_qdwh` — polar first (A = U_p·H), then ``heev_qdwh`` of the
+  SPSD factor H: Σ are H's eigenvalues, V its eigenvectors, U = U_p·V.
+
+The iteration start is condition-aware: ``(alpha, l0)`` come from the
+shared :func:`slate_tpu.linalg.condest.spectral_interval` estimate, so
+a well-conditioned input skips straight to the cheap Cholesky variant.
+The scale-and-stack epilogues (``[√c·X; I]`` assembly, the
+``X' = β·X + α·Q₁Q₂ᴴ`` update) fold into the geqrf operand and the
+gemm α/β so no separate materialization pass runs (the LP-GEMM
+fused-epilogue idiom).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config
+from ..enums import Diag, Op, Side, Uplo
+from ..matrix import as_array
+from ..options import Options, get_option
+from ..ops import blocks
+from ..ops.blocks import _ct, matmul
+from ..perf import metrics as _metrics
+from ..perf.metrics import instrument_driver
+from .blas3 import _nb
+from .condest import spectral_interval
+from .qr import geqrf_rec, unmqr_rec
+
+__all__ = ["polar", "heev_qdwh", "svd_qdwh"]
+
+#: depth backstop for the divide-and-conquer recursion — 2^64 exceeds
+#: any representable dimension, so hitting it means a degenerate split
+#: loop and the block is handed to the two-stage solver instead
+_DC_MAX_DEPTH = 64
+
+
+def _timer(ns: str, stage: str):
+    return _metrics.timer("stage.%s.%s" % (ns, stage))
+
+
+def _halley_weights(l: float) -> Tuple[float, float, float]:
+    """Dynamical Halley weights (a, b, c) from the lower bound ``l`` of
+    σ_min(X) — Nakatsukasa–Bai–Gygi eq. (2.4); at ``l = 1`` they reduce
+    to the classical Halley (3, 1, 3)."""
+    l = min(max(l, 1e-17), 1.0)
+    l2 = l * l
+    dd = (4.0 * (1.0 - l2) / (l2 * l2)) ** (1.0 / 3.0)
+    sq = math.sqrt(1.0 + dd)
+    a = sq + 0.5 * math.sqrt(8.0 - 4.0 * dd
+                             + 8.0 * (2.0 - l2) / (l2 * sq))
+    b = (a - 1.0) ** 2 / 4.0
+    return a, b, a + b - 1.0
+
+
+def _qr_step(x, a_k: float, b_k: float, c_k: float, nb: int, ns: str):
+    """One QR-based Halley step: X' = (b/c)·X + (a − b/c)/√c · Q₁Q₂ᴴ
+    from the thin QR of ``[√c·X; I]``.  The √c scale folds into the
+    stacked-operand build and the rank-n update folds into the gemm's
+    α/β epilogue — nothing is materialized twice.  The update runs on
+    the internal :func:`~slate_tpu.ops.blocks.matmul` building block,
+    not the public ``gemm`` facade: a driver internal re-entering an
+    instrumented facade would nest health gates and fault-injection
+    polls inside the heev/svd gate."""
+    m, n = x.shape
+    dt = x.dtype
+    sc = math.sqrt(c_k)
+    with _timer(ns, "qr"):
+        stacked = jnp.concatenate([sc * x, jnp.eye(n, dtype=dt)], axis=0)
+        f, taus = geqrf_rec(stacked, nb)
+        q = unmqr_rec(f, taus, jnp.eye(m + n, n, dtype=dt),
+                      Side.Left, Op.NoTrans, nb)
+    with _timer(ns, "gemm"):
+        out = ((a_k - b_k / c_k) / sc) * matmul(q[:m], _ct(q[m:])) \
+            + (b_k / c_k) * x
+    return as_array(out)
+
+
+def _chol_step(x, a_k: float, b_k: float, c_k: float, nb: int, ns: str):
+    """One Cholesky-based Halley step: Z = I + c·XᴴX = WWᴴ, then
+    X' = (b/c)·X + (a − b/c)·X·Z⁻¹ via two triangular solves.  Only
+    admitted once c is small (Z's condition ≈ c near convergence)."""
+    n = x.shape[1]
+    dt = x.dtype
+    with _timer(ns, "gemm"):
+        z = c_k * matmul(_ct(x), x) + jnp.eye(n, dtype=dt)
+        z = 0.5 * (z + _ct(z))
+    with _timer(ns, "chol"):
+        w = blocks.potrf_rec(z, nb)
+        # X·Z⁻¹ = (Z⁻¹·Xᴴ)ᴴ — two left solves on the factor
+        t = blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit,
+                            w, _ct(x), nb)
+        s = blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit,
+                            _ct(w), t, nb)
+        y = _ct(s)
+    return (b_k / c_k) * x + (a_k - b_k / c_k) * y
+
+
+def _polar_u(av, nb: int, opts, ns: str,
+             interval: Optional[Tuple[float, float]] = None):
+    """The Halley iteration proper: the polar factor U_p of ``av``
+    (m ≥ n), timers namespaced under ``stage.<ns>.*``."""
+    from ..perf import autotune
+
+    m, n = av.shape
+    dt = av.dtype
+    if n == 0:
+        return av
+    eps = float(jnp.finfo(dt).eps)
+    if interval is None:
+        alpha, smin = spectral_interval(av, opts)
+    else:
+        alpha, smin = float(interval[0]), float(interval[1])
+    if not (alpha > 0.0) or not math.isfinite(alpha):
+        # the zero matrix: U_p is any isometry; pick the canonical one
+        return jnp.eye(m, n, dtype=dt)
+    # l underestimates σ_min(X₀) by design (extra iterations are the
+    # only cost); the ε floor keeps the weight recurrence finite and
+    # still converges within the six-iteration QDWH bound
+    l = min(max(smin / alpha, eps), 1.0)
+    x = (av / alpha).astype(dt)
+    maxiter = int(get_option(opts, "qdwh_maxiter", 6))
+    it = 0
+    while it < maxiter and abs(1.0 - l) > 10.0 * eps:
+        a_k, b_k, c_k = _halley_weights(l)
+        variant = autotune.select("qdwh_step", n=n, c=c_k, dtype=dt)
+        if variant == "chol":
+            x = _chol_step(x, a_k, b_k, c_k, nb, ns)
+            _metrics.inc("qdwh.step.chol")
+        else:
+            x = _qr_step(x, a_k, b_k, c_k, nb, ns)
+            _metrics.inc("qdwh.step.qr")
+        l = l * (a_k + b_k * l * l) / (1.0 + c_k * l * l)
+        it += 1
+    return x
+
+
+@instrument_driver("polar")
+def polar(a, opts: Optional[Options] = None, *,
+          interval: Optional[Tuple[float, float]] = None):
+    """QDWH polar decomposition A = U_p·H — returns ``(U_p, H)`` with
+    U_p an m×n partial isometry (UᴴU = I) and H = UᴴA symmetrized, the
+    Hermitian positive-semidefinite factor.  ``interval`` optionally
+    supplies a precomputed ``(alpha ≥ σ_max, σ_min estimate)`` pair
+    (the :func:`~slate_tpu.linalg.condest.spectral_interval` contract);
+    otherwise one is estimated here."""
+
+    av = as_array(a)
+    if av.ndim != 2:
+        raise ValueError("polar expects a 2-D matrix")
+    m, n = av.shape
+    if m < n:
+        raise ValueError("polar expects m >= n (factor Aᴴ instead)")
+    nb = _nb(a, opts)
+    u = _polar_u(av, nb, opts, "polar", interval)
+    with _timer("polar", "gemm"):
+        uh_a = matmul(_ct(u), av)
+        h = 0.5 * (uh_a + _ct(uh_a))
+    return u, h
+
+
+# ---------------------------------------------------------------------------
+# QDWH-eig: spectral divide and conquer
+# ---------------------------------------------------------------------------
+
+def _small_heev(av, opts):
+    """Crossover leaf: the stock two-stage solver on a dense block
+    (bypassing the eig_driver dispatch — a forced qdwh pin must not
+    recurse back here)."""
+    from .eig import _heev_twostage
+
+    w, z = _heev_twostage(av, True, opts)
+    return jnp.asarray(w), as_array(z)
+
+
+def _dc(av, nb: int, crossover: int, opts, ns: str, depth: int):
+    """One divide step: polar of the shifted block → sign projector →
+    orthonormal split basis from one geqrf → rotate, recurse on the
+    diagonal blocks.  Returns ``(w ascending, Z)``."""
+    n = av.shape[-1]
+    if n <= crossover or depth >= _DC_MAX_DEPTH:
+        return _small_heev(av, opts)
+    dt = av.dtype
+    eye = jnp.eye(n, dtype=dt)
+    dvec = np.asarray(jnp.diagonal(av)).real.astype(np.float64)
+    row_abs = np.asarray(jnp.abs(av).sum(axis=1), dtype=np.float64)
+    off = row_abs - np.abs(np.asarray(jnp.diagonal(av)))
+    # shift candidates: mean eigenvalue (trace/n — splits any
+    # non-constant spectrum), then the Gershgorin midpoint and the
+    # diagonal median when the projector degenerates
+    shifts = [float(dvec.mean()),
+              0.5 * (float((dvec - off).min()) + float((dvec + off).max())),
+              float(np.median(dvec))]
+    u_s, k = None, 0
+    for sigma in shifts:
+        u_s = _polar_u(av - dt.type(sigma) * eye, nb, opts, ns)
+        # U_s ≈ sign(A − σI): trace counts (#λ>σ) − (#λ<σ)
+        k = int(round((float(jnp.trace(u_s).real) + n) / 2.0))
+        if 0 < k < n:
+            break
+    else:
+        # flat / fully clustered spectrum: no shift separates it
+        _metrics.inc("qdwh.dc.degenerate")
+        return _small_heev(av, opts)
+    p = 0.5 * (u_s + eye)        # spectral projector onto λ > σ
+    # deterministic mixing (replayable runs): P·G₁ spans range(P) and
+    # (I−P)·G₂ its complement almost surely; one full QR orthonormalizes
+    # both while preserving the leading-column span
+    rng = np.random.default_rng(0x0D_5EED + depth)
+    g = jnp.asarray(rng.standard_normal((n, n)), dtype=eye.real.dtype
+                    ).astype(dt)
+    with _timer(ns, "gemm"):
+        m1 = matmul(p, g[:, :k])
+        m2 = g[:, k:] - matmul(p, g[:, k:])
+        basis = jnp.concatenate([m1, m2], axis=1)
+    with _timer(ns, "qr"):
+        f, taus = geqrf_rec(basis, nb)
+        v = unmqr_rec(f, taus, eye, Side.Left, Op.NoTrans, nb)
+    with _timer(ns, "gemm"):
+        b = matmul(_ct(v), matmul(av, v))
+    a1 = b[:k, :k]
+    a2 = b[k:, k:]
+    w1, z1 = _dc(0.5 * (a1 + _ct(a1)), nb, crossover, opts, ns, depth + 1)
+    w2, z2 = _dc(0.5 * (a2 + _ct(a2)), nb, crossover, opts, ns, depth + 1)
+    with _timer(ns, "gemm"):
+        zz1 = matmul(v[:, :k], z1)
+        zz2 = matmul(v[:, k:], z2)
+    return (jnp.concatenate([w2, w1]),
+            jnp.concatenate([zz2, zz1], axis=1))
+
+
+def _heev_qdwh(a, jobz: bool, opts, ns: str):
+    from .eig import _hermitian_full
+
+    av = _hermitian_full(a)
+    nb = _nb(a, opts)
+    crossover = max(2, int(get_option(opts, "qdwh_crossover",
+                                      config.qdwh_crossover)))
+    w, z = _dc(av, nb, crossover, opts, ns, 0)
+    order = jnp.argsort(w)
+    if not jobz:
+        return jnp.asarray(w[order]), None
+    return jnp.asarray(w[order]), z[:, order]
+
+
+def heev_qdwh(a, jobz: bool = True, opts: Optional[Options] = None):
+    """QDWH-eig: Hermitian eigensolver by spectral divide-and-conquer
+    over the polar factor (Nakatsukasa & Higham, 2013).  Same contract
+    as :func:`~slate_tpu.linalg.eig.heev` — ``(w ascending, Z | None)``
+    — reachable from it via the autotuned ``eig_driver`` site."""
+
+    return _heev_qdwh(a, jobz, opts, "heev")
+
+
+# ---------------------------------------------------------------------------
+# QDWH-SVD
+# ---------------------------------------------------------------------------
+
+def svd_qdwh(a, jobu: bool = True, jobvt: bool = True,
+             opts: Optional[Options] = None):
+    """QDWH-SVD: A = U_p·H, then QDWH-eig of the SPSD factor H = VΣVᴴ,
+    so A = (U_p·V)·Σ·Vᴴ.  Same contract as
+    :func:`~slate_tpu.linalg.svd.svd` — ``(sigma descending, U, Vᴴ)``
+    economy, None for unrequested factors — reachable from it via the
+    autotuned ``svd_driver`` site."""
+
+    av = as_array(a)
+    m, n = av.shape
+    if m < n:
+        s, u, vh = svd_qdwh(_ct(av), jobu=jobvt, jobvt=jobu, opts=opts)
+        return s, (None if vh is None else _ct(vh)), \
+            (None if u is None else _ct(u))
+    nb = _nb(a, opts)
+    u_p = _polar_u(av, nb, opts, "svd")
+    with _timer("svd", "gemm"):
+        uh_a = matmul(_ct(u_p), av)
+        h = 0.5 * (uh_a + _ct(uh_a))
+    w, v = _heev_qdwh(h, True, opts, "svd")
+    real_dt = np.zeros(0, dtype=av.dtype).real.dtype
+    # H is SPSD: ascending eigenvalues reversed are the singular values
+    s = jnp.maximum(jnp.asarray(w, dtype=real_dt)[::-1], 0)
+    vd = v[:, ::-1]
+    u = vh = None
+    if jobu:
+        with _timer("svd", "gemm"):
+            u = matmul(u_p, vd)
+    if jobvt:
+        vh = _ct(vd)
+    return s, u, vh

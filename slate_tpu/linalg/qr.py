@@ -611,23 +611,20 @@ def gels_mixed(a, b, opts: Optional[Options] = None, *, tol=None):
         return jnp.triu(f[:n])
 
     if use_split_leg(lo):
-        import math
-
-        from .condest import norm1est
+        from .condest import refine_kappa_eps
 
         with split_factor_leg():
             r_lo = _factor()
         # κ₁(R)²·n·ε_lo is the SNE contraction bound: past ~0.25 the
         # semi-normal corrections stop converging on a split factor,
         # so demote to the stock low-precision factorization
-        rinv = norm1est(
+        ke = refine_kappa_eps(
             lambda v: blocks.trsm_rec(Side.Left, Uplo.Upper, Diag.NonUnit,
-                                      r_lo, v.astype(lo), nb),
+                                      r_lo, v, nb),
             lambda v: blocks.trsm_rec(Side.Left, Uplo.Lower, Diag.NonUnit,
-                                      _ct(r_lo), v.astype(lo), nb), n)
-        kappa = float(_norm(Norm.One, r_lo)) * float(rinv)
-        ke = kappa * kappa * n * float(jnp.finfo(lo).eps)
-        if not math.isfinite(ke) or ke > 0.25:
+                                      _ct(r_lo), v, nb),
+            n, float(_norm(Norm.One, r_lo)), lo, power=2)
+        if ke > 0.25:
             r_lo = _factor()
     else:
         r_lo = _factor()

@@ -552,6 +552,11 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
 
     Returns ``(sigma, U, Vᴴ)`` (economy: U is m×k, Vᴴ is k×n with
     k = min(m, n)); U/Vᴴ are None when not requested.
+
+    Driver selection consults the autotuned ``svd_driver`` site
+    (``twostage`` vs ``qdwh`` — :mod:`slate_tpu.linalg.polar`); an
+    ``svd_driver`` per-call option or a
+    ``SLATE_TPU_AUTOTUNE_FORCE=svd_driver=...`` pin overrides.
     """
 
     av = as_array(a)
@@ -561,6 +566,26 @@ def svd(a, jobu: bool = True, jobvt: bool = True,
         s, u, vh = svd(_ct(av), jobu=jobvt, jobvt=jobu, opts=opts)
         return s, (None if vh is None else _ct(vh)), \
             (None if u is None else _ct(u))
+    method = get_option(opts, "method_svd", MethodSVD.Auto)
+    driver = get_option(opts, "svd_driver", None)
+    if driver is None:
+        from ..perf import autotune
+
+        driver = autotune.select("svd_driver", m=m, n=n, dtype=av.dtype,
+                                 eligible=method is MethodSVD.Auto)
+    if driver == "qdwh":
+        from .polar import svd_qdwh
+
+        return svd_qdwh(a, jobu=jobu, jobvt=jobvt, opts=opts)
+    return _svd_twostage(a, jobu, jobvt, opts)
+
+
+def _svd_twostage(a, jobu: bool, jobvt: bool, opts: Optional[Options]):
+    """The two-stage chain (ge2tb → band SVD → back-transforms) — the
+    ``svd_driver=twostage`` backend; callers guarantee m ≥ n."""
+
+    av = as_array(a)
+    m, n = av.shape
     with _metrics.timer("stage.svd.stage1"):
         factors = ge2tb(a, opts)
         if _metrics.enabled():

@@ -119,6 +119,10 @@ def select_backend(op: str, **key) -> str:
                        eligible=True, eligible_fused=True)
         select_backend("lu_driver", m=8192, n=8192, nb=512,
                        dtype=jnp.float32, eligible=True)
+        select_backend("eig_driver", n=8192, dtype=jnp.float32,
+                       eligible=True)   # twostage vs QDWH-eig
+        select_backend("svd_driver", m=8192, n=8192,
+                       dtype=jnp.float32, eligible=True)
     """
 
     from .perf.autotune import select

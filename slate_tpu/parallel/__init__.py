@@ -27,6 +27,7 @@ from .dist_twostage import (  # noqa: F401
     band_tiles_to_banded, band_tiles_to_dense, pge2tb, phe2hb, pheev,
     psvd, punmbr_ge2tb_p, punmbr_ge2tb_q, punmtr_he2hb,
 )
+from .dist_qdwh import pheev_qdwh, ppolar, psvd_qdwh  # noqa: F401
 from .dist_util import peye, predistribute, ptranspose  # noqa: F401
 from .dist_lu import pgecondest, pgetri  # noqa: F401
 from .dist_qr import pgelqf, punmlq  # noqa: F401
@@ -42,7 +43,8 @@ from .dist_hesv import phetrf, phetrs, phesv  # noqa: F401
 # ---------------------------------------------------------------------------
 from . import (dist_aux as _m_aux, dist_band as _m_band,  # noqa: E402
                dist_blas3 as _m_blas3, dist_factor as _m_factor,
-               dist_hesv as _m_hesv, dist_lu as _m_lu, dist_qr as _m_qr,
+               dist_hesv as _m_hesv, dist_lu as _m_lu,
+               dist_qdwh as _m_qdwh, dist_qr as _m_qr,
                dist_twostage as _m_two, dist_util as _m_util)
 from .dist import canonical_args as _canonical_args  # noqa: E402
 
@@ -60,6 +62,7 @@ _DRIVER_NAMES = {
     _m_hesv: ["phetrf", "phetrs", "phesv"],
     _m_two: ["phe2hb", "pge2tb", "pheev", "psvd", "punmbr_ge2tb_p",
              "punmbr_ge2tb_q", "punmtr_he2hb"],
+    _m_qdwh: ["pheev_qdwh", "ppolar", "psvd_qdwh"],
     _m_util: ["predistribute", "ptranspose", "phermitize"],
 }
 for _mod, _names in _DRIVER_NAMES.items():

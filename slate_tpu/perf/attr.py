@@ -102,7 +102,7 @@ _DEF_PEAKS = {
 }
 
 _LABEL_RE = re.compile(
-    r"^(?P<routine>[a-z0-9]+?)(?:_batched)?(?P<ooc>_ooc)?_"
+    r"^(?P<routine>[a-z0-9]+?)(?P<qdwh>_qdwh)?(?:_batched)?(?P<ooc>_ooc)?_"
     r"(?P<dtype>fp32|fp64|bf16|c64|c128)_"
     r"(?P<dims>.+)$")
 _DIM_RE = re.compile(r"^([a-z]+)([0-9]+)$")
@@ -110,6 +110,11 @@ _DIM_RE = re.compile(r"^([a-z]+)([0-9]+)$")
 #: autotune op site whose decision is the routine's fusion depth
 #: (``composed`` | ``fused_trsm`` | ``fused`` | ``full``).
 _FUSION_OPS = {"getrf": "lu_step", "potrf": "potrf_step"}
+
+#: autotune op site whose decision is the spectral routine's driver
+#: chain (``twostage`` | ``qdwh``) — the eig/svd analog of
+#: :data:`_FUSION_OPS`.
+_DRIVER_OPS = {"heev": "eig_driver", "svd": "svd_driver"}
 
 
 def _env_float(name: str):
@@ -178,6 +183,8 @@ def parse_label(label: str):
             dims[dm.group(1)] = int(dm.group(2))
     if m.group("ooc"):
         dims["ooc"] = 1
+    if m.group("qdwh"):
+        dims["qdwh"] = 1
     return (m.group("routine"), m.group("dtype"), dims)
 
 
@@ -358,6 +365,33 @@ def _stages_twostage(n, isz, total):
     return stages, 0.0
 
 
+#: coarse flop shares of the QDWH spectral tier (``linalg/polar.py``):
+#: the polar iterations' stacked-QR steps, the Cholesky-variant
+#: factor+trsm steps, the epilogue/projector/similarity gemms, and the
+#: crossover leaves' two-stage tail (priced as ``stage1`` so the leaf
+#: blocks' measured ``stage.heev.stage1`` timers join the same row).
+#: >= 80% lands on qr/chol/gemm — the gemm-roofline stages the tier
+#: exists for — which the reconciliation pin in ``tests/test_qdwh.py``
+#: asserts.
+_QDWH_SHARES = {"qr": 0.30, "chol": 0.10, "gemm": 0.50, "stage1": 0.10}
+
+
+def _stages_qdwh(n, isz, total):
+    """Stage map of ``heev_qdwh``/``svd_qdwh``.  Shares are coarse by
+    design — the normalization in :func:`stage_model` reconciles them
+    exactly against :func:`model_flops`.  Byte terms: each QR iteration
+    streams the stacked 2n x n operand a few times, the chol variant
+    touches the n x n Gram matrix, and the divide-and-conquer gemms
+    sweep the operand once per similarity transform."""
+    stages = {}
+    nn = float(n) * n * isz
+    _acc(stages, "qr", _QDWH_SHARES["qr"] * total, 6.0 * nn)
+    _acc(stages, "chol", _QDWH_SHARES["chol"] * total, 3.0 * nn)
+    _acc(stages, "gemm", _QDWH_SHARES["gemm"] * total, 8.0 * nn)
+    _acc(stages, "stage1", _QDWH_SHARES["stage1"] * total, 2.0 * nn)
+    return stages, 0.0
+
+
 #: bf16 MXU passes behind one nominal fp32 flop on the split-product
 #: gemm (``ops/split_gemm.py`` bf16x3): the K-folded slice dot streams
 #: three bf16 gemm passes to produce one error-free fp32 product, so
@@ -380,8 +414,8 @@ def split_lane(label: str):
 
 #: stage order for reports (model dicts are unordered)
 _STAGE_ORDER = ("panel", "pivot", "trsm", "update", "verify", "solve",
-                "host", "stage1", "chase", "stage3", "mxu",
-                "collective")
+                "host", "qr", "chol", "gemm", "stage1", "chase",
+                "stage3", "mxu", "collective")
 
 
 def _ooc_host_bytes(routine: str, n: int, nb: int, isz: int) -> float:
@@ -443,7 +477,10 @@ def stage_model(routine: str, dims: dict, dtype: str = "fp32",
     elif routine in ("geqrf", "gels"):
         raw, rts = _stages_geqrf(m, n, nb, isz, routine == "gels")
     elif routine in ("heev", "svd"):
-        raw, rts = _stages_twostage(n, isz, total / bfac)
+        if dims.get("qdwh"):
+            raw, rts = _stages_qdwh(n, isz, total / bfac)
+        else:
+            raw, rts = _stages_twostage(n, isz, total / bfac)
     else:
         return None
     if _abft_wanted(abft) and bfac == 1 \
@@ -553,6 +590,22 @@ def fusion_from_autotune(routine: str, autotune) -> str:
     return "composed"
 
 
+def driver_from_autotune(routine: str, autotune) -> str:
+    """The driver chain a spectral routine actually ran ("twostage" |
+    "qdwh"), read off its ``eig_driver`` / ``svd_driver`` decision tags
+    — the eig/svd analog of :func:`fusion_from_autotune`.  "twostage"
+    when untagged: labels from forced-opts benches carry the ``_qdwh``
+    label marker instead, so an untagged plain label really did run the
+    two-stage chain."""
+    op = _DRIVER_OPS.get(routine)
+    if op and isinstance(autotune, dict):
+        for key, val in autotune.items():
+            if isinstance(key, str) and key.startswith(op + "|") \
+                    and val == "qdwh":
+                return "qdwh"
+    return "twostage"
+
+
 # ---------------------------------------------------------------------------
 # Measured-timer join
 # ---------------------------------------------------------------------------
@@ -614,6 +667,11 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
         return None
     routine, dtype, dims = parse_label(label)
     fusion = fusion_from_autotune(routine, autotune)
+    if routine in ("heev", "svd") and not dims.get("qdwh") \
+            and driver_from_autotune(routine, autotune) == "qdwh":
+        # autotune picked the QDWH chain for a plain-labeled run —
+        # price it with the QDWH stage model, not the two-stage one
+        dims = dict(dims, qdwh=1)
     model = stage_model(routine, dims, dtype, fusion)
     if model is None:
         return None
@@ -701,8 +759,8 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     # apportion the measured wall time across stages: timer-weighted
     # when namespaced stage timers exist, model-flop-weighted otherwise
     timers = stage_timers(metrics_snapshot, routine)
-    if routine in ("heev", "svd") and "stage2" in timers \
-            and "chase" not in timers:
+    if routine in ("heev", "svd") and not dims.get("qdwh") \
+            and "stage2" in timers and "chase" not in timers:
         # the drivers record the two-stage middle as stage.<op>.stage2;
         # the model calls that stage "chase" — without the alias the
         # measured middle-stage time would silently redistribute onto

@@ -262,6 +262,8 @@ _SAFE_BACKENDS = {
     "batched_potrf": "vmapped", "batched_lu": "vmapped",
     "batched_qr": "vmapped", "chase": "host_native",
     "dist_pivot": "maxloc", "dist_chunk": "whole", "dist_lookahead": "1",
+    "eig_driver": "twostage", "svd_driver": "twostage",
+    "qdwh_step": "qr",
 }
 
 
@@ -1926,6 +1928,213 @@ def choose_batched_qr(b: int, m: int, n: int, dtype) -> str:
     return decide("batched_qr", key, [Candidate("vmapped", setup_vmapped)])
 
 
+def _spectral_residual_ok(a, w, z, n: int, dt) -> bool:
+    """Probe gate shared by the eig/svd driver sites: eigen residual
+    ‖A·Z − Z·Λ‖ and orthogonality ‖ZᴴZ − I‖, both scaled by ε·n (the
+    library's usual gates, 100× headroom)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    if z is None or not bool(jnp.all(jnp.isfinite(z))):
+        return False
+    eps = float(np.finfo(np.dtype(dt.name)).eps)
+    anorm = float(jnp.linalg.norm(a)) or 1.0
+    r = float(jnp.linalg.norm(a @ z - z * w[None, :].astype(z.dtype)))
+    o = float(jnp.linalg.norm(jnp.conj(z.T) @ z
+                              - jnp.eye(z.shape[1], dtype=z.dtype)))
+    return (r / (anorm * eps * n) < 100.0) and (o / (eps * n) < 100.0)
+
+
+def choose_eig_driver(n: int, dtype, eligible: bool) -> str:
+    """Whole-driver site for heev: ``"twostage"`` (he2hb → bulge chase
+    → tridiagonal solve, the stock chain) vs ``"qdwh"`` (spectral
+    divide-and-conquer over the QDWH polar factor,
+    :mod:`slate_tpu.linalg.polar` — all geqrf/potrf/gemm flops, so its
+    roofline is the gemm roofline).  ``eligible`` is the call site's
+    gate (``MethodEig.Auto`` only — an explicit band-stage method
+    request pins the two-stage chain); the tri-state ``SLATE_TPU_QDWH``
+    knob (:func:`slate_tpu.config.qdwh_mode`) forces the decision."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (_bucket_dim(n), dt.name, _precision_name())
+    names = ("twostage", "qdwh")
+    if not eligible or n < 4:
+        return _static("eig_driver", key, "twostage", "ineligible")
+    mode = config.qdwh_mode()
+    if mode == "off":
+        return _static("eig_driver", key, "twostage", "forced-config")
+    if mode == "on":
+        return _static("eig_driver", key, "qdwh", "forced-config")
+    if not _on_tpu():
+        forced = _forced("eig_driver")
+        if forced is not None:
+            if forced in names:
+                return _static("eig_driver", key, forced, "forced")
+            _warn_bad_force("eig_driver", forced, names)
+        return _default("eig_driver", key, names, "twostage")
+
+    nprobe = key[0]
+    probes: dict = {}
+
+    def _a():
+        def mk():
+            g = _randn((nprobe, nprobe), dt, 31)
+            return 0.5 * (g + jnp.conj(g.T))
+        return _memo(probes, "a", mk)
+
+    def setup_twostage():
+        from ..linalg.eig import _heev_twostage
+
+        def run():
+            import jax
+
+            w, z = _heev_twostage(_a(), True, None)
+            jax.block_until_ready(z)
+            return w, z
+
+        return run
+
+    def setup_qdwh():
+        from ..linalg.polar import heev_qdwh
+
+        def run():
+            import jax
+
+            w, z = heev_qdwh(_a(), True, None)
+            jax.block_until_ready(z)
+            return w, z
+
+        return run
+
+    def check(out):
+        return _spectral_residual_ok(_a(), out[0], out[1], nprobe, dt)
+
+    return decide("eig_driver", key, [
+        Candidate("twostage", setup_twostage, check),
+        Candidate("qdwh", setup_qdwh, check),
+    ])
+
+
+def choose_svd_driver(m: int, n: int, dtype, eligible: bool) -> str:
+    """Whole-driver site for svd: ``"twostage"`` (ge2tb → chase →
+    bidiagonal solve) vs ``"qdwh"`` (polar then QDWH-eig of the SPSD
+    factor).  Same ladder shape as :func:`choose_eig_driver`; callers
+    guarantee m ≥ n."""
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    key = (_bucket_dim(m), _bucket_dim(n), dt.name, _precision_name())
+    names = ("twostage", "qdwh")
+    if not eligible or n < 4:
+        return _static("svd_driver", key, "twostage", "ineligible")
+    mode = config.qdwh_mode()
+    if mode == "off":
+        return _static("svd_driver", key, "twostage", "forced-config")
+    if mode == "on":
+        return _static("svd_driver", key, "qdwh", "forced-config")
+    if not _on_tpu():
+        forced = _forced("svd_driver")
+        if forced is not None:
+            if forced in names:
+                return _static("svd_driver", key, forced, "forced")
+            _warn_bad_force("svd_driver", forced, names)
+        return _default("svd_driver", key, names, "twostage")
+
+    mp, np_ = key[0], key[1]
+    probes: dict = {}
+
+    def _a():
+        return _memo(probes, "a", lambda: _randn((mp, np_), dt, 32))
+
+    def setup_twostage():
+        from ..linalg.svd import _svd_twostage
+
+        def run():
+            import jax
+
+            s, u, vh = _svd_twostage(_a(), True, True, None)
+            jax.block_until_ready(u)
+            return s, u, vh
+
+        return run
+
+    def setup_qdwh():
+        from ..linalg.polar import svd_qdwh
+
+        def run():
+            import jax
+
+            s, u, vh = svd_qdwh(_a(), True, True, None)
+            jax.block_until_ready(u)
+            return s, u, vh
+
+        return run
+
+    def check(out):
+        import jax.numpy as jnp_
+
+        s, u, vh = out
+        if u is None or vh is None:
+            return False
+        if not (bool(jnp_.all(jnp_.isfinite(u)))
+                and bool(jnp_.all(jnp_.isfinite(vh)))):
+            return False
+        import numpy as np
+
+        a = _a()
+        eps = float(np.finfo(np.dtype(dt.name)).eps)
+        anorm = float(jnp_.linalg.norm(a)) or 1.0
+        r = float(jnp_.linalg.norm(
+            a - u @ (s[:, None].astype(u.dtype) * vh)))
+        o = float(jnp_.linalg.norm(
+            jnp_.conj(u.T) @ u - jnp_.eye(np_, dtype=u.dtype)))
+        return (r / (anorm * eps * max(mp, np_)) < 100.0) \
+            and (o / (eps * np_) < 100.0)
+
+    return decide("svd_driver", key, [
+        Candidate("twostage", setup_twostage, check),
+        Candidate("qdwh", setup_qdwh, check),
+    ])
+
+
+def choose_qdwh_step(n: int, c: float, dtype) -> str:
+    """Per-iteration Halley variant inside the QDWH loop: ``"qr"``
+    (stacked-QR step, backward stable at any conditioning) vs
+    ``"chol"`` (``chol(I + c·XᴴX)`` + two trsm — roughly half the
+    flops, safe only once the weight ``c`` is moderate since
+    κ(I + c·XᴴX) ≈ c near convergence).  Probe-free by design (a
+    mid-iteration timing race would measure the wrong operand state):
+    the heuristic threshold is :data:`slate_tpu.config.qdwh_switch_c`,
+    with the c-decade folded into the key so an offline bundle can pin
+    the switch point per (n-bucket, c-regime, dtype) and a forced
+    ``qdwh_step=qr|chol`` pin overrides everywhere."""
+
+    import math
+
+    import jax.numpy as jnp
+
+    from .. import config
+
+    dt = jnp.dtype(dtype)
+    cd = 0 if c <= 1.0 else min(17, int(math.log10(c)))
+    key = (_bucket_dim(n), "c1e%d" % cd, dt.name)
+    names = ("qr", "chol")
+    forced = _forced("qdwh_step")
+    if forced is not None:
+        if forced in names:
+            return _static("qdwh_step", key, forced, "forced")
+        _warn_bad_force("qdwh_step", forced, names)
+    heur = "chol" if c <= config.qdwh_switch_c else "qr"
+    return _default("qdwh_step", key, names, heur)
+
+
 #: op name → chooser, the :func:`select` registry.  ``method.select_backend``
 #: is the driver-facing façade over this table.
 _CHOOSERS = {
@@ -1976,6 +2185,13 @@ _CHOOSERS = {
         kw["b"], kw["n"], kw["dtype"], kw["eligible"]),
     "batched_qr": lambda **kw: choose_batched_qr(
         kw["b"], kw["m"], kw["n"], kw["dtype"]),
+    "eig_driver": lambda **kw: choose_eig_driver(kw["n"], kw["dtype"],
+                                                 kw["eligible"]),
+    "svd_driver": lambda **kw: choose_svd_driver(kw["m"], kw["n"],
+                                                 kw["dtype"],
+                                                 kw["eligible"]),
+    "qdwh_step": lambda **kw: choose_qdwh_step(kw["n"], kw["c"],
+                                               kw["dtype"]),
 }
 
 

@@ -85,7 +85,8 @@ DEFAULT_THRESHOLD_PCT = 5.0
 ABFT_OVERHEAD_CEILING_PCT = 10.0
 
 _LABEL_RE = re.compile(
-    r"^(?P<routine>[a-z0-9]+?)(?P<batched>_batched)?(?P<ooc>_ooc)?_"
+    r"^(?P<routine>[a-z0-9]+?)(?P<qdwh>_qdwh)?(?P<batched>_batched)?"
+    r"(?P<ooc>_ooc)?_"
     r"(?P<dtype>fp32|fp64|bf16|c64|c128)_"
     r"(?P<dims>.+)$")
 
@@ -111,6 +112,13 @@ _OPS_FOR_ROUTINE = {
     # backend tag is the ooc site's pool-vs-incore residency decision
     "getrf_ooc": ("ooc",),
     "potrf_ooc": ("ooc",),
+    # spectral-driver labels (ISSUE 18): the plain rows carry the
+    # autotuned whole-driver decision; the _qdwh rows (forced dispatch)
+    # additionally tag the in-loop Halley variant switch
+    "heev": ("eig_driver",),
+    "svd": ("svd_driver",),
+    "heev_qdwh": ("eig_driver", "qdwh_step"),
+    "svd_qdwh": ("svd_driver", "qdwh_step"),
 }
 
 
@@ -122,8 +130,8 @@ def parse_label(label: str):
     m = _LABEL_RE.match(label)
     if not m:
         return (label, "", "")
-    return (m.group("routine") + (m.group("batched") or "")
-            + (m.group("ooc") or ""),
+    return (m.group("routine") + (m.group("qdwh") or "")
+            + (m.group("batched") or "") + (m.group("ooc") or ""),
             m.group("dtype"), m.group("dims"))
 
 

@@ -555,6 +555,211 @@ def _predict_ooc(key_parts, names, platform):
     return out
 
 
+def _build_eig_driver(u):
+    """Sweep unit for the heev whole-driver site (ISSUE 18): time the
+    two-stage chain against QDWH spectral divide-and-conquer at the
+    SAME key ``choose_eig_driver`` derives, gated by the shared
+    eigen-residual + orthogonality check.  Probes are host-driven run()
+    closures (NOT ``_timed_call``): both drivers carry host-side work
+    a jitted probe would trace away."""
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    n = at._bucket_dim(int(u["n"]))
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    key = (n, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _a():
+        def mk():
+            g = at._randn((n, n), dt, 31)
+            return 0.5 * (g + jnp.conj(g.T))
+        return at._memo(probes, "a", mk)
+
+    def _run(fn):
+        def run():
+            import jax
+
+            w, z = fn(_a(), True, None)
+            jax.block_until_ready(z)
+            return w, z
+        return run
+
+    def setup_twostage():
+        from ..linalg.eig import _heev_twostage
+
+        return _run(_heev_twostage)
+
+    def setup_qdwh():
+        from ..linalg.polar import heev_qdwh
+
+        return _run(heev_qdwh)
+
+    def check(out):
+        return at._spectral_residual_ok(_a(), out[0], out[1], n, dt)
+
+    return key, [at.Candidate("twostage", setup_twostage, check),
+                 at.Candidate("qdwh", setup_qdwh, check)]
+
+
+def _build_svd_driver(u):
+    """Sweep unit for the svd whole-driver site — the ``eig_driver``
+    mirror with a reconstruction + left-orthogonality gate."""
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    m = at._bucket_dim(int(u.get("m", u["n"])))
+    n = at._bucket_dim(int(u["n"]))
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    key = (m, n, dt.name, at._precision_name())
+    probes: dict = {}
+
+    def _a():
+        return at._memo(probes, "a", lambda: at._randn((m, n), dt, 32))
+
+    def _run(fn):
+        def run():
+            import jax
+
+            s, uu, vh = fn(_a(), True, True, None)
+            jax.block_until_ready(uu)
+            return s, uu, vh
+        return run
+
+    def setup_twostage():
+        from ..linalg.svd import _svd_twostage
+
+        return _run(_svd_twostage)
+
+    def setup_qdwh():
+        from ..linalg.polar import svd_qdwh
+
+        return _run(svd_qdwh)
+
+    def check(out):
+        import numpy as np
+
+        s, uu, vh = out
+        if uu is None or vh is None:
+            return False
+        if not (bool(jnp.all(jnp.isfinite(uu)))
+                and bool(jnp.all(jnp.isfinite(vh)))):
+            return False
+        a = _a()
+        eps = float(np.finfo(np.dtype(dt.name)).eps)
+        anorm = float(jnp.linalg.norm(a)) or 1.0
+        r = float(jnp.linalg.norm(a - uu @ (s[:, None].astype(uu.dtype)
+                                            * vh)))
+        o = float(jnp.linalg.norm(jnp.conj(uu.T) @ uu
+                                  - jnp.eye(n, dtype=uu.dtype)))
+        return (r / (anorm * eps * max(m, n)) < 100.0) \
+            and (o / (eps * n) < 100.0)
+
+    return key, [at.Candidate("twostage", setup_twostage, check),
+                 at.Candidate("qdwh", setup_qdwh, check)]
+
+
+def _predict_spectral_driver(routine: str):
+    """Pricing for the eig/svd whole-driver sites: both candidates are
+    normalized to the same model flop total (``model_flops``), so only
+    the stage byte terms separate them analytically — honest enough for
+    the coarse ordering pruning needs, and the sweep margin protects
+    the rest.  ``dims["qdwh"]`` routes the QDWH stage model."""
+    def predict(key_parts, names, platform):
+        if len(key_parts) < 2:
+            return {}
+        off = 1 if routine == "svd" else 0
+        n = int(key_parts[off])
+        dims0 = {"n": n}
+        if routine == "svd":
+            dims0["m"] = int(key_parts[0])
+        dt = _short(key_parts[1 + off])
+        a = _attr()
+        out = {}
+        for name in names:
+            dims = dict(dims0)
+            if name == "qdwh":
+                dims["qdwh"] = 1
+            elif name != "twostage":
+                return {}
+            t = a.predict_seconds(routine, dims, dt, platform=platform)
+            if t is None:
+                return {}
+            out[name] = t
+        return out
+    return predict
+
+
+def _build_qdwh_step(u):
+    """Sweep unit for the per-iteration Halley variant inside the QDWH
+    polar loop (``qdwh_step``): time the stacked-QR step against the
+    Cholesky step on an operand SYNTHESIZED AT THE KEY'S c-REGIME —
+    ``u["cdec"]`` picks the weight decade, the matching lower bound
+    ``l`` is recovered by bisection (c(l) is monotone decreasing), and
+    the probe is built with singular values spanning exactly [l, 1].
+    The runtime chooser is probe-free (``choose_qdwh_step``); this unit
+    exists so an offline bundle can pin the variant-switch threshold
+    per (n-bucket, c-decade, dtype) from measured step times.  The gate
+    checks the step's contraction contract: finite output with the
+    spectrum still inside (0, ~1] — the Cholesky variant fails it at
+    high c, which is the whole point of the site."""
+    from . import autotune as at
+    import jax.numpy as jnp
+
+    n = at._bucket_dim(int(u["n"]))
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    cdec = int(u.get("cdec", 0))
+    key = (n, "c1e%d" % cdec, dt.name)
+    probes: dict = {}
+
+    from ..linalg.polar import _chol_step, _halley_weights, _qr_step
+
+    def _l_for_decade():
+        # c(l) spans [~2, ~1/l] as l: 1 → 0; bisect to the decade target
+        target = 10.0 ** cdec
+        lo, hi = 1e-16, 1.0
+        for _ in range(200):
+            mid = 0.5 * (lo + hi)
+            _, _, c = _halley_weights(mid)
+            if c > target:
+                lo = mid
+            else:
+                hi = mid
+        return 0.5 * (lo + hi)
+
+    def _x():
+        def mk():
+            l = _l_for_decade()
+            g = at._randn((n, n), dt, 33)
+            q1, _ = jnp.linalg.qr(g)
+            q2, _ = jnp.linalg.qr(at._randn((n, n), dt, 34))
+            sv = jnp.linspace(l, 1.0, n).astype(dt)
+            return (q1 * sv[None, :]) @ jnp.conj(q2.T)
+        return at._memo(probes, "x", mk)
+
+    a_k, b_k, c_k = _halley_weights(_l_for_decade())
+    nb = min(256, n)
+
+    def _setup(step_fn):
+        def run():
+            import jax
+
+            return jax.block_until_ready(
+                step_fn(_x(), a_k, b_k, c_k, nb, "polar"))
+        return run
+
+    def check(out):
+        if out is None or not bool(jnp.all(jnp.isfinite(out))):
+            return False
+        # one Halley step maps [l, 1] into [l', ~1]; a variant whose
+        # output spectrum escapes (0, 1.1] lost the contraction
+        sv = jnp.linalg.svd(out, compute_uv=False)
+        return bool(sv[0] <= 1.1) and bool(sv[-1] > 0.0)
+
+    return key, [at.Candidate("qr", lambda: _setup(_qr_step), check),
+                 at.Candidate("chol", lambda: _setup(_chol_step), check)]
+
+
 def _build_dist_chunk(u):
     """Sweep unit for the distributed panel-broadcast slice count: time
     the fused ``bcast_block_col`` at each chunking on THE MESH THIS
@@ -720,6 +925,20 @@ SITES: Dict[str, SiteSpec] = {
     # PCIe tile traffic, timed with a forced tiny window so the smoke
     # sweep proves eviction/write-back end to end
     "ooc": SiteSpec(_build_ooc, _predict_ooc),
+    # QDWH spectral tier (ISSUE 18): the whole-driver crossover sites
+    # (where QDWH's gemm-rich chain beats the two-stage pipelines, per
+    # n-bucket/dtype) and the in-loop Halley variant switch — all three
+    # bundle-pinnable so a replica boots with the crossover dimension
+    # and switch threshold already settled
+    "eig_driver": SiteSpec(_build_eig_driver,
+                           _predict_spectral_driver("heev")),
+    "svd_driver": SiteSpec(_build_svd_driver,
+                           _predict_spectral_driver("svd")),
+    # the variant switch is unpriceable analytically on purpose: the
+    # Cholesky step's validity depends on the c-regime (numerics, not
+    # rooflines), so both variants are always timed and the check gate
+    # decides
+    "qdwh_step": SiteSpec(_build_qdwh_step, lambda kp, names, p: {}),
 }
 
 
@@ -750,6 +969,11 @@ def _full_units():
     for n in (4096, 8192):
         for nb in (512, 1024):
             units.append({"site": "ooc", "n": n, "nb": nb})
+    for n in (1024, 2048, 4096):
+        units.append({"site": "eig_driver", "n": n})
+        units.append({"site": "svd_driver", "m": n, "n": n})
+        for cdec in (0, 2, 6):
+            units.append({"site": "qdwh_step", "n": n, "cdec": cdec})
     return units
 
 

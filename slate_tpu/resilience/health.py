@@ -248,8 +248,9 @@ _DRIVER_SITES: Dict[str, Tuple[str, ...]] = {
     "getri": ("lu_driver", "lu_panel", "lu_step") + _FACTOR_SITES,
     "geqrf": ("geqrf_panel",) + _FACTOR_SITES,
     "gels": ("geqrf_panel",) + _FACTOR_SITES,
-    "heev": ("chase",) + _FACTOR_SITES,
-    "svd": ("chase",) + _FACTOR_SITES,
+    "heev": ("chase", "eig_driver") + _FACTOR_SITES,
+    "svd": ("chase", "svd_driver") + _FACTOR_SITES,
+    "polar": ("qdwh_step",) + _FACTOR_SITES,
     "potrf_batched": ("batched_potrf",),
     "posv_batched": ("batched_potrf",),
     "getrf_batched": ("batched_lu",),

@@ -299,6 +299,16 @@ def test_ooc_knobs_documented():
         assert knob in docs, f"{knob} missing from docs/usage.md"
 
 
+def test_qdwh_knobs_documented():
+    """The QDWH spectral-tier knobs must be registered in the
+    user-facing knob table (docs/usage.md) — an undocumented driver
+    knob is an invisible one."""
+    docs = (_PKG.parent / "docs" / "usage.md").read_text()
+    for knob in ("SLATE_TPU_QDWH", "SLATE_TPU_QDWH_CROSSOVER",
+                 "SLATE_TPU_QDWH_SWITCH_C"):
+        assert knob in docs, f"{knob} missing from docs/usage.md"
+
+
 def test_abft_knobs_documented():
     """The new knobs must be registered in the user-facing knob table
     (docs/usage.md ABFT section) — an undocumented resilience knob is
@@ -481,10 +491,16 @@ def test_multi_backend_sites_populate_autotune_table():
     st.geqrf(jnp.asarray(rng.standard_normal((2 * n, n)).astype(np.float32)))
 
     # stage-2 bulge-chase site (heev consults it before any stage-2
-    # backend runs; on CPU it resolves heuristically to host_native)
+    # backend runs; on CPU it resolves heuristically to host_native) —
+    # and the whole-driver eig_driver site (ISSUE 18: twostage vs
+    # QDWH-eig) resolved before the chain is entered
     herm = ((g + g.T) / 2).astype(np.float64)
     st.heev(st.HermitianMatrix(jnp.asarray(herm), uplo=st.Uplo.Lower),
             opts={"block_size": 16})
+
+    # whole-driver svd_driver site (ISSUE 18: twostage vs QDWH-SVD)
+    st.svd(jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)),
+           jobu=False, jobvt=False, opts={"block_size": 16})
 
     # batched many-problem sites (ISSUE 8): the leading-batch-dim
     # drivers must each leave a grid-vs-vmapped (or vmapped-only)
@@ -505,6 +521,7 @@ def test_multi_backend_sites_populate_autotune_table():
                "dist_panel|geqrf", "dist_pivot|", "dist_chunk|",
                "dist_lookahead|",
                "geqrf_panel|", "chase|hb2st", "ooc|",
+               "eig_driver|", "svd_driver|",
                "batched_potrf|", "batched_lu|", "batched_qr|"):
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"

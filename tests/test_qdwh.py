@@ -1,0 +1,330 @@
+"""QDWH spectral tier tests (ISSUE 18) — the polar decomposition
+contract across conditioning regimes, the QDWH-eig / QDWH-SVD drivers
+through the SHIPPED ``eig_driver`` / ``svd_driver`` dispatch (forced
+pins honored off-TPU), crossover consistency against the two-stage
+leaf, and the roofline model's gemm-rich attribution pin: ≥80% of a
+QDWH label's model flops land on qr/chol/gemm stages and the
+attribution reconciles with the reported GFLOP/s at 1%.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import slate_tpu as st
+from slate_tpu.linalg import heev_qdwh, polar, svd_qdwh
+from slate_tpu.linalg.condest import spectral_interval
+from slate_tpu.perf import attr, autotune
+
+try:
+    from scipy.linalg import eigvalsh as _ref_eigvalsh
+except Exception:                                  # pragma: no cover
+    _ref_eigvalsh = np.linalg.eigvalsh
+
+
+def _eps(dtype):
+    return float(np.finfo(np.dtype(dtype)).eps)
+
+
+def _orthobasis(rng, n):
+    q, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return q
+
+
+#: name -> singular spectrum (polar tests) at size n
+_SV_SPECTRA = {
+    "well": lambda n: np.linspace(1.0, 2.0, n),
+    "ill": lambda n: np.logspace(-6.0, 0.0, n),        # kappa = 1e6
+    "clustered": lambda n: np.concatenate(
+        [np.full(n // 2, 1.0), np.full(n - n // 2, 1.0 + 1e-4)]),
+}
+
+#: name -> eigenvalue spectrum (heev tests) at size n
+_EW_SPECTRA = {
+    "well": lambda n: np.linspace(0.5, 2.0, n),
+    "sign-split": lambda n: np.concatenate(
+        [np.linspace(-2.0, -0.5, n // 2),
+         np.linspace(0.3, 1.7, n - n // 2)]),
+    "clustered": lambda n: np.concatenate(
+        [np.full(n // 2, -1.0), np.full(n - n // 2, 1.0 + 1e-4)]),
+    "ill": lambda n: np.concatenate(
+        [np.logspace(-5.0, 0.0, n // 2), -np.logspace(-5.0, 0.0,
+                                                      n - n // 2)]),
+}
+
+
+def _sv_matrix(rng, n, spectrum, dtype):
+    """Nonsymmetric n×n with prescribed singular values."""
+    u = _orthobasis(rng, n)
+    v = _orthobasis(rng, n)
+    return ((u * _SV_SPECTRA[spectrum](n)) @ v.T).astype(dtype)
+
+
+def _ew_matrix(rng, n, spectrum, dtype):
+    """Hermitian n×n with prescribed eigenvalues."""
+    q = _orthobasis(rng, n)
+    a = (q * _EW_SPECTRA[spectrum](n)) @ q.T
+    a = 0.5 * (a + a.T)
+    return a.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# polar(): the QDWH contract  A = U·H,  UᴴU = I,  H ⪰ 0
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("spectrum", sorted(_SV_SPECTRA))
+def test_polar_contract(dtype, spectrum):
+    rng = np.random.default_rng(7)
+    n = 64
+    a = _sv_matrix(rng, n, spectrum, dtype)
+    u, h = polar(st.Matrix.from_array(a, nb=32))
+    uv = np.asarray(u, dtype=np.float64)
+    hv = np.asarray(h, dtype=np.float64)
+    tol = 50.0 * n * _eps(dtype)
+    assert np.linalg.norm(uv.T @ uv - np.eye(n)) < tol
+    assert np.linalg.norm(uv @ hv - a) < tol * np.linalg.norm(a)
+    assert np.linalg.norm(hv - hv.T) == 0.0          # symmetrized exactly
+    assert np.linalg.eigvalsh(hv).min() > -tol * np.linalg.norm(a)
+    # H carries A's singular values
+    sv_ref = np.linalg.svd(a.astype(np.float64), compute_uv=False)
+    sv_h = np.sort(np.linalg.eigvalsh(hv))[::-1]
+    assert np.abs(sv_h - sv_ref).max() < tol * sv_ref[0]
+
+
+def test_polar_rectangular_and_interval():
+    """m > n partial isometry, and a caller-supplied condest interval
+    must land the same factorization as the internally estimated one."""
+    rng = np.random.default_rng(8)
+    m, n = 96, 48
+    a = rng.standard_normal((m, n)).astype(np.float64)
+    u1, h1 = polar(st.Matrix.from_array(a, nb=32))
+    iv = spectral_interval(jnp.asarray(a))
+    sv = np.linalg.svd(a, compute_uv=False)
+    assert iv[0] >= sv[0] * (1.0 - 1e-10)            # alpha >= sigma_max
+    assert iv[1] <= sv[-1] * (1.0 + 1e-10)           # deliberately low
+    u2, h2 = polar(st.Matrix.from_array(a, nb=32), interval=iv)
+    tol = 50.0 * m * _eps(np.float64)
+    for uv, hv in ((np.asarray(u1), np.asarray(h1)),
+                   (np.asarray(u2), np.asarray(h2))):
+        assert uv.shape == (m, n) and hv.shape == (n, n)
+        assert np.linalg.norm(uv.T @ uv - np.eye(n)) < tol
+        assert np.linalg.norm(uv @ hv - a) < tol * np.linalg.norm(a)
+
+
+@pytest.mark.parametrize("variant", ["qr", "chol"])
+def test_polar_forced_step_variants_agree(variant, monkeypatch):
+    """A forced per-iteration Halley variant (the ``qdwh_step`` site)
+    still converges to the same polar factor on a well-conditioned
+    operand — the variant switch changes flop mix, not the answer."""
+    rng = np.random.default_rng(9)
+    n = 48
+    a = _sv_matrix(rng, n, "well", np.float64)
+    u_ref, _ = polar(st.Matrix.from_array(a, nb=16))
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE", "qdwh_step=" + variant)
+    autotune.reset_table()
+    u_f, h_f = polar(st.Matrix.from_array(a, nb=16))
+    dec = autotune.decisions()
+    assert any(k.startswith("qdwh_step|") and v == variant
+               for k, v in dec.items()), sorted(dec)
+    tol = 50.0 * n * _eps(np.float64)
+    assert np.linalg.norm(np.asarray(u_f) - np.asarray(u_ref)) < tol
+    assert np.linalg.norm(
+        np.asarray(u_f) @ np.asarray(h_f) - a) < tol * np.linalg.norm(a)
+    autotune.reset_table()
+
+
+# ---------------------------------------------------------------------------
+# heev_qdwh / svd_qdwh: spectral divide and conquer
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+@pytest.mark.parametrize("spectrum", sorted(_EW_SPECTRA))
+def test_heev_qdwh_spectra(dtype, spectrum):
+    rng = np.random.default_rng(10)
+    n = 96
+    a = _ew_matrix(rng, n, spectrum, dtype)
+    w, z = heev_qdwh(jnp.asarray(a), jobz=True,
+                     opts={"qdwh_crossover": 32, "nb": 32})
+    wv = np.asarray(w, dtype=np.float64)
+    zv = np.asarray(z, dtype=np.float64)
+    tol = 300.0 * n * _eps(dtype)
+    w_ref = _ref_eigvalsh(a.astype(np.float64))
+    scale = np.abs(w_ref).max()
+    assert (np.diff(wv) >= -tol * scale).all()       # ascending
+    assert np.abs(wv - w_ref).max() < tol * scale
+    assert np.linalg.norm(a @ zv - zv * wv) < tol * np.linalg.norm(a)
+    assert np.linalg.norm(zv.T @ zv - np.eye(n)) < tol
+
+
+def test_heev_qdwh_novectors():
+    rng = np.random.default_rng(11)
+    n = 64
+    a = _ew_matrix(rng, n, "sign-split", np.float64)
+    w, z = heev_qdwh(jnp.asarray(a), jobz=False,
+                     opts={"qdwh_crossover": 32})
+    assert z is None
+    w_ref = np.linalg.eigvalsh(a)
+    assert np.abs(np.asarray(w) - w_ref).max() \
+        < 50.0 * n * _eps(np.float64) * np.abs(w_ref).max()
+
+
+def test_crossover_consistency():
+    """The D&C answer must not depend on where the recursion bottoms
+    out: a deep recursion (crossover 16), the default, and a crossover
+    at n (pure two-stage leaf — zero divide steps) agree to the same
+    eigenvalues."""
+    rng = np.random.default_rng(12)
+    n = 96
+    a = _ew_matrix(rng, n, "sign-split", np.float64)
+    w_ref = np.linalg.eigvalsh(a)
+    tol = 50.0 * n * _eps(np.float64) * np.abs(w_ref).max()
+    for crossover in (16, 48, n):
+        w, z = heev_qdwh(jnp.asarray(a), jobz=True,
+                         opts={"qdwh_crossover": crossover, "nb": 32})
+        assert np.abs(np.asarray(w) - w_ref).max() < tol, crossover
+        zv = np.asarray(z)
+        assert np.linalg.norm(a @ zv - zv * np.asarray(w)) < tol
+        assert np.linalg.norm(zv.T @ zv - np.eye(n)) \
+            < 50.0 * n * _eps(np.float64)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_svd_qdwh_contract(dtype):
+    rng = np.random.default_rng(13)
+    n = 96
+    a = _sv_matrix(rng, n, "well", dtype)
+    s, u, vh = svd_qdwh(jnp.asarray(a), opts={"qdwh_crossover": 32,
+                                              "nb": 32})
+    sv = np.asarray(s, dtype=np.float64)
+    uv = np.asarray(u, dtype=np.float64)
+    vhv = np.asarray(vh, dtype=np.float64)
+    tol = 300.0 * n * _eps(dtype)
+    s_ref = np.linalg.svd(a.astype(np.float64), compute_uv=False)
+    assert (np.diff(sv) <= tol * s_ref[0]).all()     # descending
+    assert np.abs(sv - s_ref).max() < tol * s_ref[0]
+    assert np.linalg.norm((uv * sv) @ vhv - a) < tol * s_ref[0]
+    assert np.linalg.norm(uv.T @ uv - np.eye(n)) < tol
+    assert np.linalg.norm(vhv @ vhv.T - np.eye(n)) < tol
+
+
+# ---------------------------------------------------------------------------
+# Shipped dispatch: the forced eig_driver/svd_driver pins (acceptance)
+# ---------------------------------------------------------------------------
+
+def _heev_e2e(n, dtype):
+    rng = np.random.default_rng(n)
+    a = _ew_matrix(rng, n, "sign-split", dtype)
+    w, z = st.heev(st.HermitianMatrix(jnp.asarray(a), uplo=st.Uplo.Lower),
+                   jobz=True)
+    wv = np.asarray(w, dtype=np.float64)
+    zv = np.asarray(z, dtype=np.float64)
+    tol = 300.0 * n * _eps(dtype)
+    w_ref = _ref_eigvalsh(a.astype(np.float64))
+    scale = np.abs(w_ref).max()
+    assert np.abs(wv - w_ref).max() < tol * scale
+    assert np.linalg.norm(a @ zv - zv * wv) < tol * np.linalg.norm(a)
+    assert np.linalg.norm(zv.T @ zv - np.eye(n)) < tol
+
+
+def _svd_e2e(n, dtype):
+    rng = np.random.default_rng(n + 1)
+    a = _sv_matrix(rng, n, "well", dtype)
+    s, u, vh = st.svd(st.Matrix.from_array(a))
+    sv, uv, vhv = (np.asarray(x, dtype=np.float64) for x in (s, u, vh))
+    tol = 300.0 * n * _eps(dtype)
+    s_ref = np.linalg.svd(a.astype(np.float64), compute_uv=False)
+    assert np.abs(sv - s_ref).max() < tol * s_ref[0]
+    assert np.linalg.norm((uv * sv) @ vhv - a) < tol * np.linalg.norm(a)
+    assert np.linalg.norm(uv.T @ uv - np.eye(n)) < tol
+    assert np.linalg.norm(vhv @ vhv.T - np.eye(n)) < tol
+
+
+@pytest.fixture
+def _forced_qdwh(monkeypatch):
+    monkeypatch.setenv("SLATE_TPU_AUTOTUNE_FORCE",
+                       "eig_driver=qdwh,svd_driver=qdwh")
+    autotune.reset_table()
+    yield
+    autotune.reset_table()
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_heev_svd_dispatch_n256(dtype, _forced_qdwh):
+    """Acceptance: n=256 f32/f64 through the shipped autotune dispatch
+    (forced pins honored off-TPU) — residual, orthogonality, and
+    eigenvalue/singular-value parity against the dense reference."""
+    _heev_e2e(256, dtype)
+    _svd_e2e(256, dtype)
+    dec = autotune.decisions()
+    assert any(k.startswith("eig_driver|") and v == "qdwh"
+               for k, v in dec.items()), sorted(dec)
+    assert any(k.startswith("svd_driver|") and v == "qdwh"
+               for k, v in dec.items()), sorted(dec)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_heev_svd_dispatch_n1024(dtype, _forced_qdwh):
+    """Acceptance at the large dim (slow tier: ~2 min per dtype on one
+    CPU core)."""
+    _heev_e2e(1024, dtype)
+    _svd_e2e(1024, dtype)
+
+
+# ---------------------------------------------------------------------------
+# attr: the gemm-rich stage model (acceptance pin)
+# ---------------------------------------------------------------------------
+
+def test_qdwh_label_parsing():
+    routine, dtype, dims = attr.parse_label("heev_qdwh_fp32_n1024")
+    assert (routine, dtype) == ("heev", "fp32")
+    assert dims["n"] == 1024 and dims.get("qdwh") == 1
+    routine, dtype, dims = attr.parse_label("svd_qdwh_fp64_n512")
+    assert (routine, dtype) == ("svd", "fp64")
+    assert dims["n"] == 512 and dims.get("qdwh") == 1
+    # plain labels stay on the two-stage model
+    routine, _, dims = attr.parse_label("heev_fp32_n1024")
+    assert routine == "heev" and not dims.get("qdwh")
+
+
+@pytest.mark.parametrize("routine", ["heev", "svd"])
+def test_qdwh_stage_model_gemm_rich(routine):
+    """≥80% of the QDWH model flops are qr/chol/gemm — the tier's whole
+    premise — and the stage split reconciles exactly with the
+    routine's model flop count."""
+    dims = {"n": 1024, "qdwh": 1}
+    stages, _ = attr.stage_model(routine, dims)
+    total = sum(s["flops"] for s in stages)
+    assert total == pytest.approx(attr.model_flops(routine, dims),
+                                  rel=1e-9)
+    factor = sum(s["flops"] for s in stages
+                 if s["stage"] in ("qr", "chol", "gemm"))
+    assert factor / total >= 0.80
+    assert {s["stage"] for s in stages} == {"qr", "chol", "gemm",
+                                            "stage1"}
+
+
+@pytest.mark.parametrize("label,gf",
+                         [("heev_qdwh_fp32_n1024", 4200.0),
+                          ("svd_qdwh_fp32_n1024", 3100.0)])
+def test_qdwh_attribution_reconciles_at_1pct(label, gf):
+    rep = attr.attribute(label, gf)
+    assert rep is not None
+    total = sum(s["flops"] for s in rep["stages"])
+    assert abs(total / rep["measured_s"] / 1e9 - gf) / gf < 0.01
+    names = {s["stage"] for s in rep["stages"]}
+    assert {"qr", "chol", "gemm"} <= names
+    factor = sum(s["flops"] for s in rep["stages"]
+                 if s["stage"] in ("qr", "chol", "gemm"))
+    assert factor / total >= 0.80
+
+
+def test_plain_label_with_qdwh_autotune_tag():
+    """A plain ``heev_*`` label whose embedded autotune census carries
+    ``eig_driver -> qdwh`` attributes on the QDWH model, not the
+    two-stage chain."""
+    rep = attr.attribute("heev_fp32_n1024", 4200.0,
+                         autotune={"eig_driver|1024,float32,HIGH": "qdwh"})
+    assert rep is not None
+    assert {"qr", "chol", "gemm"} <= {s["stage"] for s in rep["stages"]}

@@ -18,7 +18,11 @@ peak; the ``gemm_fp32_split_speedup_over_floor`` sentinel row rides
 the generic ``*_over_floor`` floor pin) and the batched serving
 throughput rows (``*_solves_per_s``, r8: higher is better, judged with
 the rate direction — the sentinel pins serving throughput like any
-other metric) — and exits nonzero when
+other metric).  The QDWH spectral tier's ``heev_qdwh_*``/``svd_qdwh_*``
+labels (ISSUE 18; forced-dispatch gemm-rich drivers, with
+``_qr_s``/``_chol_s``/``_gemm_s`` stage timers) align as their own
+routines, distinct from the autotuned plain ``heev_*``/``svd_*`` rows.
+Exits nonzero when
 any routine regressed more than the threshold between consecutive
 artifacts OR when any artifact is infra-shaped (``rc != 0``,
 missing/empty/partial aggregate) — the checks that would have flagged
