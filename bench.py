@@ -113,7 +113,8 @@ BASELINE_GFLOPS = 702.0  # reference docs/usage.md per-GPU gemm anchor
 #: silently pollute the headline by missing a hand-copied tuple.
 DERIVED_SUFFIXES = ("_frac_of_gemm", "_frac_of_split_gemm",
                     "_hbm_roundtrips", "_abft_overhead_pct",
-                    "_over_floor", "_host_gb_transferred")
+                    "_over_floor", "_host_gb_transferred",
+                    "_hbm_peak_gb")
 
 #: everything a gemm-fraction would be unit salad for: wall seconds,
 #: speedup ratios, and the derived families above.
@@ -236,18 +237,67 @@ def _probes_avoided(snapshot):
 def _attribution(label, gflops, metrics_delta, autotune_tags):
     """The routine's roofline gap report (slate_tpu/perf/attr.py):
     analytical per-stage flops/bytes joined with this routine's
-    measured timer deltas, placed on the platform roofline.  Also feeds
-    the per-stage ``roofline.*`` gauges the Perfetto export renders as
-    counter tracks.  None (and never an exception) when the label has
-    no model."""
+    measured timer deltas — or, when an ``SLATE_TPU_XPROF`` capture
+    wrapped this routine, with the capture's per-stage DEVICE seconds
+    (the report's ``compute_source`` says which rung won) — placed on
+    the platform roofline.  Also feeds the per-stage ``roofline.*``
+    gauges the Perfetto export renders as counter tracks.  None (and
+    never an exception) when the label has no model."""
     try:
         from slate_tpu.perf import attr
 
+        dev_prof = None
+        try:
+            from slate_tpu.perf import xprof
+
+            dev_prof = xprof.last_profile()
+        except Exception:
+            pass
         rep = attr.attribute(label, gflops, metrics_snapshot=metrics_delta,
-                             autotune=autotune_tags, platform=_PLATFORM)
+                             autotune=autotune_tags, platform=_PLATFORM,
+                             device_profile=dev_prof)
         if rep:
             attr.record_rooflines(rep)
         return rep
+    except Exception:
+        return None
+
+
+def _xprof_capture(label):
+    """The routine's opt-in device-truth capture window
+    (``SLATE_TPU_XPROF=<dir>`` — slate_tpu/perf/xprof.py); an inert
+    context manager when the knob is unset or xprof cannot load.
+    Never allowed to kill a routine."""
+    try:
+        from slate_tpu.perf import xprof
+
+        xprof.clear()           # a stale capture must not join THIS line
+        return xprof.capture(label)
+    except Exception:
+        import contextlib
+
+        return contextlib.nullcontext()
+
+
+def _device_mem():
+    """``slate_tpu.debug.memory_stats()``, hardened — ``{}`` rather
+    than ever killing a routine."""
+    try:
+        import slate_tpu.debug as _debug
+
+        return _debug.memory_stats()
+    except Exception:
+        return {}
+
+
+def _hbm_peak_gb(mem_before):
+    """Per-routine HBM high-water delta (GB) against a pre-routine
+    ``memory_stats`` block; None on backends without the allocator API
+    (CPU CI) — the submetric is then simply absent, never a lie."""
+    try:
+        from slate_tpu.perf import xprof
+
+        return xprof.hbm_peak_delta_gb(mem_before, _device_mem())
     except Exception:
         return None
 
@@ -758,8 +808,10 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
             # chaos seam: an injected routine-startup fault takes the
             # same classified-infra retry path a real one would
             _inj.fault_here("bench.startup")
-            out = _run_with_deadline(fn, deadline, name=name,
-                                     on_hard_hang=_on_hard_hang)
+            mem_before = _device_mem()
+            with _xprof_capture(name):
+                out = _run_with_deadline(fn, deadline, name=name,
+                                         on_hard_hang=_on_hard_hang)
             label, gf, resid = out[0], out[1], out[2]
             tags = _autotune_tags(keys_before)
             delta = _metrics_delta(snap_before)
@@ -817,6 +869,13 @@ def _run_routine(name, fn, sub, fails, infra, deadline=None,
                     rt = (delta.get("counters") or {}).get(
                         "step.hbm_roundtrips", 0.0)
                 sub[label + "_hbm_roundtrips"] = float(rt)
+            peak_gb = _hbm_peak_gb(mem_before)
+            if peak_gb is not None:
+                # device-memory submetric (ISSUE 19): per-routine HBM
+                # high-water, lower-is-better, excluded from the
+                # GFLOP/s aggregates like the other derived families;
+                # absent on backends without the allocator API
+                sub[label + "_hbm_peak_gb"] = round(float(peak_gb), 6)
             if len(out) > 3:
                 line.update(out[3])
             print(json.dumps(line), flush=True)

@@ -71,15 +71,52 @@ def check_pool_leaks(pool) -> None:
             f"({pool.num_allocated} allocated, {pool.num_free} free)")
 
 
-def memory_stats() -> dict:
-    """Native runtime stats — ``Debug::printNumFreeMemBlocks``
-    (``Debug.cc:304``) territory.  Returns availability + thread count;
-    per-pool counters live on :class:`~slate_tpu.native.MemoryPool`."""
+def device_memory_stats() -> list:
+    """Per-device allocator stats via ``device.memory_stats()`` —
+    hardened: one dict per device that reports the API
+    (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit`` plus
+    platform and device id), and ``[]`` on backends without it (the CPU
+    allocator returns None) instead of raising — CPU CI and jax-free
+    processes get an empty list, never an exception."""
 
+    out = []
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:                       # pragma: no cover
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        row = {"device": str(d.id), "platform": str(d.platform)}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                  "largest_alloc_size", "num_allocs"):
+            v = stats.get(k)
+            if v is not None:
+                row[k] = int(v)
+        out.append(row)
+    return out
+
+
+def memory_stats() -> dict:
+    """Native runtime + device allocator stats —
+    ``Debug::printNumFreeMemBlocks`` (``Debug.cc:304``) territory.
+    Returns availability + thread count and, under ``"devices"``, the
+    per-device HBM gauges from :func:`device_memory_stats` (``[]`` on
+    backends without the API); per-pool counters live on
+    :class:`~slate_tpu.native.MemoryPool`."""
+
+    devices = device_memory_stats()
     try:
         from . import native
     except Exception:                       # pragma: no cover
-        return {"available": False}
+        return {"available": False, "devices": devices}
     if not native.available():
-        return {"available": False}
-    return {"available": True, "host_threads": native.num_threads()}
+        return {"available": False, "devices": devices}
+    return {"available": True, "host_threads": native.num_threads(),
+            "devices": devices}

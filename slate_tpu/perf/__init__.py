@@ -6,9 +6,13 @@ attribution engine (:mod:`.attr`) that joins the analytical per-stage
 cost model with the measured metrics to say where the time went, and
 the live serving telemetry layer (:mod:`.telemetry`): per-request
 tracing, SLO histograms, Prometheus/JSONL streaming exporters and the
-in-process live sentinel, and the offline autotune sweep engine +
+in-process live sentinel, the offline autotune sweep engine +
 versioned warm-start bundles (:mod:`.sweep`) that close the loop
-between the roofline model and the decision table."""
+between the roofline model and the decision table, and the
+device-truth profiling layer (:mod:`.xprof`) that captures an XProf
+trace around an opt-in region, joins per-kernel device walls onto the
+repo's stage vocabulary, and feeds the measured signals back into
+attribution and the sweep."""
 
 from .hlo_profile import (CollectiveOp, ComputationProfile, DotOp,
                           ModuleProfile, collective_byte_census,
@@ -19,7 +23,7 @@ __all__ = [
     "CollectiveOp", "ComputationProfile", "DotOp", "ModuleProfile",
     "attr", "autotune", "blackbox", "collective_byte_census", "metrics",
     "profile_fn", "profile_hlo_text", "regress",
-    "stablehlo_collective_shapes", "sweep", "telemetry",
+    "stablehlo_collective_shapes", "sweep", "telemetry", "xprof",
 ]
 
 
@@ -28,7 +32,7 @@ def __getattr__(name):
     # attr/metrics/regress/sweep/telemetry stay stdlib-light and import
     # on demand
     if name in ("attr", "autotune", "blackbox", "metrics", "regress",
-                "sweep", "telemetry"):
+                "sweep", "telemetry", "xprof"):
         import importlib
 
         return importlib.import_module("." + name, __name__)

@@ -633,6 +633,34 @@ def stage_timers(metrics_snapshot, op: str) -> dict:
     return out
 
 
+def profile_stage_seconds(device_profile, op: str) -> dict:
+    """Per-stage DEVICE seconds for ``op`` out of an xprof capture —
+    ``{stage: seconds}``, the ``device_profile`` join
+    :func:`attribute`'s top compute-source rung weighs stages with.
+
+    Accepts the shapes a caller naturally holds: a full parsed profile
+    (``{"stages": {op: {stage: s}}}`` — ``xprof.last_profile()``), the
+    per-op stages map alone, or a flat ``{stage: seconds}`` for this
+    op.  Non-numeric leaves and other ops' entries are ignored; ``{}``
+    when the capture saw nothing for ``op``."""
+    if not isinstance(device_profile, dict):
+        return {}
+    m = device_profile.get("stages", device_profile)
+    if isinstance(m, dict) and isinstance(m.get(op), dict):
+        m = m[op]
+    if not isinstance(m, dict):
+        return {}
+    out = {}
+    for stage, v in m.items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and float(v) > 0.0:
+            out[str(stage)] = float(v)
+        elif isinstance(v, dict):
+            # flat map keyed by op: only this op's sub-map counts
+            continue
+    return out
+
+
 # ---------------------------------------------------------------------------
 # The attribution engine
 # ---------------------------------------------------------------------------
@@ -645,7 +673,7 @@ def _r(x, nd=9):
 
 def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
               platform: str = "tpu", n_devices: int = 1,
-              collective_bytes=None) -> dict | None:
+              collective_bytes=None, device_profile=None) -> dict | None:
     """The gap report for one routine invocation, or None when the
     label has no model (derived ``_s`` / ``_frac_of_gemm`` /
     ``_frac_of_split_gemm`` / ``_over_floor`` keys, zero throughput,
@@ -658,7 +686,11 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     label, its GFLOP/s, the routine's metrics snapshot (ideally the
     per-routine DELTA — r7 bench), and its autotune tags.  On mesh runs
     pass ``n_devices`` and either ``collective_bytes`` or a snapshot
-    carrying the ``collective.bcast_*.bytes`` counters.
+    carrying the ``collective.bcast_*.bytes`` counters.  When an xprof
+    capture exists, pass its profile (or per-stage seconds) as
+    ``device_profile`` — device truth outranks host timers on the
+    compute-source ladder (``device_profile > timers > model``,
+    reported as ``compute_source``).
     """
     if label.endswith(("_s", "_frac_of_gemm", "_frac_of_split_gemm",
                        "_over_floor")):
@@ -756,28 +788,36 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
     model_s = sum(s["min_s"] for s in stages)
     gap_s = measured_s - model_s
 
-    # apportion the measured wall time across stages: timer-weighted
-    # when namespaced stage timers exist, model-flop-weighted otherwise
+    # apportion the measured wall time across stages — the
+    # compute-source ladder: device-profile-weighted when an xprof
+    # capture covered this op (device truth), timer-weighted when
+    # namespaced host stage timers exist, model-flop-weighted otherwise
     timers = stage_timers(metrics_snapshot, routine)
-    if routine in ("heev", "svd") and not dims.get("qdwh") \
-            and "stage2" in timers and "chase" not in timers:
+    dev = profile_stage_seconds(device_profile, routine)
+    if routine in ("heev", "svd") and not dims.get("qdwh"):
         # the drivers record the two-stage middle as stage.<op>.stage2;
         # the model calls that stage "chase" — without the alias the
         # measured middle-stage time would silently redistribute onto
         # stage1/stage3 and a chase regression would be misattributed
-        timers["chase"] = timers.pop("stage2")
+        if "stage2" in timers and "chase" not in timers:
+            timers["chase"] = timers.pop("stage2")
+        if "stage2" in dev and "chase" not in dev:
+            dev["chase"] = dev.pop("stage2")
+    dev_timed = {s["stage"]: dev[s["stage"]] for s in stages
+                 if dev.get(s["stage"], 0.0) > 0.0}
     timed = {s["stage"]: timers[s["stage"]]["total_s"] for s in stages
              if s["stage"] in timers
              and timers[s["stage"]]["total_s"] > 0.0}
-    if timed:
-        source = "timers"
-        untimed_min = sum(s["min_s"] for s in stages
-                          if s["stage"] not in timed)
-        leftover = max(measured_s - untimed_min, 0.0)
-        tot_t = sum(timed.values())
+    weights = dev_timed or timed
+    if weights:
+        source = "device_profile" if dev_timed else "timers"
+        unweighted_min = sum(s["min_s"] for s in stages
+                             if s["stage"] not in weights)
+        leftover = max(measured_s - unweighted_min, 0.0)
+        tot_w = sum(weights.values())
         for s in stages:
-            s["measured_s"] = (leftover * timed[s["stage"]] / tot_t
-                               if s["stage"] in timed else s["min_s"])
+            s["measured_s"] = (leftover * weights[s["stage"]] / tot_w
+                               if s["stage"] in weights else s["min_s"])
     else:
         source = "model"
         pos_gap = max(gap_s, 0.0)
@@ -812,6 +852,7 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
         "platform": platform,
         "fusion": fusion,
         "backend_source": source,
+        "compute_source": source,
         "peaks": {k: _r(v, 3) for k, v in pk.items()},
         "gflops": float(gflops),
         "total_flops": float(total_flops),
@@ -838,6 +879,13 @@ def attribute(label: str, gflops, metrics_snapshot=None, autotune=None,
         report["lookahead"] = lookahead
     if collective is not None:
         report["collective"] = collective
+    if dev_timed:
+        prov = {"stages": sorted(dev_timed),
+                "device_s": _r(sum(dev_timed.values()))}
+        if isinstance(device_profile, dict) \
+                and device_profile.get("digest"):
+            prov["digest"] = str(device_profile["digest"])
+        report["device_profile"] = prov
     return report
 
 
@@ -894,6 +942,13 @@ def explain_pair(old: dict, new: dict, delta_pct=None,
                 "(gap share %.2f->%.2f)"
                 % (head, s["stage"], o["roofline_frac"],
                    s["roofline_frac"], o["gap_share"], s["gap_share"]))
+    src_o = old.get("compute_source") or old.get("backend_source")
+    src_n = new.get("compute_source") or new.get("backend_source")
+    if src_n:
+        # a reader must be able to tell a device-truth claim from a
+        # host-timer or model-only apportionment at a glance
+        line += " [source %s]" % (src_n if src_o in (src_n, None)
+                                  else "%s->%s" % (src_o, src_n))
     if note:
         line += "; " + note
     return line
@@ -913,7 +968,8 @@ def format_report(rep: dict) -> str:
     head = [
         "%s  [%s %s, fusion=%s, attribution=%s]"
         % (rep["label"], rep["platform"], rep["dtype"] or "?",
-           rep["fusion"], rep["backend_source"]),
+           rep["fusion"],
+           rep.get("compute_source") or rep.get("backend_source")),
         "  achieved %.1f GFLOP/s = %.3f of %.1f TF/s peak "
         "(HBM %.0f GB/s); measured %.2f ms, roofline-min %.2f ms, "
         "gap %.2f ms"

@@ -75,6 +75,7 @@ __all__ = [
     "check_finite_wanted", "device_metrics_wanted",
     "resilience_wanted", "set_resilience_hint",
     "record_fallback_outcome", "pallas_census", "install_compile_watch",
+    "add_compile_listener", "set_annotation_hook",
     "step_timer", "count_hbm_roundtrips", "STEP_HBM_ROUNDTRIPS",
     "bucket_bounds", "quantiles_from_buckets", "hist_quantiles",
     "env_flag",
@@ -185,21 +186,50 @@ def observe_time(name: str, seconds: float) -> None:
             t[3] = max(t[3], seconds)
 
 
+#: optional factory (name -> context manager) entered/exited around every
+#: named timer window — the xprof capture installs
+#: ``jax.profiler.TraceAnnotation`` here so the ``step.<op>.<stage>``
+#: vocabulary exists on the profiler timeline even while the registry is
+#: off.  Host-side only: annotations never change a compiled program.
+_annotation_hook: list = [None]
+
+
+def set_annotation_hook(factory) -> None:
+    """Install (or clear, with None) the timer annotation factory — see
+    :data:`_annotation_hook`.  Used by ``slate_tpu.perf.xprof.capture``
+    for the duration of a capture window."""
+    _annotation_hook[0] = factory
+
+
 class _Timer:
     """Context manager recording its wall time into a named timer."""
 
-    __slots__ = ("name", "_t0")
+    __slots__ = ("name", "_t0", "_ann")
 
     def __init__(self, name: str):
         self.name = name
         self._t0 = 0.0
+        self._ann = None
 
     def __enter__(self):
+        hook = _annotation_hook[0]
+        if hook is not None:
+            try:
+                ann = hook(self.name)
+                ann.__enter__()
+                self._ann = ann
+            except Exception:
+                self._ann = None
         if _registry.enabled:
             self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(None, None, None)
+            finally:
+                self._ann = None
         if _registry.enabled and self._t0:
             observe_time(self.name, time.perf_counter() - self._t0)
         return False
@@ -434,16 +464,41 @@ def drain_samples() -> list:
 
 _compile_watch_installed = [False]
 
+#: extra ``callback(event, duration, **kw)`` sinks fanned the raw
+#: jax.monitoring stream (the xprof capture's per-fn compile ledger
+#: registers here).  Called BEFORE the registry-enabled check so a
+#: capture window sees compiles even with metrics off; each callback is
+#: individually guarded — a broken listener must never raise from
+#: inside jax's compile path.
+_compile_listeners: list = []
+
+
+def add_compile_listener(cb) -> None:
+    """Fan the jax.monitoring compile-event stream out to ``cb`` too
+    (idempotent per callback object).  Callers still need
+    :func:`install_compile_watch` to register the process-wide hook."""
+    if cb not in _compile_listeners:
+        _compile_listeners.append(cb)
+
 
 def _on_jax_event(event: str, duration, **kw) -> None:
     # jax.monitoring's documented listener contract is
     # callback(event, duration, **kwargs) — swallow the kwargs or a
     # future jax that passes them raises from inside its compile path
+    for cb in _compile_listeners:
+        try:
+            cb(event, duration, **kw)
+        except Exception:
+            pass
     if not _registry.enabled:
         return
     if event.endswith("backend_compile_duration"):
         inc("jit.backend_compiles")
         inc("jit.backend_compile_secs", float(duration))
+        fn = kw.get("fun_name") or kw.get("module_name")
+        if fn:
+            observe_time("jit.compile.%s" % str(fn).replace(".", "_")[:60],
+                         float(duration))
     elif "compile" in event:
         inc("jit.compile_events")
 

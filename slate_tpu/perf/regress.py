@@ -156,10 +156,13 @@ def direction(label: str) -> float:
     if label.endswith("_per_s"):
         return 1.0
     if label.endswith(("_ms", "_hbm_roundtrips", "_abft_overhead_pct",
-                       "_host_gb_transferred")):
+                       "_host_gb_transferred", "_hbm_peak_gb")):
         # _host_gb_transferred (ISSUE 17): GB moved over the host link
         # per out-of-core factorization — a rise means the window or
-        # prefetch schedule regressed into re-fetching tiles
+        # prefetch schedule regressed into re-fetching tiles.
+        # _hbm_peak_gb (ISSUE 19): the routine's device-memory
+        # high-water from the allocator gauges — a rise means an extra
+        # materialized buffer on the critical path
         return -1.0
     return -1.0 if label.endswith("_s") else 1.0
 
@@ -392,10 +395,11 @@ def _num(v, label: str = "") -> Optional[float]:
         # sentinel must see
         return float(v)
     if label.endswith(("_hbm_roundtrips", "_over_floor",
-                       "_host_gb_transferred")):
+                       "_host_gb_transferred", "_hbm_peak_gb")):
         # structural counts (steady state 0), floor-sentinel ratios (a
-        # total efficiency collapse IS 0) and host-link byte odometers
-        # (an all-resident window legitimately moves ~0 GB): zero is a
+        # total efficiency collapse IS 0), host-link byte odometers
+        # (an all-resident window legitimately moves ~0 GB) and HBM
+        # high-water deltas (a tiny routine can round to 0): zero is a
         # measured value the structural judges below compare against,
         # not the failed-routine placeholder the v > 0 filter drops
         return float(v) if v >= 0 else None

@@ -63,8 +63,9 @@ __all__ = [
     "BUNDLE_ENV", "BUNDLE_FORMAT", "GRIDS", "MODEL_GUARD", "SITES",
     "SiteSpec", "build_bundle", "bundle_digest", "key_str",
     "model_backend", "model_fit", "pow2_bucket", "predict_times",
-    "prune", "read_bundle", "run_sweep", "split_key",
-    "warm_specs_from_results", "write_bundle",
+    "profile_signals", "prune", "read_bundle", "run_sweep",
+    "set_profile_signals", "split_key", "warm_specs_from_results",
+    "write_bundle",
 ]
 
 #: env var naming the active bundle file (consumed by perf/autotune.py)
@@ -134,9 +135,62 @@ def _attr():
         return mod
 
 
+def _xprof():
+    """The device-truth profiling layer (``perf/xprof.py``) — loaded
+    the same dual-life way as :func:`_attr` so ``run_sweep(profile=
+    <path>)`` can read a capture artifact on a jax-free machine (the
+    parser half of xprof is stdlib-only)."""
+    try:
+        from . import xprof
+        return xprof
+    except ImportError:
+        import importlib.util
+        import sys
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "xprof.py")
+        name = "_slate_tpu_xprof"
+        if name in sys.modules:
+            return sys.modules[name]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        return mod
+
+
 # ---------------------------------------------------------------------------
 # Candidate pricing (the analytical pre-prune)
 # ---------------------------------------------------------------------------
+
+#: measured compute signals the pricing functions consult (ROADMAP
+#: 5(b)): ``{"digest", "launch_s", "stages", ...}`` distilled from a
+#: captured xprof profile / PR 15 timeline rows by
+#: ``xprof.signals_from``.  Installed for the duration of one
+#: ``run_sweep(profile=...)`` call (try/finally) — None means
+#: roofline-only pricing, the pre-ISSUE-19 behavior.
+_PROFILE_SIGNALS: list = [None]
+
+
+def set_profile_signals(sig) -> None:
+    """Install (or clear, with None) the measured pricing signals —
+    see :data:`_PROFILE_SIGNALS`."""
+    _PROFILE_SIGNALS[0] = dict(sig) if isinstance(sig, dict) else None
+
+
+def profile_signals():
+    """The active measured pricing signals dict, or None."""
+    return _PROFILE_SIGNALS[0]
+
+
+def _measured_launch_s():
+    """The measured per-dispatch exposed-overhead signal (seconds), or
+    None when pricing is roofline-only."""
+    sig = _PROFILE_SIGNALS[0]
+    if isinstance(sig, dict):
+        v = sig.get("launch_s")
+        if isinstance(v, (int, float)) and v > 0:
+            return float(v)
+    return None
 
 _SHORT_DTYPE = {"float32": "fp32", "float64": "fp64", "bfloat16": "bf16",
                 "complex64": "c64", "complex128": "c128"}
@@ -162,7 +216,11 @@ def _fusion_predict(routine: str, dims_of: Callable, fusion_of: dict):
     is priced as :func:`attr.predict_seconds` at its fusion, so the
     materialized-round-trip term is what separates them.  An unknown
     candidate name (or a missing stage model) disables pruning for the
-    whole unit — the sweep must never skip what it cannot price."""
+    whole unit — the sweep must never skip what it cannot price.  When
+    a captured profile installed measured signals
+    (:func:`set_profile_signals`), the measured per-dispatch overhead
+    replaces the default launch constant — the term that separates
+    fusion rungs at small shapes is then an observation."""
     def predict(key_parts, names, platform):
         dims, dt = dims_of(key_parts)
         a = _attr()
@@ -172,7 +230,8 @@ def _fusion_predict(routine: str, dims_of: Callable, fusion_of: dict):
             if f is None:
                 return {}
             t = a.predict_seconds(routine, dims, dt, fusion=f,
-                                  platform=platform)
+                                  platform=platform,
+                                  launch_s=_measured_launch_s())
             if t is None:
                 return {}
             out[name] = t
@@ -246,7 +305,10 @@ def _predict_dist_chunk(key_parts, names, platform):
     time uses :func:`attr.peaks`' ``ici_gbs`` with a representative
     panel height (:data:`_CHUNK_ROWS_PER_DEV` block rows per mesh
     row); the key carries no matrix size, so this prices candidate
-    ORDER per (mesh, nb, dtype), which is all pruning needs."""
+    ORDER per (mesh, nb, dtype), which is all pruning needs.  A
+    measured ``launch_s`` signal (:func:`set_profile_signals`) moves
+    the optimum c* = √(wire/launch) — the slice count is then tuned
+    against observed exposure, not the launch constant."""
     if len(key_parts) < 4:
         return {}
     _op, p, q, nb = key_parts[:4]
@@ -257,7 +319,8 @@ def _predict_dist_chunk(key_parts, names, platform):
            "bfloat16": 2}.get(str(dt), 4)
     m = _CHUNK_ROWS_PER_DEV * p * nb
     wire = m * nb * isz / (a.peaks(platform)["ici_gbs"] * 1e9)
-    launch = a._DEF_LAUNCH_S.get(platform, a._DEF_LAUNCH_S["tpu"])
+    launch = _measured_launch_s() \
+        or a._DEF_LAUNCH_S.get(platform, a._DEF_LAUNCH_S["tpu"])
     out = {}
     for name in names:
         try:
@@ -265,6 +328,50 @@ def _predict_dist_chunk(key_parts, names, platform):
         except ValueError:
             return {}
         out[name] = c * launch + wire / max(1, c)
+    return out
+
+
+def _predict_dist_lookahead(key_parts, names, platform):
+    """Exposure pricing for the ``dist_lookahead`` site: a depth-D
+    panel ring overlaps the broadcasts for steps k+1..k+D with the
+    step-k trailing contraction — exposed wire shrinks as
+    ``max(0, wire − D·budget)`` — but pays D−1 redundant rank-nb
+    corrections (replicated compute, zero extra collectives) plus
+    their dispatch per step::
+
+        t(D) = max(0, wire − D·budget) + (D−1)·(redund + launch)
+
+    ``budget`` is the per-device trailing-update roofline at a
+    representative window (the trailing width the ``nt`` key carries),
+    ``launch`` the per-dispatch overhead — the MEASURED signal when a
+    profile installed one, which is exactly where a timeline-informed
+    bundle flips the depth a roofline-only bundle picks."""
+    if len(key_parts) < 4:
+        return {}
+    _op, nt, nb = key_parts[:3]
+    dt = key_parts[3] if len(key_parts) > 3 else "float32"
+    a = _attr()
+    nt, nb = int(nt), int(nb)
+    pk = a.peaks(platform)
+    isz = {"float64": 8, "complex64": 8, "complex128": 16,
+           "bfloat16": 2}.get(str(dt), 4)
+    m = _CHUNK_ROWS_PER_DEV * nb
+    t_w = max(1, nt - 1) * nb
+    wire = m * nb * isz / (pk["ici_gbs"] * 1e9)
+    budget = 2.0 * m * nb * t_w / (pk["tflops"] * 1e12)
+    redund = 2.0 * m * nb * nb / (pk["tflops"] * 1e12)
+    launch = _measured_launch_s() \
+        or a._DEF_LAUNCH_S.get(platform, a._DEF_LAUNCH_S["tpu"])
+    out = {}
+    for name in names:
+        try:
+            d = int(name)
+        except ValueError:
+            return {}
+        out[name] = (max(0.0, wire - d * budget)
+                     + (d - 1) * (redund + launch))
+        if out[name] <= 0.0:
+            out[name] = 1e-12           # depth 1 fully hidden: keep > 0
     return out
 
 
@@ -831,6 +938,89 @@ def _build_dist_chunk(u):
                  at.Candidate("4", lambda: _setup(4))]
 
 
+#: steps in the dist_lookahead proxy window — enough that a depth-2+
+#: ring has broadcasts to float ahead of the consuming contraction
+_LOOKAHEAD_WINDOW = 4
+
+
+def _build_dist_lookahead(u):
+    """Sweep unit for the lookahead panel-ring depth
+    (``autotune.choose_dist_lookahead``; names ``"1"``..``"4"``, key
+    ``(op, nt, nb, dtype)``).  The proxy times a W-step window on the
+    process's own mesh with the ring's actual cost/benefit structure:
+    at depth D the panel broadcast for step k+D is issued while step
+    k's trailing contraction consumes panel k (XLA's async collectives
+    overlap them exactly as the distributed drivers' rings do), and
+    each step pays the ring's D−1 redundant rank-nb corrections.  Each
+    broadcast carries a distinct operand scale so CSE cannot collapse
+    the window.  Values are a timing proxy, not driver output — no
+    residual gate."""
+    from . import autotune as at
+    import jax
+    import jax.numpy as jnp
+
+    from .._jax_compat import shard_map
+    from jax.sharding import PartitionSpec as P
+    from ..parallel import dist_util
+    from ..parallel.mesh import AXIS_P, AXIS_Q, make_grid_mesh, \
+        mesh_grid_shape
+
+    op = str(u.get("op", "getrf"))
+    nt = int(u.get("nt", 16))
+    nb = int(u["nb"])
+    dt = jnp.dtype(u.get("dtype", "float32"))
+    mesh = make_grid_mesh()
+    p, q = mesh_grid_shape(mesh)
+    key = (op, nt, nb, dt.name)
+    mlb = _CHUNK_ROWS_PER_DEV
+    M = mlb * nb * p
+    T = mlb * nb * q
+    probes: dict = {}
+
+    def _col():
+        return at._memo(probes, "col",
+                        lambda: at._randn((M, nb), dt, 5))
+
+    def _trail():
+        return at._memo(probes, "trail",
+                        lambda: at._randn((nb, T), dt, 6))
+
+    def _setup(depth):
+        W = _LOOKAHEAD_WINDOW
+
+        def kernel(col, trail):
+            r = jax.lax.axis_index(AXIS_P)
+            grows = dist_util.local_grows(mlb, nb, p, r)
+            own = (jax.lax.axis_index(AXIS_Q) == 0)
+
+            def bcast(j):
+                return dist_util.bcast_block_col(
+                    col * jnp.asarray(1.0 + j, dt), grows, own, M)
+
+            ring = [bcast(j) for j in range(min(depth, W))]
+            acc = jnp.zeros((M, T), dt)
+            for k in range(W):
+                nxt = k + depth
+                if nxt < W:
+                    ring.append(bcast(nxt))
+                pan = ring[k]
+                acc = acc + pan @ trail
+                for j in range(depth - 1):
+                    # the ring's redundant rank-nb corrections: depth D
+                    # replicates D-1 narrow updates per step
+                    acc = acc.at[:, :nb].add(
+                        pan @ trail[:, :nb] * jnp.asarray(1.0 + j, dt))
+            return acc
+
+        fn = shard_map(kernel, mesh=mesh,
+                       in_specs=(P(AXIS_P, None), P(None, None)),
+                       out_specs=P(None, None))
+        return at._timed_call(fn, _col(), _trail())
+
+    return key, [at.Candidate(str(d), lambda d=d: _setup(d))
+                 for d in (1, 2, 3, 4)]
+
+
 def _build_batched(kind):
     def build(u):
         from . import autotune as at
@@ -921,6 +1111,12 @@ SITES: Dict[str, SiteSpec] = {
     # the offline bundle can pin the chunking per (mesh shape, nb,
     # dtype) without the runtime ever owning a timeable mesh
     "dist_chunk": SiteSpec(_build_dist_chunk, _predict_dist_chunk),
+    # the lookahead panel-ring depth (ISSUE 19): exposure-priced from
+    # the overlap model, with the per-dispatch overhead replaced by the
+    # MEASURED signal when run_sweep was handed a captured profile —
+    # the timeline-informed half of ROADMAP 5(b)
+    "dist_lookahead": SiteSpec(_build_dist_lookahead,
+                               _predict_dist_lookahead),
     # host-DRAM tile-pool residency (ISSUE 17): priced as in-core +
     # PCIe tile traffic, timed with a forced tiny window so the smoke
     # sweep proves eviction/write-back end to end
@@ -966,6 +1162,10 @@ def _full_units():
     for op in ("potrf", "getrf", "geqrf", "trsm"):
         for nb in (256, 512, 1024):
             units.append({"site": "dist_chunk", "op": op, "nb": nb})
+    for op in ("potrf", "getrf", "geqrf"):
+        for nt in (8, 16, 32):
+            units.append({"site": "dist_lookahead", "op": op, "nt": nt,
+                          "nb": 512})
     for n in (4096, 8192):
         for nb in (512, 1024):
             units.append({"site": "ooc", "n": n, "nb": nb})
@@ -1191,6 +1391,43 @@ def warm_specs_from_results(results, extra=()) -> list:
 # The sweep engine
 # ---------------------------------------------------------------------------
 
+def _resolve_profile_signals(profile, measured_steps, platform):
+    """Turn ``run_sweep``'s ``profile``/``measured_steps`` inputs into
+    ``(provenance, signals)``: the profile is loaded when given as a
+    capture dir / artifact path, the timeline rows default to the last
+    :func:`~slate_tpu.parallel.dist_util.timeline_steps` run when the
+    caller passed none, and ``xprof.signals_from`` distills both at
+    the platform's ICI peak.  ``(None, None)`` when nothing usable was
+    supplied — the sweep then prices roofline-only, exactly as before."""
+    xp = _xprof()
+    prof = None
+    src = None
+    if isinstance(profile, str):
+        src = profile
+        prof = xp.load_profile(profile)
+    elif isinstance(profile, dict):
+        prof = profile
+        src = profile.get("artifact") or profile.get("trace_path")
+    if measured_steps is None:
+        try:
+            from ..parallel import dist_util as _du
+
+            measured_steps = _du.timeline_steps() or None
+        except Exception:
+            measured_steps = None
+    if prof is None and not measured_steps:
+        return None, None
+    sig = xp.signals_from(prof, measured_steps=measured_steps,
+                          ici_gbs=_attr().peaks(platform).get("ici_gbs"))
+    prov = {"digest": sig.get("digest"),
+            "launch_s": sig.get("launch_s"),
+            "stage_ops": sorted(sig.get("stages") or {}),
+            "measured_steps": int(sig.get("measured_steps") or 0)}
+    if src:
+        prov["source"] = str(src)
+    return prov, sig
+
+
 def _write_checkpoint(path: str, done: dict) -> None:
     tmp = path + ".tmp.%d" % os.getpid()
     with open(tmp, "w") as f:
@@ -1202,7 +1439,8 @@ def run_sweep(grid="smoke", *, margin: Optional[float] = None,
               reps: Optional[int] = None, checkpoint: Optional[str] = None,
               resume: bool = False, out: Optional[str] = None,
               table_path: Optional[str] = None,
-              log: Optional[Callable] = None) -> dict:
+              log: Optional[Callable] = None,
+              profile=None, measured_steps=None) -> dict:
     """Run the offline sweep and return (and optionally write) the
     bundle.
 
@@ -1216,7 +1454,21 @@ def run_sweep(grid="smoke", *, margin: Optional[float] = None,
     it on the next run) and transient infra failures take one
     classified retry (:mod:`slate_tpu.resilience.retry`); a unit that
     still fails is recorded in ``stats["units_failed"]`` and never
-    kills the sweep."""
+    kills the sweep.
+
+    ``profile`` closes the measurement loop (ROADMAP 5(b)): a captured
+    ``slate_tpu.perf.xprof`` artifact — a capture dir / artifact path,
+    or an already-parsed profile dict — distilled (with the optional
+    PR 15 ``measured_steps`` timeline rows; when omitted the module's
+    last :func:`~slate_tpu.parallel.dist_util.timeline_steps` rows are
+    pulled) into measured pricing signals for the duration of the
+    sweep: the per-dispatch overhead that sizes ``dist_chunk`` slices,
+    prices ``dist_lookahead`` depth and separates the
+    ``lu_step``/``potrf_step`` fusion rungs comes from observation
+    instead of the launch constant.  The bundle's ``version`` (and so
+    its digest) and a ``bundle["profile"]`` block record the profile
+    digest and signal provenance — a timeline-informed bundle is
+    distinguishable from a roofline-only one by inspection."""
     from . import autotune as at
     from ..resilience.retry import transient_infra, with_backoff
 
@@ -1236,6 +1488,23 @@ def run_sweep(grid="smoke", *, margin: Optional[float] = None,
     if platform not in ("tpu", "cpu"):
         platform = "tpu"
 
+    prof_prov = sig = None
+    if profile is not None or measured_steps is not None:
+        try:
+            prof_prov, sig = _resolve_profile_signals(
+                profile, measured_steps, platform)
+        except Exception as e:
+            say(f"# sweep: profile unusable "
+                f"({type(e).__name__}: {e}); pricing roofline-only")
+    if sig is not None:
+        # the measured-signal provenance rides the version key, so the
+        # bundle digest of a timeline-informed sweep can never collide
+        # with the roofline-only bundle of the same grid
+        version = dict(version, profile=prof_prov)
+        say(f"# sweep: measured signals installed "
+            f"(digest {prof_prov.get('digest')}, "
+            f"launch_s {prof_prov.get('launch_s')})")
+
     done: dict = {}
     if checkpoint and resume and os.path.exists(checkpoint):
         try:
@@ -1254,6 +1523,9 @@ def run_sweep(grid="smoke", *, margin: Optional[float] = None,
     stats = {"units": 0, "units_resumed": 0, "units_failed": 0,
              "candidates": 0, "reps_timed": 0, "reps_saved": 0}
     seen_this_run: set = set()
+
+    if sig is not None:
+        set_profile_signals(sig)
 
     for u in units:
         site = u.get("site")
@@ -1323,11 +1595,15 @@ def run_sweep(grid="smoke", *, margin: Optional[float] = None,
                 _write_checkpoint(checkpoint, done)
             except OSError:
                 pass                    # read-only FS: in-memory only
+    if sig is not None:
+        set_profile_signals(None)
     stats["reps_exhaustive"] = stats["reps_timed"] + stats["reps_saved"]
     stats["timing_reps_actual"] = tab.timing_reps
     warm = warm_specs_from_results(results, extra=spec.get("warm") or ())
     bundle = build_bundle(results, version, pruned=pruned_log,
                           grid_name=grid_name, warm=warm, stats=stats)
+    if prof_prov is not None:
+        bundle["profile"] = dict(prof_prov)
     if out:
         write_bundle(out, bundle)
         say(f"# bundle written: {out} (digest {bundle['digest']}, "

@@ -530,7 +530,8 @@ def log_record(kind: str, **fields) -> None:
 
 #: counter/gauge prefixes worth streaming into the JSONL snapshots (the
 #: full registry would dominate the log; the serving story lives here)
-_SNAP_PREFIXES = ("serve.", "telemetry.", "resilience.", "jit.")
+_SNAP_PREFIXES = ("serve.", "telemetry.", "resilience.", "jit.",
+                  "xprof.")
 
 
 def _snapshot_record() -> dict:

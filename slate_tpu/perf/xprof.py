@@ -1,0 +1,640 @@
+"""Device-truth profiling: XProf capture → parse → join (ISSUE 19).
+
+Every other timer in the repo is a host wall — ``step.*`` timers record
+Python composition at trace/dispatch time, ``dist.step.*`` rows are
+synced host walls, serve spans are dispatcher clocks.  This module adds
+the device side: an opt-in capture window around a region of interest
+(``SLATE_TPU_XPROF=<dir>`` + :class:`capture`) drives
+``jax.profiler.start_trace``/``stop_trace`` and parses the emitted
+trace-event JSON (stdlib gzip+json — no tensorboard, no protobuf) into:
+
+* a **per-kernel table** — every execution event on an XLA/device lane
+  (``dot.3``, ``fusion.12``, TPU kernel launches), aggregated by name;
+* a **stage rollup** — kernels bucketed onto the existing annotation
+  vocabulary (``step.<op>.<stage>`` / ``stage.<op>.<name>`` from
+  :func:`slate_tpu.perf.metrics.step_timer`, ``dist.<driver>.k<k>``
+  from :func:`slate_tpu.parallel.dist_util.run_timeline`) by time
+  overlap, so fused-step / full-fused / dist-step kernels land in the
+  attr stage vocabulary.  Execution that happens outside any
+  annotation span (a jitted driver executes AFTER its trace-time
+  annotations) falls back to the annotation's own profiler wall —
+  the same proxy semantics as the host-timer rung, but on the
+  profiler's clock — and ``stage_source`` records which rung each
+  stage used;
+* **device memory** — per-device HBM high-water / live-bytes gauges
+  read through :func:`slate_tpu.debug.memory_stats` before and after
+  the window (graceful ``[]`` on backends without the API);
+* a **compile ledger** — per-fn compile walls forwarded from the PR 4
+  ``jax.monitoring`` compile-watch hook
+  (:func:`slate_tpu.perf.metrics.add_compile_listener`) while the
+  window is open.
+
+The parsed profile is written next to the trace
+(``xprof_<label>.json``) and kept as module state so the downstream
+joins are one call away: :func:`last_profile` feeds
+``attr.attribute(device_profile=...)`` and
+``dist_util.overlap_summary(device_profile=...)`` their
+``device_profile`` compute-source rung, and ``sweep.run_sweep
+(profile=...)`` consumes :func:`signals_from` (measured per-collective
+overhead + measured stage seconds) when pricing ``dist_chunk`` /
+``dist_lookahead`` / fusion-rung candidates.
+
+Contract (same as metrics/blackbox): **off by default, and enabling it
+never changes a compiled program** — the capture wraps execution in
+profiler hooks and host-side annotations only, so lowered text is
+bit-identical with the knob set or unset
+(``tests/test_backend_registry.py`` pins it).  This module is
+stdlib-only at import and dual-life: importable as
+``slate_tpu.perf.xprof`` or exec'd by file path like ``regress.py``
+(``tools/xprof_report.py`` does exactly that on jax-free machines —
+the parser half works anywhere; only :class:`capture` needs jax).
+
+Env knobs:
+
+* ``SLATE_TPU_XPROF`` — capture directory; unset (default) makes
+  :class:`capture` a no-op context manager.
+"""
+
+from __future__ import annotations
+
+import bisect
+import gzip
+import hashlib
+import json
+import os
+import time
+
+__all__ = [
+    "ENV_DIR", "PROFILE_FORMAT", "capture", "capture_dir", "clear",
+    "enabled", "find_trace_file", "hbm_peak_delta_gb", "last_profile",
+    "last_stages", "load_profile", "parse_trace", "profile_digest",
+    "signals_from", "stage_bucket",
+]
+
+ENV_DIR = "SLATE_TPU_XPROF"
+
+PROFILE_FORMAT = 1
+
+#: most recent parsed profile (module state, like dist_util's timeline
+#: rows): bench's per-routine attribution join reads it right after the
+#: capture window closes.
+_last: list = [None]
+
+
+def capture_dir():
+    """The ``SLATE_TPU_XPROF`` capture directory, or None (off)."""
+    v = os.environ.get(ENV_DIR, "").strip()
+    return v or None
+
+
+def enabled() -> bool:
+    return capture_dir() is not None
+
+
+def last_profile():
+    """The most recent capture's parsed profile dict (or None)."""
+    return _last[0]
+
+
+def last_stages() -> dict:
+    """``{op: {stage: seconds}}`` of the most recent capture — the
+    ``device_profile`` argument shape ``attr.attribute`` joins."""
+    p = _last[0]
+    return dict((p or {}).get("stages") or {})
+
+
+def clear() -> None:
+    _last[0] = None
+
+
+# ---------------------------------------------------------------------------
+# Stage bucketing: the trace.py / metrics.py annotation vocabulary
+# ---------------------------------------------------------------------------
+
+def stage_bucket(name: str):
+    """``(op, stage)`` for an annotation name in the repo's span
+    vocabulary, else None.
+
+    * ``step.<op>.<stage>`` / ``stage.<op>.<name>`` — the
+      :func:`metrics.step_timer` join keys ``attr.stage_timers``
+      already consumes;
+    * ``dist.<driver>.k<k>`` — the PR 15 timeline chunk spans, rolled
+      up under stage ``"dist"`` per driver;
+    * ``driver.<name>`` — the instrumented driver facades, stage
+      ``"driver"``.
+    """
+    parts = str(name).split(".")
+    if len(parts) == 3 and parts[0] in ("step", "stage"):
+        return parts[1], parts[2]
+    if len(parts) == 3 and parts[0] == "dist" and parts[2][:1] == "k":
+        return parts[1], "dist"
+    if len(parts) == 2 and parts[0] == "driver":
+        return parts[1], "driver"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Trace-event JSON parsing (stdlib gzip+json)
+# ---------------------------------------------------------------------------
+
+def find_trace_file(root: str):
+    """Newest trace-event JSON under ``root`` (jax writes
+    ``plugins/profile/<ts>/*.trace.json.gz`` when asked for a perfetto
+    trace).  Accepts a direct file path too.  None when nothing
+    parseable exists."""
+    if os.path.isfile(root):
+        return root
+    best, best_m = None, -1.0
+    for dirpath, _dirs, files in os.walk(root):
+        for f in files:
+            if f.endswith((".trace.json.gz", ".trace.json")) \
+                    or f in ("perfetto_trace.json.gz",
+                             "perfetto_trace.json"):
+                p = os.path.join(dirpath, f)
+                try:
+                    m = os.path.getmtime(p)
+                except OSError:
+                    continue
+                # prefer the xprof .trace.json.gz flavor over the
+                # perfetto duplicate of the same session (same events;
+                # the former carries the thread metadata we key on)
+                rank = 1.0 if ".trace.json" in f else 0.0
+                if (m, rank) > (best_m, 0.0 if best is None
+                                else (1.0 if ".trace.json" in
+                                      os.path.basename(best) else 0.0)):
+                    if m > best_m or rank > 0:
+                        best, best_m = p, m
+    return best
+
+
+def _load_events(path: str) -> list:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        blob = json.load(f)
+    if isinstance(blob, dict):
+        return list(blob.get("traceEvents") or [])
+    return list(blob or [])
+
+
+def _is_exec_lane(pname: str, tname: str) -> bool:
+    # TPU device traces land under "/device:TPU:N" processes; CPU thunk
+    # execution lands on the XLA client / codegen thread pools.  The
+    # python thread is host-side and never a kernel lane.
+    p = (pname or "").lower()
+    t = tname or ""
+    return ("device" in p) or ("XLA" in t) or t.startswith("tf_")
+
+
+def parse_trace(path_or_dir: str, label: str = "") -> dict:
+    """Parse one emitted trace into the profile dict (see module doc).
+
+    Raises ``OSError``/``ValueError`` on an unreadable or empty trace —
+    :class:`capture` converts that into an ``error`` field instead of
+    killing the profiled run.
+    """
+    path = find_trace_file(path_or_dir)
+    if path is None:
+        raise ValueError("no trace-event JSON under %r" % path_or_dir)
+    events = _load_events(path)
+
+    pname: dict = {}
+    tname: dict = {}
+    for e in events:
+        if e.get("ph") != "M":
+            continue
+        args = e.get("args") or {}
+        if e.get("name") == "process_name":
+            pname[e.get("pid")] = str(args.get("name", ""))
+        elif e.get("name") == "thread_name":
+            tname[(e.get("pid"), e.get("tid"))] = str(args.get("name", ""))
+
+    anns = []          # (start_s, stop_s, dur_s, name, op, stage)
+    kernels = []       # (start_s, stop_s, dur_s, name)
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = str(e.get("name", ""))
+        try:
+            ts = float(e.get("ts", 0.0)) / 1e6
+            dur = max(float(e.get("dur", 0.0)), 0.0) / 1e6
+        except (TypeError, ValueError):
+            continue
+        bucket = stage_bucket(name)
+        if bucket is not None:
+            anns.append((ts, ts + dur, dur, name) + bucket)
+            continue
+        if not name or name.startswith("$") or "::" in name:
+            continue                    # python frames / runtime infra
+        if _is_exec_lane(pname.get(e.get("pid"), ""),
+                         tname.get((e.get("pid"), e.get("tid")), "")):
+            kernels.append((ts, ts + dur, dur, name))
+
+    # interval-stabbing join: each kernel's midpoint finds the
+    # INNERMOST covering annotation span (shortest dur wins — nested
+    # step.<op>.<stage> inside driver.<op> buckets to the stage)
+    anns.sort(key=lambda a: a[0])
+    starts = [a[0] for a in anns]
+    max_dur = max((a[2] for a in anns), default=0.0)
+
+    def _covering(mid: float):
+        hit, hit_dur = None, max_dur
+        i = bisect.bisect_right(starts, mid) - 1
+        while i >= 0:
+            a = anns[i]
+            if a[1] >= mid and a[2] <= hit_dur:
+                hit, hit_dur = a, a[2]
+            # spans are start-sorted: an earlier span covering mid
+            # needs dur >= mid - start, so once mid - a[0] exceeds the
+            # best (or max) duration nothing earlier can win
+            if mid - a[0] > hit_dur:
+                break
+            i -= 1
+        return hit
+
+    ktab: dict = {}
+    stages: dict = {}
+    stage_source: dict = {}
+    for ts, stop, dur, name in kernels:
+        cover = _covering((ts + stop) / 2.0) if anns else None
+        key = (name, cover[4] if cover else None,
+               cover[5] if cover else None)
+        row = ktab.get(key)
+        if row is None:
+            ktab[key] = row = {"name": name, "count": 0, "total_s": 0.0,
+                               "op": key[1], "stage": key[2]}
+        row["count"] += 1
+        row["total_s"] += dur
+        if cover is not None:
+            op, stage = cover[4], cover[5]
+            stages.setdefault(op, {})
+            stages[op][stage] = stages[op].get(stage, 0.0) + dur
+            stage_source.setdefault(op, {})[stage] = "kernels"
+
+    ann_tab: dict = {}
+    for ts, stop, dur, name, op, stage in anns:
+        k = "%s.%s" % (op, stage)
+        row = ann_tab.get(k)
+        if row is None:
+            ann_tab[k] = row = {"op": op, "stage": stage, "count": 0,
+                                "wall_s": 0.0}
+        row["count"] += 1
+        row["wall_s"] += dur
+        # fallback rung: no kernel executed INSIDE this span (jitted
+        # drivers execute after their trace-time annotations) — the
+        # annotation's own profiler wall stands in, and stage_source
+        # says so
+        if stage not in (stages.get(op) or {}):
+            stages.setdefault(op, {})
+            stages[op][stage] = stages[op].get(stage, 0.0) + dur
+            stage_source.setdefault(op, {})[stage] = "annotation"
+
+    kernel_rows = sorted(ktab.values(),
+                         key=lambda r: (-r["total_s"], r["name"]))
+    prof = {
+        "format": PROFILE_FORMAT,
+        "label": str(label or ""),
+        "trace_path": path,
+        "events": len(events),
+        "kernels": [dict(r, total_s=round(r["total_s"], 9))
+                    for r in kernel_rows],
+        "stages": {op: {st: round(v, 9) for st, v in m.items()}
+                   for op, m in stages.items()},
+        "stage_source": stage_source,
+        "annotations": {k: dict(v, wall_s=round(v["wall_s"], 9))
+                        for k, v in ann_tab.items()},
+    }
+    prof["digest"] = profile_digest(prof)
+    return prof
+
+
+def profile_digest(prof: dict) -> str:
+    """Content digest over the decision-bearing parts of a profile (the
+    kernel table + stage rollup) — what a timeline-informed sweep
+    bundle is stamped with, so it is distinguishable from a
+    roofline-only one."""
+    core = {"kernels": prof.get("kernels") or [],
+            "stages": prof.get("stages") or {}}
+    payload = json.dumps(core, sort_keys=True,
+                         separators=(",", ":")).encode()
+    return hashlib.sha256(payload).hexdigest()[:16]
+
+
+def load_profile(path_or_dir: str) -> dict:
+    """Load a profile from a capture dir, a written ``xprof_*.json``
+    artifact, or a raw trace-event file.
+
+    A dir is searched for the newest artifact first (it carries memory
+    and compile blocks a re-parse cannot reconstruct), then for a raw
+    trace to parse."""
+    if os.path.isdir(path_or_dir):
+        best, best_m = None, -1.0
+        for dirpath, _dirs, files in os.walk(path_or_dir):
+            for f in files:
+                if f.startswith("xprof_") and f.endswith(".json"):
+                    p = os.path.join(dirpath, f)
+                    try:
+                        m = os.path.getmtime(p)
+                    except OSError:
+                        continue
+                    if m > best_m:
+                        best, best_m = p, m
+        if best is not None:
+            with open(best) as f:
+                return json.load(f)
+        return parse_trace(path_or_dir)
+    if path_or_dir.endswith(".json") and not path_or_dir.endswith(
+            (".trace.json", "perfetto_trace.json")):
+        with open(path_or_dir) as f:
+            blob = json.load(f)
+        if isinstance(blob, dict) and "stages" in blob:
+            return blob
+    return parse_trace(path_or_dir)
+
+
+# ---------------------------------------------------------------------------
+# Device memory gauges
+# ---------------------------------------------------------------------------
+
+def _memory_block():
+    """``slate_tpu.debug.memory_stats()`` hardened: {} when the debug
+    module (or jax) is unreachable — by-path loads and jax-free
+    machines must keep the parser half working."""
+    try:
+        from slate_tpu import debug as _debug
+
+        return _debug.memory_stats()
+    except Exception:
+        return {}
+
+
+def hbm_peak_delta_gb(before, after):
+    """Per-window HBM high-water (GB) out of two
+    ``debug.memory_stats()`` blocks.
+
+    The runtime's ``peak_bytes_in_use`` is a process-lifetime
+    high-water with no reset API, so the per-window figure is only
+    directly observable when the window ADVANCED the peak — then it is
+    ``after.peak − before.live``.  Otherwise the live-bytes delta
+    (floored at 0) stands in as the lower bound.  None when no device
+    reports the API (CPU CI) — the bench submetric is simply absent
+    there instead of lying."""
+    b = {d.get("device"): d for d in (before or {}).get("devices") or []
+         if isinstance(d, dict)}
+    total = None
+    for d in (after or {}).get("devices") or []:
+        if not isinstance(d, dict):
+            continue
+        prev = b.get(d.get("device")) or {}
+        peak, peak0 = d.get("peak_bytes_in_use"), \
+            prev.get("peak_bytes_in_use")
+        live, live0 = d.get("bytes_in_use"), prev.get("bytes_in_use")
+        if peak is None and live is None:
+            continue
+        base = float(live0 or 0.0)
+        if peak is not None and peak0 is not None \
+                and float(peak) > float(peak0):
+            gb = max(0.0, float(peak) - base)
+        elif live is not None:
+            gb = max(0.0, float(live) - base)
+        else:
+            continue
+        total = (total or 0.0) + gb
+    return None if total is None else total / 1e9
+
+
+# ---------------------------------------------------------------------------
+# Compile ledger (rides the PR 4 jax.monitoring compile watch)
+# ---------------------------------------------------------------------------
+
+_ledger: list = []
+_ledger_installed = [False]
+_capture_active = [False]
+
+
+def _install_ledger() -> None:
+    if _ledger_installed[0]:
+        return
+    try:
+        from slate_tpu.perf import metrics as _metrics
+    except Exception:
+        return
+
+    def _cb(event, secs, **kw):
+        if not _capture_active[0]:
+            return
+        name = kw.get("fun_name") or kw.get("module_name") \
+            or kw.get("event_name") or ""
+        _ledger.append({"event": str(event), "fn": str(name),
+                        "secs": float(secs)})
+
+    _metrics.add_compile_listener(_cb)
+    _metrics.install_compile_watch()
+    _ledger_installed[0] = True
+
+
+def _ledger_rollup(rows) -> dict:
+    out = {"events": len(rows), "total_s": 0.0, "by_fn": {}}
+    for r in rows:
+        out["total_s"] += r["secs"]
+        key = r["fn"] or r["event"].rsplit("/", 1)[-1]
+        ent = out["by_fn"].setdefault(key, {"count": 0, "total_s": 0.0})
+        ent["count"] += 1
+        ent["total_s"] += r["secs"]
+    out["total_s"] = round(out["total_s"], 9)
+    for ent in out["by_fn"].values():
+        ent["total_s"] = round(ent["total_s"], 9)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The capture window
+# ---------------------------------------------------------------------------
+
+class capture:
+    """Opt-in device-truth capture around a region of interest::
+
+        with xprof.capture("getrf_fp32_n4096") as cap:
+            run()
+        cap.profile       # parsed profile dict (or None when off)
+
+    No-op (and allocation-free) unless ``SLATE_TPU_XPROF`` names a
+    directory or ``log_dir`` is passed.  While open, the window also
+    forces the repo's host annotations onto the profiler clock
+    (``metrics.set_annotation_hook`` + ``trace.force_annotations``) so
+    the stage vocabulary exists in the trace even when SVG tracing and
+    the metrics registry are off.  Capture failures (profiler busy,
+    unparseable trace) are recorded on ``self.error`` — the profiled
+    run itself is never killed by its observer."""
+
+    def __init__(self, label: str, log_dir=None):
+        self.label = str(label)
+        self.dir = log_dir or capture_dir()
+        self.profile = None
+        self.error = None
+        self._active = False
+        self._mem0 = None
+        self._ledger0 = 0
+        self._t0 = 0.0
+        self._hooked = False
+
+    # -- annotation plumbing ------------------------------------------------
+    def _annotations(self, on: bool) -> None:
+        try:
+            from jax.profiler import TraceAnnotation
+
+            from slate_tpu import trace as _trace
+            from slate_tpu.perf import metrics as _metrics
+        except Exception:
+            return
+        if on:
+            _metrics.set_annotation_hook(TraceAnnotation)
+            _trace.force_annotations(True)
+            self._hooked = True
+        elif self._hooked:
+            _metrics.set_annotation_hook(None)
+            _trace.force_annotations(False)
+            self._hooked = False
+
+    def __enter__(self):
+        if not self.dir:
+            return self
+        try:
+            import jax
+        except Exception as e:                  # jax-free process
+            self.error = "jax unavailable: %s" % e
+            return self
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            self._mem0 = _memory_block()
+            _install_ledger()
+            self._ledger0 = len(_ledger)
+            _capture_active[0] = True
+            self._t0 = time.perf_counter()
+            jax.profiler.start_trace(self.dir,
+                                     create_perfetto_trace=True)
+            self._active = True
+            self._annotations(True)
+        except Exception as e:                  # profiler already busy
+            _capture_active[0] = False
+            self.error = "%s: %s" % (type(e).__name__, e)
+        return self
+
+    def __exit__(self, *exc):
+        if not self._active:
+            _capture_active[0] = False
+            return False
+        self._annotations(False)
+        _capture_active[0] = False
+        wall = time.perf_counter() - self._t0
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.error = "stop_trace: %s: %s" % (type(e).__name__, e)
+            return False
+        try:
+            prof = parse_trace(self.dir, label=self.label)
+        except Exception as e:
+            self.error = "parse: %s: %s" % (type(e).__name__, e)
+            return False
+        prof["capture_wall_s"] = round(wall, 9)
+        mem1 = _memory_block()
+        peak_gb = hbm_peak_delta_gb(self._mem0, mem1)
+        prof["memory"] = {"before": self._mem0, "after": mem1}
+        if peak_gb is not None:
+            prof["memory"]["hbm_peak_gb"] = round(peak_gb, 9)
+        prof["compile"] = _ledger_rollup(_ledger[self._ledger0:])
+        self._gauges(prof, mem1, peak_gb)
+        self._write(prof)
+        _last[0] = prof
+        self.profile = prof
+        return False
+
+    def _gauges(self, prof, mem1, peak_gb) -> None:
+        """Per-routine HBM high-water / live-bytes gauges + capture
+        accounting through the public metrics facade (no-ops while the
+        registry is off)."""
+        try:
+            from slate_tpu.perf import metrics as _metrics
+
+            _metrics.inc("xprof.captures")
+            _metrics.observe_time("xprof.capture.%s"
+                                  % self.label.replace(".", "_")[:40],
+                                  prof["capture_wall_s"])
+            if peak_gb is not None:
+                _metrics.set_gauge("xprof.hbm.peak_gb", peak_gb)
+            live = sum(float(d.get("bytes_in_use") or 0.0)
+                       for d in (mem1 or {}).get("devices") or [])
+            if (mem1 or {}).get("devices"):
+                _metrics.set_gauge("xprof.hbm.live_bytes", live)
+        except Exception:
+            pass
+
+    def _write(self, prof) -> None:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in self.label) or "capture"
+        path = os.path.join(self.dir, "xprof_%s.json" % safe)
+        try:
+            tmp = path + ".tmp.%d" % os.getpid()
+            with open(tmp, "w") as f:
+                json.dump(prof, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            prof["artifact"] = path
+        except OSError:
+            pass                        # read-only FS: in-memory only
+
+
+# ---------------------------------------------------------------------------
+# Measured signals for the sweep (ROADMAP 5(b))
+# ---------------------------------------------------------------------------
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2] if xs else None
+
+
+def signals_from(profile=None, measured_steps=None, ici_gbs=None) -> dict:
+    """Distill a captured profile (+ the PR 15 measured step rows) into
+    the compute signals ``sweep.py`` prices candidates with::
+
+        {"digest", "launch_s", "stages", "measured_steps"}
+
+    * ``launch_s`` — measured per-collective exposed overhead: each
+      timeline row's synced host wall minus its wire time
+      (``bcast_bytes / ici_gbs``), divided by the row's collective
+      count; the median over rows.  An upper bound on the dispatch
+      latency (the window's un-overlapped compute rides along), which
+      is exactly the exposure a ``dist_chunk``/``dist_lookahead``
+      candidate pays per extra collective — the measured substitute
+      for ``attr._DEF_LAUNCH_S``.  A profile artifact may also carry a
+      precomputed ``signals.launch_s`` (synthetic test signals do).
+    * ``stages`` — the capture's ``{op: {stage: seconds}}`` rollup.
+    * None/{} fields mean "no signal": callers fall back to the
+      analytical roofline, never to a guess.
+    """
+    prof = profile or {}
+    sig = {"digest": prof.get("digest"),
+           "launch_s": None,
+           "stages": dict(prof.get("stages") or {}),
+           "measured_steps": 0}
+    pre = (prof.get("signals") or {}).get("launch_s")
+    if isinstance(pre, (int, float)) and pre > 0:
+        sig["launch_s"] = float(pre)
+    rows = list(measured_steps or prof.get("measured_steps") or [])
+    rows = [r for r in rows if isinstance(r, dict)]
+    sig["measured_steps"] = len(rows)
+    if sig["launch_s"] is None and rows and ici_gbs:
+        per = []
+        for r in rows:
+            cnt = float(r.get("bcast_count") or 0.0)
+            if cnt <= 0:
+                continue
+            wire = float(r.get("bcast_bytes") or 0.0) / (float(ici_gbs)
+                                                         * 1e9)
+            per.append(max(0.0, float(r.get("wall_s") or 0.0) - wire)
+                       / cnt)
+        med = _median(per)
+        if med is not None and med > 0:
+            sig["launch_s"] = med
+    return sig

@@ -38,6 +38,21 @@ _lock = threading.Lock()
 _enabled = False
 _origin = 0.0
 
+# profiler-annotation forcing: an xprof capture window needs Block spans
+# (dist.<driver>.k<k> chunk windows etc.) on the device-profiler
+# timeline even while SVG tracing is OFF — forcing emits ONLY the
+# TraceAnnotation (host-side, never changes a compiled program); event
+# recording stays gated on _enabled alone.
+_annotations_forced = False
+
+
+def force_annotations(on: bool) -> None:
+    """Emit ``jax.profiler.TraceAnnotation`` from every :class:`Block`
+    regardless of the tracing flag (see note above) — installed/cleared
+    by ``slate_tpu.perf.xprof.capture`` around its window."""
+    global _annotations_forced
+    _annotations_forced = bool(on)
+
 # ---------------------------------------------------------------------------
 # Lane naming: one STABLE, DISTINCT lane per thread.  Keying lanes by
 # thread NAME alone collapses spans when names collide — exactly what
@@ -110,26 +125,28 @@ class Block:
         self._t0 = 0.0
 
     def __enter__(self):
+        if (_enabled or _annotations_forced) and _JaxAnnotation is not None:
+            self._ann = _JaxAnnotation(self.name)
+            self._ann.__enter__()
         if _enabled:
             if self._lane_arg is None:
                 # the disambiguated per-thread lane (colliding thread
                 # names must not collapse into one Perfetto track);
                 # resolved at ENTRY so the executing thread wins
                 self.lane = current_lane()
-            if _JaxAnnotation is not None:
-                self._ann = _JaxAnnotation(self.name)
-                self._ann.__enter__()
             self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        if _enabled:
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        if _enabled and self._t0:
             t1 = time.perf_counter()
-            if self._ann is not None:
-                self._ann.__exit__(*exc)
             with _lock:
                 _events.append(Event(self.name, self._t0 - _origin,
                                      t1 - _origin, self.lane))
+            self._t0 = 0.0
         return False
 
     def __call__(self, fn):

@@ -526,3 +526,74 @@ def test_multi_backend_sites_populate_autotune_table():
         assert any(k.startswith(op) for k in dec), \
             f"no autotune decision recorded for op site {op!r}: {sorted(dec)}"
     autotune.reset_table()
+
+
+def test_xprof_inert_at_import():
+    """ISSUE 19 guard: with SLATE_TPU_XPROF SET, importing the package
+    (and perf.xprof itself) must not start a trace, write into the
+    capture dir, install the annotation hook, or touch jax.profiler —
+    capture begins at the first ``xprof.capture(...)`` enter, never at
+    import.  Subprocess, like the exporter guards."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    code = (
+        "import os\n"
+        "import slate_tpu\n"
+        "from slate_tpu import trace\n"
+        "from slate_tpu.perf import metrics, xprof\n"
+        "assert xprof.enabled()\n"
+        "assert xprof.last_profile() is None, 'profile at import'\n"
+        "assert not os.path.exists(os.environ['SLATE_TPU_XPROF']), \\\n"
+        "    'capture dir written at import'\n"
+        "assert not trace._annotations_forced, \\\n"
+        "    'annotations forced at import'\n"
+        "assert metrics._annotation_hook[0] is None, \\\n"
+        "    'annotation hook installed at import'\n"
+        "print('OK')\n")
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   SLATE_TPU_XPROF=os.path.join(td, "cap"))
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
+def test_xprof_off_by_default_lowering_bit_identity(tmp_path,
+                                                    monkeypatch):
+    """ISSUE 19 pin: the profiling layer is host-side only — programs
+    lowered INSIDE an active capture (env set, trace running,
+    annotation hook installed) are bit-identical to the knob-unset
+    lowering (the PR 4 contract every observability layer carries)."""
+    import numpy as np
+
+    from slate_tpu.perf import xprof
+
+    a = jnp.asarray(np.eye(32, dtype=np.float32) * 4
+                    + np.ones((32, 32), np.float32))
+
+    def lower():
+        import jax
+
+        return jax.jit(lambda x: st.getrf(x)[0]).lower(a).as_text()
+
+    monkeypatch.delenv(xprof.ENV_DIR, raising=False)
+    base = lower()
+    monkeypatch.setenv(xprof.ENV_DIR, str(tmp_path / "cap"))
+    xprof.clear()
+    with xprof.capture("lowering-pin"):
+        assert lower() == base
+    assert lower() == base
+
+
+def test_xprof_knob_documented():
+    """The device-truth profiling knob must be registered in the
+    user-facing knob table (docs/usage.md) — an undocumented capture
+    knob is an invisible one."""
+    docs = (_PKG.parent / "docs" / "usage.md").read_text()
+    assert "SLATE_TPU_XPROF" in docs, \
+        "SLATE_TPU_XPROF missing from docs/usage.md"
+    assert "Device-truth profiling" in docs

@@ -14,7 +14,7 @@ import time
 import numpy as np
 import pytest
 
-from slate_tpu.perf import autotune, metrics, sweep
+from slate_tpu.perf import autotune, metrics, sweep, xprof
 
 
 @pytest.fixture
@@ -643,3 +643,100 @@ class TestBenchTag:
         line = json.loads(capsys.readouterr().out.strip()
                           .splitlines()[-1])
         assert line["bundle"] is None
+
+
+class TestProfileSignals:
+    """ISSUE 19: a captured device profile feeds the sweep's pricing —
+    the bundle is stamped with the profile digest (and so can never
+    collide with the roofline-only bundle of the same grid), and the
+    measured launch signal flips a dist_chunk decision the roofline
+    prices the other way."""
+
+    _PROFILE = {"digest": "feedbeefcafe0123",
+                "stages": {"getrf": {"panel": 0.2, "update": 0.8}},
+                "signals": {"launch_s": 1e-3}}
+
+    def test_profile_informed_bundle_digest_and_provenance(
+            self, atab, tmp_path, monkeypatch):
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            _toy_site({"a": 1.0, "b": 1.05},
+                      {"a": 0.0, "b": 0.002}))
+        grid = {"name": "toy", "margin": 0.25,
+                "units": [{"site": "toyop", "n": 64}]}
+        base = sweep.run_sweep(grid,
+                               table_path=str(tmp_path / "t0.json"))
+        assert "profile" not in base
+        assert "profile" not in base["version"]
+        autotune.reset_table()
+        informed = sweep.run_sweep(grid, profile=dict(self._PROFILE),
+                                   table_path=str(tmp_path / "t1.json"))
+        assert informed["digest"] != base["digest"]
+        prov = informed["profile"]
+        assert prov["digest"] == "feedbeefcafe0123"
+        assert prov["launch_s"] == pytest.approx(1e-3)
+        assert "getrf" in prov["stage_ops"]
+        assert informed["version"]["profile"] == prov
+        # the signals never leak past the sweep call
+        assert sweep.profile_signals() is None
+
+    def test_profile_loaded_from_artifact_path(self, atab, tmp_path,
+                                               monkeypatch):
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            _toy_site({}, {"a": 0.0, "b": 0.002}))
+        apath = tmp_path / "xprof_t.json"
+        apath.write_text(json.dumps(self._PROFILE))
+        bundle = sweep.run_sweep(
+            {"units": [{"site": "toyop", "n": 64}]},
+            profile=str(apath), table_path=str(tmp_path / "t.json"))
+        assert bundle["profile"]["digest"] == "feedbeefcafe0123"
+        assert bundle["profile"]["source"] == str(apath)
+
+    def test_unusable_profile_prices_roofline_only(self, atab, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setitem(
+            sweep.SITES, "toyop",
+            _toy_site({}, {"a": 0.0, "b": 0.002}))
+        said = []
+        bundle = sweep.run_sweep(
+            {"units": [{"site": "toyop", "n": 64}]},
+            profile=str(tmp_path / "nosuch"),
+            table_path=str(tmp_path / "t.json"), log=said.append)
+        assert "profile" not in bundle
+        assert any("roofline-only" in s for s in said)
+
+    def test_measured_launch_flips_dist_chunk_decision(self):
+        """The decision-delta pin: on a small mesh/nb the roofline's
+        launch constant keeps slicing cheap (winner "2"), a measured
+        1ms dispatch overhead makes every extra collective dear and
+        whole-panel broadcast wins — c* = sqrt(wire/launch) moved."""
+        key = ("getrf", 2, 2, 128, "float32")
+        names = ["whole", "2", "4"]
+        roof = sweep.SITES["dist_chunk"].predict(key, names, "cpu")
+        assert min(roof, key=roof.get) == "2"
+        sweep.set_profile_signals(
+            xprof.signals_from(dict(self._PROFILE)))
+        try:
+            informed = sweep.SITES["dist_chunk"].predict(key, names,
+                                                         "cpu")
+        finally:
+            sweep.set_profile_signals(None)
+        assert min(informed, key=informed.get) == "whole"
+        assert informed["4"] > informed["2"] > informed["whole"]
+
+    def test_dist_lookahead_site_priced_and_swept(self):
+        """The new dist_lookahead site prices every named depth (no
+        unpriced-candidate prune escape) and deeper depths pay their
+        redundant-compute + dispatch toll once the wire is hidden."""
+        key = ("getrf", 2, 2, 128, "float32")
+        pred = sweep.SITES["dist_lookahead"].predict(
+            key, ["1", "2", "3", "4"], "cpu")
+        assert set(pred) == {"1", "2", "3", "4"}
+        assert all(v > 0 for v in pred.values())
+        assert sweep.SITES["dist_lookahead"].predict(
+            key, ["1", "weird"], "cpu") == {}
+        units = [u for u in sweep._full_units()
+                 if u.get("site") == "dist_lookahead"]
+        assert {"op", "nt", "nb"} <= set(units[0])
+        assert len(units) == 9

@@ -17,6 +17,13 @@ deficit.  Artifacts from bench r7+ carry the measured-timer-joined
 submetric labels and autotune tags — so the historical trajectory
 explains too.
 
+Each report header names its ``compute_source`` — where the stage
+weights came from (``device_profile`` for an XProf capture joined at
+bench time, ``timers`` for host stage timers, ``model`` for the pure
+analytical split) — and the summary line rolls the sources up so a
+device-truth artifact is distinguishable from a host-timer one at a
+glance.
+
 Stdlib-only, like ``bench_diff.py``: the attribution engine
 (``slate_tpu/perf/attr.py``) and the artifact loader
 (``slate_tpu/perf/regress.py``) are loaded directly by file path, so
@@ -86,11 +93,18 @@ def main(argv=None) -> int:
         print("no attributable routines in %s" % art.name,
               file=sys.stderr)
         return 1
+    srcs = {}
+    for rep in reports:
+        s = (rep.get("compute_source") or rep.get("backend_source")
+             or "model")
+        srcs[s] = srcs.get(s, 0) + 1
     if args.json:
-        print(json.dumps({"artifact": art.name, "reports": reports},
-                         indent=1))
+        print(json.dumps({"artifact": art.name, "sources": srcs,
+                          "reports": reports}, indent=1))
     else:
-        print("gap report: %s (%d routines)" % (art.name, len(reports)))
+        print("gap report: %s (%d routines; sources: %s)"
+              % (art.name, len(reports),
+                 " ".join("%s=%d" % kv for kv in sorted(srcs.items()))))
         for rep in reports:
             print()
             print(attr.format_report(rep))

@@ -7,6 +7,7 @@ Usage::
     python tools/sweep.py --grid full --out bundle.json \\
         --checkpoint sweep.ck.json --resume
     python tools/sweep.py --grid my_grid.json --sites lu_step,matmul
+    python tools/sweep.py --grid smoke --profile /tmp/xprof_cap
     SLATE_TPU_AUTOTUNE_BUNDLE=bundle.json python my_replica.py
 
 Enumerates the candidate space per autotune site — backend, fusion
@@ -69,6 +70,13 @@ def main(argv=None) -> int:
                     help="timed repetitions per surviving candidate "
                          "(default: the autotuner's)")
     ap.add_argument("--sites", help="comma list: only sweep these sites")
+    ap.add_argument("--profile",
+                    help="xprof capture dir or xprof_*.json artifact "
+                         "(slate_tpu/perf/xprof.py): its measured "
+                         "signals replace the launch constant when "
+                         "pricing dist_chunk / dist_lookahead / fusion "
+                         "candidates, and the bundle records the "
+                         "profile digest")
     ap.add_argument("--list", action="store_true",
                     help="print the resolved grid units and exit "
                          "(never imports jax)")
@@ -102,11 +110,12 @@ def main(argv=None) -> int:
 
     bundle = sw.run_sweep(spec, margin=args.margin, reps=args.reps,
                           checkpoint=args.checkpoint, resume=args.resume,
-                          out=args.out,
+                          out=args.out, profile=args.profile,
                           log=lambda *a: print(*a, flush=True))
     st = bundle.get("stats", {})
     print(json.dumps({"bundle": args.out, "digest": bundle.get("digest"),
                       "version": bundle.get("version"),
+                      "profile": bundle.get("profile"),
                       "decisions": len(bundle.get("decisions") or {}),
                       "warm_start": len(bundle.get("warm_start") or ()),
                       "pruned": len(bundle.get("pruned") or ()),

@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""XProf report CLI — render a device-truth profile capture.
+
+Usage::
+
+    python tools/xprof_report.py /tmp/xprof_cap
+    python tools/xprof_report.py /tmp/xprof_cap/xprof_getrf.json
+    python tools/xprof_report.py trace.json.gz --routine getrf
+    python tools/xprof_report.py /tmp/xprof_cap --json
+
+The argument is anything ``slate_tpu/perf/xprof.py`` can load: a
+capture directory (``SLATE_TPU_XPROF=<dir>`` — the newest
+``xprof_*.json`` artifact wins, falling back to the newest raw trace
+underneath), a single ``xprof_*.json`` artifact, or a raw
+``*.trace.json[.gz]`` trace-event file straight out of
+``jax.profiler.start_trace``.
+
+Printed, in order: the capture header (label, digest, capture wall,
+HBM high-water and compile ledger when the artifact carries them), a
+per-kernel device-time table ranked by total device seconds with each
+kernel's joined (op, stage) bucket, and the per-routine stage rollup —
+the same ``stages`` map ``attr.attribute`` joins as its
+``device_profile`` compute source.  ``--routine`` filters both tables
+to one op; ``--json`` emits the loaded profile verbatim for scripting.
+
+Stdlib-only, like ``bench_diff.py`` / ``gap_report.py``: the parser is
+loaded directly by file path, so this tool NEVER imports jax and runs
+anywhere in milliseconds.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def _load_xprof():
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.normpath(os.path.join(
+        here, os.pardir, "slate_tpu", "perf", "xprof.py"))
+    alias = "_slate_tpu_xprof"
+    if alias in sys.modules:
+        return sys.modules[alias]
+    spec = importlib.util.spec_from_file_location(alias, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[alias] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_s(v) -> str:
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return "-"
+    if v >= 1.0:
+        return "%.3f s" % v
+    if v >= 1e-3:
+        return "%.3f ms" % (v * 1e3)
+    return "%.1f us" % (v * 1e6)
+
+
+def _header(prof: dict) -> list:
+    lines = ["xprof capture: %s" % (prof.get("label") or "(unlabelled)")]
+    lines.append("  digest %s  events %s  trace %s"
+                 % (prof.get("digest", "-"), prof.get("events", "-"),
+                    os.path.basename(str(prof.get("trace_path") or "-"))))
+    if prof.get("capture_wall_s") is not None:
+        lines.append("  capture wall %s (includes trace start/stop "
+                     "overhead)" % _fmt_s(prof["capture_wall_s"]))
+    mem = prof.get("memory") or {}
+    if mem.get("hbm_peak_gb") is not None:
+        lines.append("  hbm high-water +%.3f GB over the capture"
+                     % float(mem["hbm_peak_gb"]))
+    comp = prof.get("compile") or {}
+    if comp.get("events"):
+        lines.append("  compiles during capture: %d (%s)"
+                     % (comp["events"], _fmt_s(comp.get("total_s"))))
+    return lines
+
+
+def main(argv=None) -> int:
+    xp = _load_xprof()
+    ap = argparse.ArgumentParser(
+        prog="xprof_report.py",
+        description="Render an xprof capture: per-kernel device times "
+                    "and the per-routine stage rollup.")
+    ap.add_argument("path", help="capture dir, xprof_*.json artifact, "
+                                 "or raw *.trace.json[.gz]")
+    ap.add_argument("--routine", default="",
+                    help="only kernels/stages joined to this op")
+    ap.add_argument("--kernels", type=int, default=20,
+                    help="kernel-table row limit (default %(default)s; "
+                         "0 = all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the loaded profile as JSON and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        prof = xp.load_profile(args.path)
+    except Exception as e:
+        print("xprof_report: cannot load %s: %s" % (args.path, e),
+              file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(prof, indent=1, sort_keys=True))
+        return 0
+
+    for line in _header(prof):
+        print(line)
+
+    kernels = [k for k in (prof.get("kernels") or ())
+               if not args.routine or k.get("op") == args.routine]
+    print()
+    if kernels:
+        shown = kernels if args.kernels <= 0 else kernels[:args.kernels]
+        total = sum(float(k.get("total_s") or 0.0) for k in kernels)
+        print("kernels (%d%s, %s device total):"
+              % (len(kernels),
+                 "" if len(shown) == len(kernels)
+                 else ", top %d shown" % len(shown),
+                 _fmt_s(total)))
+        print("  %10s %6s  %-14s %s"
+              % ("device", "count", "op.stage", "kernel"))
+        for k in shown:
+            bucket = ("%s.%s" % (k["op"], k["stage"])
+                      if k.get("op") else "-")
+            print("  %10s %6d  %-14s %s"
+                  % (_fmt_s(k.get("total_s")), int(k.get("count") or 0),
+                     bucket, str(k.get("name", ""))[:60]))
+    else:
+        print("kernels: none%s" % (" for routine %r" % args.routine
+                                   if args.routine else ""))
+
+    stages = prof.get("stages") or {}
+    src = prof.get("stage_source") or {}
+    print()
+    printed = 0
+    for op in sorted(stages):
+        if args.routine and op != args.routine:
+            continue
+        m = stages[op]
+        op_total = sum(float(v) for v in m.values())
+        print("stage rollup: %s (%s device)" % (op, _fmt_s(op_total)))
+        for st, v in sorted(m.items(), key=lambda kv: -float(kv[1])):
+            tag = (src.get(op) or {}).get(st, "kernels")
+            pct = 100.0 * float(v) / op_total if op_total > 0 else 0.0
+            print("  %10s %5.1f%%  %-10s [%s]"
+                  % (_fmt_s(v), pct, st, tag))
+        printed += 1
+    if not printed:
+        print("stage rollup: none%s" % (" for routine %r" % args.routine
+                                        if args.routine else ""))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
