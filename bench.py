@@ -118,7 +118,9 @@ DERIVED_SUFFIXES = ("_frac_of_gemm", "_frac_of_split_gemm",
 
 #: everything a gemm-fraction would be unit salad for: wall seconds,
 #: speedup ratios, and the derived families above.
-NON_RATE_SUFFIXES = ("_s", "_speedup_vs_loop") + DERIVED_SUFFIXES
+NON_RATE_SUFFIXES = ("_s", "_speedup_vs_loop", "_rps",
+                     "_slo_violations",
+                     "_speedup_vs_single") + DERIVED_SUFFIXES
 
 #: per-routine wall-clock deadline (seconds).  Each routine runs under
 #: its own SIGALRM watchdog so ONE hung kernel (the round-5 lesson:
@@ -526,6 +528,177 @@ def bench_serve(on_tpu, n=None, nreq=None, max_batch=16):
         extra[label + "_p50_ms"] = round(qs[0.5], 3)
         extra[label + "_p99_ms"] = round(qs[0.99], 3)
     return label, gf, resid, extra
+
+
+def bench_serve_fleet(on_tpu, nreq=None):
+    """Fleet-router throughput under chaos (ISSUE 20): open-loop
+    mixed-shape posv load against :class:`slate_tpu.serve.Router` —
+    first a single-replica baseline, then the full fleet with a fault
+    plan killing one replica MID-RUN.  Emits the sustained
+    ``serve_fleet_rps`` (and its ``_speedup_vs_single`` ratio — the
+    ≥ 2× acceptance sentinel), client-observed ``_p50_ms``/``_p99_ms``,
+    and a ``_slo_violations`` sentinel counted over a POST-RECOVERY
+    wave (the elastic-degradation claim: after drain → reverify →
+    rejoin the fleet serves clean again).  Every answer is
+    residual-gated; the routine's gf number is the served-solves
+    GFLOP/s of the fleet phase.
+
+    Off-TPU the host has no accelerator, so each dispatch carries an
+    EMULATED device wall — the injection system's ``slow`` hook sleeps
+    ``SLATE_TPU_FAULT_SLOW_S`` (default 50 ms) inside every dispatch,
+    identically in both phases.  That is the quantity fleet serving
+    exists to overlap (a real TPU batch blocks its dispatcher thread
+    for the device wall the same way), and what makes the speedup
+    measurable on a single-core CI host; on TPU no emulation is
+    installed and the real device walls carry the comparison."""
+    import threading as _threading
+
+    import jax
+
+    from slate_tpu.perf import blackbox as _bb
+    from slate_tpu.perf import metrics as _metrics
+    from slate_tpu.perf import telemetry
+    from slate_tpu.resilience import inject
+    from slate_tpu.serve import FleetConfig, Router, ServeConfig
+
+    ndev = len(jax.devices())
+    nrep = min(4, ndev)
+    shapes = (96, 64, 48) if on_tpu else (48, 32, 24)
+    nreq = nreq or (256 if on_tpu else 96)
+    slo_ms = 2000.0
+    rng = np.random.default_rng(33)
+    probs = {}
+    for n in shapes:
+        g = rng.standard_normal((n, n)).astype(np.float32)
+        probs[n] = (g @ g.T + n * np.eye(n, dtype=np.float32),
+                    rng.standard_normal(n).astype(np.float32))
+
+    def _check(n, x):
+        a, b = probs[n]
+        eps = float(np.finfo(np.float32).eps)
+        return (np.linalg.norm(a @ x - b)
+                / (np.linalg.norm(a) * np.linalg.norm(b) * eps * n))
+
+    # the emulated device wall (see docstring): active through BOTH
+    # phases off-TPU, absent on real hardware
+    base_plan = "" if on_tpu else "serve.dispatch=slow:1.0"
+
+    def run_phase(router, count, fault_plan=None):
+        """Submit ``count`` mixed-shape requests from 4 open-loop
+        submitters (optionally arming the chaos plan halfway), resolve
+        them all, and return (wall_s, latencies, worst_resid)."""
+        lat = [0.0] * count
+        futs = [None] * count
+        fault_at = count // 2 if fault_plan else None
+
+        def worker(base):
+            for i in range(base, count, 4):
+                if fault_at is not None and i == fault_at:
+                    inject.install(inject.parse_plan(fault_plan))
+                n = shapes[i % len(shapes)]
+                a, b = probs[n]
+                ts = time.perf_counter()
+                f = router.submit("posv", a, b)
+                futs[i] = (f, ts, n)
+
+        t0 = time.perf_counter()
+        threads = [_threading.Thread(target=worker, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        worst = 0.0
+        for i, (f, ts, n) in enumerate(futs):
+            x = np.asarray(f.result(timeout=900))
+            lat[i] = time.perf_counter() - ts
+            if i % 8 == 0:              # gate a sample of every shape
+                worst = max(worst, _check(n, x))
+        return time.perf_counter() - t0, lat, worst
+
+    cfg = ServeConfig(max_batch=4, max_wait_s=0.002, slo_ms=slo_ms)
+    was_on = telemetry.enabled()
+    was_metrics = _metrics.enabled()
+    was_bb = _bb.enabled()
+    telemetry.on()
+    _bb.on()
+    worst = 0.0
+    try:
+        # every phase serves pre-warmed (the cold-start story is the
+        # PR 11 bundle's, measured elsewhere): the rps numbers compare
+        # SERVING, not per-replica executable compiles
+        warm_specs = [{"op": "posv", "batch": cfg.max_batch,
+                       "dims": (n,), "dtype": "float32"}
+                      for n in shapes]
+        # phase 1: the single-replica baseline
+        single = Router(FleetConfig(replicas=1, serve=cfg,
+                                    enable_sharded=False))
+        try:
+            single.warm_start(specs=warm_specs)
+            single.submit("posv", *probs[shapes[0]]).result(timeout=900)
+            if base_plan:
+                inject.install(inject.parse_plan(base_plan))
+            wall1, _, r1 = run_phase(single, nreq)
+        finally:
+            single.close()
+            inject.clear_plan()
+        worst = max(worst, r1)
+        rps_single = nreq / wall1
+        # phase 2: the fleet, one replica killed mid-run
+        fleet = Router(FleetConfig(replicas=nrep, serve=cfg,
+                                   enable_sharded=False,
+                                   cooldown_s=0.05))
+        try:
+            fleet.warm_start(specs=warm_specs)
+            for n in shapes:
+                fleet.submit("posv", *probs[n]).result(timeout=900)
+            if base_plan:
+                inject.install(inject.parse_plan(base_plan))
+            wall2, lat, r2 = run_phase(
+                fleet, nreq,
+                fault_plan=(base_plan + "," if base_plan else "")
+                + "fleet.replica1=device_loss:1.0:2")
+            worst = max(worst, r2)
+            # post-recovery wave: wait out the rejoin, then count SLO
+            # violations over a fresh delta — the ~0 sentinel
+            deadline = time.perf_counter() + 30.0
+            while (fleet.replica_states().count("closed") < nrep
+                   and time.perf_counter() < deadline):
+                time.sleep(0.05)
+            before = _metrics.snapshot()
+            wall3, _, r3 = run_phase(fleet, max(16, nreq // 4))
+            worst = max(worst, r3)
+            delta = _metrics.snapshot_delta(before, _metrics.snapshot())
+            viol = (delta.get("counters") or {}).get(
+                "serve.slo.violations", 0.0)
+        finally:
+            fleet.close()
+            inject.clear_plan()
+    finally:
+        if not was_bb:
+            _bb.off()
+        if not was_on:
+            telemetry.off()
+        if not was_metrics:
+            _metrics.off()
+    lat.sort()
+    rps = nreq / wall2
+    flops = sum((shapes[i % len(shapes)] ** 3 / 3.0
+                 + 2.0 * shapes[i % len(shapes)] ** 2)
+                for i in range(nreq))
+    gf = flops / wall2 / 1e9
+    label = "serve_fleet_fp32"
+    extra = {
+        label + "_rps": round(rps, 2),
+        label + "_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+        label + "_p99_ms": round(lat[min(len(lat) - 1,
+                                         int(len(lat) * 0.99))] * 1e3,
+                                 3),
+        label + "_slo_violations": float(viol),
+        label + "_speedup_vs_single": round(rps / max(rps_single, 1e-9),
+                                            3),
+    }
+    return label, gf, worst, extra
 
 
 #: per-stage wall-time attribution for the two-stage eig/SVD pipelines:
@@ -1637,6 +1810,7 @@ def main():
         ("batched_posv", lambda: bench_batched_posv(on_tpu), False),
         ("batched_gesv", lambda: bench_batched_gesv(on_tpu), False),
         ("serve_posv", lambda: bench_serve(on_tpu), False),
+        ("serve_fleet", lambda: bench_serve_fleet(on_tpu), True),
         ("getrf_ooc", bench_getrf_ooc, True),
         ("potrf_ooc", bench_potrf_ooc, True),
         ("heev_fp32", bench_heev32, True),

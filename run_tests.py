@@ -735,6 +735,38 @@ def qdwh_smoke() -> int:
     return 1
 
 
+def fleet_smoke() -> int:
+    """The --fleet tier (ISSUE 20): the full fleet-serving suite —
+    including the heavy drain/rejoin and throughput tests the fast
+    tier skips (``@pytest.mark.slow``) — on an 8-way virtual CPU mesh
+    in a fresh subprocess.  Green means the cost-model router, the
+    ICI-sharded big-problem lane, priority preemption and the
+    device-loss drain → reverify → rejoin ladder all hold end to end."""
+    here = pathlib.Path(__file__).resolve().parent
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if "xla_force_host_platform_device_count" \
+            not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    for k in ("SLATE_TPU_AUTOTUNE_FORCE", "SLATE_TPU_AUTOTUNE_BUNDLE",
+              "SLATE_TPU_FAULT_INJECT", "SLATE_TPU_FLEET_REPLICAS"):
+        env.pop(k, None)
+    cmd = [sys.executable, "-m", "pytest", "tests/test_fleet.py", "-q",
+           "--runslow", "-p", "no:cacheprovider"]
+    print("=== fleet tier: " + " ".join(cmd), flush=True)
+    try:
+        rc = subprocess.run(cmd, env=env, cwd=str(here),
+                            timeout=1800).returncode
+    except subprocess.TimeoutExpired:
+        rc = 124
+    if rc == 0:
+        print("==== fleet smoke passed ====")
+        return 0
+    print("==== fleet smoke FAILED (rc=%d) ====" % rc)
+    return 1
+
+
 def sweep_smoke() -> int:
     """The --sweep tier: tiny CPU grid end-to-end through the CLI in a
     subprocess (sweep → versioned bundle artifact), then a second fresh
@@ -1015,6 +1047,13 @@ def main(argv=None):
                     "under injected corruption and dispatch falls "
                     "back to twostage (see docs/usage.md QDWH "
                     "spectral tier)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet-serving suite: the full "
+                    "tests/test_fleet.py sweep (including the heavy "
+                    "drain/rejoin and throughput tests the fast tier "
+                    "skips) on an 8-way virtual CPU mesh — router, "
+                    "sharded lane, preemption, device-loss recovery "
+                    "(see docs/usage.md Fleet serving)")
     ap.add_argument("--xprof", action="store_true",
                     help="device-truth profiling smoke: real capture "
                     "around a composed getrf on CPU "
@@ -1046,6 +1085,9 @@ def main(argv=None):
 
     if args.qdwh:
         return qdwh_smoke()
+
+    if args.fleet:
+        return fleet_smoke()
 
     if args.xprof:
         return xprof_smoke()

@@ -47,7 +47,7 @@ from ..perf.metrics import instrument_driver
 __all__ = [
     "potrf_batched", "potrs_batched", "posv_batched",
     "getrf_batched", "getrs_batched", "gesv_batched",
-    "geqrf_batched", "gels_batched",
+    "geqrf_batched", "gels_batched", "heev_batched",
 ]
 
 
@@ -328,3 +328,24 @@ def gels_batched(a, b, opts: Optional[Options] = None):
     qtb = jnp.matmul(jnp.swapaxes(q, -1, -2), bv)
     x = lax.linalg.triangular_solve(r, qtb, left_side=True, lower=False)
     return x[:, :, 0] if squeeze else x
+
+
+@instrument_driver("heev_batched")
+def heev_batched(a, opts: Optional[Options] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Batched Hermitian eigensolver: ``a`` (B, n, n) → ``(w, z)`` with
+    per-problem eigenvalues ascending (B, n) and eigenvectors in the
+    columns of ``z`` (B, n, n) — the batched-drivers gap ROADMAP item 3
+    names, closing the served surface (ISSUE 20).  Registered through
+    the ``batched_heev`` site (single vmapped candidate — XLA's
+    natively batched ``eigh`` — today, like ``batched_qr``), so the
+    serving layer's warm start can enumerate its buckets and a
+    grid-batched spectral candidate can arbitrate here later."""
+
+    av = _check_batched(a, "heev_batched")
+    bsz, n, _ = av.shape
+    metrics.inc("batched.problems", float(bsz))
+    from ..method import select_backend
+    select_backend("batched_heev", b=bsz, n=n, dtype=av.dtype)
+    w, z = jnp.linalg.eigh(av)
+    return w, z

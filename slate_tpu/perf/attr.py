@@ -63,8 +63,8 @@ __all__ = [
     "DEFAULT_NB", "attribute", "attribute_live",
     "expected_hbm_roundtrips", "explain_pair", "format_report",
     "fusion_from_autotune", "model_flops", "parse_label", "peaks",
-    "predict_seconds", "record_rooflines", "stage_model",
-    "stage_timers",
+    "predict_request_seconds", "predict_seconds", "record_rooflines",
+    "stage_model", "stage_timers",
 ]
 
 #: panel width assumed when the submetric label carries no ``nb`` token
@@ -566,6 +566,47 @@ def predict_seconds(routine: str, dims: dict, dtype: str = "fp32",
     rt_bytes = 2.0 * n * nb * isz
     t += launch_s + rts * (launch_s + rt_bytes / (pk["hbm_gbs"] * 1e9))
     return t
+
+
+#: serve-surface op (``serve/queue.py``'s SUPPORTED_OPS) → the stage
+#: model routine pricing one such problem — the fleet router's cost
+#: vocabulary (ISSUE 20)
+_SERVE_ROUTINES = {"potrf": "potrf", "getrf": "getrf", "posv": "posv",
+                   "gesv": "gesv", "geqrf": "geqrf", "gels": "gels",
+                   "heev": "heev"}
+
+
+def predict_request_seconds(op: str, dims, nrhs: int = 1,
+                            dtype: str = "fp32", batch: int = 1,
+                            platform: str = "tpu") -> float:
+    """Model-predicted wall seconds for ONE serve-surface request
+    batch — the fleet router's analytical cost model: placement
+    compares each replica's ``queue backlog × this`` against the
+    ICI-sharded lane without timing anything (BLASX's cost-model
+    scheduling stance).  ``op`` is a serve op name, ``dims`` the RAW
+    problem dims ((n,) square, (m, n) tall).  Always returns a
+    positive float: when the stage model abstains, a crude
+    flops-over-peak bound (plus a launch floor) keeps the router's
+    argmin ordered instead of crashing placement."""
+    routine = _SERVE_ROUTINES.get(op)
+    if routine is None:
+        raise KeyError(f"unknown serve op {op!r}; "
+                       f"known: {sorted(_SERVE_ROUTINES)}")
+    dims = tuple(int(d) for d in (dims if isinstance(dims, (tuple, list))
+                                  else (dims,)))
+    d = {"b": max(1, int(batch))}
+    if op in ("geqrf", "gels"):
+        d["m"], d["n"] = dims
+    else:
+        d["n"] = dims[0]
+    if op in ("posv", "gesv", "gels"):
+        d["k"] = max(1, int(nrhs))
+    t = predict_seconds(routine, d, dtype=dtype, platform=platform)
+    if t is not None and t > 0.0:
+        return float(t)
+    fl = model_flops(routine, d) or (2.0 * dims[0] ** 3)
+    pk = peaks(platform, dtype)
+    return float(fl / (pk["tflops"] * 1e12) + 2e-5)
 
 
 def expected_hbm_roundtrips(routine: str, dims: dict,

@@ -76,7 +76,8 @@ __all__ = [
     "choose_geqrf_panel", "choose_chase", "choose_lu_step",
     "choose_potrf_step", "choose_dist_panel", "choose_dist_pivot",
     "choose_dist_chunk", "choose_dist_lookahead", "choose_batched_potrf",
-    "choose_batched_lu", "choose_batched_qr",
+    "choose_batched_lu", "choose_batched_qr", "choose_batched_heev",
+    "choose_route",
 ]
 
 #: timed repetitions per surviving candidate (after the compile/warm rep)
@@ -1928,6 +1929,90 @@ def choose_batched_qr(b: int, m: int, n: int, dtype) -> str:
     return decide("batched_qr", key, [Candidate("vmapped", setup_vmapped)])
 
 
+def choose_batched_heev(b: int, n: int, dtype) -> str:
+    """Backend for the leading-batch-dim Hermitian eigensolver
+    (ISSUE 20 — batched heev joins the served surface): today a single
+    candidate (``"vmapped"`` — XLA's natively batched ``eigh``),
+    registered through the table like ``batched_qr`` so the site is
+    enumerable, its cache keys warm the serving ``heev`` buckets
+    (``serve.queue._SITE_TO_OPS``), and a grid-batched spectral
+    candidate can arbitrate here later without touching the call
+    sites."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (_bucket_dim(b), _bucket_dim(n), dt.name, _precision_name())
+
+    def setup_vmapped():
+        def run(x):
+            w, _ = jnp.linalg.eigh(x)
+            return w
+        a = _randn((key[0], key[1], key[1]), dt, 23)
+        spd = jnp.matmul(a, jnp.conj(jnp.swapaxes(a, -1, -2)))
+        return _timed_call(run, spd)
+
+    return decide("batched_heev", key,
+                  [Candidate("vmapped", setup_vmapped)])
+
+
+def _route_crossover_s() -> float:
+    """The replica→sharded crossover in model wall seconds
+    (``SLATE_TPU_FLEET_SHARD_MS``, default 25 ms): a problem whose
+    single-chip predicted wall exceeds this is worth the ICI-sharded
+    lane's collective overhead."""
+    try:
+        return float(os.environ.get("SLATE_TPU_FLEET_SHARD_MS",
+                                    "") or 25.0) * 1e-3
+    except ValueError:
+        return 25e-3
+
+
+def choose_route(op: str, n: int, ndev: int, dtype) -> str:
+    """Fleet placement for ONE served problem (ISSUE 20):
+    ``"replica"`` (data-parallel — the per-device BatchQueue whose
+    predicted completion is shortest) vs ``"sharded"`` (the dedicated
+    ICI lane through the PR 13 p* drivers — pposv/pgesv/pgels).
+
+    Like ``choose_ooc``/``dist_chunk`` this site resolves
+    ANALYTICALLY under ``auto``: a timing rep at genuinely
+    sharded-worthy dims is itself a multi-second distributed
+    factorization, so the heuristic compares the single-chip
+    :func:`slate_tpu.perf.attr.predict_seconds` wall against the
+    crossover knob (``SLATE_TPU_FLEET_SHARD_MS``).  The bundle
+    resolution ladder (:func:`_default`) outranks the heuristic — an
+    offline sweep that TIMED the crossover on matching hardware ships
+    the decision in the PR 11 bundle, so a fresh fleet routes its
+    first request with zero probes.  ``n`` is the problem's dominant
+    dim (rows for gels)."""
+
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype)
+    key = (op, _bucket_dim(n), dt.name, max(1, int(ndev)),
+           _precision_name())
+    if ndev <= 1 or op not in ("posv", "gesv", "gels"):
+        # potrf/getrf/geqrf/heev serve factor-only outputs the dist
+        # lane has no undistribute story for yet; single-device fleets
+        # have no lane to shard across
+        return _static("route", key, "replica", "ineligible")
+    forced = _forced("route")
+    if forced in ("replica", "sharded"):
+        return _static("route", key, forced, "forced")
+    from . import attr
+
+    routine = {"posv": "posv", "gesv": "gesv", "gels": "gels"}[op]
+    dims = {"m": n, "n": n} if op == "gels" else {"n": n, "k": 1}
+    short = {"float32": "fp32", "float64": "fp64",
+             "complex64": "c64", "complex128": "c128"}.get(dt.name,
+                                                           "fp32")
+    plat = "tpu" if _on_tpu() else "cpu"
+    t1 = attr.predict_seconds(routine, dims, dtype=short, platform=plat)
+    fallback = ("sharded" if t1 is not None
+                and t1 >= _route_crossover_s() else "replica")
+    return _default("route", key, ("replica", "sharded"), fallback)
+
+
 def _spectral_residual_ok(a, w, z, n: int, dt) -> bool:
     """Probe gate shared by the eig/svd driver sites: eigen residual
     ‖A·Z − Z·Λ‖ and orthogonality ‖ZᴴZ − I‖, both scaled by ε·n (the
@@ -2185,6 +2270,11 @@ _CHOOSERS = {
         kw["b"], kw["n"], kw["dtype"], kw["eligible"]),
     "batched_qr": lambda **kw: choose_batched_qr(
         kw["b"], kw["m"], kw["n"], kw["dtype"]),
+    "batched_heev": lambda **kw: choose_batched_heev(
+        kw["b"], kw["n"], kw["dtype"]),
+    "route": lambda **kw: choose_route(kw["serve_op"], kw["n"],
+                                       kw["ndev"],
+                                       kw["dtype"]),
     "eig_driver": lambda **kw: choose_eig_driver(kw["n"], kw["dtype"],
                                                  kw["eligible"]),
     "svd_driver": lambda **kw: choose_svd_driver(kw["m"], kw["n"],

@@ -153,8 +153,15 @@ def direction(label: str) -> float:
     ``*_over_floor`` sentinel (split rate ÷ stock rate ÷ 1.5× floor —
     judged REGRESS below 1.0 even single-artifact, see
     ``_floor_override``) are both bigger-is-better, the +1 default."""
-    if label.endswith("_per_s"):
+    if label.endswith(("_per_s", "_rps")):
+        # _rps (ISSUE 20): the fleet router's sustained requests per
+        # second — a rate despite the trailing "s"
         return 1.0
+    if label.endswith("_slo_violations"):
+        # the fleet chaos run's post-recovery SLO-violation sentinel
+        # (ISSUE 20): ~0 after a clean rejoin — any rise is the
+        # degradation ladder failing to re-absorb traffic
+        return -1.0
     if label.endswith(("_ms", "_hbm_roundtrips", "_abft_overhead_pct",
                        "_host_gb_transferred", "_hbm_peak_gb")):
         # _host_gb_transferred (ISSUE 17): GB moved over the host link
@@ -395,7 +402,11 @@ def _num(v, label: str = "") -> Optional[float]:
         # sentinel must see
         return float(v)
     if label.endswith(("_hbm_roundtrips", "_over_floor",
-                       "_host_gb_transferred", "_hbm_peak_gb")):
+                       "_host_gb_transferred", "_hbm_peak_gb",
+                       "_slo_violations")):
+        # _slo_violations: zero IS the healthy post-recovery reading
+        # (ISSUE 20) — dropping it would hide the one value the
+        # sentinel exists to pin
         # structural counts (steady state 0), floor-sentinel ratios (a
         # total efficiency collapse IS 0), host-link byte odometers
         # (an all-resident window legitimately moves ~0 GB) and HBM

@@ -85,7 +85,8 @@ __all__ = [
     "add_hook", "remove_hook", "close", "configure_sentinel",
     "default_slo_ms", "drain_spans", "enabled", "exporter_port",
     "log_record", "maybe_start", "new_trace_id",
-    "observe_dispatch_error", "observe_request", "off", "on",
+    "observe_dispatch_error", "observe_fleet", "observe_request",
+    "off", "on",
     "percentiles", "prometheus_text", "quantiles_from_buckets",
     "record_span", "sentinel", "short_dtype", "spans", "start_exporter",
     "start_log", "stop_exporter", "trip_wanted",
@@ -278,6 +279,47 @@ def observe_request(op: str, bucket: str, latency_s: float,
                slo_violation=bool(viol), batch=int(batch))
     sentinel().observe(op, bucket, latency_s, error=error, batch=batch,
                        key=key, dtype=dtype, n=n)
+
+
+def observe_fleet(event: str, replica: Optional[int] = None,
+                  lane: Optional[str] = None, op: Optional[str] = None,
+                  latency_s: Optional[float] = None,
+                  error: bool = False, **fields) -> None:
+    """One fleet-router observation (ISSUE 20) into the counters + the
+    JSONL log.  ``event`` is the record vocabulary the ``--fleet``
+    report rolls up:
+
+    * ``"request"`` — one routed request's final outcome (fields:
+      ``replica`` OR ``lane="sharded"``, ``op``, ``latency_s``,
+      ``error``) → per-replica req/s + p99 + the replica/sharded split.
+    * ``"breaker"`` — a replica availability transition (fields:
+      ``replica``, ``state`` in closed/open/half_open) → the incident
+      timeline.
+    * anything else — counted and logged verbatim (``preempt``,
+      ``drain``, ``rejoin``...).
+
+    One attribute read when telemetry is off — the router calls this
+    unconditionally."""
+    if not _state.enabled:
+        return
+    metrics.inc("fleet.%s" % event)
+    if error:
+        metrics.inc("fleet.%s.errors" % event)
+    rec: dict = {"error": bool(error)} if event == "request" else {}
+    if replica is not None:
+        rec["replica"] = int(replica)
+    if lane is not None:
+        rec["lane"] = str(lane)
+    if op is not None:
+        rec["op"] = str(op)
+    if latency_s is not None:
+        ms = float(latency_s) * 1e3
+        rec["latency_ms"] = round(ms, 3)
+        if event == "request" and not error:
+            metrics.observe("fleet.latency_ms.%s" % (lane or "replica"),
+                            ms)
+    rec.update(fields)
+    log_record("fleet_%s" % event, **rec)
 
 
 def observe_dispatch_error(op: str, bucket: str,
@@ -531,7 +573,7 @@ def log_record(kind: str, **fields) -> None:
 #: counter/gauge prefixes worth streaming into the JSONL snapshots (the
 #: full registry would dominate the log; the serving story lives here)
 _SNAP_PREFIXES = ("serve.", "telemetry.", "resilience.", "jit.",
-                  "xprof.")
+                  "xprof.", "fleet.")
 
 
 def _snapshot_record() -> dict:

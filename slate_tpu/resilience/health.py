@@ -46,7 +46,7 @@ from .inject import iter_leaves
 
 __all__ = [
     "ENV_HEALTH", "MODES", "driver_gate", "mode", "quarantine_driver",
-    "register_residual", "safe_backend",
+    "register_residual", "reverify", "safe_backend",
 ]
 
 ENV_HEALTH = "SLATE_TPU_HEALTH"
@@ -100,6 +100,45 @@ def safe_backend():
         finally:
             (config.use_pallas, config.f64_mxu, config.scattered_lu,
              config.split_gemm) = saved
+
+
+def reverify(n: int = 16, dtype="float32", device=None) -> bool:
+    """Post-device-loss re-verification probe (the fleet router's
+    half-open rejoin gate, ISSUE 20): factor a small known-good SPD
+    problem ON the suspect device and gate its scaled Cholesky residual
+    — the same ABFT-style "check the arithmetic, not just liveness"
+    stance PR 14 takes inside a factorization.  Returns True when the
+    device produced a finite, residual-clean answer; False on ANY
+    failure (a dead or poisoned device must read as unhealthy, never
+    raise into the recovery thread)."""
+    import numpy as np
+
+    try:
+        import contextlib
+
+        import jax
+        import jax.numpy as jnp
+
+        scope = (jax.default_device(device) if device is not None
+                 else contextlib.nullcontext())
+        rng = np.random.default_rng(0)
+        g = rng.standard_normal((n, n)).astype(dtype)
+        a = g @ g.T + n * np.eye(n, dtype=dtype)
+        with scope:
+            l = np.asarray(jnp.linalg.cholesky(jnp.asarray(a)))
+        if not np.isfinite(l).all():
+            metrics.inc("resilience.reverify.fail")
+            return False
+        eps = float(np.finfo(np.dtype(dtype)).eps)
+        r = (np.linalg.norm(np.tril(l) @ np.tril(l).T - a)
+             / (np.linalg.norm(a) * eps * n))
+        ok = bool(r < 100.0)
+        metrics.inc("resilience.reverify.ok" if ok
+                    else "resilience.reverify.fail")
+        return ok
+    except Exception:
+        metrics.inc("resilience.reverify.fail")
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,11 @@ def transient_infra(e: BaseException) -> bool:
 
     if isinstance(e, InjectedFault):
         return True
+    if getattr(e, "retryable", False):
+        # an explicit self-declared retryable signal (serve.Preempted:
+        # the evicted request was never dispatched, resubmitting is
+        # always safe)
+        return True
     if isinstance(e, _NEVER_TRANSIENT):
         return False
     if isinstance(e, (OSError, TimeoutError, ConnectionError)):

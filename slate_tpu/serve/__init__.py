@@ -23,10 +23,17 @@ flow tracing, SLO latency histograms, the Prometheus/JSONL streaming
 exporters and the in-process live sentinel — rides along through
 :mod:`slate_tpu.perf.telemetry` (all off-by-default; see the "Live
 telemetry" section of ``docs/usage.md``).
+
+The fleet tier (ISSUE 20, :mod:`slate_tpu.serve.fleet`) scales the
+front door across devices: a cost-model :class:`Router` over
+per-device BatchQueue replicas with an ICI-sharded big-problem lane,
+priority preemption, and device-loss drain/rejoin — see its module
+docstring.
 """
 
+from .fleet import FleetConfig, Router  # noqa: F401
 from .queue import (  # noqa: F401
-    Backpressure, BatchQueue, ServeConfig, SUPPORTED_OPS, get_server,
-    shutdown, specs_from_autotune_cache, specs_from_bundle, submit,
-    warm_start,
+    Backpressure, BatchQueue, Preempted, ServeConfig, SUPPORTED_OPS,
+    get_server, shutdown, specs_from_autotune_cache, specs_from_bundle,
+    submit, warm_start,
 )

@@ -1,0 +1,580 @@
+"""Fleet serving: a cost-model router over per-device BatchQueue
+replicas with an ICI-sharded big-problem lane and elastic degradation
+(ISSUE 20 — ROADMAP item 3's "many chips, one front door").
+
+One :class:`BatchQueue` (the single-chip front door, PRs 8–11) serves
+one device.  A :class:`Router` fronts N of them — one per
+``jax.devices()`` entry (CPU/virtual-device meshes included, so the
+whole fleet is testable off-TPU) — and places every request from the
+ANALYTICAL cost model instead of round-robin (BLASX's multi-device
+L3-BLAS scheduling stance, PAPERS.md):
+
+* **Small problems go data-parallel**: each replica carries a running
+  ``backlog_s`` — the sum of :func:`slate_tpu.perf.attr.
+  predict_request_seconds` over its queued-not-resolved requests — and
+  a submit lands on the replica with the shortest predicted completion
+  (backlog + this request's predicted wall).  No timing, no probes:
+  the model IS the placement signal.
+* **Large problems take the sharded lane**: past the autotuned
+  ``route`` crossover (:func:`slate_tpu.perf.autotune.choose_route`,
+  resolvable from the PR 11 bundle so a fresh fleet routes its first
+  request with zero probes) a posv/gesv/gels request bypasses the
+  replicas entirely and runs ONE ICI-sharded solve through the PR 13
+  p* drivers (pposv/pgesv/pgels) on the process mesh — replicating a
+  multi-second factorization per chip is the one thing a fleet must
+  never do (FlatAttention's fabric-collective co-optimization,
+  PAPERS.md).
+
+**Priority classes + preemption** ride the PR 9 backpressure
+machinery: a high-priority submit that meets :class:`Backpressure`
+evicts queued-not-dispatched lower-priority work
+(:meth:`BatchQueue.preempt`) — each victim's future fails with the
+retryable :class:`Preempted` signal, never a silent drop — and then
+retries the submit.
+
+**Elastic degradation** (the drain → recover → rejoin ladder):
+
+1. an injected ``device_loss`` inside replica i's dispatch
+   (``fleet.replica<i>`` injection site) reaches the router through
+   the queue's fault-listener seam BEFORE the retry ladder absorbs
+   it; the replica's fleet-level availability trips ``closed → open``;
+2. the router **drains** the replica's queued-not-dispatched requests
+   (:meth:`BatchQueue.drain_queued`) and re-files each on a healthy
+   replica, chaining the result into the ORIGINAL future — a device
+   loss strands zero futures (in-flight work resolves through the
+   queue's own retry → singles ladder);
+3. a recovery thread cools down, goes ``half_open``, and re-verifies
+   the device with :func:`slate_tpu.resilience.health.reverify` — a
+   known-good SPD factorization ON the suspect device, residual-gated
+   (PR 14's ABFT stance: check the arithmetic, not just liveness);
+   the drained-and-refiled queue state is the serving layer's
+   checkpoint/restart;
+4. on a clean probe the replica **rejoins** (``closed``) and the PR 15
+   flight recorder bundles the whole incident with ONE
+   ``blackbox.trigger("fleet.recovered")`` — the bundle's event ring
+   names the device_loss → drain → rejoin chain.  (The router
+   deliberately does NOT reuse :class:`slate_tpu.resilience.breaker.
+   CircuitBreaker` for replica availability: its trip path dumps a
+   bundle per transition, and an incident must produce exactly one.)
+
+**Cold start**: :meth:`Router.warm_start` distributes the PR 11
+bundle's AOT bucket specs to every replica
+(:func:`slate_tpu.serve.queue.specs_from_bundle`), so a brand-new
+fleet serves its first bucketed request on every replica with zero
+timing reps, zero on-demand compiles, zero probes — the bundle is the
+ONE artifact a fresh process needs.
+
+Importing this module starts nothing; constructing a :class:`Router`
+builds the replica queues but spawns no threads (each BatchQueue's
+dispatcher starts on its first submit; the sharded lane's worker on
+its first sharded request).  Observability flows through the public
+telemetry facade (:func:`slate_tpu.perf.telemetry.observe_fleet` —
+``fleet_request`` / ``fleet_breaker`` JSONL records the
+``telemetry_report.py --fleet`` rollup reads) and ``fleet.*``
+counters; the module touches only the serve/metrics/attr/telemetry/
+health facades (pinned in ``tests/test_backend_registry.py``).
+
+Env knobs (see docs/usage.md "Fleet serving"):
+
+* ``SLATE_TPU_FLEET_REPLICAS`` — cap the replica count (default: one
+  per device).
+* ``SLATE_TPU_FLEET_SHARD_MS`` — the replica→sharded predicted-wall
+  crossover (read by the ``route`` chooser; default 25 ms).
+* ``SLATE_TPU_FLEET_PREEMPT_DEPTH`` — max victims one high-priority
+  submit may evict (default 16).
+* ``SLATE_TPU_FLEET_COOLDOWN_S`` — seconds a lost replica waits
+  before its half-open re-verification probe (default 0.25).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import queue as _pyqueue
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional
+
+from ..exceptions import SlateError
+from ..perf import attr as _attr
+from ..perf import blackbox as _blackbox
+from ..perf import metrics
+from ..perf import telemetry as _telemetry
+from ..resilience import health as _health
+from .queue import (BatchQueue, Backpressure, ServeConfig,
+                    SUPPORTED_OPS, specs_from_autotune_cache,
+                    specs_from_bundle)
+from .queue import warm_start as _queue_warm_start
+
+__all__ = ["FleetConfig", "Router"]
+
+ENV_REPLICAS = "SLATE_TPU_FLEET_REPLICAS"
+ENV_PREEMPT_DEPTH = "SLATE_TPU_FLEET_PREEMPT_DEPTH"
+ENV_COOLDOWN = "SLATE_TPU_FLEET_COOLDOWN_S"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "").strip() or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "").strip() or default)
+    except ValueError:
+        return default
+
+
+@dataclass
+class FleetConfig:
+    """Router policy knobs.
+
+    * ``replicas`` — replica count (None: one per ``jax.devices()``
+      entry, capped by ``SLATE_TPU_FLEET_REPLICAS``).
+    * ``serve`` — the per-replica :class:`ServeConfig` template; the
+      router copies it per replica with ``device`` and the
+      ``fleet.replica<i>`` injection site filled in.
+    * ``enable_sharded`` — let the ``route`` chooser send big
+      posv/gesv/gels problems to the ICI-sharded lane (needs > 1
+      device; off forces everything data-parallel).
+    * ``shard_nb`` — the sharded lane's block size (None: 16 below
+      n=512, else 256 — the p* drivers' defaults at those scales).
+    * ``preempt_depth`` — max victims one high-priority submit may
+      evict on :class:`Backpressure`
+      (``SLATE_TPU_FLEET_PREEMPT_DEPTH``).
+    * ``cooldown_s`` — the open→half_open wait after a device loss
+      (``SLATE_TPU_FLEET_COOLDOWN_S``).
+    * ``rejoin_attempts`` — failed re-verification probes before the
+      replica is left open for good (a ``fleet.degraded`` trigger).
+    """
+
+    replicas: Optional[int] = None
+    serve: ServeConfig = field(default_factory=ServeConfig)
+    enable_sharded: bool = True
+    shard_nb: Optional[int] = None
+    preempt_depth: Optional[int] = None
+    cooldown_s: Optional[float] = None
+    rejoin_attempts: int = 5
+
+
+class _Replica:
+    """One per-device serving lane: a device-pinned BatchQueue plus
+    the router's availability state (closed = serving, open = lost,
+    half_open = probing) and model-predicted backlog accounting."""
+
+    __slots__ = ("idx", "device", "queue", "state", "backlog_s",
+                 "losses")
+
+    def __init__(self, idx: int, device, cfg: ServeConfig):
+        self.idx = idx
+        self.device = device
+        self.queue = BatchQueue(replace(
+            cfg, device=device, inject_site="fleet.replica%d" % idx))
+        self.state = "closed"
+        self.backlog_s = 0.0
+        self.losses = 0
+
+
+class _ShardedLane:
+    """The big-problem lane: a single worker thread running ONE
+    ICI-sharded p* solve at a time on the process mesh.  Serializing
+    is the point — two concurrent whole-mesh factorizations would
+    fight for every chip; queueing behind the lane is the cost model's
+    job to predict."""
+
+    def __init__(self, mesh=None, nb: Optional[int] = None):
+        self._mesh = mesh
+        self._nb = nb
+        self._q: _pyqueue.Queue = _pyqueue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.backlog_s = 0.0
+
+    def submit(self, op: str, operands: tuple,
+               fut: concurrent.futures.Future) -> None:
+        self._q.put((op, operands, fut))
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="slate-fleet-sharded",
+                    daemon=True)
+                self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread = self._thread
+        if thread is not None and thread.is_alive():
+            self._q.put(None)
+            thread.join(timeout=30.0)
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            op, operands, fut = item
+            try:
+                out = self._solve(op, operands)
+                if not fut.done():
+                    fut.set_result(out)
+            except Exception as e:     # one bad solve ≠ a dead lane
+                metrics.inc("fleet.sharded.errors")
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def _solve(self, op: str, operands: tuple):
+        import numpy as np
+
+        from .. import parallel as P
+
+        a, b = operands
+        a = np.asarray(a)
+        bv = np.asarray(b)
+        one_d = bv.ndim == 1
+        if one_d:
+            bv = bv[:, None]
+        mesh = self._mesh if self._mesh is not None else P.default_mesh()
+        n = a.shape[1] if op == "gels" else a.shape[0]
+        nb = self._nb if self._nb else (16 if n < 512 else 256)
+        if op == "posv":
+            _, x = P.pposv(a, bv, mesh, nb=nb)
+        elif op == "gesv":
+            _, _, x = P.pgesv(a, bv, mesh, nb=nb)
+        elif op == "gels":
+            _, _, x = P.pgels(a, bv, mesh, nb=nb)
+        else:
+            raise KeyError(f"op {op!r} has no sharded lane")
+        xd = np.asarray(P.undistribute(x))[:n, :bv.shape[1]]
+        metrics.inc("fleet.sharded.solves")
+        return xd[:, 0] if one_d else xd
+
+
+class Router:
+    """The fleet front door: cost-model placement over per-device
+    replicas, the sharded big-problem lane, priority preemption, and
+    the device-loss drain/rejoin ladder.  See the module docstring."""
+
+    def __init__(self, config: Optional[FleetConfig] = None,
+                 devices=None, mesh=None):
+        import jax
+
+        self.config = config or FleetConfig()
+        devs = list(devices if devices is not None else jax.devices())
+        want = self.config.replicas
+        if want is None:
+            want = _env_int(ENV_REPLICAS, len(devs))
+        devs = devs[:max(1, int(want))]
+        self._lock = threading.Lock()
+        self._replicas: List[_Replica] = [
+            _Replica(i, d, self.config.serve)
+            for i, d in enumerate(devs)]
+        for rep in self._replicas:
+            # the fault-listener seam: replica i's dispatch tells US
+            # about a device_loss before its retry ladder absorbs it
+            rep.queue.add_fault_listener(
+                lambda ev, idx=rep.idx: self._on_replica_fault(idx, ev))
+        self._ndev = len(self._replicas)
+        self._sharded = _ShardedLane(mesh=mesh, nb=self.config.shard_nb)
+        self._closed = False
+        metrics.set_gauge("fleet.replicas", float(self._ndev))
+
+    # -- introspection -----------------------------------------------------
+
+    def replica_states(self) -> List[str]:
+        """Availability per replica (closed = serving)."""
+        with self._lock:
+            return [r.state for r in self._replicas]
+
+    def backlog_seconds(self) -> List[float]:
+        """Model-predicted queued work per replica."""
+        with self._lock:
+            return [r.backlog_s for r in self._replicas]
+
+    # -- placement ---------------------------------------------------------
+
+    def _route(self, op: str, operands: tuple) -> str:
+        """``"replica"`` or ``"sharded"`` from the autotuned ``route``
+        site (bundle-resolvable; analytic fallback)."""
+        if not self.config.enable_sharded or self._ndev <= 1 \
+                or op not in ("posv", "gesv", "gels"):
+            return "replica"
+        from ..perf import autotune
+
+        a = operands[0]
+        n = a.shape[0]
+        try:
+            return autotune.select("route", serve_op=op, n=int(n),
+                                   ndev=self._ndev, dtype=a.dtype)
+        except Exception:
+            metrics.inc("fleet.route.errors")
+            return "replica"
+
+    def _predict(self, op: str, operands: tuple) -> float:
+        a = operands[0]
+        dims = tuple(a.shape) if op in ("geqrf", "gels") \
+            else (a.shape[0],)
+        nrhs = 1
+        if op in ("posv", "gesv", "gels"):
+            b = operands[1]
+            nrhs = 1 if getattr(b, "ndim", 1) == 1 else b.shape[1]
+        dt = str(getattr(a, "dtype", "float32"))
+        short = {"float32": "fp32", "float64": "fp64",
+                 "complex64": "c64", "complex128": "c128"}.get(dt,
+                                                               "fp32")
+        plat = getattr(self._replicas[0].device, "platform", "cpu")
+        try:
+            return _attr.predict_request_seconds(
+                op, dims, nrhs=nrhs, dtype=short,
+                platform=plat if plat in ("tpu", "cpu") else "cpu")
+        except Exception:
+            metrics.inc("fleet.predict.errors")
+            return 1e-4
+
+    def _pick_replica(self, pred_s: float) -> _Replica:
+        """Shortest predicted completion among AVAILABLE replicas:
+        argmin(backlog_s + this request's predicted wall) — ties break
+        to the lowest index for determinism."""
+        with self._lock:
+            live = [r for r in self._replicas if r.state == "closed"]
+            if not live:
+                raise SlateError(
+                    "fleet: no replica available (all draining or "
+                    "lost); retry after recovery")
+            best = min(live, key=lambda r: (r.backlog_s, r.idx))
+            best.backlog_s += pred_s
+            return best
+
+    def _settle(self, rep: _Replica, pred_s: float) -> None:
+        with self._lock:
+            rep.backlog_s = max(0.0, rep.backlog_s - pred_s)
+
+    # -- the public submit -------------------------------------------------
+
+    def submit(self, op: str, *operands,
+               deadline_s: Optional[float] = None, priority: int = 0
+               ) -> concurrent.futures.Future:
+        """Place one problem on the fleet; returns the Future of its
+        result (same per-op output contract as
+        :meth:`BatchQueue.submit`).  ``priority`` > 0 may preempt
+        queued lower-priority work when the chosen replica is at its
+        backpressure bound."""
+        if self._closed:
+            raise RuntimeError("Router is closed")
+        if op not in SUPPORTED_OPS:
+            raise KeyError(f"unsupported serve op {op!r}; "
+                           f"known: {sorted(SUPPORTED_OPS)}")
+        if len(operands) != SUPPORTED_OPS[op]:
+            raise TypeError(f"{op} takes {SUPPORTED_OPS[op]} operands, "
+                            f"got {len(operands)}")
+        lane = self._route(op, operands)
+        metrics.inc("fleet.requests")
+        if lane == "sharded":
+            return self._submit_sharded(op, operands)
+        return self._submit_replica(op, operands, deadline_s, priority)
+
+    def _submit_sharded(self, op: str, operands: tuple
+                        ) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        t0 = time.perf_counter()
+        metrics.inc("fleet.routed.sharded")
+
+        def _done(f: concurrent.futures.Future) -> None:
+            _telemetry.observe_fleet(
+                "request", lane="sharded", op=op,
+                latency_s=time.perf_counter() - t0,
+                error=f.exception() is not None)
+
+        fut.add_done_callback(_done)
+        self._sharded.submit(op, operands, fut)
+        return fut
+
+    def _submit_replica(self, op: str, operands: tuple,
+                        deadline_s: Optional[float], priority: int
+                        ) -> concurrent.futures.Future:
+        pred = self._predict(op, operands)
+        metrics.inc("fleet.routed.replica")
+        last: Optional[BaseException] = None
+        for _attempt in range(2):
+            rep = self._pick_replica(pred)
+            try:
+                fut = rep.queue.submit(op, *operands,
+                                       deadline_s=deadline_s,
+                                       priority=priority)
+            except Backpressure as e:
+                self._settle(rep, pred)
+                last = e
+                if priority <= 0:
+                    raise
+                # the priority-class lever: evict queued lower-priority
+                # work (each victim fails with the retryable Preempted
+                # signal) and try once more
+                depth = self.config.preempt_depth
+                if depth is None:
+                    depth = _env_int(ENV_PREEMPT_DEPTH, 16)
+                n_evicted = rep.queue.preempt(min_priority=priority,
+                                              max_evict=depth)
+                metrics.inc("fleet.preempt.evicted", float(n_evicted))
+                _telemetry.observe_fleet("preempt", replica=rep.idx,
+                                         op=op, evicted=n_evicted)
+                if n_evicted == 0:
+                    raise
+                continue
+            t0 = time.perf_counter()
+
+            def _done(f: concurrent.futures.Future, rep=rep,
+                      pred=pred) -> None:
+                self._settle(rep, pred)
+                _telemetry.observe_fleet(
+                    "request", replica=rep.idx, lane="replica", op=op,
+                    latency_s=time.perf_counter() - t0,
+                    error=f.exception() is not None)
+
+            fut.add_done_callback(_done)
+            return fut
+        raise last if last is not None else SlateError("fleet submit")
+
+    # -- elastic degradation -----------------------------------------------
+
+    def _set_state(self, rep: _Replica, state: str) -> None:
+        with self._lock:
+            rep.state = state
+        metrics.inc("fleet.breaker.%s" % state)
+        _telemetry.observe_fleet("breaker", replica=rep.idx,
+                                 state=state)
+        _blackbox.record("fleet.breaker", replica=rep.idx, state=state)
+
+    def _on_replica_fault(self, idx: int, ev: dict) -> None:
+        """Replica ``idx``'s dispatch saw a device_loss (fault-listener
+        callback, runs ON the replica's dispatcher thread — everything
+        heavy goes to the recovery thread)."""
+        if ev.get("kind") != "device_loss":
+            return
+        rep = self._replicas[idx]
+        with self._lock:
+            if rep.state != "closed":
+                return              # already draining/probing
+            rep.state = "open"
+            rep.losses += 1
+        metrics.inc("fleet.device_loss")
+        metrics.inc("fleet.breaker.open")
+        _telemetry.observe_fleet("breaker", replica=idx, state="open")
+        _blackbox.record("fleet.device_loss", replica=idx,
+                         op=ev.get("op"))
+        # drain around the lost replica: every queued-not-dispatched
+        # request re-files on a healthy replica, chained into its
+        # ORIGINAL future — zero stranded (in-flight work resolves
+        # through the queue's own retry → singles ladder)
+        drained = rep.queue.drain_queued()
+        metrics.inc("fleet.drained", float(len(drained)))
+        _telemetry.observe_fleet("drain", replica=idx,
+                                 requests=len(drained))
+        _blackbox.record("fleet.drain", replica=idx,
+                         requests=len(drained))
+        for op, operands, fut, deadline, priority in drained:
+            self._refile(op, operands, fut, priority)
+        threading.Thread(target=self._recover, args=(idx,),
+                         name="slate-fleet-recover-%d" % idx,
+                         daemon=True).start()
+
+    def _refile(self, op: str, operands: tuple,
+                fut: concurrent.futures.Future, priority: int) -> None:
+        """Re-place one drained request and chain the new future into
+        the original one the caller already holds."""
+        try:
+            inner = self._submit_replica(op, operands, None, priority)
+        except Exception as e:
+            if not fut.done():
+                fut.set_exception(e)
+            return
+
+        def _chain(f: concurrent.futures.Future) -> None:
+            if fut.done():
+                return
+            e = f.exception()
+            if e is not None:
+                fut.set_exception(e)
+            else:
+                fut.set_result(f.result())
+
+        inner.add_done_callback(_chain)
+
+    def _recover(self, idx: int) -> None:
+        """The lost replica's recovery thread: cooldown → half_open →
+        residual-gated re-verification on the device → rejoin, with
+        ONE flight-recorder bundle for the whole incident."""
+        rep = self._replicas[idx]
+        cool = self.config.cooldown_s
+        if cool is None:
+            cool = _env_float(ENV_COOLDOWN, 0.25)
+        for probe in range(max(1, self.config.rejoin_attempts)):
+            time.sleep(cool * (2 ** min(probe, 4)))
+            self._set_state(rep, "half_open")
+            if _health.reverify(device=rep.device):
+                with self._lock:
+                    rep.state = "closed"
+                    rep.backlog_s = 0.0
+                metrics.inc("fleet.breaker.closed")
+                metrics.inc("fleet.rejoin")
+                _telemetry.observe_fleet("rejoin", replica=idx,
+                                         probes=probe + 1)
+                _telemetry.observe_fleet("breaker", replica=idx,
+                                         state="closed")
+                _blackbox.record("fleet.rejoin", replica=idx,
+                                 probes=probe + 1)
+                # exactly ONE bundle per incident, carrying the whole
+                # device_loss → drain → half_open → rejoin event chain
+                # in its ring
+                _blackbox.trigger(
+                    "fleet.recovered",
+                    detail="replica %d: device_loss -> drain -> "
+                           "reverify -> rejoin" % idx)
+                return
+            self._set_state(rep, "open")
+        metrics.inc("fleet.rejoin_failed")
+        _telemetry.observe_fleet("degraded", replica=idx)
+        _blackbox.trigger(
+            "fleet.degraded",
+            detail="replica %d failed %d re-verification probes; "
+                   "left open" % (idx, self.config.rejoin_attempts))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def warm_start(self, specs: Optional[list] = None) -> int:
+        """Distribute the warm-start specs (default: the PR 11 bundle's
+        AOT bucket specs, falling back to the persisted autotune cache)
+        to EVERY replica — after this each replica serves its first
+        bucketed request with zero timing reps and zero on-demand
+        compiles.  Returns total executables compiled."""
+        if specs is None:
+            specs = specs_from_bundle() or specs_from_autotune_cache()
+        done = 0
+        for rep in self._replicas:
+            done += _queue_warm_start(rep.queue, specs=specs)
+        metrics.inc("fleet.warm_start.compiled", float(done))
+        return done
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every replica's queued AND in-flight work has
+        resolved (per-replica :meth:`BatchQueue.flush` semantics)."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + timeout
+        for rep in self._replicas:
+            rem = None if deadline is None \
+                else max(0.0, deadline - time.perf_counter())
+            rep.queue.flush(timeout=rem)
+        # the sharded lane: wait for its queue to empty
+        while not self._sharded._q.empty():
+            if deadline is not None and time.perf_counter() >= deadline:
+                raise TimeoutError("fleet sharded lane still busy")
+            time.sleep(0.005)
+
+    def close(self) -> None:
+        """Stop the sharded lane and close every replica queue (each
+        FAILS — never strands — its still-queued futures)."""
+        self._closed = True
+        self._sharded.stop()
+        for rep in self._replicas:
+            rep.queue.close()

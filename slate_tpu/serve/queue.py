@@ -117,10 +117,11 @@ off-by-default, one attribute read per entry point when unset:
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..exceptions import SlateError
 from ..perf import blackbox as _blackbox
@@ -132,14 +133,25 @@ from ..resilience import inject as _inject
 from ..resilience.breaker import CircuitBreaker
 from ..resilience.retry import transient_infra, with_backoff
 
-__all__ = ["ServeConfig", "BatchQueue", "Backpressure", "warm_start",
-           "get_server", "submit", "shutdown", "SUPPORTED_OPS",
-           "specs_from_bundle"]
+__all__ = ["ServeConfig", "BatchQueue", "Backpressure", "Preempted",
+           "warm_start", "get_server", "submit", "shutdown",
+           "SUPPORTED_OPS", "specs_from_bundle"]
 
 
 class Backpressure(SlateError):
     """The queue is at its depth bound — explicit backpressure: the
     caller should shed load or retry later, not enqueue unboundedly."""
+
+
+class Preempted(SlateError):
+    """This queued-not-dispatched request was EVICTED to make room for
+    higher-priority work (the fleet router's preemption ladder,
+    ISSUE 20) — a retryable signal back to the caller: the problem was
+    never dispatched, so resubmitting (at lower urgency, or elsewhere)
+    is always safe.  ``retryable`` marks it for
+    :func:`slate_tpu.resilience.retry.transient_infra`."""
+
+    retryable = True
 
 
 class _UnhealthyBatch(SlateError):
@@ -206,6 +218,17 @@ class ServeConfig:
       of this queue's buckets open that bucket's circuit breaker and
       quarantine the batched driver's settled autotune winners
       (``SLATE_TPU_SENTINEL_TRIP=1`` is the env-side opt-in).
+
+    Fleet knobs (ISSUE 20 — one BatchQueue per device replica):
+
+    * ``device`` — the jax device this queue's executables compile and
+      run on (None: the process default).  The fleet router pins one
+      queue per ``jax.devices()`` entry through this.
+    * ``inject_site`` — an EXTRA fault-injection site polled per
+      dispatch alongside the shared ``serve.dispatch`` site, so a
+      chaos plan can kill ONE replica
+      (``fleet.replica0=device_loss:...``) instead of whichever
+      replica dispatches next.
     """
 
     max_batch: int = 64
@@ -219,6 +242,8 @@ class ServeConfig:
     max_queue_depth: int = 4096
     slo_ms: Optional[float] = None
     sentinel_trip: bool = False
+    device: Optional[object] = None
+    inject_site: Optional[str] = None
 
 
 @dataclass(eq=False)
@@ -230,12 +255,13 @@ class _Request:
     t_submit: float = field(default_factory=time.perf_counter)
     deadline: Optional[float] = None    # absolute perf_counter time
     trace_id: Optional[int] = None      # minted when telemetry is on
+    priority: int = 0                   # higher = more urgent (fleet)
 
 
 #: op name → number of operands.  Every op maps onto one batched driver
 #: facade; results are the driver's natural per-problem output.
 SUPPORTED_OPS = {"potrf": 1, "getrf": 1, "posv": 2, "gesv": 2,
-                 "geqrf": 1, "gels": 2}
+                 "geqrf": 1, "gels": 2, "heev": 1}
 
 
 def _exec_key(op: str, dt: str, pol: str, dims: tuple,
@@ -252,7 +278,7 @@ def _exec_key(op: str, dt: str, pol: str, dims: tuple,
     what keeps the anchors in bounds (and the padded operand full
     column rank).  The nrhs bucket uses floor 1 — the common single-rhs
     solve must not pay an 8-column pad."""
-    if op in ("potrf", "getrf"):
+    if op in ("potrf", "getrf", "heev"):
         return (op, dt, _bucket(dims[0], pol))
     if op in ("posv", "gesv"):
         return (op, dt, _bucket(dims[0], pol),
@@ -282,6 +308,29 @@ def _pad_square(a, big):
     out[:n, :n] = np.asarray(a)
     idx = np.arange(n, big)
     out[idx, idx] = 1.0
+    return out
+
+
+def _pad_heev(a, big):
+    """Embed a Hermitian (n, n) into (N, N) as ``[[A, 0], [0, αI]]``
+    with α STRICTLY above A's spectral radius (the ∞-norm bound, +1):
+    block-diagonal, so the padded problem's spectrum is A's eigenpairs
+    — eigenvectors exactly ``[v; 0]`` — plus the padded block's
+    (α, eᵢ).  Because α > λmax(A) and ``eigh`` sorts ascending, the
+    leading problem's eigenpairs occupy exactly the first n slots;
+    plain identity padding (α = 1) would interleave the padded
+    eigenvalues into A's spectrum and scramble the slices."""
+    import numpy as np
+
+    n = a.shape[0]
+    av = np.asarray(a)
+    if big == n:
+        return av
+    out = np.zeros((big, big), av.dtype)
+    out[:n, :n] = av
+    alpha = float(np.abs(av).sum(axis=1).max().real) + 1.0
+    idx = np.arange(n, big)
+    out[idx, idx] = alpha
     return out
 
 
@@ -329,6 +378,7 @@ class BatchQueue:
         self._wake = threading.Condition(self._lock)
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self._fault_listeners: List[Callable[[dict], None]] = []
         # streaming exporters the environment asks for start HERE (the
         # front door's constructor), never at import; pure no-op with
         # no telemetry env knob set
@@ -357,16 +407,18 @@ class BatchQueue:
     # -- public API --------------------------------------------------------
 
     def submit(self, op: str, *operands,
-               deadline_s: Optional[float] = None
+               deadline_s: Optional[float] = None, priority: int = 0
                ) -> concurrent.futures.Future:
         """File one problem; returns the Future of its result (the
         batched driver's per-problem output: potrf→L, getrf→(LU, perm),
-        posv/gesv/gels→x, geqrf→(packed, taus)).
+        posv/gesv/gels→x, geqrf→(packed, taus), heev→(w, Z)).
 
         ``deadline_s`` (default :attr:`ServeConfig.deadline_s`): a
         request still queued past its deadline resolves with
         ``TimeoutError``.  Raises :class:`Backpressure` when the queue
-        is at :attr:`ServeConfig.max_queue_depth`."""
+        is at :attr:`ServeConfig.max_queue_depth`.  ``priority`` tags
+        the request for :meth:`preempt` (higher = more urgent; the
+        fleet router's priority classes)."""
         if op not in SUPPORTED_OPS:
             raise KeyError(f"unsupported serve op {op!r}; "
                            f"known: {sorted(SUPPORTED_OPS)}")
@@ -378,7 +430,8 @@ class BatchQueue:
             deadline_s = self.config.deadline_s
         req = _Request(operands=tuple(operands),
                        shape=tuple(getattr(x, "shape", ())
-                                   for x in operands))
+                                   for x in operands),
+                       priority=int(priority))
         if deadline_s is not None:
             req.deadline = req.t_submit + float(deadline_s)
         if _telemetry.enabled():
@@ -426,6 +479,86 @@ class BatchQueue:
                         f"pending after {timeout}s")
                 self._wake.wait(timeout=rem if rem is not None
                                 else self.config.max_wait_s)
+
+    def queue_depth(self) -> int:
+        """Total queued-not-dispatched requests (the fleet router's
+        backlog signal; the ``serve.queue.depth`` gauge's instantaneous
+        read)."""
+        with self._lock:
+            return sum(len(v) for v in self._buckets.values())
+
+    def preempt(self, min_priority: int = 1,
+                max_evict: Optional[int] = None) -> int:
+        """Evict queued-NOT-dispatched requests whose priority is below
+        ``min_priority`` (newest first — the least-sunk work), failing
+        each future with :class:`Preempted` — a retryable signal back
+        to the caller, never a silent drop.  In-flight batches are
+        untouched (a dispatched request always resolves normally).
+        Returns the number evicted.  This is the fleet router's
+        priority-class lever on the PR 9 backpressure machinery: a
+        high-priority submit that meets :class:`Backpressure` evicts
+        low-priority work instead of failing."""
+        with self._wake:
+            cands = [r for reqs in self._buckets.values() for r in reqs
+                     if r.priority < min_priority]
+            cands.sort(key=lambda r: r.t_submit, reverse=True)
+            if max_evict is not None:
+                cands = cands[:max(0, int(max_evict))]
+            victims = {id(r) for r in cands}
+            for key in list(self._buckets):
+                keep = [r for r in self._buckets[key]
+                        if id(r) not in victims]
+                if keep:
+                    self._buckets[key] = keep
+                else:
+                    del self._buckets[key]
+            self._wake.notify_all()
+        for r in cands:
+            metrics.inc("serve.preempted")
+            if not r.future.done():
+                r.future.set_exception(Preempted(
+                    "request evicted for higher-priority work; "
+                    "resubmit (retryable)"))
+        return len(cands)
+
+    def drain_queued(self) -> List[tuple]:
+        """Pop EVERY queued-not-dispatched request and return
+        ``(op, operands, future, deadline, priority)`` tuples — the
+        fleet router's drain-around-a-lost-replica path: it re-files
+        the operands on a healthy replica and chains the result into
+        the original future, so a device loss strands zero futures.
+        The queue keeps running (in-flight work resolves normally)."""
+        with self._wake:
+            drained = [(key[0], r) for key, reqs in self._buckets.items()
+                       for r in reqs]
+            self._buckets.clear()
+            self._wake.notify_all()
+        out = []
+        for op, r in drained:
+            metrics.inc("serve.drained")
+            out.append((op, r.operands, r.future, r.deadline,
+                        r.priority))
+        return out
+
+    def add_fault_listener(self, fn: Callable[[dict], None]) -> None:
+        """Register a best-effort callback for dispatch-level fault
+        events (today: ``{"kind": "device_loss", "op": ...}`` before
+        the transient retry ladder absorbs it) — the fleet router's
+        seam for tripping a replica-level breaker without reaching into
+        queue internals.  Listener exceptions are swallowed (a monitor
+        must never kill the dispatcher)."""
+        with self._lock:
+            if fn not in self._fault_listeners:
+                self._fault_listeners.append(fn)
+
+    def _notify_fault(self, event: dict) -> None:
+        with self._lock:
+            listeners = list(self._fault_listeners)
+        for fn in listeners:
+            try:
+                fn(dict(event))
+            except Exception:
+                metrics.inc("serve.fault_listener_errors")
 
     def close(self) -> None:
         """Stop accepting work, drain what the dispatcher can, then
@@ -571,6 +704,18 @@ class BatchQueue:
 
     # -- executables -------------------------------------------------------
 
+    def _device_scope(self):
+        """``jax.default_device`` pinned to this queue's replica device
+        (:attr:`ServeConfig.device`) — compilation AND execution run
+        under it, so a fleet of queues genuinely spreads over
+        ``jax.devices()`` instead of stacking on device 0.  A
+        null context when unpinned."""
+        if self.config.device is None:
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(self.config.device)
+
     def _driver(self, op: str):
         from ..linalg import batched as B
 
@@ -581,13 +726,14 @@ class BatchQueue:
             "gesv": lambda a, b: B.gesv_batched(a, b)[2],
             "geqrf": lambda a: B.geqrf_batched(a),
             "gels": lambda a, b: B.gels_batched(a, b),
+            "heev": lambda a: B.heev_batched(a),
         }[op]
 
     def _avals(self, key: tuple, bexec: int):
         import jax
 
         op, dt = key[0], key[1]
-        if op in ("potrf", "getrf"):
+        if op in ("potrf", "getrf", "heev"):
             n = key[2]
             return (jax.ShapeDtypeStruct((bexec, n, n), dt),)
         if op in ("posv", "gesv"):
@@ -620,7 +766,8 @@ class BatchQueue:
         else:
             metrics.inc("serve.warm_start.compiled")
         fn = self._driver(key[0])
-        ex = jax.jit(fn).lower(*self._avals(key, bexec)).compile()
+        with self._device_scope():
+            ex = jax.jit(fn).lower(*self._avals(key, bexec)).compile()
         with self._lock:
             self._compiled[ck] = ex
         return ex, True
@@ -826,17 +973,31 @@ class BatchQueue:
         import numpy as np
 
         def attempt():
-            kind = _inject.poll("serve.dispatch")
+            # the replica-scoped site (ServeConfig.inject_site) polls
+            # FIRST so a plan can target ONE fleet replica even while
+            # a fleet-wide serve.dispatch schedule (e.g. an emulated
+            # device wall) is active on every dispatch
+            kind = None
+            site = "serve.dispatch"
+            if self.config.inject_site:
+                kind = _inject.poll(self.config.inject_site)
+                if kind is not None:
+                    site = self.config.inject_site
+            if kind is None:
+                kind = _inject.poll("serve.dispatch")
             if kind == "error":
-                raise _inject.InjectedFault("serve.dispatch")
+                raise _inject.InjectedFault(site)
             if kind == "device_loss":
                 # a device dying under a batch (ISSUE 14): transient
                 # like any infra blip (the classified retry / singles
                 # fallback absorb it), but counted apart — a run of
                 # serve.device_loss means hardware attrition, not
-                # queue-tuning trouble
+                # queue-tuning trouble.  Fault listeners (the fleet
+                # router) hear it BEFORE the retry ladder absorbs it.
                 metrics.inc("serve.device_loss")
-                raise _inject.DeviceLoss("serve.dispatch")
+                self._notify_fault({"kind": "device_loss",
+                                    "op": key[0], "site": site})
+                raise _inject.DeviceLoss(site)
             if kind == "slow":
                 # the injected sustained-latency degradation the live
                 # sentinel classifies (ISSUE 10)
@@ -861,7 +1022,7 @@ class BatchQueue:
                 except Exception:
                     metrics.inc("telemetry.observe_errors")
             stacked = self._pad_stack(key, reqs, bexec, np)
-            with metrics.timer("serve.dispatch"):
+            with metrics.timer("serve.dispatch"), self._device_scope():
                 out = ex(*stacked)
                 out = tuple(np.asarray(o) for o in (
                     out if isinstance(out, (tuple, list)) else (out,)))
@@ -903,7 +1064,7 @@ class BatchQueue:
         if t_pop is None:
             t_pop = time.perf_counter()
         fn = self._driver(key[0])
-        with _health.safe_backend():
+        with _health.safe_backend(), self._device_scope():
             for r in reqs:
                 if r.future.done():
                     continue
@@ -964,6 +1125,17 @@ class BatchQueue:
             pads = [np.broadcast_to(fill, (bexec - len(reqs), n, n))]
             return (np.concatenate([a.astype(dt)] + pads)
                     if bexec > len(reqs) else a.astype(dt),)
+        if op == "heev":
+            # per-problem α·I padding keeps each leading problem's
+            # eigenpairs in the first n slots (see _pad_heev); the
+            # batch-occupancy fill is a plain identity — its results
+            # are discarded
+            n = key[2]
+            a = np.stack([_pad_heev(r.operands[0], n) for r in reqs])
+            fill = np.eye(n, dtype=dt)[None]
+            pads = [np.broadcast_to(fill, (bexec - len(reqs), n, n))]
+            return (np.concatenate([a.astype(dt)] + pads)
+                    if bexec > len(reqs) else a.astype(dt),)
         if op in ("posv", "gesv"):
             n, k = key[2], key[3]
             a = np.stack([_pad_square(r.operands[0], n) for r in reqs])
@@ -1005,6 +1177,11 @@ class BatchQueue:
         if op == "getrf":
             n = a_shape[0]
             return out[0][i, :n, :n], out[1][i, :n]
+        if op == "heev":
+            # ascending eigh + α > λmax padding: A's eigenpairs are
+            # exactly the first n slots, eigenvectors [v; 0]
+            n = a_shape[0]
+            return out[0][i, :n], out[1][i, :n, :n]
         if op in ("posv", "gesv", "gels"):
             n = a_shape[0] if op != "gels" else a_shape[1]
             b_shape = req.shape[1]
@@ -1050,7 +1227,8 @@ def shutdown() -> None:
 #: autotune batched-site op → the serve ops its cache keys warm
 _SITE_TO_OPS = {"batched_potrf": ("potrf", "posv"),
                 "batched_lu": ("getrf", "gesv"),
-                "batched_qr": ("geqrf",)}
+                "batched_qr": ("geqrf",),
+                "batched_heev": ("heev",)}
 
 
 def specs_from_autotune_cache() -> List[dict]:

@@ -597,3 +597,108 @@ def test_xprof_knob_documented():
     assert "SLATE_TPU_XPROF" in docs, \
         "SLATE_TPU_XPROF missing from docs/usage.md"
     assert "Device-truth profiling" in docs
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: the fleet router (serve/fleet.py)
+# ---------------------------------------------------------------------------
+
+#: every intra-package module fleet.py may import: the public serve /
+#: perf / resilience facades plus the parallel package facade (the
+#: sharded lane's p* drivers).  Reaching past these — linalg drivers,
+#: ops kernels, private registry modules — would bypass the autotune
+#: table and the health ladder.
+_FLEET_ALLOWED_IMPORTS = {
+    "exceptions", "parallel", "perf.attr", "perf.autotune",
+    "perf.blackbox", "perf.metrics", "perf.telemetry",
+    "resilience.health", "serve.queue",
+}
+
+_FLEET_FROM_RE = re.compile(
+    r"^\s*from\s+(\.+|slate_tpu\.?)([\w.]*)\s+import\s+(.+)")
+_FLEET_IMPORT_RE = re.compile(r"^\s*import\s+slate_tpu([\w.]*)")
+
+
+def test_fleet_imports_public_facades_only():
+    """ISSUE 20 guard: serve/fleet.py composes EXISTING subsystems —
+    it may touch only the public serve/perf/resilience/parallel
+    facades, never the linalg/ops layers underneath them."""
+    offenders = []
+    path = _PKG / "serve" / "fleet.py"
+    src = path.read_text().splitlines()
+    for lineno, line in enumerate(src, 1):
+        m = _FLEET_IMPORT_RE.match(line)
+        if m:
+            name = m.group(1).lstrip(".")
+            if name and name not in _FLEET_ALLOWED_IMPORTS:
+                offenders.append(f"fleet.py:{lineno}: {line.strip()}")
+            continue
+        m = _FLEET_FROM_RE.match(line)
+        if not m:
+            continue
+        dots, base, names = m.groups()
+        # one leading dot = the serve package; more (or slate_tpu) =
+        # the package root
+        prefix = "serve." if dots == "." else ""
+        base = (prefix + base).strip(".")
+        if base in _FLEET_ALLOWED_IMPORTS:
+            continue                   # e.g. from .queue import ...
+        # from <pkg> import <submodule>: each imported name must land
+        # on an allowlisted module (handles multi-line paren imports
+        # only for the single-name case fleet.py uses)
+        for name in names.split(","):
+            name = name.split(" as ")[0].strip(" ()\\")
+            if not name:
+                continue
+            full = (base + "." + name).strip(".")
+            if full not in _FLEET_ALLOWED_IMPORTS:
+                offenders.append(f"fleet.py:{lineno}: {line.strip()}")
+                break
+    assert not offenders, (
+        "serve/fleet.py imported outside its facade allowlist "
+        f"({sorted(_FLEET_ALLOWED_IMPORTS)}):\n" + "\n".join(offenders))
+
+
+def test_fleet_inert_at_import_and_construction():
+    """ISSUE 20 guard: with every fleet knob SET, importing the serve
+    package — and even CONSTRUCTING a Router — must spawn no threads
+    and start no exporters.  Each replica's dispatcher starts on its
+    first submit; the sharded lane's worker on its first sharded
+    request.  Subprocess so this process's own threads can't
+    contaminate."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import threading\n"
+        "before = {t.name for t in threading.enumerate()}\n"
+        "from slate_tpu.serve import FleetConfig, Router\n"
+        "fleet = Router(FleetConfig(replicas=2))\n"
+        "after = {t.name for t in threading.enumerate()}\n"
+        "assert after == before, after - before\n"
+        "assert fleet.replica_states() == ['closed', 'closed']\n"
+        "fleet.close()\n"
+        "print('OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLATE_TPU_FLEET_REPLICAS="2",
+               SLATE_TPU_FLEET_SHARD_MS="10",
+               SLATE_TPU_FLEET_PREEMPT_DEPTH="4",
+               SLATE_TPU_FLEET_COOLDOWN_S="0.1")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         cwd=str(_PKG.parent), capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0 and "OK" in out.stdout, \
+        (out.stdout, out.stderr)
+
+
+def test_fleet_knobs_documented():
+    """The fleet-serving knobs must be registered in the user-facing
+    knob table (docs/usage.md) — an undocumented routing knob is an
+    invisible one."""
+    docs = (_PKG.parent / "docs" / "usage.md").read_text()
+    for knob in ("SLATE_TPU_FLEET_REPLICAS", "SLATE_TPU_FLEET_SHARD_MS",
+                 "SLATE_TPU_FLEET_PREEMPT_DEPTH",
+                 "SLATE_TPU_FLEET_COOLDOWN_S"):
+        assert knob in docs, f"{knob} missing from docs/usage.md"
+    assert "Fleet serving" in docs

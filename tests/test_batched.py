@@ -138,6 +138,27 @@ class TestResidualVsScipy:
             assert np.allclose(x[i], ref, atol=1e-2 if dtype == np.float32
                                else 1e-7)
 
+    @pytest.mark.parametrize("b", (1, 7))
+    @pytest.mark.parametrize("dtype", DTYPES)
+    def test_heev(self, b, dtype):
+        n = 32
+        rng = np.random.default_rng(20)
+        g = rng.standard_normal((b, n, n)).astype(dtype)
+        a = 0.5 * (g + np.swapaxes(g, -1, -2))
+        w, z = batched.heev_batched(jnp.asarray(a))
+        w, z = np.asarray(w), np.asarray(z)
+        for i in range(b):
+            assert (np.diff(w[i]) >= 0).all(), "eigenvalues not ascending"
+            r = (np.linalg.norm(a[i] @ z[i] - z[i] * w[i])
+                 / (np.linalg.norm(a[i]) * _eps(dtype) * n))
+            assert r < 3, (i, r)
+            orth = (np.linalg.norm(z[i].T @ z[i] - np.eye(n))
+                    / (_eps(dtype) * n))
+            assert orth < 3, (i, orth)
+            ref = sla.eigvalsh(a[i].astype(np.float64))
+            assert np.allclose(w[i], ref, atol=100 * _eps(dtype)
+                               * np.abs(ref).max())
+
     @pytest.mark.parametrize("b", BATCHES)
     def test_posv_rhs_matrix(self, b):
         n, k = 32, 3

@@ -170,6 +170,33 @@ class TestServeCorrectness:
                    * eps * np.sqrt(50))) < 3
         srv.close()
 
+    def test_heev_served_eigenpairs_survive_padding(self):
+        """Served heev (ISSUE 20): two odd sizes in one queue, both
+        bucket-padded, so the [[A,0],[0,αI]] embedding is exercised —
+        the answers must be A's OWN eigenpairs (residual-gated,
+        ascending, orthonormal), not the padded block's."""
+        srv = BatchQueue(ServeConfig(max_batch=2, max_wait_s=0.005))
+        eps = float(np.finfo(np.float32).eps)
+        rng = np.random.default_rng(11)
+        futs = []
+        for n in (12, 20, 12):
+            g = rng.standard_normal((n, n)).astype(np.float32)
+            a = 0.5 * (g + g.T)
+            futs.append((a, srv.submit("heev", a)))
+        for a, fut in futs:
+            n = a.shape[0]
+            w, z = fut.result(timeout=60)
+            assert w.shape == (n,) and z.shape == (n, n)
+            assert (np.diff(w) >= 0).all(), "eigenvalues not ascending"
+            r = (np.linalg.norm(a @ z - z * w)
+                 / (np.linalg.norm(a) * eps * n))
+            assert r < 3, r
+            orth = np.linalg.norm(z.T @ z - np.eye(n)) / (eps * n)
+            assert orth < 3, orth
+            ref = np.linalg.eigvalsh(a.astype(np.float64))
+            assert np.allclose(w, ref, atol=100 * eps * np.abs(ref).max())
+        srv.close()
+
     def test_unknown_op_and_arity_rejected(self):
         srv = BatchQueue()
         with pytest.raises(KeyError):

@@ -696,3 +696,44 @@ class TestTelemetryReportCLI:
         assert abs(row["p50_ms"] - 6.5) < 1e-9
         assert blob["degradations"] == 1
         assert self._run(tmp_path, log, "--strict").returncode == 1
+
+    def test_fleet_rollup(self, tmp_path):
+        """The ISSUE 20 --fleet rollup: per-replica req/s + p99, the
+        breaker-transition timeline, incident counts and the
+        replica-vs-sharded split — still jax-free."""
+        log = str(tmp_path / "fleet.jsonl")
+        recs = (
+            [{"t": 100.0 + i, "kind": "fleet_request", "replica": i % 2,
+              "lane": "replica", "op": "posv",
+              "latency_ms": 5.0 + i, "error": False}
+             for i in range(8)]
+            + [{"t": 109.0, "kind": "fleet_request", "lane": "sharded",
+                "op": "gesv", "latency_ms": 250.0, "error": False},
+               {"t": 110.0, "kind": "fleet_breaker", "replica": 1,
+                "state": "open"},
+               {"t": 110.1, "kind": "fleet_drain", "replica": 1,
+                "requests": 3},
+               {"t": 111.0, "kind": "fleet_breaker", "replica": 1,
+                "state": "half_open"},
+               {"t": 111.5, "kind": "fleet_breaker", "replica": 1,
+                "state": "closed"},
+               {"t": 111.6, "kind": "fleet_rejoin", "replica": 1}])
+        with open(log, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        r = self._run(tmp_path, log, "--fleet")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "fleet rollup:" in r.stdout
+        assert "replica 0" in r.stdout and "sharded" in r.stdout
+        assert "breaker transitions: 3" in r.stdout
+        assert "drain=1" in r.stdout and "rejoin=1" in r.stdout
+        blob = json.loads(
+            self._run(tmp_path, log, "--fleet", "--json").stdout)
+        fleet = blob["fleet"]
+        assert fleet["lanes"] == {"replica": 8, "sharded": 1}
+        assert [t["state"] for t in fleet["breaker_transitions"]] \
+            == ["open", "half_open", "closed"]
+        rows = {row["lane"]: row for row in fleet["rows"]}
+        assert rows["replica 0"]["count"] == 4
+        assert rows["replica 1"]["p99_ms"] is not None
+        assert fleet["incidents"] == {"drain": 1, "rejoin": 1}

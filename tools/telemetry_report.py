@@ -26,6 +26,13 @@ over the record span; then lists the sentinel events.  A rotated
 sibling (``<path>.1``) is read first when present so the report spans
 the rotation.
 
+``--fleet`` additionally rolls up the fleet-router records
+(``fleet_request``/``fleet_breaker``/``fleet_*`` from
+:func:`slate_tpu.perf.telemetry.observe_fleet`): per-replica and
+sharded-lane req/s + p50/p99, the replica-vs-sharded routed split,
+the breaker-transition timeline and incident-event counts
+(preempt/drain/rejoin...).
+
 ``--blackbox BUNDLE`` joins a flight-recorder bundle
 (``slate_tpu.perf.blackbox``; rendered alone by ``tools/blackbox.py``)
 onto the sentinel events: for each degradation/infra event the report
@@ -151,6 +158,95 @@ def _fmt(v):
     return str(v)
 
 
+def aggregate_fleet(recs):
+    """Roll up the fleet-router records (``fleet_request``,
+    ``fleet_breaker`` and the free-form ``fleet_*`` incident events
+    that :func:`slate_tpu.perf.telemetry.observe_fleet` writes) into
+    per-lane rows, the breaker-transition timeline and incident-event
+    counts."""
+    rows = OrderedDict()
+    transitions = []
+    incidents = OrderedDict()
+    lanes = {"replica": 0, "sharded": 0}
+    for rec in recs:
+        kind = rec.get("kind")
+        if not isinstance(kind, str) or not kind.startswith("fleet_"):
+            continue
+        event = kind[len("fleet_"):]
+        if event == "request":
+            lane = str(rec.get("lane", "replica"))
+            key = ("replica %s" % rec["replica"]
+                   if rec.get("lane") != "sharded"
+                   and rec.get("replica") is not None else lane)
+            lanes[lane if lane in lanes else "replica"] += 1
+            row = rows.get(key)
+            if row is None:
+                row = rows[key] = {"lane": key, "count": 0,
+                                   "errors": 0, "lat_ms": [],
+                                   "t_min": None, "t_max": None}
+            row["count"] += 1
+            t = rec.get("t")
+            if isinstance(t, (int, float)):
+                row["t_min"] = t if row["t_min"] is None \
+                    else min(row["t_min"], t)
+                row["t_max"] = t if row["t_max"] is None \
+                    else max(row["t_max"], t)
+            if rec.get("error"):
+                row["errors"] += 1
+            elif isinstance(rec.get("latency_ms"), (int, float)):
+                row["lat_ms"].append(float(rec["latency_ms"]))
+        elif event == "breaker":
+            transitions.append((rec.get("t"), rec.get("replica"),
+                                str(rec.get("state", "?"))))
+        else:
+            incidents[event] = incidents.get(event, 0) + 1
+    for row in rows.values():
+        lat = sorted(row.pop("lat_ms"))
+        span = ((row["t_max"] - row["t_min"])
+                if row["t_min"] is not None
+                and row["t_max"] is not None else 0.0)
+        row["p50_ms"] = quantile(lat, 0.50)
+        row["p99_ms"] = quantile(lat, 0.99)
+        row["req_per_s"] = (row["count"] / span) if span > 0 else None
+        del row["t_min"], row["t_max"]
+    return rows, transitions, incidents, lanes
+
+
+def format_fleet(rows, transitions, incidents, lanes):
+    out = ["fleet rollup:"]
+    heads = ["lane", "count", "err", "p50_ms", "p99_ms", "req/s"]
+    body = [[r["lane"], r["count"], r["errors"], _fmt(r["p50_ms"]),
+             _fmt(r["p99_ms"]), _fmt(r["req_per_s"])]
+            for r in rows.values()]
+    if body:
+        widths = [max(len(str(row[i])) for row in [heads] + body)
+                  for i in range(len(heads))]
+        for row in [heads] + body:
+            out.append("  " + "  ".join(
+                str(c).ljust(w)
+                for c, w in zip(row, widths)).rstrip())
+    else:
+        out.append("  no fleet_request records")
+    total = sum(lanes.values())
+    if total:
+        out.append("")
+        out.append("  routed split: replica=%d sharded=%d (%.1f%% "
+                   "sharded)" % (lanes["replica"], lanes["sharded"],
+                                 100.0 * lanes["sharded"] / total))
+    out.append("")
+    if transitions:
+        out.append("  breaker transitions: %d" % len(transitions))
+        for t, replica, state in transitions:
+            out.append("    [%s] replica %s -> %s"
+                       % (_fmt(t), _fmt(replica), state))
+    else:
+        out.append("  breaker transitions: none")
+    if incidents:
+        out.append("  incident events: " + "  ".join(
+            "%s=%d" % (k, v) for k, v in incidents.items()))
+    return "\n".join(out)
+
+
 def load_blackbox(path):
     """The bundle's event ring + trigger header (``None`` + a reason on
     any parse problem — the join must degrade, not crash the report)."""
@@ -266,6 +362,10 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 when the log carries any sentinel "
                          "degradation event")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also roll up the fleet-router records: "
+                         "per-replica req/s + p99, breaker "
+                         "transitions, replica-vs-sharded split")
     ap.add_argument("--blackbox",
                     help="flight-recorder bundle to correlate the "
                          "sentinel events against (ring events within "
@@ -280,6 +380,7 @@ def main(argv=None) -> int:
     degradations = [e for e in events
                     if e.get("classification") == "degradation"]
     bundle = bb_err = pairs = None
+    fleet = aggregate_fleet(recs) if args.fleet else None
     if args.blackbox:
         bundle, bb_err = load_blackbox(args.blackbox)
         pairs = correlate_blackbox(events, bundle,
@@ -290,6 +391,15 @@ def main(argv=None) -> int:
             "rows": list(rows.values()), "sentinel_events": events,
             "degradations": len(degradations),
         }
+        if fleet is not None:
+            f_rows, transitions, incidents, lanes = fleet
+            blob["fleet"] = {
+                "rows": list(f_rows.values()),
+                "breaker_transitions": [
+                    {"t": t, "replica": r, "state": s}
+                    for t, r, s in transitions],
+                "incidents": dict(incidents), "lanes": lanes,
+            }
         if args.blackbox:
             blob["blackbox"] = {
                 "path": args.blackbox, "error": bb_err,
@@ -300,6 +410,9 @@ def main(argv=None) -> int:
         print(json.dumps(blob, indent=1))
     else:
         print(format_tables(rows, events, last_snapshot))
+        if fleet is not None:
+            print()
+            print(format_fleet(*fleet))
         if args.blackbox:
             print(format_blackbox_join(pairs or [], args.blackbox,
                                        bb_err))
